@@ -1,0 +1,40 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Re-imagination of the reference framework (craigbrownphd/Paddle, Fluid era)
+for TPU: the serializable Program IR survives (build -> transform -> run),
+but execution lowers whole blocks into single XLA computations via JAX,
+parallelism is expressed as shardings over a ``jax.sharding.Mesh`` (XLA
+collectives over ICI replace NCCL rings and gRPC parameter servers), and hot
+kernels beyond XLA's fusion reach are Pallas.
+
+Public surface mirrors ``python/paddle/fluid``:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", shape=[784])
+    y = fluid.layers.fc(x, size=10, act="softmax")
+    ...
+    exe = fluid.Executor(fluid.TPUPlace(0))
+"""
+
+from . import ops  # registers the op library
+from . import initializer, layers, optimizer, regularizer, unique_name  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from .core import (  # noqa: F401
+    CPUPlace,
+    DataType,
+    Executor,
+    Place,
+    Program,
+    Scope,
+    TPUPlace,
+    Variable,
+    append_backward,
+    default_main_program,
+    default_startup_program,
+    default_place,
+    global_scope,
+    program_guard,
+    reset_default_programs,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+from .autodiff import append_backward, calc_gradient  # noqa: F401
+from .executor import Executor, Scope, global_scope  # noqa: F401
+from .ir import (  # noqa: F401
+    Block,
+    Operator,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    program_guard,
+    reset_default_programs,
+)
+from .registry import ExecContext, OpDef, get_op_def, has_op, register_op  # noqa: F401
+from .types import CPUPlace, DataType, Place, TPUPlace, VarKind, default_place  # noqa: F401

@@ -1,0 +1,194 @@
+"""IR-level reverse-mode autodiff: ``append_backward``.
+
+<- python/paddle/fluid/backward.py:123,280,435. Walks a block's ops in
+reverse, asks each op's grad maker (default: registry.default_grad_op_descs,
+the analogue of C++ GradOpDescMaker) for grad op descs, de-duplicates repeated
+gradients with explicit ``sum`` ops (<- _addup_repetitive_outputs_,
+backward.py:123), and names gradients ``X@GRAD``.
+
+The transform operates on the IR, not on traced values, so the produced
+program is serializable and splittable (the property the reference's
+DistributeTranspiler relies on). Numerics are still guaranteed to match
+``jax.grad`` because every grad kernel is derived from the forward kernel via
+``jax.vjp`` (see registry.generic_grad_impl) — the tests assert this.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .ir import (
+    GRAD_RENAME_INFIX,
+    GRAD_SUFFIX,
+    Block,
+    Operator,
+    Variable,
+    grad_var_name,
+)
+from .registry import default_grad_op_descs, get_op_def, has_op
+from .types import DataType
+
+
+def _op_has_grad(op: Operator) -> bool:
+    if not has_op(op.type):
+        return False
+    opdef = get_op_def(op.type)
+    return not opdef.no_grad
+
+
+def _find_loss_op_index(block: Block, loss_name: str) -> int:
+    for i in range(len(block.ops) - 1, -1, -1):
+        if loss_name in block.ops[i].output_names:
+            return i
+    raise ValueError(f"loss var {loss_name!r} is not produced by any op in the block")
+
+
+def _relevant_ops(block: Block, loss_idx: int) -> List[bool]:
+    """Mark ops on a path to the loss (<- backward.py op-path pruning)."""
+    needed: Set[str] = set(block.ops[loss_idx].input_names)
+    mark = [False] * (loss_idx + 1)
+    mark[loss_idx] = True
+    for i in range(loss_idx - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_names):
+            mark[i] = True
+            needed.update(n for n in op.input_names if n)
+    return mark
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence[str]] = None,
+    no_grad_set: Optional[Set[str]] = None,
+) -> List[Tuple[Variable, Variable]]:
+    """Append grad ops for ``loss`` to its block; return [(param, param@GRAD)].
+
+    <- backward.append_backward (backward.py:435).
+    """
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient or v.is_data:
+            no_grad.add(v.name)
+
+    loss_idx = _find_loss_op_index(block, loss.name)
+    mark = _relevant_ops(block, loss_idx)
+
+    # seed: d loss / d loss = 1
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(
+        loss_grad, dtype=loss.dtype or DataType.FP32, shape=loss.shape or ()
+    )
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={
+            "shape": list(loss.shape or ()),
+            "value": 1.0,
+            "dtype": loss.dtype or DataType.FP32,
+        },
+    )
+
+    produced: Set[str] = {loss_grad}  # grad vars with a value so far
+    rename_count: Dict[str, int] = {}
+
+    for i in range(loss_idx, -1, -1):
+        if not mark[i]:
+            continue
+        op = block.ops[i]
+        if not _op_has_grad(op):
+            continue
+        # does any output of this op have a gradient flowing back?
+        out_grads_available = any(
+            grad_var_name(n) in produced for n in op.output_names if n
+        )
+        if not out_grads_available:
+            continue
+
+        opdef = get_op_def(op.type)
+        maker = opdef.grad_maker or default_grad_op_descs
+        grad_descs = maker(op, no_grad)
+
+        for gd in grad_descs:
+            g_inputs = {k: list(v) for k, v in gd["inputs"].items()}
+            g_outputs = {k: list(v) for k, v in gd["outputs"].items()}
+            # null out grad inputs that were never produced
+            for slot, names in g_inputs.items():
+                if not slot.endswith(GRAD_SUFFIX):
+                    continue
+                g_inputs[slot] = [n if n in produced or not n.endswith(GRAD_SUFFIX) else ""
+                                  for n in names]
+            # handle accumulation on outputs (+ no_grad suppression)
+            accum_after: List[Tuple[str, str]] = []
+            for slot, names in g_outputs.items():
+                new_names = []
+                for g in names:
+                    if not g:
+                        new_names.append("")
+                        continue
+                    base = g[: -len(GRAD_SUFFIX)] if g.endswith(GRAD_SUFFIX) else g
+                    if base in no_grad:
+                        new_names.append("")
+                        continue
+                    if g in produced:
+                        k = rename_count.get(g, 0) + 1
+                        rename_count[g] = k
+                        renamed = f"{g}{GRAD_RENAME_INFIX}{k}"
+                        new_names.append(renamed)
+                        accum_after.append((g, renamed))
+                        _create_grad_var(block, renamed, base)
+                    else:
+                        new_names.append(g)
+                        produced.add(g)
+                        _create_grad_var(block, g, base)
+                g_outputs[slot] = new_names
+            if all(n == "" for ns in g_outputs.values() for n in ns):
+                continue
+            block.append_op(gd["type"], g_inputs, g_outputs, gd.get("attrs", {}))
+            for canonical, renamed in accum_after:
+                block.append_op(
+                    "sum",
+                    inputs={"X": [canonical, renamed]},
+                    outputs={"Out": [canonical]},
+                )
+
+    # collect (param, grad) pairs for the optimizer
+    params = []
+    for v in block.vars.values():
+        if not v.persistable or v.is_data or v.stop_gradient:
+            continue
+        if parameter_list is not None and v.name not in parameter_list:
+            continue
+        g = grad_var_name(v.name)
+        if g in produced:
+            params.append((v, block.var(g)))
+    params.sort(key=lambda pg: pg[0].name)
+    return params
+
+
+def _create_grad_var(block: Block, grad_name: str, base_name: str) -> None:
+    if block.has_var(grad_name):
+        return
+    base = block.find_var_recursive(base_name)
+    kwargs = {}
+    if base is not None:
+        kwargs = {"dtype": base.dtype, "shape": base.shape}
+    block.create_var(grad_name, **kwargs)
+
+
+def calc_gradient(
+    targets: Sequence[Variable],
+    inputs: Sequence[Variable],
+    no_grad_set: Optional[Set[str]] = None,
+) -> List[Variable]:
+    """Gradients of ``targets`` w.r.t. ``inputs`` (<- backward.py:652)."""
+    if len(targets) != 1:
+        raise NotImplementedError("calc_gradient currently supports a single target")
+    target = targets[0]
+    block = target.block
+    append_backward(target, no_grad_set=no_grad_set)
+    out = []
+    for v in inputs:
+        g = grad_var_name(v.name)
+        out.append(block.var(g) if block.find_var_recursive(g) is not None else None)
+    return out

@@ -1,0 +1,460 @@
+"""Program IR: Program -> Block -> {Operator, Variable}.
+
+This mirrors the *semantics* of the reference IR
+(paddle/fluid/framework/framework.proto:34-180, python/paddle/fluid/framework.py)
+— a serializable, nested-block program description that transforms
+(autodiff, distribution, pruning) operate on — but not its layout. Ops here
+are bound to JAX implementations at execution time; a whole block lowers to a
+single XLA computation instead of per-op kernel dispatch.
+
+Grad variables use the reference's naming convention ``X@GRAD``
+(python/paddle/fluid/framework.py:42).
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .types import DataType, VarKind
+
+GRAD_SUFFIX = "@GRAD"
+GRAD_RENAME_INFIX = "@RENAME@"
+
+IR_VERSION = 1
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class Variable:
+    """Static description of a value flowing through the program.
+
+    <- VarDesc (framework.proto:110-160) + python Variable (framework.py:122).
+    """
+
+    __slots__ = (
+        "block",
+        "name",
+        "kind",
+        "dtype",
+        "shape",
+        "persistable",
+        "stop_gradient",
+        "is_data",
+        "initializer",
+        "_param_attr",
+    )
+
+    def __init__(
+        self,
+        block: "Block",
+        name: str,
+        kind: VarKind = VarKind.DENSE_TENSOR,
+        dtype: Optional[DataType] = None,
+        shape: Optional[Sequence[int]] = None,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_data: bool = False,
+    ):
+        self.block = block
+        self.name = name
+        self.kind = kind
+        self.dtype = DataType.from_any(dtype) if dtype is not None else None
+        self.shape = tuple(shape) if shape is not None else None
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.initializer = None  # set by layers when a startup op exists
+
+    # -- convenience used throughout layers code --
+    @property
+    def program(self) -> "Program":
+        return self.block.program
+
+    def grad_name(self) -> str:
+        return grad_var_name(self.name)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "kind": self.kind.value,
+            "dtype": None if self.dtype is None else self.dtype.value,
+            "shape": None if self.shape is None else list(self.shape),
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+        }
+
+    @staticmethod
+    def from_dict(block: "Block", d: dict) -> "Variable":
+        return Variable(
+            block,
+            d["name"],
+            VarKind(d["kind"]),
+            None if d["dtype"] is None else DataType(d["dtype"]),
+            d["shape"],
+            d["persistable"],
+            d["stop_gradient"],
+            d["is_data"],
+        )
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, "
+            f"dtype={self.dtype and self.dtype.np_dtype.name}, "
+            f"persistable={self.persistable})"
+        )
+
+
+class Operator:
+    """One operation: named input/output slots -> lists of var names + attrs.
+
+    <- OpDesc (framework.proto:34-90) / python Operator (framework.py:410).
+    Sub-blocks (control flow) are referenced by index via attrs of kind
+    "block" (ints into program.blocks).
+    """
+
+    __slots__ = ("block", "type", "inputs", "outputs", "attrs")
+
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, List[str]]] = None,
+        outputs: Optional[Dict[str, List[str]]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.type = type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "inputs": {k: list(v) for k, v in self.inputs.items()},
+            "outputs": {k: list(v) for k, v in self.outputs.items()},
+            "attrs": {k: _attr_to_jsonable(v) for k, v in self.attrs.items()},
+        }
+
+    @staticmethod
+    def from_dict(block: "Block", d: dict) -> "Operator":
+        return Operator(
+            block,
+            d["type"],
+            d["inputs"],
+            d["outputs"],
+            {k: _attr_from_jsonable(v) for k, v in d["attrs"].items()},
+        )
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items() if v}
+        outs = {k: v for k, v in self.outputs.items() if v}
+        return f"Operator({self.type}, in={ins}, out={outs})"
+
+
+def _attr_to_jsonable(v):
+    if isinstance(v, DataType):
+        return {"__dtype__": v.value}
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": v.dtype.name}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def _attr_from_jsonable(v):
+    if isinstance(v, dict) and "__dtype__" in v:
+        return DataType(v["__dtype__"])
+    if isinstance(v, dict) and "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+    return v
+
+
+class Block:
+    """Ordered op list + var table; nests via parent_idx for control flow.
+
+    <- BlockDesc (framework.proto:161-180, block_desc.h).
+    """
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    # -- variables --
+    def create_var(self, name: str, **kwargs) -> Variable:
+        if name in self.vars:
+            return self.vars[name]
+        var = Variable(self, name, **kwargs)
+        self.vars[name] = var
+        self.program._bump_version()
+        return var
+
+    def var(self, name: str) -> Variable:
+        """Find var in this block or ancestors (scope-chain lookup)."""
+        v = self.find_var_recursive(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars
+
+    def find_var_recursive(self, name: str) -> Optional[Variable]:
+        blk: Optional[Block] = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent
+        return None
+
+    def all_parameters(self) -> List[Variable]:
+        return [v for v in self.vars.values() if v.persistable and not v.is_data]
+
+    # -- ops --
+    def append_op(
+        self,
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Operator:
+        op = Operator(
+            self,
+            type,
+            _normalize_slots(inputs),
+            _normalize_slots(outputs),
+            attrs,
+        )
+        self.ops.append(op)
+        self.program._bump_version()
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, _normalize_slots(inputs), _normalize_slots(outputs), attrs)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        return op
+
+    def remove_op(self, index: int) -> None:
+        del self.ops[index]
+        self.program._bump_version()
+
+    def to_dict(self) -> dict:
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+    @staticmethod
+    def from_dict(program: "Program", d: dict) -> "Block":
+        blk = Block(program, d["idx"], d["parent_idx"])
+        for vd in d["vars"]:
+            blk.vars[vd["name"]] = Variable.from_dict(blk, vd)
+        for od in d["ops"]:
+            blk.ops.append(Operator.from_dict(blk, od))
+        return blk
+
+
+def _normalize_slots(slots) -> Dict[str, List[str]]:
+    """Accept {'X': var|name|[vars|names]} and normalize to {'X': [names]}."""
+    if not slots:
+        return {}
+    out: Dict[str, List[str]] = {}
+    for k, v in slots.items():
+        if v is None:
+            out[k] = []
+            continue
+        if isinstance(v, (Variable, str)):
+            v = [v]
+        out[k] = [x.name if isinstance(x, Variable) else str(x) for x in v]
+    return out
+
+
+class Program:
+    """A whole computation: list of blocks, block 0 is global.
+
+    <- ProgramDesc (program_desc.h) / python Program (framework.py:1227).
+    ``_version`` increments on any mutation; the executor keys its jit cache on
+    it so edited programs recompile (<- executor.py:204 program cache).
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0, -1)]
+        self._current_block_idx = 0
+        self._version = 0
+        self.random_seed = 0
+
+    # -- structure --
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current_block_idx]
+
+    def create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self._current_block_idx if parent_idx is None else parent_idx
+        blk = Block(self, len(self.blocks), parent)
+        self.blocks.append(blk)
+        self._current_block_idx = blk.idx
+        self._bump_version()
+        return blk
+
+    def rollback(self) -> None:
+        self._current_block_idx = self.current_block().parent_idx
+
+    def _bump_version(self) -> None:
+        self._version += 1
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    # -- transforms --
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep copy; with for_test=True, switch train-only ops to eval mode
+        (<- Program.clone framework.py:1440: prune backward + set is_test)."""
+        p = Program.from_dict(self.to_dict())
+        p.random_seed = self.random_seed
+        if for_test:
+            for blk in p.blocks:
+                for op in blk.ops:
+                    if "is_test" in _TRAIN_MODE_OPS.get(op.type, ()):
+                        op.attrs["is_test"] = True
+        return p
+
+    def list_vars(self):
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    # -- serialization --
+    def to_dict(self) -> dict:
+        return {
+            "ir_version": IR_VERSION,
+            "blocks": [b.to_dict() for b in self.blocks],
+            "random_seed": self.random_seed,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Program":
+        p = Program()
+        p.blocks = [Block.from_dict(p, bd) for bd in d["blocks"]]
+        p.random_seed = d.get("random_seed", 0)
+        return p
+
+    def serialize_to_string(self) -> bytes:
+        return json.dumps(self.to_dict(), separators=(",", ":")).encode("utf-8")
+
+    @staticmethod
+    def parse_from_string(data: bytes) -> "Program":
+        return Program.from_dict(json.loads(data.decode("utf-8")))
+
+    def __repr__(self):
+        lines = [f"Program(version={self._version})"]
+        for blk in self.blocks:
+            lines.append(f"  Block {blk.idx} (parent={blk.parent_idx}):")
+            for v in blk.vars.values():
+                lines.append(f"    var  {v.name}: {v.shape} {v.dtype and v.dtype.np_dtype.name}"
+                             + (" [persistable]" if v.persistable else ""))
+            for op in blk.ops:
+                lines.append(f"    op   {op!r}")
+        return "\n".join(lines)
+
+
+# ops whose semantics differ between train and eval (dropout, batch_norm, ...)
+_TRAIN_MODE_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+
+# ---------------------------------------------------------------------------
+# default program state (<- framework.py:1861 program_guard and friends)
+# ---------------------------------------------------------------------------
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(program: Program) -> Program:
+    global _main_program
+    prev, _main_program = _main_program, program
+    return prev
+
+
+def switch_startup_program(program: Program) -> Program:
+    global _startup_program
+    prev, _startup_program = _startup_program, program
+    return prev
+
+
+class program_guard:
+    """Context manager scoping default main/startup programs."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self._main = main_program
+        self._startup = startup_program
+        self._prev_main = None
+        self._prev_startup = None
+
+    def __enter__(self):
+        self._prev_main = switch_main_program(self._main)
+        if self._startup is not None:
+            self._prev_startup = switch_startup_program(self._startup)
+        return self
+
+    def __exit__(self, *exc):
+        switch_main_program(self._prev_main)
+        if self._startup is not None:
+            switch_startup_program(self._prev_startup)
+        return False
+
+
+def reset_default_programs() -> None:
+    """Fresh global programs (used by tests)."""
+    switch_main_program(Program())
+    switch_startup_program(Program())

@@ -1,0 +1,325 @@
+"""Op registry: one table mapping op type -> JAX implementation + metadata.
+
+<- the reference's OpInfoMap / REGISTER_OPERATOR machinery
+(paddle/fluid/framework/op_registry.h:136-224, op_info.h), re-imagined:
+
+* Kernels are JAX functions, not per-device C++ kernels. Kernel selection by
+  (place, dtype, layout, library) disappears — XLA owns lowering per backend.
+* Shape inference is *derived* from the kernel via ``jax.eval_shape`` instead
+  of hand-written InferShape functions (shape_inference.h), so it can never
+  drift from the implementation.
+* Grad ops are emitted at the IR level like GradOpDescMaker
+  (grad_op_desc_maker.h:34) but their kernels default to ``jax.vjp`` of the
+  forward kernel, *recomputing the forward inside the same traced block* —
+  XLA's CSE merges the recomputation with the original forward, so this costs
+  nothing at runtime while keeping every grad numerically consistent with the
+  forward by construction.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ir import GRAD_SUFFIX, Block, Operator, grad_var_name
+from .types import DataType
+
+# inputs/outputs as {slot: [jax.Array, ...]}
+SlotValues = Dict[str, List[Any]]
+
+
+class ExecContext:
+    """Per-trace context handed to kernels.
+
+    Carries the functional PRNG key (threaded through the compiled program —
+    random ops are pure under jit) and a callback to trace sub-blocks, which
+    control-flow kernels use to lower While/Cond bodies into
+    ``lax.while_loop`` / ``lax.cond`` branches.
+    """
+
+    def __init__(self, key=None, block_runner=None, is_test: bool = False):
+        self._key = key
+        self.block_runner = block_runner
+        self.is_test = is_test
+
+    def next_key(self):
+        if self._key is None:
+            raise RuntimeError("op requires randomness but no PRNG key was provided")
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+@dataclass
+class OpDef:
+    """Registered operator definition."""
+
+    type: str
+    impl: Callable[[ExecContext, SlotValues, Dict[str, Any]], SlotValues]
+    input_slots: Sequence[str] = ()
+    output_slots: Sequence[str] = ()
+    # which input slots are differentiable (None = every floating-point input)
+    diff_inputs: Optional[Sequence[str]] = None
+    # custom IR-level grad maker: (op, block) -> list[Operator-dict]
+    grad_maker: Optional[Callable] = None
+    # ops with no gradient at all (metrics, fill, IO)
+    no_grad: bool = False
+    # kernel needs PRNG / is stateful across steps (disables some caching)
+    stochastic: bool = False
+    # custom shape inference overriding eval_shape (control flow etc.)
+    infer_shape: Optional[Callable[[Operator, Block], None]] = None
+    # extra metadata for docs/parity tooling
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    *,
+    inputs: Sequence[str] = (),
+    outputs: Sequence[str] = ("Out",),
+    diff_inputs: Optional[Sequence[str]] = None,
+    grad_maker: Optional[Callable] = None,
+    no_grad: bool = False,
+    stochastic: bool = False,
+    infer_shape: Optional[Callable] = None,
+    doc: str = "",
+):
+    """Decorator registering a kernel. The kernel signature is
+    ``impl(ctx, ins: SlotValues, attrs) -> SlotValues``."""
+
+    def deco(fn):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} already registered")
+        _REGISTRY[type] = OpDef(
+            type=type,
+            impl=fn,
+            input_slots=tuple(inputs),
+            output_slots=tuple(outputs),
+            diff_inputs=tuple(diff_inputs) if diff_inputs is not None else None,
+            grad_maker=grad_maker,
+            no_grad=no_grad,
+            stochastic=stochastic,
+            infer_shape=infer_shape,
+            doc=doc,
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    if type not in _REGISTRY:
+        raise KeyError(f"op {type!r} is not registered")
+    return _REGISTRY[type]
+
+
+def has_op(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def simple_op(type: str, inputs=("X",), outputs=("Out",), **kw):
+    """Register an op whose kernel is ``out = fn(*positional_inputs, **attrs)``
+    with exactly one tensor per input slot and one output."""
+
+    def deco(fn):
+        @register_op(type, inputs=inputs, outputs=outputs, **kw)
+        def _impl(ctx, ins, attrs, _fn=fn, _inputs=inputs, _outputs=outputs):
+            args = [ins[slot][0] for slot in _inputs]
+            out = _fn(*args, **attrs)
+            if len(_outputs) == 1:
+                out = (out,)
+            return {slot: [o] for slot, o in zip(_outputs, out)}
+
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Shape inference via eval_shape
+# ---------------------------------------------------------------------------
+
+
+def infer_and_create_outputs(op: Operator, block: Block) -> None:
+    """Infer output shapes/dtypes of ``op`` from its input VarDescs and
+    create/refine the output Variables in ``block``.
+
+    Replaces hand-written InferShape (operator.cc:605 InferShape step): we run
+    the registered kernel abstractly with ``jax.eval_shape`` so shapes always
+    match the real computation.
+    """
+    opdef = get_op_def(op.type)
+    if opdef.infer_shape is not None:
+        opdef.infer_shape(op, block)
+        return
+
+    # The reference marks the batch dim -1; we substitute a placeholder batch
+    # for abstract evaluation and restore -1 on output dim 0 afterwards
+    # (executor shapes are always concrete — they come from the fed arrays).
+    _PLACEHOLDER_BATCH = 97  # unlikely literal so we can spot it in outputs
+    symbolic_batch = False
+    ins: Dict[str, List[jax.ShapeDtypeStruct]] = {}
+    for slot, names in op.inputs.items():
+        structs = []
+        for n in names:
+            if n == "":
+                structs.append(None)
+                continue
+            v = block.var(n)
+            if v.shape is None or v.dtype is None:
+                return  # cannot infer statically; executor will still work
+            shape = list(v.shape)
+            if shape and shape[0] == -1:
+                symbolic_batch = True
+                shape[0] = _PLACEHOLDER_BATCH
+            if any(d < 0 for d in shape):
+                return
+            structs.append(jax.ShapeDtypeStruct(tuple(shape), v.dtype.jnp_dtype))
+        ins[slot] = structs
+
+    ctx = ExecContext(key=jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+    def run(ins):
+        # eval_shape can't split a ShapeDtypeStruct key; substitute an abstract
+        # fresh key per call — shapes don't depend on key values.
+        c = ExecContext(key=jax.random.PRNGKey(0), block_runner=ctx.block_runner)
+        return opdef.impl(c, ins, op.attrs)
+
+    try:
+        outs = jax.eval_shape(run, ins)
+    except Exception:
+        return  # dynamic/unsupported at build time; defer to execution
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for n, s in zip(names, vals):
+            if not n:
+                continue
+            var = block.vars.get(n) or block.find_var_recursive(n)
+            if var is None:
+                var = block.create_var(n)
+            if s is not None:
+                shape = list(s.shape)
+                if symbolic_batch and shape and shape[0] == _PLACEHOLDER_BATCH:
+                    shape[0] = -1
+                var.shape = tuple(shape)
+                var.dtype = DataType.from_any(s.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Generic gradient machinery
+# ---------------------------------------------------------------------------
+
+
+def default_grad_op_descs(op: Operator, no_grad_set=frozenset()) -> List[dict]:
+    """Build the IR description of ``<type>_grad`` for a forward op.
+
+    Convention (mirrors GradOpDescMakerBase, grad_op_desc_maker.h:34):
+      inputs  = all forward inputs + all forward outputs
+                + ``<slot>@GRAD`` for each forward *output* slot
+      outputs = ``<slot>@GRAD`` for each forward *input* slot
+    Variable names map ``x -> x@GRAD``.
+    """
+    g_inputs = {k: list(v) for k, v in op.inputs.items()}
+    for slot, names in op.outputs.items():
+        g_inputs[slot] = list(names)
+        g_inputs[slot + GRAD_SUFFIX] = [grad_var_name(n) for n in names]
+    g_outputs = {}
+    for slot, names in op.inputs.items():
+        outs = []
+        for n in names:
+            outs.append("" if n in no_grad_set else grad_var_name(n))
+        g_outputs[slot + GRAD_SUFFIX] = outs
+    return [
+        {
+            "type": op.type + "_grad",
+            "inputs": g_inputs,
+            "outputs": g_outputs,
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+def _float_slots(opdef: OpDef, ins: SlotValues) -> List[str]:
+    """Input slots we differentiate with respect to."""
+    if opdef.diff_inputs is not None:
+        return [s for s in opdef.diff_inputs if ins.get(s)]
+    out = []
+    for slot, vals in ins.items():
+        if vals and all(jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) for v in vals):
+            out.append(slot)
+    return out
+
+
+def generic_grad_impl(fwd_type: str):
+    """Kernel for ``<fwd>_grad`` built from ``jax.vjp`` over the forward kernel."""
+    fwd_def = get_op_def(fwd_type)
+
+    def impl(ctx: ExecContext, ins: SlotValues, attrs: Dict[str, Any]) -> SlotValues:
+        fwd_ins = {s: ins[s] for s in fwd_def.input_slots if ins.get(s)}
+        diff_slots = _float_slots(fwd_def, fwd_ins)
+        frozen = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
+        live = {s: fwd_ins[s] for s in diff_slots}
+
+        def fwd(live_ins):
+            outs = fwd_def.impl(ctx, {**frozen, **live_ins}, attrs)
+            # only float outputs participate in the vjp
+            return {
+                s: [o for o in vs]
+                for s, vs in outs.items()
+            }
+
+        outs, vjp = jax.vjp(fwd, live)
+        # cotangents: provided grads where present, zeros elsewhere
+        cot = {}
+        for slot, vals in outs.items():
+            gnames = ins.get(slot + GRAD_SUFFIX)
+            cs = []
+            for i, o in enumerate(vals):
+                g = None
+                if gnames is not None and i < len(gnames):
+                    g = gnames[i]
+                if g is None:
+                    if jnp.issubdtype(o.dtype, jnp.floating):
+                        cs.append(jnp.zeros_like(o))
+                    else:
+                        cs.append(np.zeros((), dtype=jax.dtypes.float0) if o.ndim == 0
+                                  else np.zeros(o.shape, dtype=jax.dtypes.float0))
+                else:
+                    cs.append(g)
+            cot[slot] = cs
+        (grads,) = vjp(cot)
+        result: SlotValues = {}
+        for slot in diff_slots:
+            result[slot + GRAD_SUFFIX] = grads.get(slot, [None] * len(fwd_ins[slot]))
+        return result
+
+    return impl
+
+
+def ensure_grad_op_registered(grad_type: str) -> None:
+    """Lazily register ``<fwd>_grad`` kernels derived from the forward."""
+    if grad_type in _REGISTRY or not grad_type.endswith("_grad"):
+        return
+    fwd_type = grad_type[: -len("_grad")]
+    if fwd_type not in _REGISTRY:
+        raise KeyError(f"no forward op {fwd_type!r} for grad op {grad_type!r}")
+    fwd = _REGISTRY[fwd_type]
+    _REGISTRY[grad_type] = OpDef(
+        type=grad_type,
+        impl=generic_grad_impl(fwd_type),
+        input_slots=tuple(fwd.input_slots)
+        + tuple(fwd.output_slots)
+        + tuple(s + GRAD_SUFFIX for s in fwd.output_slots),
+        output_slots=tuple(s + GRAD_SUFFIX for s in fwd.input_slots),
+        no_grad=True,
+    )

@@ -1,0 +1,155 @@
+"""Core type system: dtypes, variable kinds, device places.
+
+TPU-native re-imagination of the reference's type layer:
+  - dtype enum        <- paddle/fluid/framework/framework.proto:91-109 (VarType.Type)
+  - VarKind           <- framework.proto:110-130 (LOD_TENSOR, SELECTED_ROWS, ...)
+  - Place             <- paddle/fluid/platform/place.h:25-75
+
+Unlike the reference there is no CUDAPlace/CUDAPinnedPlace; the natural places
+on this stack are CPUPlace (XLA:CPU) and TPUPlace (XLA:TPU).  Places select a
+``jax.Device`` rather than a kernel library.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Scalar element types; values chosen to be stable for serialization.
+
+    Deviation from the reference: INT64 is accepted everywhere in the API
+    (labels, ids) but lowers to 32-bit on device — TPUs have no fast s64 path
+    and JAX defaults to x32. Index-producing ops (top_k, arg_max, ...) emit
+    int32 arrays.
+    """
+
+    BOOL = 0
+    INT8 = 1
+    UINT8 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FP16 = 6
+    FP32 = 7
+    FP64 = 8
+    BF16 = 9
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(_TO_NP[self])
+
+    @property
+    def jnp_dtype(self):
+        return _TO_JNP[self]
+
+    @staticmethod
+    def from_any(dtype) -> "DataType":
+        """Coerce a numpy/jax dtype, string, or DataType into a DataType."""
+        if isinstance(dtype, DataType):
+            return dtype
+        if isinstance(dtype, str):
+            key = dtype.lower()
+            if key in _FROM_STR:
+                return _FROM_STR[key]
+        key = np.dtype(jnp.dtype(dtype).name if hasattr(dtype, "name") else dtype).name
+        if key not in _FROM_STR:
+            raise TypeError(f"unsupported dtype: {dtype!r}")
+        return _FROM_STR[key]
+
+
+_TO_NP = {
+    DataType.BOOL: np.bool_,
+    DataType.INT8: np.int8,
+    DataType.UINT8: np.uint8,
+    DataType.INT16: np.int16,
+    DataType.INT32: np.int32,
+    DataType.INT64: np.int64,
+    DataType.FP16: np.float16,
+    DataType.FP32: np.float32,
+    DataType.FP64: np.float64,
+    # numpy has no native bfloat16; ml_dtypes (via jax) provides one.
+    DataType.BF16: jnp.bfloat16,
+}
+_TO_JNP = {
+    DataType.BOOL: jnp.bool_,
+    DataType.INT8: jnp.int8,
+    DataType.UINT8: jnp.uint8,
+    DataType.INT16: jnp.int16,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.FP16: jnp.float16,
+    DataType.FP32: jnp.float32,
+    DataType.FP64: jnp.float64,
+    DataType.BF16: jnp.bfloat16,
+}
+_FROM_STR = {
+    "bool": DataType.BOOL,
+    "int8": DataType.INT8,
+    "uint8": DataType.UINT8,
+    "int16": DataType.INT16,
+    "int32": DataType.INT32,
+    "int64": DataType.INT64,
+    "float16": DataType.FP16,
+    "fp16": DataType.FP16,
+    "float32": DataType.FP32,
+    "fp32": DataType.FP32,
+    "float": DataType.FP32,
+    "float64": DataType.FP64,
+    "fp64": DataType.FP64,
+    "double": DataType.FP64,
+    "bfloat16": DataType.BF16,
+    "bf16": DataType.BF16,
+}
+
+
+class VarKind(enum.Enum):
+    """What a Variable holds.
+
+    DENSE_TENSOR subsumes the reference's LOD_TENSOR: variable-length sequence
+    structure lives in explicit companion tensors (segment lengths / offsets)
+    rather than host-side offset vectors, so everything stays XLA-traceable.
+    """
+
+    DENSE_TENSOR = 0
+    SELECTED_ROWS = 1  # sparse row-subset: (rows, values) pair
+    TENSOR_ARRAY = 2  # list of tensors (fixed length under jit)
+    STEP_SCOPES = 3  # control-flow carried state
+    READER = 4  # data source
+    RAW = 5  # opaque python object (host side only)
+
+
+@dataclass(frozen=True)
+class Place:
+    """Device placement. Selects a jax device set, not a kernel library."""
+
+    kind: str  # "cpu" | "tpu"
+    device_id: int = 0
+
+    def jax_device(self) -> jax.Device:
+        try:
+            devs = jax.devices(self.kind)
+        except RuntimeError:
+            devs = jax.devices()  # fall back (e.g. TPUPlace on CPU-only CI)
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self) -> str:  # matches reference-style printing
+        return f"{self.kind.upper()}Place({self.device_id})"
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def default_place() -> Place:
+    """TPU if attached, else CPU — the natural 'best place' for this stack."""
+    platforms = {d.platform for d in jax.devices()}
+    return TPUPlace(0) if "tpu" in platforms else CPUPlace()

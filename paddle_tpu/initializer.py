@@ -1,0 +1,132 @@
+"""Parameter initializers (<- python/paddle/fluid/initializer.py).
+
+An initializer appends one op to the *startup* program that produces the
+parameter's initial value; running the startup program through the Executor
+materializes all parameters on device in one compiled XLA program (instead of
+one kernel launch per parameter).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .core.ir import Block, Variable
+from .core.types import DataType
+
+
+class Initializer:
+    def __call__(self, var: Variable, block: Block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, var: Variable, block: Block):
+        block.append_op(
+            "fill_constant",
+            outputs={"Out": [var.name]},
+            attrs={"shape": list(var.shape), "value": self.value, "dtype": var.dtype},
+        )
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, seed: int = 0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var: Variable, block: Block):
+        block.append_op(
+            "uniform_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "min": self.low,
+                "max": self.high,
+                "dtype": var.dtype,
+                "seed": self.seed,
+            },
+        )
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var: Variable, block: Block):
+        block.append_op(
+            "gaussian_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "mean": self.loc,
+                "std": self.scale,
+                "dtype": var.dtype,
+                "seed": self.seed,
+            },
+        )
+
+
+def _fans(var: Variable):
+    shape = var.shape
+    if len(shape) < 2:
+        return (shape[0] if shape else 1), (shape[0] if shape else 1)
+    fan_in = shape[1] * int(np.prod(shape[2:])) if len(shape) > 2 else shape[0]
+    fan_out = shape[0] * int(np.prod(shape[2:])) if len(shape) > 2 else shape[1]
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    return fan_in, fan_out
+
+
+class XavierInitializer(Initializer):
+    """Glorot (<- initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, fan_out=None, seed: int = 0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var: Variable, block: Block):
+        fi, fo = _fans(var)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            NormalInitializer(0.0, math.sqrt(2.0 / (fi + fo)), self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """He init (<- initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, seed: int = 0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var: Variable, block: Block):
+        fi, _ = _fans(var)
+        fi = self.fan_in or fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            NormalInitializer(0.0, math.sqrt(2.0 / fi), self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value: np.ndarray):
+        self.value = np.asarray(value)
+
+    def __call__(self, var: Variable, block: Block):
+        block.append_op(
+            "assign_value",
+            outputs={"Out": [var.name]},
+            attrs={"values": self.value, "dtype": var.dtype},
+        )
+
+
+# fluid-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer

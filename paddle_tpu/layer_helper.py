@@ -1,0 +1,112 @@
+"""LayerHelper: shared plumbing for layer functions.
+
+<- python/paddle/fluid/layer_helper.py. Creates parameters (var in the main
+program + init op in the startup program), temp output vars, appends ops and
+runs shape inference so downstream layers see static shapes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from . import unique_name
+from .core.ir import Variable, default_main_program, default_startup_program
+from .core.registry import infer_and_create_outputs
+from .core.types import DataType
+from .initializer import ConstantInitializer, Initializer, XavierInitializer
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get("name")
+        self.name = name if name is not None else unique_name.generate(layer_type)
+
+    @property
+    def main_program(self):
+        return self.kwargs.get("main_program") or default_main_program()
+
+    @property
+    def startup_program(self):
+        return self.kwargs.get("startup_program") or default_startup_program()
+
+    @property
+    def block(self):
+        return self.main_program.current_block()
+
+    # -- parameters --
+    def create_parameter(
+        self,
+        attr,
+        shape: Sequence[int],
+        dtype="float32",
+        is_bias: bool = False,
+        default_initializer: Optional[Initializer] = None,
+    ) -> Variable:
+        attr = ParamAttr.to_attr(attr)
+        name = attr.name or unique_name.generate(f"{self.name}.w")
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
+        dtype = DataType.from_any(dtype)
+        # parameter lives in the main program's global block...
+        param = self.main_program.global_block().create_var(
+            name, dtype=dtype, shape=tuple(int(s) for s in shape), persistable=True
+        )
+        param.initializer = init
+        # stash optimizer-relevant attrs on the variable
+        setattr(param, "_param_attr", attr)
+        # ...and is produced by an init op in the startup program
+        sb = self.startup_program.global_block()
+        if not sb.has_var(name):
+            sv = sb.create_var(name, dtype=dtype, shape=tuple(shape), persistable=True)
+            init(sv, sb)
+        return param
+
+    # -- temporaries --
+    def create_variable_for_type_inference(self, dtype="float32") -> Variable:
+        return self.block.create_var(
+            unique_name.generate(f"{self.name}.tmp"),
+            dtype=DataType.from_any(dtype) if dtype is not None else None,
+        )
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_global_variable(self, shape, dtype, persistable=False, name=None) -> Variable:
+        return self.main_program.global_block().create_var(
+            name or unique_name.generate(f"{self.name}.global"),
+            dtype=DataType.from_any(dtype),
+            shape=tuple(shape),
+            persistable=persistable,
+        )
+
+    # -- ops --
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None):
+        op = self.block.append_op(type, inputs, outputs, attrs)
+        infer_and_create_outputs(op, self.block)
+        return op
+
+    def append_activation(self, out: Variable) -> Variable:
+        act = self.kwargs.get("act")
+        if act is None:
+            return out
+        tmp = self.create_variable_for_type_inference(out.dtype)
+        self.append_op(act, {"X": [out]}, {"Out": [tmp]})
+        return tmp
+
+    def input(self, name="input"):
+        return self.kwargs[name]
+
+    # bias helper used by fc/conv layers
+    def append_bias_op(self, out: Variable, dim_start=1, bias_attr=None) -> Variable:
+        bias_attr = bias_attr if bias_attr is not None else self.kwargs.get("bias_attr")
+        if bias_attr is False:
+            return out
+        size = out.shape[dim_start]
+        b = self.create_parameter(bias_attr, [size], out.dtype, is_bias=True)
+        tmp = self.create_variable_for_type_inference(out.dtype)
+        self.append_op(
+            "elementwise_add", {"X": [out], "Y": [b]}, {"Out": [tmp]}, {"axis": dim_start}
+        )
+        return tmp

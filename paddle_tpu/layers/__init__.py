@@ -1,0 +1,20 @@
+"""fluid.layers equivalent: IR-building layer functions."""
+from .io import data  # noqa: F401
+from .metric_op import accuracy, auc  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    argmax,
+    argmin,
+    assign,
+    cast,
+    create_global_var,
+    create_tensor,
+    fill_constant,
+    fill_constant_batch_size_like,
+    increment,
+    ones,
+    reverse,
+    sums,
+    zeros,
+)

@@ -1,0 +1,30 @@
+"""Input layers (<- python/paddle/fluid/layers/io.py data())."""
+from __future__ import annotations
+
+from ..core.ir import default_main_program
+from ..core.types import DataType, VarKind
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare a feed variable.
+
+    ``append_batch_size`` prepends a batch dim like the reference (-1 there;
+    here we leave it symbolic as None-free: the executor takes the runtime
+    shape from the fed array, so the declared leading dim is only
+    documentation). ``lod_level`` is accepted for parity; variable-length
+    structure travels as explicit companion tensors (see ops/sequence.py).
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    var = block.create_var(
+        name,
+        kind=VarKind.DENSE_TENSOR,
+        dtype=DataType.from_any(dtype),
+        shape=tuple(shape),
+        is_data=True,
+        stop_gradient=stop_gradient,
+    )
+    return var

@@ -1,0 +1,54 @@
+"""Metric layers (<- python/paddle/fluid/layers/metric_op.py)."""
+from __future__ import annotations
+
+from ..core.types import DataType
+from ..layer_helper import LayerHelper
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """<- metric_op.py accuracy: top-k accuracy over predictions."""
+    helper = LayerHelper("accuracy", name=name)
+    topk_out = helper.create_variable_for_type_inference(input.dtype)
+    topk_indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op("top_k", {"X": [input]},
+                     {"Out": [topk_out], "Indices": [topk_indices]}, {"k": k})
+    acc_out = helper.create_variable_for_type_inference("float32")
+    if correct is None:
+        correct = helper.create_variable_for_type_inference("int32")
+    if total is None:
+        total = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "accuracy",
+        {"Out": [topk_out], "Indices": [topk_indices], "Label": [label]},
+        {"Accuracy": [acc_out], "Correct": [correct], "Total": [total]},
+    )
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, name=None):
+    """<- metric_op.py auc: streaming AUC with persistable bucket state."""
+    helper = LayerHelper("auc", name=name)
+    state_shape = [num_thresholds]
+
+    def _state(suffix):
+        var = helper.create_global_variable(state_shape, "int64", persistable=True,
+                                            name=f"{helper.name}.{suffix}")
+        sb = helper.startup_program.global_block()
+        if not sb.has_var(var.name):
+            sb.create_var(var.name, dtype=DataType.INT64, shape=tuple(state_shape),
+                          persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": [var.name]},
+                         attrs={"shape": state_shape, "value": 0,
+                                "dtype": DataType.INT64})
+        return var
+
+    tp, fp, tn, fn = _state("tp"), _state("fp"), _state("tn"), _state("fn")
+    auc_out = helper.create_variable_for_type_inference("float64")
+    helper.append_op(
+        "auc",
+        {"Predict": [input], "Label": [label], "TP": [tp], "FP": [fp],
+         "TN": [tn], "FN": [fn]},
+        {"AUC": [auc_out], "TPOut": [tp], "FPOut": [fp], "TNOut": [tn], "FNOut": [fn]},
+        {"curve": curve, "num_thresholds": num_thresholds},
+    )
+    return auc_out

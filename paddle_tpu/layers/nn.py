@@ -1,0 +1,580 @@
+"""Core NN layers building IR (<- python/paddle/fluid/layers/nn.py).
+
+Each function appends ops to the default main program and returns the output
+Variable, exactly like the reference's layers; nothing executes until an
+Executor lowers the block to XLA.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.ir import Variable
+from ..core.types import DataType
+from ..layer_helper import LayerHelper
+
+
+def fc(
+    input,
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    is_test: bool = False,
+    name: Optional[str] = None,
+):
+    """Fully connected (<- layers/nn.py fc, mul_op + elementwise_add + act).
+
+    On TPU this becomes one MXU matmul with the bias/activation fused by XLA.
+    """
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for inp in inputs:
+        in_dim = 1
+        for d in inp.shape[num_flatten_dims:]:
+            in_dim *= d
+        w = helper.create_parameter(param_attr, [in_dim, size], inp.dtype)
+        tmp = helper.create_variable_for_type_inference(inp.dtype)
+        helper.append_op(
+            "mul",
+            {"X": [inp], "Y": [w]},
+            {"Out": [tmp]},
+            {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(inputs[0].dtype)
+        helper.append_op("sum", {"X": mul_results}, {"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, num_flatten_dims, bias_attr)
+    return helper.append_activation(pre_act)
+
+
+def embedding(
+    input,
+    size: Sequence[int],
+    is_sparse: bool = False,
+    padding_idx: Optional[int] = None,
+    param_attr=None,
+    dtype="float32",
+    name: Optional[str] = None,
+):
+    """<- layers/nn.py embedding / lookup_table_op. ``is_sparse`` is accepted
+    for API parity; on TPU the gather's backward is a fused scatter-add, which
+    is the sparse path."""
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    w = helper.create_parameter(param_attr, size, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lookup_table",
+        {"W": [w], "Ids": [input]},
+        {"Out": [out]},
+        {"padding_idx": -1 if padding_idx is None else padding_idx},
+    )
+    return out
+
+
+def conv2d(
+    input,
+    num_filters: int,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """<- layers/nn.py conv2d / conv_op.cc. NCHW."""
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    num_channels = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    stride = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    padding = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    dilation = dilation if isinstance(dilation, (list, tuple)) else (dilation, dilation)
+    filter_shape = [num_filters, num_channels // groups, fs[0], fs[1]]
+    from ..initializer import NormalInitializer
+
+    fan_in = (num_channels // groups) * fs[0] * fs[1]
+    w = helper.create_parameter(
+        param_attr, filter_shape, input.dtype,
+        default_initializer=NormalInitializer(0.0, (2.0 / fan_in) ** 0.5),
+    )
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d",
+        {"Input": [input], "Filter": [w]},
+        {"Output": [pre_bias]},
+        {
+            "strides": list(stride),
+            "paddings": list(padding),
+            "dilations": list(dilation),
+            "groups": groups,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, bias_attr=bias_attr)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(
+    input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+    param_attr=None, bias_attr=None, act=None, name=None,
+):
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    stride = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    padding = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    dilation = dilation if isinstance(dilation, (list, tuple)) else (dilation, dilation)
+    w = helper.create_parameter(param_attr, [c, num_filters, fs[0], fs[1]], input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        {"Input": [input], "Filter": [w]},
+        {"Output": [out]},
+        {"strides": list(stride), "paddings": list(padding), "dilations": list(dilation)},
+    )
+    out = helper.append_bias_op(out, dim_start=1, bias_attr=bias_attr)
+    return helper.append_activation(out)
+
+
+def pool2d(
+    input,
+    pool_size=2,
+    pool_type: str = "max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling: bool = False,
+    ceil_mode: bool = False,
+    exclusive: bool = True,
+    name: Optional[str] = None,
+):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ps = pool_size if isinstance(pool_size, (list, tuple)) else (pool_size, pool_size)
+    st = pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride, pool_stride)
+    pd = pool_padding if isinstance(pool_padding, (list, tuple)) else (pool_padding, pool_padding)
+    helper.append_op(
+        "pool2d",
+        {"X": [input]},
+        {"Out": [out]},
+        {
+            "pooling_type": pool_type,
+            "ksize": list(ps),
+            "strides": list(st),
+            "paddings": list(pd),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act: Optional[str] = None,
+    is_test: bool = False,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout: str = "NCHW",
+    name: Optional[str] = None,
+    moving_mean_name: Optional[str] = None,
+    moving_variance_name: Optional[str] = None,
+):
+    """<- layers/nn.py batch_norm / batch_norm_op.cc."""
+    helper = LayerHelper("batch_norm", act=act, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    from ..initializer import ConstantInitializer
+    from ..param_attr import ParamAttr
+
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, initializer=ConstantInitializer(0.0), trainable=False),
+        [c], input.dtype)
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, initializer=ConstantInitializer(1.0), trainable=False),
+        [c], input.dtype)
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+
+    y = helper.create_variable_for_type_inference(input.dtype)
+    saved_mean = helper.create_variable_for_type_inference(input.dtype)
+    saved_var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "batch_norm",
+        {"X": [input], "Scale": [scale], "Bias": [bias], "Mean": [mean], "Variance": [variance]},
+        {
+            "Y": [y],
+            "MeanOut": [mean],  # in-place running stats, as in the reference
+            "VarianceOut": [variance],
+            "SavedMean": [saved_mean],
+            "SavedVariance": [saved_var],
+        },
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout},
+    )
+    return helper.append_activation(y)
+
+
+def layer_norm(
+    input, scale: bool = True, shift: bool = True, begin_norm_axis: int = 1,
+    epsilon: float = 1e-5, param_attr=None, bias_attr=None, act=None, name=None,
+):
+    helper = LayerHelper("layer_norm", act=act, name=name)
+    from ..initializer import ConstantInitializer
+
+    norm_dim = 1
+    for d in input.shape[begin_norm_axis:]:
+        norm_dim *= d
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, [norm_dim], input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, [norm_dim], input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "layer_norm", inputs, {"Y": [y], "Mean": [mean], "Variance": [var]},
+        {"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    return helper.append_activation(y)
+
+
+def dropout(x, dropout_prob: float, is_test: bool = False, seed=None,
+            dropout_implementation: str = "downgrade_in_infer", name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "dropout",
+        {"X": [x]},
+        {"Out": [out], "Mask": [mask]},
+        {"dropout_prob": dropout_prob, "is_test": is_test,
+         "seed": seed or 0,
+         "dropout_implementation": dropout_implementation},
+    )
+    return out
+
+
+def softmax(input, axis: int = -1, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("softmax", {"X": [input]}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def cross_entropy(input, label, soft_label: bool = False, name=None):
+    helper = LayerHelper("cross_entropy", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "cross_entropy", {"X": [input], "Label": [label]}, {"Y": [out]},
+        {"soft_label": soft_label},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               return_softmax: bool = False, name=None):
+    helper = LayerHelper("softmax_with_cross_entropy", name=name)
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        {"Logits": [logits], "Label": [label]},
+        {"Softmax": [softmax_out], "Loss": [loss]},
+        {"soft_label": soft_label},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        {"X": [x], "Label": [label]}, {"Out": [out]}, {})
+    return out
+
+
+def square_error_cost(input, label, name=None):
+    helper = LayerHelper("square_error_cost", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("square_error_cost", {"X": [input], "Y": [label]}, {"Out": [out]})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mean", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "mul", {"X": [x], "Y": [y]}, {"Out": [out]},
+        {"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "matmul", {"X": [x], "Y": [y]}, {"Out": [out]},
+        {"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": alpha},
+    )
+    return out
+
+
+def l2_normalize(x, axis: int = 1, epsilon: float = 1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "norm", {"X": [x]}, {"Out": [out], "Norm": [norm]},
+        {"axis": axis, "epsilon": epsilon},
+    )
+    return out
+
+
+def topk(input, k: int, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op("top_k", {"X": [input]}, {"Out": [values], "Indices": [indices]}, {"k": k})
+    return values, indices
+
+
+def elementwise_op(op_name, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_name, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_name, {"X": [x], "Y": [y]}, {"Out": [out]}, {"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_pow", x, y, axis, act, name)
+
+
+def _reduce(op, input, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"keep_dim": keep_dim, "reduce_all": dim is None}
+    if dim is not None:
+        attrs["dim"] = dim if isinstance(dim, (list, tuple)) else [dim]
+    helper.append_op(op, {"X": [input]}, {"Out": [out]}, attrs)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reshape(x, shape, inplace: bool = False, name=None):
+    helper = LayerHelper("reshape", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reshape", {"X": [x]}, {"Out": [out]}, {"shape": list(shape)})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("transpose", {"X": [x]}, {"Out": [out]}, {"axis": list(perm)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", {"X": input}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        outs = [helper.create_variable_for_type_inference(input.dtype) for _ in range(num)]
+        attrs = {"num": num, "axis": dim}
+    else:
+        outs = [helper.create_variable_for_type_inference(input.dtype)
+                for _ in num_or_sections]
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+    helper.append_op("split", {"X": [input]}, {"Out": outs}, attrs)
+    return outs
+
+
+def dropout_prob_check(p):
+    if not 0 <= p <= 1:
+        raise ValueError("dropout probability must be in [0, 1]")
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", {"X": [x]}, {"Out": [out]}, {"min": min, "max": max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip_by_norm", {"X": [x]}, {"Out": [out]}, {"max_norm": max_norm})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("label_smooth", {"X": [label]}, {"Out": [out]}, {"epsilon": epsilon})
+    return out
+
+
+def one_hot(input, depth: int, name=None):
+    helper = LayerHelper("one_hot", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot", {"X": [input]}, {"Out": [out]}, {"depth": depth})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("lrn", {"X": [input]}, {"Out": [out], "MidOut": [mid]},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flatten", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def stack(x, axis=0, name=None):
+    helper = LayerHelper("stack", name=name)
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", {"X": x}, {"Y": [out]}, {"axis": axis})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand", {"X": [x]}, {"Out": [out]}, {"expand_times": list(expand_times)})
+    return out
+
+
+def gather(input, index, name=None):
+    helper = LayerHelper("gather", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", {"X": [input], "Index": [index]}, {"Out": [out]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "scatter", {"X": [input], "Ids": [index], "Updates": [updates]},
+        {"Out": [out]}, {"overwrite": overwrite})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", {"X": [x]}, {"Out": [out]},
+                     {"paddings": list(paddings), "pad_value": pad_value})
+    return out
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("squeeze", {"X": [input]}, {"Out": [out]}, {"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("unsqueeze", {"X": [input]}, {"Out": [out]}, {"axes": list(axes)})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    helper.append_op("im2sequence", {"X": [input]}, {"Out": [out]},
+                     {"kernels": list(fs), "strides": list(st)})
+    return out

@@ -1,0 +1,35 @@
+"""Auto-generated single-input layer functions from the op registry
+(<- python/paddle/fluid/layers/ops.py via layer_function_generator.py)."""
+from __future__ import annotations
+
+import sys
+
+from ..layer_helper import LayerHelper
+
+_UNARY = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softshrink",
+    "sqrt", "abs", "ceil", "floor", "round", "reciprocal", "log", "square",
+    "softplus", "softsign", "relu", "relu6", "elu", "leaky_relu",
+    "hard_shrink", "hard_sigmoid", "brelu", "swish", "stanh",
+    "thresholded_relu", "pow", "log_softmax",
+]
+
+_mod = sys.modules[__name__]
+
+
+def _make_layer(op_name):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_name, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_name, {"X": [x]}, {"Out": [out]}, attrs)
+        return out
+
+    layer.__name__ = op_name
+    layer.__doc__ = f"elementwise {op_name} (auto-generated from op registry)"
+    return layer
+
+
+for _name in _UNARY:
+    setattr(_mod, _name, _make_layer(_name))
+
+__all__ = list(_UNARY)

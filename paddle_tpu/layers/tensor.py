@@ -1,0 +1,116 @@
+"""Tensor creation / manipulation layers (<- python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+from ..core.types import DataType
+from ..layer_helper import LayerHelper
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_global_variable([1], dtype, persistable=persistable, name=name)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(shape, dtype, persistable=persistable, name=name)
+    sb = helper.startup_program.global_block()
+    if not sb.has_var(var.name):
+        sv = sb.create_var(var.name, dtype=DataType.from_any(dtype),
+                           shape=tuple(shape), persistable=persistable)
+        sb.append_op(
+            "fill_constant",
+            outputs={"Out": [var.name]},
+            attrs={"shape": list(shape), "value": value, "dtype": DataType.from_any(dtype)},
+        )
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "fill_constant", {}, {"Out": [out]},
+        {"shape": list(shape), "value": value, "dtype": DataType.from_any(dtype)},
+    )
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0, name=None):
+    helper = LayerHelper("fill_constant_batch_size_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "fill_constant_batch_size_like", {"Input": [input]}, {"Out": [out]},
+        {"shape": list(shape), "value": value, "dtype": DataType.from_any(dtype),
+         "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx},
+    )
+    return out
+
+
+def zeros(shape, dtype, name=None):
+    return fill_constant(shape, dtype, 0.0, name=name)
+
+
+def ones(shape, dtype, name=None):
+    return fill_constant(shape, dtype, 1.0, name=name)
+
+
+def cast(x, dtype, name=None):
+    helper = LayerHelper("cast", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", {"X": [x]}, {"Out": [out]}, {"dtype": DataType.from_any(dtype)})
+    return out
+
+
+def assign(input, output=None, name=None):
+    helper = LayerHelper("assign", name=name)
+    import numpy as np
+
+    if isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype.name)
+        helper.append_op("assign_value", {}, {"Out": [output]},
+                         {"values": input, "dtype": DataType.from_any(input.dtype)})
+    else:
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("assign", {"X": [input]}, {"Out": [output]})
+    return output
+
+
+def sums(input, out=None, name=None):
+    helper = LayerHelper("sums", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", {"X": input}, {"Out": [out]})
+    return out
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_max", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_min", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def reverse(x, axis, name=None):
+    helper = LayerHelper("reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reverse", {"X": [x]}, {"Out": [out]},
+                     {"axis": axis if isinstance(axis, (list, tuple)) else [axis]})
+    return out
+
+
+def increment(x, value=1.0, in_place=True, name=None):
+    helper = LayerHelper("increment", name=name)
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", {"X": [x]}, {"Out": [out]}, {"step": value})
+    return out

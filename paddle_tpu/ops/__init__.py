@@ -1,0 +1,15 @@
+"""Operator library: importing this package registers every op.
+
+The inventory tracks SURVEY.md §2b / paddle/fluid/operators; each module's
+docstring cites the reference files it re-imagines for TPU/XLA.
+"""
+from ..core.registry import get_op_def, has_op, register_op, registered_ops  # noqa: F401
+
+from . import basic  # noqa: F401
+from . import math  # noqa: F401
+from . import activations  # noqa: F401
+from . import loss  # noqa: F401
+from . import nn  # noqa: F401
+from . import tensor_manip  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import metrics_ops  # noqa: F401

@@ -1,0 +1,81 @@
+"""Activation functors (<- paddle/fluid/operators/activation_op.cc ~25
+functors, softmax_op.cc, prelu_op.cc). One registration helper; grads come
+from the registry's generic vjp machinery so every activation's backward is
+exactly consistent with its forward."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _register_act(name, fn):
+    @register_op(name, inputs=("X",), outputs=("Out",))
+    def impl(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], attrs)]}
+
+
+_ACTS = {
+    "relu": lambda x, a: jnp.maximum(x, 0),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "square": lambda x, a: x * x,
+    "reciprocal": lambda x, a: 1.0 / x,
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: x / (1 + jnp.abs(x)),
+    "softshrink": lambda x, a: jnp.where(
+        x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+        jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    "relu6": lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "leaky_relu": lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x),
+    "elu": lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 2.0 / 3.0) * x),
+    "thresholded_relu": lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+}
+
+for _n, _f in _ACTS.items():
+    _register_act(_n, _f)
+
+
+@register_op("softmax", inputs=("X",), outputs=("Out",))
+def softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax", inputs=("X",), outputs=("Out",))
+def log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",))
+def prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and alpha.ndim == 1 and x.ndim == 4:
+        alpha = alpha.reshape(1, -1, 1, 1)
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("maxout", inputs=("X",), outputs=("Out",))
+def maxout(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // groups, groups, h, w).max(axis=2)]}

@@ -1,0 +1,128 @@
+"""Creation / casting / misc ops.
+
+<- paddle/fluid/operators/{fill_constant,uniform_random,gaussian_random,
+cast,assign,shape,scale,clip,sign,sum,increment}_op.cc. Kernels are jnp
+expressions that trace into the enclosing block's single XLA computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.types import DataType
+
+
+def _dtype_attr(attrs, default=DataType.FP32):
+    d = attrs.get("dtype", default)
+    return DataType.from_any(d).jnp_dtype
+
+
+@register_op("fill_constant", inputs=(), outputs=("Out",), no_grad=True)
+def fill_constant(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", ()))
+    value = attrs.get("value", 0.0)
+    return {"Out": [jnp.full(shape, value, dtype=_dtype_attr(attrs))]}
+
+
+@register_op("fill_constant_batch_size_like", inputs=("Input",), outputs=("Out",), no_grad=True)
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs.get("shape", ()))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=_dtype_attr(attrs))]}
+
+
+@register_op("fill_zeros_like", inputs=("X",), outputs=("Out",), no_grad=True)
+def fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+def _op_key(ctx, attrs):
+    """Honor an explicit nonzero 'seed' attr (reference semantics: seed=0
+    means 'draw from the global source'), else thread the executor's key."""
+    seed = attrs.get("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.next_key()
+
+
+@register_op("uniform_random", inputs=(), outputs=("Out",), no_grad=True, stochastic=True)
+def uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", ()))
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    dt = _dtype_attr(attrs)
+    return {"Out": [jax.random.uniform(_op_key(ctx, attrs), shape, dt, lo, hi)]}
+
+
+@register_op("gaussian_random", inputs=(), outputs=("Out",), no_grad=True, stochastic=True)
+def gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", ()))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    dt = _dtype_attr(attrs)
+    return {"Out": [mean + std * jax.random.normal(_op_key(ctx, attrs), shape, dt)]}
+
+
+@register_op("cast", inputs=("X",), outputs=("Out",))
+def cast(ctx, ins, attrs):
+    return {"Out": [ins["X"][0].astype(_dtype_attr(attrs, attrs.get("out_dtype", DataType.FP32)))]}
+
+
+@register_op("assign", inputs=("X",), outputs=("Out",))
+def assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("shape", inputs=("Input",), outputs=("Out",), no_grad=True)
+def shape(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32)]}
+
+
+@register_op("scale", inputs=("X",), outputs=("Out",))
+def scale(ctx, ins, attrs):
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    after = attrs.get("bias_after_scale", True)
+    x = ins["X"][0]
+    return {"Out": [x * s + b if after else (x + b) * s]}
+
+
+@register_op("increment", inputs=("X",), outputs=("Out",), no_grad=True)
+def increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+
+
+@register_op("clip", inputs=("X",), outputs=("Out",))
+def clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max"))]}
+
+
+@register_op("sign", inputs=("X",), outputs=("Out",), no_grad=True)
+def sign(ctx, ins, attrs):
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register_op("sum", inputs=("X",), outputs=("Out",))
+def sum_op(ctx, ins, attrs):
+    """Add N tensors (grad accumulation uses this, <- sum_op.cc)."""
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("assign_value", inputs=(), outputs=("Out",), no_grad=True)
+def assign_value(ctx, ins, attrs):
+    vals = attrs["values"]
+    return {"Out": [jnp.asarray(vals).astype(_dtype_attr(attrs))]}
+
+
+@register_op("label_smooth", inputs=("X",), outputs=("Out",))
+def label_smooth(ctx, ins, attrs):
+    eps = attrs.get("epsilon", 0.0)
+    x = ins["X"][0]
+    k = x.shape[-1]
+    return {"Out": [(1.0 - eps) * x + eps / k]}

@@ -1,0 +1,221 @@
+"""Loss ops (<- paddle/fluid/operators/{cross_entropy,softmax_with_cross_entropy,
+sigmoid_cross_entropy_with_logits,huber_loss,smooth_l1_loss,log_loss,hinge_loss,
+rank_loss,margin_rank_loss,square_error_cost via squared_l2_distance}_op.cc).
+
+Per-example losses keep the reference's [N, 1] shape so layer code and tests
+line up; reductions to scalars happen via the ``mean`` op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _gather_label(x, label):
+    """x[i, label[i]] with label shaped [N] or [N, 1]."""
+    if label.ndim == x.ndim:
+        label = label.squeeze(-1)
+    return jnp.take_along_axis(x, label[..., None].astype(jnp.int32), axis=-1)
+
+
+@register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",), diff_inputs=("X",))
+def cross_entropy(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-12
+    if attrs.get("soft_label", False):
+        y = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        y = -jnp.log(_gather_label(x, label) + eps)
+    return {"Y": [y]}
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=("Logits", "Label"),
+    outputs=("Softmax", "Loss"),
+    diff_inputs=("Logits",),
+)
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    log_p = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
+    else:
+        loss = -_gather_label(log_p, label)
+    return {"Softmax": [jnp.exp(log_p)], "Loss": [loss]}
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits",
+    inputs=("X", "Label"),
+    outputs=("Out",),
+    diff_inputs=("X",),
+)
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    # max(x,0) - x*z + log(1+exp(-|x|)) — numerically stable form
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost", inputs=("X", "Y"), outputs=("Out",))
+def square_error_cost(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"Out": [d * d]}
+
+
+@register_op("huber_loss", inputs=("X", "Y"), outputs=("Out", "Residual"),
+             diff_inputs=("X", "Y"))
+def huber_loss(ctx, ins, attrs):
+    delta = attrs.get("delta", 1.0)
+    r = ins["Y"][0] - ins["X"][0]
+    absr = jnp.abs(r)
+    loss = jnp.where(absr <= delta, 0.5 * r * r, delta * (absr - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss", inputs=("X", "Y", "InsideWeight", "OutsideWeight"),
+             outputs=("Out", "Diff"), diff_inputs=("X", "Y"))
+def smooth_l1_loss(ctx, ins, attrs):
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    x, y = ins["X"][0], ins["Y"][0]
+    iw = ins["InsideWeight"][0] if ins.get("InsideWeight") and ins["InsideWeight"][0] is not None else 1.0
+    ow = ins["OutsideWeight"][0] if ins.get("OutsideWeight") and ins["OutsideWeight"][0] is not None else 1.0
+    d = (x - y) * iw
+    absd = jnp.abs(d)
+    val = jnp.where(absd < 1.0 / s2, 0.5 * d * d * s2, absd - 0.5 / s2)
+    out = jnp.sum(val * ow, axis=tuple(range(1, x.ndim)), keepdims=False)[..., None]
+    return {"Out": [out], "Diff": [d]}
+
+
+@register_op("log_loss", inputs=("Predicted", "Labels"), outputs=("Loss",),
+             diff_inputs=("Predicted",))
+def log_loss(ctx, ins, attrs):
+    eps = attrs.get("epsilon", 1e-4)
+    p, l = ins["Predicted"][0], ins["Labels"][0]
+    return {"Loss": [-l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)]}
+
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"), outputs=("Loss",),
+             diff_inputs=("Logits",))
+def hinge_loss(ctx, ins, attrs):
+    x, y = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * x)]}
+
+
+@register_op("rank_loss", inputs=("Label", "Left", "Right"), outputs=("Out",),
+             diff_inputs=("Left", "Right"))
+def rank_loss(ctx, ins, attrs):
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register_op("margin_rank_loss", inputs=("X1", "X2", "Label"),
+             outputs=("Out", "Activated"), diff_inputs=("X1", "X2"))
+def margin_rank_loss(ctx, ins, attrs):
+    m = attrs.get("margin", 0.0)
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    out = jnp.maximum(0.0, -label * (x1 - x2) + m)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("modified_huber_loss", inputs=("X", "Y"),
+             outputs=("Out", "IntermediateVal"), diff_inputs=("X",))
+def modified_huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    z = (2.0 * y - 1.0) * x
+    out = jnp.where(z >= 1.0, 0.0, jnp.where(z >= -1.0, (1.0 - z) ** 2, -4.0 * z))
+    return {"Out": [out], "IntermediateVal": [z]}
+
+
+@register_op("kldiv_loss", inputs=("X", "Target"), outputs=("Loss",), diff_inputs=("X",))
+def kldiv_loss(ctx, ins, attrs):
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = jnp.where(t > 0, t * (jnp.log(t) - x), 0.0)
+    return {"Loss": [loss]}
+
+
+@register_op("nce", inputs=("Input", "Label", "Weight", "Bias", "SampleWeight"),
+             outputs=("Cost", "SampleLogits", "SampleLabels"),
+             diff_inputs=("Input", "Weight", "Bias"), stochastic=True)
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (<- nce_op.cc), uniform sampler."""
+    x, label, w = ins["Input"][0], ins["Label"][0], ins["Weight"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    num_classes = attrs["num_total_classes"]
+    num_neg = attrs.get("num_neg_samples", 10)
+    if label.ndim > 1:
+        label = label[:, 0]
+    n = x.shape[0]
+    neg = jax.random.randint(ctx.next_key(), (n, num_neg), 0, num_classes)
+    samples = jnp.concatenate([label[:, None], neg], axis=1)  # [n, 1+num_neg]
+    sw = w[samples]  # [n, 1+num_neg, dim]
+    logits = jnp.einsum("nd,nkd->nk", x, sw)
+    if bias is not None:
+        logits = logits + bias[samples]
+    labels = jnp.concatenate(
+        [jnp.ones((n, 1), x.dtype), jnp.zeros((n, num_neg), x.dtype)], axis=1
+    )
+    p_noise = 1.0 / num_classes
+    # NCE logistic loss with uniform noise distribution
+    logit_adj = logits - jnp.log(num_neg * p_noise)
+    loss = jnp.maximum(logit_adj, 0) - logit_adj * labels + jnp.log1p(jnp.exp(-jnp.abs(logit_adj)))
+    return {
+        "Cost": [jnp.sum(loss, axis=1, keepdims=True)],
+        "SampleLogits": [logits],
+        "SampleLabels": [samples],
+    }
+
+
+def _nce_fixed_samples(x, w, bias, samples, num_neg, num_classes):
+    n = x.shape[0]
+    logits = jnp.einsum("nd,nkd->nk", x, w[samples])
+    if bias is not None:
+        logits = logits + bias[samples]
+    labels = jnp.concatenate(
+        [jnp.ones((n, 1), x.dtype), jnp.zeros((n, samples.shape[1] - 1), x.dtype)], axis=1
+    )
+    logit_adj = logits - jnp.log(num_neg * (1.0 / num_classes))
+    loss = jnp.maximum(logit_adj, 0) - logit_adj * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logit_adj))
+    )
+    return jnp.sum(loss, axis=1, keepdims=True)
+
+
+@register_op(
+    "nce_grad",
+    inputs=("Input", "Label", "Weight", "Bias", "SampleWeight", "Cost",
+            "SampleLogits", "SampleLabels", "Cost@GRAD", "SampleLogits@GRAD",
+            "SampleLabels@GRAD"),
+    outputs=("Input@GRAD", "Weight@GRAD", "Bias@GRAD"),
+    no_grad=True,
+)
+def nce_grad(ctx, ins, attrs):
+    """Custom grad: the forward is stochastic (negative sampling), so the
+    backward must reuse the *saved* samples rather than letting the generic
+    vjp machinery re-draw them."""
+    x, w = ins["Input"][0], ins["Weight"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    samples = ins["SampleLabels"][0]
+    g = ins["Cost@GRAD"][0]
+    num_neg = attrs.get("num_neg_samples", 10)
+    num_classes = attrs["num_total_classes"]
+    diff = (x, w, bias) if bias is not None else (x, w)
+
+    def f(*args):
+        if bias is not None:
+            xx, ww, bb = args
+        else:
+            (xx, ww), bb = args, None
+        return _nce_fixed_samples(xx, ww, bb, samples, num_neg, num_classes)
+
+    _, vjp = jax.vjp(f, *diff)
+    grads = vjp(g)
+    out = {"Input@GRAD": [grads[0]], "Weight@GRAD": [grads[1]]}
+    if bias is not None:
+        out["Bias@GRAD"] = [grads[2]]
+    return out

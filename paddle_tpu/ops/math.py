@@ -1,0 +1,210 @@
+"""Linear algebra, elementwise (broadcasting), and reduction ops.
+
+<- paddle/fluid/operators/{mul,matmul,elementwise_*,reduce_*,top_k,arg_max,
+cumsum,cos_sim,clip_by_norm,norm}_op.cc and elementwise_op_function.h
+broadcast semantics. All of these map directly onto MXU-friendly jnp/lax
+primitives; XLA fuses the elementwise ops into neighbouring matmuls.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _flatten2(x, num_col_dims):
+    """Flatten to 2D as the reference's mul op does (mul_op.cc)."""
+    lead = 1
+    for d in x.shape[:num_col_dims]:
+        lead *= d
+    rest = 1
+    for d in x.shape[num_col_dims:]:
+        rest *= d
+    return x.reshape(lead, rest)
+
+
+@register_op("mul", inputs=("X", "Y"), outputs=("Out",))
+def mul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = _flatten2(x, xnc)
+    y2 = _flatten2(y, ync)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.promote_types(x2.dtype, y2.dtype))
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register_op("matmul", inputs=("X", "Y"), outputs=("Out",))
+def matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+def _broadcast_y(x, y, axis):
+    """Reference elementwise broadcast: align Y's dims to X starting at axis
+    (elementwise_op_function.h)."""
+    if x.shape == y.shape:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    # append trailing 1s so numpy broadcasting matches the axis-aligned rule
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(f"elementwise_{name}", inputs=("X", "Y"), outputs=("Out",))
+    def impl(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+
+
+for _name, _fn in [
+    ("add", jnp.add),
+    ("sub", jnp.subtract),
+    ("mul", jnp.multiply),
+    ("div", jnp.divide),
+    ("max", jnp.maximum),
+    ("min", jnp.minimum),
+    ("pow", jnp.power),
+    ("mod", jnp.mod),
+    ("floordiv", jnp.floor_divide),
+]:
+    _register_elementwise(_name, _fn)
+
+
+def _reduce_axes(x, attrs):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % x.ndim for d in dim)
+
+
+def _register_reduce(name, fn):
+    @register_op(f"reduce_{name}", inputs=("X",), outputs=("Out",))
+    def impl(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        axes = _reduce_axes(x, attrs)
+        return {"Out": [_fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))]}
+
+
+for _name, _fn in [
+    ("sum", jnp.sum),
+    ("mean", jnp.mean),
+    ("max", jnp.max),
+    ("min", jnp.min),
+    ("prod", jnp.prod),
+]:
+    _register_reduce(_name, _fn)
+
+
+@register_op("mean", inputs=("X",), outputs=("Out",))
+def mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register_op("cumsum", inputs=("X",), outputs=("Out",))
+def cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get("exclusive", False):
+            out = out - x
+    return {"Out": [out]}
+
+
+@register_op("arg_max", inputs=("X",), outputs=("Out",), no_grad=True)
+def arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int32)]}
+
+
+@register_op("arg_min", inputs=("X",), outputs=("Out",), no_grad=True)
+def arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int32)]}
+
+
+@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"), no_grad=True)
+def top_k(ctx, ins, attrs):
+    vals, idx = lax.top_k(ins["X"][0], attrs.get("k", 1))
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
+
+
+@register_op("cos_sim", inputs=("X", "Y"), outputs=("Out", "XNorm", "YNorm"),
+             diff_inputs=("X", "Y"))
+def cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("clip_by_norm", inputs=("X",), outputs=("Out",))
+def clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return {"Out": [jnp.where(norm > max_norm, x * (max_norm / norm), x)]}
+
+
+@register_op("norm", inputs=("X",), outputs=("Out", "Norm"), diff_inputs=("X",))
+def norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [n]}
+
+
+@register_op("l1_norm", inputs=("X",), outputs=("Out",))
+def l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0]))]}
+
+
+@register_op("squared_l2_norm", inputs=("X",), outputs=("Out",))
+def squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x * x)]}
+
+
+@register_op("squared_l2_distance", inputs=("X", "Y"), outputs=("Out", "sub_result"),
+             diff_inputs=("X", "Y"))
+def squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {"Out": [jnp.sum(sub * sub, axis=-1, keepdims=True)], "sub_result": [sub]}
+
+
+@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"),
+             outputs=("Out",), diff_inputs=("X", "Y", "Weight", "Bias"))
+def bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    # out[b, k] = x[b] @ w[k] @ y[b]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("minus", inputs=("X", "Y"), outputs=("Out",))
+def minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}

@@ -1,0 +1,108 @@
+"""Metric ops (<- paddle/fluid/operators/{accuracy,auc,precision_recall,
+mean_iou}_op.cc). Pure functions of predictions/labels; streaming state is
+kept in persistable vars updated functionally like any other state."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", inputs=("Out", "Indices", "Label"),
+             outputs=("Accuracy", "Correct", "Total"), no_grad=True)
+def accuracy(ctx, ins, attrs):
+    idx, label = ins["Indices"][0], ins["Label"][0]
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    hit = jnp.any(idx == label[:, None], axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    return {
+        "Accuracy": [correct.astype(jnp.float32) / total.astype(jnp.float32)],
+        "Correct": [correct],
+        "Total": [total],
+    }
+
+
+@register_op("auc", inputs=("Predict", "Label", "TP", "FP", "TN", "FN"),
+             outputs=("AUC", "TPOut", "FPOut", "TNOut", "FNOut"), no_grad=True)
+def auc(ctx, ins, attrs):
+    """Streaming AUC over threshold buckets (<- auc_op.cc)."""
+    pred, label = ins["Predict"][0], ins["Label"][0]
+    tp, fp, tn, fn = (ins[k][0] for k in ("TP", "FP", "TN", "FN"))
+    num_t = attrs.get("num_thresholds", 200)
+    if label.ndim == 2:
+        label = label.squeeze(-1)
+    pos_score = pred[:, -1] if pred.ndim == 2 else pred
+    thresholds = (jnp.arange(num_t) + 1.0) / (num_t + 1.0)
+    above = pos_score[None, :] >= thresholds[:, None]  # [T, N]
+    is_pos = (label > 0)[None, :]
+    tp_new = tp + jnp.sum(above & is_pos, axis=1)
+    fp_new = fp + jnp.sum(above & ~is_pos, axis=1)
+    fn_new = fn + jnp.sum(~above & is_pos, axis=1)
+    tn_new = tn + jnp.sum(~above & ~is_pos, axis=1)
+    tpr = tp_new / jnp.maximum(tp_new + fn_new, 1)
+    fpr = fp_new / jnp.maximum(fp_new + tn_new, 1)
+    # trapezoid over descending thresholds
+    auc_val = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+    return {
+        "AUC": [jnp.abs(auc_val)],
+        "TPOut": [tp_new],
+        "FPOut": [fp_new],
+        "TNOut": [tn_new],
+        "FNOut": [fn_new],
+    }
+
+
+@register_op("mean_iou", inputs=("Predictions", "Labels"),
+             outputs=("OutMeanIou", "OutWrong", "OutCorrect"), no_grad=True)
+def mean_iou(ctx, ins, attrs):
+    pred, label = ins["Predictions"][0], ins["Labels"][0]
+    n = attrs["num_classes"]
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    conf = jnp.zeros((n, n), jnp.int64).at[label, pred].add(1)
+    inter = jnp.diagonal(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    wrong = conf.sum(1) - inter
+    return {"OutMeanIou": [miou], "OutWrong": [wrong.astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+@register_op("precision_recall",
+             inputs=("MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"),
+             outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"), no_grad=True)
+def precision_recall(ctx, ins, attrs):
+    """Macro/micro precision-recall-F1 (<- precision_recall_op.cc)."""
+    idx, labels = ins["Indices"][0], ins["Labels"][0]
+    states = ins["StatesInfo"][0]  # [C, 4]: TP, FP, TN, FN
+    c = attrs["class_number"]
+    if labels.ndim == 2:
+        labels = labels.squeeze(-1)
+    pred = idx[:, 0].astype(jnp.int32)
+    onehot_p = jnp.zeros((pred.shape[0], c)).at[jnp.arange(pred.shape[0]), pred].set(1)
+    onehot_l = jnp.zeros((pred.shape[0], c)).at[jnp.arange(pred.shape[0]), labels.astype(jnp.int32)].set(1)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1 - onehot_l), axis=0)
+    fn = jnp.sum((1 - onehot_p) * onehot_l, axis=0)
+    tn = pred.shape[0] - tp - fp - fn
+
+    def metrics(tp, fp, tn, fn):
+        prec = tp / jnp.maximum(tp + fp, 1e-12)
+        rec = tp / jnp.maximum(tp + fn, 1e-12)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        tps, fps, fns = tp.sum(), fp.sum(), fn.sum()
+        mprec = tps / jnp.maximum(tps + fps, 1e-12)
+        mrec = tps / jnp.maximum(tps + fns, 1e-12)
+        mf1 = 2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-12)
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    batch = metrics(tp, fp, tn, fn)
+    acc_states = states + jnp.stack([tp, fp, tn, fn], axis=1)
+    accum = metrics(acc_states[:, 0], acc_states[:, 1], acc_states[:, 2], acc_states[:, 3])
+    return {"BatchMetrics": [batch], "AccumMetrics": [accum],
+            "AccumStatesInfo": [acc_states]}

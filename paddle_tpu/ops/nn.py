@@ -1,0 +1,397 @@
+"""Neural-net structural ops: conv / pool / normalization / dropout /
+embedding lookup.
+
+<- paddle/fluid/operators/{conv,conv_transpose,pool,batch_norm,layer_norm,
+lrn,dropout,lookup_table,one_hot}_op.cc. Data layout is NCHW to match the
+reference's Python API; XLA re-lays-out for the MXU internally, so there is
+no reason to diverge from the reference's user-visible convention.
+
+Convs lower to ``lax.conv_general_dilated`` — exactly the HLO the TPU's MXU
+wants — instead of im2col+GEMM (the reference's math/im2col.cc path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.ir import GRAD_SUFFIX, grad_var_name
+from ..core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register_op("conv2d", inputs=("Input", "Filter", "Bias"), outputs=("Output",),
+             diff_inputs=("Input", "Filter", "Bias"))
+def conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]  # x: NCHW, w: OIHW
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.promote_types(x.dtype, w.dtype),
+    )
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", inputs=("Input", "Filter"), outputs=("Output",))
+def depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return conv2d(ctx, {"Input": [x], "Filter": [w], "Bias": [None]}, attrs)
+
+
+@register_op("conv2d_transpose", inputs=("Input", "Filter"), outputs=("Output",))
+def conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: IOHW in reference transpose
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    out = lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+    )
+    return {"Output": [out]}
+
+
+@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",))
+def conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]  # NCDHW / OIDHW
+    s = attrs.get("strides", [1, 1, 1])
+    p = attrs.get("paddings", [0, 0, 0])
+    d = attrs.get("dilations", [1, 1, 1])
+    out = lax.conv_general_dilated(
+        x, w, tuple(s), [(pp, pp) for pp in p], rhs_dilation=tuple(d),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+    )
+    return {"Output": [out]}
+
+
+def _ceil_extra(size, k, p, s):
+    """Extra right/bottom padding so reduce_window (floor) matches ceil_mode."""
+    floor_out = (size + 2 * p - k) // s + 1
+    ceil_out = -((size + 2 * p - k) // -s) + 1
+    return (ceil_out - floor_out) * s
+
+
+def _pool2d_impl(x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = x.shape[2:]
+        strides = (1, 1)
+        pads = (0, 0)
+    eh = ew = 0
+    if attrs.get("ceil_mode", False):
+        eh = _ceil_extra(x.shape[2], ksize[0], pads[0], strides[0])
+        ew = _ceil_extra(x.shape[3], ksize[1], pads[1], strides[1])
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0), (pads[0], pads[0] + eh), (pads[1], pads[1] + ew))
+    if ptype == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides_full, padding)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides_full, padding)
+        if attrs.get("exclusive", True) and (pads != (0, 0) or eh or ew):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, padding)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return out
+
+
+@register_op("pool2d", inputs=("X",), outputs=("Out",))
+def pool2d(ctx, ins, attrs):
+    return {"Out": [_pool2d_impl(ins["X"][0], attrs)]}
+
+
+def _pool_window_positions(x, ksize, strides):
+    """Global flat (h*W+w) index of each element of each pooling window.
+
+    Returns patches [n, c, kh*kw, oh, ow] and the matching global index map
+    [kh*kw, oh, ow] so argmax picks parity-faithful max_pool_with_index masks
+    (<- pool_with_index_op.cc: mask = offset within the input feature plane).
+    """
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )  # [n, c*kh*kw, oh, ow]
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, kh * kw, oh, ow)
+    wins = jnp.arange(kh * kw)
+    wi, wj = wins // kw, wins % kw
+    base_i = jnp.arange(oh)[:, None] * sh
+    base_j = jnp.arange(ow)[None, :] * sw
+    # [kh*kw, oh, ow]
+    gidx = (wi[:, None, None] + base_i[None]) * w + (wj[:, None, None] + base_j[None])
+    return patches, gidx
+
+
+@register_op("pool2d_with_index", inputs=("X",), outputs=("Out", "Mask"),
+             diff_inputs=("X",))
+def pool2d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    patches, gidx = _pool_window_positions(x, ksize, strides)
+    arg = jnp.argmax(patches, axis=2)  # [n, c, oh, ow]
+    out = jnp.max(patches, axis=2)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(gidx[None, None], patches.shape[:2] + gidx.shape),
+        arg[:, :, None], axis=2,
+    ).squeeze(2)
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("unpool", inputs=("X", "Indices"), outputs=("Out",), diff_inputs=("X",))
+def unpool(ctx, ins, attrs):
+    """Scatter pooled values back to the positions recorded in Indices
+    (<- unpool_op.cc)."""
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    oh, ow = attrs.get("unpooled_height"), attrs.get("unpooled_width")
+    if oh is None or ow is None:
+        s = _pair(attrs.get("strides", [2, 2]))
+        oh, ow = h * s[0], w * s[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1).astype(jnp.int32),
+    ].set(x.reshape(n, c, -1))
+    return {"Out": [flat.reshape(n, c, oh, ow)]}
+
+
+@register_op(
+    "batch_norm",
+    inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+    outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    diff_inputs=("X", "Scale", "Bias"),
+)
+def batch_norm(ctx, ins, attrs):
+    """Train mode computes batch stats and updates running stats functionally
+    (MeanOut/VarianceOut carry the same var names as Mean/Variance, so the
+    executor's env update is the in-place semantics of batch_norm_op.cc)."""
+    x, scale, bias = ins["X"][0], ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    shape_bcast = [1] * x.ndim
+    shape_bcast[1 if layout == "NCHW" else x.ndim - 1] = -1
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean + (1 - momentum) * lax.stop_gradient(use_mean)
+        var_out = momentum * var + (1 - momentum) * lax.stop_gradient(use_var)
+        saved_mean = use_mean
+        saved_var = use_var
+    inv = lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(shape_bcast)) * inv.reshape(shape_bcast) * scale.reshape(
+        shape_bcast
+    ) + bias.reshape(shape_bcast)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op("layer_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"), diff_inputs=("X", "Scale", "Bias"))
+def layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * begin + norm_shape)
+    if bias is not None:
+        y = y + bias.reshape((1,) * begin + norm_shape)
+    return {"Y": [y], "Mean": [mean.squeeze(axes)], "Variance": [var.squeeze(axes)]}
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"), diff_inputs=("X",))
+def lrn(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x * mid ** (-beta)], "MidOut": [mid]}
+
+
+def _dropout_grad_maker(op, no_grad_set):
+    return [
+        {
+            "type": "dropout_grad",
+            "inputs": {
+                "Mask": list(op.outputs["Mask"]),
+                "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]],
+            },
+            "outputs": {"X@GRAD": [
+                "" if n in no_grad_set else grad_var_name(n) for n in op.inputs["X"]
+            ]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+@register_op("dropout", inputs=("X",), outputs=("Out", "Mask"),
+             stochastic=True, grad_maker=_dropout_grad_maker)
+def dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if is_test or p == 0.0:
+        # reference's downgrade-in-infer: scale by (1-p) at inference
+        mode = attrs.get("dropout_implementation", "downgrade_in_infer")
+        out = x if mode == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones_like(x)]}
+    from .basic import _op_key
+
+    keep = jax.random.bernoulli(_op_key(ctx, attrs), 1.0 - p, x.shape)
+    mode = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if mode == "upscale_in_train":
+        mask = keep.astype(x.dtype) / (1.0 - p)
+    else:
+        mask = keep.astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+@register_op("dropout_grad", inputs=("Mask", "Out@GRAD"), outputs=("X@GRAD",),
+             no_grad=True)
+def dropout_grad(ctx, ins, attrs):
+    """Backward reuses the saved mask — never re-drawn (cf. dropout_op.cc)."""
+    return {"X@GRAD": [ins["Out@GRAD"][0] * ins["Mask"][0]]}
+
+
+@register_op("lookup_table", inputs=("W", "Ids"), outputs=("Out",), diff_inputs=("W",))
+def lookup_table(ctx, ins, attrs):
+    """Embedding lookup (<- lookup_table_op.cc). The generic vjp turns the
+    gather's backward into a scatter-add — the dense equivalent of the
+    reference's SelectedRows sparse gradient."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.squeeze(-1)
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return {"Out": [out]}
+
+
+@register_op("one_hot", inputs=("X",), outputs=("Out",), no_grad=True)
+def one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": [jax.nn.one_hot(x.astype(jnp.int32), attrs["depth"], dtype=jnp.float32)]}
+
+
+@register_op("embedding", inputs=("W", "Ids"), outputs=("Out",), diff_inputs=("W",))
+def embedding(ctx, ins, attrs):
+    return lookup_table(ctx, ins, attrs)
+
+
+@register_op("bilinear_interp", inputs=("X",), outputs=("Out",))
+def bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    oh = attrs.get("out_h")
+    ow = attrs.get("out_w")
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+    return {"Out": [out]}
+
+
+@register_op("nearest_interp", inputs=("X",), outputs=("Out",))
+def nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    n, c, _, _ = x.shape
+    out = jax.image.resize(x, (n, c, attrs.get("out_h"), attrs.get("out_w")), method="nearest")
+    return {"Out": [out]}
+
+
+@register_op("im2sequence", inputs=("X",), outputs=("Out",))
+def im2sequence(ctx, ins, attrs):
+    """Image patches -> sequence rows (<- im2sequence_op.cc), dense layout."""
+    x = ins["X"][0]
+    kh, kw = _pair(attrs.get("kernels", [1, 1]))
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )  # [n, c*kh*kw, oh, ow]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": [out]}
+
+
+@register_op("conv_shift", inputs=("X", "Y"), outputs=("Out",))
+def conv_shift(ctx, ins, attrs):
+    """Circular correlation (<- conv_shift_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(x.shape[1])[:, None] + jnp.arange(m)[None, :] - half) % x.shape[1]
+    return {"Out": [jnp.einsum("bnm,bm->bn", x[:, idx], y)]}
+
+
+@register_op("row_conv", inputs=("X", "Filter"), outputs=("Out",))
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution over time-major input [T, D] per sequence
+    (dense batched form: [N, T, D]; <- row_conv_op.cc)."""
+    x, f = ins["X"][0], ins["Filter"][0]  # f: [future_context, D]
+    k = f.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i : i + x.shape[1]] * f[i] for i in range(k))
+    return {"Out": [out]}

@@ -1,0 +1,254 @@
+"""Optimizer update ops.
+
+<- paddle/fluid/operators/{sgd,momentum,adam,adamax,adagrad,decayed_adagrad,
+adadelta,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.cc (python driver:
+python/paddle/fluid/optimizer.py:36-1105).
+
+Each op's outputs reuse its state-input var names (ParamOut <- Param etc.), so
+the executor's functional env-update gives exactly the reference's in-place
+semantics; with buffer donation XLA updates parameters in place in HBM, and
+because the whole block is one XLA program the optimizer fuses with the
+backward pass (no separate update kernel launches).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("sgd", inputs=("Param", "Grad", "LearningRate"), outputs=("ParamOut",),
+             no_grad=True)
+def sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr * g]}
+
+
+@register_op(
+    "momentum",
+    inputs=("Param", "Grad", "Velocity", "LearningRate"),
+    outputs=("ParamOut", "VelocityOut"),
+    no_grad=True,
+)
+def momentum(ctx, ins, attrs):
+    p, g, v, lr = (ins[k][0] for k in ("Param", "Grad", "Velocity", "LearningRate"))
+    mu = attrs.get("mu", 0.9)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op(
+    "adam",
+    inputs=("Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow", "Beta2Pow"),
+    outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
+    no_grad=True,
+)
+def adam(ctx, ins, attrs):
+    p, g, m1, m2, lr, b1p, b2p = (
+        ins[k][0]
+        for k in ("Param", "Grad", "Moment1", "Moment2", "LearningRate", "Beta1Pow", "Beta2Pow")
+    )
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    pn = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {
+        "ParamOut": [pn],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op(
+    "adamax",
+    inputs=("Param", "Grad", "Moment", "InfNorm", "LearningRate", "Beta1Pow"),
+    outputs=("ParamOut", "MomentOut", "InfNormOut"),
+    no_grad=True,
+)
+def adamax(ctx, ins, attrs):
+    p, g, m, u, lr, b1p = (
+        ins[k][0] for k in ("Param", "Grad", "Moment", "InfNorm", "LearningRate", "Beta1Pow")
+    )
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    mn = b1 * m + (1 - b1) * g
+    un = jnp.maximum(b2 * u, jnp.abs(g))
+    pn = p - (lr / (1 - b1p)) * mn / (un + eps)
+    return {"ParamOut": [pn], "MomentOut": [mn], "InfNormOut": [un]}
+
+
+@register_op(
+    "adagrad",
+    inputs=("Param", "Grad", "Moment", "LearningRate"),
+    outputs=("ParamOut", "MomentOut"),
+    no_grad=True,
+)
+def adagrad(ctx, ins, attrs):
+    p, g, m, lr = (ins[k][0] for k in ("Param", "Grad", "Moment", "LearningRate"))
+    eps = attrs.get("epsilon", 1e-6)
+    mn = m + g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mn) + eps)], "MomentOut": [mn]}
+
+
+@register_op(
+    "decayed_adagrad",
+    inputs=("Param", "Grad", "Moment", "LearningRate"),
+    outputs=("ParamOut", "MomentOut"),
+    no_grad=True,
+)
+def decayed_adagrad(ctx, ins, attrs):
+    p, g, m, lr = (ins[k][0] for k in ("Param", "Grad", "Moment", "LearningRate"))
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mn = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mn) + eps)], "MomentOut": [mn]}
+
+
+@register_op(
+    "adadelta",
+    inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"),
+    outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
+    no_grad=True,
+)
+def adadelta(ctx, ins, attrs):
+    p, g, ag, au = (
+        ins[k][0] for k in ("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate")
+    )
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    agn = rho * ag + (1 - rho) * g * g
+    update = -jnp.sqrt((au + eps) / (agn + eps)) * g
+    aun = rho * au + (1 - rho) * update * update
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [agn], "AvgSquaredUpdateOut": [aun]}
+
+
+@register_op(
+    "rmsprop",
+    inputs=("Param", "Grad", "MeanSquare", "Moment", "LearningRate"),
+    outputs=("ParamOut", "MeanSquareOut", "MomentOut"),
+    no_grad=True,
+)
+def rmsprop(ctx, ins, attrs):
+    p, g, ms, mom, lr = (
+        ins[k][0] for k in ("Param", "Grad", "MeanSquare", "Moment", "LearningRate")
+    )
+    rho = attrs.get("decay", 0.9)
+    mu = attrs.get("momentum", 0.0)
+    eps = attrs.get("epsilon", 1e-10)
+    msn = rho * ms + (1 - rho) * g * g
+    momn = mu * mom + lr * g / jnp.sqrt(msn + eps)
+    return {"ParamOut": [p - momn], "MeanSquareOut": [msn], "MomentOut": [momn]}
+
+
+@register_op(
+    "ftrl",
+    inputs=("Param", "Grad", "SquaredAccumulator", "LinearAccumulator", "LearningRate"),
+    outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
+    no_grad=True,
+)
+def ftrl(ctx, ins, attrs):
+    p, g, sq, lin, lr = (
+        ins[k][0]
+        for k in ("Param", "Grad", "SquaredAccumulator", "LinearAccumulator", "LearningRate")
+    )
+    l1 = attrs.get("l1", 0.0) + 1e-10
+    l2 = attrs.get("l2", 0.0) + 1e-10
+    lr_power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = new_sq ** (-lr_power) / lr + 2 * l2
+    x = l1 * jnp.sign(new_lin) - new_lin
+    pn = jnp.where(jnp.abs(new_lin) > l1, x / denom, 0.0)
+    return {"ParamOut": [pn], "SquaredAccumOut": [new_sq], "LinearAccumOut": [new_lin]}
+
+
+@register_op("proximal_gd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), no_grad=True)
+def proximal_gd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    pn = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": [pn]}
+
+
+@register_op(
+    "proximal_adagrad",
+    inputs=("Param", "Grad", "Moment", "LearningRate"),
+    outputs=("ParamOut", "MomentOut"),
+    no_grad=True,
+)
+def proximal_adagrad(ctx, ins, attrs):
+    p, g, m, lr = (ins[k][0] for k in ("Param", "Grad", "Moment", "LearningRate"))
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    mn = m + g * g
+    lr_t = lr / jnp.sqrt(mn + 1e-12)
+    prox = p - lr_t * g
+    pn = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / (1.0 + lr_t * l2)
+    return {"ParamOut": [pn], "MomentOut": [mn]}
+
+
+@register_op(
+    "average_accumulates",
+    inputs=("param", "in_sum_1", "in_sum_2", "in_sum_3", "in_num_accumulates",
+            "in_old_num_accumulates", "in_num_updates"),
+    outputs=("out_sum_1", "out_sum_2", "out_sum_3", "out_num_accumulates",
+             "out_old_num_accumulates", "out_num_updates"),
+    no_grad=True,
+)
+def average_accumulates(ctx, ins, attrs):
+    """Sliding parameter average state machine (<- average_accumulates_op.cc,
+    used by ModelAverage, optimizer.py:929)."""
+    p = ins["param"][0]
+    s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
+    num_acc = ins["in_num_accumulates"][0]
+    old_num = ins["in_old_num_accumulates"][0]
+    num_upd = ins["in_num_updates"][0]
+    avg_window = attrs.get("average_window", 0.0)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+    window = jnp.maximum(
+        jnp.asarray(min_avg, jnp.int64),
+        jnp.minimum(jnp.asarray(max_avg, jnp.int64), (num_upd * avg_window).astype(jnp.int64)),
+    )
+    roll = num_acc >= window
+    s2n = jnp.where(roll, s2 + s1, s2)
+    s1n = jnp.where(roll, jnp.zeros_like(s1), s1)
+    old_n = jnp.where(roll, old_num + num_acc, old_num)
+    num_accn = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    roll2 = old_n > 2 * window
+    s3n = jnp.where(roll2, s2n, s3)
+    s2n = jnp.where(roll2, jnp.zeros_like(s2n), s2n)
+    old_n2 = jnp.where(roll2, jnp.zeros_like(old_n), old_n)
+    return {
+        "out_sum_1": [s1n],
+        "out_sum_2": [s2n],
+        "out_sum_3": [s3n],
+        "out_num_accumulates": [num_accn],
+        "out_old_num_accumulates": [old_n2],
+        "out_num_updates": [num_upd],
+    }

@@ -1,0 +1,174 @@
+"""Shape/layout manipulation ops.
+
+<- paddle/fluid/operators/{reshape,transpose,concat,split,expand,gather,
+scatter,pad,crop,reverse,squeeze/unsqueeze(absent in ref),stack,multiplex,
+slice(sequence_slice)}_op.cc. These are pure metadata/data-movement ops; XLA
+folds most of them into neighbouring computations.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("reshape", inputs=("X",), outputs=("Out",))
+def reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # reference semantics: 0 = copy input dim at that position, -1 = infer
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": [x.reshape(shape)]}
+
+
+@register_op("reshape2", inputs=("X",), outputs=("Out", "XShape"), diff_inputs=("X",))
+def reshape2(ctx, ins, attrs):
+    out = reshape(ctx, ins, attrs)
+    return {"Out": out["Out"], "XShape": [jnp.zeros((0,) + ins["X"][0].shape)]}
+
+
+@register_op("transpose", inputs=("X",), outputs=("Out",))
+def transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register_op("concat", inputs=("X",), outputs=("Out",))
+def concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split", inputs=("X",), outputs=("Out",))
+def split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if num:
+        parts = jnp.split(x, num, axis=axis)
+    else:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        parts = jnp.split(x, idx, axis=axis)
+    return {"Out": parts}
+
+
+@register_op("expand", inputs=("X",), outputs=("Out",))
+def expand(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], attrs["expand_times"])]}
+
+
+@register_op("gather", inputs=("X", "Index"), outputs=("Out",), diff_inputs=("X",))
+def gather(ctx, ins, attrs):
+    idx = ins["Index"][0]
+    if idx.ndim == 2 and idx.shape[-1] == 1:
+        idx = idx.squeeze(-1)
+    return {"Out": [jnp.take(ins["X"][0], idx.astype(jnp.int32), axis=0)]}
+
+
+@register_op("scatter", inputs=("X", "Ids", "Updates"), outputs=("Out",),
+             diff_inputs=("X", "Updates"))
+def scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if ids.ndim == 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    ids = ids.astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(upd)]}
+    return {"Out": [x.at[ids].add(upd)]}
+
+
+@register_op("pad", inputs=("X",), outputs=("Out",))
+def pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # flat [before0, after0, before1, after1, ...]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("crop", inputs=("X", "Y"), outputs=("Out",), diff_inputs=("X",))
+def crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    if ins.get("Y") and ins["Y"][0] is not None:
+        shape = ins["Y"][0].shape
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[slices]]}
+
+
+@register_op("slice", inputs=("Input",), outputs=("Out",), diff_inputs=("Input",))
+def slice_op(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    sl = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = slice(st, en)
+    return {"Out": [x[tuple(sl)]]}
+
+
+@register_op("reverse", inputs=("X",), outputs=("Out",))
+def reverse(ctx, ins, attrs):
+    axes = attrs.get("axis", [0])
+    if isinstance(axes, int):
+        axes = [axes]
+    x = ins["X"][0]
+    for ax in axes:
+        x = jnp.flip(x, ax)
+    return {"Out": [x]}
+
+
+@register_op("stack", inputs=("X",), outputs=("Y",))
+def stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack", inputs=("X",), outputs=("Y",))
+def unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(p, axis) for p in jnp.split(x, n, axis)]}
+
+
+@register_op("squeeze", inputs=("X",), outputs=("Out",))
+def squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes", [])
+    x = ins["X"][0]
+    if not axes:
+        return {"Out": [jnp.squeeze(x)]}
+    return {"Out": [jnp.squeeze(x, axis=tuple(axes))]}
+
+
+@register_op("unsqueeze", inputs=("X",), outputs=("Out",))
+def unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for ax in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, ax)
+    return {"Out": [x]}
+
+
+@register_op("multiplex", inputs=("Ids", "X"), outputs=("Out",), diff_inputs=("X",))
+def multiplex(ctx, ins, attrs):
+    ids = ins["Ids"][0]
+    stackx = jnp.stack(ins["X"], axis=0)  # [K, N, D]
+    if ids.ndim == 2:
+        ids = ids.squeeze(-1)
+    n = stackx.shape[1]
+    return {"Out": [stackx[ids.astype(jnp.int32), jnp.arange(n)]]}
+
+
+@register_op("flatten", inputs=("X",), outputs=("Out",))
+def flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return {"Out": [x.reshape(lead, -1)]}

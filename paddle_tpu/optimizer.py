@@ -1,0 +1,345 @@
+"""Optimizers: IR passes appending per-parameter update ops.
+
+<- python/paddle/fluid/optimizer.py:36-1105 (SGD, Momentum, Adagrad, Adam,
+Adamax, DecayedAdagrad, Adadelta, RMSProp, Ftrl, ModelAverage).
+
+``minimize(loss)`` = append_backward + one update op per parameter, exactly
+like the reference. Because the whole block compiles to one XLA program, all
+per-parameter update ops fuse into the backward — the TPU analogue of fused
+optimizers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .core.autodiff import append_backward
+from .core.ir import Program, Variable, default_startup_program
+from .core.types import DataType
+from . import unique_name
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name: Optional[str] = None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+
+    # -- learning rate --
+    def _create_global_learning_rate(self, program: Program, startup: Program):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        name = unique_name.generate("learning_rate")
+        block = program.global_block()
+        self._lr_var = block.create_var(
+            name, dtype=DataType.FP32, shape=(), persistable=True, stop_gradient=True
+        )
+        sb = startup.global_block()
+        sb.create_var(name, dtype=DataType.FP32, shape=(), persistable=True)
+        sb.append_op(
+            "fill_constant",
+            outputs={"Out": [name]},
+            attrs={"shape": [], "value": float(self._learning_rate), "dtype": DataType.FP32},
+        )
+
+    def _lr_for_param(self, param: Variable) -> Variable:
+        # per-param lr scaling (ParamAttr.learning_rate) is applied by an
+        # extra scale op only when != 1.0
+        attr = getattr(param, "_param_attr", None)
+        scale = attr.learning_rate if attr is not None else 1.0
+        if scale == 1.0:
+            return self._lr_var
+        block = param.block.program.global_block()
+        name = unique_name.generate(f"{param.name}.lr")
+        out = block.create_var(name, dtype=DataType.FP32, shape=())
+        block.append_op(
+            "scale", {"X": [self._lr_var.name]}, {"Out": [name]}, {"scale": scale}
+        )
+        return out
+
+    # -- accumulators --
+    def _add_accumulator(
+        self,
+        name: str,
+        param: Variable,
+        startup: Program,
+        fill_value: float = 0.0,
+        shape=None,
+    ) -> Variable:
+        if self._accumulators.setdefault(name, {}).get(param.name) is not None:
+            return self._accumulators[name][param.name]
+        block = param.block.program.global_block()
+        var_name = unique_name.generate(f"{param.name}_{name}")
+        shape = tuple(shape if shape is not None else param.shape)
+        var = block.create_var(
+            var_name, dtype=param.dtype, shape=shape, persistable=True, stop_gradient=True
+        )
+        sb = startup.global_block()
+        sb.create_var(var_name, dtype=param.dtype, shape=shape, persistable=True)
+        sb.append_op(
+            "fill_constant",
+            outputs={"Out": [var_name]},
+            attrs={"shape": list(shape), "value": fill_value, "dtype": param.dtype},
+        )
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _create_accumulators(self, param: Variable, startup: Program):
+        pass
+
+    def _append_optimize_op(self, block, param: Variable, grad: Variable):
+        raise NotImplementedError
+
+    # -- public --
+    def minimize(
+        self,
+        loss: Variable,
+        startup_program: Optional[Program] = None,
+        parameter_list=None,
+        no_grad_set=None,
+    ) -> Tuple[List, List[Tuple[Variable, Variable]]]:
+        startup = startup_program or default_startup_program()
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        params_grads = [
+            (p, g)
+            for p, g in params_grads
+            if getattr(p, "_param_attr", None) is None or p._param_attr.trainable
+        ]
+        self._apply_regularization(loss.block, params_grads)
+        program = loss.block.program
+        self._create_global_learning_rate(program, startup)
+        block = program.global_block()
+        for p, g in params_grads:
+            self._create_accumulators(p, startup)
+        for p, g in params_grads:
+            self._append_optimize_op(block, p, g)
+        return [], params_grads
+
+    def _apply_regularization(self, block, params_grads):
+        from .regularizer import append_regularization_ops
+
+        append_regularization_ops(block, params_grads, self.regularization)
+
+
+class SGD(Optimizer):
+    """<- optimizer.py SGDOptimizer / sgd_op.cc."""
+
+    def _append_optimize_op(self, block, param, grad):
+        block.append_op(
+            "sgd",
+            {"Param": [param], "Grad": [grad], "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param]},
+        )
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("velocity", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        v = self._accumulators["velocity"][param.name]
+        block.append_op(
+            "momentum",
+            {"Param": [param], "Grad": [grad], "Velocity": [v],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param], "VelocityOut": [v]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("moment1", param, startup)
+        self._add_accumulator("moment2", param, startup)
+        self._add_accumulator("beta1_pow", param, startup, fill_value=self._beta1, shape=())
+        self._add_accumulator("beta2_pow", param, startup, fill_value=self._beta2, shape=())
+
+    def _append_optimize_op(self, block, param, grad):
+        a = self._accumulators
+        block.append_op(
+            "adam",
+            {
+                "Param": [param],
+                "Grad": [grad],
+                "Moment1": [a["moment1"][param.name]],
+                "Moment2": [a["moment2"][param.name]],
+                "LearningRate": [self._lr_for_param(param)],
+                "Beta1Pow": [a["beta1_pow"][param.name]],
+                "Beta2Pow": [a["beta2_pow"][param.name]],
+            },
+            {
+                "ParamOut": [param],
+                "Moment1Out": [a["moment1"][param.name]],
+                "Moment2Out": [a["moment2"][param.name]],
+                "Beta1PowOut": [a["beta1_pow"][param.name]],
+                "Beta2PowOut": [a["beta2_pow"][param.name]],
+            },
+            {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("moment", param, startup)
+        self._add_accumulator("inf_norm", param, startup)
+        self._add_accumulator("beta1_pow", param, startup, fill_value=self._beta1, shape=())
+
+    def _append_optimize_op(self, block, param, grad):
+        a = self._accumulators
+        block.append_op(
+            "adamax",
+            {
+                "Param": [param], "Grad": [grad],
+                "Moment": [a["moment"][param.name]],
+                "InfNorm": [a["inf_norm"][param.name]],
+                "LearningRate": [self._lr_for_param(param)],
+                "Beta1Pow": [a["beta1_pow"][param.name]],
+            },
+            {
+                "ParamOut": [param],
+                "MomentOut": [a["moment"][param.name]],
+                "InfNormOut": [a["inf_norm"][param.name]],
+            },
+            {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+        # beta1_pow update (reference does this on CPU side of adamax op)
+        bp = a["beta1_pow"][param.name]
+        block.append_op("scale", {"X": [bp]}, {"Out": [bp]}, {"scale": self._beta1})
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("moment", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        m = self._accumulators["moment"][param.name]
+        block.append_op(
+            "adagrad",
+            {"Param": [param], "Grad": [grad], "Moment": [m],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param], "MomentOut": [m]},
+            {"epsilon": self._epsilon},
+        )
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("moment", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        m = self._accumulators["moment"][param.name]
+        block.append_op(
+            "decayed_adagrad",
+            {"Param": [param], "Grad": [grad], "Moment": [m],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param], "MomentOut": [m]},
+            {"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("avg_squared_grad", param, startup)
+        self._add_accumulator("avg_squared_update", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        a = self._accumulators
+        block.append_op(
+            "adadelta",
+            {"Param": [param], "Grad": [grad],
+             "AvgSquaredGrad": [a["avg_squared_grad"][param.name]],
+             "AvgSquaredUpdate": [a["avg_squared_update"][param.name]]},
+            {"ParamOut": [param],
+             "AvgSquaredGradOut": [a["avg_squared_grad"][param.name]],
+             "AvgSquaredUpdateOut": [a["avg_squared_update"][param.name]]},
+            {"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("mean_square", param, startup)
+        self._add_accumulator("momentum", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        a = self._accumulators
+        block.append_op(
+            "rmsprop",
+            {"Param": [param], "Grad": [grad],
+             "MeanSquare": [a["mean_square"][param.name]],
+             "Moment": [a["momentum"][param.name]],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param],
+             "MeanSquareOut": [a["mean_square"][param.name]],
+             "MomentOut": [a["momentum"][param.name]]},
+            {"decay": self._rho, "epsilon": self._epsilon, "momentum": self._momentum},
+        )
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("squared", param, startup)
+        self._add_accumulator("linear", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        a = self._accumulators
+        block.append_op(
+            "ftrl",
+            {"Param": [param], "Grad": [grad],
+             "SquaredAccumulator": [a["squared"][param.name]],
+             "LinearAccumulator": [a["linear"][param.name]],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param],
+             "SquaredAccumOut": [a["squared"][param.name]],
+             "LinearAccumOut": [a["linear"][param.name]]},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+# fluid-style aliases
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl

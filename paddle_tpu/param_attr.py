@@ -1,0 +1,45 @@
+"""ParamAttr (<- python/paddle/fluid/param_attr.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .initializer import Initializer
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer: Optional[Initializer] = None,
+        learning_rate: float = 1.0,
+        regularizer=None,
+        trainable: bool = True,
+        gradient_clip=None,
+        sharding=None,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+        # TPU-native extension: optional jax.sharding PartitionSpec-like tuple
+        # naming mesh axes per param dim (used by parallel.apply_shardings)
+        self.sharding = sharding
+
+    @staticmethod
+    def to_attr(arg) -> "ParamAttr":
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        if isinstance(arg, bool):
+            return ParamAttr(trainable=arg)
+        raise TypeError(f"cannot interpret {arg!r} as ParamAttr")
+
+
+WeightNormParamAttr = ParamAttr  # placeholder parity alias

@@ -1,0 +1,56 @@
+"""Weight decay regularizers (<- python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, block, param, grad):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, block, param, grad):
+        from . import unique_name
+
+        decay = block.create_var(
+            unique_name.generate(f"{param.name}.l2decay"),
+            dtype=param.dtype, shape=param.shape)
+        block.append_op("scale", {"X": [param]}, {"Out": [decay]}, {"scale": self._coeff})
+        block.append_op("sum", {"X": [grad, decay]}, {"Out": [grad]})
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, block, param, grad):
+        from . import unique_name
+
+        sign = block.create_var(
+            unique_name.generate(f"{param.name}.sign"),
+            dtype=param.dtype, shape=param.shape)
+        decay = block.create_var(
+            unique_name.generate(f"{param.name}.l1decay"),
+            dtype=param.dtype, shape=param.shape)
+        block.append_op("sign", {"X": [param]}, {"Out": [sign]})
+        block.append_op("scale", {"X": [sign]}, {"Out": [decay]}, {"scale": self._coeff})
+        block.append_op("sum", {"X": [grad, decay]}, {"Out": [grad]})
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay
+
+
+def append_regularization_ops(block, params_grads, global_regularization=None):
+    """<- regularizer.py append_regularization_ops: per-param regularizer wins
+    over the optimizer-level one."""
+    for param, grad in params_grads:
+        attr = getattr(param, "_param_attr", None)
+        reg = (attr.regularizer if attr is not None and attr.regularizer is not None
+               else global_regularization)
+        if reg is None:
+            continue
+        reg.append_regularization_op(block, param, grad)
+    return params_grads

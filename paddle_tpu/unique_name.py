@@ -1,0 +1,32 @@
+"""Unique name generator (<- python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class NameGenerator:
+    def __init__(self):
+        self.ids = defaultdict(int)
+
+    def generate(self, prefix: str) -> str:
+        self.ids[prefix] += 1
+        return f"{prefix}_{self.ids[prefix] - 1}"
+
+
+_generator = NameGenerator()
+
+
+def generate(prefix: str) -> str:
+    return _generator.generate(prefix)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _generator
+    prev = _generator
+    _generator = new_generator or NameGenerator()
+    try:
+        yield
+    finally:
+        _generator = prev

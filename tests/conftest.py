@@ -1,0 +1,35 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's strategy of testing multi-device paths without real
+hardware (SURVEY.md §4): sharding/collective tests run on
+xla_force_host_platform_device_count=8 CPU devices.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The axon TPU plugin registers itself as the default backend regardless of
+# JAX_PLATFORMS; tests must be deterministic/exact, so force CPU as default.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(1234)
+
+
+@pytest.fixture()
+def fresh_programs():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        yield main, startup

@@ -1,0 +1,160 @@
+"""OpTest harness (<- python/paddle/fluid/tests/unittests/op_test.py:113).
+
+Subclasses declare ``self.op_type / self.inputs / self.outputs / self.attrs``
+as numpy; the harness builds a one-op program, executes it through the real
+Executor (so the op runs inside a jitted XLA computation exactly as in
+training), checks outputs against the numpy reference, and checks analytic
+gradients (IR-level append_backward) against central-difference numeric
+gradients (<- get_numeric_gradient, op_test.py:40).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import append_backward, grad_var_name
+from paddle_tpu.core.registry import get_op_def
+
+
+def _as_list(v):
+    return v if isinstance(v, list) else [v]
+
+
+class OpTest:
+    op_type: str = ""
+
+    def setup(self):
+        raise NotImplementedError
+
+    # -- program construction --
+    def _build(self):
+        self.main = fluid.Program()
+        self.startup = fluid.Program()
+        block = self.main.global_block()
+        feed = {}
+        inputs_desc = {}
+        for slot, value in self.inputs.items():
+            entries = value if isinstance(value, list) else [(slot, value)]
+            names = []
+            for name, arr in entries:
+                arr = np.asarray(arr)
+                block.create_var(name, dtype=arr.dtype.name, shape=arr.shape,
+                                 is_data=True, stop_gradient=True)
+                feed[name] = arr
+                names.append(name)
+            inputs_desc[slot] = names
+        outputs_desc = {}
+        self._expected = {}
+        for slot, value in self.outputs.items():
+            entries = value if isinstance(value, list) else [(slot, value)]
+            names = []
+            for name, arr in entries:
+                block.create_var(name)
+                names.append(name)
+                self._expected[name] = np.asarray(arr)
+            outputs_desc[slot] = names
+        block.append_op(self.op_type, inputs_desc, outputs_desc,
+                        getattr(self, "attrs", {}))
+        return feed
+
+    # -- checks --
+    def check_output(self, atol=1e-5, rtol=1e-4):
+        self.setup()
+        feed = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        fetch_names = list(self._expected)
+        res = exe.run(self.main, feed=feed, fetch_list=fetch_names, scope=scope,
+                      seed=17)
+        for name, got in zip(fetch_names, res):
+            want = self._expected[name]
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64) if got.dtype.kind == "f" else got,
+                np.asarray(want, dtype=np.float64) if want.dtype.kind == "f" else want,
+                atol=atol, rtol=rtol,
+                err_msg=f"output {name} of op {self.op_type} mismatches reference",
+            )
+
+    def _append_weighted_loss(self, block, output_name, w):
+        out_var = block.var(output_name)
+        dtype = out_var.dtype.np_dtype.name if out_var.dtype else "float32"
+        block.create_var("__w__")
+        block.append_op("assign_value", {}, {"Out": ["__w__"]},
+                        {"values": w.astype(dtype), "dtype": dtype})
+        block.create_var("__wo__")
+        block.append_op("elementwise_mul", {"X": [output_name], "Y": ["__w__"]},
+                        {"Out": ["__wo__"]})
+        block.create_var("__loss__")
+        block.append_op("mean", {"X": ["__wo__"]}, {"Out": ["__loss__"]})
+
+    def check_grad(
+        self,
+        inputs_to_check: Sequence[str],
+        output_name: str,
+        max_relative_error: float = 5e-3,
+        no_grad_set=None,
+        numeric_delta: float = 5e-3,  # <- op_test.py:40 delta=0.005
+    ):
+        """Numeric (central difference) vs analytic (append_backward) grads of
+        mean(output) w.r.t. each input."""
+        self.setup()
+        feed = self._build()
+        block = self.main.global_block()
+        # loss = mean(output * fixed_random_weights): random weights avoid
+        # degenerate zero-grad losses (e.g. mean(softmax) is constant)
+        w = np.random.RandomState(42).uniform(0.5, 1.5, self._expected[output_name].shape)
+        self._append_weighted_loss(block, output_name, w)
+        loss = block.var("__loss__")
+        loss.shape, loss.dtype = (), block.var(output_name).dtype
+        for n in inputs_to_check:
+            block.vars[n].stop_gradient = False
+            block.vars[n].is_data = False
+        append_backward(loss, no_grad_set=no_grad_set)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        grad_names = [grad_var_name(n) for n in inputs_to_check]
+        analytic = exe.run(self.main, feed=feed, fetch_list=grad_names,
+                           scope=scope, seed=17)
+
+        # numeric: pristine forward program (the analytic one was mutated by
+        # append_backward), fed the SAME saved input arrays, perturbed per
+        # element
+        saved_feed = {k: np.array(v) for k, v in feed.items()}
+        self.setup()
+        self._build()
+        b2 = self.main.global_block()
+        self._append_weighted_loss(b2, output_name, w)
+        numeric_prog = self.main
+        e2 = fluid.Executor(fluid.CPUPlace())
+        numeric_scope = fluid.Scope()
+
+        def run_loss(full_feed):
+            return float(
+                e2.run(numeric_prog, feed=full_feed, fetch_list=["__loss__"],
+                       scope=numeric_scope, seed=17)[0]
+            )
+
+        for n, got in zip(inputs_to_check, analytic):
+            base = np.asarray(saved_feed[n], dtype=np.float64)
+            num = np.zeros_like(base)
+            flat = base.reshape(-1)
+            numf = num.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + numeric_delta
+                up = run_loss({**saved_feed, n: base.astype(saved_feed[n].dtype)})
+                flat[i] = orig - numeric_delta
+                down = run_loss({**saved_feed, n: base.astype(saved_feed[n].dtype)})
+                flat[i] = orig
+                numf[i] = (up - down) / (2 * numeric_delta)
+            got = np.asarray(got, dtype=np.float64)
+            denom = np.maximum(np.maximum(np.abs(num), np.abs(got)), 1e-3)
+            rel = np.abs(num - got) / denom
+            assert rel.max() <= max_relative_error, (
+                f"gradient of {self.op_type} wrt {n}: max rel error "
+                f"{rel.max():.2e} > {max_relative_error:.2e}\n"
+                f"numeric:\n{num}\nanalytic:\n{got}"
+            )

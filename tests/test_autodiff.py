@@ -1,0 +1,91 @@
+"""IR-level append_backward vs jax.grad (the numerical oracle, SURVEY.md §7.4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import append_backward
+
+
+def test_mlp_grads_match_jax_grad():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=6, act="tanh")
+        pred = fluid.layers.fc(h, size=3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        pgs = append_backward(loss)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    xv = np.random.randn(4, 8).astype("float32")
+    lv = np.random.randint(0, 3, (4, 1)).astype("int64")
+    names = [p.name for p, _ in pgs]
+    grads = exe.run(main, feed={"x": xv, "label": lv},
+                    fetch_list=[g.name for _, g in pgs], scope=scope)
+
+    params = {n: np.asarray(scope.get(n)) for n in names}
+
+    def f(params):
+        w0, b0 = params[names[0]], params[names[1]]
+        w1, b1 = params[names[2]], params[names[3]]
+        h = jnp.tanh(xv @ w0 + b0)
+        logits = h @ w1 + b1
+        p = jax.nn.softmax(logits)
+        onehot = jax.nn.one_hot(lv[:, 0], 3)
+        return jnp.mean(-jnp.sum(onehot * jnp.log(p + 1e-12), axis=-1, keepdims=True))
+
+    # names sorted: fc_0.w_0 (w0), fc_0.w_1 (b0), fc_1.w_0 (w1), fc_1.w_1 (b1)
+    jg = jax.grad(f)(params)
+    for n, g in zip(names, grads):
+        np.testing.assert_allclose(g, jg[n], rtol=1e-4, atol=1e-5)
+
+
+def test_grad_accumulation_var_used_twice():
+    """A var consumed by two ops must get a summed gradient (<- backward.py
+    _addup_repetitive_outputs_)."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        blk = main.global_block()
+        x = blk.create_var("x", dtype="float32", shape=(3,), persistable=True)
+        blk.create_var("a")
+        blk.create_var("b")
+        blk.create_var("c")
+        blk.append_op("square", {"X": ["x"]}, {"Out": ["a"]})
+        blk.append_op("exp", {"X": ["x"]}, {"Out": ["b"]})
+        blk.append_op("elementwise_add", {"X": ["a"], "Y": ["b"]}, {"Out": ["c"]})
+        blk.create_var("loss")
+        blk.append_op("reduce_sum", {"X": ["c"]}, {"Out": ["loss"]}, {"reduce_all": True})
+        loss = blk.var("loss")
+        loss.dtype = fluid.DataType.FP32
+        loss.shape = ()
+        append_backward(loss)
+
+    scope = fluid.Scope()
+    xv = np.array([0.5, -1.0, 2.0], "float32")
+    scope.set("x", jnp.asarray(xv))
+    exe = fluid.Executor(fluid.CPUPlace())
+    (gx,) = exe.run(main, fetch_list=["x@GRAD"], scope=scope)
+    expected = 2 * xv + np.exp(xv)
+    np.testing.assert_allclose(gx, expected, rtol=1e-5)
+
+
+def test_stop_gradient_blocks_flow():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        blk = main.global_block()
+        blk.create_var("x", dtype="float32", shape=(3,), persistable=True)
+        w = blk.create_var("w", dtype="float32", shape=(3,), persistable=True)
+        w.stop_gradient = True
+        blk.create_var("y")
+        blk.append_op("elementwise_mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["y"]})
+        blk.create_var("loss")
+        blk.append_op("reduce_sum", {"X": ["y"]}, {"Out": ["loss"]}, {"reduce_all": True})
+        loss = blk.var("loss")
+        loss.dtype = fluid.DataType.FP32
+        loss.shape = ()
+        pgs = append_backward(loss)
+    names = [p.name for p, _ in pgs]
+    assert "x" in names and "w" not in names

@@ -1,0 +1,74 @@
+"""Executor behavior: feed/fetch, scope state, IR serialization, clone."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_feed_fetch_roundtrip():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0) if hasattr(fluid.layers, "scale") else None
+        blk = main.global_block()
+        blk.create_var("y2")
+        blk.append_op("scale", {"X": ["x"]}, {"Out": ["y2"]}, {"scale": 2.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), "float32")
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=["y2"])
+    np.testing.assert_allclose(out, xv * 2)
+
+
+def test_scope_state_persists_across_runs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        counter = fluid.layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                                 name="counter")
+        blk = main.global_block()
+        blk.append_op("increment", {"X": ["counter"]}, {"Out": ["counter"]}, {"step": 1.0})
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    for _ in range(3):
+        exe.run(main, scope=scope)
+    assert float(np.asarray(scope.get("counter"))[0]) == 3.0
+
+
+def test_program_serialization_roundtrip():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=2)
+    data = main.serialize_to_string()
+    restored = fluid.Program.parse_from_string(data)
+    assert [op.type for op in restored.global_block().ops] == [
+        op.type for op in main.global_block().ops
+    ]
+    assert set(restored.global_block().vars) == set(main.global_block().vars)
+
+
+def test_clone_for_test_sets_is_test():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        d = fluid.layers.dropout(x, dropout_prob=0.5)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attr("is_test") is True
+    # original untouched
+    assert not main.global_block().ops[-1].attr("is_test", False)
+
+
+def test_executor_jit_cache_reused():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        blk = main.global_block()
+        blk.create_var("x", dtype="float32", shape=(2,), is_data=True)
+        blk.create_var("y")
+        blk.append_op("scale", {"X": ["x"]}, {"Out": ["y"]}, {"scale": 3.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(main, feed={"x": np.ones(2, "float32")}, fetch_list=["y"])
+    assert len(exe._cache) == 1
+    exe.run(main, feed={"x": np.ones(2, "float32") * 2}, fetch_list=["y"])
+    assert len(exe._cache) == 1  # same signature -> cache hit
+    exe.run(main, feed={"x": np.ones(3, "float32")}, fetch_list=["y"])
+    assert len(exe._cache) == 2  # new shape -> new entry

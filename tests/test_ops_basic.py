@@ -1,0 +1,465 @@
+"""Per-op numpy-reference tests via the OpTest harness (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMulFlatten(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(12, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x.reshape(2, 12) @ y).reshape(2, 5)}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x = np.random.rand(5, 4).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x.T @ y}
+        self.attrs = {"transpose_X": True}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype("float32") + 0.5
+        y = np.random.rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=1e-2)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = np.random.rand(4, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup(self):
+        p = np.random.rand(5, 4).astype("float32") + 0.1
+        p /= p.sum(-1, keepdims=True)
+        label = np.random.randint(0, 4, (5, 1)).astype("int64")
+        self.inputs = {"X": p, "Label": label}
+        self.outputs = {"Y": -np.log(p[np.arange(5), label[:, 0]] + 1e-12)[:, None]}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Y", max_relative_error=1e-2)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup(self):
+        logits = np.random.rand(5, 4).astype("float32")
+        label = np.random.randint(0, 4, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label[:, 0]])[:, None]
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss", max_relative_error=1e-2)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup(self):
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.attrs = {"dim": [1]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    op_type = "reduce_mean"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean(), "float32")}
+        self.attrs = {"reduce_all": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        import jax.numpy as jnp
+        from jax import lax
+
+        ref = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": np.asarray(ref)}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConv2dGrad(OpTest):
+    op_type = "conv2d"
+
+    def setup(self):
+        x = np.random.rand(1, 2, 5, 5).astype("float32")
+        w = np.random.rand(2, 2, 3, 3).astype("float32")
+        import jax.numpy as jnp
+        from jax import lax
+
+        ref = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": np.asarray(ref)}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0]}
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=1e-2)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": ref}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        ref = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": ref}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def setup(self):
+        x = np.random.rand(4, 3, 2, 2).astype("float32")
+        scale = np.random.rand(3).astype("float32")
+        bias = np.random.rand(3).astype("float32")
+        mean = np.zeros(3, "float32")
+        var = np.ones(3, "float32")
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {
+            "X": x,
+            "Scale": [("Scale", scale)],
+            "Bias": [("Bias", bias)],
+            "Mean": [("Mean", mean)],
+            "Variance": [("Variance", var)],
+        }
+        self.outputs = {
+            "Y": y,
+            "SavedMean": [("SavedMean", bm)],
+            "SavedVariance": [("SavedVariance", bv)],
+        }
+        self.attrs = {"epsilon": 1e-5, "momentum": 0.9}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup(self):
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], "float32")
+        self.inputs = {"X": x}
+        self.outputs = {
+            "Out": np.array([[3.0, 2.0], [6.0, 5.0]], "float32"),
+            "Indices": [("Indices", np.array([[1, 2], [2, 0]], "int64"))],
+        }
+        self.attrs = {"k": 2}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup(self):
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 4).astype("float32")
+        self.inputs = {"X": [("x0", a), ("x1", b)]}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0", "x1"], "Out")
+
+
+class TestSplit(OpTest):
+    op_type = "split"
+
+    def setup(self):
+        x = np.random.rand(4, 6).astype("float32")
+        parts = np.split(x, [2, 5], axis=1)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": [(f"out{i}", p) for i, p in enumerate(parts)]}
+        self.attrs = {"sections": [2, 3, 1], "axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReshapeZeroMinusOne(OpTest):
+    op_type = "reshape"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 12)}
+        self.attrs = {"shape": [0, -1]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+        self.attrs = {"axis": [2, 0, 1]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setup(self):
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.array([[1], [3], [1]], "int64")
+        self.inputs = {"W": [("W", w)], "Ids": [("Ids", ids)]}
+        self.outputs = {"Out": w[ids[:, 0]]}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W"], "Out")
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def setup(self):
+        x = np.random.randn(4, 3).astype("float32")
+        label = np.random.rand(4, 3).astype("float32")
+        sig = 1 / (1 + np.exp(-x))
+        ref = -label * np.log(sig) - (1 - label) * np.log(1 - sig)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": ref}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestDropoutInference(OpTest):
+    op_type = "dropout"
+
+    def setup(self):
+        x = np.random.rand(4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * 0.7}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setup(self):
+        x = np.random.rand(3, 6).astype("float32")
+        scale = np.random.rand(6).astype("float32")
+        bias = np.random.rand(6).astype("float32")
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": [("Scale", scale)], "Bias": [("Bias", bias)]}
+        self.outputs = {"Y": y}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=2e-2)
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setup(self):
+        x = np.random.rand(5, 3).astype("float32")
+        idx = np.array([1, 4, 1], "int64")
+        self.inputs = {"X": x, "Index": [("Index", idx)]}
+        self.outputs = {"Out": x[idx]}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+ACTIVATIONS = [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("square", lambda x: x * x),
+    ("softplus", lambda x: np.log1p(np.exp(x))),
+    ("leaky_relu", lambda x: np.where(x >= 0, x, 0.02 * x)),
+]
+
+
+@pytest.mark.parametrize("name,fn", ACTIVATIONS, ids=[a[0] for a in ACTIVATIONS])
+def test_activation(name, fn):
+    class T(OpTest):
+        op_type = name
+
+        def setup(self):
+            x = (np.random.rand(3, 4).astype("float32") - 0.5) * 2
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x.astype("float64")).astype("float32")}
+            self.attrs = {}
+
+    t = T()
+    t.check_output()
+    if name not in ("square",):  # square grad fine too but keep list small
+        t2 = T()
+        t2.check_grad(["X"], "Out", max_relative_error=2e-2)

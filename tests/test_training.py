@@ -1,0 +1,58 @@
+"""End-to-end training convergence (book-test style, SURVEY.md §4)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _train_mlp(optimizer, steps=60, lr_check=True):
+    np.random.seed(7)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[32], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, size=24, act="relu")
+        pred = fluid.layers.fc(h, size=5, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        optimizer.minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    X = np.random.randn(256, 32).astype("float32")
+    Y = np.argmax(X[:, :5], axis=1).astype("int64")[:, None]
+    losses = []
+    for i in range(steps):
+        idx = np.random.randint(0, 256, 64)
+        (lv,) = exe.run(main, feed={"img": X[idx], "label": Y[idx]},
+                        fetch_list=[loss], scope=scope)
+        losses.append(float(lv))
+    return losses
+
+
+def test_sgd_converges():
+    losses = _train_mlp(fluid.optimizer.SGD(learning_rate=0.5))
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_adam_converges():
+    losses = _train_mlp(fluid.optimizer.Adam(learning_rate=0.01))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_momentum_converges():
+    losses = _train_mlp(fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9))
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_regularizer_applied():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(y)
+        opt = fluid.optimizer.SGD(
+            learning_rate=0.1, regularization=fluid.regularizer.L2Decay(0.01))
+        opt.minimize(loss, startup)
+    types = [op.type for op in main.global_block().ops]
+    # L2Decay adds a scale op + sum op per parameter before the sgd updates
+    assert types.count("sgd") == 2
+    assert "scale" in types
