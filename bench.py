@@ -1,0 +1,87 @@
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Workload mirrors benchmark/fluid/fluid_benchmark.py --model resnet (synthetic
+data, examples/sec metric, fluid_benchmark.py:295 print_train_time).
+vs_baseline compares against the reference's published ResNet-50 training
+throughput (81.69 img/s, 2×Xeon 6148 MKL-DNN, BASELINE.md — the only
+published reference number for this model; the reference has no TPU/GPU
+ResNet-50 numbers).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 81.69  # BASELINE.md ResNet-50 train bs64
+BATCH = 32
+IMAGE = 224
+CLASSES = 1000
+WARMUP = 5
+ITERS = 50
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet50
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data("img", shape=[3, IMAGE, IMAGE], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = resnet50(img, label, class_dim=CLASSES)
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            avg_cost, startup)
+
+    place = fluid.default_place()
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=7)
+
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    # device-resident synthetic data (the input pipeline is benchmarked
+    # separately; fluid_benchmark's --use_fake_data does the same)
+    feed = {
+        "img": jax.device_put(
+            rng.randn(BATCH, 3, IMAGE, IMAGE).astype("float32"), dev),
+        "label": jax.device_put(
+            rng.randint(0, CLASSES, (BATCH, 1)).astype("int32"), dev),
+    }
+
+    # Slope-based timing: the axon tunnel's block_until_ready returns before
+    # device completion, and a per-step fetch pays ~80 ms RPC latency. Timing
+    # N1 vs N2 pipelined steps each closed by one scalar fetch isolates the
+    # true per-step device time.
+    def run_n(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            exe.run(main_prog, feed=feed, fetch_list=[], scope=scope)
+        exe.run(main_prog, feed=feed, fetch_list=[avg_cost], scope=scope)
+        return time.perf_counter() - t0
+
+    for _ in range(WARMUP):
+        exe.run(main_prog, feed=feed, fetch_list=[], scope=scope)
+    exe.run(main_prog, feed=feed, fetch_list=[avg_cost], scope=scope)
+    n1, n2 = ITERS // 5, ITERS
+    t1 = run_n(n1)
+    t2 = run_n(n2)
+    step_time = (t2 - t1) / (n2 - n1)
+    img_s = BATCH / step_time
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

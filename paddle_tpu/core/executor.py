@@ -151,6 +151,39 @@ def _collect_block_io(
     return reads, writes
 
 
+def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names):
+    """Trace a block into a pure function
+    ``step(feed, readonly, donated, key) -> (fetches, new_state)``.
+
+    Shared by Executor (single device) and parallel.ParallelExecutor (jitted
+    with mesh shardings — GSPMD inserts the collectives the reference built by
+    hand in details/multi_devices_graph_builder.cc).
+    Returns (step, readonly_names, donated_names, state_out_names).
+    """
+    state_in_names, state_out_names = _collect_block_io(program, block_idx, feed_names)
+    donated_names = [n for n in state_in_names if n in set(state_out_names)]
+    readonly_names = [n for n in state_in_names if n not in set(donated_names)]
+    builder = BlockProgramBuilder(program)
+
+    def step(feed_vals, readonly, donated, key):
+        env: Dict[str, Any] = {}
+        env.update(readonly)
+        env.update(donated)
+        env.update(feed_vals)
+        ctx = ExecContext(key=key)
+        ctx.block_runner = builder
+        builder.run_block(block_idx, env, ctx)
+        fetches = []
+        for n in fetch_names:
+            if n not in env:
+                raise KeyError(f"fetch var {n!r} was not produced by the program")
+            fetches.append(env[n])
+        new_state = {n: env[n] for n in state_out_names if n in env}
+        return fetches, new_state
+
+    return step, readonly_names, donated_names, state_out_names
+
+
 class Executor:
     """Drop-in analogue of fluid.Executor (executor.py:222) on XLA."""
 
@@ -230,30 +263,12 @@ class Executor:
 
     # -- compilation --
     def _compile(self, program: Program, block_idx: int, feed_names, fetch_names, sig):
-        state_in_names, state_out_names = _collect_block_io(program, block_idx, feed_names)
+        step, readonly_names, donated_names, state_out_names = build_step_fn(
+            program, block_idx, feed_names, fetch_names
+        )
         # donate only buffers the block overwrites (params under an optimizer):
         # their old values die with the update, so XLA can update in place in
         # HBM. Read-only state must not be donated — the scope keeps it live.
-        donated_names = [n for n in state_in_names if n in set(state_out_names)]
-        readonly_names = [n for n in state_in_names if n not in set(donated_names)]
-        builder = BlockProgramBuilder(program)
-
-        def step(feed_vals, readonly, donated, key):
-            env: Dict[str, Any] = {}
-            env.update(readonly)
-            env.update(donated)
-            env.update(feed_vals)
-            ctx = ExecContext(key=key)
-            ctx.block_runner = builder
-            builder.run_block(block_idx, env, ctx)
-            fetches = []
-            for n in fetch_names:
-                if n not in env:
-                    raise KeyError(f"fetch var {n!r} was not produced by the program")
-                fetches.append(env[n])
-            new_state = {n: env[n] for n in state_out_names if n in env}
-            return fetches, new_state
-
         jitted = jax.jit(step, donate_argnums=(2,))
         return jitted, readonly_names, donated_names, state_out_names
 

@@ -1,0 +1,9 @@
+"""Model zoo (<- benchmark/fluid/models/* and python/paddle/fluid/tests/book/).
+
+Each builder appends layers to the default main program and returns the
+relevant output Variables. They exist both as user examples and as the
+benchmark workloads named in BASELINE.json.
+"""
+from .lenet import lenet5  # noqa: F401
+from .resnet import resnet_cifar10, resnet50  # noqa: F401
+from .vgg import vgg16  # noqa: F401

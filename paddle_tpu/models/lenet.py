@@ -1,0 +1,18 @@
+"""LeNet-5 for MNIST (<- book/02.recognize_digits convolutional net,
+python/paddle/fluid/tests/book/test_recognize_digits.py conv path)."""
+from __future__ import annotations
+
+from .. import layers
+
+
+def lenet5(img, label):
+    """img: [N, 1, 28, 28], label: [N, 1] int. Returns (prediction, avg_loss, acc)."""
+    conv1 = layers.conv2d(img, num_filters=20, filter_size=5, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=50, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    prediction = layers.fc(pool2, size=10, act="softmax")
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc

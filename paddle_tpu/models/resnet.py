@@ -1,0 +1,86 @@
+"""ResNet (<- benchmark/fluid/models/resnet.py).
+
+ResNet-50 bottleneck variant for ImageNet-shape inputs (the BASELINE.json
+flagship workload) and the small basic-block variant for cifar10.
+NCHW layout; batch_norm after every conv, no bias on convs (folded into BN),
+matching the reference builder's structure.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                  is_test=False):
+    conv = layers.conv2d(
+        input,
+        num_filters=ch_out,
+        filter_size=filter_size,
+        stride=stride,
+        padding=padding,
+        act=None,
+        bias_attr=False,
+    )
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None, is_test=is_test)
+    return input
+
+
+def basicblock(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return layers.elementwise_add(short, conv2, act="relu")
+
+
+def bottleneck(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out * 4, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None, is_test=is_test)
+    return layers.elementwise_add(short, conv3, act="relu")
+
+
+def layer_warp(block_func, input, ch_out, count, stride, is_test=False):
+    res_out = block_func(input, ch_out, stride, is_test)
+    for _ in range(1, count):
+        res_out = block_func(res_out, ch_out, 1, is_test)
+    return res_out
+
+
+def resnet50(img, label, class_dim=1000, is_test=False):
+    """ResNet-50 [3,4,6,3] bottleneck (<- benchmark/fluid/models/resnet.py
+    resnet_imagenet). img: [N, 3, 224, 224]."""
+    conv = conv_bn_layer(img, 64, 7, 2, 3, is_test=is_test)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
+    res1 = layer_warp(bottleneck, pool, 64, 3, 1, is_test)
+    res2 = layer_warp(bottleneck, res1, 128, 4, 2, is_test)
+    res3 = layer_warp(bottleneck, res2, 256, 6, 2, is_test)
+    res4 = layer_warp(bottleneck, res3, 512, 3, 2, is_test)
+    pool2 = layers.pool2d(res4, pool_size=7, pool_type="avg", global_pooling=True)
+    out = layers.fc(pool2, size=class_dim, act="softmax")
+    cost = layers.cross_entropy(out, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(out, label)
+    return out, avg_cost, acc
+
+
+def resnet_cifar10(img, label, depth=32, class_dim=10, is_test=False):
+    """<- benchmark/fluid/models/resnet.py resnet_cifar10 (6n+2 basic blocks)."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(img, 16, 3, 1, 1, is_test=is_test)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1, is_test)
+    res2 = layer_warp(basicblock, res1, 32, n, 2, is_test)
+    res3 = layer_warp(basicblock, res2, 64, n, 2, is_test)
+    pool = layers.pool2d(res3, pool_size=8, pool_type="avg", global_pooling=True)
+    out = layers.fc(pool, size=class_dim, act="softmax")
+    cost = layers.cross_entropy(out, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(out, label)
+    return out, avg_cost, acc

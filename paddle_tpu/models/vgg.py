@@ -1,0 +1,33 @@
+"""VGG-16 (<- benchmark/fluid/models/vgg.py)."""
+from __future__ import annotations
+
+from .. import layers
+
+
+def conv_block(input, num_filter, groups, dropouts, is_test=False):
+    conv = input
+    for i in range(groups):
+        conv = layers.conv2d(conv, num_filters=num_filter, filter_size=3,
+                             stride=1, padding=1, act="relu")
+        if dropouts[i] > 0:
+            conv = layers.dropout(conv, dropout_prob=dropouts[i], is_test=is_test)
+    return layers.pool2d(conv, pool_size=2, pool_type="max", pool_stride=2)
+
+
+def vgg16(img, label, class_dim=1000, is_test=False):
+    """img: [N, 3, H, W] (224 for ImageNet, 32 for cifar)."""
+    conv1 = conv_block(img, 64, 2, [0.3, 0.0], is_test)
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0.0], is_test)
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0.0], is_test)
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0.0], is_test)
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0.0], is_test)
+    drop = layers.dropout(conv5, dropout_prob=0.5, is_test=is_test)
+    fc1 = layers.fc(drop, size=512, act=None)
+    bn = layers.batch_norm(fc1, act="relu", is_test=is_test, data_layout="NCHW")
+    drop2 = layers.dropout(bn, dropout_prob=0.5, is_test=is_test)
+    fc2 = layers.fc(drop2, size=512, act=None)
+    prediction = layers.fc(fc2, size=class_dim, act="softmax")
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc

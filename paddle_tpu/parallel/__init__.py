@@ -1,0 +1,2 @@
+from .mesh import make_mesh, sharding_for  # noqa: F401
+from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy  # noqa: F401
