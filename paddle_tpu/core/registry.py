@@ -175,7 +175,9 @@ def infer_and_create_outputs(op: Operator, block: Block) -> None:
             if n == "":
                 structs.append(None)
                 continue
-            v = block.var(n)
+            v = block.find_var_recursive(n)
+            if v is None:
+                return  # referenced-by-name var not declared in this program
             if v.shape is None or v.dtype is None:
                 return  # cannot infer statically; executor will still work
             shape = list(v.shape)

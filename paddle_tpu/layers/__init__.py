@@ -1,5 +1,20 @@
 """fluid.layers equivalent: IR-building layer functions."""
 from .io import data  # noqa: F401
+from .sequence import (  # noqa: F401
+    attention_decoder,
+    dynamic_gru,
+    dynamic_lstm,
+    lstm_unit,
+    sequence_conv,
+    sequence_expand,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_mask,
+    sequence_pool,
+    sequence_reshape,
+    sequence_reverse,
+    sequence_softmax,
+)
 from .metric_op import accuracy, auc  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

@@ -1,0 +1,251 @@
+"""Sequence + recurrent layers over the dense (values, lengths) representation.
+
+<- python/paddle/fluid/layers/nn.py sequence_* layers and dynamic_lstm/
+dynamic_gru. API deviation from the reference, by design (SURVEY.md §5.7):
+where fluid infers sequence structure from the LoD attached to the tensor,
+these layers take an explicit ``length`` Variable (int32 [batch]). Data
+arrives dense-padded [batch, max_len, ...] (see reader.seq for the
+bucketing/padding pipeline).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.types import DataType
+from ..layer_helper import LayerHelper
+
+
+def sequence_mask(length, maxlen: int, dtype="float32", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("sequence_mask", {"X": [length]}, {"Y": [out]},
+                     {"maxlen": maxlen, "out_dtype": DataType.from_any(dtype)})
+    return out
+
+
+def sequence_pool(input, pool_type: str, length=None, name=None):
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    max_index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "sequence_pool",
+        {"X": [input], "Length": [length] if length is not None else []},
+        {"Out": [out], "MaxIndex": [max_index]},
+        {"pooltype": pool_type.upper()},
+    )
+    return out
+
+
+def sequence_first_step(input, length=None, name=None):
+    return sequence_pool(input, "FIRST", length, name)
+
+
+def sequence_last_step(input, length=None, name=None):
+    return sequence_pool(input, "LAST", length, name)
+
+
+def sequence_softmax(input, length, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_softmax", {"X": [input], "Length": [length]},
+                     {"Out": [out]})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, length=None,
+                  param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, [filter_size * d, num_filters],
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "sequence_conv",
+        {"X": [input], "Filter": [w],
+         "Length": [length] if length is not None else []},
+        {"Out": [out]},
+        {"contextLength": filter_size},
+    )
+    out = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr)
+    return helper.append_activation(out)
+
+
+def sequence_expand(x, y, length=None, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sequence_expand",
+        {"X": [x], "Y": [y], "Length": [length] if length is not None else []},
+        {"Out": [out]})
+    return out
+
+
+def sequence_reverse(x, length, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_reverse", {"X": [x], "Length": [length]}, {"Y": [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim, length, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op("sequence_reshape", {"X": [input], "Length": [length]},
+                     {"Out": [out], "OutLength": [out_len]}, {"new_dim": new_dim})
+    return out, out_len
+
+
+def dynamic_lstm(
+    input,
+    size: int,
+    length=None,
+    h_0=None,
+    c_0=None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes: bool = False,
+    is_reverse: bool = False,
+    gate_activation: str = "sigmoid",
+    cell_activation: str = "tanh",
+    candidate_activation: str = "tanh",
+    name=None,
+):
+    """<- layers/nn.py dynamic_lstm / lstm_op.cc. ``input`` is the
+    pre-projected gate tensor [N, T, 4*size] (project with fc, as in the
+    reference); returns (hidden [N, T, size], cell [N, T, size])."""
+    helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    assert size * 4 == input.shape[-1], "dynamic_lstm input must be [N,T,4*size]"
+    w = helper.create_parameter(param_attr, [size, 4 * size], input.dtype)
+    bias_size = 4 * size + (3 * size if use_peepholes else 0)
+    b = helper.create_parameter(bias_attr, [bias_size], input.dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "lstm",
+        {
+            "Input": [input],
+            "H0": [h_0] if h_0 is not None else [],
+            "C0": [c_0] if c_0 is not None else [],
+            "Weight": [w],
+            "Bias": [b],
+            "Length": [length] if length is not None else [],
+        },
+        {"Hidden": [hidden], "Cell": [cell], "LastH": [last_h], "LastC": [last_c]},
+        {
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden, cell
+
+
+def dynamic_gru(
+    input,
+    size: int,
+    length=None,
+    h_0=None,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse: bool = False,
+    gate_activation: str = "sigmoid",
+    candidate_activation: str = "tanh",
+    name=None,
+):
+    """<- layers/nn.py dynamic_gru / gru_op.cc. input: [N, T, 3*size]."""
+    helper = LayerHelper("dynamic_gru", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    assert size * 3 == input.shape[-1], "dynamic_gru input must be [N,T,3*size]"
+    w = helper.create_parameter(param_attr, [size, 3 * size], input.dtype)
+    b = helper.create_parameter(bias_attr, [3 * size], input.dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "gru",
+        {
+            "Input": [input],
+            "H0": [h_0] if h_0 is not None else [],
+            "Weight": [w],
+            "Bias": [b],
+            "Length": [length] if length is not None else [],
+        },
+        {"Hidden": [hidden], "LastH": [last_h]},
+        {
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return hidden
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single explicit step (<- layers/nn.py lstm_unit): projects
+    concat(x, h) to gates then applies lstm_unit op."""
+    helper = LayerHelper("lstm_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = cell_t_prev.shape[-1]
+    from . import nn as _nn
+
+    concat_in = _nn.concat([x_t, hidden_t_prev], axis=1)
+    gates = _nn.fc(concat_in, size=4 * size, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op("lstm_unit", {"X": [gates], "C_prev": [cell_t_prev]},
+                     {"C": [c], "H": [h]}, {"forget_bias": forget_bias})
+    return h, c
+
+
+def attention_decoder(
+    trg_embedding,
+    encoder_out,
+    encoder_length,
+    init_h,
+    init_c,
+    size: int,
+    trg_length=None,
+    param_attr=None,
+    name=None,
+):
+    """Teacher-forced attention LSTM decoder (fused; see ops/attention.py).
+    Returns (hidden [N, Td, size], context [N, Td, H_enc])."""
+    helper = LayerHelper("attention_decoder", name=name)
+    e = trg_embedding.shape[-1]
+    h_enc = encoder_out.shape[-1]
+    if isinstance(param_attr, (list, tuple)):
+        attn_attr, wx_attr, wh_attr, b_attr = param_attr
+    else:
+        attn_attr = wx_attr = wh_attr = param_attr
+        b_attr = None
+    wa = helper.create_parameter(attn_attr, [size, h_enc], trg_embedding.dtype)
+    wx = helper.create_parameter(wx_attr, [e + h_enc, 4 * size], trg_embedding.dtype)
+    wh = helper.create_parameter(wh_attr, [size, 4 * size], trg_embedding.dtype)
+    b = helper.create_parameter(b_attr, [4 * size], trg_embedding.dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(trg_embedding.dtype)
+    context = helper.create_variable_for_type_inference(trg_embedding.dtype)
+    helper.append_op(
+        "attention_lstm_decoder",
+        {
+            "TrgEmb": [trg_embedding],
+            "EncOut": [encoder_out],
+            "EncLength": [encoder_length],
+            "InitH": [init_h],
+            "InitC": [init_c],
+            "AttnW": [wa],
+            "InputW": [wx],
+            "HiddenW": [wh],
+            "Bias": [b],
+            "TrgLength": [trg_length] if trg_length is not None else [],
+        },
+        {"Hidden": [hidden], "Context": [context]},
+    )
+    return hidden, context, (wa, wx, wh, b)

@@ -1,0 +1,121 @@
+"""Seq2seq NMT with attention + beam-search inference.
+
+<- book/08.machine_translation (python/paddle/fluid/tests/book/
+test_machine_translation.py) and benchmark/fluid/models/machine_translation.py.
+Encoder: embedding -> fc(4H) -> dynamic LSTM. Decoder: fused attention LSTM
+(ops/attention.py) with teacher forcing for training and fixed-capacity
+beam search (attention_lstm_beam_decode op) for inference. Training and
+decode graphs share parameters by explicit ParamAttr names, the same
+mechanism the reference book test uses.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..layers import sequence as seq_layers
+from ..param_attr import ParamAttr
+
+
+class Seq2SeqAttention:
+    def __init__(self, src_vocab, trg_vocab, embed_dim=64, hidden=128,
+                 name="s2s"):
+        self.src_vocab = src_vocab
+        self.trg_vocab = trg_vocab
+        self.embed_dim = embed_dim
+        self.hidden = hidden
+        n = name
+        self.p = {
+            "src_emb": f"{n}.src_emb.w",
+            "trg_emb": f"{n}.trg_emb.w",
+            "src_proj": f"{n}.src_proj.w",
+            "enc_w": f"{n}.enc.w",
+            "enc_b": f"{n}.enc.b",
+            "attn_w": f"{n}.attn.w",
+            "dec_wx": f"{n}.dec.wx",
+            "dec_wh": f"{n}.dec.wh",
+            "dec_b": f"{n}.dec.b",
+            "out_w": f"{n}.out.w",
+            "out_b": f"{n}.out.b",
+        }
+
+    def _encode(self, src_ids, src_length):
+        src_emb = layers.embedding(src_ids, size=[self.src_vocab, self.embed_dim],
+                                   param_attr=ParamAttr(self.p["src_emb"]))
+        gate_in = layers.fc(src_emb, size=4 * self.hidden, num_flatten_dims=2,
+                            bias_attr=False, param_attr=ParamAttr(self.p["src_proj"]))
+        enc_out, enc_cell = seq_layers.dynamic_lstm(
+            gate_in, self.hidden, length=src_length,
+            param_attr=ParamAttr(self.p["enc_w"]),
+            bias_attr=ParamAttr(self.p["enc_b"]))
+        enc_last = seq_layers.sequence_last_step(enc_out, src_length)
+        enc_last_cell = seq_layers.sequence_last_step(enc_cell, src_length)
+        return enc_out, enc_last, enc_last_cell
+
+    def build_train(self, src_ids, src_length, trg_ids, trg_length, trg_next_ids):
+        """Returns (avg_loss, per_token_loss)."""
+        enc_out, h0, c0 = self._encode(src_ids, src_length)
+        trg_emb = layers.embedding(trg_ids, size=[self.trg_vocab, self.embed_dim],
+                                   param_attr=ParamAttr(self.p["trg_emb"]))
+        dec_hidden, _, _ = seq_layers.attention_decoder(
+            trg_emb, enc_out, src_length, h0, c0, self.hidden,
+            trg_length=trg_length,
+            param_attr=[ParamAttr(self.p["attn_w"]), ParamAttr(self.p["dec_wx"]),
+                        ParamAttr(self.p["dec_wh"]), ParamAttr(self.p["dec_b"])],
+        )
+        logits = layers.fc(dec_hidden, size=self.trg_vocab, num_flatten_dims=2,
+                           param_attr=ParamAttr(self.p["out_w"]),
+                           bias_attr=ParamAttr(self.p["out_b"]))
+        loss = layers.softmax_with_cross_entropy(logits, trg_next_ids)
+        tmax = int(trg_ids.shape[1])
+        mask = seq_layers.sequence_mask(trg_length, maxlen=tmax)
+        mask3 = layers.reshape(mask, [0, tmax, 1])
+        masked = layers.elementwise_mul(loss, mask3)
+        total = layers.reduce_sum(masked)
+        denom = layers.reduce_sum(mask)
+        avg_loss = layers.elementwise_div(total, denom)
+        return avg_loss, masked
+
+    def build_decode(self, src_ids, src_length, beam_size=4, max_len=16,
+                     bos_id=0, eos_id=1):
+        """Beam-search inference graph. Returns (ids [N,K,L], scores [N,K])."""
+        from ..core.ir import default_main_program
+        from ..layer_helper import LayerHelper
+
+        enc_out, h0, c0 = self._encode(src_ids, src_length)
+        # declare the decoder parameters shared-by-name with the training
+        # program so this program is self-describing (shapes + persistable)
+        blk = default_main_program().global_block()
+        e, h, v = self.embed_dim, self.hidden, self.trg_vocab
+        for name, shape in [
+            (self.p["trg_emb"], (v, e)),
+            (self.p["attn_w"], (h, h)),
+            (self.p["dec_wx"], (e + h, 4 * h)),
+            (self.p["dec_wh"], (h, 4 * h)),
+            (self.p["dec_b"], (4 * h,)),
+            (self.p["out_w"], (h, v)),
+            (self.p["out_b"], (v,)),
+        ]:
+            if not blk.has_var(name):
+                blk.create_var(name, dtype="float32", shape=shape, persistable=True)
+        helper = LayerHelper("beam_decode")
+        ids = helper.create_variable_for_type_inference("int32")
+        scores = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "attention_lstm_beam_decode",
+            {
+                "EncOut": [enc_out],
+                "EncLength": [src_length],
+                "InitH": [h0],
+                "InitC": [c0],
+                "Embedding": [self.p["trg_emb"]],
+                "AttnW": [self.p["attn_w"]],
+                "InputW": [self.p["dec_wx"]],
+                "HiddenW": [self.p["dec_wh"]],
+                "Bias": [self.p["dec_b"]],
+                "OutW": [self.p["out_w"]],
+                "OutB": [self.p["out_b"]],
+            },
+            {"Ids": [ids], "Scores": [scores]},
+            {"beam_size": beam_size, "max_len": max_len,
+             "bos_id": bos_id, "eos_id": eos_id},
+        )
+        return ids, scores

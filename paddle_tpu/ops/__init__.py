@@ -13,3 +13,6 @@ from . import nn  # noqa: F401
 from . import tensor_manip  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import metrics_ops  # noqa: F401
+from . import sequence  # noqa: F401
+from . import rnn  # noqa: F401
+from . import attention  # noqa: F401

@@ -1,0 +1,162 @@
+"""Fused attention decoder + beam search.
+
+The reference builds seq2seq attention decoding out of DynamicRNN pieces
+(book/08.machine_translation: rnn_encoder_decoder with attention built from
+matmul/softmax/sequence_expand inside a DynamicRNN block) and decodes with the
+step-wise beam_search/beam_search_decode op pair over LoD arrays
+(operators/beam_search_op.cc, beam_search_decode_op.cc).
+
+Neither maps well to XLA (host-driven step loops, ragged beam state), so the
+TPU-native design fuses each into ONE op:
+
+* ``attention_lstm_decoder`` — teacher-forced training decoder: a single
+  lax.scan whose body does masked dot-product attention over the encoder
+  states + one LSTM cell step. XLA keeps the whole recurrence on-device.
+* ``attention_lstm_beam_decode`` — inference: lax.scan over decode steps
+  carrying a fixed-capacity beam (tokens [N, K, L], scores [N, K]), with
+  top-k expansion per step and EOS freezing — the fixed-shape re-design of
+  the reference's growing LoD beams.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _attend(h, enc, enc_mask, wa):
+    """Luong general attention: scores = h Wa enc^T, masked softmax, context."""
+    q = h @ wa  # [N, H]
+    scores = jnp.einsum("nh,nth->nt", q, enc)
+    scores = jnp.where(enc_mask, scores, jnp.finfo(scores.dtype).min)
+    alpha = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("nt,nth->nh", alpha, enc)
+    return ctx, alpha
+
+
+def _decoder_step(emb_t, h_prev, c_prev, enc, enc_mask, wa, wx, wh, b):
+    ctx, alpha = _attend(h_prev, enc, enc_mask, wa)
+    inp = jnp.concatenate([emb_t, ctx], axis=-1)
+    gates = inp @ wx + h_prev @ wh + b
+    i, f, c_bar, o = jnp.split(gates, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(c_bar)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new, ctx, alpha
+
+
+@register_op(
+    "attention_lstm_decoder",
+    inputs=("TrgEmb", "EncOut", "EncLength", "InitH", "InitC",
+            "AttnW", "InputW", "HiddenW", "Bias", "TrgLength"),
+    outputs=("Hidden", "Context"),
+    diff_inputs=("TrgEmb", "EncOut", "InitH", "InitC", "AttnW", "InputW",
+                 "HiddenW", "Bias"),
+)
+def attention_lstm_decoder(ctx_, ins, attrs):
+    emb = ins["TrgEmb"][0]  # [N, Td, E]
+    enc = ins["EncOut"][0]  # [N, Ts, H]
+    enc_len = ins["EncLength"][0]
+    h0, c0 = ins["InitH"][0], ins["InitC"][0]
+    wa, wx, wh = ins["AttnW"][0], ins["InputW"][0], ins["HiddenW"][0]
+    b = (ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None
+         else jnp.zeros((wx.shape[1],), emb.dtype))
+    n, td, _ = emb.shape
+    ts = enc.shape[1]
+    enc_mask = jnp.arange(ts)[None, :] < enc_len.reshape(-1, 1)
+    trg_len = (ins["TrgLength"][0] if ins.get("TrgLength") and ins["TrgLength"][0] is not None
+               else jnp.full((n,), td, jnp.int32))
+    step_mask = (jnp.arange(td)[:, None] < trg_len.reshape(1, -1)).astype(emb.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        emb_t, m = inp
+        h_new, c_new, ctx_t, _ = _decoder_step(
+            emb_t, h_prev, c_prev, enc, enc_mask, wa, wx, wh, b)
+        m = m[:, None]
+        h_out = m * h_new + (1 - m) * h_prev
+        c_out = m * c_new + (1 - m) * c_prev
+        return (h_out, c_out), (h_out * m, ctx_t * m)
+
+    (_, _), (hs, ctxs) = lax.scan(step, (h0, c0), (jnp.moveaxis(emb, 1, 0), step_mask))
+    return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "Context": [jnp.moveaxis(ctxs, 0, 1)]}
+
+
+@register_op(
+    "attention_lstm_beam_decode",
+    inputs=("EncOut", "EncLength", "InitH", "InitC", "Embedding",
+            "AttnW", "InputW", "HiddenW", "Bias", "OutW", "OutB"),
+    outputs=("Ids", "Scores"),
+    no_grad=True,
+)
+def attention_lstm_beam_decode(ctx_, ins, attrs):
+    """Beam search over the attention decoder.
+
+    attrs: beam_size K, max_len L, bos_id, eos_id.
+    Outputs Ids [N, K, L] (eos-padded) and Scores [N, K] (sum log-prob),
+    beams sorted best-first — the dense analogue of beam_search_decode's
+    LoD sentence tensor.
+    """
+    enc, enc_len = ins["EncOut"][0], ins["EncLength"][0]
+    h0, c0 = ins["InitH"][0], ins["InitC"][0]
+    table = ins["Embedding"][0]  # [V, E]
+    wa, wx, wh = ins["AttnW"][0], ins["InputW"][0], ins["HiddenW"][0]
+    b = (ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None
+         else jnp.zeros((wx.shape[1],), enc.dtype))
+    ow = ins["OutW"][0]  # [H, V]
+    ob = (ins["OutB"][0] if ins.get("OutB") and ins["OutB"][0] is not None
+          else jnp.zeros((ow.shape[1],), enc.dtype))
+    K = attrs.get("beam_size", 4)
+    L = attrs.get("max_len", 32)
+    bos = attrs.get("bos_id", 0)
+    eos = attrs.get("eos_id", 1)
+    n, ts, h = enc.shape[0], enc.shape[1], h0.shape[-1]
+    v = ow.shape[1]
+
+    enc_mask = jnp.arange(ts)[None, :] < enc_len.reshape(-1, 1)
+    # beam-expanded encoder state: [N*K, Ts, H]
+    encK = jnp.repeat(enc, K, axis=0)
+    enc_maskK = jnp.repeat(enc_mask, K, axis=0)
+
+    tokens0 = jnp.full((n, K), bos, jnp.int32)
+    # only beam 0 is live initially (others -inf) so step 1 picks distinct tokens
+    scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0,
+                        jnp.finfo(enc.dtype).min).astype(enc.dtype)
+    scores0 = jnp.broadcast_to(scores0, (n, K))
+    hK = jnp.repeat(h0, K, axis=0)
+    cK = jnp.repeat(c0, K, axis=0)
+    ids0 = jnp.full((n, K, L), eos, jnp.int32)
+    finished0 = jnp.zeros((n, K), bool)
+
+    def step(carry, t):
+        tokens, scores, hK, cK, ids, finished = carry
+        emb_t = table[tokens.reshape(-1)]  # [N*K, E]
+        h_new, c_new, _, _ = _decoder_step(emb_t, hK, cK, encK, enc_maskK,
+                                           wa, wx, wh, b)
+        logp = jax.nn.log_softmax(h_new @ ow + ob)  # [N*K, V]
+        logp = logp.reshape(n, K, v)
+        # finished beams only extend with EOS at zero cost
+        eos_only = jnp.full((v,), jnp.finfo(enc.dtype).min).at[eos].set(0.0)
+        logp = jnp.where(finished[..., None], eos_only[None, None, :], logp)
+        cand = scores[..., None] + logp  # [N, K, V]
+        flat = cand.reshape(n, K * v)
+        top_scores, top_idx = lax.top_k(flat, K)  # [N, K]
+        beam_src = (top_idx // v).astype(jnp.int32)
+        new_tok = (top_idx % v).astype(jnp.int32)
+        gather = lambda x: jnp.take_along_axis(x, beam_src, axis=1)
+        batch_ix = jnp.arange(n)[:, None]
+        h_newK = h_new.reshape(n, K, h)[batch_ix, beam_src].reshape(n * K, h)
+        c_newK = c_new.reshape(n, K, h)[batch_ix, beam_src].reshape(n * K, h)
+        new_finished = gather(finished) | (new_tok == eos)
+        ids = ids[batch_ix, beam_src]  # reorder histories
+        ids = ids.at[:, :, t].set(new_tok)
+        return (new_tok, top_scores, h_newK, c_newK, ids, new_finished), None
+
+    (tokens, scores, hK, cK, ids, finished), _ = lax.scan(
+        step, (tokens0, scores0, hK, cK, ids0, finished0), jnp.arange(L))
+    # sort beams best-first
+    order = jnp.argsort(-scores, axis=1)
+    ids = jnp.take_along_axis(ids, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return {"Ids": [ids], "Scores": [scores]}

@@ -1,0 +1,196 @@
+"""Recurrent ops: LSTM / GRU over dense padded sequences via lax.scan.
+
+<- paddle/fluid/operators/{lstm,lstm_unit,gru,gru_unit}_op.cc and the cell
+kernels in operators/math/detail/. The reference iterates host-side over LoD
+batches (sequence2batch reordering + shrink_rnn_memory as short sequences
+finish); here the whole recurrence is ONE lax.scan compiled by XLA, and
+"shrinking" is a per-step mask that freezes finished sequences — same math,
+no host loop, MXU-friendly [N, 4H] gate matmuls at every step.
+
+Gate order convention: i, f, c(candidate), o for LSTM; u(update), r(reset),
+c(candidate) for GRU. Documented here because the reference's blob layout
+differs; capability parity, not byte layout, is the contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "identity": lambda x: x,
+}
+
+
+def _lstm_scan(x, h0, c0, w, bias, peephole, length, gate_act, cell_act, cand_act,
+               is_reverse=False):
+    """x: [N, T, 4H] (input projection already applied), w: [H, 4H]."""
+    n, t, h4 = x.shape
+    h = h4 // 4
+    if is_reverse:
+        # reverse within valid region
+        idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
+        idx = jnp.where(idx >= 0, idx, jnp.arange(t)[None, :])
+        x = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32), axis=1)
+    xs = jnp.moveaxis(x, 1, 0)  # [T, N, 4H]
+    step_mask = (jnp.arange(t)[:, None] < length.reshape(1, -1)).astype(x.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, m = inp
+        gates = xt + h_prev @ w
+        i, f, c_bar, o = jnp.split(gates + bias, 4, axis=-1)
+        if peephole is not None:
+            p_i, p_f, p_o = jnp.split(peephole, 3)
+            i = i + c_prev * p_i
+            f = f + c_prev * p_f
+        i = gate_act(i)
+        f = gate_act(f)
+        c_new = f * c_prev + i * cand_act(c_bar)
+        if peephole is not None:
+            o = o + c_new * p_o
+        o = gate_act(o)
+        h_new = o * cell_act(c_new)
+        m = m[:, None]
+        h_out = m * h_new + (1 - m) * h_prev
+        c_out = m * c_new + (1 - m) * c_prev
+        return (h_out, c_out), (h_out * m, c_out * m)
+
+    (hT, cT), (hs, cs) = lax.scan(step, (h0, c0), (xs, step_mask))
+    hidden = jnp.moveaxis(hs, 0, 1)
+    cell = jnp.moveaxis(cs, 0, 1)
+    if is_reverse:
+        idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
+        idx = jnp.where(idx >= 0, idx, jnp.arange(t)[None, :])
+        hidden = jnp.take_along_axis(hidden, idx[..., None].astype(jnp.int32), axis=1)
+        cell = jnp.take_along_axis(cell, idx[..., None].astype(jnp.int32), axis=1)
+    return hidden, cell, hT, cT
+
+
+@register_op(
+    "lstm",
+    inputs=("Input", "H0", "C0", "Weight", "Bias", "Length"),
+    outputs=("Hidden", "Cell", "LastH", "LastC"),
+    diff_inputs=("Input", "H0", "C0", "Weight", "Bias"),
+)
+def lstm(ctx, ins, attrs):
+    x = ins["Input"][0]
+    n, t, h4 = x.shape
+    h = h4 // 4
+    w = ins["Weight"][0]
+    bias_in = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    use_peep = attrs.get("use_peepholes", False)
+    if bias_in is None:
+        bias = jnp.zeros((h4,), x.dtype)
+        peephole = jnp.zeros((3 * h,), x.dtype) if use_peep else None
+    else:
+        b = bias_in.reshape(-1)
+        if use_peep:
+            bias, peephole = b[:h4], b[h4 : h4 + 3 * h]
+        else:
+            bias, peephole = b[:h4], None
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((n, h), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else jnp.zeros((n, h), x.dtype)
+    length = (ins["Length"][0] if ins.get("Length") and ins["Length"][0] is not None
+              else jnp.full((n,), t, jnp.int32))
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    hidden, cell, hT, cT = _lstm_scan(
+        x, h0, c0, w, bias, peephole, length, gate_act, cell_act, cand_act,
+        is_reverse=attrs.get("is_reverse", False),
+    )
+    return {"Hidden": [hidden], "Cell": [cell], "LastH": [hT], "LastC": [cT]}
+
+
+@register_op(
+    "gru",
+    inputs=("Input", "H0", "Weight", "Bias", "Length"),
+    outputs=("Hidden", "LastH"),
+    diff_inputs=("Input", "H0", "Weight", "Bias"),
+)
+def gru(ctx, ins, attrs):
+    """x: [N, T, 3H] gate order (u, r, c); w packs [H, 2H] for u,r and
+    [H, H] for the candidate (<- gru_op.cc layout, re-expressed)."""
+    x = ins["Input"][0]
+    n, t, h3 = x.shape
+    h = h3 // 3
+    w = ins["Weight"][0]  # [H, 3H]
+    w_ur, w_c = w[:, : 2 * h], w[:, 2 * h :]
+    bias = (ins["Bias"][0].reshape(-1) if ins.get("Bias") and ins["Bias"][0] is not None
+            else jnp.zeros((h3,), x.dtype))
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((n, h), x.dtype)
+    length = (ins["Length"][0] if ins.get("Length") and ins["Length"][0] is not None
+              else jnp.full((n,), t, jnp.int32))
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    is_reverse = attrs.get("is_reverse", False)
+    if is_reverse:
+        idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
+        idx = jnp.where(idx >= 0, idx, jnp.arange(t)[None, :])
+        x = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32), axis=1)
+    xs = jnp.moveaxis(x, 1, 0)
+    step_mask = (jnp.arange(t)[:, None] < length.reshape(1, -1)).astype(x.dtype)
+
+    def step(h_prev, inp):
+        xt, m = inp
+        ur = gate_act(xt[:, : 2 * h] + h_prev @ w_ur + bias[: 2 * h])
+        u, r = ur[:, :h], ur[:, h:]
+        c = cand_act(xt[:, 2 * h :] + (r * h_prev) @ w_c + bias[2 * h :])
+        h_new = u * h_prev + (1 - u) * c
+        m = m[:, None]
+        h_out = m * h_new + (1 - m) * h_prev
+        return h_out, h_out * m
+
+    hT, hs = lax.scan(step, h0, (xs, step_mask))
+    hidden = jnp.moveaxis(hs, 0, 1)
+    if is_reverse:
+        idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
+        idx = jnp.where(idx >= 0, idx, jnp.arange(t)[None, :])
+        hidden = jnp.take_along_axis(hidden, idx[..., None].astype(jnp.int32), axis=1)
+    return {"Hidden": [hidden], "LastH": [hT]}
+
+
+@register_op(
+    "lstm_unit",
+    inputs=("X", "C_prev"),
+    outputs=("C", "H"),
+    diff_inputs=("X", "C_prev"),
+)
+def lstm_unit(ctx, ins, attrs):
+    """Single LSTM step on pre-projected gates X=[N,4H] (<- lstm_unit_op.cc)."""
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, f, c_bar, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(c_bar)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op(
+    "gru_unit",
+    inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+    outputs=("Gate", "ResetHiddenPrev", "Hidden"),
+    diff_inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+)
+def gru_unit(ctx, ins, attrs):
+    """Single GRU step (<- gru_unit_op.cc). Input [N,3H] pre-projected."""
+    x, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    h = h_prev.shape[-1]
+    bias = (ins["Bias"][0].reshape(-1) if ins.get("Bias") and ins["Bias"][0] is not None
+            else jnp.zeros((3 * h,), x.dtype))
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    w_ur, w_c = w[:, : 2 * h], w[:, 2 * h :]
+    ur = gate_act(x[:, : 2 * h] + h_prev @ w_ur + bias[: 2 * h])
+    u, r = ur[:, :h], ur[:, h:]
+    r_h = r * h_prev
+    c = cand_act(x[:, 2 * h :] + r_h @ w_c + bias[2 * h :])
+    h_new = u * h_prev + (1 - u) * c
+    gate = jnp.concatenate([u, r, c], axis=-1)
+    return {"Gate": [gate], "ResetHiddenPrev": [r_h], "Hidden": [h_new]}

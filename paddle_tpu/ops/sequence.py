@@ -1,0 +1,263 @@
+"""Variable-length sequence ops — the LoDTensor redesign.
+
+The reference packs ragged sequences into one tensor plus host-side offset
+tables (LoDTensor, framework/lod_tensor.h) and every sequence op walks the
+offsets. That representation is hostile to XLA (dynamic shapes, host
+metadata), so here sequences are **dense padded [batch, max_len, ...] with an
+explicit per-example Length tensor** (int32 [batch]) — static shapes, masks
+instead of offset walks, everything traceable and TPU-tileable.
+
+Ops mirror paddle/fluid/operators/sequence_*.cc semantics on that
+representation; the Length input replaces the LoD. Grads come from the
+generic vjp machinery (masks are constants w.r.t. differentiation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _mask(x, length, dtype=None):
+    """[N, T, 1...] validity mask from per-example lengths."""
+    n, t = x.shape[0], x.shape[1]
+    m = jnp.arange(t)[None, :] < length.reshape(-1, 1)
+    m = m.reshape((n, t) + (1,) * (x.ndim - 2))
+    return m if dtype is None else m.astype(dtype)
+
+
+@register_op("sequence_pool", inputs=("X", "Length"), outputs=("Out", "MaxIndex"),
+             diff_inputs=("X",))
+def sequence_pool(ctx, ins, attrs):
+    """<- sequence_pool_op.cc / math/sequence_pooling.cc.
+    pooltype in {SUM, AVERAGE, SQRT, MAX, LAST, FIRST}."""
+    x, length = ins["X"][0], ins["Length"][0]
+    ptype = attrs.get("pooltype", "SUM").upper()
+    m = _mask(x, length, x.dtype)
+    lf = jnp.maximum(length.astype(x.dtype), 1).reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / lf
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(lf)
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(length - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype!r}")
+    max_index = jnp.argmax(jnp.where(m > 0, x, jnp.finfo(x.dtype).min), axis=1)
+    return {"Out": [out], "MaxIndex": [max_index.astype(jnp.int32)]}
+
+
+@register_op("sequence_softmax", inputs=("X", "Length"), outputs=("Out",),
+             diff_inputs=("X",))
+def sequence_softmax(ctx, ins, attrs):
+    """Softmax over the valid time steps of each sequence
+    (<- sequence_softmax_op.cc). X: [N, T] or [N, T, 1]."""
+    x, length = ins["X"][0], ins["Length"][0]
+    m = _mask(x, length)
+    neg = jnp.finfo(x.dtype).min
+    logits = jnp.where(m, x, neg)
+    out = jax.nn.softmax(logits, axis=1)
+    return {"Out": [out * m.astype(x.dtype)]}
+
+
+@register_op("sequence_expand", inputs=("X", "Y", "Length"), outputs=("Out",),
+             diff_inputs=("X",))
+def sequence_expand(ctx, ins, attrs):
+    """Broadcast per-sequence rows X [N, D] along Y's time dim
+    (<- sequence_expand_op.cc at ref_level=0): Out[n, t] = X[n]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    t = y.shape[1]
+    if x.ndim == 2:
+        return {"Out": [jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1]))]}
+    return {"Out": [jnp.broadcast_to(x, (x.shape[0], t) + x.shape[2:])]}
+
+
+@register_op("sequence_concat", inputs=("X", "Length"), outputs=("Out", "OutLength"),
+             diff_inputs=("X",))
+def sequence_concat(ctx, ins, attrs):
+    """Concatenate two padded sequence batches along time, compacting padding
+    (<- sequence_concat_op.cc). Inputs: X = [A, B] with matching Lengths
+    [LenA, LenB]."""
+    a, b = ins["X"][0], ins["X"][1]
+    la, lb = ins["Length"][0], ins["Length"][1]
+    n, ta = a.shape[0], a.shape[1]
+    tb = b.shape[1]
+    tout = ta + tb
+    # target position of each b element: la + t
+    pos_b = la.reshape(-1, 1) + jnp.arange(tb)[None, :]
+    out = jnp.zeros((n, tout) + a.shape[2:], a.dtype)
+    out = out.at[:, :ta].set(a * _mask(a, la, a.dtype))
+    out = out.at[jnp.arange(n)[:, None], pos_b].add(b * _mask(b, lb, b.dtype))
+    return {"Out": [out], "OutLength": [la + lb]}
+
+
+@register_op("sequence_reshape", inputs=("X", "Length"), outputs=("Out", "OutLength"),
+             diff_inputs=("X",))
+def sequence_reshape(ctx, ins, attrs):
+    """Change feature dim by folding time (<- sequence_reshape_op.cc):
+    new_dim attr; T*D must be divisible."""
+    x, length = ins["X"][0], ins["Length"][0]
+    new_dim = attrs["new_dim"]
+    n, t, d = x.shape
+    factor = d / new_dim
+    out = x.reshape(n, int(t * factor), new_dim)
+    return {"Out": [out], "OutLength": [(length * d) // new_dim]}
+
+
+@register_op("sequence_slice", inputs=("X", "Offset", "Length"), outputs=("Out",),
+             diff_inputs=("X",))
+def sequence_slice(ctx, ins, attrs):
+    """Per-sequence time slice (<- sequence_slice_op.cc): Out[n] =
+    X[n, offset[n]:offset[n]+length[n]] left-aligned into a [N, max_len, D]
+    buffer."""
+    x, offset, length = ins["X"][0], ins["Offset"][0], ins["Length"][0]
+    offset = offset.reshape(-1).astype(jnp.int32)
+    length = length.reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    idx = offset[:, None] + jnp.arange(t)[None, :]
+    idx = jnp.minimum(idx, t - 1)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return {"Out": [gathered * _mask(gathered, length, x.dtype)]}
+
+
+@register_op("sequence_erase", inputs=("X", "Length"), outputs=("Out", "OutLength"),
+             no_grad=True)
+def sequence_erase(ctx, ins, attrs):
+    """Remove tokens in attr 'tokens' from each int sequence, compacting left
+    (<- sequence_erase_op.cc). X: [N, T] int."""
+    x, length = ins["X"][0], ins["Length"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    valid = _mask(x[..., None], length)[..., 0]
+    keep = valid & ~jnp.isin(x, tokens)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    # stable compaction: position = cumsum of keep - 1
+    pos = jnp.cumsum(keep, axis=1) - 1
+    n, t = x.shape
+    out = jnp.zeros_like(x)
+    out = out.at[
+        jnp.arange(n)[:, None], jnp.where(keep, pos, t - 1)
+    ].max(jnp.where(keep, x, 0))
+    return {"Out": [out], "OutLength": [new_len]}
+
+
+@register_op("sequence_conv", inputs=("X", "Filter", "Length"), outputs=("Out",),
+             diff_inputs=("X", "Filter"))
+def sequence_conv(ctx, ins, attrs):
+    """Context-window projection over time (<- sequence_conv_op.cc +
+    math/context_project.h): for each t, concat rows
+    [t+start, t+start+ctx_len) (zero outside the sequence) then matmul with
+    Filter [ctx_len*D, M]."""
+    x, w = ins["X"][0], ins["Filter"][0]
+    length = ins["Length"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    start = attrs.get("contextStart", -((ctx_len - 1) // 2) - (ctx_len - 1) % 2)
+    n, t, d = x.shape
+    xm = x * _mask(x, length, x.dtype)
+    cols = []
+    for i in range(ctx_len):
+        shift = start + i
+        if shift < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-shift, 0), (0, 0)))[:, :t]
+        elif shift > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, shift), (0, 0)))[:, shift:]
+        else:
+            shifted = xm
+        cols.append(shifted)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [N, T, ctx_len*D]
+    out = jnp.einsum("ntc,cm->ntm", ctx_mat, w)
+    return {"Out": [out * _mask(out, length, out.dtype)]}
+
+
+@register_op("sequence_pad", inputs=("X", "Length"), outputs=("Out",), diff_inputs=("X",))
+def sequence_pad(ctx, ins, attrs):
+    """Zero out positions beyond each length (dense-representation analogue of
+    math/sequence_padding.cc)."""
+    x, length = ins["X"][0], ins["Length"][0]
+    return {"Out": [x * _mask(x, length, x.dtype)]}
+
+
+@register_op("lod_reset", inputs=("X", "Y"), outputs=("Out",))
+def lod_reset(ctx, ins, attrs):
+    """Identity on dense data (<- lod_reset_op.cc re-binds LoD; lengths travel
+    separately here, so data is unchanged)."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("sequence_reverse", inputs=("X", "Length"), outputs=("Y",),
+             diff_inputs=("X",))
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse each sequence within its valid length."""
+    x, length = ins["X"][0], ins["Length"][0]
+    t = x.shape[1]
+    idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
+    idx = jnp.where(idx >= 0, idx, jnp.arange(t)[None, :])
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+    return {"Y": [out]}
+
+
+@register_op("sequence_mask", inputs=("X",), outputs=("Y",), no_grad=True)
+def sequence_mask(ctx, ins, attrs):
+    """Lengths [N] -> mask [N, maxlen] (<- sequence_mask in later reference
+    versions; needed for masked losses over padded sequences)."""
+    length = ins["X"][0].reshape(-1)
+    maxlen = attrs["maxlen"]
+    from ..core.types import DataType
+
+    dt = attrs.get("out_dtype", DataType.FP32)
+    dt = DataType.from_any(dt).jnp_dtype
+    return {"Y": [(jnp.arange(maxlen)[None, :] < length[:, None]).astype(dt)]}
+
+
+@register_op("edit_distance", inputs=("Hyps", "Refs", "HypLength", "RefLength"),
+             outputs=("Out", "SequenceNum"), no_grad=True)
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per pair (<- edit_distance_op.cc), computed with a
+    scan over the DP table rows (static shapes)."""
+    hyp, ref = ins["Hyps"][0], ins["Refs"][0]
+    hlen = ins["HypLength"][0].reshape(-1)
+    rlen = ins["RefLength"][0].reshape(-1)
+    n, th = hyp.shape
+    tr = ref.shape[1]
+
+    def per_pair(h, r, hl, rl):
+        init = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def row(prev, i):
+            hi = h[i]
+
+            def col(carry, j):
+                row_prev = carry
+                cost = jnp.where(hi == r[j], 0.0, 1.0)
+                val = jnp.minimum(
+                    jnp.minimum(row_prev + 1.0, prev[j + 1] + 1.0),
+                    prev[j] + cost,
+                )
+                return val, val
+
+            _, vals = lax.scan(col, i + 1.0, jnp.arange(tr))
+            new_row = jnp.concatenate([jnp.array([i + 1.0]), vals])
+            return new_row, new_row
+
+        _, rows = lax.scan(row, init, jnp.arange(th))
+        table = jnp.concatenate([init[None], rows])  # [th+1, tr+1]
+        return table[hl, rl]
+
+    dists = jax.vmap(per_pair)(hyp, ref, hlen, rlen)
+    if attrs.get("normalized", False):
+        dists = dists / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return {"Out": [dists.reshape(-1, 1)],
+            "SequenceNum": [jnp.asarray(n, jnp.int32)]}

@@ -1,0 +1,188 @@
+"""Sequence ops on the dense (values, lengths) representation vs numpy refs."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _seq_data(n=3, t=5, d=4):
+    x = np.random.rand(n, t, d).astype("float32")
+    length = np.array([5, 2, 3], "int32")[:n]
+    mask = (np.arange(t)[None, :] < length[:, None]).astype("float32")
+    return x, length, mask
+
+
+class TestSequencePoolSum(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self):
+        x, length, mask = _seq_data()
+        ref = (x * mask[..., None]).sum(axis=1)
+        self.inputs = {"X": x, "Length": [("Length", length)]}
+        self.outputs = {"Out": ref}
+        self.attrs = {"pooltype": "SUM"}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSequencePoolAvg(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self):
+        x, length, mask = _seq_data()
+        ref = (x * mask[..., None]).sum(axis=1) / length[:, None]
+        self.inputs = {"X": x, "Length": [("Length", length)]}
+        self.outputs = {"Out": ref}
+        self.attrs = {"pooltype": "AVERAGE"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequencePoolMax(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self):
+        x, length, mask = _seq_data()
+        masked = np.where(mask[..., None] > 0, x, -np.inf)
+        self.inputs = {"X": x, "Length": [("Length", length)]}
+        self.outputs = {"Out": masked.max(axis=1)}
+        self.attrs = {"pooltype": "MAX"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequencePoolLast(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self):
+        x, length, _ = _seq_data()
+        ref = x[np.arange(3), length - 1]
+        self.inputs = {"X": x, "Length": [("Length", length)]}
+        self.outputs = {"Out": ref}
+        self.attrs = {"pooltype": "LAST"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceSoftmax(OpTest):
+    op_type = "sequence_softmax"
+
+    def setup(self):
+        x, length, mask = _seq_data(d=1)
+        x = x.squeeze(-1)  # [N, T]
+        mask2 = mask
+        e = np.exp(x) * mask2
+        ref = e / np.maximum(e.sum(axis=1, keepdims=True), 1e-12) * mask2
+        self.inputs = {"X": x, "Length": [("Length", length)]}
+        self.outputs = {"Out": ref.astype("float32")}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSequenceMask(OpTest):
+    op_type = "sequence_mask"
+
+    def setup(self):
+        length = np.array([3, 1, 4], "int32")
+        ref = (np.arange(5)[None, :] < length[:, None]).astype("float32")
+        self.inputs = {"X": length}
+        self.outputs = {"Y": ref}
+        self.attrs = {"maxlen": 5}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceReverse(OpTest):
+    op_type = "sequence_reverse"
+
+    def setup(self):
+        x, length, mask = _seq_data()
+        ref = x.copy()
+        for i, l in enumerate(length):
+            ref[i, :l] = x[i, :l][::-1]
+        self.inputs = {"X": x, "Length": [("Length", length)]}
+        self.outputs = {"Y": ref}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceConcat(OpTest):
+    op_type = "sequence_concat"
+
+    def setup(self):
+        n, ta, tb, d = 2, 3, 4, 2
+        a = np.random.rand(n, ta, d).astype("float32")
+        b = np.random.rand(n, tb, d).astype("float32")
+        la = np.array([2, 3], "int32")
+        lb = np.array([4, 1], "int32")
+        out = np.zeros((n, ta + tb, d), "float32")
+        for i in range(n):
+            seq = np.concatenate([a[i, : la[i]], b[i, : lb[i]]])
+            out[i, : la[i] + lb[i]] = seq
+        self.inputs = {"X": [("a", a), ("b", b)],
+                       "Length": [("la", la), ("lb", lb)]}
+        self.outputs = {"Out": out, "OutLength": [("OutLength", la + lb)]}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def setup(self):
+        n, t, d, m = 2, 4, 3, 5
+        x = np.random.rand(n, t, d).astype("float32")
+        w = np.random.rand(3 * d, m).astype("float32")
+        length = np.array([4, 2], "int32")
+        maskx = (np.arange(t)[None, :] < length[:, None]).astype("float32")[..., None]
+        xm = x * maskx
+        ctx = np.zeros((n, t, 3 * d), "float32")
+        for sh, sl in [(-1, slice(0, 0)), (0, None), (1, None)]:
+            pass
+        padded = np.pad(xm, ((0, 0), (1, 1), (0, 0)))
+        for i in range(3):
+            ctx[:, :, i * d:(i + 1) * d] = padded[:, i:i + t]
+        ref = (ctx @ w) * maskx
+        self.inputs = {"X": x, "Filter": [("Filter", w)],
+                       "Length": [("Length", length)]}
+        self.outputs = {"Out": ref}
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=1e-2)
+
+
+class TestEditDistance(OpTest):
+    op_type = "edit_distance"
+
+    def setup(self):
+        hyp = np.array([[1, 2, 3, 0], [5, 6, 0, 0]], "int32")
+        ref = np.array([[1, 3, 3], [6, 5, 0]], "int32")
+        hlen = np.array([3, 2], "int32")
+        rlen = np.array([3, 2], "int32")
+        # d("123","133")=1 ; d("56","65")=2
+        self.inputs = {
+            "Hyps": [("Hyps", hyp)], "Refs": [("Refs", ref)],
+            "HypLength": [("HypLength", hlen)], "RefLength": [("RefLength", rlen)],
+        }
+        self.outputs = {"Out": np.array([[1.0], [2.0]], "float32")}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
