@@ -17,8 +17,10 @@ Public surface mirrors ``python/paddle/fluid``:
 """
 
 from . import ops  # registers the op library
-from . import initializer, layers, optimizer, regularizer, unique_name  # noqa: F401
+from . import clip, initializer, layers, optimizer, regularizer, unique_name  # noqa: F401
+from . import dataset, io, metrics, profiler, reader  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from .layers import learning_rate_scheduler  # noqa: F401
 from .core import (  # noqa: F401
     CPUPlace,
     DataType,

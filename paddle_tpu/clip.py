@@ -1,0 +1,133 @@
+"""Gradient clipping (<- python/paddle/fluid/clip.py incl.
+GradientClipByGlobalNorm clip.py:210). IR passes inserting clip ops between
+append_backward and the optimizer ops."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from . import unique_name
+from .core.ir import Block, Variable
+
+
+class BaseGradientClipAttr:
+    def _process(self, block: Block, param: Variable, grad: Variable) -> Variable:
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _process(self, block, param, grad):
+        out = block.create_var(unique_name.generate(f"{grad.name}.clip"),
+                               dtype=grad.dtype, shape=grad.shape)
+        block.append_op("clip", {"X": [grad]}, {"Out": [out]},
+                        {"min": self.min, "max": self.max})
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, block, param, grad):
+        out = block.create_var(unique_name.generate(f"{grad.name}.clip"),
+                               dtype=grad.dtype, shape=grad.shape)
+        block.append_op("clip_by_norm", {"X": [grad]}, {"Out": [out]},
+                        {"max_norm": self.clip_norm})
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """<- clip.py:210: scale every grad by clip_norm/max(global_norm, clip_norm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process_all(self, block: Block,
+                     params_grads: List[Tuple[Variable, Variable]]):
+        sq_names = []
+        for _, g in params_grads:
+            sq = block.create_var(unique_name.generate(f"{g.name}.sq"),
+                                  dtype=g.dtype, shape=())
+            block.append_op("squared_l2_norm", {"X": [g]}, {"Out": [sq]})
+            sq_names.append(sq.name)
+        total = block.create_var(unique_name.generate("global_norm.sq"),
+                                 dtype=params_grads[0][1].dtype, shape=())
+        block.append_op("sum", {"X": sq_names}, {"Out": [total]})
+        gnorm = block.create_var(unique_name.generate("global_norm"),
+                                 dtype=total.dtype, shape=())
+        block.append_op("sqrt", {"X": [total]}, {"Out": [gnorm]})
+        # scale = clip_norm / max(gnorm, clip_norm)
+        clipped = block.create_var(unique_name.generate("global_norm.clip"),
+                                   dtype=total.dtype, shape=())
+        block.append_op("clip", {"X": [gnorm]}, {"Out": [clipped]},
+                        {"min": self.clip_norm, "max": 3.4e38})
+        scale = block.create_var(unique_name.generate("clip_scale"),
+                                 dtype=total.dtype, shape=())
+        block.append_op("elementwise_div", {"X": [_const(block, self.clip_norm,
+                                                         total.dtype)],
+                                            "Y": [clipped]}, {"Out": [scale]})
+        out = []
+        for p, g in params_grads:
+            ng = block.create_var(unique_name.generate(f"{g.name}.clip"),
+                                  dtype=g.dtype, shape=g.shape)
+            block.append_op("elementwise_mul", {"X": [g], "Y": [scale]},
+                            {"Out": [ng]})
+            out.append((p, block.var(ng.name)))
+        return out
+
+
+def _const(block, value, dtype):
+    name = unique_name.generate("clip_const")
+    block.create_var(name, dtype=dtype, shape=())
+    block.append_op("fill_constant", outputs={"Out": [name]},
+                    attrs={"shape": [], "value": value, "dtype": dtype})
+    return name
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """<- clip.py set_gradient_clip: stash clip attr on parameters."""
+    from .core.ir import default_main_program
+
+    program = program or default_main_program()
+    if param_list is None:
+        params = program.global_block().all_parameters()
+    else:
+        params = [program.global_block().var(p if isinstance(p, str) else p.name)
+                  for p in param_list]
+    for p in params:
+        attr = getattr(p, "_param_attr", None)
+        if attr is not None:
+            attr.gradient_clip = clip
+        else:
+            from .param_attr import ParamAttr
+
+            a = ParamAttr()
+            a.gradient_clip = clip
+            p._param_attr = a
+
+
+def append_gradient_clip_ops(block: Block, params_grads):
+    """Apply per-param clip attrs (+global-norm group) to grads; returns new
+    (param, grad) list. Called from Optimizer.minimize."""
+    global_norm_groups: dict = {}
+    out = []
+    for p, g in params_grads:
+        attr = getattr(p, "_param_attr", None)
+        clip = attr.gradient_clip if attr is not None else None
+        if clip is None:
+            out.append((p, g))
+        elif isinstance(clip, GradientClipByGlobalNorm):
+            global_norm_groups.setdefault(clip, []).append((p, g))
+        else:
+            out.append((p, clip._process(block, p, g)))
+    for clip, pgs in global_norm_groups.items():
+        out.extend(clip._process_all(block, pgs))
+    out.sort(key=lambda pg: pg[0].name)
+    return out
+
+
+# fluid aliases
+ErrorClipByValue = GradientClipByValue

@@ -349,15 +349,18 @@ class Program:
 
     # -- transforms --
     def clone(self, for_test: bool = False) -> "Program":
-        """Deep copy; with for_test=True, switch train-only ops to eval mode
+        """Deep copy; with for_test=True, prune backward/optimizer ops and
+        switch train-only ops to eval mode
         (<- Program.clone framework.py:1440: prune backward + set is_test)."""
         p = Program.from_dict(self.to_dict())
         p.random_seed = self.random_seed
         if for_test:
             for blk in p.blocks:
+                blk.ops = [op for op in blk.ops if not _is_backward_op(op)]
                 for op in blk.ops:
                     if "is_test" in _TRAIN_MODE_OPS.get(op.type, ()):
                         op.attrs["is_test"] = True
+            p._bump_version()
         return p
 
     def list_vars(self):
@@ -403,6 +406,23 @@ _TRAIN_MODE_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
 }
+
+_OPTIMIZER_OPS = {
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad",
+    "average_accumulates",
+}
+
+
+def _is_backward_op(op: "Operator") -> bool:
+    """Backward/optimizer detection for clone(for_test): the reference tags
+    ops with an op_role attr; here grad ops and their glue are identified by
+    the @GRAD naming convention plus the optimizer op set."""
+    if op.type in _OPTIMIZER_OPS or op.type.endswith("_grad"):
+        return True
+    return any(
+        GRAD_SUFFIX in n for n in (*op.input_names, *op.output_names) if n
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,9 @@
+"""Datasets (<- python/paddle/dataset/: mnist, cifar, imdb, uci_housing, ...).
+
+This environment has zero network egress, so each dataset loads from a local
+cache directory when present (same file formats as the reference's fetch
+cache) and otherwise falls back to a deterministic synthetic generator with
+the exact sample shapes/dtypes of the real dataset — enough for the book
+tests, benchmarks, and pipeline code to run unchanged.
+"""
+from . import cifar, imdb, mnist, uci_housing  # noqa: F401

@@ -1,0 +1,56 @@
+"""CIFAR readers (<- python/paddle/dataset/cifar.py). Samples:
+(image float32[3072] in [0,1], label int64). Local pickle cache or synthetic."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/cifar")
+
+
+def _synthetic(n, classes, seed):
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(classes, 3072).astype("float32")
+    labels = rng.randint(0, classes, n).astype("int64")
+    images = np.clip(protos[labels] + 0.25 * rng.randn(n, 3072), 0, 1)
+    return images.astype("float32"), labels
+
+
+def _reader(tar_name, keys, classes, n_synth, seed):
+    def reader():
+        path = os.path.join(CACHE, tar_name)
+        if os.path.exists(path):
+            with tarfile.open(path) as tar:
+                for member in tar.getmembers():
+                    if not any(k in member.name for k in keys):
+                        continue
+                    batch = pickle.load(tar.extractfile(member), encoding="bytes")
+                    data = batch[b"data"].astype("float32") / 255.0
+                    labels = batch.get(b"labels", batch.get(b"fine_labels"))
+                    for img, lbl in zip(data, labels):
+                        yield img, int(lbl)
+        else:
+            images, labels = _synthetic(n_synth, classes, seed)
+            for img, lbl in zip(images, labels):
+                yield img, int(lbl)
+
+    return reader
+
+
+def train10():
+    return _reader("cifar-10-python.tar.gz", ["data_batch"], 10, 4096, 10)
+
+
+def test10():
+    return _reader("cifar-10-python.tar.gz", ["test_batch"], 10, 512, 11)
+
+
+def train100():
+    return _reader("cifar-100-python.tar.gz", ["train"], 100, 4096, 12)
+
+
+def test100():
+    return _reader("cifar-100-python.tar.gz", ["test"], 100, 512, 13)

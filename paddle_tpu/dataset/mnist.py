@@ -1,0 +1,63 @@
+"""MNIST readers (<- python/paddle/dataset/mnist.py). Samples: (image
+float32[784] in [-1, 1], label int64). Loads idx-format files from
+~/.cache/paddle/dataset/mnist when present, else synthesizes digits-like
+data deterministically."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/mnist")
+
+_FILES = {
+    "train_images": "train-images-idx3-ubyte.gz",
+    "train_labels": "train-labels-idx1-ubyte.gz",
+    "test_images": "t10k-images-idx3-ubyte.gz",
+    "test_labels": "t10k-labels-idx1-ubyte.gz",
+}
+
+
+def _load_idx(path):
+    with gzip.open(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        if magic == 2051:
+            n, rows, cols = struct.unpack(">III", f.read(12))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
+        n = struct.unpack(">I", f.read(4))[0]
+        return np.frombuffer(f.read(), np.uint8)
+
+
+def _synthetic(n, seed):
+    """Deterministic learnable stand-in: blurred one-hot patterns per digit."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(10, 784).astype("float32")
+    labels = rng.randint(0, 10, n).astype("int64")
+    images = protos[labels] + 0.3 * rng.randn(n, 784).astype("float32")
+    images = np.clip(images, 0, 1) * 2 - 1
+    return images.astype("float32"), labels
+
+
+def _reader(images_file, labels_file, n_synth, seed):
+    def reader():
+        ipath = os.path.join(CACHE, images_file)
+        lpath = os.path.join(CACHE, labels_file)
+        if os.path.exists(ipath) and os.path.exists(lpath):
+            images = _load_idx(ipath).astype("float32") / 255.0 * 2 - 1
+            labels = _load_idx(lpath).astype("int64")
+        else:
+            images, labels = _synthetic(n_synth, seed)
+        for img, lbl in zip(images, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader(_FILES["train_images"], _FILES["train_labels"], 8192, 0)
+
+
+def test():
+    return _reader(_FILES["test_images"], _FILES["test_labels"], 1024, 1)

@@ -1,0 +1,46 @@
+"""UCI housing (<- python/paddle/dataset/uci_housing.py), the fit_a_line book
+workload. Samples: (features float32[13], price float32[1]). Synthetic
+fallback: linear function + noise (so fit_a_line genuinely converges)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle/dataset/uci_housing")
+_W = None
+
+
+def _synthetic(n, seed):
+    global _W
+    rng = np.random.RandomState(7)
+    if _W is None:
+        _W = rng.randn(13).astype("float32")
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 13).astype("float32")
+    y = x @ _W + 0.1 * rng.randn(n).astype("float32")
+    return x, y.astype("float32")
+
+
+def _reader(n, seed):
+    def reader():
+        path = os.path.join(CACHE, "housing.data")
+        if os.path.exists(path):
+            data = np.loadtxt(path).astype("float32")
+            feats = (data[:, :-1] - data[:, :-1].mean(0)) / (data[:, :-1].std(0) + 1e-8)
+            for f, p in zip(feats, data[:, -1]):
+                yield f, np.array([p], "float32")
+        else:
+            x, y = _synthetic(n, seed)
+            for f, p in zip(x, y):
+                yield f, np.array([p], "float32")
+
+    return reader
+
+
+def train():
+    return _reader(404, 30)
+
+
+def test():
+    return _reader(102, 31)

@@ -1,0 +1,199 @@
+"""Model IO: persistables, inference export, checkpoint/resume.
+
+<- python/paddle/fluid/io.py (save/load_persistables io.py:249,454,
+save/load_inference_model io.py:551,654, checkpoints io.py:802,882) and
+save_op.cc/load_op.cc tensor serialization.
+
+Format: one directory per save; each variable is a .npy file (name URL-quoted
+for filesystem safety), the program a JSON IR file (``__model__``). Sharded
+jax arrays are gathered to host before writing; loading re-places them on the
+executor's device at first use. Checkpoints keep the reference's numbered
+``checkpoint_N`` + ``_SUCCESS`` marker protocol so resume semantics match.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import urllib.parse
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Executor, Scope, global_scope
+from .core.ir import Program, Variable, default_main_program
+
+MODEL_FILENAME = "__model__"
+SUCCESS_MARKER = "_SUCCESS"
+CHECKPOINT_PREFIX = "checkpoint"
+
+
+def _var_path(dirname: str, name: str) -> str:
+    return os.path.join(dirname, urllib.parse.quote(name, safe="") + ".npy")
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable)
+
+
+def save_vars(executor, dirname, main_program=None, vars: Optional[Sequence] = None,
+              predicate=None, scope: Optional[Scope] = None):
+    """<- io.py save_vars. Writes each selected var's ndarray."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars() if (predicate or _is_persistable)(v)]
+    os.makedirs(dirname, exist_ok=True)
+    for v in vars:
+        name = v if isinstance(v, str) else v.name
+        val = scope.get(name)
+        if val is None:
+            raise RuntimeError(f"variable {name!r} has no value in scope")
+        np.save(_var_path(dirname, name), np.asarray(val))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              scope: Optional[Scope] = None):
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars() if (predicate or _is_persistable)(v)]
+    for v in vars:
+        name = v if isinstance(v, str) else v.name
+        path = _var_path(dirname, name)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no saved value for variable {name!r} at {path}")
+        scope.set(name, np.load(path))
+
+
+def save_persistables(executor, dirname, main_program=None, scope=None):
+    """<- io.py:249."""
+    save_vars(executor, dirname, main_program, predicate=_is_persistable, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, scope=None):
+    """<- io.py:454."""
+    load_vars(executor, dirname, main_program, predicate=_is_persistable, scope=scope)
+
+
+def save_params(executor, dirname, main_program=None, scope=None):
+    program = main_program or default_main_program()
+    save_vars(executor, dirname, program,
+              predicate=lambda v: v.persistable and not v.is_data, scope=scope)
+
+
+load_params = load_persistables
+
+
+# ---------------------------------------------------------------------------
+# Inference model export (<- io.py:551 save_inference_model)
+# ---------------------------------------------------------------------------
+
+
+def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
+    """Keep only ops on the path from feeds to fetches (<- framework prune.cc)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_names):
+            keep.append(op)
+            needed.update(n for n in op.input_names if n)
+    block.ops = list(reversed(keep))
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, scope=None):
+    program = main_program or default_main_program()
+    fetch_names = [t if isinstance(t, str) else t.name for t in target_vars]
+    pruned = _prune_for_inference(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
+        json.dump(meta, f)
+    # persist every persistable the pruned program still references
+    referenced = {n for op in pruned.global_block().ops for n in op.input_names}
+    vars = [v for v in program.list_vars()
+            if v.persistable and (v.name in referenced)]
+    save_vars(executor, dirname, program, vars=vars, scope=scope)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, scope=None):
+    """Returns (program, feed_names, fetch_names); params loaded into scope."""
+    with open(os.path.join(dirname, MODEL_FILENAME)) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    scope = scope or global_scope()
+    for v in program.list_vars():
+        if v.persistable:
+            path = _var_path(dirname, v.name)
+            if os.path.exists(path):
+                scope.set(v.name, np.load(path))
+    return program, meta["feed_names"], meta["fetch_names"]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume (<- io.py:802 save_checkpoint, :882 load_checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
+                    max_num_checkpoints=3, scope=None, step=None):
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    serial = _next_checkpoint_serial(checkpoint_dir) if step is None else step
+    cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+    os.makedirs(cur, exist_ok=True)
+    save_persistables(executor, cur, main_program, scope=scope)
+    with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
+        f.write(str(trainer_id))
+    _scroll_delete(checkpoint_dir, max_num_checkpoints)
+    return serial
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
+                    serial=None):
+    if serial is None:
+        serial = _latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        raise FileNotFoundError(f"no complete checkpoint under {checkpoint_dir}")
+    cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+    load_persistables(executor, cur, main_program, scope=scope)
+    return serial
+
+
+def _checkpoint_serials(checkpoint_dir) -> List[int]:
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith(CHECKPOINT_PREFIX + "_"):
+            try:
+                serial = int(name.rsplit("_", 1)[1])
+            except ValueError:
+                continue
+            if os.path.exists(os.path.join(checkpoint_dir, name, SUCCESS_MARKER)):
+                out.append(serial)
+    return sorted(out)
+
+
+def _latest_checkpoint_serial(checkpoint_dir) -> int:
+    serials = _checkpoint_serials(checkpoint_dir)
+    return serials[-1] if serials else -1
+
+
+def _next_checkpoint_serial(checkpoint_dir) -> int:
+    return _latest_checkpoint_serial(checkpoint_dir) + 1
+
+
+def _scroll_delete(checkpoint_dir, max_num_checkpoints):
+    serials = _checkpoint_serials(checkpoint_dir)
+    for s in serials[:-max_num_checkpoints] if max_num_checkpoints > 0 else []:
+        shutil.rmtree(os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{s}"),
+                      ignore_errors=True)

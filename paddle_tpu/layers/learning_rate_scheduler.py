@@ -1,0 +1,124 @@
+"""Learning-rate schedules as IR (<- python/paddle/fluid/layers/
+learning_rate_scheduler.py). Each schedule creates a persistable global step
+counter (incremented once per run at the top of the program) and computes the
+lr from it with ordinary ops — the whole schedule compiles into the training
+step."""
+from __future__ import annotations
+
+import math
+
+from .. import unique_name
+from ..core.ir import default_main_program, default_startup_program
+from ..core.types import DataType
+from ..layer_helper import LayerHelper
+
+
+def _global_step_counter():
+    """Persistable float step counter, incremented each program run
+    (<- layers/learning_rate_scheduler.py _decay_step_counter)."""
+    main = default_main_program()
+    startup = default_startup_program()
+    name = "@lr_decay_counter@"
+    block = main.global_block()
+    if not block.has_var(name):
+        block.create_var(name, dtype=DataType.FP32, shape=(), persistable=True,
+                         stop_gradient=True)
+        sb = startup.global_block()
+        sb.create_var(name, dtype=DataType.FP32, shape=(), persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": [name]},
+                     attrs={"shape": [], "value": 0.0, "dtype": DataType.FP32})
+        # prepend so every run sees step = previous_step + 1
+        block.prepend_op("increment", {"X": [name]}, {"Out": [name]}, {"step": 1.0})
+    return block.var(name)
+
+
+def _unary(helper, op, x, **attrs):
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(op, {"X": [x]}, {"Out": [out]}, attrs)
+    return out
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)."""
+    helper = LayerHelper("exponential_decay")
+    step = _global_step_counter()
+    div = _unary(helper, "scale", step, scale=1.0 / decay_steps)
+    if staircase:
+        div = _unary(helper, "floor", div)
+    exponent = _unary(helper, "scale", div, scale=math.log(decay_rate))
+    factor = _unary(helper, "exp", exponent)
+    return _unary(helper, "scale", factor, scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)."""
+    helper = LayerHelper("natural_exp_decay")
+    step = _global_step_counter()
+    div = _unary(helper, "scale", step, scale=1.0 / decay_steps)
+    if staircase:
+        div = _unary(helper, "floor", div)
+    exponent = _unary(helper, "scale", div, scale=-float(decay_rate))
+    factor = _unary(helper, "exp", exponent)
+    return _unary(helper, "scale", factor, scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)."""
+    helper = LayerHelper("inverse_time_decay")
+    step = _global_step_counter()
+    div = _unary(helper, "scale", step, scale=1.0 / decay_steps)
+    if staircase:
+        div = _unary(helper, "floor", div)
+    denom = _unary(helper, "scale", div, scale=float(decay_rate), bias=1.0)
+    inv = _unary(helper, "reciprocal", denom)
+    return _unary(helper, "scale", inv, scale=float(learning_rate))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    """(lr - end) * (1 - min(step, decay)/decay)^power + end."""
+    helper = LayerHelper("polynomial_decay")
+    step = _global_step_counter()
+    capped = _unary(helper, "clip", step, min=0.0, max=float(decay_steps))
+    frac = _unary(helper, "scale", capped, scale=-1.0 / decay_steps, bias=1.0)
+    powed = _unary(helper, "pow", frac, factor=float(power))
+    return _unary(helper, "scale", powed,
+                  scale=float(learning_rate - end_learning_rate),
+                  bias=float(end_learning_rate))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """d_model^-0.5 * min(step^-0.5, step * warmup^-1.5) (<- transformer)."""
+    helper = LayerHelper("noam_decay")
+    step = _global_step_counter()
+    a = _unary(helper, "pow", step, factor=-0.5)
+    b = _unary(helper, "scale", step, scale=float(warmup_steps) ** -1.5)
+    m = helper.create_variable_for_type_inference("float32")
+    helper.append_op("elementwise_min", {"X": [a], "Y": [b]}, {"Out": [m]})
+    return _unary(helper, "scale", m,
+                  scale=float(learning_rate) * float(d_model) ** -0.5)
+
+
+def piecewise_decay(boundaries, values):
+    """Step-function schedule (<- learning_rate_scheduler.py piecewise_decay):
+    lr = values[i] for boundaries[i-1] <= step < boundaries[i]."""
+    assert len(boundaries) + 1 == len(values)
+    helper = LayerHelper("piecewise_decay")
+    step = _global_step_counter()
+    # lr = v0 + sum_i (v_{i+1} - v_i) * [step >= b_i], built from clips:
+    # indicator(step >= b) = clip(step - b + 1, 0, 1) floored
+    lr = None
+    prev_v = values[0]
+    acc_name = None
+    const = _unary(helper, "scale", step, scale=0.0, bias=float(values[0]))
+    lr = const
+    for b, v in zip(boundaries, values[1:]):
+        shifted = _unary(helper, "scale", step, scale=1.0, bias=float(1 - b))
+        ind = _unary(helper, "clip", shifted, min=0.0, max=1.0)
+        ind = _unary(helper, "floor", ind)
+        delta = _unary(helper, "scale", ind, scale=float(v - prev_v))
+        s = helper.create_variable_for_type_inference("float32")
+        helper.append_op("elementwise_add", {"X": [lr], "Y": [delta]}, {"Out": [s]})
+        lr = s
+        prev_v = v
+    return lr

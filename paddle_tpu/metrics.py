@@ -1,0 +1,172 @@
+"""Python-side streaming metrics (<- python/paddle/fluid/metrics.py:49-538).
+
+Pure-python aggregation over per-batch values fetched from the program (the
+metric *ops* live in ops/metrics_ops.py); same class surface as the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, 0 if isinstance(v, int) else 0.0)
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """<- metrics.py Accuracy: weighted running mean of batch accuracies."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(value) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """Binary precision (<- metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int32").reshape(-1)
+        labels = np.asarray(labels).astype("int32").reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        return self.tp / (self.tp + self.fp) if (self.tp + self.fp) else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int32").reshape(-1)
+        labels = np.asarray(labels).astype("int32").reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        return self.tp / (self.tp + self.fn) if (self.tp + self.fn) else 0.0
+
+
+class EditDistance(MetricBase):
+    """<- metrics.py EditDistance: mean distance + instance error rate."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        distances = np.asarray(distances).reshape(-1)
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num if seq_num is not None else distances.size)
+        self.instance_error += int((distances > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no batches accumulated")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """Threshold-bucketed streaming AUC (<- metrics.py Auc)."""
+
+    def __init__(self, name=None, num_thresholds=200):
+        super().__init__(name)
+        self._num_t = num_thresholds
+        self.tp = np.zeros(num_thresholds, "int64")
+        self.fp = np.zeros(num_thresholds, "int64")
+        self.tn = np.zeros(num_thresholds, "int64")
+        self.fn = np.zeros(num_thresholds, "int64")
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        pos_score = preds[:, -1] if preds.ndim == 2 else preds
+        labels = np.asarray(labels).reshape(-1)
+        thresholds = (np.arange(self._num_t) + 1.0) / (self._num_t + 1.0)
+        above = pos_score[None, :] >= thresholds[:, None]
+        is_pos = (labels > 0)[None, :]
+        self.tp += (above & is_pos).sum(1)
+        self.fp += (above & ~is_pos).sum(1)
+        self.fn += (~above & is_pos).sum(1)
+        self.tn += (~above & ~is_pos).sum(1)
+
+    def eval(self):
+        tpr = self.tp / np.maximum(self.tp + self.fn, 1)
+        fpr = self.fp / np.maximum(self.fp + self.tn, 1)
+        return abs(float(np.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2)))
+
+
+class ChunkEvaluator(MetricBase):
+    """Chunk F1 from per-batch (num_infer, num_label, num_correct)
+    (<- metrics.py ChunkEvaluator)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

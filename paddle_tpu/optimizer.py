@@ -110,6 +110,9 @@ class Optimizer:
             if getattr(p, "_param_attr", None) is None or p._param_attr.trainable
         ]
         self._apply_regularization(loss.block, params_grads)
+        from .clip import append_gradient_clip_ops
+
+        params_grads = append_gradient_clip_ops(loss.block, params_grads)
         program = loss.block.program
         self._create_global_learning_rate(program, startup)
         block = program.global_block()

@@ -1,0 +1,99 @@
+"""Profiler: host events + device traces (<- python/paddle/fluid/profiler.py
+and platform/profiler.{h,cc} / device_tracer CUPTI integration).
+
+The contract is the reference's — annotate regions, collect a per-event
+min/max/avg table, dump a timeline a browser can open — re-based on
+``jax.profiler``: device-side tracing produces a TensorBoard/perfetto trace
+(the Chrome-trace analogue of tools/timeline.py), host-side RecordEvent keeps
+the aggregate table that EnableProfiler/DisableProfiler printed.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+
+_events: Dict[str, List[float]] = defaultdict(list)
+_enabled = False
+_trace_dir: Optional[str] = None
+
+
+class RecordEvent:
+    """RAII region annotation (<- platform/profiler.h RecordEvent). Also
+    pushes a jax named scope so the region shows up in device traces."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = 0.0
+        self._scope = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._scope = jax.named_scope(self.name)
+        self._scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._scope.__exit__(*exc)
+        if _enabled:
+            _events[self.name].append(time.perf_counter() - self._t0)
+        return False
+
+
+def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
+    """<- profiler.py start_profiler. state kept for API parity ('CPU'/'GPU'/
+    'All' — device tracing is on whenever trace_dir is given)."""
+    global _enabled, _trace_dir
+    _enabled = True
+    if trace_dir:
+        _trace_dir = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    """<- profiler.py stop_profiler: stop tracing, print/append the table."""
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir:
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    table = summary(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table)
+    else:
+        print(table)
+
+
+def reset_profiler():
+    """<- profiler.py reset_profiler."""
+    _events.clear()
+
+
+def summary(sorted_key: str = "total") -> str:
+    rows = []
+    for name, times in _events.items():
+        rows.append((name, len(times), sum(times), min(times), max(times),
+                     sum(times) / len(times)))
+    key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}.get(sorted_key, 2)
+    rows.sort(key=lambda r: -r[key_idx])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Min(s)':>10}"
+             f"{'Max(s)':>10}{'Ave(s)':>10}"]
+    for r in rows:
+        lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>12.6f}{r[3]:>10.6f}"
+                     f"{r[4]:>10.6f}{r[5]:>10.6f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None, trace_dir: Optional[str] = None):
+    """<- profiler.py profiler context manager."""
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,195 @@
+"""Reader combinators (<- python/paddle/reader/decorator.py:29-208).
+
+A reader is a zero-arg callable returning an iterator of samples. Combinators
+wrap readers into new readers — identical contract to the reference, so user
+data pipelines port unchanged.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Callable, Iterable, List
+
+
+def map_readers(func, *readers):
+    """<- decorator.py map_readers."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """<- decorator.py shuffle: buffered reservoir shuffle."""
+
+    def shuffled_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    """<- decorator.py chain: concatenate readers."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    """<- decorator.py compose: zip readers into tuple samples."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        iterators = [iter(r) for r in rs]
+        while True:
+            outputs = []
+            done = 0
+            for it in iterators:
+                try:
+                    outputs.append(next(it))
+                except StopIteration:
+                    done += 1
+                    outputs.append(None)
+            if done:
+                if check_alignment and 0 < done < len(iterators):
+                    raise RuntimeError("readers of compose have different lengths")
+                return
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size: int):
+    """<- decorator.py buffered: background-thread prefetch queue."""
+
+    end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is end:
+                return
+            yield sample
+
+    return buffered_reader
+
+
+def batch(reader, batch_size: int, drop_last: bool = True):
+    """<- python/paddle/batch.py: group samples into lists."""
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def firstn(reader, n: int):
+    """<- decorator.py firstn."""
+
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory."""
+    all_data: List = []
+    filled = [False]
+
+    def cached_reader():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        yield from all_data
+
+    return cached_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """<- decorator.py xmap_readers: parallel map via worker threads."""
+    end = object()
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader

@@ -1,0 +1,70 @@
+"""Sequence batching: bucketing + padding to the dense (values, lengths)
+representation (the data-layer half of the LoDTensor redesign, SURVEY.md §5.7).
+
+Replaces the reference's LoD construction in DataFeeder: ragged samples are
+bucketed by length (to bound padding waste and retrace count — each bucket's
+max_len is a static shape for XLA) and padded into [batch, max_len] arrays
+with an explicit lengths vector.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Sequence
+
+import numpy as np
+
+
+def pad_batch(samples: Sequence[Sequence[int]], max_len=None, pad_value=0,
+              dtype="int64"):
+    """Ragged list -> (padded [N, T], lengths [N])."""
+    lengths = np.asarray([len(s) for s in samples], "int32")
+    t = int(max_len or max(1, lengths.max(initial=1)))
+    out = np.full((len(samples), t), pad_value, dtype)
+    for i, s in enumerate(samples):
+        trunc = min(len(s), t)
+        out[i, :trunc] = np.asarray(s[:trunc], dtype)
+        lengths[i] = trunc
+    return out, lengths
+
+
+def pad_batch_reader(reader, batch_size: int, buckets: Sequence[int] = (16, 32, 64),
+                     pad_value=0, drop_last: bool = True, sort_within: bool = True):
+    """Batch a reader of variable-length int sequences (or (seq, label)
+    tuples) into padded arrays, bucketed by length.
+
+    Yields dicts {"ids", "length"} (+ "label" when samples are tuples).
+    Bucketing keeps the set of distinct max_len shapes small so the executor
+    compiles one XLA program per bucket instead of per batch.
+    """
+    buckets = sorted(buckets)
+
+    def bucket_of(n):
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
+
+    def padded_reader():
+        pools: dict = {b: [] for b in buckets}
+        for sample in reader():
+            seq, label = (sample if isinstance(sample, tuple) else (sample, None))
+            b = bucket_of(len(seq))
+            pools[b].append((seq, label))
+            if len(pools[b]) == batch_size:
+                yield _emit(pools[b], b, pad_value)
+                pools[b] = []
+        if not drop_last:
+            for b, pool in pools.items():
+                if pool:
+                    yield _emit(pool, b, pad_value)
+
+    return padded_reader
+
+
+def _emit(pool, max_len, pad_value):
+    seqs = [s for s, _ in pool]
+    ids, lengths = pad_batch(seqs, max_len=max_len, pad_value=pad_value)
+    out = {"ids": ids, "length": lengths}
+    labels = [l for _, l in pool]
+    if labels[0] is not None:
+        out["label"] = np.asarray(labels, "int64").reshape(-1, 1)
+    return out

@@ -1,0 +1,160 @@
+"""Model IO: persistables, inference export, checkpoint rotation (SURVEY.md §5.4)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+
+
+def _model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    return main, startup, pred, loss
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup, pred, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    X = np.random.randn(8, 4).astype("float32")
+    Y = np.random.randint(0, 3, (8, 1)).astype("int64")
+    exe.run(main, feed={"x": X, "label": Y}, fetch_list=[loss], scope=scope)
+    io.save_persistables(exe, str(tmp_path / "model"), main, scope=scope)
+
+    scope2 = fluid.Scope()
+    io.load_persistables(exe, str(tmp_path / "model"), main, scope=scope2)
+    for v in main.list_vars():
+        if v.persistable:
+            np.testing.assert_array_equal(
+                np.asarray(scope.get(v.name)), np.asarray(scope2.get(v.name)))
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup, pred, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    X = np.random.randn(4, 4).astype("float32")
+    ref = exe.run(main.clone(for_test=True), feed={"x": X, "label": np.zeros((4, 1), "int64")},
+                  fetch_list=[pred], scope=scope)[0]
+    io.save_inference_model(str(tmp_path / "infer"), ["x"], [pred], exe, main,
+                            scope=scope)
+    prog, feeds, fetches = io.load_inference_model(str(tmp_path / "infer"), exe,
+                                                   scope=fluid.Scope())
+    scope3 = fluid.Scope()
+    prog2, feeds2, fetches2 = io.load_inference_model(str(tmp_path / "infer"), exe,
+                                                      scope=scope3)
+    assert feeds2 == ["x"]
+    out = exe.run(prog2, feed={"x": X}, fetch_list=fetches2, scope=scope3)[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # pruned program should not contain the optimizer/backward ops
+    types = [op.type for op in prog2.global_block().ops]
+    assert "sgd" not in types and not any(t.endswith("_grad") for t in types)
+
+
+def test_checkpoint_rotation_and_resume(tmp_path):
+    main, startup, pred, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    ckpt = str(tmp_path / "ckpts")
+    X = np.random.randn(8, 4).astype("float32")
+    Y = np.random.randint(0, 3, (8, 1)).astype("int64")
+    for step in range(5):
+        exe.run(main, feed={"x": X, "label": Y}, fetch_list=[], scope=scope)
+        io.save_checkpoint(exe, ckpt, main_program=main, scope=scope,
+                           max_num_checkpoints=3)
+    dirs = sorted(os.listdir(ckpt))
+    assert len(dirs) == 3  # rotation keeps last 3
+    serial = io.load_checkpoint(exe, ckpt, main, scope=fluid.Scope())
+    assert serial == 4
+
+
+def test_reader_decorators_and_padding():
+    from paddle_tpu import reader as rd
+
+    base = lambda: iter(range(10))
+    assert list(rd.firstn(base, 3)()) == [0, 1, 2]
+    assert sorted(rd.shuffle(base, 5)()) == list(range(10))
+    assert list(rd.chain(base, base)()) == list(range(10)) * 2
+    assert list(rd.map_readers(lambda a, b: a + b, base, base)()) == [
+        2 * i for i in range(10)]
+    batches = list(rd.batch(base, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    assert list(rd.buffered(base, 2)()) == list(range(10))
+    doubled = sorted(rd.xmap_readers(lambda x: x * 2, base, 2, 4)())
+    assert doubled == [2 * i for i in range(10)]
+
+    seqs = lambda: iter([([1, 2, 3], 0), ([4] * 20, 1), ([5, 6], 0), ([7] * 30, 1)])
+    out = list(rd.pad_batch_reader(seqs, 2, buckets=(4, 32), drop_last=False)())
+    assert all(o["ids"].shape[1] in (4, 32) for o in out)
+    total = sum(o["ids"].shape[0] for o in out)
+    assert total == 4
+
+
+def test_metrics_and_datasets():
+    from paddle_tpu import dataset, metrics
+
+    m = metrics.Accuracy()
+    m.update(0.5, 10)
+    m.update(1.0, 10)
+    assert abs(m.eval() - 0.75) < 1e-9
+
+    sample = next(dataset.mnist.train()())
+    assert sample[0].shape == (784,) and 0 <= sample[1] < 10
+    f, p = next(dataset.uci_housing.train()())
+    assert f.shape == (13,) and p.shape == (1,)
+    toks, label = next(dataset.imdb.train()())
+    assert isinstance(toks, list) and label in (0, 1)
+
+
+def test_gradient_clip_by_global_norm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3)
+        loss = fluid.layers.mean(y)
+        fluid.clip.set_gradient_clip(fluid.clip.GradientClipByGlobalNorm(0.01))
+        fluid.optimizer.SGD(1.0).minimize(loss, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert "squared_l2_norm" in types and "sqrt" in types
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    wname = next(p.name for p in main.global_block().all_parameters()
+                 if len(p.shape or ()) == 2)
+    w_before = np.asarray(scope.get(wname)).copy()
+    exe.run(main, feed={"x": np.ones((4, 4), "float32") * 100}, fetch_list=[],
+            scope=scope)
+    w_after = np.asarray(scope.get(wname))
+    # update magnitude bounded by lr * clip_norm
+    assert np.linalg.norm(w_after - w_before) <= 0.011
+
+
+def test_lr_scheduler_decays():
+    from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(y)
+        lr = lrs.exponential_decay(0.1, decay_steps=1, decay_rate=0.5)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    lrs_seen = []
+    for _ in range(3):
+        (lv,) = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[lr], scope=scope)
+        lrs_seen.append(float(lv))
+    np.testing.assert_allclose(lrs_seen, [0.05, 0.025, 0.0125], rtol=1e-5)
