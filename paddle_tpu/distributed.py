@@ -1,0 +1,83 @@
+"""Multi-host bootstrap + role environment.
+
+<- the reference's two bootstrap planes (SURVEY.md §5.8): gen_nccl_id over
+gRPC (operators/gen_nccl_id_op.cc) for collective mode, and the
+PADDLE_TRAINING_ROLE/PADDLE_PSERVER_IPS/PADDLE_TRAINER_ID env-var protocol
+(trainer.py:231) for pserver mode.
+
+On TPU both collapse into the JAX distributed runtime: one coordinator
+address, N processes, and every collective rides ICI/DCN inside compiled
+programs. This module keeps the reference's env-var names working so cluster
+launch scripts port unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+):
+    """Initialize multi-host JAX (replaces gen_nccl_id + pserver bootstrap).
+
+    Falls back to the reference's env protocol:
+      PADDLE_TRAINER_ENDPOINTS (comma list; first entry = coordinator)
+      PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID
+    or the standard JAX env vars when unset. Single-process when nothing is
+    configured (no-op).
+    """
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            coordinator_address = eps.split(",")[0]
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("PADDLE_TRAINER_ID")
+        process_id = int(pid) if pid is not None else None
+    if not coordinator_address or num_processes in (None, 1):
+        return False  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def trainer_id() -> int:
+    return jax.process_index()
+
+
+def trainer_num() -> int:
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    return jax.process_index() == 0
+
+
+class RoleMaker:
+    """<- the reference's role makers (PADDLE_TRAINING_ROLE env protocol).
+    On TPU every process is a TRAINER; the PSERVER role is extinct — sharded
+    parameters + in-program collectives replace the parameter-server plane."""
+
+    TRAINER = "TRAINER"
+
+    @property
+    def role(self) -> str:
+        return RoleMaker.TRAINER
+
+    def is_worker(self) -> bool:
+        return True
+
+    def worker_index(self) -> int:
+        return trainer_id()
+
+    def worker_num(self) -> int:
+        return trainer_num()

@@ -474,6 +474,15 @@ def dropout_prob_check(p):
         raise ValueError("dropout probability must be in [0, 1]")
 
 
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", {"X": [x]}, {"Out": [out]},
+                     {"scale": scale, "bias": bias,
+                      "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
 def clip(x, min, max, name=None):
     helper = LayerHelper("clip", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)

@@ -1,0 +1,99 @@
+"""Sparse CTR models: Wide&Deep / DeepFM.
+
+<- the DeepFM/Wide&Deep CTR workload in BASELINE.json, which in the reference
+stresses the distributed sparse lookup-table path (prefetch ops pulling rows
+from pservers, distribute_transpiler.py:685-906). TPU-native: the embedding
+table is a dense parameter **sharded on the vocab dim over the mesh**
+(ParamAttr(sharding=('dp', None)) — or 'ep' on expert meshes); GSPMD turns
+each lookup into the gather collective the pserver prefetch implemented by
+hand, and the scatter-add gradient stays sharded (the SelectedRows path).
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def wide_deep_ctr(
+    sparse_ids,
+    dense_feats,
+    label,
+    sparse_vocab: int = 10000,
+    embed_dim: int = 16,
+    hidden_sizes=(64, 32),
+    shard_embeddings: bool = True,
+):
+    """sparse_ids: [N, S] int64 (S slots), dense_feats: [N, D] float32,
+    label: [N, 1] float32 in {0,1}. Returns (avg_loss, prob, auc_var)."""
+    emb_attr = ParamAttr(
+        name="ctr_embedding",
+        sharding=("dp", None) if shard_embeddings else None,
+    )
+    emb = layers.embedding(sparse_ids, size=[sparse_vocab, embed_dim],
+                           param_attr=emb_attr)  # [N, S, E]
+    n_slots = int(sparse_ids.shape[1])
+    deep_in = layers.reshape(emb, [0, n_slots * embed_dim])
+
+    # deep tower
+    deep = layers.concat([deep_in, dense_feats], axis=1)
+    for h in hidden_sizes:
+        deep = layers.fc(deep, size=h, act="relu")
+
+    # wide tower: linear over dense + 1-dim sparse embeddings
+    wide_emb = layers.embedding(sparse_ids, size=[sparse_vocab, 1],
+                                param_attr=ParamAttr(name="ctr_wide_embedding"))
+    wide_sparse = layers.reshape(wide_emb, [0, n_slots])
+    wide = layers.concat([wide_sparse, dense_feats], axis=1)
+
+    both = layers.concat([deep, wide], axis=1)
+    logit = layers.fc(both, size=1, act=None)
+    prob = layers.sigmoid(logit)
+    loss = layers.sigmoid_cross_entropy_with_logits(logit, label)
+    avg_loss = layers.mean(loss)
+    return avg_loss, prob
+
+
+def deepfm_ctr(
+    sparse_ids,
+    dense_feats,
+    label,
+    sparse_vocab: int = 10000,
+    embed_dim: int = 16,
+    hidden_sizes=(64, 32),
+    shard_embeddings: bool = True,
+):
+    """DeepFM: first-order + pairwise FM interactions + deep tower."""
+    emb_attr = ParamAttr(
+        name="deepfm_embedding",
+        sharding=("dp", None) if shard_embeddings else None,
+    )
+    emb = layers.embedding(sparse_ids, size=[sparse_vocab, embed_dim],
+                           param_attr=emb_attr)  # [N, S, E]
+    n_slots = int(sparse_ids.shape[1])
+
+    # first order
+    first = layers.embedding(sparse_ids, size=[sparse_vocab, 1],
+                             param_attr=ParamAttr(name="deepfm_first_order"))
+    first = layers.reduce_sum(layers.reshape(first, [0, n_slots]), dim=1,
+                              keep_dim=True)
+
+    # FM second order: 0.5 * ((sum e)^2 - sum e^2)
+    sum_emb = layers.reduce_sum(emb, dim=1)  # [N, E]
+    sum_sq = layers.elementwise_mul(sum_emb, sum_emb)
+    sq = layers.elementwise_mul(emb, emb)
+    sq_sum = layers.reduce_sum(sq, dim=1)
+    fm = layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=1,
+                           keep_dim=True)
+    fm = layers.scale(fm, scale=0.5)
+
+    deep = layers.reshape(emb, [0, n_slots * embed_dim])
+    deep = layers.concat([deep, dense_feats], axis=1)
+    for h in hidden_sizes:
+        deep = layers.fc(deep, size=h, act="relu")
+    deep_logit = layers.fc(deep, size=1, act=None)
+
+    logit = layers.elementwise_add(layers.elementwise_add(first, fm), deep_logit)
+    prob = layers.sigmoid(logit)
+    loss = layers.sigmoid_cross_entropy_with_logits(logit, label)
+    avg_loss = layers.mean(loss)
+    return avg_loss, prob

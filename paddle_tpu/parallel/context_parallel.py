@@ -1,0 +1,113 @@
+"""Sequence/context parallelism: ring attention over the 'sp' mesh axis.
+
+The reference has NO long-context machinery (SURVEY.md §5.7) — this is the
+TPU-native capability that replaces it at scale: shard the sequence dim over
+the mesh's 'sp' axis and compute exact attention by rotating K/V blocks
+around the ring with ``lax.ppermute`` while accumulating a numerically-stable
+online softmax (flash-attention style log-sum-exp merging). Compute on the
+current block overlaps with the ICI transfer of the next; memory per device
+is O(T/sp). Gradients flow through ppermute, so jax.grad of the sharded
+function is the ring-attention backward.
+
+Public entry points:
+  dense_attention(q, k, v, mask)        — single-device reference
+  ring_attention(q, k, v, mesh, axis)   — shard_map'ed exact equivalent
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def dense_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """q,k,v: [B, T, H, D]. Plain softmax attention (the oracle)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _ring_block(q, k, v, scale, q_offset, k_offset, causal):
+    """Partial attention of local q against one k/v block with running
+    (out, max, denom) statistics."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        qi = q_offset + jnp.arange(tq)[:, None]
+        ki = k_offset + jnp.arange(tk)[None, :]
+        logits = jnp.where(qi >= ki, logits, jnp.finfo(logits.dtype).min)
+    m = jnp.max(logits, axis=-1)  # [B, H, Tq]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [B, H, Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def _merge(acc, new):
+    """Log-sum-exp merge of two partial attention accumulators."""
+    o1, m1, l1 = acc
+    o2, m2, l2 = new
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    # o carries [B, T, H, D]; stats are [B, H, T] -> align axes
+    o = o1 * jnp.moveaxis(a1, 1, 2)[..., None] + o2 * jnp.moveaxis(a2, 1, 2)[..., None]
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None,
+                   batch_axis: Optional[str] = None):
+    """Exact attention with the sequence dim sharded over ``axis``.
+
+    q,k,v: [B, T, H, D] global arrays (or shardings compatible with
+    P(batch_axis, axis, None, None)). Returns [B, T, H, D] with the same
+    sharding as q.
+    """
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    n_shards = mesh.shape[axis]
+    t_local = q.shape[1] // n_shards
+    spec = P(batch_axis, axis, None, None)
+
+    def local_fn(q, k, v):
+        # q,k,v: local shards [B, T/sp, H, D]
+        idx = lax.axis_index(axis)
+        q_off = idx * t_local
+        neg = jnp.finfo(q.dtype).min
+        o0 = jnp.zeros_like(q)
+        m0 = jnp.full(q.shape[:1] + (q.shape[2], q.shape[1]), neg, q.dtype)
+        l0 = jnp.zeros_like(m0)
+        perm = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+
+        def body(i, carry):
+            acc, kv = carry
+            k_i, v_i = kv
+            # block i currently resident came from shard (idx + i) % n
+            src = (idx + i) % n_shards
+            o, m, l = _ring_block(q, k_i, v_i, sc, q_off, src * t_local, causal)
+            acc = _merge(acc, (o, m, l))
+            # rotate k/v around the ring for the next iteration
+            k_n = lax.ppermute(k_i, axis, perm)
+            v_n = lax.ppermute(v_i, axis, perm)
+            return acc, (k_n, v_n)
+
+        (o, m, l), _ = lax.fori_loop(0, n_shards, body, ((o0, m0, l0), (k, v)))
+        denom = jnp.moveaxis(l, 1, 2)[..., None]
+        return o / jnp.maximum(denom, 1e-20)
+
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,3 @@
+from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .inference_transpiler import InferenceTranspiler  # noqa: F401
+from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401

@@ -1,0 +1,131 @@
+"""DistributeTranspiler: program -> distributed program.
+
+<- python/paddle/fluid/transpiler/distribute_transpiler.py:112. The reference
+rewrites one program into trainer programs (send/recv ops) + pserver programs
+(listen_and_serv with per-param optimize blocks), slicing parameters into
+blocks round-robined over pservers (slice_variable :66).
+
+TPU-native re-expression: there is no pserver plane. "Transpiling" becomes
+choosing *shardings*:
+
+* sync pserver mode  -> ZeRO-style parameter sharding over the 'dp' axis
+  (each device owns a param shard = the pserver block that lived on one
+  server; reduce_scatter/all_gather over ICI replace send/recv+barriers,
+  inserted by GSPMD inside the compiled step).
+* distributed (sparse) lookup tables -> embedding tables sharded on the
+  vocab dim (see slice_vars_round_robin for the same block-split math as
+  the reference); the gather/scatter-add collectives replace prefetch ops.
+* async mode has no collective analogue and is intentionally dropped
+  (documented deviation, SURVEY.md §7.7).
+
+The class keeps the reference's call surface (transpile / get_trainer_program
+/ get_pserver_program) so migration is mechanical.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+from ..core.ir import Program, default_main_program
+
+
+class DistributeTranspilerConfig:
+    """<- transpiler config: slice_var_up, min_block_size kept for parity."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.min_block_size = 8192
+        self.mode = "collective"  # the only mode on TPU
+
+
+def slice_vars_round_robin(var_shapes, num_parts: int, min_block_size: int = 8192):
+    """Reference block-split math (<- slice_variable, distribute_transpiler.py:66):
+    returns per-var list of (part_idx, offset, size) along dim 0."""
+    out = {}
+    for name, shape in var_shapes.items():
+        total = 1
+        for d in shape:
+            total *= d
+        if not shape or total < min_block_size * num_parts:
+            out[name] = [(0, 0, shape[0] if shape else 1)]
+            continue
+        rows = shape[0]
+        per = int(math.ceil(rows / num_parts))
+        parts = []
+        off = 0
+        i = 0
+        while off < rows:
+            size = min(per, rows - off)
+            parts.append((i % num_parts, off, size))
+            off += size
+            i += 1
+        out[name] = parts
+    return out
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program: Optional[Program] = None
+        self.trainer_id = 0
+        self.trainers = 1
+
+    def transpile(
+        self,
+        trainer_id: int,
+        program: Optional[Program] = None,
+        pservers: str = "",
+        trainers: int = 1,
+        sync_mode: bool = True,
+        startup_program: Optional[Program] = None,
+    ):
+        """Annotate the program for collective execution.
+
+        ``pservers`` is accepted for API parity; its host list is ignored —
+        the device mesh (ParallelExecutor's 'dp' axis spanning all hosts'
+        chips) plays that role. sync_mode=False raises: async SGD has no
+        sound collective analogue (deviation documented in the module
+        docstring).
+        """
+        if not sync_mode:
+            raise NotImplementedError(
+                "async pserver mode is intentionally unsupported on TPU; "
+                "use sync collective training (the default)"
+            )
+        program = program or default_main_program()
+        self._program = program
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        # ZeRO-style placement: mark every large parameter to be sharded over
+        # dp (the pserver block assignment); ParallelExecutor.param_sharding
+        # consumes this.
+        from ..param_attr import ParamAttr
+
+        for v in program.global_block().all_parameters():
+            if v.shape and len(v.shape) >= 1 and v.shape[0] >= trainers:
+                attr = getattr(v, "_param_attr", None) or ParamAttr()
+                if attr.sharding is None:
+                    attr.sharding = ("dp",) + (None,) * (len(v.shape) - 1)
+                v._param_attr = attr
+        return self
+
+    def get_trainer_program(self) -> Program:
+        """All trainers run the same sharded program (SPMD)."""
+        assert self._program is not None, "call transpile() first"
+        return self._program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        raise NotImplementedError(
+            "there are no parameter servers on TPU: parameters are sharded "
+            "across the mesh and updated in-program via XLA collectives. "
+            "Run get_trainer_program() on every host instead."
+        )
+
+    get_pserver_programs = get_pserver_program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        raise NotImplementedError(
+            "pserver startup programs do not exist on TPU; run the normal "
+            "startup program on every host"
+        )

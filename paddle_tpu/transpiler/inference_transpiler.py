@@ -1,0 +1,57 @@
+"""InferenceTranspiler: program+weights rewrites for inference.
+
+<- python/paddle/fluid/transpiler/inference_transpiler.py: its headline pass
+folds batch_norm into the preceding conv (fuse_batch_norm), mutating both the
+program and the parameter values in scope. Same pass here on our IR/scope.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.executor import Scope
+from ..core.ir import Program
+
+
+class InferenceTranspiler:
+    def transpile(self, program: Program, place=None, scope: Scope = None):
+        """Fold conv2d + batch_norm(is_test) into conv2d with adjusted
+        weights/bias. Mutates ``program`` and ``scope`` in place."""
+        assert scope is not None, "InferenceTranspiler needs the scope holding weights"
+        block = program.global_block()
+        ops = block.ops
+        i = 0
+        while i < len(ops) - 1:
+            op = ops[i]
+            nxt = ops[i + 1]
+            if (op.type == "conv2d" and nxt.type == "batch_norm"
+                    and op.output("Output") and nxt.input("X")
+                    and op.output("Output")[0] == nxt.input("X")[0]):
+                self._fold(block, op, nxt, scope)
+                # batch_norm's Y replaces conv output var
+                op.outputs["Output"] = [nxt.output("Y")[0]]
+                del ops[i + 1]
+                program._bump_version()
+            i += 1
+        return program
+
+    def _fold(self, block, conv_op, bn_op, scope: Scope):
+        w_name = conv_op.input("Filter")[0]
+        scale = np.asarray(scope.get(bn_op.input("Scale")[0]))
+        bias = np.asarray(scope.get(bn_op.input("Bias")[0]))
+        mean = np.asarray(scope.get(bn_op.input("Mean")[0]))
+        var = np.asarray(scope.get(bn_op.input("Variance")[0]))
+        eps = bn_op.attr("epsilon", 1e-5)
+        w = np.asarray(scope.get(w_name))
+        inv = scale / np.sqrt(var + eps)
+        scope.set(w_name, (w * inv[:, None, None, None]).astype(w.dtype))
+        new_bias = (bias - mean * inv).astype(w.dtype)
+        if conv_op.input("Bias"):
+            b_name = conv_op.input("Bias")[0]
+            old = np.asarray(scope.get(b_name))
+            scope.set(b_name, (old * inv + new_bias).astype(w.dtype))
+        else:
+            b_name = w_name + ".bn_folded_bias"
+            block.create_var(b_name, dtype=block.var(w_name).dtype,
+                             shape=new_bias.shape, persistable=True)
+            scope.set(b_name, new_bias)
+            conv_op.inputs["Bias"] = [b_name]

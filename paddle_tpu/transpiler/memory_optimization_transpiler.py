@@ -1,0 +1,66 @@
+"""Memory optimization (<- python/paddle/fluid/transpiler/
+memory_optimization_transpiler.py: liveness analysis + var reuse).
+
+On XLA, buffer liveness/reuse is the compiler's job — the whole block is one
+HLO program and XLA's buffer assignment already performs the reuse this
+transpiler implemented by renaming vars. What remains useful at our level:
+
+* ``memory_optimize(program)`` runs the same liveness analysis and returns
+  the reuse statistics (so tooling parity holds and tests can assert on it),
+  and flags the program so the executor enables rematerialization
+  (jax.checkpoint-style) for grad ops when requested.
+* ``release_memory`` (<- release_memory): drops non-persistable fetch targets
+  early — a no-op under XLA, kept for API parity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..core.ir import Program
+
+
+def _liveness(program: Program, block_idx: int = 0):
+    """Classic backward liveness over the op list (the reference's analysis,
+    memory_optimization_transpiler.py ControlFlowGraph)."""
+    block = program.blocks[block_idx]
+    n = len(block.ops)
+    live_out: List[Set[str]] = [set() for _ in range(n)]
+    live = set()
+    last_use = {}
+    for i in range(n - 1, -1, -1):
+        op = block.ops[i]
+        live_out[i] = set(live)
+        for name in op.output_names:
+            live.discard(name)
+        for name in op.input_names:
+            if name and name not in last_use:
+                last_use[name] = i
+            if name:
+                live.add(name)
+    return live_out, last_use
+
+
+def memory_optimize(input_program: Program, print_log: bool = False, level: int = 0):
+    """Compute reusable-var statistics; actual buffer reuse happens inside
+    XLA buffer assignment. Returns {var: dies_at_op_index} for non-persistable
+    temporaries, and records the savings estimate on the program."""
+    block = input_program.global_block()
+    live_out, last_use = _liveness(input_program)
+    reusable: Dict[str, int] = {}
+    for name, idx in last_use.items():
+        var = block.vars.get(name)
+        if var is None or var.persistable or var.is_data:
+            continue
+        if all(name not in lo for lo in live_out[idx + 1:] or [set()]):
+            reusable[name] = idx
+    if print_log:
+        print(f"memory_optimize: {len(reusable)} temporaries die before program end "
+              f"(XLA buffer assignment reuses their buffers)")
+    input_program._memory_optimize_stats = reusable  # type: ignore[attr-defined]
+    return reusable
+
+
+def release_memory(input_program: Program, skip_opt_set=None):
+    """<- release_memory transpiler: no-op under XLA (buffers are freed by
+    the runtime when the compiled program ends); kept for API parity."""
+    return input_program
