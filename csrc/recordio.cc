@@ -1,0 +1,239 @@
+// RecordIO: chunked binary record file format + threaded prefetch loader.
+//
+// Native re-implementation of the reference's recordio library
+// (paddle/fluid/recordio/{header,chunk,writer,scanner}.h, ~710 LoC) and the
+// prefetching side of the reader op stack
+// (operators/reader/create_double_buffer_reader_op.cc:39 — a background
+// thread filling a blocking queue; operators/reader/blocking_queue.h).
+//
+// File layout:
+//   [8-byte magic "PTRIO\x01\0\0"]
+//   chunk*:
+//     u32 num_records | u32 payload_len | u32 crc32(payload) | payload
+//     payload = (u32 record_len | bytes)*
+//
+// Exposed as a C API consumed from Python via ctypes
+// (paddle_tpu/recordio.py). No Python objects cross the boundary: records
+// are length-prefixed byte buffers.
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'T', 'R', 'I', 'O', 1, 0, 0};
+constexpr uint32_t kDefaultChunkRecords = 1000;
+constexpr size_t kDefaultChunkBytes = 1 << 20;
+
+// --- crc32 (IEEE, table-driven) ------------------------------------------
+uint32_t crc_table[256];
+bool crc_init_done = [] {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  return true;
+}();
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<uint8_t> payload;
+  uint32_t num_records = 0;
+  uint32_t max_records = kDefaultChunkRecords;
+  size_t max_bytes = kDefaultChunkBytes;
+
+  void flush_chunk() {
+    if (num_records == 0) return;
+    uint32_t len = static_cast<uint32_t>(payload.size());
+    uint32_t crc = crc32(payload.data(), payload.size());
+    fwrite(&num_records, 4, 1, f);
+    fwrite(&len, 4, 1, f);
+    fwrite(&crc, 4, 1, f);
+    fwrite(payload.data(), 1, payload.size(), f);
+    payload.clear();
+    num_records = 0;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<uint8_t> chunk;       // current decoded payload
+  size_t pos = 0;                   // cursor within chunk
+  uint32_t remaining = 0;           // records left in current chunk
+  std::string error;
+
+  bool load_chunk() {
+    uint32_t hdr[3];
+    if (fread(hdr, 4, 3, f) != 3) return false;  // EOF
+    chunk.resize(hdr[1]);
+    if (fread(chunk.data(), 1, hdr[1], f) != hdr[1]) {
+      error = "truncated chunk";
+      return false;
+    }
+    if (crc32(chunk.data(), chunk.size()) != hdr[2]) {
+      error = "crc mismatch";
+      return false;
+    }
+    remaining = hdr[0];
+    pos = 0;
+    return true;
+  }
+};
+
+// --- threaded prefetch loader --------------------------------------------
+struct Loader {
+  std::deque<std::vector<uint8_t>> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  size_t capacity = 64;
+  bool done = false;
+  bool closed = false;
+  std::thread worker;
+  std::vector<uint8_t> current;  // last record handed to the consumer
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      closed = true;
+    }
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    if (worker.joinable()) worker.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- writer ----
+void* rio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  fwrite(kMagic, 1, 8, f);
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int rio_write(void* handle, const uint8_t* data, uint32_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  if (!w || !w->f) return -1;
+  uint32_t l = len;
+  const uint8_t* lp = reinterpret_cast<const uint8_t*>(&l);
+  w->payload.insert(w->payload.end(), lp, lp + 4);
+  w->payload.insert(w->payload.end(), data, data + len);
+  w->num_records++;
+  if (w->num_records >= w->max_records || w->payload.size() >= w->max_bytes)
+    w->flush_chunk();
+  return 0;
+}
+
+void rio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  if (!w) return;
+  w->flush_chunk();
+  fclose(w->f);
+  delete w;
+}
+
+// ---- scanner ----
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[8];
+  if (fread(magic, 1, 8, f) != 8 || memcmp(magic, kMagic, 8) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// Returns pointer to record bytes (valid until next call) or nullptr at EOF/
+// error; *len receives the record size.
+const uint8_t* rio_next(void* handle, uint32_t* len) {
+  auto* s = static_cast<Scanner*>(handle);
+  if (!s) return nullptr;
+  while (s->remaining == 0) {
+    if (!s->load_chunk()) return nullptr;
+  }
+  uint32_t l;
+  memcpy(&l, s->chunk.data() + s->pos, 4);
+  const uint8_t* rec = s->chunk.data() + s->pos + 4;
+  s->pos += 4 + l;
+  s->remaining--;
+  *len = l;
+  return rec;
+}
+
+const char* rio_scanner_error(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  return s ? s->error.c_str() : "null scanner";
+}
+
+void rio_scanner_close(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  if (!s) return;
+  fclose(s->f);
+  delete s;
+}
+
+// ---- prefetch loader ----
+void* rio_loader_open(const char* path, uint32_t capacity) {
+  auto* ld = new Loader();
+  if (capacity) ld->capacity = capacity;
+  std::string p(path);
+  ld->worker = std::thread([ld, p]() {
+    void* sc = rio_scanner_open(p.c_str());
+    if (sc) {
+      uint32_t len;
+      const uint8_t* rec;
+      while ((rec = rio_next(sc, &len)) != nullptr) {
+        std::unique_lock<std::mutex> lk(ld->mu);
+        ld->cv_push.wait(lk, [ld] {
+          return ld->closed || ld->queue.size() < ld->capacity;
+        });
+        if (ld->closed) break;
+        ld->queue.emplace_back(rec, rec + len);
+        ld->cv_pop.notify_one();
+      }
+      rio_scanner_close(sc);
+    }
+    std::lock_guard<std::mutex> g(ld->mu);
+    ld->done = true;
+    ld->cv_pop.notify_all();
+  });
+  return ld;
+}
+
+// Blocking pop; returns nullptr when the file is exhausted.
+const uint8_t* rio_loader_next(void* handle, uint32_t* len) {
+  auto* ld = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(ld->mu);
+  ld->cv_pop.wait(lk, [ld] { return ld->done || !ld->queue.empty(); });
+  if (ld->queue.empty()) return nullptr;
+  ld->current = std::move(ld->queue.front());
+  ld->queue.pop_front();
+  ld->cv_push.notify_one();
+  *len = static_cast<uint32_t>(ld->current.size());
+  return ld->current.data();
+}
+
+void rio_loader_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"

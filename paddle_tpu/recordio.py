@@ -1,0 +1,154 @@
+"""RecordIO: python surface over the native C++ library (csrc/recordio.cc).
+
+<- python/paddle/fluid/recordio_writer.py + the recordio reader op. The C++
+side owns file IO, CRC validation, chunking, and a background prefetch
+thread; records cross the ctypes boundary as bytes. Builds the shared
+library on first use with g++ (cached under ~/.cache/paddle_tpu).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "csrc", "recordio.cc")
+_CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu")
+
+
+def _build_lib() -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so = os.path.join(_CACHE_DIR, "librecordio.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(_CSRC)):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _CSRC, "-o", so + ".tmp"],
+            check=True, capture_output=True,
+        )
+        os.replace(so + ".tmp", so)
+    return so
+
+
+def _lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build_lib())
+            lib.rio_writer_open.restype = ctypes.c_void_p
+            lib.rio_writer_open.argtypes = [ctypes.c_char_p]
+            lib.rio_write.restype = ctypes.c_int
+            lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint32]
+            lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+            lib.rio_scanner_open.restype = ctypes.c_void_p
+            lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+            lib.rio_next.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.rio_next.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint32)]
+            lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+            lib.rio_loader_open.restype = ctypes.c_void_p
+            lib.rio_loader_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+            lib.rio_loader_next.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.rio_loader_next.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.c_uint32)]
+            lib.rio_loader_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+    return _LIB
+
+
+class Writer:
+    def __init__(self, path: str):
+        self._lib = _lib()
+        self._h = self._lib.rio_writer_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path!r} for writing")
+
+    def write(self, record: bytes):
+        if self._lib.rio_write(self._h, record, len(record)) != 0:
+            raise IOError("write failed")
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class Scanner:
+    """Sequential record iterator (CRC-checked chunk by chunk)."""
+
+    def __init__(self, path: str):
+        self._lib = _lib()
+        self._h = self._lib.rio_scanner_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path!r} (missing or bad magic)")
+
+    def __iter__(self) -> Iterator[bytes]:
+        length = ctypes.c_uint32()
+        while True:
+            ptr = self._lib.rio_next(self._h, ctypes.byref(length))
+            if not ptr:
+                return
+            yield ctypes.string_at(ptr, length.value)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_scanner_close(self._h)
+            self._h = None
+
+
+class PrefetchLoader:
+    """Background C++ thread fills a bounded queue; iteration pops records
+    (<- double-buffer reader, create_double_buffer_reader_op.cc:39)."""
+
+    def __init__(self, path: str, capacity: int = 64):
+        self._lib = _lib()
+        self._h = self._lib.rio_loader_open(path.encode(), capacity)
+
+    def __iter__(self) -> Iterator[bytes]:
+        length = ctypes.c_uint32()
+        while True:
+            ptr = self._lib.rio_loader_next(self._h, ctypes.byref(length))
+            if not ptr:
+                return
+            yield ctypes.string_at(ptr, length.value)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_loader_close(self._h)
+            self._h = None
+
+
+def write_recordio(path: str, records) -> int:
+    """Convenience: dump an iterable of bytes; returns count."""
+    n = 0
+    with Writer(path) as w:
+        for r in records:
+            w.write(r)
+            n += 1
+    return n
+
+
+def recordio_reader(path: str, prefetch: bool = True):
+    """Reader-combinator-compatible factory (<- create_recordio_file_reader)."""
+
+    def reader():
+        it = PrefetchLoader(path) if prefetch else Scanner(path)
+        try:
+            yield from it
+        finally:
+            it.close()
+
+    return reader
