@@ -1,4 +1,4 @@
-"""Benchmark: ResNet-50 training throughput on one TPU chip.
+"""Benchmark: ResNet-50 training throughput on one TPU chip (AMP bf16).
 
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
@@ -18,7 +18,7 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 81.69  # BASELINE.md ResNet-50 train bs64
-BATCH = 32
+BATCH = 128
 IMAGE = 224
 CLASSES = 1000
 WARMUP = 5
@@ -40,7 +40,7 @@ def main():
             avg_cost, startup)
 
     place = fluid.default_place()
-    exe = fluid.Executor(place)
+    exe = fluid.Executor(place, amp=True)
     scope = fluid.Scope()
     exe.run(startup, scope=scope, seed=7)
 

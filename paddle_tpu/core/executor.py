@@ -151,7 +151,8 @@ def _collect_block_io(
     return reads, writes
 
 
-def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names):
+def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names,
+                  amp: bool = False):
     """Trace a block into a pure function
     ``step(feed, readonly, donated, key) -> (fetches, new_state)``.
 
@@ -170,7 +171,7 @@ def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names):
         env.update(readonly)
         env.update(donated)
         env.update(feed_vals)
-        ctx = ExecContext(key=key)
+        ctx = ExecContext(key=key, amp=amp)
         ctx.block_runner = builder
         builder.run_block(block_idx, env, ctx)
         fetches = []
@@ -187,8 +188,9 @@ def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names):
 class Executor:
     """Drop-in analogue of fluid.Executor (executor.py:222) on XLA."""
 
-    def __init__(self, place: Optional[Place] = None):
+    def __init__(self, place: Optional[Place] = None, amp: bool = False):
         self.place = place or default_place()
+        self.amp = amp
         self._device = self.place.jax_device()
         self._cache: Dict[Any, Any] = {}
         self._cache_capacity = 32
@@ -223,7 +225,8 @@ class Executor:
         feed_vals = {k: _to_device_array(v, program, k, self._device)
                      for k, v in feed.items()}
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
-        cache_key = (id(program), program.version, block_idx, sig, tuple(fetch_names))
+        cache_key = (id(program), program.version, block_idx, sig,
+                     tuple(fetch_names), self.amp)
 
         entry = self._cache.get(cache_key)
         if entry is None:
@@ -264,7 +267,7 @@ class Executor:
     # -- compilation --
     def _compile(self, program: Program, block_idx: int, feed_names, fetch_names, sig):
         step, readonly_names, donated_names, state_out_names = build_step_fn(
-            program, block_idx, feed_names, fetch_names
+            program, block_idx, feed_names, fetch_names, amp=self.amp
         )
         # donate only buffers the block overwrites (params under an optimizer):
         # their old values die with the update, so XLA can update in place in

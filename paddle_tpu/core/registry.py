@@ -41,10 +41,14 @@ class ExecContext:
     ``lax.while_loop`` / ``lax.cond`` branches.
     """
 
-    def __init__(self, key=None, block_runner=None, is_test: bool = False):
+    def __init__(self, key=None, block_runner=None, is_test: bool = False,
+                 amp: bool = False):
         self._key = key
         self.block_runner = block_runner
         self.is_test = is_test
+        # auto-mixed-precision: matmul/conv kernels compute in bf16 with f32
+        # accumulation while parameters stay f32 (the TPU-native AMP recipe)
+        self.amp = amp
 
     def next_key(self):
         if self._key is None:

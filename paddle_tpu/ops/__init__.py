@@ -16,3 +16,4 @@ from . import metrics_ops  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
+from . import pallas_attention  # noqa: F401

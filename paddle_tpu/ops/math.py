@@ -26,16 +26,26 @@ def _flatten2(x, num_col_dims):
     return x.reshape(lead, rest)
 
 
+def _amp_cast(ctx, *xs):
+    """Under AMP, feed the MXU bf16 operands (f32 accumulation is preserved
+    via preferred_element_type at the call sites)."""
+    if getattr(ctx, "amp", False):
+        return tuple(x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating)
+                     else x for x in xs)
+    return xs
+
+
 @register_op("mul", inputs=("X", "Y"), outputs=("Out",))
 def mul(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
     xnc = attrs.get("x_num_col_dims", 1)
     ync = attrs.get("y_num_col_dims", 1)
-    x2 = _flatten2(x, xnc)
-    y2 = _flatten2(y, ync)
-    out = jnp.dot(x2, y2, preferred_element_type=jnp.promote_types(x2.dtype, y2.dtype))
+    acc = jnp.promote_types(x.dtype, y.dtype)
+    x2, y2 = _amp_cast(ctx, _flatten2(x, xnc), _flatten2(y, ync))
+    out = jnp.dot(x2, y2,
+                  preferred_element_type=None if x2.dtype != acc else acc)
     out_shape = x.shape[:xnc] + y.shape[ync:]
-    return {"Out": [out.reshape(out_shape)]}
+    return {"Out": [out.reshape(out_shape).astype(acc)]}
 
 
 @register_op("matmul", inputs=("X", "Y"), outputs=("Out",))

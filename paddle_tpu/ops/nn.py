@@ -33,6 +33,14 @@ def conv2d(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    acc = jnp.promote_types(x.dtype, w.dtype)
+    amp = getattr(ctx, "amp", False) and jnp.issubdtype(acc, jnp.floating)
+    if amp:
+        # bf16 operands, bf16 result dtype (MXU still accumulates f32
+        # internally); cast back after — keeping operand/result dtypes equal
+        # keeps the conv transpose (vjp) rule happy
+        x = x.astype(jnp.bfloat16)
+        w = w.astype(jnp.bfloat16)
     out = lax.conv_general_dilated(
         x,
         w,
@@ -41,8 +49,8 @@ def conv2d(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.promote_types(x.dtype, w.dtype),
-    )
+        preferred_element_type=None if amp else acc,
+    ).astype(acc)
     if ins.get("Bias") and ins["Bias"][0] is not None:
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
     return {"Output": [out]}

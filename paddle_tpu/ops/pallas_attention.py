@@ -1,0 +1,152 @@
+"""Pallas TPU flash attention.
+
+The reference has no attention op at all — its transformer benchmark builds
+attention from matmul+softmax primitives (SURVEY.md §5.7). Here attention is
+a first-class op whose forward is a Pallas kernel: per (batch*head, q-block)
+grid cell, K/V stream through VMEM in blocks under an online-softmax
+accumulator, so the [Tq, Tk] logits matrix never materializes in HBM —
+the flash-attention memory profile the MXU wants.
+
+Backward (round 1): recompute through the dense formulation under jax.vjp —
+correct, and XLA still fuses it reasonably; a Pallas backward kernel is a
+planned optimization.
+
+On non-TPU backends the same kernel runs in interpreter mode (tests), so
+numerical behavior is identical everywhere.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..core.ir import grad_var_name
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, causal, q_block):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    n_blocks = t // block_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        o_new = o * alpha[:, None] + pv
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    o, m, l = lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None,
+                        q_block=128, k_block=128, interpret=None):
+    """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+    b, t, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    if interpret is None:
+        # interpret anywhere except a real TPU (jax.default_device overrides
+        # the backend the computation actually lands on)
+        dev = jax.config.jax_default_device
+        platform = dev.platform if dev is not None else jax.default_backend()
+        interpret = platform != "tpu"
+    q_block = min(q_block, t)
+    k_block = min(k_block, t)
+    if t % q_block or t % k_block:
+        # ragged tail: fall back to the dense path
+        from ..parallel.context_parallel import dense_attention
+
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+
+    qh = jnp.moveaxis(q, 2, 1).reshape(b * h, t, d)
+    kh = jnp.moveaxis(k, 2, 1).reshape(b * h, t, d)
+    vh = jnp.moveaxis(v, 2, 1).reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_kernel, scale=sc, block_k=k_block,
+                               causal=causal, q_block=q_block)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // q_block),
+        in_specs=[
+            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
+
+
+def _flash_grad_maker(op, no_grad_set):
+    return [{
+        "type": "flash_attention_grad",
+        "inputs": {
+            "Q": list(op.inputs["Q"]),
+            "K": list(op.inputs["K"]),
+            "V": list(op.inputs["V"]),
+            "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]],
+        },
+        "outputs": {
+            s + "@GRAD": ["" if n in no_grad_set else grad_var_name(n)
+                          for n in op.inputs[s]]
+            for s in ("Q", "K", "V")
+        },
+        "attrs": dict(op.attrs),
+    }]
+
+
+@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
+             grad_maker=_flash_grad_maker)
+def flash_attention_op(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    return {"Out": [flash_attention_fwd(
+        q, k, v,
+        causal=attrs.get("causal", False),
+        scale=attrs.get("scale"),
+        q_block=attrs.get("q_block", 128),
+        k_block=attrs.get("k_block", 128),
+    )]}
+
+
+@register_op("flash_attention_grad",
+             inputs=("Q", "K", "V", "Out@GRAD"),
+             outputs=("Q@GRAD", "K@GRAD", "V@GRAD"), no_grad=True)
+def flash_attention_grad_op(ctx, ins, attrs):
+    """Backward: dense recompute under jax.vjp (flash bwd kernel planned)."""
+    from ..parallel.context_parallel import dense_attention
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    g = ins["Out@GRAD"][0]
+    _, vjp = jax.vjp(
+        lambda q, k, v: dense_attention(q, k, v,
+                                        causal=attrs.get("causal", False),
+                                        scale=attrs.get("scale")),
+        q, k, v)
+    gq, gk, gv = vjp(g)
+    return {"Q@GRAD": [gq], "K@GRAD": [gk], "V@GRAD": [gv]}

@@ -70,6 +70,7 @@ class ParallelExecutor:
         mesh: Optional[Mesh] = None,
         num_trainers: int = 1,
         trainer_id: int = 0,
+        amp: bool = False,
     ):
         self.program = main_program or default_main_program()
         self.scope = scope or global_scope()
@@ -80,6 +81,7 @@ class ParallelExecutor:
         if "dp" not in self.mesh.axis_names:
             raise ValueError("ParallelExecutor mesh must have a 'dp' axis")
         self.loss_name = loss_name
+        self.amp = amp
         self._cache: Dict[Any, Any] = {}
         self._step_seed = 0
         self._placed = False
@@ -138,11 +140,12 @@ class ParallelExecutor:
             feed_vals[k] = jax.device_put(arr, self._feed_sharding(arr))
 
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
-        key_cache = (id(self.program), self.program.version, sig, tuple(fetch_names))
+        key_cache = (id(self.program), self.program.version, sig,
+                     tuple(fetch_names), self.amp)
         entry = self._cache.get(key_cache)
         if entry is None:
             step, readonly_names, donated_names, state_out = build_step_fn(
-                self.program, 0, feed_names, fetch_names
+                self.program, 0, feed_names, fetch_names, amp=self.amp
             )
             if not self._placed:
                 self._place_state(readonly_names + donated_names)

@@ -157,3 +157,62 @@ def test_memory_optimize_liveness():
         loss = fluid.layers.mean(y)
     reusable = memory_optimize(main)
     assert len(reusable) > 0  # intermediate activations die mid-program
+
+
+def test_flash_attention_matches_dense():
+    from paddle_tpu.ops.pallas_attention import flash_attention_fwd
+
+    rng = np.random.RandomState(3)
+    b, t, h, d = 2, 128, 2, 16
+    q = rng.randn(b, t, h, d).astype("float32")
+    k = rng.randn(b, t, h, d).astype("float32")
+    v = rng.randn(b, t, h, d).astype("float32")
+    ref = np.asarray(dense_attention(q, k, v))
+    out = np.asarray(flash_attention_fwd(q, k, v, q_block=64, k_block=64))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    # causal
+    ref_c = np.asarray(dense_attention(q, k, v, causal=True))
+    out_c = np.asarray(flash_attention_fwd(q, k, v, causal=True, q_block=64,
+                                           k_block=64))
+    np.testing.assert_allclose(out_c, ref_c, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_op_and_grad():
+    main = fluid.Program()
+    rng = np.random.RandomState(4)
+    b, t, h, d = 1, 64, 1, 8
+    q = rng.randn(b, t, h, d).astype("float32")
+    with fluid.program_guard(main):
+        blk = main.global_block()
+        for n in ("q", "k", "v"):
+            blk.create_var(n, dtype="float32", shape=(b, t, h, d), persistable=True)
+        blk.create_var("out")
+        blk.append_op("flash_attention", {"Q": ["q"], "K": ["k"], "V": ["v"]},
+                      {"Out": ["out"]}, {"causal": True})
+        blk.create_var("loss")
+        blk.append_op("reduce_sum", {"X": ["out"]}, {"Out": ["loss"]},
+                      {"reduce_all": True})
+        loss = blk.var("loss")
+        loss.dtype, loss.shape = fluid.DataType.FP32, ()
+        from paddle_tpu.core import append_backward
+
+        append_backward(loss)
+    scope = fluid.Scope()
+    for n in ("q", "k", "v"):
+        scope.set(n, rng.randn(b, t, h, d).astype("float32"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    gq, = exe.run(main, fetch_list=["q@GRAD"], scope=scope)
+
+    import jax
+
+    def f(q):
+        return np.asarray(dense_attention(q, scope.get("k"), scope.get("v"),
+                                          causal=True)).sum()
+
+    def f_jax(q):
+        import jax.numpy as jnp
+        return jnp.sum(dense_attention(q, scope.get("k"), scope.get("v"),
+                                       causal=True))
+
+    g_ref = np.asarray(jax.grad(f_jax)(scope.get("q")))
+    np.testing.assert_allclose(gq, g_ref, rtol=5e-4, atol=5e-5)

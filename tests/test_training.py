@@ -56,3 +56,28 @@ def test_regularizer_applied():
     # L2Decay adds a scale op + sum op per parameter before the sgd updates
     assert types.count("sgd") == 2
     assert "scale" in types
+
+
+def test_amp_training_converges():
+    """bf16 mixed precision (Executor(amp=True)) still converges."""
+    np.random.seed(7)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[32], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, size=24, act="relu")
+        pred = fluid.layers.fc(h, size=5, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(0.01).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace(), amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    X = np.random.randn(256, 32).astype("float32")
+    Y = np.argmax(X[:, :5], axis=1).astype("int64")[:, None]
+    losses = []
+    for i in range(60):
+        idx = np.random.randint(0, 256, 64)
+        (lv,) = exe.run(main, feed={"img": X[idx], "label": Y[idx]},
+                        fetch_list=[loss], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5
