@@ -134,10 +134,9 @@ def _collect_block_io(
                     if n and n not in produced and n not in seen_reads:
                         seen_reads.add(n)
                         reads.append(n)
-            # sub-blocks read outer vars too
-            for k, v in op.attrs.items():
-                if k in ("sub_block", "block", "sub_block_idx") and isinstance(v, int):
-                    visit_block(program.blocks[v], set(produced))
+            # NOTE: no recursion into sub-blocks — control-flow ops surface
+            # their closures as explicit Hold/Carry/Seq inputs, and per-step
+            # inner vars are bound by the kernel, not the scope.
             for names in op.outputs.values():
                 for n in names:
                     if n:

@@ -193,12 +193,15 @@ def infer_and_create_outputs(op: Operator, block: Block) -> None:
             structs.append(jax.ShapeDtypeStruct(tuple(shape), v.dtype.jnp_dtype))
         ins[slot] = structs
 
-    ctx = ExecContext(key=jax.ShapeDtypeStruct((2,), jnp.uint32))
-
     def run(ins):
         # eval_shape can't split a ShapeDtypeStruct key; substitute an abstract
-        # fresh key per call — shapes don't depend on key values.
-        c = ExecContext(key=jax.random.PRNGKey(0), block_runner=ctx.block_runner)
+        # fresh key per call — shapes don't depend on key values. Control-flow
+        # ops trace sub-blocks, so hand them a real block runner (lazy import:
+        # executor imports this module at load time).
+        from .executor import BlockProgramBuilder
+
+        c = ExecContext(key=jax.random.PRNGKey(0),
+                        block_runner=BlockProgramBuilder(block.program))
         return opdef.impl(c, ins, op.attrs)
 
     try:

@@ -15,6 +15,29 @@ from .sequence import (  # noqa: F401
     sequence_reverse,
     sequence_softmax,
 )
+from .control_flow import (  # noqa: F401
+    DynamicRNN,
+    IfElse,
+    StaticRNN,
+    Switch,
+    While,
+    array_length,
+    array_read,
+    array_write,
+    cond,
+    create_array,
+    equal,
+    greater_equal,
+    greater_than,
+    is_empty,
+    less_equal,
+    less_than,
+    logical_and,
+    logical_not,
+    logical_or,
+    logical_xor,
+    not_equal,
+)
 from .metric_op import accuracy, auc  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

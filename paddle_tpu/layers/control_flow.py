@@ -1,0 +1,744 @@
+"""Control-flow layers: While, cond, IfElse, Switch, StaticRNN, DynamicRNN,
+tensor arrays, compare/logical wrappers.
+
+<- python/paddle/fluid/layers/control_flow.py:25-53 (While, IfElse, Switch,
+DynamicRNN, StaticRNN) re-imagined for XLA: each construct builds a nested
+sub-block in the IR (BlockDesc.parent_idx nesting, framework.proto:169) that
+the executor lowers into ``lax.while_loop`` / ``lax.cond`` / ``lax.scan`` —
+see ops/control_flow.py for the lowering contract.
+
+Differences from the reference, by design:
+* DynamicRNN/StaticRNN compile to one differentiable ``lax.scan`` — no
+  while_grad sub-programs, no shrink_rnn_memory; variable lengths are masks.
+* IfElse computes both branches over the full batch and merges row-wise
+  (static shapes) instead of physically splitting rows.
+* Tensor arrays are fixed-capacity dense buffers (static shapes under jit).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.ir import Block, Program, Variable
+from ..core.registry import infer_and_create_outputs
+from ..core.types import DataType
+from ..layer_helper import LayerHelper
+from .. import unique_name
+
+__all__ = [
+    "While", "cond", "IfElse", "Switch", "StaticRNN", "DynamicRNN",
+    "create_array", "array_write", "array_read", "array_length",
+    "less_than", "less_equal", "greater_than", "greater_equal",
+    "equal", "not_equal", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "is_empty",
+]
+
+
+# ---------------------------------------------------------------------------
+# compare / logical wrappers (<- layers/compare ops in layers/ops.py)
+# ---------------------------------------------------------------------------
+
+
+def _binary(op_type, x, y, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(op_type, {"X": [x], "Y": [y]}, {"Out": [out]})
+    return out
+
+
+def less_than(x, y, name=None):
+    return _binary("less_than", x, y, name)
+
+
+def less_equal(x, y, name=None):
+    return _binary("less_equal", x, y, name)
+
+
+def greater_than(x, y, name=None):
+    return _binary("greater_than", x, y, name)
+
+
+def greater_equal(x, y, name=None):
+    return _binary("greater_equal", x, y, name)
+
+
+def equal(x, y, name=None):
+    return _binary("equal", x, y, name)
+
+
+def not_equal(x, y, name=None):
+    return _binary("not_equal", x, y, name)
+
+
+def logical_and(x, y, name=None):
+    return _binary("logical_and", x, y, name)
+
+
+def logical_or(x, y, name=None):
+    return _binary("logical_or", x, y, name)
+
+
+def logical_xor(x, y, name=None):
+    return _binary("logical_xor", x, y, name)
+
+
+def logical_not(x, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("logical_not", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def is_empty(x, name=None):
+    helper = LayerHelper("is_empty", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# block read/write analysis
+# ---------------------------------------------------------------------------
+
+
+def _block_reads_writes(block: Block, provided=()):
+    """Names a block's ops read before producing / write, in program order.
+
+    Nested control-flow ops surface their closures as explicit Hold/Carry
+    inputs, so one flat pass over this block's ops is sufficient.
+    """
+    produced = set(provided)
+    reads: List[str] = []
+    writes: List[str] = []
+    rseen, wseen = set(), set()
+    for op in block.ops:
+        for ns in op.inputs.values():
+            for n in ns:
+                if n and n not in produced and n not in rseen:
+                    rseen.add(n)
+                    reads.append(n)
+        for ns in op.outputs.values():
+            for n in ns:
+                if n:
+                    produced.add(n)
+                    if n not in wseen:
+                        wseen.add(n)
+                        writes.append(n)
+    return reads, writes
+
+
+def _outer_names(names, sub: Block, parent: Block):
+    """Filter to names that resolve OUTSIDE the sub-block."""
+    return [n for n in names
+            if n not in sub.vars and parent.find_var_recursive(n) is not None]
+
+
+class _BlockGuard:
+    """Enter a fresh sub-block of ``program``; rollback on exit."""
+
+    def __init__(self, program: Program):
+        self.program = program
+
+    def __enter__(self):
+        self.block = self.program.create_block()
+        return self.block
+
+    def __exit__(self, exc_type, *a):
+        self.program.rollback()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# While (<- While, control_flow.py:46; while_op.cc:35)
+# ---------------------------------------------------------------------------
+
+
+class While:
+    """``while cond:`` over a sub-block.
+
+    The body must update ``cond`` (and any loop state) by writing to the SAME
+    outer variable names (e.g. ``layers.assign(new, output=var)`` or
+    ``layers.increment(i)``); those become the lax.while_loop carry. Shapes
+    and dtypes of carried vars must be loop-invariant (the XLA contract).
+    Forward-only — use StaticRNN/DynamicRNN for differentiable recurrence.
+    """
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.main = self.helper.main_program
+        self.sub: Optional[Block] = None
+        self.parent: Optional[Block] = None
+
+    def block(self):
+        return _WhileGuard(self)
+
+
+class _WhileGuard:
+    def __init__(self, w: While):
+        self.w = w
+
+    def __enter__(self):
+        self.w.parent = self.w.main.current_block()
+        self.w.sub = self.w.main.create_block()
+        return self.w.sub
+
+    def __exit__(self, exc_type, *a):
+        self.w.main.rollback()
+        if exc_type is None:
+            _complete_while(self.w)
+        return False
+
+
+def _complete_while(w: While):
+    sub, parent = w.sub, w.parent
+    reads, writes = _block_reads_writes(sub)
+    carry = _outer_names(writes, sub, parent)
+    if w.cond_var.name not in carry:
+        raise ValueError(
+            f"While body must update the condition variable "
+            f"{w.cond_var.name!r} (write it with layers.assign(..., "
+            f"output=cond) or a comparison into the same name)"
+        )
+    carry_set = set(carry)
+    hold = [n for n in _outer_names(reads, sub, parent) if n not in carry_set]
+    op = parent.append_op(
+        "while",
+        {"Carry": carry, "Hold": hold},
+        {"Out": carry},
+        {
+            "sub_block": sub.idx,
+            "carry_names": carry,
+            "hold_names": hold,
+            "cond_name": w.cond_var.name,
+        },
+    )
+    infer_and_create_outputs(op, parent)
+
+
+# ---------------------------------------------------------------------------
+# cond (functional true_fn/false_fn; <- layers.cond / conditional_block)
+# ---------------------------------------------------------------------------
+
+
+def cond(pred: Variable, true_fn, false_fn, name: Optional[str] = None):
+    """Run ``true_fn()`` or ``false_fn()`` based on scalar ``pred``; only the
+    selected branch executes (lax.cond). Both branches must return the same
+    structure of variables with matching shapes/dtypes."""
+    helper = LayerHelper("cond", name=name)
+    main = helper.main_program
+    parent = main.current_block()
+
+    with _BlockGuard(main) as sub_t:
+        t_out = true_fn()
+    with _BlockGuard(main) as sub_f:
+        f_out = false_fn()
+
+    single = isinstance(t_out, Variable)
+    t_outs = [t_out] if single else list(t_out)
+    f_outs = [f_out] if single else list(f_out)
+    if len(t_outs) != len(f_outs):
+        raise ValueError("cond branches must return the same number of outputs")
+
+    hold = _branch_hold([sub_t, sub_f],
+                        [[v.name for v in t_outs], [v.name for v in f_outs]],
+                        parent)
+    outs = [parent.create_var(unique_name.generate(f"{helper.name}.out"),
+                              dtype=v.dtype, shape=v.shape)
+            for v in t_outs]
+    op = parent.append_op(
+        "cond",
+        {"Cond": [pred], "Hold": hold},
+        {"Out": outs},
+        {
+            "sub_true": sub_t.idx,
+            "sub_false": sub_f.idx,
+            "hold_names": hold,
+            "true_out_names": [v.name for v in t_outs],
+            "false_out_names": [v.name for v in f_outs],
+        },
+    )
+    infer_and_create_outputs(op, parent)
+    return outs[0] if single else outs
+
+
+def _branch_hold(blocks: Sequence[Block], out_name_lists, parent: Block):
+    """Union of outer reads of branch blocks, plus branch outputs that
+    resolve outside their block (pass-through outputs)."""
+    hold: List[str] = []
+    seen = set()
+    for blk, out_names in zip(blocks, out_name_lists):
+        reads, writes = _block_reads_writes(blk)
+        wset = set(writes)
+        for n in _outer_names(reads, blk, parent):
+            if n not in seen:
+                seen.add(n)
+                hold.append(n)
+        for n in out_names:  # pass-through: output not produced in the block
+            if n not in wset and n not in blk.vars and n not in seen:
+                if parent.find_var_recursive(n) is not None:
+                    seen.add(n)
+                    hold.append(n)
+    return hold
+
+
+# ---------------------------------------------------------------------------
+# IfElse (row-wise; <- IfElse control_flow.py:47, split/merge_lod_tensor)
+# ---------------------------------------------------------------------------
+
+
+class IfElse:
+    """Row-wise branch on a (N, 1) boolean condition.
+
+    Both branches see the FULL batch (``ie.input(x)`` returns ``x`` itself);
+    outputs merge per row with ``where(cond, true, false)``. The reference
+    physically splits rows into variable-length tensors — dynamic shapes XLA
+    can't compile; computing both branches keeps everything static.
+    """
+
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond_var = cond
+        self.main = self.helper.main_program
+        self.parent = None
+        self._blocks = {}      # branch -> Block
+        self._outputs = {True: [], False: []}
+        self._status = None
+
+    def true_block(self):
+        return _IfElseGuard(self, True)
+
+    def false_block(self):
+        return _IfElseGuard(self, False)
+
+    def input(self, x: Variable) -> Variable:
+        if self._status is None:
+            raise RuntimeError("IfElse.input() must be called inside a branch block")
+        return x
+
+    def output(self, *outs: Variable):
+        if self._status is None:
+            raise RuntimeError("IfElse.output() must be called inside a branch block")
+        self._outputs[self._status].extend(outs)
+
+    def __call__(self):
+        t_outs, f_outs = self._outputs[True], self._outputs[False]
+        if len(t_outs) != len(f_outs):
+            raise ValueError("IfElse branches must produce the same number of outputs")
+        if True not in self._blocks or False not in self._blocks:
+            raise ValueError("IfElse requires both true_block and false_block")
+        parent = self.parent
+        sub_t, sub_f = self._blocks[True], self._blocks[False]
+        hold = _branch_hold(
+            [sub_t, sub_f],
+            [[v.name for v in t_outs], [v.name for v in f_outs]],
+            parent,
+        )
+        outs = [parent.create_var(unique_name.generate(f"{self.helper.name}.out"),
+                                  dtype=v.dtype, shape=v.shape)
+                for v in t_outs]
+        op = parent.append_op(
+            "row_cond",
+            {"Cond": [self.cond_var], "Hold": hold},
+            {"Out": outs},
+            {
+                "sub_true": sub_t.idx,
+                "sub_false": sub_f.idx,
+                "hold_names": hold,
+                "true_out_names": [v.name for v in t_outs],
+                "false_out_names": [v.name for v in f_outs],
+            },
+        )
+        infer_and_create_outputs(op, parent)
+        return outs if len(outs) > 1 else outs[0]
+
+
+class _IfElseGuard:
+    def __init__(self, ie: IfElse, branch: bool):
+        self.ie = ie
+        self.branch = branch
+
+    def __enter__(self):
+        if self.ie.parent is None:
+            self.ie.parent = self.ie.main.current_block()
+        blk = self.ie.main.create_block(parent_idx=self.ie.parent.idx)
+        self.ie._blocks[self.branch] = blk
+        self.ie._status = self.branch
+        return blk
+
+    def __exit__(self, exc_type, *a):
+        self.ie.main.rollback()
+        self.ie._status = None
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Switch (<- Switch control_flow.py:48; used by LR schedules)
+# ---------------------------------------------------------------------------
+
+
+class Switch:
+    """Chained scalar conditional: first matching case's block runs.
+
+    Case blocks take effect by writing to pre-existing outer variables
+    (typically ``layers.assign(value, output=var)``); the chain lowers to
+    nested ``cond`` ops, so exactly one branch executes per step.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("switch", name=name)
+        self.main = self.helper.main_program
+        self.parent = None
+        self.cases = []            # (pred var or None, Block)
+        self._inside = False
+
+    def __enter__(self):
+        self.parent = self.main.current_block()
+        self._inside = True
+        return self
+
+    def __exit__(self, exc_type, *a):
+        self._inside = False
+        if exc_type is None:
+            self._complete()
+        return False
+
+    def case(self, condition: Variable):
+        if not self._inside:
+            raise RuntimeError("Switch.case must be used inside 'with Switch()'")
+        return _SwitchCaseGuard(self, condition)
+
+    def default(self):
+        if not self._inside:
+            raise RuntimeError("Switch.default must be used inside 'with Switch()'")
+        return _SwitchCaseGuard(self, None)
+
+    def _complete(self):
+        cases = [(p, b) for p, b in self.cases if p is not None]
+        defaults = [b for p, b in self.cases if p is None]
+        if not cases:
+            raise ValueError("Switch needs at least one case")
+        if len(defaults) > 1:
+            raise ValueError("Switch allows at most one default block")
+        parent = self.parent
+        # union of outer vars written by any branch, in first-seen order
+        written: List[str] = []
+        seen = set()
+        for _, blk in self.cases:
+            _, writes = _block_reads_writes(blk)
+            for n in _outer_names(writes, blk, parent):
+                if n not in seen:
+                    seen.add(n)
+                    written.append(n)
+        if not written:
+            raise ValueError("Switch branches wrote no outer variables")
+        for n in written:
+            if parent.find_var_recursive(n) is None:
+                raise ValueError(f"Switch writes {n!r} which does not pre-exist")
+
+        empty = self.main.create_block(parent_idx=parent.idx)
+        self.main.rollback()
+
+        # innermost else: the default block (or pass-through of current
+        # values). Either way the env names are the written names — a block
+        # that writes var n binds n; one that doesn't falls through to Hold.
+        else_blk = defaults[0] if defaults else empty
+        else_outs = list(written)
+
+        # fold cases from last to first; the outermost cond writes the real
+        # variable names so downstream ops observe the selected values
+        acc_blk, acc_outs = else_blk, else_outs
+        for i, (pred, blk) in enumerate(reversed(cases)):
+            outermost = i == len(cases) - 1
+            out_names = (written if outermost else
+                         [unique_name.generate(f"{self.helper.name}.acc")
+                          for _ in written])
+            out_vars = []
+            for n, w in zip(out_names, written):
+                wvar = parent.find_var_recursive(w)
+                v = parent.vars.get(n) or parent.create_var(
+                    n, dtype=wvar.dtype, shape=wvar.shape)
+                out_vars.append(v)
+            true_outs = list(written)
+            hold = _branch_hold([blk, acc_blk], [true_outs, acc_outs], parent)
+            op = parent.append_op(
+                "cond",
+                {"Cond": [pred], "Hold": hold},
+                {"Out": out_vars},
+                {
+                    "sub_true": blk.idx,
+                    "sub_false": acc_blk.idx,
+                    "hold_names": hold,
+                    "true_out_names": true_outs,
+                    "false_out_names": acc_outs,
+                },
+            )
+            infer_and_create_outputs(op, parent)
+            acc_blk, acc_outs = empty, [v.name for v in out_vars]
+
+
+class _SwitchCaseGuard:
+    def __init__(self, sw: Switch, pred: Optional[Variable]):
+        self.sw = sw
+        self.pred = pred
+
+    def __enter__(self):
+        blk = self.sw.main.create_block(parent_idx=self.sw.parent.idx)
+        self.sw.cases.append((self.pred, blk))
+        return blk
+
+    def __exit__(self, exc_type, *a):
+        self.sw.main.rollback()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN / DynamicRNN (<- control_flow.py StaticRNN/DynamicRNN;
+# recurrent_op.cc:222)
+# ---------------------------------------------------------------------------
+
+
+class StaticRNN:
+    """Build a per-timestep sub-block; lowers to one differentiable lax.scan.
+
+    Sequence inputs are dense batch-major ``[N, T, ...]`` (the dense-padded
+    LoD redesign — SURVEY.md §5.7); ``step_input`` yields the ``[N, ...]``
+    slice at each step.
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 max_len: Optional[int] = None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.max_len = max_len  # required iff the RNN has no step_input
+        self.main = self.helper.main_program
+        self.parent: Optional[Block] = None
+        self.sub: Optional[Block] = None
+        self.seq_outer: List[Variable] = []
+        self.seq_inner: List[Variable] = []
+        self.boots: List[Variable] = []
+        self.pre_vars: List[Variable] = []
+        self.post_names: List[Optional[str]] = []
+        self.out_inner: List[Variable] = []
+        self.out_outer: List[Variable] = []
+        self.last_outer: List[Variable] = []
+        self.lengths: Optional[Variable] = None
+        self._completed = False
+
+    # -- block construction --
+    def step(self):
+        return _RnnGuard(self)
+
+    def step_input(self, x: Variable) -> Variable:
+        self._check_in_block("step_input")
+        shape = None
+        if x.shape is not None and len(x.shape) >= 2:
+            shape = (x.shape[0],) + tuple(x.shape[2:])
+        inner = self.sub.create_var(
+            unique_name.generate(f"{self.helper.name}.step_in"),
+            dtype=x.dtype, shape=shape)
+        self.seq_outer.append(x)
+        self.seq_inner.append(inner)
+        return inner
+
+    def memory(self, init: Optional[Variable] = None,
+               shape: Optional[Sequence[int]] = None,
+               batch_ref: Optional[Variable] = None,
+               init_value: float = 0.0, dtype="float32") -> Variable:
+        self._check_in_block("memory")
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs either init= or shape=")
+            ref = batch_ref or (self.seq_outer[0] if self.seq_outer else None)
+            if ref is None:
+                raise ValueError("memory(shape=...) needs batch_ref or a prior step_input")
+            boot = self.parent.create_var(
+                unique_name.generate(f"{self.helper.name}.mem_boot"),
+                dtype=DataType.from_any(dtype))
+            op = self.parent.append_op(
+                "fill_constant_batch_size_like",
+                {"Input": [ref]}, {"Out": [boot]},
+                {"shape": [-1] + [int(s) for s in shape], "value": init_value,
+                 "dtype": DataType.from_any(dtype),
+                 "input_dim_idx": 0, "output_dim_idx": 0},
+            )
+            infer_and_create_outputs(op, self.parent)
+        else:
+            boot = init
+        pre = self.sub.create_var(
+            unique_name.generate(f"{self.helper.name}.mem"),
+            dtype=boot.dtype, shape=boot.shape)
+        self.boots.append(boot)
+        self.pre_vars.append(pre)
+        self.post_names.append(None)
+        return pre
+
+    def update_memory(self, mem: Variable, var: Variable) -> None:
+        self._check_in_block("update_memory")
+        for i, p in enumerate(self.pre_vars):
+            if p.name == mem.name:
+                self.post_names[i] = var.name
+                return
+        raise ValueError(f"{mem.name!r} is not a memory of this RNN")
+
+    def step_output(self, o: Variable) -> None:
+        self._check_in_block("step_output")
+        self.out_inner.append(o)
+
+    output = step_output
+
+    def __call__(self):
+        if not self._completed:
+            raise RuntimeError("use the RNN outside its step() block")
+        outs = self.out_outer
+        return outs[0] if len(outs) == 1 else outs
+
+    def get_last(self, mem_index: int = 0) -> Variable:
+        return self.last_outer[mem_index]
+
+    # -- internals --
+    def _check_in_block(self, what: str):
+        if self.sub is None or self._completed:
+            raise RuntimeError(f"StaticRNN.{what}() must be called inside step()")
+
+    def _complete(self):
+        parent, sub = self.parent, self.sub
+        for i, post in enumerate(self.post_names):
+            if post is None:
+                raise ValueError(
+                    f"memory {self.pre_vars[i].name!r} was never update_memory'd")
+        provided = {v.name for v in self.seq_inner} | {v.name for v in self.pre_vars}
+        reads, _ = _block_reads_writes(sub, provided)
+        hold = _outer_names(reads, sub, parent)
+
+        T = None
+        for x in self.seq_outer:
+            if x.shape is not None and len(x.shape) >= 2 and x.shape[1] > 0:
+                T = x.shape[1]
+                break
+
+        self.out_outer = []
+        for o in self.out_inner:
+            shape = None
+            if o.shape is not None and T is not None:
+                shape = (o.shape[0], T) + tuple(o.shape[1:])
+            self.out_outer.append(parent.create_var(
+                unique_name.generate(f"{self.helper.name}.out"),
+                dtype=o.dtype, shape=shape))
+        self.last_outer = [
+            parent.create_var(unique_name.generate(f"{self.helper.name}.last"),
+                              dtype=b.dtype, shape=b.shape)
+            for b in self.boots
+        ]
+        inputs = {
+            "Seq": self.seq_outer,
+            "Boot": self.boots,
+            "Hold": hold,
+        }
+        if self.lengths is not None:
+            inputs["Length"] = [self.lengths]
+        attrs = {
+            "sub_block": sub.idx,
+            "step_input_names": [v.name for v in self.seq_inner],
+            "pre_names": [v.name for v in self.pre_vars],
+            "post_names": list(self.post_names),
+            "step_output_names": [v.name for v in self.out_inner],
+            "hold_names": hold,
+        }
+        if not self.seq_outer:
+            if self.max_len is None:
+                raise ValueError(
+                    "an RNN with no step_input needs max_len= (the number of "
+                    "steps to scan)")
+            attrs["max_len"] = int(self.max_len)
+        op = parent.append_op(
+            "recurrent",
+            inputs,
+            {"Out": self.out_outer, "Last": self.last_outer},
+            attrs,
+        )
+        infer_and_create_outputs(op, parent)
+        self._completed = True
+
+
+class _RnnGuard:
+    def __init__(self, rnn: StaticRNN):
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn.parent = self.rnn.main.current_block()
+        self.rnn.sub = self.rnn.main.create_block()
+        return self.rnn
+
+    def __exit__(self, exc_type, *a):
+        self.rnn.main.rollback()
+        if exc_type is None:
+            self.rnn._complete()
+        return False
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN: StaticRNN + per-row length masking.
+
+    The reference's DynamicRNN sorts/packs sequences by length and shrinks
+    the running batch (lod_rank_table + shrink_rnn_memory); here lengths are
+    a companion ``(N,)`` tensor and steps past a row's length are masked so
+    memories freeze and outputs zero-pad — same math, static shapes.
+    """
+
+    def __init__(self, lengths: Optional[Variable] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.lengths = lengths
+
+    def block(self, lengths: Optional[Variable] = None):
+        if lengths is not None:
+            self.lengths = lengths
+        return _RnnGuard(self)
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (<- LoDTensorArray + array_read/write, tensor_array_read_write)
+# ---------------------------------------------------------------------------
+
+
+def create_array(dtype, element_shape: Sequence[int], capacity: int,
+                 name: Optional[str] = None) -> Variable:
+    """Fixed-capacity array: a dense ``[capacity, *element_shape]`` buffer.
+
+    The reference's LoDTensorArray grows dynamically (vector<LoDTensor>);
+    under XLA shapes are static, so capacity is declared up front — size it to
+    the max steps (e.g. max decode length)."""
+    from .tensor import fill_constant
+
+    arr = fill_constant(shape=[capacity] + list(element_shape), dtype=dtype,
+                        value=0.0, name=name)
+    return arr
+
+
+def array_write(x: Variable, i: Variable, array: Variable) -> Variable:
+    """Write ``x`` at index ``i``; returns the SAME variable name (the update
+    is functional under the hood, in-place in the executor env) so arrays
+    thread naturally through While carries."""
+    helper = LayerHelper("array_write")
+    helper.append_op("array_write",
+                     {"Array": [array], "X": [x], "I": [i]},
+                     {"Out": [array]})
+    return array
+
+
+def array_read(array: Variable, i: Variable) -> Variable:
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("array_read", {"Array": [array], "I": [i]}, {"Out": [out]})
+    return out
+
+
+def array_length(counter: Variable) -> Variable:
+    """The reference derives length from the vector size; the dense-buffer
+    design tracks it as the user's loop counter — this casts it to int64."""
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("array_length", {"Len": [counter]}, {"Out": [out]})
+    return out

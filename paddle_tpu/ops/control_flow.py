@@ -1,0 +1,274 @@
+"""Control-flow ops: while / cond / recurrent (scan) / row_cond / tensor arrays.
+
+<- paddle/fluid/operators/{while_op.cc:35, recurrent_op.cc:222,
+conditional_block_op.cc, compare_op.cc, logical_op.cc, is_empty_op.cc,
+tensor_array_read_write_op.cc} re-imagined for XLA:
+
+* The reference interprets a sub-BlockDesc per iteration inside a C++ op with
+  per-step `StepScopes` (recurrent_op.cc:53). Here the sub-block is *traced
+  once* into the body of `lax.while_loop` / `lax.scan` / `lax.cond`, so the
+  whole loop is one compiled XLA computation — no per-iteration dispatch, and
+  scan bodies are reverse-differentiable (the grad of a `recurrent` op falls
+  out of `jax.vjp`, replacing while_grad / recurrent_grad sub-programs and
+  `shrink_rnn_memory`-style bookkeeping with masking).
+* `while` maps to `lax.while_loop` (forward-only — its role in the reference
+  is inference-time generation/beam search; training recurrence uses
+  `recurrent`).
+* IfElse's row partitioning (split_lod_tensor/merge_lod_tensor) becomes
+  `row_cond`: run both branches on the full batch and merge with `where` —
+  static shapes, XLA-friendly, mathematically identical.
+* LoDTensorArray read/write become fixed-capacity dense buffers updated with
+  `lax.dynamic_update_slice` (static shapes under jit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import ExecContext, register_op
+
+# ---------------------------------------------------------------------------
+# compare / logical ops (<- compare_op.cc, logical_op.cc)
+# ---------------------------------------------------------------------------
+
+for _name, _fn in [
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+]:
+    def _make(fn):
+        def impl(ctx, ins, attrs):
+            return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+        return impl
+
+    register_op(_name, inputs=("X", "Y"), outputs=("Out",), no_grad=True)(_make(_fn))
+
+for _name, _fn in [
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    def _make2(fn):
+        def impl(ctx, ins, attrs):
+            return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+        return impl
+
+    register_op(_name, inputs=("X", "Y"), outputs=("Out",), no_grad=True)(_make2(_fn))
+
+
+@register_op("logical_not", inputs=("X",), outputs=("Out",), no_grad=True)
+def logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register_op("is_empty", inputs=("X",), outputs=("Out",), no_grad=True)
+def is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray(x.size == 0)]}
+
+
+def _scalar_bool(x):
+    return jnp.reshape(x, ()).astype(bool)
+
+
+def _sub_ctx(ctx: ExecContext, key) -> ExecContext:
+    return ExecContext(key=key, block_runner=ctx.block_runner,
+                       is_test=ctx.is_test, amp=ctx.amp)
+
+
+# ---------------------------------------------------------------------------
+# while (<- while_op.cc:35)
+# ---------------------------------------------------------------------------
+
+
+@register_op("while", inputs=("Carry", "Hold"), outputs=("Out",), no_grad=True)
+def while_op(ctx, ins, attrs):
+    """Run ``sub_block`` while the carried condition var is true.
+
+    attrs: sub_block, carry_names (vars the body reads AND writes, including
+    the condition), hold_names (read-only closure), cond_name.
+    Carry structure (shape/dtype of every carried var) must be loop-invariant
+    — the XLA contract, enforced by lax.while_loop.
+    """
+    carry_names = list(attrs["carry_names"])
+    cond_idx = carry_names.index(attrs["cond_name"])
+    hold = dict(zip(attrs.get("hold_names", ()), ins.get("Hold", [])))
+    runner = ctx.block_runner
+    sub_idx = attrs["sub_block"]
+
+    def cond_fn(state):
+        carry, _ = state
+        return _scalar_bool(carry[cond_idx])
+
+    def body_fn(state):
+        carry, key = state
+        key, sub = jax.random.split(key)
+        env = dict(hold)
+        env.update(zip(carry_names, carry))
+        runner.run_block(sub_idx, env, _sub_ctx(ctx, sub))
+        return tuple(env[n] for n in carry_names), key
+
+    init = (tuple(ins["Carry"]), ctx.next_key())
+    carry, _ = lax.while_loop(cond_fn, body_fn, init)
+    return {"Out": list(carry)}
+
+
+# ---------------------------------------------------------------------------
+# cond (scalar predicate; <- conditional_block_op.cc + layers.cond)
+# ---------------------------------------------------------------------------
+
+
+@register_op("cond", inputs=("Cond", "Hold"), outputs=("Out",),
+             diff_inputs=("Hold",))
+def cond_op(ctx, ins, attrs):
+    """lax.cond over two sub-blocks; only the selected branch executes.
+
+    attrs: sub_true, sub_false, hold_names, true_out_names, false_out_names.
+    Branch outputs pair positionally and must match shape/dtype.
+    """
+    pred = _scalar_bool(ins["Cond"][0])
+    hold_names = list(attrs.get("hold_names", ()))
+    hold_vals = tuple(ins.get("Hold", []))
+    runner = ctx.block_runner
+
+    def make_branch(sub_idx, out_names):
+        out_names = list(out_names)
+
+        def branch(args):
+            vals, key = args
+            env = dict(zip(hold_names, vals))
+            runner.run_block(sub_idx, env, _sub_ctx(ctx, key))
+            return tuple(env[n] for n in out_names)
+
+        return branch
+
+    out = lax.cond(
+        pred,
+        make_branch(attrs["sub_true"], attrs["true_out_names"]),
+        make_branch(attrs["sub_false"], attrs["false_out_names"]),
+        (hold_vals, ctx.next_key()),
+    )
+    return {"Out": list(out)}
+
+
+# ---------------------------------------------------------------------------
+# row_cond (per-row predicate; <- IfElse + split/merge_lod_tensor_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("row_cond", inputs=("Cond", "Hold"), outputs=("Out",),
+             diff_inputs=("Hold",))
+def row_cond(ctx, ins, attrs):
+    """IfElse the XLA way: both branches run on the FULL batch, outputs merge
+    row-wise with ``where(mask, true, false)``.
+
+    The reference physically partitions rows (split_lod_tensor_op.cc) into two
+    dynamic-length tensors — dynamic shapes XLA can't compile. Computing both
+    branches keeps shapes static; XLA fuses the select into the producers.
+    """
+    mask = ins["Cond"][0]
+    mask = mask.reshape(mask.shape[0])  # (N,) bool
+    hold_names = list(attrs.get("hold_names", ()))
+    hold_vals = list(ins.get("Hold", []))
+    runner = ctx.block_runner
+
+    def run_branch(sub_idx, out_names):
+        env = dict(zip(hold_names, hold_vals))
+        runner.run_block(sub_idx, env, _sub_ctx(ctx, ctx.next_key()))
+        return [env[n] for n in out_names]
+
+    t_outs = run_branch(attrs["sub_true"], attrs["true_out_names"])
+    f_outs = run_branch(attrs["sub_false"], attrs["false_out_names"])
+    outs = []
+    for t, f in zip(t_outs, f_outs):
+        m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+        outs.append(jnp.where(m, t, f))
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# recurrent: StaticRNN / DynamicRNN via lax.scan (<- recurrent_op.cc:222)
+# ---------------------------------------------------------------------------
+
+
+@register_op("recurrent", inputs=("Seq", "Boot", "Hold", "Length"),
+             outputs=("Out", "Last"), diff_inputs=("Seq", "Boot", "Hold"))
+def recurrent(ctx, ins, attrs):
+    """One lax.scan over time replaces the reference's per-step interpreter
+    with StepScopes (recurrent_op.cc:39-120).
+
+    attrs: sub_block; step_input_names (per-step vars inside the block, one
+    per Seq input, which are batch-major [N, T, ...]); pre_names/post_names
+    (memory state before/after one step); step_output_names (per-step outputs
+    to stack); hold_names. Optional Length (N,) masks steps past each row's
+    length: memories hold their last real value (<- shrink_rnn_memory_op.cc
+    semantics via masking, not batch shrinking) and outputs are zero-padded.
+    """
+    sub_idx = attrs["sub_block"]
+    step_in = list(attrs.get("step_input_names", ()))
+    pre = list(attrs.get("pre_names", ()))
+    post = list(attrs.get("post_names", ()))
+    inner_outs = list(attrs.get("step_output_names", ()))
+    hold = dict(zip(attrs.get("hold_names", ()), ins.get("Hold", [])))
+    seqs = [jnp.swapaxes(v, 0, 1) for v in ins.get("Seq", [])]  # [T, N, ...]
+    boots = tuple(ins.get("Boot", []))
+    lengths = ins.get("Length") or [None]
+    lengths = lengths[0]
+    runner = ctx.block_runner
+
+    if seqs:
+        T = seqs[0].shape[0]
+    else:
+        T = int(attrs["max_len"])
+    keys = jax.random.split(ctx.next_key(), T)
+    ts = jnp.arange(T, dtype=jnp.int32)
+
+    def body(mems, xs):
+        step_vals, key, t = xs
+        env = dict(hold)
+        env.update(zip(step_in, step_vals))
+        env.update(zip(pre, mems))
+        runner.run_block(sub_idx, env, _sub_ctx(ctx, key))
+        new_mems = [env[p] for p in post]
+        outs = [env[o] for o in inner_outs]
+        if lengths is not None:
+            active = t < lengths  # (N,) bool
+            def rowmask(v):
+                return active.reshape((-1,) + (1,) * (v.ndim - 1))
+            new_mems = [jnp.where(rowmask(n), n, o) for n, o in zip(new_mems, mems)]
+            outs = [jnp.where(rowmask(o), o, jnp.zeros_like(o)) for o in outs]
+        return tuple(new_mems), tuple(outs)
+
+    last, ys = lax.scan(body, boots, (tuple(seqs), keys, ts))
+    outs_bm = [jnp.swapaxes(y, 0, 1) for y in ys]  # back to [N, T, ...]
+    return {"Out": outs_bm, "Last": list(last)}
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (<- tensor_array_read_write_op.cc, LoDTensorArray)
+# ---------------------------------------------------------------------------
+
+
+@register_op("array_write", inputs=("Array", "X", "I"), outputs=("Out",),
+             diff_inputs=("Array", "X"))
+def array_write(ctx, ins, attrs):
+    arr, x, i = ins["Array"][0], ins["X"][0], ins["I"][0]
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [lax.dynamic_update_index_in_dim(arr, x, i, 0)]}
+
+
+@register_op("array_read", inputs=("Array", "I"), outputs=("Out",),
+             diff_inputs=("Array",))
+def array_read(ctx, ins, attrs):
+    arr, i = ins["Array"][0], ins["I"][0]
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [lax.dynamic_index_in_dim(arr, i, 0, keepdims=False)]}
+
+
+@register_op("array_length", inputs=("Len",), outputs=("Out",), no_grad=True)
+def array_length(ctx, ins, attrs):
+    return {"Out": [jnp.reshape(ins["Len"][0], ()).astype(jnp.int64)]}

@@ -1,0 +1,211 @@
+"""Control flow: While / cond / IfElse / Switch / StaticRNN / DynamicRNN /
+tensor arrays, mirroring the reference's control-flow op tests
+(test_while_op.py, test_recurrent_op.py, test_dynrnn_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetches, scope=None):
+    scope = scope or fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=fetches, scope=scope), scope
+
+
+def test_compare_and_logical_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.data("y", shape=[3], dtype="float32")
+        lt = layers.less_than(x, y)
+        eq = layers.equal(x, y)
+        both = layers.logical_and(lt, layers.logical_not(eq))
+    xv = np.array([[1.0, 2.0, 3.0]], "float32")
+    yv = np.array([[2.0, 2.0, 2.0]], "float32")
+    (ltv, eqv, bv), _ = _run(main, startup, {"x": xv, "y": yv},
+                             [lt, eq, both])
+    np.testing.assert_array_equal(ltv, [[True, False, False]])
+    np.testing.assert_array_equal(eqv, [[False, True, False]])
+    np.testing.assert_array_equal(bv, [[True, False, False]])
+
+
+def test_while_sums_integers():
+    # sum 0..9 with a While loop (<- test_while_op.py pattern)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        limit = layers.fill_constant([1], "float32", 10.0)
+        total = layers.fill_constant([1], "float32", 0.0)
+        cond_v = layers.less_than(i, limit)
+        w = layers.While(cond_v)
+        with w.block():
+            nt = layers.elementwise_add(total, i)
+            layers.assign(nt, output=total)
+            layers.increment(i, value=1.0)
+            nc = layers.less_than(i, limit)
+            layers.assign(nc, output=cond_v)
+    (tv,), _ = _run(main, startup, {}, [total])
+    assert float(tv[0]) == sum(range(10))
+
+
+def test_cond_selects_branch():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        pred = layers.data("p", shape=[], dtype="bool", append_batch_size=False)
+        out = layers.cond(pred,
+                          lambda: layers.scale(x, scale=2.0),
+                          lambda: layers.scale(x, scale=-1.0))
+    xv = np.array([[1.0, 3.0]], "float32")
+    (ov,), _ = _run(main, startup, {"x": xv, "p": np.asarray(True)}, [out])
+    np.testing.assert_allclose(ov, xv * 2)
+    (ov,), _ = _run(main, startup, {"x": xv, "p": np.asarray(False)}, [out])
+    np.testing.assert_allclose(ov, -xv)
+
+
+def test_ifelse_merges_rows():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="float32")
+        zero = layers.fill_constant_batch_size_like(x, [-1, 1], "float32", 0.0)
+        c = layers.greater_than(x, zero)
+        ie = layers.IfElse(c)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(layers.scale(xt, scale=10.0))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(layers.scale(xf, scale=-1.0))
+        out = ie()
+    xv = np.array([[1.0], [-2.0], [3.0]], "float32")
+    (ov,), _ = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(ov, [[10.0], [2.0], [30.0]])
+
+
+def test_switch_piecewise():
+    # the LR-schedule pattern: assign into a pre-existing global var
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = layers.data("step", shape=[1], dtype="float32",
+                           append_batch_size=False)
+        lr = layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                     name="lr")
+        b1 = layers.fill_constant([1], "float32", 10.0)
+        b2 = layers.fill_constant([1], "float32", 20.0)
+        with layers.Switch() as sw:
+            with sw.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant([1], "float32", 1.0), output=lr)
+            with sw.case(layers.less_than(step, b2)):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), output=lr)
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.01), output=lr)
+    for sv, expect in [(5.0, 1.0), (15.0, 0.1), (25.0, 0.01)]:
+        (lv,), _ = _run(main, startup, {"step": np.array([sv], "float32")}, [lr])
+        assert float(lv[0]) == pytest.approx(expect)
+
+
+def test_static_rnn_matches_numpy():
+    N, T, D, H = 2, 5, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, D], dtype="float32")
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[H], init_value=0.0)
+            nh = layers.fc(xt, size=H, act="tanh",
+                           param_attr=fluid.ParamAttr(name="w"),
+                           bias_attr=False)
+            nh2 = layers.elementwise_add(nh, h)
+            rnn.update_memory(h, nh2)
+            rnn.step_output(nh2)
+        out = rnn()
+    xv = np.random.randn(N, T, D).astype("float32")
+    (ov,), scope = _run(main, startup, {"x": xv}, [out])
+    assert ov.shape == (N, T, H)
+    w = np.asarray(scope.get("w"))
+    h = np.zeros((N, H), "float32")
+    for t in range(T):
+        h = np.tanh(xv[:, t] @ w) + h
+        np.testing.assert_allclose(ov[:, t], h, rtol=2e-5, atol=2e-5)
+
+
+def test_static_rnn_is_differentiable():
+    # the scan-based recurrent op must backprop (replaces recurrent_grad)
+    N, T, D, H = 2, 4, 3, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, D], dtype="float32")
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[H], init_value=0.0)
+            nh = layers.fc(xt, size=H, act="tanh", bias_attr=False)
+            nh2 = layers.elementwise_add(nh, h)
+            rnn.update_memory(h, nh2)
+            rnn.step_output(nh2)
+        out = rnn()
+        loss = layers.mean(layers.reduce_sum(layers.elementwise_mul(out, out),
+                                             dim=-1))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss, startup)
+    xv = np.random.randn(N, T, D).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(5):
+        (lv,) = exe.run(main, feed={"x": xv}, fetch_list=[loss], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]  # gradient actually flowed through the scan
+
+
+def test_dynamic_rnn_masks_by_length():
+    N, T, D = 3, 5, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, D], dtype="float32")
+        lens = layers.data("lens", shape=[], dtype="int32")
+        drnn = layers.DynamicRNN()
+        with drnn.block(lengths=lens):
+            xt = drnn.step_input(x)
+            acc = drnn.memory(shape=[D], init_value=0.0)
+            nacc = layers.elementwise_add(acc, xt)
+            drnn.update_memory(acc, nacc)
+            drnn.output(nacc)
+        out = drnn()
+        last = drnn.get_last(0)
+    xv = np.ones((N, T, D), "float32")
+    lv = np.array([2, 5, 0], "int32")
+    (ov, fv), _ = _run(main, startup, {"x": xv, "lens": lv}, [out, last])
+    # outputs zero past each row's length; memory freezes at the last real step
+    np.testing.assert_allclose(ov[0, :, 0], [1, 2, 0, 0, 0])
+    np.testing.assert_allclose(ov[1, :, 0], [1, 2, 3, 4, 5])
+    np.testing.assert_allclose(ov[2, :, 0], [0, 0, 0, 0, 0])
+    np.testing.assert_allclose(fv[:, 0], [2, 5, 0])
+
+
+def test_array_write_read_in_while():
+    # collect i*i into an array inside a While loop, then read back
+    CAP = 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        limit = layers.fill_constant([1], "float32", float(CAP))
+        arr = layers.create_array("float32", [1], CAP)
+        cond_v = layers.less_than(i, limit)
+        w = layers.While(cond_v)
+        with w.block():
+            sq = layers.elementwise_mul(i, i)
+            idx = layers.cast(i, "int32")
+            layers.array_write(sq, idx, arr)
+            layers.increment(i, value=1.0)
+            layers.assign(layers.less_than(i, limit), output=cond_v)
+        two = layers.fill_constant([1], "int32", 2)
+        picked = layers.array_read(arr, two)
+    (av, pv), _ = _run(main, startup, {}, [arr, picked])
+    np.testing.assert_allclose(av[:, 0], [0, 1, 4, 9, 16, 25])
+    assert float(pv[0]) == 4.0
