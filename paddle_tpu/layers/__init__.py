@@ -39,6 +39,13 @@ from .control_flow import (  # noqa: F401
     not_equal,
 )
 from .metric_op import accuracy, auc  # noqa: F401
+from .structured import (  # noqa: F401
+    chunk_eval,
+    crf_decoding,
+    ctc_greedy_decoder,
+    linear_chain_crf,
+    warpctc,
+)
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401

@@ -1,0 +1,122 @@
+"""Structured-prediction layers: linear_chain_crf, crf_decoding, warpctc,
+ctc_greedy_decoder, chunk_eval.
+
+<- python/paddle/fluid/layers/nn.py (linear_chain_crf, crf_decoding, warpctc)
+with the dense-padded sequence convention: inputs are ``[N, T, ...]`` with a
+``length`` companion tensor instead of LoD offsets.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["linear_chain_crf", "crf_decoding", "warpctc",
+           "ctc_greedy_decoder", "chunk_eval"]
+
+
+def linear_chain_crf(input, label, length=None, param_attr=None, name=None):
+    """CRF negative log-likelihood per sequence; creates the transition
+    parameter ``[K+2, K]`` (row 0 start, row 1 stop, rows 2.. transitions).
+
+    Share the transition with ``crf_decoding`` by naming it:
+    ``param_attr=ParamAttr(name="crfw")`` in both layers (the reference's
+    pattern in the label_semantic_roles book model)."""
+    helper = LayerHelper("linear_chain_crf", name=name, param_attr=param_attr)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("linear_chain_crf", ins, {"LogLikelihood": [out]})
+    return out
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None, name=None):
+    """Viterbi decode against a trained transition parameter. Pass either the
+    ``transition`` variable directly or a ``param_attr`` naming the same
+    parameter used by ``linear_chain_crf``."""
+    helper = LayerHelper("crf_decoding", name=name)
+    if transition is None:
+        num_tags = input.shape[-1]
+        transition = helper.create_parameter(
+            param_attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("crf_decoding", ins, {"ViterbiPath": [out]})
+    return out
+
+
+def warpctc(input, label, input_length, label_length, blank=0,
+            norm_by_times=False, name=None):
+    """CTC loss on raw logits ``[N, T, C]`` with padded labels ``[N, L]``."""
+    helper = LayerHelper("warpctc", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "warpctc",
+        {"Logits": [input], "Label": [label],
+         "LogitsLength": [input_length], "LabelLength": [label_length]},
+        {"Loss": [out]},
+        {"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return out
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, pad_value=0, name=None):
+    """Greedy CTC decode: argmax over classes, merge repeats, drop blanks.
+
+    input ``[N, T, C]`` probabilities/logits (argmax inside) or ``[N, T]``
+    token ids. Returns (decoded [N, T] front-packed, lengths [N])."""
+    from .tensor import argmax as _argmax
+
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    tokens = input
+    if input.shape is not None and len(input.shape) == 3:
+        tokens = _argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int64")
+    ins = {"Input": [tokens]}
+    if input_length is not None:
+        ins["Length"] = [input_length]
+    helper.append_op("ctc_align", ins, {"Output": [out], "OutLength": [out_len]},
+                     {"blank": blank, "pad_value": pad_value})
+    return out, out_len
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, length=None,
+               excluded_chunk_types=None, name=None):
+    """Batch chunk precision/recall/F1 over IOB-tagged sequences.
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) — feed the counts into metrics.ChunkEvaluator for
+    epoch-level aggregation (reference contract, layers/nn.py chunk_eval)."""
+    if chunk_scheme != "IOB":
+        raise NotImplementedError(
+            f"chunk_scheme {chunk_scheme!r}: the dense redesign implements IOB "
+            f"(the scheme the reference book models use)")
+    helper = LayerHelper("chunk_eval", name=name)
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    num_infer = helper.create_variable_for_type_inference("int64")
+    num_label = helper.create_variable_for_type_inference("int64")
+    num_correct = helper.create_variable_for_type_inference("int64")
+    ins = {"Inference": [input], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        "chunk_eval", ins,
+        {"Precision": [precision], "Recall": [recall], "F1-Score": [f1],
+         "NumInferChunks": [num_infer], "NumLabelChunks": [num_label],
+         "NumCorrectChunks": [num_correct]},
+        {"num_chunk_types": num_chunk_types,
+         "excluded_chunk_types": list(excluded_chunk_types or ())},
+    )
+    return precision, recall, f1, num_infer, num_label, num_correct

@@ -18,3 +18,4 @@ from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
 from . import pallas_attention  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import structured  # noqa: F401

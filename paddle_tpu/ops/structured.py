@@ -1,0 +1,309 @@
+"""Structured-prediction ops: linear-chain CRF, Viterbi decode, CTC loss,
+CTC alignment, chunk evaluation.
+
+<- paddle/fluid/operators/{linear_chain_crf_op.cc, crf_decoding_op.cc,
+warpctc_op.cc, ctc_align_op.cc, chunk_eval_op.cc} re-imagined for XLA:
+
+* The reference iterates per-sequence over LoD offsets in C++ loops
+  (linear_chain_crf_op.h forward/backward); here sequences are dense padded
+  ``[N, T, ...]`` with a ``Length`` companion and the whole batch runs one
+  masked ``lax.scan`` over time — batched on the MXU, differentiable by
+  ``jax.vjp`` (the hand-written CRF backward in the reference falls out of
+  autodiff).
+* warpctc's custom CUDA kernel becomes the standard log-space CTC
+  alpha-recursion as a scan — no external library.
+* Transition layout matches the reference: row 0 = start weights, row 1 =
+  stop weights, rows 2.. = the [K, K] transition matrix
+  (linear_chain_crf_op.cc op doc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.special import logsumexp
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _lengths_or_full(ins, n, t):
+    length = ins.get("Length", [None])
+    length = length[0] if length else None
+    if length is None:
+        return jnp.full((n,), t, jnp.int32)
+    return jnp.reshape(length, (n,)).astype(jnp.int32)
+
+
+def _split_transition(trans):
+    """[K+2, K] -> (start[K], stop[K], A[K, K])."""
+    return trans[0], trans[1], trans[2:]
+
+
+@register_op("linear_chain_crf", inputs=("Emission", "Transition", "Label", "Length"),
+             outputs=("LogLikelihood",), diff_inputs=("Emission", "Transition"))
+def linear_chain_crf(ctx, ins, attrs):
+    """Per-sequence negative log-likelihood of the gold tag path.
+
+    Emission [N, T, K], Transition [K+2, K], Label [N, T] (or [N, T, 1]),
+    Length [N]. Output [N, 1] — used as a cost, like the reference's
+    LogLikelihood output (linear_chain_crf_op.cc).
+    """
+    em = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    label = ins["Label"][0]
+    n, t, k = em.shape
+    label = jnp.reshape(label, (n, t)).astype(jnp.int32)
+    length = _lengths_or_full(ins, n, t)
+    start, stop, A = _split_transition(trans)
+
+    ts = jnp.arange(t)
+    mask = ts[None, :] < length[:, None]  # [N, T]
+
+    # log-partition via masked forward recursion
+    em_t = jnp.swapaxes(em, 0, 1)        # [T, N, K]
+    mask_t = jnp.swapaxes(mask, 0, 1)
+    alpha0 = start[None, :] + em_t[0]
+
+    def step(alpha, xs):
+        e, m = xs
+        nxt = logsumexp(alpha[:, :, None] + A[None, :, :], axis=1) + e
+        return jnp.where(m[:, None], nxt, alpha), None
+
+    if t > 1:
+        alpha, _ = lax.scan(step, alpha0, (em_t[1:], mask_t[1:]))
+    else:
+        alpha = alpha0
+    log_z = logsumexp(alpha + stop[None, :], axis=1)
+
+    # gold path score
+    em_sc = jnp.take_along_axis(em, label[..., None], axis=2)[..., 0]
+    em_score = jnp.sum(em_sc * mask, axis=1)
+    trans_score = jnp.sum(
+        A[label[:, :-1], label[:, 1:]] * mask[:, 1:], axis=1) if t > 1 else 0.0
+    last_idx = jnp.clip(length - 1, 0, t - 1)
+    last_lbl = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = em_score + trans_score + start[label[:, 0]] + stop[last_lbl]
+
+    nll = jnp.where(length > 0, log_z - gold, 0.0)
+    return {"LogLikelihood": [nll.reshape(n, 1)]}
+
+
+@register_op("crf_decoding", inputs=("Emission", "Transition", "Label", "Length"),
+             outputs=("ViterbiPath",), no_grad=True)
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode. Without Label: best path [N, T] int64, zero past each
+    length. With Label: per-token correctness mask (reference semantics,
+    crf_decoding_op.cc)."""
+    em = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    n, t, k = em.shape
+    length = _lengths_or_full(ins, n, t)
+    start, stop, A = _split_transition(trans)
+
+    ts = jnp.arange(t)
+    mask = ts[None, :] < length[:, None]
+    em_t = jnp.swapaxes(em, 0, 1)
+    mask_t = jnp.swapaxes(mask, 0, 1)
+    delta0 = start[None, :] + em_t[0]
+    identity_bp = jnp.broadcast_to(jnp.arange(k)[None, :], (n, k))
+
+    def step(delta, xs):
+        e, m = xs
+        scores = delta[:, :, None] + A[None, :, :]       # [N, Kprev, K]
+        best_prev = jnp.argmax(scores, axis=1)           # [N, K]
+        nxt = jnp.max(scores, axis=1) + e
+        delta_new = jnp.where(m[:, None], nxt, delta)
+        bp = jnp.where(m[:, None], best_prev, identity_bp)
+        return delta_new, bp
+
+    if t > 1:
+        delta, bps = lax.scan(step, delta0, (em_t[1:], mask_t[1:]))
+    else:
+        delta, bps = delta0, jnp.zeros((0, n, k), jnp.int32)
+    last_tag = jnp.argmax(delta + stop[None, :], axis=1)  # [N]
+
+    def back(cur, bp):
+        prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    first_tag, rest = lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([first_tag[None], rest], axis=0)  # [T, N]
+    path = jnp.swapaxes(path, 0, 1) * mask  # zero past length
+    label = ins.get("Label", [None])
+    label = label[0] if label else None
+    if label is not None:
+        label = jnp.reshape(label, (n, t)).astype(path.dtype)
+        return {"ViterbiPath": [((path == label) & mask).astype(jnp.int64)]}
+    return {"ViterbiPath": [path.astype(jnp.int64)]}
+
+
+@register_op("warpctc", inputs=("Logits", "Label", "LogitsLength", "LabelLength"),
+             outputs=("Loss",), diff_inputs=("Logits",))
+def warpctc(ctx, ins, attrs):
+    """CTC negative log-likelihood via the log-space alpha recursion.
+
+    Logits [N, T, C] raw (softmax applied inside, like warpctc), Label
+    [N, L] padded, lengths per row. attr blank (default 0). One lax.scan
+    over T for the whole batch; grads via jax.vjp — replaces the warp-ctc
+    CUDA dependency (warpctc_op.cc, platform/dynload/warpctc).
+    """
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    n, t, c = logits.shape
+    l = label.shape[1]
+    blank = int(attrs.get("blank", 0))
+    logit_len = jnp.reshape(ins["LogitsLength"][0], (n,)).astype(jnp.int32)
+    label_len = jnp.reshape(ins["LabelLength"][0], (n,)).astype(jnp.int32)
+    label = jnp.reshape(label, (n, l)).astype(jnp.int32)
+
+    logp = logits - logsumexp(logits, axis=2, keepdims=True)  # log-softmax
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank  [N, S], S=2L+1
+    s = 2 * l + 1
+    ext = jnp.full((n, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    pos = jnp.arange(s)
+    in_label = (pos[None, :] < (2 * label_len + 1)[:, None])  # valid ext positions
+    # skip-connection allowed at odd positions whose label differs from s-2
+    can_skip = jnp.zeros((n, s), bool)
+    if l > 1:
+        can_skip = can_skip.at[:, 3::2].set(label[:, 1:] != label[:, :-1])
+
+    def gather_logp(lp_t, ext):
+        return jnp.take_along_axis(lp_t, ext, axis=1)  # [N, S]
+
+    logp_t = jnp.swapaxes(logp, 0, 1)  # [T, N, C]
+    alpha0 = jnp.full((n, s), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp_t[0][:, blank])
+    first_lbl = gather_logp(logp_t[0], ext)[:, 1]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_len > 0, first_lbl, _NEG_INF))
+
+    def shift(a, by):
+        pad = jnp.full((n, by), _NEG_INF)
+        return jnp.concatenate([pad, a[:, :-by]], axis=1) if by else a
+
+    ts_idx = jnp.arange(1, t)
+
+    def step(alpha, xs):
+        lp, ti = xs
+        stay = alpha
+        from_prev = shift(alpha, 1)
+        from_skip = jnp.where(can_skip, shift(alpha, 2), _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, from_prev), from_skip)
+        nxt = merged + gather_logp(lp, ext)
+        nxt = jnp.where(in_label, nxt, _NEG_INF)
+        active = (ti < logit_len)[:, None]
+        return jnp.where(active, nxt, alpha), None
+
+    if t > 1:
+        alpha, _ = lax.scan(step, alpha0, (logp_t[1:], ts_idx))
+    else:
+        alpha = alpha0
+
+    # total prob: alpha at the last blank (2*label_len) and last label (2*label_len-1)
+    idx_last = (2 * label_len)[:, None]
+    idx_prev = jnp.clip(2 * label_len - 1, 0, s - 1)[:, None]
+    a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0]
+    a_prev = jnp.where(label_len > 0, a_prev, _NEG_INF)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    if attrs.get("norm_by_times"):
+        # reference semantics: normalize the GRADIENTS by time steps, loss
+        # value untouched (warpctc_op.cc). value(loss) = loss, d(loss) = d/T:
+        scaled = loss / jnp.maximum(logit_len, 1).astype(loss.dtype)
+        loss = scaled + lax.stop_gradient(loss - scaled)
+    return {"Loss": [loss.reshape(n, 1)]}
+
+
+@register_op("ctc_align", inputs=("Input", "Length"), outputs=("Output", "OutLength"),
+             no_grad=True)
+def ctc_align(ctx, ins, attrs):
+    """Greedy CTC collapse: merge repeats, drop blanks (<- ctc_align_op.cc).
+
+    Input [N, T] token ids + Length [N]; output [N, T] front-packed, padded
+    with attr ``pad_value`` (default 0), plus per-row collapsed lengths.
+    Scatter-based — no per-row Python loops, static shapes.
+    """
+    x = ins["Input"][0]
+    n, t = x.shape[0], x.shape[1]
+    x = jnp.reshape(x, (n, t)).astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    pad_value = int(attrs.get("pad_value", 0))
+    length = _lengths_or_full(ins, n, t)
+    mask = jnp.arange(t)[None, :] < length[:, None]
+
+    prev = jnp.concatenate([jnp.full((n, 1), -1, jnp.int32), x[:, :-1]], axis=1)
+    keep = (x != blank) & (x != prev) & mask
+    slot = jnp.cumsum(keep, axis=1) - 1                 # target position
+    slot = jnp.where(keep, slot, t)                     # dump discarded to slot T
+    out = jnp.full((n, t + 1), pad_value, jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, t))
+    out = out.at[rows, slot].set(jnp.where(keep, x, pad_value))
+    return {"Output": [out[:, :t].astype(jnp.int64)],
+            "OutLength": [keep.sum(axis=1).astype(jnp.int64)]}
+
+
+@register_op("chunk_eval", inputs=("Inference", "Label", "Length"),
+             outputs=("Precision", "Recall", "F1-Score",
+                      "NumInferChunks", "NumLabelChunks", "NumCorrectChunks"),
+             no_grad=True)
+def chunk_eval(ctx, ins, attrs):
+    """IOB chunk precision/recall/F1 (<- chunk_eval_op.cc), vectorized.
+
+    Tags follow the reference encoding: ``tag = chunk_type * 2 + (0 for B,
+    1 for I)``; anything outside ``[0, 2*num_chunk_types)`` is Outside.
+    Chunk boundaries are computed with shifted comparisons and one reverse
+    scan for end positions — no per-sequence loops.
+    """
+    inf = ins["Inference"][0]
+    lbl = ins["Label"][0]
+    n = inf.shape[0]
+    t = inf.shape[1] if inf.ndim > 1 else 1
+    inf = jnp.reshape(inf, (n, t)).astype(jnp.int32)
+    lbl = jnp.reshape(lbl, (n, t)).astype(jnp.int32)
+    ntypes = int(attrs["num_chunk_types"])
+    length = _lengths_or_full(ins, n, t)
+    mask = jnp.arange(t)[None, :] < length[:, None]
+
+    excluded = [int(e) for e in attrs.get("excluded_chunk_types") or ()]
+
+    def chunks(tags):
+        valid = mask & (tags >= 0) & (tags < 2 * ntypes)
+        typ = tags // 2
+        for e in excluded:  # excluded types count as Outside
+            valid = valid & (typ != e)
+        is_i = valid & (tags % 2 == 1)
+        prev_valid = jnp.concatenate([jnp.zeros((n, 1), bool), valid[:, :-1]], 1)
+        prev_typ = jnp.concatenate([jnp.full((n, 1), -1, jnp.int32), typ[:, :-1]], 1)
+        cont = is_i & prev_valid & (prev_typ == typ)   # continues previous chunk
+        start = valid & ~cont
+        nxt_cont = jnp.concatenate([cont[:, 1:], jnp.zeros((n, 1), bool)], 1)
+        end = valid & ~nxt_cont
+
+        # end position of the chunk containing t: reverse scan
+        def back(carry, xs):
+            e_t, idx_t = xs
+            pos = jnp.where(e_t, idx_t, carry)
+            return pos, pos
+
+        idxs = jnp.arange(t, dtype=jnp.int32)
+        xs = (jnp.swapaxes(end, 0, 1),
+              jnp.broadcast_to(idxs[:, None], (t, n)))
+        _, endpos_t = lax.scan(back, jnp.full((n,), -1, jnp.int32), xs,
+                               reverse=True)
+        return start, typ, jnp.swapaxes(endpos_t, 0, 1)
+
+    s_i, t_i, e_i = chunks(inf)
+    s_l, t_l, e_l = chunks(lbl)
+    num_inf = s_i.sum()
+    num_lbl = s_l.sum()
+    correct = (s_i & s_l & (t_i == t_l) & (e_i == e_l)).sum()
+
+    p = jnp.where(num_inf > 0, correct / num_inf, 0.0).astype(jnp.float32)
+    r = jnp.where(num_lbl > 0, correct / num_lbl, 0.0).astype(jnp.float32)
+    f1 = jnp.where(p + r > 0, 2 * p * r / (p + r), 0.0).astype(jnp.float32)
+    return {"Precision": [p], "Recall": [r], "F1-Score": [f1],
+            "NumInferChunks": [num_inf.astype(jnp.int64)],
+            "NumLabelChunks": [num_lbl.astype(jnp.int64)],
+            "NumCorrectChunks": [correct.astype(jnp.int64)]}

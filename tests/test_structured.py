@@ -1,0 +1,206 @@
+"""Structured prediction: CRF (vs brute-force enumeration), Viterbi,
+CTC loss (vs numpy DP), ctc_align, chunk_eval — the OpTest-style contract
+(<- test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_warpctc_op.py,
+test_ctc_align_op.py, test_chunk_eval_op.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetches, scope=None):
+    scope = scope or fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=fetches, scope=scope), scope
+
+
+def _crf_brute_force(em, trans, label, length):
+    """Enumerate all paths for the log-partition; score the gold path."""
+    start, stop, A = trans[0], trans[1], trans[2:]
+    n, t, k = em.shape
+    nll = np.zeros(n)
+    for i in range(n):
+        L = int(length[i])
+        if L == 0:
+            continue
+        scores = []
+        for path in itertools.product(range(k), repeat=L):
+            s = start[path[0]] + stop[path[-1]]
+            s += sum(em[i, j, path[j]] for j in range(L))
+            s += sum(A[path[j], path[j + 1]] for j in range(L - 1))
+            scores.append(s)
+        log_z = np.logaddexp.reduce(scores)
+        gold = start[label[i, 0]] + stop[label[i, L - 1]]
+        gold += sum(em[i, j, label[i, j]] for j in range(L))
+        gold += sum(A[label[i, j], label[i, j + 1]] for j in range(L - 1))
+        nll[i] = log_z - gold
+    return nll
+
+
+def test_linear_chain_crf_matches_brute_force():
+    N, T, K = 3, 4, 3
+    rng = np.random.RandomState(7)
+    em = rng.randn(N, T, K).astype("float32")
+    lbl = rng.randint(0, K, (N, T)).astype("int64")
+    lens = np.array([4, 2, 3], "int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        e = layers.data("e", shape=[T, K], dtype="float32")
+        y = layers.data("y", shape=[T], dtype="int64")
+        ln = layers.data("ln", shape=[], dtype="int32")
+        cost = layers.linear_chain_crf(e, y, length=ln,
+                                       param_attr=fluid.ParamAttr(name="crf_w"))
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    trans = np.asarray(scope.get("crf_w"))
+    (out,), _ = ((exe.run(main, feed={"e": em, "y": lbl, "ln": lens},
+                          fetch_list=[cost], scope=scope)), None)
+    expect = _crf_brute_force(em, trans, lbl, lens)
+    np.testing.assert_allclose(out[:, 0], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_viterbi_matches_brute_force():
+    N, T, K = 2, 4, 3
+    rng = np.random.RandomState(3)
+    em = rng.randn(N, T, K).astype("float32")
+    lens = np.array([4, 3], "int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        e = layers.data("e", shape=[T, K], dtype="float32")
+        ln = layers.data("ln", shape=[], dtype="int32")
+        path = layers.crf_decoding(e, length=ln,
+                                   param_attr=fluid.ParamAttr(name="crf_w2"))
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    trans = np.asarray(scope.get("crf_w2"))
+    (out,) = exe.run(main, feed={"e": em, "ln": lens}, fetch_list=[path],
+                     scope=scope)
+    start, stop, A = trans[0], trans[1], trans[2:]
+    for i in range(N):
+        L = int(lens[i])
+        best, best_s = None, -np.inf
+        for p in itertools.product(range(K), repeat=L):
+            s = start[p[0]] + stop[p[-1]]
+            s += sum(em[i, j, p[j]] for j in range(L))
+            s += sum(A[p[j], p[j + 1]] for j in range(L - 1))
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(out[i, :L], best)
+        assert (out[i, L:] == 0).all()
+
+
+def test_crf_training_improves_likelihood():
+    # end-to-end: emissions from an fc, CRF cost minimized by Adam
+    N, T, K, D = 6, 5, 4, 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(N, T, D).astype("float32")
+    Y = rng.randint(0, K, (N, T)).astype("int64")
+    L = np.full((N,), T, "int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, D], dtype="float32")
+        y = layers.data("y", shape=[T], dtype="int64")
+        ln = layers.data("ln", shape=[], dtype="int32")
+        emission = layers.fc(x, size=K, num_flatten_dims=2)
+        crf = layers.linear_chain_crf(emission, y, length=ln)
+        loss = layers.mean(crf)
+        fluid.optimizer.Adam(0.05).minimize(loss, startup)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = [float(exe.run(main, feed={"x": X, "y": Y, "ln": L},
+                            fetch_list=[loss], scope=scope)[0])
+              for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def _ctc_ref(logp, label, blank):
+    """Reference CTC -log p via the standard DP (single sequence)."""
+    T, C = logp.shape
+    ext = [blank]
+    for c in label:
+        ext += [c, blank]
+    S = len(ext)
+    a = np.full((T, S), -np.inf)
+    a[0, 0] = logp[0, blank]
+    if S > 1:
+        a[0, 1] = logp[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            cands = [a[t - 1, s]]
+            if s >= 1:
+                cands.append(a[t - 1, s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                cands.append(a[t - 1, s - 2])
+            a[t, s] = np.logaddexp.reduce(cands) + logp[t, ext[s]]
+    return -np.logaddexp(a[T - 1, S - 1], a[T - 1, S - 2] if S > 1 else -np.inf)
+
+
+def test_warpctc_matches_reference_dp():
+    N, T, C, L = 3, 6, 5, 3
+    rng = np.random.RandomState(11)
+    logits = rng.randn(N, T, C).astype("float32")
+    label = np.array([[1, 2, 1], [3, 3, 0], [4, 0, 0]], "int32")
+    logit_len = np.array([6, 5, 4], "int32")
+    label_len = np.array([3, 2, 1], "int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = layers.data("lg", shape=[T, C], dtype="float32")
+        lb = layers.data("lb", shape=[L], dtype="int32")
+        ll = layers.data("ll", shape=[], dtype="int32")
+        yl = layers.data("yl", shape=[], dtype="int32")
+        loss = layers.warpctc(lg, lb, ll, yl, blank=0)
+    (out,), _ = _run(main, startup,
+                     {"lg": logits, "lb": label, "ll": logit_len, "yl": label_len},
+                     [loss])
+    for i in range(N):
+        lp = logits[i, :logit_len[i]]
+        lp = lp - np.log(np.exp(lp).sum(1, keepdims=True))
+        expect = _ctc_ref(lp, label[i, :label_len[i]], blank=0)
+        np.testing.assert_allclose(out[i, 0], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_greedy_decoder_collapses():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[7], dtype="int64")
+        ln = layers.data("ln", shape=[], dtype="int32")
+        out, out_len = layers.ctc_greedy_decoder(x, blank=0, input_length=ln)
+    xv = np.array([[1, 1, 0, 2, 2, 0, 3],
+                   [0, 0, 4, 4, 4, 5, 5]], "int64")
+    lens = np.array([7, 5], "int32")
+    (ov, lv), _ = _run(main, startup, {"x": xv, "ln": lens}, [out, out_len])
+    np.testing.assert_array_equal(ov[0, :3], [1, 2, 3])
+    assert lv[0] == 3
+    np.testing.assert_array_equal(ov[1, :1], [4])
+    assert lv[1] == 1
+
+
+def test_chunk_eval_counts():
+    # IOB, 2 types: tags B0=0 I0=1 B1=2 I1=3, O=4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = layers.data("inf", shape=[6], dtype="int64")
+        lbl = layers.data("lbl", shape=[6], dtype="int64")
+        ln = layers.data("ln", shape=[], dtype="int32")
+        p, r, f1, ni, nl, nc = layers.chunk_eval(inf, lbl, "IOB", 2, length=ln)
+    # label chunks: [0,1]=type0@0-1, [2]=type1@3;  infer: type0@0-1, type1@3-4
+    lblv = np.array([[0, 1, 4, 2, 4, 4]], "int64")
+    infv = np.array([[0, 1, 4, 2, 3, 4]], "int64")
+    lens = np.array([6], "int32")
+    (pv, rv, fv, niv, nlv, ncv), _ = _run(
+        main, startup, {"inf": infv, "lbl": lblv, "ln": lens},
+        [p, r, f1, ni, nl, nc])
+    assert niv == 2 and nlv == 2 and ncv == 1
+    assert pv == pytest.approx(0.5) and rv == pytest.approx(0.5)
+    assert fv == pytest.approx(0.5)
