@@ -5,6 +5,7 @@ from .sequence import (  # noqa: F401
     dynamic_gru,
     dynamic_lstm,
     lstm_unit,
+    masked_sequence_mean,
     sequence_conv,
     sequence_expand,
     sequence_first_step,

@@ -349,6 +349,17 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     return out
 
 
+def cos_sim(x, y, name=None):
+    """Row-wise cosine similarity (<- layers/nn.py cos_sim / cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xn = helper.create_variable_for_type_inference(x.dtype)
+    yn = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("cos_sim", {"X": [x], "Y": [y]},
+                     {"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
 def l2_normalize(x, axis: int = 1, epsilon: float = 1e-12, name=None):
     helper = LayerHelper("l2_normalize", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)

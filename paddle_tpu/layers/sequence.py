@@ -23,6 +23,23 @@ def sequence_mask(length, maxlen: int, dtype="float32", name=None):
     return out
 
 
+def masked_sequence_mean(loss, length, maxlen: int, name=None):
+    """Mean of a per-token loss over real (unpadded) positions.
+
+    ``loss`` is [N, T] or [N, T, 1]; padded positions are zeroed by a
+    sequence mask and the sum is divided by the number of real tokens — the
+    shared masked-loss epilogue of every padded seq2seq/LM model here (the
+    reference gets this for free from LoD, where pads don't exist)."""
+    from .nn import elementwise_div, elementwise_mul, reduce_sum, reshape
+
+    helper = LayerHelper("masked_sequence_mean", name=name)
+    mask = sequence_mask(length, maxlen=maxlen, dtype=loss.dtype)
+    if loss.shape is not None and len(loss.shape) == 3:
+        mask = reshape(mask, [0, maxlen, 1])
+    masked = elementwise_mul(loss, mask)
+    return elementwise_div(reduce_sum(masked), reduce_sum(mask))
+
+
 def sequence_pool(input, pool_type: str, length=None, name=None):
     helper = LayerHelper("sequence_pool", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)

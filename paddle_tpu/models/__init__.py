@@ -9,3 +9,12 @@ from .resnet import resnet_cifar10, resnet50  # noqa: F401
 from .vgg import vgg16  # noqa: F401
 from .ctr import deepfm_ctr, wide_deep_ctr  # noqa: F401
 from .seq2seq import Seq2SeqAttention  # noqa: F401
+from .book import (  # noqa: F401
+    fit_a_line,
+    label_semantic_roles,
+    recommender_system,
+    rnn_encoder_decoder,
+    understand_sentiment_conv,
+    understand_sentiment_stacked_lstm,
+    word2vec,
+)

@@ -1,0 +1,189 @@
+"""The "book" model suite (<- python/paddle/fluid/tests/book/): the eight
+end-to-end models the reference uses as its correctness contract.
+
+Each builder appends to the default main program and returns the variables a
+training/inference driver needs. Sequence inputs follow the dense-padded
+convention: ``[N, T]`` id tensors with a ``length`` companion instead of LoD.
+
+Covered here: fit_a_line, word2vec (N-gram LM), understand_sentiment (conv
+and stacked-LSTM variants), recommender_system, label_semantic_roles
+(BiLSTM-CRF), rnn_encoder_decoder (plain seq2seq; the attention +
+beam-search machine_translation model lives in models/seq2seq.py).
+recognize_digits/image_classification are models/lenet.py, resnet.py, vgg.py.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def fit_a_line(x, y):
+    """Linear regression (<- book/test_fit_a_line.py:28-34)."""
+    y_predict = layers.fc(x, size=1)
+    cost = layers.square_error_cost(y_predict, y)
+    avg_cost = layers.mean(cost)
+    return y_predict, avg_cost
+
+
+def word2vec(words, dict_size, embed_size=32, hidden_size=256):
+    """N-gram LM with a shared embedding table
+    (<- book/test_word2vec.py:40-76: four context words predict the next).
+
+    ``words`` = [first, second, third, fourth, next] id tensors [N, 1].
+    """
+    first, second, third, fourth, next_word = words
+    shared = ParamAttr(name="shared_w")
+    embeds = [
+        layers.embedding(w, size=[dict_size, embed_size], param_attr=shared)
+        for w in (first, second, third, fourth)
+    ]
+    concat = layers.concat(embeds, axis=-1)
+    concat = layers.reshape(concat, [-1, 4 * embed_size])
+    hidden = layers.fc(concat, size=hidden_size, act="sigmoid")
+    predict = layers.fc(hidden, size=dict_size, act="softmax")
+    cost = layers.cross_entropy(predict, next_word)
+    avg_cost = layers.mean(cost)
+    return predict, avg_cost
+
+
+def understand_sentiment_conv(data, label, length, dict_dim, class_dim=2,
+                              emb_dim=32, hid_dim=32):
+    """TextCNN (<- book/test_understand_sentiment.py:26 convolution_net /
+    nets.sequence_conv_pool): two conv branches, max-pool over time, softmax.
+    """
+    emb = layers.embedding(data, size=[dict_dim, emb_dim])
+    conv3 = layers.sequence_conv(emb, num_filters=hid_dim, filter_size=3,
+                                 length=length, act="tanh")
+    pool3 = layers.sequence_pool(conv3, "max", length=length)
+    conv4 = layers.sequence_conv(emb, num_filters=hid_dim, filter_size=4,
+                                 length=length, act="tanh")
+    pool4 = layers.sequence_pool(conv4, "max", length=length)
+    feat = layers.concat([pool3, pool4], axis=-1)
+    prediction = layers.fc(feat, size=class_dim, act="softmax")
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc
+
+
+def understand_sentiment_stacked_lstm(data, label, length, dict_dim,
+                                      class_dim=2, emb_dim=32, hid_dim=32,
+                                      stacked_num=3):
+    """Stacked bidirectional-ish LSTM classifier
+    (<- book/test_understand_sentiment.py:50 stacked_lstm_net): fc+lstm
+    stack with alternating direction, max-pools, softmax."""
+    emb = layers.embedding(data, size=[dict_dim, emb_dim])
+    fc1 = layers.fc(emb, size=hid_dim * 4, num_flatten_dims=2)
+    lstm1, _cell = layers.dynamic_lstm(fc1, size=hid_dim, length=length)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc_i = layers.fc(inputs, size=hid_dim * 4, num_flatten_dims=2)
+        lstm_i, _ = layers.dynamic_lstm(fc_i, size=hid_dim, length=length,
+                                        is_reverse=(i % 2 == 0))
+        inputs = [fc_i, lstm_i]
+    fc_last = layers.sequence_pool(inputs[0], "max", length=length)
+    lstm_last = layers.sequence_pool(inputs[1], "max", length=length)
+    prediction = layers.fc([fc_last, lstm_last], size=class_dim, act="softmax")
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc
+
+
+def recommender_system(usr_id, usr_gender, usr_age, usr_job,
+                       mov_id, mov_title, mov_title_len, score,
+                       user_vocab=1000, movie_vocab=1000, title_vocab=500,
+                       emb_dim=32):
+    """Two-tower MovieLens model (<- book/test_recommender_system.py:31-150:
+    get_usr_combined_features / get_mov_combined_features, cos_sim head
+    scaled to [0, 5])."""
+    # user tower
+    usr_emb = layers.embedding(usr_id, [user_vocab, emb_dim])
+    usr_fc = layers.fc(usr_emb, size=emb_dim)
+    gender_emb = layers.embedding(usr_gender, [2, 16])
+    gender_fc = layers.fc(gender_emb, size=16)
+    age_emb = layers.embedding(usr_age, [8, 16])
+    age_fc = layers.fc(age_emb, size=16)
+    job_emb = layers.embedding(usr_job, [32, 16])
+    job_fc = layers.fc(job_emb, size=16)
+    usr_concat = layers.concat([usr_fc, gender_fc, age_fc, job_fc], axis=-1)
+    usr_feat = layers.fc(usr_concat, size=200, act="tanh")
+    # movie tower
+    mov_emb = layers.embedding(mov_id, [movie_vocab, emb_dim])
+    mov_fc = layers.fc(mov_emb, size=emb_dim)
+    title_emb = layers.embedding(mov_title, [title_vocab, emb_dim])
+    title_conv = layers.sequence_conv(title_emb, num_filters=emb_dim,
+                                      filter_size=3, length=mov_title_len,
+                                      act="tanh")
+    title_pool = layers.sequence_pool(title_conv, "sum", length=mov_title_len)
+    mov_concat = layers.concat([mov_fc, title_pool], axis=-1)
+    mov_feat = layers.fc(mov_concat, size=200, act="tanh")
+    # cosine head scaled to the 5-star range
+    sim = layers.cos_sim(usr_feat, mov_feat)
+    predict = layers.scale(sim, scale=5.0)
+    cost = layers.square_error_cost(predict, score)
+    avg_cost = layers.mean(cost)
+    return predict, avg_cost
+
+
+def label_semantic_roles(word, mark, length, target, word_dict_len,
+                         mark_dict_len, label_dict_len, word_dim=32,
+                         mark_dim=5, hidden_dim=128, depth=4,
+                         crf_param_name="crfw"):
+    """Simplified SRL BiLSTM-CRF (<- book/test_label_semantic_roles.py:38-127
+    db_lstm): word+mark embeddings, stacked alternating-direction LSTMs,
+    emission fc, linear-chain CRF cost. Returns (emission, crf_cost).
+
+    The reference feeds 6 context-window word slots + predicate; the dense
+    redesign keeps word+mark (predicate mark) which exercises the same
+    machinery (multi-embedding concat, deep BiLSTM, CRF) without the
+    dataset-specific plumbing.
+    """
+    assert hidden_dim % 4 == 0
+    word_emb = layers.embedding(word, [word_dict_len, word_dim])
+    mark_emb = layers.embedding(mark, [mark_dict_len, mark_dim])
+    emb = layers.concat([word_emb, mark_emb], axis=-1)
+    fc0 = layers.fc(emb, size=hidden_dim, num_flatten_dims=2)
+    lstm0, _ = layers.dynamic_lstm(fc0, size=hidden_dim // 4, length=length)
+    input_tmp = [fc0, lstm0]
+    for i in range(1, depth):
+        mix = layers.fc(input_tmp, size=hidden_dim, num_flatten_dims=2)
+        lstm = layers.dynamic_lstm(mix, size=hidden_dim // 4, length=length,
+                                   is_reverse=(i % 2 == 1))[0]
+        input_tmp = [mix, lstm]
+    emission = layers.fc(input_tmp, size=label_dict_len, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(
+        emission, target, length=length,
+        param_attr=ParamAttr(name=crf_param_name))
+    return emission, crf_cost
+
+
+def rnn_encoder_decoder(src_ids, src_length, trg_ids, trg_length,
+                        trg_next_ids, src_vocab, trg_vocab, embed_dim=32,
+                        hidden=64):
+    """Plain seq2seq without attention
+    (<- book/test_rnn_encoder_decoder.py:48-124): GRU encoder's last state
+    seeds a DynamicRNN decoder with teacher forcing; softmax over target
+    vocab; per-token masked cross-entropy."""
+    src_emb = layers.embedding(src_ids, [src_vocab, embed_dim])
+    enc_proj = layers.fc(src_emb, size=hidden * 3, num_flatten_dims=2)
+    enc_hidden = layers.dynamic_gru(enc_proj, size=hidden, length=src_length)
+    enc_last = layers.sequence_last_step(enc_hidden, length=src_length)
+
+    trg_emb = layers.embedding(trg_ids, [trg_vocab, embed_dim])
+    drnn = layers.DynamicRNN()
+    with drnn.block(lengths=trg_length):
+        x_t = drnn.step_input(trg_emb)
+        h = drnn.memory(init=enc_last)
+        gates = layers.fc([x_t, h], size=hidden, act="tanh")
+        drnn.update_memory(h, gates)
+        out_t = layers.fc(gates, size=trg_vocab)
+        drnn.output(out_t)
+    logits = drnn()  # [N, T, trg_vocab]
+
+    cost = layers.softmax_with_cross_entropy(
+        logits, layers.reshape(trg_next_ids, [0, trg_ids.shape[1], 1]))
+    avg_cost = layers.masked_sequence_mean(cost, trg_length,
+                                           maxlen=trg_ids.shape[1])
+    predict = layers.softmax(logits)
+    return predict, avg_cost

@@ -66,13 +66,8 @@ class Seq2SeqAttention:
                            bias_attr=ParamAttr(self.p["out_b"]))
         loss = layers.softmax_with_cross_entropy(logits, trg_next_ids)
         tmax = int(trg_ids.shape[1])
-        mask = seq_layers.sequence_mask(trg_length, maxlen=tmax)
-        mask3 = layers.reshape(mask, [0, tmax, 1])
-        masked = layers.elementwise_mul(loss, mask3)
-        total = layers.reduce_sum(masked)
-        denom = layers.reduce_sum(mask)
-        avg_loss = layers.elementwise_div(total, denom)
-        return avg_loss, masked
+        avg_loss = seq_layers.masked_sequence_mean(loss, trg_length, maxlen=tmax)
+        return avg_loss, loss
 
     def build_decode(self, src_ids, src_length, beam_size=4, max_len=16,
                      bos_id=0, eos_id=1):

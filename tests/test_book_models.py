@@ -1,0 +1,196 @@
+"""Book-model integration tests (<- python/paddle/fluid/tests/book/):
+each model trains on synthetic data until the loss drops below a threshold,
+then round-trips through save_inference_model/load_inference_model and
+produces consistent inference output — the reference's end-to-end contract.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, models
+
+
+def _train(main, startup, feed_fn, loss, steps=30, scope=None):
+    scope = scope or fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = []
+    for i in range(steps):
+        (lv,) = exe.run(main, feed=feed_fn(i), fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv)))
+    return losses, scope, exe
+
+
+def test_fit_a_line(tmp_path):
+    rng = np.random.RandomState(0)
+    W = rng.randn(13, 1).astype("float32")
+    X = rng.randn(64, 13).astype("float32")
+    Y = (X @ W + 0.5).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[13], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        y_pred, avg_cost = models.fit_a_line(x, y)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost, startup)
+
+    losses, scope, exe = _train(main, startup,
+                                lambda i: {"x": X, "y": Y}, avg_cost, steps=60)
+    assert losses[-1] < 0.05, losses[-1]
+
+    path = str(tmp_path / "fit_a_line")
+    fluid.io.save_inference_model(path, ["x"], [y_pred], exe, main, scope=scope)
+    prog, feeds, fetches = fluid.io.load_inference_model(path, exe, scope=scope)
+    (out,) = exe.run(prog, feed={"x": X[:4]}, fetch_list=fetches, scope=scope)
+    np.testing.assert_allclose(out, Y[:4], atol=0.6)
+
+
+def test_word2vec():
+    rng = np.random.RandomState(1)
+    DICT, N = 30, 64
+    ctx = rng.randint(0, DICT, (4, N, 1)).astype("int64")
+    nxt = ((ctx.sum(0) * 7 + 3) % DICT).astype("int64")  # deterministic target
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ws = [layers.data(n, shape=[1], dtype="int64")
+              for n in ("firstw", "secondw", "thirdw", "fourthw")]
+        nw = layers.data("nextw", shape=[1], dtype="int64")
+        predict, avg_cost = models.word2vec(ws + [nw], DICT, embed_size=16,
+                                            hidden_size=64)
+        fluid.optimizer.Adam(0.02).minimize(avg_cost, startup)
+
+    feed = lambda i: {"firstw": ctx[0], "secondw": ctx[1], "thirdw": ctx[2],
+                      "fourthw": ctx[3], "nextw": nxt}
+    losses, _, _ = _train(main, startup, feed, avg_cost, steps=80)
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+@pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
+def test_understand_sentiment(net):
+    rng = np.random.RandomState(2)
+    DICT, N, T = 40, 32, 12
+    X = rng.randint(1, DICT, (N, T)).astype("int64")
+    L = rng.randint(4, T + 1, (N,)).astype("int32")
+    Y = (X[:, 0] % 2).reshape(N, 1).astype("int64")  # first token decides
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = layers.data("words", shape=[T], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="int64")
+        length = layers.data("length", shape=[], dtype="int32")
+        if net == "conv":
+            _, avg_cost, acc = models.understand_sentiment_conv(
+                data, label, length, DICT, emb_dim=16, hid_dim=16)
+        else:
+            _, avg_cost, acc = models.understand_sentiment_stacked_lstm(
+                data, label, length, DICT, emb_dim=16, hid_dim=16,
+                stacked_num=2)
+        fluid.optimizer.Adam(0.02).minimize(avg_cost, startup)
+
+    feed = lambda i: {"words": X, "label": Y, "length": L}
+    losses, scope, exe = _train(main, startup, feed, avg_cost, steps=40)
+    (accv,) = exe.run(main, feed=feed(0), fetch_list=[acc], scope=scope)
+    assert losses[-1] < losses[0] * 0.5
+    assert float(accv) > 0.9
+
+
+def test_recommender_system():
+    rng = np.random.RandomState(3)
+    N, TT = 32, 6
+    feed_np = {
+        "usr_id": rng.randint(0, 100, (N, 1)).astype("int64"),
+        "usr_gender": rng.randint(0, 2, (N, 1)).astype("int64"),
+        "usr_age": rng.randint(0, 8, (N, 1)).astype("int64"),
+        "usr_job": rng.randint(0, 20, (N, 1)).astype("int64"),
+        "mov_id": rng.randint(0, 200, (N, 1)).astype("int64"),
+        "mov_title": rng.randint(0, 100, (N, TT)).astype("int64"),
+        "mov_title_len": np.full((N,), TT, "int32"),
+    }
+    score = ((feed_np["usr_id"] + feed_np["mov_id"]) % 5 + 1).astype("float32")
+    feed_np["score"] = score
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        usr_id = layers.data("usr_id", shape=[1], dtype="int64")
+        usr_gender = layers.data("usr_gender", shape=[1], dtype="int64")
+        usr_age = layers.data("usr_age", shape=[1], dtype="int64")
+        usr_job = layers.data("usr_job", shape=[1], dtype="int64")
+        mov_id = layers.data("mov_id", shape=[1], dtype="int64")
+        mov_title = layers.data("mov_title", shape=[TT], dtype="int64")
+        mov_title_len = layers.data("mov_title_len", shape=[], dtype="int32")
+        score_v = layers.data("score", shape=[1], dtype="float32")
+        predict, avg_cost = models.recommender_system(
+            usr_id, usr_gender, usr_age, usr_job, mov_id, mov_title,
+            mov_title_len, score_v, user_vocab=100, movie_vocab=200,
+            title_vocab=100, emb_dim=16)
+        fluid.optimizer.Adam(0.02).minimize(avg_cost, startup)
+
+    losses, _, _ = _train(main, startup, lambda i: feed_np, avg_cost, steps=60)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_label_semantic_roles():
+    rng = np.random.RandomState(4)
+    N, T, WD, MD, LD = 16, 8, 50, 2, 5
+    word = rng.randint(0, WD, (N, T)).astype("int64")
+    mark = rng.randint(0, MD, (N, T)).astype("int64")
+    lens = np.full((N,), T, "int32")
+    target = ((word * 3 + mark) % LD).astype("int64")  # learnable tags
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = layers.data("word", shape=[T], dtype="int64")
+        m = layers.data("mark", shape=[T], dtype="int64")
+        ln = layers.data("len", shape=[], dtype="int32")
+        t = layers.data("target", shape=[T], dtype="int64")
+        emission, crf_cost = models.label_semantic_roles(
+            w, m, ln, t, WD, MD, LD, word_dim=16, mark_dim=4,
+            hidden_dim=32, depth=2)
+        avg_cost = layers.mean(crf_cost)
+        fluid.optimizer.Adam(0.05).minimize(avg_cost, startup)
+
+    feed = lambda i: {"word": word, "mark": mark, "len": lens, "target": target}
+    losses, scope, exe = _train(main, startup, feed, avg_cost, steps=60)
+    assert losses[-1] < losses[0] * 0.25, (losses[0], losses[-1])
+
+    # decode with the trained transition and check tag accuracy
+    m2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2, s2):
+        e = layers.data("e", shape=[T, LD], dtype="float32")
+        ln2 = layers.data("len", shape=[], dtype="int32")
+        path = layers.crf_decoding(e, length=ln2,
+                                   param_attr=fluid.ParamAttr(name="crfw"))
+    (em_v,) = exe.run(main, feed=feed(0), fetch_list=[emission], scope=scope)
+    (path_v,) = exe.run(m2, feed={"e": em_v, "len": lens}, fetch_list=[path],
+                        scope=scope)
+    assert (path_v == target).mean() > 0.8
+
+
+def test_rnn_encoder_decoder():
+    rng = np.random.RandomState(5)
+    N, TS, TT, SV, TV = 16, 7, 6, 30, 25
+    src = rng.randint(1, SV, (N, TS)).astype("int64")
+    src_len = np.full((N,), TS, "int32")
+    trg = rng.randint(1, TV, (N, TT)).astype("int64")
+    trg_len = np.full((N,), TT, "int32")
+    trg_next = np.roll(trg, -1, axis=1)
+    trg_next[:, -1] = 0
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        s = layers.data("src", shape=[TS], dtype="int64")
+        sl = layers.data("src_len", shape=[], dtype="int32")
+        t = layers.data("trg", shape=[TT], dtype="int64")
+        tl = layers.data("trg_len", shape=[], dtype="int32")
+        tn = layers.data("trg_next", shape=[TT], dtype="int64")
+        predict, avg_cost = models.rnn_encoder_decoder(
+            s, sl, t, tl, tn, SV, TV, embed_dim=16, hidden=32)
+        fluid.optimizer.Adam(0.02).minimize(avg_cost, startup)
+
+    feed = lambda i: {"src": src, "src_len": src_len, "trg": trg,
+                      "trg_len": trg_len, "trg_next": trg_next}
+    losses, _, _ = _train(main, startup, feed, avg_cost, steps=50)
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
