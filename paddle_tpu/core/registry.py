@@ -163,6 +163,17 @@ def infer_and_create_outputs(op: Operator, block: Block) -> None:
     match the real computation.
     """
     opdef = get_op_def(op.type)
+    if opdef.no_grad:
+        # outputs of gradient-free ops (metrics, matching, NMS, …) are
+        # constants to autodiff: mark them stop_gradient so append_backward
+        # never chases a path through them (<- backward.py _remove_no_grad_branch_)
+        for names in op.outputs.values():
+            for n in names:
+                if not n:
+                    continue
+                v = block.vars.get(n) or block.find_var_recursive(n)
+                if v is not None:
+                    v.stop_gradient = True
     if opdef.infer_shape is not None:
         opdef.infer_shape(op, block)
         return
@@ -243,10 +254,13 @@ def default_grad_op_descs(op: Operator, no_grad_set=frozenset()) -> List[dict]:
         g_inputs[slot] = list(names)
         g_inputs[slot + GRAD_SUFFIX] = [grad_var_name(n) for n in names]
     g_outputs = {}
+    opdef = _REGISTRY.get(op.type)
+    diff = None if opdef is None or opdef.diff_inputs is None else set(opdef.diff_inputs)
     for slot, names in op.inputs.items():
         outs = []
         for n in names:
-            outs.append("" if n in no_grad_set else grad_var_name(n))
+            dead = n in no_grad_set or (diff is not None and slot not in diff)
+            outs.append("" if dead else grad_var_name(n))
         g_outputs[slot + GRAD_SUFFIX] = outs
     return [
         {

@@ -1,0 +1,202 @@
+"""Detection layers (<- python/paddle/fluid/layers/detection.py).
+
+Builds on the dense/masked detection ops in ``paddle_tpu.ops.detection``.
+Where the reference threads LoDTensors of per-image variable box counts,
+these layers take padded [B, N, ...] tensors plus validity masks (label -1 /
+``gt_valid`` masks) — the XLA-friendly redesign described in SURVEY.md §5.7.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None, offset=0.5,
+              name=None):
+    """<- detection.py prior_box (SSD anchors for one feature map)."""
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        "prior_box", {"Input": [input], "Image": [image]},
+        {"Boxes": [boxes], "Variances": [var]},
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios or [1.0]),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "flip": flip, "clip": clip, "step_w": steps[0], "step_h": steps[1],
+         "offset": offset})
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", name=None):
+    """<- detection.py box_coder."""
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", ins, {"OutputBox": [out]},
+                     {"code_type": code_type})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    """<- detection.py iou_similarity."""
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("iou_similarity", {"X": [x], "Y": [y]}, {"Out": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, row_valid=None, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """<- detection.py bipartite_match; ``row_valid`` replaces gt LoD."""
+    helper = LayerHelper("bipartite_match", name=name)
+    midx = helper.create_variable_for_type_inference("int32")
+    mdist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    ins = {"DistMat": [dist_matrix]}
+    if row_valid is not None:
+        ins["RowValid"] = [row_valid]
+    helper.append_op("bipartite_match", ins,
+                     {"ColToRowMatchIndices": [midx],
+                      "ColToRowMatchDist": [mdist]},
+                     {"match_type": match_type, "dist_threshold": dist_threshold})
+    return midx, mdist
+
+
+def target_assign(input, match_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """<- detection.py target_assign."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [input], "MatchIndices": [match_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    helper.append_op("target_assign", ins, {"Out": [out], "OutWeight": [w]},
+                     {"mismatch_value": mismatch_value})
+    return out, w
+
+
+def mine_hard_examples(cls_loss, match_indices, loc_loss=None,
+                       neg_pos_ratio=3.0, mining_type="max_negative",
+                       sample_size=0, name=None):
+    """<- detection.py ssd_loss's internal mine_hard_examples op."""
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg = helper.create_variable_for_type_inference("bool")
+    upd = helper.create_variable_for_type_inference("int32")
+    ins = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices]}
+    if loc_loss is not None:
+        ins["LocLoss"] = [loc_loss]
+    helper.append_op("mine_hard_examples", ins,
+                     {"NegMask": [neg], "UpdatedMatchIndices": [upd]},
+                     {"neg_pos_ratio": neg_pos_ratio, "mining_type": mining_type,
+                      "sample_size": sample_size})
+    return neg, upd
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
+                   nms_threshold=0.3, keep_top_k=200, background_label=0,
+                   name=None):
+    """<- detection.py detection_output's NMS stage; fixed-capacity output
+    [B, keep_top_k, 6] with label -1 in empty rows."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op("multiclass_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+                     {"Out": [out]},
+                     {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+                      "nms_threshold": nms_threshold, "keep_top_k": keep_top_k,
+                      "background_label": background_label})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, name=None):
+    """<- detection.py detection_output: decode predicted offsets against
+    priors then run multiclass NMS.  loc: [B, M, 4]; scores: [B, C, M]."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    return multiclass_nms(decoded, scores, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, nms_threshold=nms_threshold,
+                          keep_top_k=keep_top_k, background_label=background_label,
+                          name=name)
+
+
+def polygon_box_transform(input, name=None):
+    """<- detection.py polygon_box_transform."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", {"Input": [input]},
+                     {"Output": [out]})
+    return out
+
+
+def roi_pool(input, rois, rois_batch=None, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    """<- nn.py roi_pool (roi_pool_op.cc)."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["ROIsBatch"] = [rois_batch]
+    helper.append_op("roi_pool", ins, {"Out": [out]},
+                     {"pooled_height": pooled_height, "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """<- detection.py detection_map (single-batch AP; streaming accumulation
+    lives in metrics.DetectionMAP)."""
+    helper = LayerHelper("detection_map", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("detection_map",
+                     {"DetectRes": [detect_res], "Label": [label]},
+                     {"MAP": [out]},
+                     {"class_num": class_num, "background_label": background_label,
+                      "overlap_threshold": overlap_threshold,
+                      "evaluate_difficult": evaluate_difficult,
+                      "ap_type": ap_version})
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, gt_valid=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, mining_type="max_negative",
+             sample_size=0, match_type="per_prediction", name=None):
+    """SSD multibox loss (<- detection.py ssd_loss, 5-step recipe).
+
+    location: [B, M, 4] predicted offsets; confidence: [B, M, C] logits;
+    gt_box: [B, G, 4]; gt_label: [B, G] int; prior_box: [M, 4];
+    gt_valid: [B, G] mask of real gt rows (replaces the reference's LoD).
+
+    The reference composes ~10 intermediate ops (iou, bipartite_match,
+    mine_hard_examples, two target_assigns, softmax + smooth_l1, …); here
+    the whole recipe is ONE fused op — on TPU the sub-steps are elementwise/
+    sort/gather work that XLA fuses into a single kernel cluster, and a
+    fused op keeps the IR small and the vjp single-pass.
+    """
+    helper = LayerHelper("ssd_loss", name=name)
+    out = helper.create_variable_for_type_inference(location.dtype)
+    ins = {"Location": [location], "Confidence": [confidence],
+           "GTBox": [gt_box], "GTLabel": [gt_label], "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    if gt_valid is not None:
+        ins["GTValid"] = [gt_valid]
+    helper.append_op("ssd_loss", ins, {"Loss": [out]},
+                     {"background_label": background_label,
+                      "overlap_threshold": overlap_threshold,
+                      "neg_pos_ratio": neg_pos_ratio,
+                      "loc_loss_weight": loc_loss_weight,
+                      "conf_loss_weight": conf_loss_weight,
+                      "mining_type": mining_type,
+                      "sample_size": sample_size,
+                      "match_type": match_type})
+    return out

@@ -7,6 +7,7 @@ benchmark workloads named in BASELINE.json.
 from .lenet import lenet5  # noqa: F401
 from .resnet import resnet_cifar10, resnet50  # noqa: F401
 from .vgg import vgg16  # noqa: F401
+from .ssd import ssd_mobilenet  # noqa: F401
 from .ctr import deepfm_ctr, wide_deep_ctr  # noqa: F401
 from .seq2seq import Seq2SeqAttention  # noqa: F401
 from .book import (  # noqa: F401

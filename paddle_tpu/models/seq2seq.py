@@ -66,8 +66,14 @@ class Seq2SeqAttention:
                            bias_attr=ParamAttr(self.p["out_b"]))
         loss = layers.softmax_with_cross_entropy(logits, trg_next_ids)
         tmax = int(trg_ids.shape[1])
+        # per-token loss is pad-masked before being exposed: positions past
+        # trg_length carry no signal (callers use it for per-position stats)
+        mask = seq_layers.sequence_mask(trg_length, maxlen=tmax, dtype=loss.dtype)
+        if loss.shape is not None and len(loss.shape) == 3:
+            mask = layers.reshape(mask, [0, tmax, 1])
+        masked_loss = layers.elementwise_mul(loss, mask)
         avg_loss = seq_layers.masked_sequence_mean(loss, trg_length, maxlen=tmax)
-        return avg_loss, loss
+        return avg_loss, masked_loss
 
     def build_decode(self, src_ids, src_length, beam_size=4, max_len=16,
                      bos_id=0, eos_id=1):

@@ -1,0 +1,82 @@
+"""MobileNet-SSD-style single-shot detector.
+
+<- the SSD pieces of python/paddle/fluid/layers/detection.py assembled the
+way the reference's models use them (prior_box per feature map, ssd_loss for
+training, detection_output for inference).  Backbone is a small depthwise-
+separable conv stack; two detection heads over two feature-map scales keep
+the model compact enough for CI while exercising the full detection op
+family end to end.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def _dw_sep_block(x, out_ch, stride, name):
+    """Depthwise separable conv (MobileNet building block)."""
+    in_ch = x.shape[1]
+    dw = layers.conv2d(x, in_ch, 3, stride=stride, padding=1, groups=in_ch,
+                       act="relu", name=f"{name}.dw")
+    return layers.conv2d(dw, out_ch, 1, act="relu", name=f"{name}.pw")
+
+
+def _head(feat, num_priors, num_classes, name):
+    """Per-scale detection head -> (loc [B, HWP, 4], conf [B, HWP, C])."""
+    loc = layers.conv2d(feat, num_priors * 4, 3, padding=1, name=f"{name}.loc")
+    conf = layers.conv2d(feat, num_priors * num_classes, 3, padding=1,
+                         name=f"{name}.conf")
+    b = loc.shape[0]
+    h, w = loc.shape[2], loc.shape[3]
+    loc = layers.reshape(layers.transpose(loc, [0, 2, 3, 1]),
+                         [b, h * w * num_priors, 4])
+    conf = layers.reshape(layers.transpose(conf, [0, 2, 3, 1]),
+                          [b, h * w * num_priors, num_classes])
+    return loc, conf
+
+
+def ssd_mobilenet(image, gt_box=None, gt_label=None, gt_valid=None,
+                  num_classes=21, is_test=False):
+    """Build the detector over ``image`` [B, 3, H, W] (H, W multiples of 16).
+
+    ``gt_box`` is [B, G, 4] in NORMALIZED [0, 1] corner coordinates (the
+    same space prior_box emits) — pixel-space gt produces near-zero IoU with
+    the priors and a silently zero loss.
+
+    Training (is_test=False): returns the scalar ssd_loss.
+    Inference: returns [B, keep_top_k, 6] NMS'd detections.  To share
+    trained parameters between separately-built train/infer programs, build
+    both under ``fluid.unique_name.guard()`` so parameter names line up.
+    """
+    x = layers.conv2d(image, 16, 3, stride=2, padding=1, act="relu",
+                      name="ssd.stem")
+    x = _dw_sep_block(x, 32, 2, "ssd.b1")
+    f1 = _dw_sep_block(x, 64, 2, "ssd.b2")    # stride 8 feature map
+    f2 = _dw_sep_block(f1, 128, 2, "ssd.b3")  # stride 16 feature map
+
+    img_h, img_w = image.shape[2], image.shape[3]
+    boxes1, var1 = layers.prior_box(
+        f1, image, min_sizes=[img_h * 0.1], max_sizes=[img_h * 0.25],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    boxes2, var2 = layers.prior_box(
+        f2, image, min_sizes=[img_h * 0.3], max_sizes=[img_h * 0.6],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    p1 = boxes1.shape[2]
+    p2 = boxes2.shape[2]
+
+    loc1, conf1 = _head(f1, p1, num_classes, "ssd.h1")
+    loc2, conf2 = _head(f2, p2, num_classes, "ssd.h2")
+    loc = layers.concat([loc1, loc2], axis=1)
+    conf = layers.concat([conf1, conf2], axis=1)
+    prior = layers.concat(
+        [layers.reshape(boxes1, [-1, 4]), layers.reshape(boxes2, [-1, 4])],
+        axis=0)
+    pvar = layers.concat(
+        [layers.reshape(var1, [-1, 4]), layers.reshape(var2, [-1, 4])], axis=0)
+
+    if is_test:
+        scores = layers.transpose(layers.softmax(conf), [0, 2, 1])  # [B, C, M]
+        return layers.detection_output(loc, scores, prior, pvar,
+                                       score_threshold=0.01, keep_top_k=50)
+    loss = layers.ssd_loss(loc, conf, gt_box, gt_label, prior,
+                           prior_box_var=pvar, gt_valid=gt_valid)
+    return loss

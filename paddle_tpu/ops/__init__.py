@@ -19,3 +19,4 @@ from . import attention  # noqa: F401
 from . import pallas_attention  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import structured  # noqa: F401
+from . import detection  # noqa: F401

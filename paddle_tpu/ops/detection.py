@@ -1,0 +1,601 @@
+"""Detection op family, TPU-native.
+
+<- paddle/fluid/operators/detection/{prior_box,box_coder,iou_similarity,
+bipartite_match,target_assign,mine_hard_examples,multiclass_nms,
+polygon_box_transform}_op.cc, detection_map_op.cc, roi_pool_op.cc.
+
+Redesigned for XLA: every op is dense, fixed-shape, and masked.  The
+reference's LoD-batched variable-count boxes become padded [N, M, ...]
+tensors with explicit validity masks; NMS is sort + iterative suppression
+under ``lax.fori_loop`` instead of data-dependent loops; bipartite matching
+is a greedy global-argmax loop of static trip count.  Outputs that the
+reference emits as variable-length LoDTensors (e.g. multiclass_nms) come out
+as fixed-capacity buffers with a ``-1`` label marking empty slots — the same
+convention the reference uses for "no detection" rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("prior_box", inputs=("Input", "Image"), outputs=("Boxes", "Variances"),
+             no_grad=True)
+def prior_box(ctx, ins, attrs):
+    """SSD prior (anchor) boxes for one feature map (<- prior_box_op.cc).
+
+    Returns Boxes/Variances of shape [H, W, num_priors, 4] in normalized
+    [xmin, ymin, xmax, ymax] corner form.
+    """
+    feat, image = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[-2], feat.shape[-1]
+    img_h, img_w = image.shape[-2], image.shape[-1]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            f"prior_box: len(max_sizes)={len(max_sizes)} must equal "
+            f"len(min_sizes)={len(min_sizes)}")
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [1.0])]
+    flip = attrs.get("flip", True)
+    clip = attrs.get("clip", True)
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or float(img_w) / w
+    step_h = float(attrs.get("step_h", 0.0)) or float(img_h) / h
+    offset = float(attrs.get("offset", 0.5))
+
+    # expand aspect ratios like ExpandAspectRatios (prior_box_op.h)
+    out_ratios = [1.0]
+    for r in ratios:
+        if not any(abs(r - o) < 1e-6 for o in out_ratios):
+            out_ratios.append(r)
+            if flip:
+                out_ratios.append(1.0 / r)
+
+    # per-prior (width, height) in pixels, order matches reference:
+    # for each min_size: ratio-1 box, [max_size geometric-mean box], other ratios
+    ws, hs = [], []
+    for k, ms in enumerate(min_sizes):
+        ws.append(ms)
+        hs.append(ms)
+        if max_sizes:
+            big = (ms * max_sizes[k]) ** 0.5
+            ws.append(big)
+            hs.append(big)
+        for r in out_ratios:
+            if abs(r - 1.0) < 1e-6:
+                continue
+            ws.append(ms * r ** 0.5)
+            hs.append(ms / r ** 0.5)
+    ws = jnp.asarray(ws, jnp.float32)  # [P]
+    hs = jnp.asarray(hs, jnp.float32)
+    num_priors = ws.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h  # [H]
+    cx = cx[None, :, None]  # [1, W, 1]
+    cy = cy[:, None, None]  # [H, 1, 1]
+    half_w = ws[None, None, :] / 2.0  # [1, 1, P]
+    half_h = hs[None, None, :] / 2.0
+    xmin = (cx - half_w) / img_w
+    ymin = (cy - half_h) / img_h
+    xmax = (cx + half_w) / img_w
+    ymax = (cy + half_h) / img_h
+    boxes = jnp.stack(
+        [jnp.broadcast_to(a, (h, w, num_priors)) for a in (xmin, ymin, xmax, ymax)],
+        axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, num_priors, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _corner_to_center(boxes):
+    """[..., 4] corner -> (cx, cy, w, h)."""
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w / 2.0
+    cy = boxes[..., 1] + h / 2.0
+    return cx, cy, w, h
+
+
+@register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"),
+             outputs=("OutputBox",), no_grad=True)
+def box_coder(ctx, ins, attrs):
+    """Encode/decode boxes against priors in center-size form (<- box_coder_op.cc).
+
+    encode_center_size: TargetBox [N, 4] gt boxes vs PriorBox [M, 4]
+        -> [N, M, 4] offsets.
+    decode_center_size: TargetBox [N, M, 4] offsets -> [N, M, 4] corner boxes.
+    """
+    prior = ins["PriorBox"][0]  # [M, 4]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None  # [M, 4]
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    pcx, pcy, pw, ph = _corner_to_center(prior)  # [M]
+    if pvar is None:
+        pvar = jnp.ones(prior.shape[-1:], jnp.float32)
+    if code_type == "encode_center_size":
+        tcx, tcy, tw, th = _corner_to_center(target)  # [N]
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1) / pvar
+    else:  # decode_center_size
+        d = target * pvar
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    return {"OutputBox": [out]}
+
+
+def pairwise_iou(a, b):
+    """IoU between [N, 4] and [M, 4] corner boxes -> [N, M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",), no_grad=True)
+def iou_similarity(ctx, ins, attrs):
+    """Pairwise IoU matrix (<- iou_similarity_op.cc)."""
+    return {"Out": [pairwise_iou(ins["X"][0], ins["Y"][0])]}
+
+
+def _greedy_bipartite(sim, row_valid):
+    """Greedy global-argmax bipartite match (<- bipartite_match_op.cc).
+
+    sim: [N, M] similarity (rows = gt, cols = priors); row_valid: [N] mask.
+    Returns (match_idx [M] int32 row-or--1, match_dist [M]).
+    """
+    n, m = sim.shape
+    sim = jnp.where(row_valid[:, None], sim, -1.0)
+
+    def body(_, state):
+        s, midx, mdist = state
+        flat = jnp.argmax(s)
+        i, j = flat // m, flat % m
+        best = s[i, j]
+        take = best > 0
+        midx = jnp.where(take, midx.at[j].set(i.astype(jnp.int32)), midx)
+        mdist = jnp.where(take, mdist.at[j].set(best), mdist)
+        # retire the matched row and column
+        s = jnp.where(take, s.at[i, :].set(-1.0).at[:, j].set(-1.0), s)
+        return s, midx, mdist
+
+    midx0 = jnp.full((m,), -1, jnp.int32)
+    mdist0 = jnp.zeros((m,), sim.dtype)
+    _, midx, mdist = lax.fori_loop(0, n, body, (sim, midx0, mdist0))
+    return midx, mdist
+
+
+def _match_priors(sim, row_valid, match_type, thr):
+    """Shared matching recipe: greedy bipartite, optionally topped up with
+    per-prediction argmax matches above ``thr`` (<- bipartite_match_op.cc
+    match_type). Returns (match_idx [M], match_dist [M])."""
+    midx, mdist = _greedy_bipartite(sim, row_valid)
+    if match_type == "per_prediction":
+        simv = jnp.where(row_valid[:, None], sim, -1.0)
+        best_row = jnp.argmax(simv, axis=0).astype(jnp.int32)
+        best = jnp.max(simv, axis=0)
+        extra = (midx < 0) & (best >= thr)
+        midx = jnp.where(extra, best_row, midx)
+        mdist = jnp.where(extra, best, mdist)
+    return midx, mdist
+
+
+def _mine_negatives(loss, matched, neg_pos_ratio, mining_type, sample_size):
+    """Shared hard-negative mining (<- mine_hard_examples_op.cc).
+
+    loss: [B, M] per-prior loss; matched: [B, M] bool. Returns bool mask of
+    selected negatives, capped per image at neg_pos_ratio * num_positives
+    (max_negative) or sample_size (hard_example)."""
+    neg_loss = jnp.where(~matched, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    num_pos = jnp.sum(matched.astype(jnp.int32), axis=1, keepdims=True)
+    if mining_type == "hard_example" and sample_size > 0:
+        limit = jnp.full_like(num_pos, sample_size)
+    else:
+        limit = (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32)
+    return (~matched) & (rank < limit) & jnp.isfinite(neg_loss)
+
+
+@register_op("bipartite_match", inputs=("DistMat", "RowValid"),
+             outputs=("ColToRowMatchIndices", "ColToRowMatchDist"), no_grad=True)
+def bipartite_match(ctx, ins, attrs):
+    """Batched greedy bipartite matching (<- bipartite_match_op.cc).
+
+    DistMat: [B, N, M]; RowValid: [B, N] bool mask of real gt rows (the
+    reference uses LoD to delimit per-image gt counts).  match_type
+    'per_prediction' additionally matches any unmatched column whose best
+    row-distance exceeds overlap_threshold.
+    """
+    dist = ins["DistMat"][0]
+    row_valid = ins["RowValid"][0].astype(bool) if ins.get("RowValid") else \
+        jnp.ones(dist.shape[:-1], bool)
+    match_type = attrs.get("match_type", "bipartite")
+    thr = float(attrs.get("dist_threshold", 0.5))
+
+    midx, mdist = jax.vmap(
+        lambda sim, rv: _match_priors(sim, rv, match_type, thr))(dist, row_valid)
+    return {"ColToRowMatchIndices": [midx], "ColToRowMatchDist": [mdist]}
+
+
+@register_op("target_assign", inputs=("X", "MatchIndices", "NegIndices"),
+             outputs=("Out", "OutWeight"), no_grad=True)
+def target_assign(ctx, ins, attrs):
+    """Gather per-prior targets by match indices (<- target_assign_op.cc).
+
+    X: [B, N, K] per-gt targets; MatchIndices: [B, M] (-1 = unmatched).
+    Out[b, m] = X[b, MatchIndices[b, m]] with mismatch_value fill,
+    OutWeight = 1 for matched (or negative-listed) entries.
+    """
+    x = ins["X"][0]
+    midx = ins["MatchIndices"][0]
+    mismatch = attrs.get("mismatch_value", 0)
+    safe = jnp.maximum(midx, 0)
+    out = jnp.take_along_axis(x, safe[..., None].astype(jnp.int32), axis=1)
+    matched = (midx >= 0)[..., None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    w = matched.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        # NegIndices: [B, M] bool/int mask of hard negatives to include
+        neg = ins["NegIndices"][0].astype(bool)[..., None]
+        out = jnp.where(neg & ~matched, jnp.asarray(mismatch, x.dtype), out)
+        w = jnp.maximum(w, neg.astype(jnp.float32))
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("mine_hard_examples", inputs=("ClsLoss", "LocLoss", "MatchIndices"),
+             outputs=("NegMask", "UpdatedMatchIndices"), no_grad=True)
+def mine_hard_examples(ctx, ins, attrs):
+    """Hard-negative mining (<- mine_hard_examples_op.cc).
+
+    Selects the highest-loss unmatched priors per image, capped at
+    neg_pos_ratio * num_positives (max_negative) or sample_size (hard_example).
+    Returns a dense bool NegMask [B, M] instead of the reference's LoD index
+    list.
+    """
+    cls_loss = ins["ClsLoss"][0]  # [B, M]
+    midx = ins["MatchIndices"][0]  # [B, M]
+    loss = cls_loss
+    if ins.get("LocLoss"):
+        loss = loss + ins["LocLoss"][0]
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    mining_type = attrs.get("mining_type", "max_negative")
+    sample_size = int(attrs.get("sample_size", 0))
+
+    neg_mask = _mine_negatives(loss, midx >= 0, neg_pos_ratio, mining_type,
+                               sample_size)
+    return {"NegMask": [neg_mask],
+            "UpdatedMatchIndices": [jnp.where(neg_mask, -1, midx)]}
+
+
+def _nms_single_class(iou_all, scores, valid, iou_thr, top_k):
+    """Greedy NMS over one class; returns keep mask [M].
+
+    ``iou_all`` is the class-independent [M, M] pairwise IoU of the shared
+    boxes — computed ONCE per image and re-indexed per class (only the score
+    order differs between classes)."""
+    m = scores.shape[0]
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    v = valid[order]
+    iou = iou_all[order][:, order]
+
+    def body(i, keep):
+        # suppressed if any earlier-kept box overlaps > thr
+        earlier = jnp.arange(m) < i
+        sup = jnp.sum(jnp.where(earlier, keep * (iou[i] > iou_thr), 0.0)) > 0
+        ki = jnp.where(v[i] & ~sup, 1.0, 0.0)
+        return keep.at[i].set(ki)
+
+    keep_sorted = lax.fori_loop(0, m, body, jnp.zeros((m,), jnp.float32))
+    if top_k > 0:
+        csum = jnp.cumsum(keep_sorted)
+        keep_sorted = jnp.where(csum <= top_k, keep_sorted, 0.0)
+    keep = jnp.zeros((m,), jnp.float32).at[order].set(keep_sorted)
+    return keep > 0
+
+
+@register_op("multiclass_nms", inputs=("BBoxes", "Scores"), outputs=("Out",),
+             no_grad=True)
+def multiclass_nms(ctx, ins, attrs):
+    """Per-class NMS + cross-class keep_top_k (<- multiclass_nms_op.cc).
+
+    BBoxes: [B, M, 4]; Scores: [B, C, M].  Out: [B, keep_top_k, 6] rows of
+    [label, score, xmin, ymin, xmax, ymax]; empty slots have label -1 —
+    fixed capacity replacing the reference's LoD output.
+    """
+    bboxes, scores = ins["BBoxes"][0], ins["Scores"][0]
+    score_thr = float(attrs.get("score_threshold", 0.0))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 0))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    background = int(attrs.get("background_label", 0))
+    c = scores.shape[1]
+    m = scores.shape[2]
+    if keep_top_k <= 0:
+        keep_top_k = c * m
+    # non-background classes only: background never reaches NMS
+    fg = np.asarray([cls for cls in range(c) if cls != background], np.int32)
+
+    def per_image(bb, sc):
+        iou_all = pairwise_iou(bb, bb)  # shared across classes
+        sc_fg = sc[fg]  # [C-1, M]
+
+        def per_class(cls_scores):
+            valid = cls_scores > score_thr
+            return _nms_single_class(iou_all, cls_scores, valid, nms_thr,
+                                     nms_top_k)
+
+        keep = jax.vmap(per_class)(sc_fg)  # [C-1, M]
+        flat_scores = jnp.where(keep, sc_fg, -jnp.inf).reshape(-1)
+        # fixed [keep_top_k] capacity even when (C-1)*M < keep_top_k: pad the
+        # candidate pool with -inf slots so the output shape is static
+        pad = max(0, keep_top_k - flat_scores.shape[0])
+        if pad:
+            flat_scores = jnp.concatenate(
+                [flat_scores, jnp.full((pad,), -jnp.inf, flat_scores.dtype)])
+        order = jnp.argsort(-flat_scores)[:keep_top_k]
+        sel_scores = flat_scores[order]
+        safe = jnp.minimum(order, fg.shape[0] * m - 1)
+        sel_labels = jnp.asarray(fg)[safe // m].astype(jnp.float32)
+        sel_boxes = bb[safe % m]
+        ok = jnp.isfinite(sel_scores)
+        rows = jnp.concatenate(
+            [jnp.where(ok, sel_labels, -1.0)[:, None],
+             jnp.where(ok, sel_scores, 0.0)[:, None],
+             jnp.where(ok[:, None], sel_boxes, 0.0)], axis=1)
+        return rows
+
+    return {"Out": [jax.vmap(per_image)(bboxes, scores)]}
+
+
+@register_op("polygon_box_transform", inputs=("Input",), outputs=("Output",),
+             no_grad=True)
+def polygon_box_transform(ctx, ins, attrs):
+    """Quad offset field -> absolute vertex coordinates
+    (<- polygon_box_transform_op.cc).  Input [N, 8k, H, W]: even channels are
+    x-offsets, odd channels y-offsets from the pixel center grid."""
+    x = ins["Input"][0]
+    n, cch, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :], (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    is_x = (jnp.arange(cch) % 2 == 0)[None, :, None, None]
+    grid = jnp.where(is_x, col[None, None], row[None, None])
+    return {"Output": [4.0 * grid - x]}
+
+
+@register_op("roi_pool", inputs=("X", "ROIs", "ROIsBatch"), outputs=("Out",),
+             diff_inputs=("X",))
+def roi_pool(ctx, ins, attrs):
+    """Max-pool each ROI into a fixed pooled grid (<- roi_pool_op.cc).
+
+    X: [N, C, H, W]; ROIs: [R, 4] (x1, y1, x2, y2) at input scale;
+    ROIsBatch: [R] image index per roi.  Quantization matches the reference
+    (floor/ceil of scaled coords, bins clamped to >=1 element).  Implemented
+    as a masked max over the full spatial map per bin — dense and fusable,
+    no gather with data-dependent extents; grads flow via the max.
+    """
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    batch_idx = ins["ROIsBatch"][0].astype(jnp.int32) if ins.get("ROIsBatch") \
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bi):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        py = jnp.arange(ph, dtype=jnp.float32)
+        px = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(py * bin_h) + y1, 0, h)  # [ph]
+        hend = jnp.clip(jnp.ceil((py + 1) * bin_h) + y1, 0, h)
+        wstart = jnp.clip(jnp.floor(px * bin_w) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((px + 1) * bin_w) + x1, 0, w)
+        hh = jnp.arange(h, dtype=jnp.float32)
+        ww = jnp.arange(w, dtype=jnp.float32)
+        hmask = (hh[None, :] >= hstart[:, None]) & (hh[None, :] < hend[:, None])
+        wmask = (ww[None, :] >= wstart[:, None]) & (ww[None, :] < wend[:, None])
+        mask = hmask[:, None, :, None] & wmask[None, :, None, :]  # [ph,pw,h,w]
+        img = x[bi]  # [C, H, W]
+        masked = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(masked, axis=(-2, -1))  # [C, ph, pw]
+        empty = ~jnp.any(mask, axis=(-2, -1))  # [ph, pw]
+        return jnp.where(empty[None], 0.0, out)
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32), batch_idx)
+    return {"Out": [out]}
+
+
+@register_op("ssd_loss",
+             inputs=("Location", "Confidence", "GTBox", "GTLabel",
+                     "PriorBox", "PriorBoxVar", "GTValid"),
+             outputs=("Loss",), diff_inputs=("Location", "Confidence"))
+def ssd_loss(ctx, ins, attrs):
+    """Fused SSD multibox loss (<- python layers/detection.py ssd_loss).
+
+    One op covering the reference's 5-step recipe: IoU matching, per-prior
+    conf loss, hard-negative mining, target assignment, weighted
+    smooth-l1 + softmax losses normalized by positive count.  Matching and
+    mining are wrapped in stop_gradient; grads flow only through the
+    smooth-l1/softmax terms w.r.t. Location/Confidence.
+    """
+    loc = ins["Location"][0]        # [B, M, 4]
+    conf = ins["Confidence"][0]     # [B, M, C]
+    gt_box = ins["GTBox"][0]        # [B, G, 4]
+    gt_label = ins["GTLabel"][0]    # [B, G]
+    prior = ins["PriorBox"][0]      # [M, 4]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else \
+        jnp.ones((4,), jnp.float32)
+    gt_valid = ins["GTValid"][0].astype(bool) if ins.get("GTValid") else \
+        jnp.ones(gt_box.shape[:2], bool)
+    background = int(attrs.get("background_label", 0))
+    thr = float(attrs.get("overlap_threshold", 0.5))
+    npr = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    match_type = attrs.get("match_type", "per_prediction")
+    mining_type = attrs.get("mining_type", "max_negative")
+    sample_size = int(attrs.get("sample_size", 0))
+    if gt_label.ndim == 3:
+        gt_label = gt_label.squeeze(-1)
+    gt_label = gt_label.astype(jnp.int32)
+
+    def match_one(gb, gv):
+        return _match_priors(pairwise_iou(gb, prior), gv, match_type, thr)[0]
+
+    midx = lax.stop_gradient(jax.vmap(match_one)(gt_box, gt_valid))  # [B, M]
+    matched = midx >= 0
+    safe = jnp.maximum(midx, 0)
+
+    # per-prior class targets
+    tgt_label = jnp.take_along_axis(gt_label, safe, axis=1)
+    tgt_label = jnp.where(matched, tgt_label, background)
+
+    # softmax CE per prior
+    logz = jax.nn.logsumexp(conf, axis=-1)
+    picked = jnp.take_along_axis(conf, tgt_label[..., None], axis=-1).squeeze(-1)
+    ce = logz - picked  # [B, M]
+
+    # hard-negative mining on the conf loss (stop-gradient, like the
+    # reference which mines on an auxiliary loss evaluation)
+    neg_mask = _mine_negatives(lax.stop_gradient(ce), matched, npr,
+                               mining_type, sample_size)
+    num_pos = jnp.sum(matched.astype(jnp.int32), axis=1, keepdims=True)
+
+    conf_loss = jnp.where(matched | neg_mask, ce, 0.0)
+
+    # localization targets: encode matched gt against priors (center-size)
+    gt_matched = jnp.take_along_axis(gt_box, safe[..., None], axis=1)  # [B,M,4]
+    pcx, pcy, pw, ph = _corner_to_center(prior)
+    tcx, tcy, tw, th = _corner_to_center(gt_matched)
+    dx = (tcx - pcx[None]) / pw[None]
+    dy = (tcy - pcy[None]) / ph[None]
+    dw = jnp.log(jnp.maximum(tw / pw[None], 1e-10))
+    dh = jnp.log(jnp.maximum(th / ph[None], 1e-10))
+    loc_tgt = lax.stop_gradient(jnp.stack([dx, dy, dw, dh], axis=-1) / pvar)
+
+    diff = jnp.abs(loc - loc_tgt)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)  # [B, M]
+    loc_loss = jnp.where(matched, sl1, 0.0)
+
+    total = loc_w * loc_loss + conf_w * conf_loss  # [B, M]
+    denom = jnp.maximum(jnp.sum(num_pos).astype(total.dtype), 1.0)
+    return {"Loss": [jnp.sum(total) / denom]}
+
+
+@register_op("detection_map",
+             inputs=("DetectRes", "Label", "PosCount", "TruePos", "FalsePos"),
+             outputs=("MAP",), no_grad=True)
+def detection_map(ctx, ins, attrs):
+    """Mean average precision over detections (<- detection_map_op.cc).
+
+    DetectRes: [B, D, 6] rows [label, score, x1, y1, x2, y2] (label -1 =
+    empty slot); Label: [B, G, 6] rows [label, x1, y1, x2, y2, is_difficult]
+    (label -1 = empty).  Single-batch AP ('integral' or '11point'); the
+    streaming PosCount/TruePos/FalsePos accumulation of the reference is
+    handled host-side by metrics.DetectionMAP.
+    """
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    overlap_thr = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    num_classes = int(attrs["class_num"])
+    b, d, _ = det.shape
+    g = gt.shape[1]
+
+    def ap_for_class(cls):
+        # ground truth of this class per image: [B, G]
+        gt_mask = (gt[..., 0] == cls) & (gt[..., 0] >= 0)
+        difficult = gt[..., 5] > 0 if gt.shape[-1] > 5 else jnp.zeros_like(gt_mask)
+        if not evaluate_difficult:
+            count_mask = gt_mask & ~difficult
+        else:
+            count_mask = gt_mask
+        npos = jnp.sum(count_mask)
+
+        det_mask = det[..., 0] == cls  # [B, D]
+        scores = jnp.where(det_mask, det[..., 1], -jnp.inf)
+
+        # per image: match detections (descending score) to gt, mark tp/fp
+        def per_image(dets, dmask, gts, gmask, diff):
+            order = jnp.argsort(-jnp.where(dmask, dets[:, 1], -jnp.inf))
+            dboxes = dets[order, 2:6]
+            dvalid = dmask[order]
+            iou = pairwise_iou(dboxes, gts[:, 1:5])  # [D, G]
+            iou = jnp.where(gmask[None, :], iou, -1.0)
+
+            def body(i, state):
+                used, tp, fp = state
+                best_j = jnp.argmax(jnp.where(used, -1.0, iou[i]))
+                best = jnp.where(used[best_j], -1.0, iou[i, best_j])
+                hit = (best >= overlap_thr) & dvalid[i]
+                is_diff = diff[best_j]
+                skip = hit & is_diff & (not evaluate_difficult)
+                tp = tp.at[i].set(jnp.where(hit & ~skip, 1.0, 0.0))
+                fp = fp.at[i].set(jnp.where(dvalid[i] & ~hit & ~skip, 1.0, 0.0))
+                used = used.at[best_j].set(used[best_j] | hit)
+                return used, tp, fp
+
+            used0 = jnp.zeros((g,), bool)
+            _, tp_s, fp_s = lax.fori_loop(
+                0, dets.shape[0], body,
+                (used0, jnp.zeros((dets.shape[0],)), jnp.zeros((dets.shape[0],))))
+            # un-sort back to original rows
+            tp = jnp.zeros_like(tp_s).at[order].set(tp_s)
+            fp = jnp.zeros_like(fp_s).at[order].set(fp_s)
+            return tp, fp
+
+        tp, fp = jax.vmap(per_image)(det, det_mask, gt, gt_mask, difficult)
+        flat_scores = scores.reshape(-1)
+        order = jnp.argsort(-flat_scores)
+        tp = tp.reshape(-1)[order]
+        fp = fp.reshape(-1)[order]
+        valid = jnp.isfinite(flat_scores[order])
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(npos, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(
+                lambda t: jnp.max(jnp.where(valid & (recall >= t), precision, 0.0))
+            )(pts)
+            ap = jnp.mean(pmax)
+        else:
+            dr = jnp.diff(jnp.concatenate([jnp.zeros((1,)), recall]))
+            ap = jnp.sum(jnp.where(valid, dr * precision, 0.0))
+        return jnp.where(npos > 0, ap, jnp.nan), npos > 0
+
+    background = int(attrs.get("background_label", 0))
+    classes = jnp.asarray(
+        [cls for cls in range(num_classes) if cls != background], jnp.int32)
+    # one traced copy of the matching loop, vmapped over the class axis —
+    # program size stays constant in num_classes
+    aps, has = jax.vmap(ap_for_class)(classes)
+    mAP = jnp.sum(jnp.where(has, aps, 0.0)) / jnp.maximum(jnp.sum(has), 1)
+    return {"MAP": [mAP]}

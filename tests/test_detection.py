@@ -1,0 +1,497 @@
+"""Detection op/layer tests (<- unittests/test_{prior_box,box_coder,
+iou_similarity,bipartite_match,target_assign,multiclass_nms,roi_pool,
+detection_map}_op.py, test_detection.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.models
+from op_test import OpTest
+
+
+def np_iou(a, b):
+    n, m = a.shape[0], b.shape[0]
+    out = np.zeros((n, m), np.float64)
+    for i in range(n):
+        for j in range(m):
+            ix1 = max(a[i, 0], b[j, 0]); iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2]); iy2 = min(a[i, 3], b[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ua = (a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+            ub = (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1])
+            u = ua + ub - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+class TestIouSimilarity(OpTest):
+    op_type = "iou_similarity"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(5, 4).astype("float32")
+        y = rng.rand(7, 4).astype("float32")
+        x[:, 2:] += x[:, :2]  # well-formed boxes
+        y[:, 2:] += y[:, :2]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np_iou(x, y).astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBoxCoderEncode(OpTest):
+    op_type = "box_coder"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        prior = rng.rand(8, 4).astype("float32")
+        prior[:, 2:] += prior[:, :2] + 0.1
+        pvar = rng.uniform(0.1, 0.3, (8, 4)).astype("float32")
+        target = rng.rand(5, 4).astype("float32")
+        target[:, 2:] += target[:, :2] + 0.1
+        pw = prior[:, 2] - prior[:, 0]; phh = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2; pcy = prior[:, 1] + phh / 2
+        tw = target[:, 2] - target[:, 0]; th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + tw / 2; tcy = target[:, 1] + th / 2
+        out = np.zeros((5, 8, 4), np.float32)
+        for i in range(5):
+            for j in range(8):
+                out[i, j, 0] = (tcx[i] - pcx[j]) / pw[j] / pvar[j, 0]
+                out[i, j, 1] = (tcy[i] - pcy[j]) / phh[j] / pvar[j, 1]
+                out[i, j, 2] = np.log(tw[i] / pw[j]) / pvar[j, 2]
+                out[i, j, 3] = np.log(th[i] / phh[j]) / pvar[j, 3]
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": target}
+        self.outputs = {"OutputBox": out}
+        self.attrs = {"code_type": "encode_center_size"}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestBoxCoderDecode(OpTest):
+    op_type = "box_coder"
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        prior = rng.rand(6, 4).astype("float32")
+        prior[:, 2:] += prior[:, :2] + 0.1
+        pvar = rng.uniform(0.1, 0.3, (6, 4)).astype("float32")
+        target = rng.randn(3, 6, 4).astype("float32") * 0.2
+        pw = prior[:, 2] - prior[:, 0]; phh = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2; pcy = prior[:, 1] + phh / 2
+        out = np.zeros_like(target)
+        for i in range(3):
+            for j in range(6):
+                d = target[i, j] * pvar[j]
+                cx = d[0] * pw[j] + pcx[j]; cy = d[1] * phh[j] + pcy[j]
+                w = np.exp(d[2]) * pw[j]; h = np.exp(d[3]) * phh[j]
+                out[i, j] = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": target}
+        self.outputs = {"OutputBox": out}
+        self.attrs = {"code_type": "decode_center_size"}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+def np_prior_box(h, w, img_h, img_w, min_sizes, max_sizes, ratios, flip, clip,
+                 variances, offset=0.5):
+    out_ratios = [1.0]
+    for r in ratios:
+        if not any(abs(r - o) < 1e-6 for o in out_ratios):
+            out_ratios.append(r)
+            if flip:
+                out_ratios.append(1.0 / r)
+    ws, hs = [], []
+    for k, ms in enumerate(min_sizes):
+        ws.append(ms); hs.append(ms)
+        if max_sizes:
+            big = np.sqrt(ms * max_sizes[k]); ws.append(big); hs.append(big)
+        for r in out_ratios:
+            if abs(r - 1.0) < 1e-6:
+                continue
+            ws.append(ms * np.sqrt(r)); hs.append(ms / np.sqrt(r))
+    p = len(ws)
+    step_w, step_h = img_w / w, img_h / h
+    boxes = np.zeros((h, w, p, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            for k in range(p):
+                boxes[i, j, k] = [(cx - ws[k] / 2) / img_w, (cy - hs[k] / 2) / img_h,
+                                  (cx + ws[k] / 2) / img_w, (cy + hs[k] / 2) / img_h]
+    if clip:
+        boxes = np.clip(boxes, 0, 1)
+    var = np.tile(np.array(variances, np.float32), (h, w, p, 1))
+    return boxes, var
+
+
+class TestPriorBox(OpTest):
+    op_type = "prior_box"
+
+    def setup(self):
+        feat = np.zeros((1, 8, 4, 5), np.float32)
+        image = np.zeros((1, 3, 32, 40), np.float32)
+        attrs = dict(min_sizes=[4.0], max_sizes=[8.0], aspect_ratios=[2.0],
+                     flip=True, clip=True, variances=[0.1, 0.1, 0.2, 0.2])
+        boxes, var = np_prior_box(4, 5, 32, 40, [4.0], [8.0], [2.0], True, True,
+                                  [0.1, 0.1, 0.2, 0.2])
+        self.inputs = {"Input": feat, "Image": image}
+        self.outputs = {"Boxes": boxes, "Variances": var}
+        self.attrs = attrs
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def np_bipartite(sim, valid):
+    n, m = sim.shape
+    s = np.where(valid[:, None], sim.astype(np.float64), -1.0)
+    midx = np.full(m, -1, np.int32)
+    mdist = np.zeros(m, np.float64)
+    for _ in range(n):
+        i, j = np.unravel_index(np.argmax(s), s.shape)
+        if s[i, j] <= 0:
+            break
+        midx[j] = i
+        mdist[j] = s[i, j]
+        s[i, :] = -1
+        s[:, j] = -1
+    return midx, mdist
+
+
+class TestBipartiteMatch(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        dist = rng.rand(2, 4, 9).astype("float32")
+        valid = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], bool)
+        midx = np.zeros((2, 9), np.int32)
+        mdist = np.zeros((2, 9), np.float32)
+        for b in range(2):
+            mi, md = np_bipartite(dist[b], valid[b])
+            midx[b], mdist[b] = mi, md.astype(np.float32)
+        self.inputs = {"DistMat": dist, "RowValid": valid}
+        self.outputs = [("ColToRowMatchIndices", midx),
+                        ("ColToRowMatchDist", mdist)]
+        self.outputs = {"ColToRowMatchIndices": midx, "ColToRowMatchDist": mdist}
+        self.attrs = {"match_type": "bipartite"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBipartiteMatchPerPrediction(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        rng = np.random.RandomState(4)
+        dist = rng.rand(1, 3, 7).astype("float32")
+        valid = np.ones((1, 3), bool)
+        midx, mdist = np_bipartite(dist[0], valid[0])
+        thr = 0.5
+        for j in range(7):
+            if midx[j] < 0:
+                i = int(np.argmax(dist[0, :, j]))
+                if dist[0, i, j] >= thr:
+                    midx[j] = i
+                    mdist[j] = dist[0, i, j]
+        self.inputs = {"DistMat": dist, "RowValid": valid}
+        self.outputs = {"ColToRowMatchIndices": midx[None],
+                        "ColToRowMatchDist": mdist.astype(np.float32)[None]}
+        self.attrs = {"match_type": "per_prediction", "dist_threshold": thr}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTargetAssign(OpTest):
+    op_type = "target_assign"
+
+    def setup(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 3, 4).astype("float32")
+        midx = np.array([[0, -1, 2, 1], [-1, -1, 0, 1]], np.int32)
+        out = np.zeros((2, 4, 4), np.float32)
+        w = np.zeros((2, 4, 1), np.float32)
+        for b in range(2):
+            for m in range(4):
+                if midx[b, m] >= 0:
+                    out[b, m] = x[b, midx[b, m]]
+                    w[b, m] = 1
+        self.inputs = {"X": x, "MatchIndices": midx}
+        self.outputs = {"Out": out, "OutWeight": w}
+        self.attrs = {"mismatch_value": 0}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMineHardExamples(OpTest):
+    op_type = "mine_hard_examples"
+
+    def setup(self):
+        cls_loss = np.array([[5.0, 0.1, 3.0, 2.0, 0.5, 4.0]], np.float32)
+        midx = np.array([[1, -1, -1, -1, -1, -1]], np.int32)  # 1 positive
+        # neg_pos_ratio=3 -> keep 3 highest-loss negatives: idx 5 (4.0),
+        # idx 2 (3.0), idx 3 (2.0)
+        neg = np.zeros((1, 6), bool)
+        neg[0, [5, 2, 3]] = True
+        self.inputs = {"ClsLoss": cls_loss, "MatchIndices": midx}
+        self.outputs = {"NegMask": neg,
+                        "UpdatedMatchIndices": midx}
+        self.attrs = {"neg_pos_ratio": 3.0, "mining_type": "max_negative"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPolygonBoxTransform(OpTest):
+    op_type = "polygon_box_transform"
+
+    def setup(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(1, 8, 2, 3).astype("float32")
+        out = np.zeros_like(x)
+        for c in range(8):
+            for i in range(2):
+                for j in range(3):
+                    grid = j if c % 2 == 0 else i
+                    out[0, c, i, j] = 4 * grid - x[0, c, i, j]
+        self.inputs = {"Input": x}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+def np_roi_pool(x, rois, batch_idx, ph, pw, scale):
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    out = np.zeros((r, c, ph, pw), np.float32)
+    for ri in range(r):
+        x1, y1, x2, y2 = np.round(rois[ri] * scale)
+        rw = max(x2 - x1 + 1, 1)
+        rh = max(y2 - y1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        img = x[batch_idx[ri]]
+        for i in range(ph):
+            for j in range(pw):
+                hs = int(min(max(np.floor(i * bh) + y1, 0), h))
+                he = int(min(max(np.ceil((i + 1) * bh) + y1, 0), h))
+                ws_ = int(min(max(np.floor(j * bw) + x1, 0), w))
+                we = int(min(max(np.ceil((j + 1) * bw) + x1, 0), w))
+                if he > hs and we > ws_:
+                    out[ri, :, i, j] = img[:, hs:he, ws_:we].max(axis=(1, 2))
+    return out
+
+
+class TestRoiPool(OpTest):
+    op_type = "roi_pool"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        # well-separated values (gap 0.1 >> numeric delta) so the max's
+        # argmax never flips under central-difference perturbation
+        x = (rng.permutation(2 * 3 * 8 * 8).reshape(2, 3, 8, 8) * 0.1
+             ).astype("float32")
+        rois = np.array([[1, 1, 6, 6], [0, 0, 3, 3], [2, 4, 7, 7]], np.float32)
+        bidx = np.array([0, 1, 1], np.int32)
+        out = np_roi_pool(x, rois, bidx, 2, 2, 1.0)
+        self.inputs = {"X": x, "ROIs": rois, "ROIsBatch": bidx}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_multiclass_nms_basic():
+    """Two overlapping boxes of one class -> keep higher-score one; empty
+    slots carry label -1."""
+    boxes = np.array([[[0, 0, 1, 1], [0, 0, 0.95, 0.95], [0.5, 0.5, 1.5, 1.5]]],
+                     np.float32)
+    # class 0 = background; class 1 scores
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        bb = fluid.layers.data("bb", shape=[3, 4], dtype="float32")
+        sc = fluid.layers.data("sc", shape=[2, 3], dtype="float32")
+        out = fluid.layers.multiclass_nms(bb, sc, score_threshold=0.05,
+                                          nms_threshold=0.5, keep_top_k=3,
+                                          background_label=0)
+    exe = fluid.Executor()
+    res = exe.run(main, feed={"bb": boxes, "sc": scores},
+                  fetch_list=[out.name])[0]
+    res = np.asarray(res)
+    assert res.shape == (1, 3, 6)
+    kept = res[0][res[0, :, 0] >= 0]
+    # box 1 suppressed by box 0 (iou > 0.5); box 2 kept (iou ~0.14)
+    assert kept.shape[0] == 2
+    assert np.isclose(kept[0, 1], 0.9)
+    assert np.isclose(kept[1, 1], 0.7)
+    assert np.all(kept[:, 0] == 1)
+
+
+def test_detection_map_perfect():
+    """Detections exactly matching gt -> mAP 1.0."""
+    det = np.array([[[1, 0.9, 0, 0, 1, 1], [2, 0.8, 2, 2, 3, 3],
+                     [-1, 0, 0, 0, 0, 0]]], np.float32)
+    gt = np.array([[[1, 0, 0, 1, 1, 0], [2, 2, 2, 3, 3, 0],
+                    [-1, 0, 0, 0, 0, 0]]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = fluid.layers.data("d", shape=[3, 6], dtype="float32")
+        g = fluid.layers.data("g", shape=[3, 6], dtype="float32")
+        m = fluid.layers.detection_map(d, g, class_num=3)
+    exe = fluid.Executor()
+    res = exe.run(main, feed={"d": det, "g": gt}, fetch_list=[m.name])[0]
+    assert np.isclose(float(np.asarray(res)), 1.0, atol=1e-5)
+
+
+def test_ssd_loss_trains():
+    """ssd_loss is finite, positive, and its grads flow to loc+conf."""
+    from paddle_tpu.core import append_backward, grad_var_name
+
+    rng = np.random.RandomState(8)
+    b, m, g, c = 2, 12, 3, 4
+    prior = np.zeros((m, 4), np.float32)
+    # a 3x4 grid of unit priors
+    k = 0
+    for i in range(3):
+        for j in range(4):
+            prior[k] = [j / 4, i / 3, (j + 1) / 4, (i + 1) / 3]
+            k += 1
+    loc = (rng.randn(b, m, 4) * 0.1).astype("float32")
+    conf = (rng.randn(b, m, c) * 0.1).astype("float32")
+    gt_box = np.array([[[0.0, 0.0, 0.3, 0.4], [0.5, 0.5, 0.9, 0.9],
+                        [0, 0, 0, 0]],
+                       [[0.2, 0.2, 0.6, 0.7], [0, 0, 0, 0], [0, 0, 0, 0]]],
+                      np.float32)
+    gt_label = np.array([[1, 2, 0], [3, 0, 0]], np.int64)
+    gt_valid = np.array([[1, 1, 0], [1, 0, 0]], bool)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        locv = fluid.layers.data("loc", shape=[m, 4], dtype="float32")
+        confv = fluid.layers.data("conf", shape=[m, c], dtype="float32")
+        gb = fluid.layers.data("gb", shape=[g, 4], dtype="float32")
+        gl = fluid.layers.data("gl", shape=[g], dtype="int64")
+        gv = fluid.layers.data("gv", shape=[g], dtype="bool")
+        pb = fluid.layers.data("pb", shape=[m, 4], dtype="float32",
+                               append_batch_size=False)
+        locv.stop_gradient = False
+        locv.is_data = False
+        confv.stop_gradient = False
+        confv.is_data = False
+        loss = fluid.layers.ssd_loss(locv, confv, gb, gl, pb, gt_valid=gv)
+        append_backward(loss)
+    exe = fluid.Executor()
+    feed = {"loc": loc, "conf": conf, "gb": gt_box, "gl": gt_label,
+            "gv": gt_valid, "pb": prior}
+    res = exe.run(main, feed=feed,
+                  fetch_list=[loss.name, grad_var_name("loc"),
+                              grad_var_name("conf")])
+    lval, dloc, dconf = (np.asarray(r) for r in res)
+    assert np.isfinite(lval) and lval > 0
+    assert np.abs(dloc).sum() > 0
+    assert np.abs(dconf).sum() > 0
+
+
+def test_roi_pool_grad():
+    t = TestRoiPool()
+    t.check_grad(["X"], "Out", max_relative_error=3e-2)
+
+
+def test_detection_output_layer():
+    """decode + nms end-to-end shape check."""
+    rng = np.random.RandomState(9)
+    b, m, c = 1, 6, 3
+    prior = rng.rand(m, 4).astype("float32")
+    prior[:, 2:] += prior[:, :2] + 0.2
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (m, 1))
+    loc = (rng.randn(b, m, 4) * 0.1).astype("float32")
+    scores = rng.rand(b, c, m).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        locv = fluid.layers.data("loc", shape=[m, 4], dtype="float32")
+        scv = fluid.layers.data("sc", shape=[c, m], dtype="float32")
+        pb = fluid.layers.data("pb", shape=[m, 4], dtype="float32",
+                               append_batch_size=False)
+        pv = fluid.layers.data("pv", shape=[m, 4], dtype="float32",
+                               append_batch_size=False)
+        out = fluid.layers.detection_output(locv, scv, pb, pv, keep_top_k=4)
+    exe = fluid.Executor()
+    res = exe.run(main, feed={"loc": loc, "sc": scores, "pb": prior, "pv": pvar},
+                  fetch_list=[out.name])[0]
+    assert np.asarray(res).shape == (b, 4, 6)
+
+
+def test_ssd_mobilenet_model():
+    """End-to-end SSD model: train step produces finite loss; inference
+    produces fixed-capacity detections."""
+    from paddle_tpu.core import append_backward
+
+    rng = np.random.RandomState(10)
+    b, g = 2, 4
+    img = rng.rand(b, 3, 64, 64).astype("float32")
+    gt_box = rng.rand(b, g, 4).astype("float32") * 0.5
+    gt_box[..., 2:] += gt_box[..., :2] + 0.1
+    gt_box = np.clip(gt_box, 0, 1)  # normalized, same space as the priors
+    gt_label = rng.randint(1, 5, (b, g)).astype("int64")
+    gt_valid = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], bool)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        im = fluid.layers.data("im", shape=[3, 64, 64], dtype="float32")
+        gb = fluid.layers.data("gb", shape=[g, 4], dtype="float32")
+        gl = fluid.layers.data("gl", shape=[g], dtype="int64")
+        gv = fluid.layers.data("gv", shape=[g], dtype="bool")
+        loss = paddle_tpu.models.ssd_mobilenet(im, gb, gl, gv, num_classes=5)
+        opt = fluid.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=123)
+    vals = []
+    for _ in range(5):
+        res = exe.run(main, feed={"im": img, "gb": gt_box, "gl": gt_label,
+                                  "gv": gt_valid},
+                      fetch_list=[loss.name], scope=scope)
+        vals.append(float(np.asarray(res[0])))
+    assert all(np.isfinite(v) for v in vals)
+    assert vals[0] > 0 and vals[-1] < vals[0]  # actually learning
+
+    infer, istart = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer, istart):
+        im = fluid.layers.data("im", shape=[3, 64, 64], dtype="float32")
+        det = paddle_tpu.models.ssd_mobilenet(im, num_classes=5, is_test=True)
+    e2 = fluid.Executor()
+    s2 = fluid.Scope()
+    e2.run(istart, scope=s2, seed=123)
+    out = e2.run(infer, feed={"im": img}, fetch_list=[det.name], scope=s2)[0]
+    assert np.asarray(out).shape == (b, 50, 6)
+
+
+def test_multiclass_nms_fixed_capacity():
+    """keep_top_k larger than the candidate pool still yields a static
+    [B, keep_top_k, 6] buffer padded with label -1."""
+    boxes = np.array([[[0, 0, 1, 1], [2, 2, 3, 3]]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.9, 0.8]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        bb = fluid.layers.data("bb", shape=[2, 4], dtype="float32")
+        sc = fluid.layers.data("sc", shape=[2, 2], dtype="float32")
+        out = fluid.layers.multiclass_nms(bb, sc, score_threshold=0.05,
+                                          keep_top_k=10, background_label=0)
+    exe = fluid.Executor()
+    res = np.asarray(exe.run(main, feed={"bb": boxes, "sc": scores},
+                             fetch_list=[out.name])[0])
+    assert res.shape == (1, 10, 6)
+    assert (res[0, :, 0] >= 0).sum() == 2
+    assert np.all(res[0, 2:, 0] == -1)
