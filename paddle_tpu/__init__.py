@@ -20,6 +20,16 @@ from . import ops  # registers the op library
 from . import clip, initializer, layers, optimizer, regularizer, unique_name  # noqa: F401
 from . import dataset, io, metrics, profiler, reader  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Inferencer,
+    Trainer,
+)
 from .layers import learning_rate_scheduler  # noqa: F401
 from .core import (  # noqa: F401
     CPUPlace,

@@ -1,0 +1,45 @@
+"""DataFeeder: reader minibatches -> feed dicts.
+
+<- python/paddle/fluid/data_feeder.py. The reference converts per-sample
+LoD lists into LoDTensors; here a minibatch (list of sample tuples from
+``paddle_tpu.reader.batch``) becomes a dict of stacked dense numpy arrays
+keyed by variable name, ready for ``Executor.run(feed=...)``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        """feed_list: Variables (or their names, resolved against ``program``)."""
+        self.feed_names: List[str] = []
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                if program is None:
+                    raise ValueError("string feed names need a program to resolve")
+                v = program.global_block().var(v)
+            self.feed_vars.append(v)
+            self.feed_names.append(v.name)
+        self.place = place
+
+    def feed(self, minibatch: Sequence[Sequence]) -> Dict[str, np.ndarray]:
+        """minibatch: iterable of sample tuples aligned with feed_list."""
+        cols = list(zip(*minibatch))
+        if len(cols) != len(self.feed_vars):
+            raise ValueError(
+                f"sample width {len(cols)} != number of feed vars "
+                f"{len(self.feed_vars)} ({self.feed_names})")
+        out = {}
+        for var, col in zip(self.feed_vars, cols):
+            dtype = var.dtype.np_dtype if var.dtype is not None else np.float32
+            arr = np.asarray(col, dtype=dtype)
+            # scalar samples for a [-1, 1]-shaped var get the trailing axis
+            shape = var.shape
+            if shape is not None and arr.ndim + 1 == len(shape) and shape[-1] == 1:
+                arr = arr[..., None]
+            out[var.name] = arr
+        return out

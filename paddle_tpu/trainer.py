@@ -1,0 +1,211 @@
+"""High-level training driver (<- python/paddle/fluid/trainer.py:171).
+
+``Trainer`` owns the program pair + scope, runs the epoch/step loop over a
+reader, streams Begin/End events (with metrics) to a user callback, and
+auto-checkpoints per ``CheckpointConfig`` (trainer.py:95-145) with resume on
+restart.  ``Inferencer`` (<- inferencer.py:29) is the matching
+load-and-predict wrapper.
+
+TPU notes: the step function is one jitted XLA program (the Executor caches
+the compiled step across calls), so the event loop here is pure host-side
+orchestration — it never fragments the compiled computation.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from . import io as fluid_io
+from . import unique_name
+from .core.executor import Executor, Scope
+from .core.ir import Program, program_guard
+from .data_feeder import DataFeeder
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id: int, step_id: int):
+        self.epoch = epoch_id
+        self.step = step_id
+        # user may flip this to request a fetch of metrics this step
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id: int, step_id: int, metrics: List):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """<- trainer.py:95 CheckpointConfig."""
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 max_num_checkpoints: int = 3, epoch_interval: int = 1,
+                 step_interval: int = 10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), ".paddle_tpu_checkpoints")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+
+
+class Trainer:
+    """<- trainer.py:171.
+
+    train_func: builds the model in the default programs and returns the
+    loss Variable (or [loss, *metric_vars]).
+    optimizer_func: returns an Optimizer (called once).
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 param_path: Optional[str] = None, place=None,
+                 checkpoint_config: Optional[CheckpointConfig] = None,
+                 seed: Optional[int] = None):
+        self.checkpoint_cfg = checkpoint_config
+        self.place = place
+        self.stop_requested = False
+
+        self.train_program = Program()
+        self.startup_program = Program()
+        with unique_name.guard():
+            with program_guard(self.train_program, self.startup_program):
+                outs = train_func()
+                if isinstance(outs, (list, tuple)):
+                    self.loss = outs[0]
+                    self.metric_vars = list(outs[1:])
+                else:
+                    self.loss = outs
+                    self.metric_vars = []
+                self.test_program = self.train_program.clone(for_test=True)
+                optimizer = optimizer_func()
+                optimizer.minimize(self.loss, self.startup_program)
+
+        self.scope = Scope()
+        self.exe = Executor(place)
+        self.exe.run(self.startup_program, scope=self.scope, seed=seed)
+
+        if param_path:
+            fluid_io.load_persistables(self.exe, param_path,
+                                       self.train_program, scope=self.scope)
+        self._resumed_serial = -1
+        if self.checkpoint_cfg:
+            try:
+                self._resumed_serial = fluid_io.load_checkpoint(
+                    self.exe, self.checkpoint_cfg.checkpoint_dir,
+                    self.train_program, scope=self.scope)
+            except FileNotFoundError:
+                pass  # fresh start
+
+    def stop(self):
+        """Request the train loop to exit after the current step
+        (<- trainer.py Trainer.stop)."""
+        self.stop_requested = True
+
+    def _feeder(self, feed_order: Sequence[str]) -> DataFeeder:
+        block = self.train_program.global_block()
+        return DataFeeder([block.var(n) for n in feed_order])
+
+    def train(self, num_epochs: int, event_handler: Optional[Callable] = None,
+              reader: Optional[Callable] = None,
+              feed_order: Optional[Sequence[str]] = None):
+        """Epoch/step loop with events (<- trainer.py train/_train_by_executor)."""
+        event_handler = event_handler or (lambda e: None)
+        feeder = self._feeder(feed_order) if feed_order else None
+        fetch = [self.loss.name] + [m.name for m in self.metric_vars]
+        step_count = 0
+        for epoch in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch))
+            for step, batch in enumerate(reader()):
+                if self.stop_requested:
+                    return
+                begin = BeginStepEvent(epoch, step)
+                event_handler(begin)
+                feed = feeder.feed(batch) if feeder else batch
+                metrics = self.exe.run(
+                    self.train_program, feed=feed,
+                    fetch_list=fetch if begin.fetch_metrics else [],
+                    scope=self.scope)
+                event_handler(EndStepEvent(epoch, step, list(metrics or [])))
+                step_count += 1
+                if (self.checkpoint_cfg
+                        and step_count % self.checkpoint_cfg.step_interval == 0):
+                    self._save_checkpoint()
+            event_handler(EndEpochEvent(epoch))
+            if (self.checkpoint_cfg
+                    and (epoch + 1) % self.checkpoint_cfg.epoch_interval == 0):
+                self._save_checkpoint()
+
+    def test(self, reader: Callable, feed_order: Sequence[str]) -> List[float]:
+        """Average loss+metrics over the reader using the for_test clone
+        (<- trainer.py Trainer.test)."""
+        feeder = self._feeder(feed_order)
+        fetch = [self.loss.name] + [m.name for m in self.metric_vars]
+        sums = np.zeros(len(fetch))
+        count = 0
+        for batch in reader():
+            vals = self.exe.run(self.test_program, feed=feeder.feed(batch),
+                                fetch_list=fetch, scope=self.scope)
+            sums += np.asarray([float(np.asarray(v).mean()) for v in vals])
+            count += 1
+        return list(sums / max(count, 1))
+
+    def save_params(self, param_path: str):
+        """<- trainer.py save_params."""
+        fluid_io.save_persistables(self.exe, param_path, self.train_program,
+                                   scope=self.scope)
+
+    def save_inference_model(self, param_path: str,
+                             feeded_var_names: Sequence[str],
+                             target_vars: Sequence):
+        """<- trainer.py save_inference_model."""
+        fluid_io.save_inference_model(param_path, feeded_var_names,
+                                      target_vars, self.exe,
+                                      self.test_program, scope=self.scope)
+
+    def _save_checkpoint(self):
+        fluid_io.save_checkpoint(
+            self.exe, self.checkpoint_cfg.checkpoint_dir,
+            main_program=self.train_program,
+            max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+            scope=self.scope)
+
+
+class Inferencer:
+    """<- python/paddle/fluid/inferencer.py:29.
+
+    infer_func: builds the inference graph in the default programs and
+    returns the prediction Variable(s); params load from ``param_path``
+    (a save_params/save_inference_model directory).
+    """
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None):
+        self.place = place
+        self.scope = Scope()
+        self.exe = Executor(place)
+        self.inference_program = Program()
+        startup = Program()
+        with unique_name.guard():
+            with program_guard(self.inference_program, startup):
+                outs = infer_func()
+        self.predict_vars = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        fluid_io.load_persistables(self.exe, param_path,
+                                   self.inference_program, scope=self.scope)
+
+    def infer(self, inputs: dict):
+        """inputs: {var_name: numpy array} -> list of prediction arrays."""
+        return self.exe.run(self.inference_program, feed=inputs,
+                            fetch_list=[v.name for v in self.predict_vars],
+                            scope=self.scope)

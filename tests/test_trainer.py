@@ -1,0 +1,118 @@
+"""Trainer/Inferencer high-level API tests (<- the reference's book tests
+exercising Trainer.train/test with event handlers + CheckpointConfig,
+trainer.py:171, inferencer.py:29)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+RNG = np.random.RandomState(0)
+W_TRUE = RNG.randn(13, 1).astype("float32")
+
+
+def _sample_reader():
+    rng = np.random.RandomState(1)
+
+    def reader():
+        for _ in range(32):
+            x = rng.randn(13).astype("float32")
+            y = (x @ W_TRUE + 0.5).astype("float32")
+            yield x, y
+
+    return reader
+
+
+def _train_func():
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss
+
+
+def _optimizer_func():
+    return fluid.optimizer.SGD(learning_rate=0.05)
+
+
+def test_trainer_events_and_learning():
+    events = []
+
+    def handler(e):
+        events.append(e)
+
+    trainer = fluid.Trainer(_train_func, _optimizer_func,
+                            place=fluid.CPUPlace(), seed=3)
+    batched = fluid.reader.batch(_sample_reader(), batch_size=8)
+    trainer.train(num_epochs=12, event_handler=handler, reader=batched,
+                  feed_order=["x", "y"])
+
+    kinds = [type(e).__name__ for e in events]
+    assert kinds[0] == "BeginEpochEvent"
+    assert kinds[1] == "BeginStepEvent"
+    assert kinds[2] == "EndStepEvent"
+    assert kinds[-1] == "EndEpochEvent"
+    step_events = [e for e in events if isinstance(e, fluid.EndStepEvent)]
+    first = float(np.asarray(step_events[0].metrics[0]))
+    last = float(np.asarray(step_events[-1].metrics[0]))
+    assert last < first * 0.5, (first, last)
+
+    # test() uses the for_test clone on the trained scope
+    test_loss = trainer.test(batched, feed_order=["x", "y"])[0]
+    assert test_loss < first
+
+
+def test_trainer_stop():
+    seen = []
+
+    def handler(e):
+        if isinstance(e, fluid.EndStepEvent):
+            seen.append(e)
+            if len(seen) >= 3:
+                trainer.stop()
+
+    trainer = fluid.Trainer(_train_func, _optimizer_func,
+                            place=fluid.CPUPlace(), seed=3)
+    batched = fluid.reader.batch(_sample_reader(), batch_size=8)
+    trainer.train(num_epochs=100, event_handler=handler, reader=batched,
+                  feed_order=["x", "y"])
+    assert len(seen) == 3  # stopped after the 3rd step, not 100 epochs
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    cfg = fluid.CheckpointConfig(str(tmp_path / "ckpt"), step_interval=2)
+    t1 = fluid.Trainer(_train_func, _optimizer_func, place=fluid.CPUPlace(),
+                       checkpoint_config=cfg, seed=3)
+    batched = fluid.reader.batch(_sample_reader(), batch_size=8)
+    t1.train(num_epochs=3, reader=batched, feed_order=["x", "y"])
+    w1 = np.asarray(t1.scope.get(_param_name(t1)))
+
+    # a new trainer with the same checkpoint dir resumes the trained params
+    t2 = fluid.Trainer(_train_func, _optimizer_func, place=fluid.CPUPlace(),
+                       checkpoint_config=cfg, seed=99)
+    assert t2._resumed_serial >= 0
+    w2 = np.asarray(t2.scope.get(_param_name(t2)))
+    np.testing.assert_allclose(w1, w2)
+
+
+def _param_name(trainer):
+    return next(n for n, v in trainer.train_program.global_block().vars.items()
+                if v.persistable and n.endswith(".w_0"))
+
+
+def test_trainer_save_params_and_inferencer(tmp_path):
+    trainer = fluid.Trainer(_train_func, _optimizer_func,
+                            place=fluid.CPUPlace(), seed=3)
+    batched = fluid.reader.batch(_sample_reader(), batch_size=8)
+    trainer.train(num_epochs=15, reader=batched, feed_order=["x", "y"])
+    path = str(tmp_path / "params")
+    trainer.save_params(path)
+
+    def infer_func():
+        x = layers.data("x", shape=[13], dtype="float32")
+        return layers.fc(x, size=1)
+
+    inferencer = fluid.Inferencer(infer_func, path, place=fluid.CPUPlace())
+    X = np.random.RandomState(5).randn(6, 13).astype("float32")
+    (out,) = inferencer.infer({"x": X})
+    np.testing.assert_allclose(np.asarray(out), X @ W_TRUE + 0.5, atol=0.5)
