@@ -742,3 +742,104 @@ def array_length(counter: Variable) -> Variable:
     out = helper.create_variable_for_type_inference("int64")
     helper.append_op("array_length", {"Len": [counter]}, {"Out": [out]})
     return out
+
+
+# ---------------------------------------------------------------------------
+# LoD structural wrappers (<- layers/control_flow.py lod_rank_table,
+# max_sequence_len, lod_tensor_to_array, array_to_lod_tensor,
+# reorder_lod_tensor_by_rank, shrink_memory, split/merge_lod_tensor).
+# Dense redesign: see ops/sequence.py LoD-compat block.
+# ---------------------------------------------------------------------------
+
+
+def lod_rank_table(x, level: int = 0, name=None):
+    """Build the (Index, Length) rank table from a Length vector; returns
+    (index, sorted_length) variables, longest sequence first."""
+    helper = LayerHelper("lod_rank_table", name=name)
+    index = helper.create_variable_for_type_inference("int32")
+    length = helper.create_variable_for_type_inference("int32")
+    helper.append_op("lod_rank_table", {"X": [x]},
+                     {"Index": [index], "OutLength": [length]}, {"level": level})
+    return index, length
+
+
+def max_sequence_len(rank_table_length, name=None):
+    helper = LayerHelper("max_sequence_len", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("max_sequence_len", {"RankTable": [rank_table_length]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table_index, name=None):
+    helper = LayerHelper("reorder_lod_tensor_by_rank", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     {"X": [x], "RankTable": [rank_table_index]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def lod_tensor_to_array(x, rank_table_index, name=None):
+    helper = LayerHelper("lod_tensor_to_array", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("lod_tensor_to_array",
+                     {"X": [x], "RankTable": [rank_table_index]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def array_to_lod_tensor(x, rank_table_index, name=None):
+    helper = LayerHelper("array_to_lod_tensor", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("array_to_lod_tensor",
+                     {"X": [x], "RankTable": [rank_table_index]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def split_lod_tensor(input, mask, name=None):
+    helper = LayerHelper("split_lod_tensor", name=name)
+    out_true = helper.create_variable_for_type_inference(input.dtype)
+    out_false = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("split_lod_tensor", {"X": [input], "Mask": [mask]},
+                     {"OutTrue": [out_true], "OutFalse": [out_false]}, {})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, mask, name=None):
+    helper = LayerHelper("merge_lod_tensor", name=name)
+    out = helper.create_variable_for_type_inference(in_true.dtype)
+    helper.append_op("merge_lod_tensor",
+                     {"InTrue": [in_true], "InFalse": [in_false], "Mask": [mask]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def shrink_memory(x, i, rank_table_length, name=None):
+    helper = LayerHelper("shrink_rnn_memory", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shrink_rnn_memory",
+                     {"X": [x], "RankTable": [rank_table_length], "I": [i]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both", name=None):
+    """<- layers/control_flow.py Print / print_op.cc: identity with a host
+    debug print compiled into the program."""
+    helper = LayerHelper("print", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", {"In": [input]}, {"Out": [out]},
+                     {"first_n": first_n, "message": message or "",
+                      "summarize": summarize})
+    return out
+
+
+__all__ += [
+    "lod_rank_table", "max_sequence_len", "reorder_lod_tensor_by_rank",
+    "lod_tensor_to_array", "array_to_lod_tensor", "split_lod_tensor",
+    "merge_lod_tensor", "shrink_memory", "Print",
+]

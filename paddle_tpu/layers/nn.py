@@ -598,3 +598,58 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
     helper.append_op("im2sequence", {"X": [input]}, {"Out": [out]},
                      {"kernels": list(fs), "strides": list(st)})
     return out
+
+
+def pool3d(
+    input,
+    pool_size=2,
+    pool_type: str = "max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling: bool = False,
+    ceil_mode: bool = False,
+    exclusive: bool = True,
+    name: Optional[str] = None,
+):
+    """3-D pooling over NCDHW (<- layers/nn.py pool3d / pool_op.cc)."""
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    def _t(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+    helper.append_op(
+        "pool3d", {"X": [input]}, {"Out": [out]},
+        {"pooling_type": pool_type, "ksize": _t(pool_size),
+         "strides": _t(pool_stride), "paddings": _t(pool_padding),
+         "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+         "exclusive": exclusive},
+    )
+    return out
+
+
+def spp(input, pyramid_height: int = 2, pool_type: str = "max",
+        name: Optional[str] = None):
+    """Spatial pyramid pooling (<- spp_op.cc)."""
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("spp", {"X": [input]}, {"Out": [out]},
+                     {"pyramid_height": pyramid_height, "pooling_type": pool_type})
+    return out
+
+
+def random_crop(x, shape, seed=None, name: Optional[str] = None):
+    """Random crop of the trailing dims to ``shape``
+    (<- layers/nn.py random_crop / random_crop_op.cc). ``seed`` may be an
+    int (materialized as a constant, as the reference does) or a variable;
+    randomness itself comes from the executor's functional PRNG."""
+    from .tensor import fill_constant
+
+    helper = LayerHelper("random_crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    seed_out = helper.create_variable_for_type_inference("int32")
+    if seed is not None and not hasattr(seed, "name"):
+        seed = fill_constant(shape=[1], dtype="int32", value=int(seed))
+    helper.append_op("random_crop",
+                     {"X": [x], "Seed": [seed] if seed is not None else []},
+                     {"Out": [out], "SeedOut": [seed_out]},
+                     {"shape": list(shape)})
+    return out

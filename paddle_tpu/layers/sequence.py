@@ -266,3 +266,92 @@ def attention_decoder(
         {"Hidden": [hidden], "Context": [context]},
     )
     return hidden, context, (wa, wx, wh, b)
+
+
+def dynamic_lstmp(
+    input,
+    size: int,
+    proj_size: int,
+    length=None,
+    h_0=None,
+    c_0=None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes: bool = False,
+    gate_activation: str = "sigmoid",
+    cell_activation: str = "tanh",
+    candidate_activation: str = "tanh",
+    proj_activation: str = "tanh",
+    name=None,
+):
+    """LSTM with recurrent projection (<- layers/nn.py dynamic_lstmp /
+    lstmp_op.cc). ``input`` is [N, T, 4*size]; returns
+    (projection [N, T, proj_size], cell [N, T, size])."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    assert size * 4 == input.shape[-1], "dynamic_lstmp input must be [N,T,4*size]"
+    w = helper.create_parameter(param_attr, [proj_size, 4 * size], input.dtype)
+    w_proj = helper.create_parameter(None, [size, proj_size], input.dtype)
+    bias_size = 4 * size + (3 * size if use_peepholes else 0)
+    b = helper.create_parameter(bias_attr, [bias_size], input.dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "lstmp",
+        {
+            "Input": [input],
+            "H0": [h_0] if h_0 is not None else [],
+            "C0": [c_0] if c_0 is not None else [],
+            "Weight": [w],
+            "ProjWeight": [w_proj],
+            "Bias": [b],
+            "Length": [length] if length is not None else [],
+        },
+        {"Projection": [proj], "Cell": [cell], "LastH": [last_h],
+         "LastC": [last_c]},
+        {
+            "use_peepholes": use_peepholes,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    return proj, cell
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size: int, end_id: int,
+                level: int = 0, name=None):
+    """One beam step (<- layers/nn.py beam_search). Dense fixed-capacity:
+    returns (selected_ids [N,K], selected_scores [N,K], parent_idx [N,K])."""
+    helper = LayerHelper("beam_search", name=name)
+    ids = helper.create_variable_for_type_inference("int32")
+    sc = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "beam_search",
+        {"pre_ids": [pre_ids], "pre_scores": [pre_scores], "scores": [scores]},
+        {"selected_ids": [ids], "selected_scores": [sc], "parent_idx": [parent]},
+        {"beam_size": beam_size, "end_id": end_id, "level": level},
+    )
+    return ids, sc, parent
+
+
+def beam_search_decode(ids, parent_idx, scores, name=None):
+    """Backtrace stacked beam steps (<- layers/nn.py beam_search_decode).
+    ids/parent_idx/scores are [T, N, K] stacks (e.g. tensor arrays written
+    once per step); returns (sentence_ids [N,K,T], sentence_scores [N,K]).
+    The reference's beam_size/end_id params are not needed: capacity is the
+    stack's K dim and finished-beam handling happened in beam_search."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_variable_for_type_inference("int32")
+    sc = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        "beam_search_decode",
+        {"Ids": [ids], "ParentIdx": [parent_idx], "Scores": [scores]},
+        {"SentenceIds": [sent], "SentenceScores": [sc]},
+        {},
+    )
+    return sent, sc

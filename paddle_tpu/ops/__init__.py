@@ -20,3 +20,4 @@ from . import pallas_attention  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import structured  # noqa: F401
 from . import detection  # noqa: F401
+from . import quant  # noqa: F401

@@ -160,3 +160,70 @@ def attention_lstm_beam_decode(ctx_, ins, attrs):
     ids = jnp.take_along_axis(ids, order[..., None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)
     return {"Ids": [ids], "Scores": [scores]}
+
+
+@register_op(
+    "beam_search",
+    inputs=("pre_ids", "pre_scores", "scores"),
+    outputs=("selected_ids", "selected_scores", "parent_idx"),
+    no_grad=True,
+)
+def beam_search(ctx_, ins, attrs):
+    """One generic beam-search step (<- beam_search_op.cc), dense redesign.
+
+    The reference grows LoD candidate lists per source sentence; here the
+    beam state is fixed-capacity: pre_ids/pre_scores [N, K], scores [N, K, V]
+    per-beam next-token log-probs. Selects the global top-K of
+    pre_scores + scores per source, emitting the chosen tokens, their
+    accumulated scores, and the source-beam index (parent_idx) that
+    beam_search_decode backtraces — the role the reference's LoD links play.
+    Finished beams (pre_id == end_id) only extend with end_id at no cost.
+    """
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    n, k, v = scores.shape
+    end_id = attrs.get("end_id", 1)
+    beam_size = attrs.get("beam_size", k)
+    neg_inf = jnp.finfo(scores.dtype).min
+    finished = pre_ids == end_id
+    eos_only = jnp.full((v,), neg_inf, scores.dtype).at[end_id].set(0.0)
+    step_scores = jnp.where(finished[..., None], eos_only[None, None, :], scores)
+    cand = pre_scores[..., None] + step_scores  # [N, K, V]
+    top_scores, top_idx = lax.top_k(cand.reshape(n, k * v), beam_size)
+    parent = (top_idx // v).astype(jnp.int32)
+    tok = (top_idx % v).astype(jnp.int32)
+    return {"selected_ids": [tok], "selected_scores": [top_scores],
+            "parent_idx": [parent]}
+
+
+@register_op(
+    "beam_search_decode",
+    inputs=("Ids", "ParentIdx", "Scores"),
+    outputs=("SentenceIds", "SentenceScores"),
+    no_grad=True,
+)
+def beam_search_decode(ctx_, ins, attrs):
+    """Backtrace stacked per-step beam outputs into full sentences
+    (<- beam_search_decode_op.cc). Ids/ParentIdx [T, N, K] from T
+    ``beam_search`` steps; emits SentenceIds [N, K, T] best-first and the
+    final accumulated SentenceScores [N, K]."""
+    ids = ins["Ids"][0]          # [T, N, K]
+    parents = ins["ParentIdx"][0]
+    scores = ins["Scores"][0]    # [T, N, K] accumulated
+    t, n, k = ids.shape
+    batch_ix = jnp.arange(n)[:, None]
+
+    def back(beam_ix, step):
+        tok = ids[step][batch_ix, beam_ix]       # [N, K]
+        prev = parents[step][batch_ix, beam_ix]
+        return prev, tok
+
+    _, toks = lax.scan(back, jnp.broadcast_to(jnp.arange(k)[None, :], (n, k)),
+                       jnp.arange(t - 1, -1, -1))
+    sent = jnp.flip(jnp.moveaxis(toks, 0, 2), axis=2)  # [N, K, T]
+    final = scores[-1]
+    order = jnp.argsort(-final, axis=1)
+    sent = jnp.take_along_axis(sent, order[..., None], axis=1)
+    final = jnp.take_along_axis(final, order, axis=1)
+    return {"SentenceIds": [sent], "SentenceScores": [final]}

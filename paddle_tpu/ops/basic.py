@@ -126,3 +126,25 @@ def label_smooth(ctx, ins, attrs):
     x = ins["X"][0]
     k = x.shape[-1]
     return {"Out": [(1.0 - eps) * x + eps / k]}
+
+
+@register_op("print", inputs=("In",), outputs=("Out",), no_grad=True)
+def print_op(ctx, ins, attrs):
+    """Debug print (<- print_op.cc): identity passthrough that prints the
+    tensor from inside the compiled program via a host callback at execution
+    time, honoring first_n (prints stop after N executions) and summarize
+    (truncate to the first N elements) like the reference."""
+    x = ins["In"][0]
+    msg = attrs.get("message", "") or ""
+    summarize = attrs.get("summarize", -1)
+    first_n = attrs.get("first_n", -1)
+    shown = x.reshape(-1)[:summarize] if summarize and summarize > 0 else x
+    count = {"n": 0}  # closure state survives across executions of the jit
+
+    def _host_print(val):
+        if first_n is None or first_n < 0 or count["n"] < first_n:
+            count["n"] += 1
+            print(f"{msg}{val}", flush=True)
+
+    jax.debug.callback(_host_print, shown)
+    return {"Out": [x]}

@@ -106,3 +106,36 @@ def precision_recall(ctx, ins, attrs):
     accum = metrics(acc_states[:, 0], acc_states[:, 1], acc_states[:, 2], acc_states[:, 3])
     return {"BatchMetrics": [batch], "AccumMetrics": [accum],
             "AccumStatesInfo": [acc_states]}
+
+
+@register_op(
+    "positive_negative_pair",
+    inputs=("Score", "Label", "QueryID", "AccumulatePositivePair",
+            "AccumulateNegativePair", "AccumulateNeutralPair"),
+    outputs=("PositivePair", "NegativePair", "NeutralPair"),
+    no_grad=True,
+)
+def positive_negative_pair(ctx, ins, attrs):
+    """Ranking pair statistics per query group (<- positive_negative_pair_op.cc).
+
+    For every pair of items within the same query: a pair is *positive* when
+    the better-labelled item scored higher, *negative* when lower, *neutral*
+    on score ties. O(N^2) masked comparison — metric-sized N, not a hot op.
+    """
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    lab_gt = label[:, None] > label[None, :]  # i is the better-labelled item
+    valid = same_q & lab_gt
+    s_i, s_j = score[:, None], score[None, :]
+    pos = jnp.sum(valid & (s_i > s_j))
+    neg = jnp.sum(valid & (s_i < s_j))
+    neu = jnp.sum(valid & (s_i == s_j))
+    f32 = jnp.float32
+    def acc(slot, v):
+        prev = ins[slot][0] if ins.get(slot) and ins[slot][0] is not None else jnp.zeros((1,), f32)
+        return (v.astype(f32) + prev.reshape(-1)[0]).reshape(1)
+    return {"PositivePair": [acc("AccumulatePositivePair", pos)],
+            "NegativePair": [acc("AccumulateNegativePair", neg)],
+            "NeutralPair": [acc("AccumulateNeutralPair", neu)]}

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.ir import GRAD_SUFFIX, grad_var_name
@@ -102,39 +103,45 @@ def _ceil_extra(size, k, p, s):
     return (ceil_out - floor_out) * s
 
 
-def _pool2d_impl(x, attrs):
+def _ntuple(v, n):
+    v = list(v) if isinstance(v, (list, tuple)) else [v]
+    return tuple(int(x) for x in (v * n if len(v) == 1 else v))
+
+
+def _pool_impl(x, attrs, nsp=2):
+    """Shared N-spatial-dim pooling (pool2d over NCHW, pool3d over NCDHW)."""
     ptype = attrs.get("pooling_type", "max")
-    ksize = _pair(attrs.get("ksize", [2, 2]))
-    strides = _pair(attrs.get("strides", [1, 1]))
-    pads = _pair(attrs.get("paddings", [0, 0]))
+    ksize = _ntuple(attrs.get("ksize", [2] * nsp), nsp)
+    strides = _ntuple(attrs.get("strides", [1] * nsp), nsp)
+    pads = _ntuple(attrs.get("paddings", [0] * nsp), nsp)
     if attrs.get("global_pooling", False):
         ksize = x.shape[2:]
-        strides = (1, 1)
-        pads = (0, 0)
-    eh = ew = 0
+        strides = (1,) * nsp
+        pads = (0,) * nsp
+    extra = [0] * nsp
     if attrs.get("ceil_mode", False):
-        eh = _ceil_extra(x.shape[2], ksize[0], pads[0], strides[0])
-        ew = _ceil_extra(x.shape[3], ksize[1], pads[1], strides[1])
+        extra = [_ceil_extra(x.shape[2 + i], ksize[i], pads[i], strides[i])
+                 for i in range(nsp)]
     window = (1, 1) + tuple(ksize)
     strides_full = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0), (pads[0], pads[0] + eh), (pads[1], pads[1] + ew))
+    padding = ((0, 0), (0, 0)) + tuple(
+        (pads[i], pads[i] + extra[i]) for i in range(nsp))
     if ptype == "max":
-        init = -jnp.inf
-        out = lax.reduce_window(x, init, lax.max, window, strides_full, padding)
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides_full, padding)
     else:
         summed = lax.reduce_window(x, 0.0, lax.add, window, strides_full, padding)
-        if attrs.get("exclusive", True) and (pads != (0, 0) or eh or ew):
+        if attrs.get("exclusive", True) and (any(pads) or any(extra)):
             ones = jnp.ones_like(x)
             counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, padding)
             out = summed / counts
         else:
-            out = summed / (ksize[0] * ksize[1])
+            out = summed / int(np.prod(ksize))
     return out
 
 
 @register_op("pool2d", inputs=("X",), outputs=("Out",))
 def pool2d(ctx, ins, attrs):
-    return {"Out": [_pool2d_impl(ins["X"][0], attrs)]}
+    return {"Out": [_pool_impl(ins["X"][0], attrs, nsp=2)]}
 
 
 def _pool_window_positions(x, ksize, strides):
@@ -403,3 +410,65 @@ def row_conv(ctx, ins, attrs):
     pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
     out = sum(pad[:, i : i + x.shape[1]] * f[i] for i in range(k))
     return {"Out": [out]}
+
+
+@register_op("pool3d", inputs=("X",), outputs=("Out",))
+def pool3d(ctx, ins, attrs):
+    """3-D pooling over NCDHW (<- pool_op.cc 3-D registration)."""
+    return {"Out": [_pool_impl(ins["X"][0], attrs, nsp=3)]}
+
+
+@register_op("spp", inputs=("X",), outputs=("Out",), diff_inputs=("X",))
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (<- spp_op.cc): pyramid level i pools onto a
+    2^i x 2^i grid (adaptive window), levels flattened + concatenated to
+    [N, C * (4^height - 1) / 3]."""
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    height = attrs.get("pyramid_height", 2)
+    ptype = attrs.get("pooling_type", "max")
+    outs = []
+    for lvl in range(height):
+        bins = 2 ** lvl
+        # kernel = stride = ceil(size/bins), symmetric-ish padding so the
+        # bins tile the (padded) plane exactly (<- spp_op.cc kernel/padding)
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph = (kh * bins - h + 1) // 2 if kh * bins > h else 0
+        pw = (kw * bins - w + 1) // 2 if kw * bins > w else 0
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        padding = ((0, 0), (0, 0), (ph, kh * bins - h - ph), (pw, kw * bins - w - pw))
+        if ptype == "max":
+            o = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                    strides, padding)
+            o = s / cnt
+        outs.append(o[:, :, :bins, :bins].reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("random_crop", inputs=("X", "Seed"), outputs=("Out", "SeedOut"),
+             no_grad=True, stochastic=True)
+def random_crop(ctx, ins, attrs):
+    """Random spatial crop (<- random_crop_op.cc): crops the trailing dims of
+    every batch element to attrs['shape'] at a random offset drawn from the
+    functional PRNG (the reference threads an integer Seed tensor; the PRNG
+    key plays that role, and SeedOut keeps the slot shape for parity)."""
+    x = ins["X"][0]
+    crop = list(attrs["shape"])
+    k = len(crop)
+    lead = x.shape[: x.ndim - k]
+    key = ctx.next_key()
+    maxs = jnp.array([x.shape[x.ndim - k + i] - crop[i] for i in range(k)], jnp.int32)
+    nbatch = int(np.prod(lead)) if lead else 1
+    offs = jax.random.randint(key, (nbatch, k), 0, maxs + 1, jnp.int32)
+    flat = x.reshape((nbatch,) + x.shape[x.ndim - k:])
+
+    def crop_one(xi, oi):
+        return lax.dynamic_slice(xi, tuple(oi), tuple(crop))
+
+    out = jax.vmap(crop_one)(flat, offs).reshape(tuple(lead) + tuple(crop))
+    seed = ins["Seed"][0] if ins.get("Seed") and ins["Seed"][0] is not None else jnp.zeros((1,), jnp.int32)
+    return {"Out": [out], "SeedOut": [seed]}

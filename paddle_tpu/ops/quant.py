@@ -1,0 +1,61 @@
+"""Quantization ops (<- paddle/fluid/operators/fake_quantize_op.cc,
+fake_dequantize_op.cc).
+
+Fake-quant simulates int8/intN inference inside the float graph: quantize to
+the integer grid, keep float dtype. On TPU the straight-through estimator
+gradient (identity within range) keeps training in bf16/f32 while the MXU
+sees quantization-aware values.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _ste_round(x):
+    """round() with a straight-through gradient."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+@register_op("fake_quantize_abs_max", inputs=("X",), outputs=("Out", "OutScale"),
+             diff_inputs=("X",))
+def fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bit_length = attrs.get("bit_length", 8)
+    bin_cnt = (1 << (bit_length - 1)) - 1
+    scale = jnp.max(jnp.abs(x))
+    scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
+    out = _ste_round(x / scale * bin_cnt)
+    out = jnp.clip(out, -bin_cnt, bin_cnt)
+    return {"Out": [out], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_quantize_range_abs_max",
+             inputs=("X", "InScale", "Iter"),
+             outputs=("Out", "OutScale", "OutScales"),
+             diff_inputs=("X",), no_grad=False)
+def fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Running-max variant used in QAT: scale = max(|x|, decayed history)."""
+    x = ins["X"][0]
+    bit_length = attrs.get("bit_length", 8)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    bin_cnt = (1 << (bit_length - 1)) - 1
+    in_scale = (ins["InScale"][0].reshape(-1)[0]
+                if ins.get("InScale") and ins["InScale"][0] is not None else jnp.float32(0))
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.where(is_test, in_scale, jnp.maximum(cur, in_scale))
+    scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
+    out = jnp.clip(_ste_round(x / scale * bin_cnt), -bin_cnt, bin_cnt)
+    return {"Out": [out], "OutScale": [scale.reshape(1)],
+            "OutScales": [scale.reshape(1)]}
+
+
+@register_op("fake_dequantize_max_abs", inputs=("X", "Scale"), outputs=("Out",),
+             diff_inputs=("X",))
+def fake_dequantize_max_abs(ctx, ins, attrs):
+    """<- fake_dequantize_op.cc: Out = Scale * X / max_range."""
+    x, scale = ins["X"][0], ins["Scale"][0]
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(-1)[0] / max_range]}

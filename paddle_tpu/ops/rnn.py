@@ -194,3 +194,69 @@ def gru_unit(ctx, ins, attrs):
     h_new = u * h_prev + (1 - u) * c
     gate = jnp.concatenate([u, r, c], axis=-1)
     return {"Gate": [gate], "ResetHiddenPrev": [r_h], "Hidden": [h_new]}
+
+
+@register_op(
+    "lstmp",
+    inputs=("Input", "H0", "C0", "Weight", "ProjWeight", "Bias", "Length"),
+    outputs=("Projection", "Cell", "LastH", "LastC"),
+    diff_inputs=("Input", "H0", "C0", "Weight", "ProjWeight", "Bias"),
+)
+def lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection layer (<- lstmp_op.cc).
+
+    Input [N, T, 4H] pre-projected gates; Weight [P, 4H] acts on the
+    *projected* recurrent state r [N, P]; ProjWeight [H, P] maps the cell
+    output h to the projection. Same masked lax.scan as ``lstm`` — the
+    projection matmul rides the MXU inside the scan body.
+    """
+    x = ins["Input"][0]
+    n, t, h4 = x.shape
+    h = h4 // 4
+    w = ins["Weight"][0]           # [P, 4H]
+    w_proj = ins["ProjWeight"][0]  # [H, P]
+    p = w_proj.shape[1]
+    use_peep = attrs.get("use_peepholes", False)
+    bias_in = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    if bias_in is None:
+        bias = jnp.zeros((h4,), x.dtype)
+        peephole = jnp.zeros((3 * h,), x.dtype) if use_peep else None
+    else:
+        b = bias_in.reshape(-1)
+        bias = b[:h4]
+        peephole = b[h4 : h4 + 3 * h] if use_peep else None
+    r0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((n, p), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else jnp.zeros((n, h), x.dtype)
+    length = (ins["Length"][0] if ins.get("Length") and ins["Length"][0] is not None
+              else jnp.full((n,), t, jnp.int32))
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACT[attrs.get("proj_activation", "tanh")]
+    xs = jnp.moveaxis(x, 1, 0)
+    step_mask = (jnp.arange(t)[:, None] < length.reshape(1, -1)).astype(x.dtype)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, m = inp
+        gates = xt + r_prev @ w + bias
+        i, f, c_bar, o = jnp.split(gates, 4, axis=-1)
+        if peephole is not None:
+            p_i, p_f, p_o = jnp.split(peephole, 3)
+            i = i + c_prev * p_i
+            f = f + c_prev * p_f
+        i, f = gate_act(i), gate_act(f)
+        c_new = f * c_prev + i * cand_act(c_bar)
+        if peephole is not None:
+            o = o + c_new * p_o
+        o = gate_act(o)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        m = m[:, None]
+        r_out = m * r_new + (1 - m) * r_prev
+        c_out = m * c_new + (1 - m) * c_prev
+        return (r_out, c_out), (r_out * m, c_out * m)
+
+    (rT, cT), (rs, cs) = lax.scan(step, (r0, c0), (xs, step_mask))
+    return {"Projection": [jnp.moveaxis(rs, 0, 1)], "Cell": [jnp.moveaxis(cs, 0, 1)],
+            "LastH": [rT], "LastC": [cT]}

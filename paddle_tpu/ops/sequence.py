@@ -261,3 +261,98 @@ def edit_distance(ctx, ins, attrs):
         dists = dists / jnp.maximum(rlen.astype(jnp.float32), 1.0)
     return {"Out": [dists.reshape(-1, 1)],
             "SequenceNum": [jnp.asarray(n, jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# LoD structural compat ops. The reference moves variable-length batches
+# through LoDRankTable / LoDTensorArray plumbing (lod_rank_table_op.cc,
+# lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc, split_lod_tensor_op.cc,
+# merge_lod_tensor_op.cc, reorder_lod_tensor_by_rank_op.cc,
+# max_sequence_len_op.cc, shrink_rnn_memory_op.cc). Dense redesign: sequences
+# are padded [N, T, ...] + Length [N]; the "rank table" is (Index, Length)
+# sorted by descending length; tensor arrays are stacked time-major tensors;
+# "shrinking" freezes finished rows by mask instead of changing shapes —
+# all static-shape, all XLA-compilable.
+# ---------------------------------------------------------------------------
+
+
+@register_op("lod_rank_table", inputs=("X",), outputs=("Index", "OutLength"),
+             no_grad=True)
+def lod_rank_table(ctx, ins, attrs):
+    length = ins["X"][0].reshape(-1).astype(jnp.int32)
+    # stable sort by descending length (reference sorts (idx, len) pairs)
+    order = jnp.argsort(-length, stable=True).astype(jnp.int32)
+    return {"Index": [order], "OutLength": [length[order]]}
+
+
+@register_op("max_sequence_len", inputs=("RankTable",), outputs=("Out",),
+             no_grad=True)
+def max_sequence_len(ctx, ins, attrs):
+    return {"Out": [jnp.max(ins["RankTable"][0]).astype(jnp.int64)]}
+
+
+@register_op("reorder_lod_tensor_by_rank", inputs=("X", "RankTable"),
+             outputs=("Out",), diff_inputs=("X",))
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    return {"Out": [x[idx]]}
+
+
+@register_op("lod_tensor_to_array", inputs=("X", "RankTable"), outputs=("Out",),
+             diff_inputs=("X",))
+def lod_tensor_to_array(ctx, ins, attrs):
+    """[N, T, ...] batch-major -> [T, N, ...] time-major array, rows ordered
+    longest-first so step t's active rows are a prefix (as in the reference)."""
+    x, idx = ins["X"][0], ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    return {"Out": [jnp.moveaxis(x[idx], 0, 1)]}
+
+
+@register_op("array_to_lod_tensor", inputs=("X", "RankTable"), outputs=("Out",),
+             diff_inputs=("X",))
+def array_to_lod_tensor(ctx, ins, attrs):
+    """Inverse of lod_tensor_to_array: un-transpose and undo the rank reorder."""
+    x, idx = ins["X"][0], ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    batch_major = jnp.moveaxis(x, 0, 1)  # [N, T, ...]
+    inv = jnp.zeros_like(idx).at[idx].set(jnp.arange(idx.shape[0], dtype=jnp.int32))
+    return {"Out": [batch_major[inv]]}
+
+
+def _row_mask(mask, x):
+    m = mask.reshape(mask.shape[0], *([1] * (x.ndim - 1)))
+    return m.astype(bool)
+
+
+@register_op("split_lod_tensor", inputs=("X", "Mask"),
+             outputs=("OutTrue", "OutFalse"), diff_inputs=("X",))
+def split_lod_tensor(ctx, ins, attrs):
+    """Route rows by boolean mask (<- split_lod_tensor_op.cc, the IfElse
+    scaffold). Dense: both outputs keep the full static shape; non-selected
+    rows are zeroed, and merge_lod_tensor recombines by the same mask."""
+    x, mask = ins["X"][0], ins["Mask"][0]
+    m = _row_mask(mask, x)
+    zero = jnp.zeros_like(x)
+    return {"OutTrue": [jnp.where(m, x, zero)],
+            "OutFalse": [jnp.where(m, zero, x)]}
+
+
+@register_op("merge_lod_tensor", inputs=("InTrue", "InFalse", "Mask"),
+             outputs=("Out",), diff_inputs=("InTrue", "InFalse"))
+def merge_lod_tensor(ctx, ins, attrs):
+    t, f, mask = ins["InTrue"][0], ins["InFalse"][0], ins["Mask"][0]
+    return {"Out": [jnp.where(_row_mask(mask, t), t, f)]}
+
+
+@register_op("shrink_rnn_memory", inputs=("X", "RankTable", "I"),
+             outputs=("Out",), diff_inputs=("X",))
+def shrink_rnn_memory(ctx, ins, attrs):
+    """Freeze finished sequences at step I (<- shrink_rnn_memory_op.cc).
+
+    The reference physically truncates the batch to the rows still active
+    (rows are sorted longest-first so they form a prefix); dense analogue
+    zero-masks rows whose length <= I, keeping the shape static for XLA.
+    """
+    x = ins["X"][0]
+    length = ins["RankTable"][0].reshape(-1)
+    i = jnp.reshape(ins["I"][0], ()).astype(length.dtype)
+    keep = (length > i).astype(x.dtype)
+    return {"Out": [x * keep.reshape(-1, *([1] * (x.ndim - 1)))]}

@@ -1,0 +1,395 @@
+"""Ops completing the SURVEY §2b inventory: lstmp, pool3d, spp, random_crop,
+positive_negative_pair, fake quant/dequant, generic beam_search(+decode),
+LoD structural compat ops — vs numpy references."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+class TestPool3dMax(OpTest):
+    op_type = "pool3d"
+
+    def setup(self):
+        # well-separated values: max-pool numeric grad breaks on near-ties
+        x = (np.random.permutation(2 * 3 * 4 * 6 * 6).astype("float32")
+             .reshape(2, 3, 4, 6, 6) / 10.0)
+        k, s = 2, 2
+        out = np.zeros((2, 3, 2, 3, 3), "float32")
+        for d in range(2):
+            for i in range(3):
+                for j in range(3):
+                    out[:, :, d, i, j] = x[:, :, d*s:d*s+k, i*s:i*s+k, j*s:j*s+k].max(axis=(2, 3, 4))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2], "strides": [2, 2, 2]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        """Exact analytic check: d mean(out) / dx routes 1/n_out to each
+        window's argmax (numeric diff is too noisy at this tensor size)."""
+        self.setup()
+        x = self.inputs["X"]
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", shape=list(x.shape), dtype="float32",
+                                   append_batch_size=False)
+            xv.stop_gradient = False
+            xv.is_data = False
+            out = fluid.layers.pool3d(xv, pool_size=2, pool_stride=2,
+                                      pool_type="max")
+            loss = fluid.layers.mean(out)
+        from paddle_tpu.core import append_backward, grad_var_name
+        append_backward(loss)
+        exe = fluid.Executor()
+        g, = exe.run(main, feed={"x": x}, fetch_list=[grad_var_name("x")])
+        ref = np.zeros_like(x)
+        n_out = self.outputs["Out"].size
+        s = 2
+        for b in range(x.shape[0]):
+            for c in range(x.shape[1]):
+                for d in range(2):
+                    for i in range(3):
+                        for j in range(3):
+                            win = x[b, c, d*s:d*s+2, i*s:i*s+2, j*s:j*s+2]
+                            am = np.unravel_index(np.argmax(win), win.shape)
+                            ref[b, c, d*s+am[0], i*s+am[1], j*s+am[2]] += 1.0 / n_out
+        np.testing.assert_allclose(g, ref, rtol=1e-5, atol=1e-8)
+
+
+class TestPool3dAvgGlobal(OpTest):
+    op_type = "pool3d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 5, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.mean(axis=(2, 3, 4), keepdims=True)}
+        self.attrs = {"pooling_type": "avg", "global_pooling": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSppMax(OpTest):
+    op_type = "spp"
+
+    def setup(self):
+        x = (np.random.permutation(2 * 3 * 8 * 8).astype("float32")
+             .reshape(2, 3, 8, 8) / 100.0)
+        # level 0: global max [N, C]; level 1: 2x2 grid max [N, C*4]
+        l0 = x.max(axis=(2, 3)).reshape(2, -1)
+        l1 = np.zeros((2, 3, 2, 2), "float32")
+        for i in range(2):
+            for j in range(2):
+                l1[:, :, i, j] = x[:, :, i*4:(i+1)*4, j*4:(j+1)*4].max(axis=(2, 3))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.concatenate([l0, l1.reshape(2, -1)], axis=1)}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestPositiveNegativePair(OpTest):
+    op_type = "positive_negative_pair"
+
+    def setup(self):
+        score = np.array([[0.9], [0.2], [0.5], [0.5], [0.1]], "float32")
+        label = np.array([[1.0], [0.0], [1.0], [0.0], [0.0]], "float32")
+        qid = np.array([[0], [0], [1], [1], [1]], "int32")
+        # q0: pair (0 better than 1): score .9 > .2 -> positive
+        # q1: (2,3): .5 == .5 -> neutral; (2,4): .5 > .1 -> positive
+        self.inputs = {"Score": score, "Label": label, "QueryID": qid}
+        self.outputs = {
+            "PositivePair": np.array([2.0], "float32"),
+            "NegativePair": np.array([0.0], "float32"),
+            "NeutralPair": np.array([1.0], "float32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFakeQuantizeAbsMax(OpTest):
+    op_type = "fake_quantize_abs_max"
+
+    def setup(self):
+        x = (np.random.rand(8, 6).astype("float32") - 0.5) * 4
+        scale = np.abs(x).max()
+        self.inputs = {"X": x}
+        self.outputs = {
+            "Out": np.clip(np.round(x / scale * 127), -127, 127).astype("float32"),
+            "OutScale": np.array([scale], "float32"),
+        }
+        self.attrs = {"bit_length": 8}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFakeDequantizeMaxAbs(OpTest):
+    op_type = "fake_dequantize_max_abs"
+
+    def setup(self):
+        x = np.random.randint(-127, 127, (6, 4)).astype("float32")
+        scale = np.array([3.7], "float32")
+        self.inputs = {"X": x, "Scale": scale}
+        self.outputs = {"Out": (x * 3.7 / 127.0).astype("float32")}
+        self.attrs = {"max_range": 127.0}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLodRankTable(OpTest):
+    op_type = "lod_rank_table"
+
+    def setup(self):
+        length = np.array([2, 5, 3, 5], "int32")
+        self.inputs = {"X": length}
+        # stable sort by descending length: idx 1 (5), 3 (5), 2 (3), 0 (2)
+        self.outputs = {
+            "Index": np.array([1, 3, 2, 0], "int32"),
+            "OutLength": np.array([5, 5, 3, 2], "int32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReorderByRank(OpTest):
+    op_type = "reorder_lod_tensor_by_rank"
+
+    def setup(self):
+        x = np.random.rand(4, 3).astype("float32")
+        idx = np.array([1, 3, 2, 0], "int32")
+        self.inputs = {"X": x, "RankTable": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestShrinkRnnMemory(OpTest):
+    op_type = "shrink_rnn_memory"
+
+    def setup(self):
+        x = np.random.rand(4, 3).astype("float32")
+        length = np.array([5, 5, 3, 2], "int32")  # sorted desc as in rank table
+        i = np.array([3], "int32")
+        out = x.copy()
+        out[length <= 3] = 0.0
+        self.inputs = {"X": x, "RankTable": length, "I": i}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_lod_tensor_array_roundtrip():
+    """lod_tensor_to_array o array_to_lod_tensor == identity."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 3], dtype="float32",
+                              append_batch_size=False)
+        length = fluid.layers.data("len", shape=[4], dtype="int32",
+                                   append_batch_size=False)
+        idx, slen = fluid.layers.lod_rank_table(length)
+        arr = fluid.layers.lod_tensor_to_array(x, idx)
+        back = fluid.layers.array_to_lod_tensor(arr, idx)
+        mx = fluid.layers.max_sequence_len(slen)
+    exe = fluid.Executor()
+    xv = np.random.rand(4, 3).astype("float32")
+    lv = np.array([2, 4, 1, 3], "int32")
+    arr_v, back_v, mx_v = exe.run(
+        main, feed={"x": xv, "len": lv},
+        fetch_list=[arr.name, back.name, mx.name])
+    assert arr_v.shape == (3, 4)  # time-major
+    np.testing.assert_allclose(back_v, xv, rtol=1e-6)
+    assert int(mx_v) == 4
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5, 2], dtype="float32",
+                              append_batch_size=False)
+        mask = fluid.layers.data("m", shape=[5, 1], dtype="bool",
+                                 append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, mask)
+        merged = fluid.layers.merge_lod_tensor(t, f, mask)
+    exe = fluid.Executor()
+    xv = np.random.rand(5, 2).astype("float32")
+    mv = np.array([[1], [0], [1], [0], [1]], dtype=bool)
+    tv, fv, mg = exe.run(main, feed={"x": xv, "m": mv},
+                         fetch_list=[t.name, f.name, merged.name])
+    np.testing.assert_allclose(tv[mv[:, 0]], xv[mv[:, 0]])
+    assert np.all(tv[~mv[:, 0]] == 0)
+    np.testing.assert_allclose(mg, xv, rtol=1e-6)
+
+
+def test_lstmp_shapes_and_masking():
+    """lstmp projects the recurrent state; frozen rows stop updating."""
+    n, t, h, p = 3, 5, 4, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[t, 4 * h], dtype="float32")
+        length = fluid.layers.data("len", shape=[3], dtype="int32",
+                                   append_batch_size=False)
+        proj, cell = fluid.layers.dynamic_lstmp(x, size=h, proj_size=p,
+                                                length=length)
+        loss = fluid.layers.mean(proj)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=3)
+    xv = np.random.rand(n, t, 4 * h).astype("float32")
+    lv = np.array([5, 2, 3], "int32")
+    pv, cv = exe.run(main, feed={"x": xv, "len": lv},
+                     fetch_list=[proj.name, cell.name], scope=scope)
+    assert pv.shape == (n, t, p) and cv.shape == (n, t, h)
+    # sequence 1 has length 2: steps >= 2 are masked to zero
+    assert np.all(pv[1, 2:] == 0) and np.all(cv[1, 2:] == 0)
+    assert np.any(pv[1, :2] != 0)
+
+
+def test_beam_search_step_and_decode():
+    """Generic beam_search picks global top-K; decode backtraces parents."""
+    n, k, v, steps = 2, 2, 5, 3
+    rng = np.random.RandomState(0)
+    logp = np.log(rng.dirichlet(np.ones(v), size=(steps, n, k)).astype("float32"))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.data("pre_ids", shape=[n, k], dtype="int32",
+                                    append_batch_size=False)
+        pre_sc = fluid.layers.data("pre_sc", shape=[n, k], dtype="float32",
+                                   append_batch_size=False)
+        sc = fluid.layers.data("sc", shape=[n, k, v], dtype="float32",
+                               append_batch_size=False)
+        ids, scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_sc, sc, beam_size=k, end_id=0)
+    exe = fluid.Executor()
+
+    # run the stepwise op against a numpy beam search
+    pre_i = np.full((n, k), 2, "int32")
+    pre_s = np.zeros((n, k), "float32")
+    pre_s[:, 1] = -1e9  # only beam 0 live
+    all_ids, all_par, all_sc = [], [], []
+    for t in range(steps):
+        iv, sv, pv = exe.run(
+            main, feed={"pre_ids": pre_i, "pre_sc": pre_s, "sc": logp[t]},
+            fetch_list=[ids.name, scores.name, parent.name])
+        # numpy reference: top-k of pre_s + logp over (k*v)
+        cand = pre_s[:, :, None] + logp[t]
+        finished = pre_i == 0
+        cand = np.where(finished[..., None],
+                        np.where(np.arange(v) == 0, pre_s[:, :, None], -np.inf),
+                        cand)
+        flat = cand.reshape(n, -1)
+        ref_idx = np.argsort(-flat, axis=1)[:, :k]
+        np.testing.assert_allclose(np.sort(sv, axis=1),
+                                   np.sort(np.take_along_axis(flat, ref_idx, 1), axis=1),
+                                   rtol=1e-5)
+        pre_i, pre_s = iv, sv
+        all_ids.append(iv)
+        all_par.append(pv)
+        all_sc.append(sv)
+
+    main2 = fluid.Program()
+    with fluid.program_guard(main2, fluid.Program()):
+        ids_arr = fluid.layers.data("ids", shape=[steps, n, k], dtype="int32",
+                                    append_batch_size=False)
+        par_arr = fluid.layers.data("par", shape=[steps, n, k], dtype="int32",
+                                    append_batch_size=False)
+        sc_arr = fluid.layers.data("scs", shape=[steps, n, k], dtype="float32",
+                                   append_batch_size=False)
+        sent, fin = fluid.layers.beam_search_decode(ids_arr, par_arr, sc_arr)
+    sent_v, fin_v = exe.run(
+        main2, feed={"ids": np.stack(all_ids), "par": np.stack(all_par),
+                     "scs": np.stack(all_sc)},
+        fetch_list=[sent.name, fin.name])
+    assert sent_v.shape == (n, k, steps)
+    # best-first ordering
+    assert np.all(fin_v[:, 0] >= fin_v[:, 1])
+    # backtrace consistency: last token of best sentence is the argmax beam's token
+    best_beam = np.argmax(all_sc[-1], axis=1)
+    np.testing.assert_array_equal(sent_v[np.arange(n), 0, -1],
+                                  np.stack(all_ids)[-1][np.arange(n), best_beam])
+
+
+def test_random_crop_shape_and_content():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        out = fluid.layers.random_crop(x, shape=[3, 6, 6])
+    exe = fluid.Executor()
+    xv = np.random.rand(2, 3, 8, 8).astype("float32")
+    ov, = exe.run(main, feed={"x": xv}, fetch_list=[out.name], seed=13)
+    assert ov.shape == (2, 3, 6, 6)
+    # each batch element's crop must be a contiguous window of its image
+    for b in range(2):
+        found = False
+        for oi in range(3):
+            for oj in range(3):
+                if np.allclose(xv[b, :, oi:oi+6, oj:oj+6], ov[b]):
+                    found = True
+        assert found, "crop is not a contiguous window of the input"
+
+
+class TestSppNonDivisible(OpTest):
+    """7x7 plane, level-1 bins: kernel = stride = ceil(7/2) = 4, pad 1."""
+    op_type = "spp"
+
+    def setup(self):
+        x = (np.random.permutation(1 * 2 * 7 * 7).astype("float32")
+             .reshape(1, 2, 7, 7))
+        l0 = x.max(axis=(2, 3)).reshape(1, -1)
+        padded = np.full((1, 2, 8, 8), -np.inf, "float32")
+        padded[:, :, :7, :7] = x  # pad lands at the high side (ph = (8-7+1)//2 = 1 -> low 1? see op)
+        # replicate op padding: low = (k*bins - size + 1)//2 = 1, high = k*bins - size - low = 0
+        padded = np.full((1, 2, 8, 8), -np.inf, "float32")
+        padded[:, :, 1:8, 1:8] = x
+        l1 = padded.reshape(1, 2, 2, 4, 2, 4).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.concatenate([l0, l1.reshape(1, -1)], axis=1)}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_print_op_braces_and_first_n(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32",
+                              append_batch_size=False)
+        out = fluid.layers.Print(x, message="step {}: ", first_n=2, summarize=2)
+        y = fluid.layers.scale(out, scale=2.0)
+    # host callbacks are unsupported over the axon tunnel; pin to CPU XLA
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([1.0, 2.0], "float32")
+    for _ in range(4):
+        yv, = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+    np.testing.assert_allclose(yv, xv * 2)
+    captured = capfd.readouterr()
+    assert captured.out.count("step {}:") == 2  # first_n honored, braces literal
+
+
+def test_random_crop_int_seed():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        out = fluid.layers.random_crop(x, shape=[3, 6, 6], seed=42)
+    exe = fluid.Executor()
+    xv = np.random.rand(2, 3, 8, 8).astype("float32")
+    ov, = exe.run(main, feed={"x": xv}, fetch_list=[out.name], seed=7)
+    assert ov.shape == (2, 3, 6, 6)
