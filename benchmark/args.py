@@ -1,0 +1,54 @@
+"""CLI for the benchmark driver (<- benchmark/fluid/args.py).
+
+Differences from the reference, by design: --device grows a TPU choice (the
+GPU rows of BASELINE.md map to the single-chip TPU run); --gpus becomes
+--num_devices (a jax.sharding mesh dimension, not a CUDA_VISIBLE_DEVICES
+count); pserver/nccl2 --update_method modes collapse into the collective
+executor, so the flag keeps only local|collective.
+"""
+from __future__ import annotations
+
+import argparse
+
+__all__ = ["parse_args", "BENCHMARK_MODELS"]
+
+BENCHMARK_MODELS = [
+    "machine_translation", "resnet", "vgg", "mnist", "stacked_dynamic_lstm",
+]
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu model benchmarks.")
+    parser.add_argument("--model", type=str, choices=BENCHMARK_MODELS,
+                        default="resnet", help="The model to benchmark.")
+    parser.add_argument("--batch_size", type=int, default=32,
+                        help="The minibatch size (global, across devices).")
+    parser.add_argument("--learning_rate", type=float, default=0.001)
+    parser.add_argument("--skip_batch_num", type=int, default=5,
+                        help="Warmup minibatches excluded from timing.")
+    parser.add_argument("--iterations", type=int, default=80,
+                        help="Number of timed minibatches.")
+    parser.add_argument("--pass_num", type=int, default=1,
+                        help="Number of passes (epochs).")
+    parser.add_argument("--device", type=str, default="TPU",
+                        choices=["CPU", "TPU"])
+    parser.add_argument("--num_devices", type=int, default=1,
+                        help=">1 runs the mesh-sharded ParallelExecutor "
+                             "(data parallel over the 'dp' axis).")
+    parser.add_argument("--use_fake_data", action="store_true", default=True,
+                        help="Synthetic device-side data (reference "
+                             "--use_fake_data); real datasets need a cache.")
+    parser.add_argument("--amp", action="store_true",
+                        help="bf16 auto-mixed-precision (TPU-native AMP).")
+    parser.add_argument("--profile", action="store_true",
+                        help="Wrap the timed loop in the profiler and print "
+                             "the event table.")
+    parser.add_argument("--no_test", action="store_true")
+    parser.add_argument("--seed", type=int, default=0)
+    # model-specific
+    parser.add_argument("--class_num", type=int, default=1000)
+    parser.add_argument("--image_shape", type=str, default="3,224,224")
+    parser.add_argument("--seq_len", type=int, default=80)
+    parser.add_argument("--dict_size", type=int, default=30000)
+    parser.add_argument("--hidden_dim", type=int, default=512)
+    return parser.parse_args(argv)

@@ -1,0 +1,105 @@
+"""Benchmark driver (<- benchmark/fluid/fluid_benchmark.py).
+
+Run, from the repo root::
+
+    python benchmark/fluid_benchmark.py --model resnet --batch_size 32 \
+        --device TPU --iterations 50
+
+Metric is examples/sec (<- fluid_benchmark.py:295 print_train_time). The
+reference's single-GPU / multi-GPU / pserver / nccl2 modes map to:
+--num_devices 1 (one chip), --num_devices N (mesh-sharded ParallelExecutor,
+gradient all-reduce over ICI compiled into the step), and multi-host via
+paddle_tpu.distributed.init_distributed (DCN axis) respectively.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from args import parse_args  # noqa: E402  (benchmark-local args.py)
+
+_args = parse_args() if __name__ == "__main__" else None
+if _args is not None and _args.device == "CPU" and _args.num_devices > 1:
+    # must happen before jax initializes: virtual CPU devices for the mesh
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_args.num_devices}"
+        ).strip()
+
+import paddle_tpu as fluid  # noqa: E402
+from models import get_model_module  # noqa: E402
+
+
+def print_train_time(start_time, end_time, num_samples):
+    """<- fluid_benchmark.py print_train_time: same output contract."""
+    train_elapsed = end_time - start_time
+    examples_per_sec = num_samples / train_elapsed
+    print("\nTotal examples: %d, total time: %.5f, %.5f examples/sec\n" %
+          (num_samples, train_elapsed, examples_per_sec))
+    return examples_per_sec
+
+
+def train(args):
+    mod = get_model_module(args.model)
+    main, startup, feed_fn, loss, examples_per_batch = mod.get_model(args)
+
+    place = fluid.TPUPlace(0) if args.device == "TPU" else fluid.CPUPlace()
+    scope = fluid.Scope()
+    exe = fluid.Executor(place, amp=args.amp)
+    exe.run(startup, scope=scope, seed=args.seed)
+
+    if args.num_devices > 1:
+        import jax
+
+        from paddle_tpu.parallel import ParallelExecutor, make_mesh
+
+        devices = (jax.devices() if args.device == "TPU"
+                   else jax.devices("cpu"))[: args.num_devices]
+        mesh = make_mesh({"dp": args.num_devices}, devices=devices)
+        runner = ParallelExecutor(use_tpu=args.device == "TPU",
+                                  loss_name=loss.name, main_program=main,
+                                  scope=scope, mesh=mesh, amp=args.amp)
+        run = lambda feed: runner.run(fetch_list=[loss.name], feed=feed)
+    else:
+        run = lambda feed: exe.run(main, feed=feed, fetch_list=[loss.name],
+                                   scope=scope, seed=args.seed)
+
+    rng = np.random.RandomState(args.seed)
+    feed = feed_fn(0, rng)  # fake data: one batch reused (reference parity)
+
+    for i in range(args.skip_batch_num):
+        run(feed)
+
+    if args.profile:
+        fluid.profiler.start_profiler("All")
+    losses = []
+    start = time.time()
+    for i in range(args.iterations):
+        if not args.use_fake_data:
+            feed = feed_fn(i + 1, rng)
+        out = run(feed)
+        losses.append(float(np.asarray(out[0]).mean()))
+    # the executor returns host numpy, so the loop above is device-complete
+    elapsed_end = time.time()
+    if args.profile:
+        fluid.profiler.stop_profiler("total")
+
+    eps = print_train_time(start, elapsed_end, examples_per_batch * args.iterations)
+    print("last loss: %.5f" % (losses[-1],))
+    return eps
+
+
+if __name__ == "__main__":
+    args = _args
+    print("----------- Configuration Arguments -----------")
+    for arg, value in sorted(vars(args).items()):
+        print("%s: %s" % (arg, value))
+    print("------------------------------------------------")
+    train(args)

@@ -1,0 +1,20 @@
+"""Benchmark model zoo (<- benchmark/fluid/models/).
+
+Every module exposes ``get_model(args) -> (main, startup, feed_fn, loss,
+examples_per_batch)``: feed_fn(step, rng) builds one synthetic minibatch
+(the reference's --use_fake_data), loss is the variable to minimize/fetch.
+"""
+from . import machine_translation, mnist, resnet, stacked_dynamic_lstm, vgg  # noqa: F401
+
+__all__ = ["machine_translation", "mnist", "resnet", "stacked_dynamic_lstm",
+           "vgg", "get_model_module"]
+
+
+def get_model_module(name: str):
+    return {
+        "machine_translation": machine_translation,
+        "mnist": mnist,
+        "resnet": resnet,
+        "stacked_dynamic_lstm": stacked_dynamic_lstm,
+        "vgg": vgg,
+    }[name]

@@ -1,0 +1,43 @@
+"""Seq2seq machine-translation benchmark
+(<- benchmark/fluid/models/machine_translation.py: WMT-style encoder-decoder
+with attention). Uses the attention seq2seq from the model zoo; synthetic
+token data at WMT-ish vocab sizes."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.seq2seq import Seq2SeqAttention
+
+
+def get_model(args):
+    seq_len = args.seq_len
+    model = Seq2SeqAttention(src_vocab=args.dict_size,
+                             trg_vocab=args.dict_size,
+                             embed_dim=args.hidden_dim // 4,
+                             hidden=args.hidden_dim // 2)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[seq_len], dtype="int64")
+        src_len = fluid.layers.data("src_len", shape=[-1], dtype="int32",
+                                    append_batch_size=False)
+        trg = fluid.layers.data("trg", shape=[seq_len], dtype="int64")
+        trg_len = fluid.layers.data("trg_len", shape=[-1], dtype="int32",
+                                    append_batch_size=False)
+        trg_next = fluid.layers.data("trg_next", shape=[seq_len, 1],
+                                     dtype="int64")
+        avg_cost, _ = model.build_train(src, src_len, trg, trg_len, trg_next)
+        opt = fluid.optimizer.Adam(learning_rate=args.learning_rate)
+        opt.minimize(avg_cost, startup)
+
+    def feed_fn(step, rng):
+        n, v = args.batch_size, args.dict_size
+        return {
+            "src": rng.randint(0, v, (n, seq_len)).astype("int64"),
+            "src_len": rng.randint(seq_len // 2, seq_len + 1, (n,)).astype("int32"),
+            "trg": rng.randint(0, v, (n, seq_len)).astype("int64"),
+            "trg_len": rng.randint(seq_len // 2, seq_len + 1, (n,)).astype("int32"),
+            "trg_next": rng.randint(0, v, (n, seq_len, 1)).astype("int64"),
+        }
+
+    return main, startup, feed_fn, avg_cost, args.batch_size

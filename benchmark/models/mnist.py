@@ -1,0 +1,25 @@
+"""MNIST LeNet-5 benchmark model (<- benchmark/fluid/models/mnist.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import lenet5
+
+
+def get_model(args):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("pixel", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = lenet5(img, label)
+        opt = fluid.optimizer.Adam(learning_rate=args.learning_rate)
+        opt.minimize(avg_cost, startup)
+
+    def feed_fn(step, rng):
+        return {
+            "pixel": rng.rand(args.batch_size, 1, 28, 28).astype("float32"),
+            "label": rng.randint(0, 10, (args.batch_size, 1)).astype("int64"),
+        }
+
+    return main, startup, feed_fn, avg_cost, args.batch_size

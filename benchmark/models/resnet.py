@@ -1,0 +1,28 @@
+"""ResNet-50 benchmark model (<- benchmark/fluid/models/resnet.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet50
+
+
+def get_model(args):
+    c, h, w = (int(s) for s in args.image_shape.split(","))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("data", shape=[c, h, w], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = resnet50(img, label, class_dim=args.class_num)
+        opt = fluid.optimizer.Momentum(learning_rate=args.learning_rate,
+                                       momentum=0.9)
+        opt.minimize(avg_cost, startup)
+
+    def feed_fn(step, rng):
+        return {
+            "data": rng.rand(args.batch_size, c, h, w).astype("float32"),
+            "label": rng.randint(0, args.class_num,
+                                 (args.batch_size, 1)).astype("int64"),
+        }
+
+    return main, startup, feed_fn, avg_cost, args.batch_size

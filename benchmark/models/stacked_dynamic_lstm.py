@@ -1,0 +1,35 @@
+"""Stacked dynamic LSTM text classifier benchmark
+(<- benchmark/fluid/models/stacked_dynamic_lstm.py: IMDB-style classifier).
+Variable-length sequences use the dense padded + length representation;
+the whole stack compiles to masked lax.scans."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.book import understand_sentiment_stacked_lstm
+
+
+def get_model(args):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data("words", shape=[args.seq_len], dtype="int64")
+        length = fluid.layers.data("length", shape=[-1], dtype="int32",
+                                   append_batch_size=False)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = understand_sentiment_stacked_lstm(
+            data, label, length, dict_dim=args.dict_size,
+            hid_dim=args.hidden_dim // 4)
+        opt = fluid.optimizer.Adam(learning_rate=args.learning_rate)
+        opt.minimize(avg_cost, startup)
+
+    def feed_fn(step, rng):
+        return {
+            "words": rng.randint(0, args.dict_size,
+                                 (args.batch_size, args.seq_len)).astype("int64"),
+            "length": rng.randint(args.seq_len // 2, args.seq_len + 1,
+                                  (args.batch_size,)).astype("int32"),
+            "label": rng.randint(0, 2, (args.batch_size, 1)).astype("int64"),
+        }
+
+    return main, startup, feed_fn, avg_cost, args.batch_size

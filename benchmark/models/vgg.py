@@ -1,0 +1,27 @@
+"""VGG-16 benchmark model (<- benchmark/fluid/models/vgg.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import vgg16
+
+
+def get_model(args):
+    c, h, w = (int(s) for s in args.image_shape.split(","))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("data", shape=[c, h, w], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = vgg16(img, label, class_dim=args.class_num)
+        opt = fluid.optimizer.Adam(learning_rate=args.learning_rate)
+        opt.minimize(avg_cost, startup)
+
+    def feed_fn(step, rng):
+        return {
+            "data": rng.rand(args.batch_size, c, h, w).astype("float32"),
+            "label": rng.randint(0, args.class_num,
+                                 (args.batch_size, 1)).astype("int64"),
+        }
+
+    return main, startup, feed_fn, avg_cost, args.batch_size

@@ -227,9 +227,12 @@ class Executor:
         cache_key = (id(program), program.version, block_idx, sig,
                      tuple(fetch_names), self.amp)
 
+        from ..profiler import RecordEvent  # lazy: profiler imports jax
+
         entry = self._cache.get(cache_key)
         if entry is None:
-            entry = self._compile(program, block_idx, feed_names, fetch_names, sig)
+            with RecordEvent("executor_compile"):
+                entry = self._compile(program, block_idx, feed_names, fetch_names, sig)
             self._cache[cache_key] = entry
             # bounded LRU: mutating a program between runs (append_backward in
             # a loop, etc.) would otherwise accumulate stale executables
@@ -256,11 +259,16 @@ class Executor:
             seed = self._step_seed
         key = jax.random.PRNGKey(np.uint32(seed ^ (program.random_seed or 0)))
 
-        fetches, new_state = fn(feed_vals, readonly, donated, key)
-        for n in state_out_names:
-            scope.set(n, new_state[n])
-        if return_numpy:
-            fetches = [np.asarray(v) for v in fetches]
+        # the profiler event is the whole compiled-block run — the analogue of
+        # the reference's per-op RecordEvent in the interpreter hot loop
+        # (operator.cc RunImpl); ops fused into one XLA program leave only
+        # block-granularity host events, finer grain lives in device traces
+        with RecordEvent(f"executor_run/block{block_idx}"):
+            fetches, new_state = fn(feed_vals, readonly, donated, key)
+            for n in state_out_names:
+                scope.set(n, new_state[n])
+            if return_numpy:
+                fetches = [np.asarray(v) for v in fetches]
         return fetches
 
     # -- compilation --

@@ -6,4 +6,20 @@ cache) and otherwise falls back to a deterministic synthetic generator with
 the exact sample shapes/dtypes of the real dataset — enough for the book
 tests, benchmarks, and pipeline code to run unchanged.
 """
-from . import cifar, imdb, mnist, uci_housing  # noqa: F401
+from . import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    image,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)

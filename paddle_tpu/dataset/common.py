@@ -1,0 +1,93 @@
+"""Dataset cache/common helpers (<- python/paddle/dataset/common.py).
+
+The reference downloads archives into DATA_HOME keyed by md5. This
+environment has zero egress, so ``download`` only resolves already-cached
+files and otherwise raises with a clear message; every dataset module in
+this package degrades to a deterministic synthetic generator instead of
+calling it.
+"""
+from __future__ import annotations
+
+import errno
+import glob
+import hashlib
+import os
+import pickle
+
+__all__ = ["DATA_HOME", "download", "md5file", "split", "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def must_mkdirs(path):
+    try:
+        os.makedirs(path)
+    except OSError as exc:
+        if exc.errno != errno.EEXIST:
+            raise
+
+
+must_mkdirs(DATA_HOME)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Resolve a cached file; no network egress is available, so a miss
+    raises instead of fetching (<- common.py download)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(
+        dirname, url.split("/")[-1] if save_name is None else save_name)
+    if os.path.exists(filename) and (not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise IOError(
+        f"dataset file {filename} not cached and network egress is disabled; "
+        f"place the file there manually or use the synthetic fallback reader")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split a reader's samples into pickled chunk files
+    (<- common.py split)."""
+    if not callable(reader):
+        raise TypeError("reader should be callable")
+    if "%" not in suffix:
+        raise ValueError("suffix should contain %d")
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Read this trainer's shard of chunk files (<- common.py
+    cluster_files_reader): file i belongs to trainer i % trainer_count."""
+
+    def reader():
+        if not callable(loader):
+            raise TypeError("loader should be callable")
+        file_list = glob.glob(files_pattern)
+        file_list.sort()
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    lines = loader(f)
+                    for line in lines:
+                        yield line
+
+    return reader

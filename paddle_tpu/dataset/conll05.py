@@ -1,0 +1,92 @@
+"""CoNLL-2005 semantic-role-labeling readers
+(<- python/paddle/dataset/conll05.py).
+
+Samples: 9 slots per token sequence — (word_ids, ctx_n2, ctx_n1, ctx_0,
+ctx_p1, ctx_p2, pred_ids, mark, label_ids) — exactly the feed the SRL book
+model consumes. Synthetic fallback generates consistent dictionaries and
+BIO label sequences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["test", "get_dict", "get_embedding"]
+
+_WORD_VOCAB = 3000
+_LABEL_KINDS = ["A0", "A1", "A2", "A3", "AM-TMP", "AM-LOC"]
+_EMB_DIM = 32
+_SYNTH_SENTS = 300
+
+UNK_IDX = 0
+
+_word_dict = None
+_verb_dict = None
+_label_dict = None
+
+
+def _build_dicts():
+    global _word_dict, _verb_dict, _label_dict
+    if _word_dict is not None:
+        return
+    _word_dict = {"<unk>": UNK_IDX}
+    for i in range(_WORD_VOCAB):
+        _word_dict["w%d" % i] = len(_word_dict)
+    _verb_dict = {}
+    for i in range(200):
+        _verb_dict["v%d" % i] = len(_verb_dict)
+    _label_dict = {"O": 0}
+    for k in _LABEL_KINDS:
+        _label_dict["B-" + k] = len(_label_dict)
+        _label_dict["I-" + k] = len(_label_dict)
+    # verb marker label as in the reference's label file
+    _label_dict["B-V"] = len(_label_dict)
+
+
+def get_dict():
+    """Returns (word_dict, verb_dict, label_dict) (<- conll05.py:201)."""
+    _build_dicts()
+    return _word_dict, _verb_dict, _label_dict
+
+
+def get_embedding():
+    """Pre-trained word embedding matrix [len(word_dict), 32]
+    (<- conll05.py:214 emb file); synthetic = deterministic gaussian."""
+    _build_dicts()
+    rng = np.random.RandomState(5)
+    return rng.randn(len(_word_dict), _EMB_DIM).astype("float32")
+
+
+def reader_creator():
+    word_dict, verb_dict, label_dict = get_dict()
+
+    def reader():
+        rng = np.random.RandomState(17)
+        for _ in range(_SYNTH_SENTS):
+            n = rng.randint(5, 25)
+            words = rng.randint(1, len(word_dict), n).astype(np.int64)
+            pred_pos = rng.randint(0, n)
+            verb = rng.randint(0, len(verb_dict))
+            mark = np.zeros(n, np.int64)
+            mark[pred_pos] = 1
+            # BIO labels: one argument span left or right of the predicate
+            labels = np.zeros(n, np.int64)
+            span_start = rng.randint(0, n)
+            span_len = rng.randint(1, min(4, n - span_start) + 1)
+            kind = rng.randint(0, len(_LABEL_KINDS))
+            labels[span_start] = 1 + 2 * kind
+            labels[span_start + 1: span_start + span_len] = 2 + 2 * kind
+            labels[pred_pos] = label_dict["B-V"]
+
+            def ctx(off):
+                idx = np.clip(pred_pos + off, 0, n - 1)
+                return np.full(n, words[idx], np.int64)
+
+            yield (list(words), list(ctx(-2)), list(ctx(-1)), list(ctx(0)),
+                   list(ctx(1)), list(ctx(2)),
+                   [verb] * n, list(mark), list(labels))
+
+    return reader
+
+
+def test():
+    return reader_creator()

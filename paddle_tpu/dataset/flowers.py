@@ -1,0 +1,60 @@
+"""Oxford-102 flowers readers (<- python/paddle/dataset/flowers.py).
+
+Samples: (image float32 CHW [3, 224, 224], label int). Synthetic fallback
+renders class-correlated color-field images so classifiers can overfit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .image import simple_transform
+
+__all__ = ["train", "test", "valid"]
+
+_CLASSES = 102
+_SYNTH = {"train": 400, "test": 100, "valid": 100}
+
+
+def _raw_images(split):
+    rng = np.random.RandomState({"train": 30, "test": 31, "valid": 32}[split])
+    proto_rng = np.random.RandomState(29)
+    protos = proto_rng.rand(_CLASSES, 3).astype("float32")  # class hue
+    for _ in range(_SYNTH[split]):
+        label = int(rng.randint(0, _CLASSES))
+        hw = rng.randint(256, 320)
+        im = (protos[label][None, None] * 255 * 0.7 +
+              rng.rand(hw, hw, 3).astype("float32") * 255 * 0.3)
+        yield im.astype("float32"), label
+
+
+def default_mapper(is_train, sample):
+    """image bytes -> transformed sample (<- flowers.py:58); here the raw
+    sample is already an HWC array."""
+    img, label = sample
+    img = simple_transform(img, 256, 224, is_train,
+                           rng=np.random.RandomState(len(str(label))))
+    return img.flatten().astype("float32"), label
+
+
+train_mapper = lambda sample: default_mapper(True, sample)
+test_mapper = lambda sample: default_mapper(False, sample)
+
+
+def reader_creator(split, mapper, buffered_size=1024, use_xmap=True):
+    def reader():
+        for sample in _raw_images(split):
+            yield mapper(sample)
+
+    return reader
+
+
+def train(mapper=train_mapper, buffered_size=1024, use_xmap=True):
+    return reader_creator("train", mapper, buffered_size, use_xmap)
+
+
+def test(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    return reader_creator("test", mapper, buffered_size, use_xmap)
+
+
+def valid(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    return reader_creator("valid", mapper, buffered_size, use_xmap)

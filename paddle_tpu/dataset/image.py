@@ -1,0 +1,91 @@
+"""Image preprocessing utilities (<- python/paddle/dataset/image.py).
+
+The reference wraps PIL/cv2; these are pure-numpy equivalents (bilinear
+resize, crops, flip, CHW transform, normalize) with the same call surface,
+so reader pipelines port unchanged and stay dependency-free.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["resize_short", "to_chw", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform"]
+
+
+def _resize_bilinear(im, h, w):
+    """im: HWC uint8/float -> HWC float32 bilinear-resampled."""
+    im = np.asarray(im, dtype=np.float32)
+    src_h, src_w = im.shape[:2]
+    if (src_h, src_w) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * src_h / h - 0.5
+    xs = (np.arange(w) + 0.5) * src_w / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, src_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, src_w - 1)
+    y1 = np.clip(y0 + 1, 0, src_h - 1)
+    x1 = np.clip(x0 + 1, 0, src_w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    if im.ndim == 2:
+        im = im[:, :, None]
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.squeeze(-1) if out.shape[-1] == 1 else out
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge == size, keeping aspect
+    (<- image.py resize_short)."""
+    h, w = im.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(round(w * size / h))
+    else:
+        new_h, new_w = int(round(h * size / w)), size
+    return _resize_bilinear(im, new_h, new_w)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h_start = rng.randint(0, h - size + 1)
+    w_start = rng.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> (random|center) crop -> maybe flip -> CHW -> -mean
+    (<- image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, None, None]
+        im -= mean
+    return im

@@ -1,0 +1,100 @@
+"""imikolov (PTB language-model) readers (<- python/paddle/dataset/imikolov.py).
+
+Samples: NGRAM mode yields n-tuples of word ids; SEQ mode yields
+([id, ...],) sentences bracketed by <s>/<e>. Falls back to a deterministic
+synthetic corpus with a Zipfian vocabulary when the PTB archive is not
+cached.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ["train", "test", "build_dict"]
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+_TAR = os.path.join(DATA_HOME, "imikolov", "simple-examples.tgz")
+_TRAIN = "./simple-examples/data/ptb.train.txt"
+_TEST = "./simple-examples/data/ptb.valid.txt"
+
+_SYNTH_VOCAB = 2000
+_SYNTH_SENTS = {_TRAIN: 2000, _TEST: 200}
+
+
+def _synthetic_sentences(path, seed_base=7):
+    """Zipf-distributed fake PTB: deterministic per split."""
+    rng = np.random.RandomState(seed_base + (0 if path == _TRAIN else 1))
+    for _ in range(_SYNTH_SENTS[path]):
+        n = rng.randint(3, 20)
+        words = (rng.zipf(1.3, n) % _SYNTH_VOCAB).astype(np.int64)
+        yield ["w%d" % w for w in words]
+
+
+def _sentences(path):
+    if os.path.exists(_TAR):
+        with tarfile.open(_TAR) as tf:
+            for line in tf.extractfile(path):
+                yield line.decode().strip().split()
+    else:
+        yield from _synthetic_sentences(path)
+
+
+def word_count(sentences, word_freq=None):
+    if word_freq is None:
+        word_freq = {}
+    for words in sentences:
+        for w in words:
+            word_freq[w] = word_freq.get(w, 0) + 1
+        word_freq["<s>"] = word_freq.get("<s>", 0) + 1
+        word_freq["<e>"] = word_freq.get("<e>", 0) + 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50):
+    """word -> id over train+test, rare words dropped, '<unk>' appended
+    (<- imikolov.py:49)."""
+    word_freq = word_count(_sentences(_TEST), word_count(_sentences(_TRAIN)))
+    word_freq = {k: v for k, v in word_freq.items()
+                 if v >= min_word_freq and k != "<unk>"}
+    word_freq_sorted = sorted(word_freq.items(), key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*word_freq_sorted))
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(path, word_idx, n, data_type):
+    def reader():
+        for words in _sentences(path):
+            if DataType.NGRAM == data_type:
+                assert n > -1, "Invalid gram length"
+                words = ["<s>"] + words + ["<e>"]
+                if len(words) >= n:
+                    words = [word_idx.get(w, word_idx["<unk>"]) for w in words]
+                    for i in range(n, len(words) + 1):
+                        yield tuple(words[i - n: i])
+            elif DataType.SEQ == data_type:
+                words = [word_idx.get(w, word_idx["<unk>"]) for w in words]
+                ids = ([word_idx["<s>"]] + words, words + [word_idx["<e>"]])
+                yield ids
+            else:
+                raise AssertionError("Unknown data type")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(_TRAIN, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(_TEST, word_idx, n, data_type)

@@ -1,0 +1,204 @@
+"""MovieLens-1M readers (<- python/paddle/dataset/movielens.py).
+
+Samples: [user_id, gender_id, age_id, job_id, movie_id, [category_ids],
+[title_word_ids], rating]. Uses the real ml-1m archive when cached,
+otherwise a deterministic synthetic catalogue with the same id spaces.
+"""
+from __future__ import annotations
+
+import os
+import re
+import zipfile
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories", "user_info",
+           "movie_info"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_ZIP = os.path.join(DATA_HOME, "movielens", "ml-1m.zip")
+
+_SYNTH_USERS = 600
+_SYNTH_MOVIES = 400
+_SYNTH_CATEGORIES = ["Action", "Comedy", "Drama", "Horror", "Romance",
+                     "Sci-Fi", "Thriller", "Animation"]
+_SYNTH_TITLE_VOCAB = 500
+_SYNTH_JOBS = 21
+_SYNTH_RATINGS = 8000
+
+
+class MovieInfo:
+    """<- movielens.py MovieInfo."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo:
+    """<- movielens.py UserInfo."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
+            self.index, "M" if self.is_male else "F",
+            age_table[self.age], self.job_id)
+
+
+MOVIE_INFO = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO = None
+_RATINGS = None
+
+
+def _init():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, _RATINGS
+    if MOVIE_INFO is not None:
+        return
+    if os.path.exists(_ZIP):
+        _init_real()
+    else:
+        _init_synthetic()
+
+
+def _init_real():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, _RATINGS
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    MOVIE_INFO, CATEGORIES_DICT, MOVIE_TITLE_DICT, USER_INFO = {}, {}, {}, {}
+    _RATINGS = []
+    with zipfile.ZipFile(_ZIP) as package:
+        for info in package.infolist():
+            assert isinstance(info, zipfile.ZipInfo)
+            title_word_set = set()
+            categories_set = set()
+            with package.open("ml-1m/movies.dat") as movie_file:
+                for line in movie_file:
+                    line = line.decode(encoding="latin")
+                    movie_id, title, categories = line.strip().split("::")
+                    categories = categories.split("|")
+                    for c in categories:
+                        categories_set.add(c)
+                    title = pattern.match(title).group(1)
+                    MOVIE_INFO[int(movie_id)] = MovieInfo(
+                        index=movie_id, categories=categories, title=title)
+                    for w in title.split():
+                        title_word_set.add(w.lower())
+            for i, w in enumerate(title_word_set):
+                MOVIE_TITLE_DICT[w] = i
+            for i, c in enumerate(categories_set):
+                CATEGORIES_DICT[c] = i
+            with package.open("ml-1m/users.dat") as user_file:
+                for line in user_file:
+                    line = line.decode(encoding="latin")
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    USER_INFO[int(uid)] = UserInfo(
+                        index=uid, gender=gender, age=age, job_id=job)
+            with package.open("ml-1m/ratings.dat") as rating:
+                for line in rating:
+                    line = line.decode(encoding="latin")
+                    uid, mov_id, rating_v, _ = line.strip().split("::")
+                    _RATINGS.append((int(uid), int(mov_id), float(rating_v)))
+            break
+
+
+def _init_synthetic():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, _RATINGS
+    rng = np.random.RandomState(11)
+    CATEGORIES_DICT = {c: i for i, c in enumerate(_SYNTH_CATEGORIES)}
+    MOVIE_TITLE_DICT = {"t%d" % i: i for i in range(_SYNTH_TITLE_VOCAB)}
+    MOVIE_INFO = {}
+    for mid in range(1, _SYNTH_MOVIES + 1):
+        cats = list(rng.choice(_SYNTH_CATEGORIES,
+                               size=rng.randint(1, 4), replace=False))
+        title = " ".join("t%d" % w for w in
+                         rng.randint(0, _SYNTH_TITLE_VOCAB, rng.randint(1, 5)))
+        MOVIE_INFO[mid] = MovieInfo(index=mid, categories=cats, title=title)
+    USER_INFO = {}
+    for uid in range(1, _SYNTH_USERS + 1):
+        USER_INFO[uid] = UserInfo(
+            index=uid, gender="M" if rng.rand() < 0.5 else "F",
+            age=age_table[rng.randint(0, len(age_table))],
+            job_id=rng.randint(0, _SYNTH_JOBS))
+    _RATINGS = []
+    for _ in range(_SYNTH_RATINGS):
+        uid = rng.randint(1, _SYNTH_USERS + 1)
+        mid = rng.randint(1, _SYNTH_MOVIES + 1)
+        # learnable signal: rating correlates with (uid+mid) parity
+        base = 1 + ((uid + mid) % 5)
+        _RATINGS.append((uid, mid, float(base)))
+
+
+def _reader(rand_seed=0, test_ratio=0.1, is_test=False):
+    _init()
+    rng = np.random.RandomState(rand_seed)
+    for uid, mov_id, rating in _RATINGS:
+        if (rng.rand() < test_ratio) == is_test:
+            usr = USER_INFO[uid]
+            mov = MOVIE_INFO[mov_id]
+            yield usr.value() + mov.value() + [[rating]]
+
+
+def train():
+    return lambda: _reader(is_test=False)
+
+
+def test():
+    return lambda: _reader(is_test=True)
+
+
+def get_movie_title_dict():
+    _init()
+    return MOVIE_TITLE_DICT
+
+
+def max_movie_id():
+    _init()
+    return max(MOVIE_INFO.values(), key=lambda m: m.index).index
+
+
+def max_user_id():
+    _init()
+    return max(USER_INFO.values(), key=lambda u: u.index).index
+
+
+def max_job_id():
+    _init()
+    return max(USER_INFO.values(), key=lambda u: u.job_id).job_id
+
+
+def movie_categories():
+    _init()
+    return CATEGORIES_DICT
+
+
+def user_info():
+    _init()
+    return list(USER_INFO.values())
+
+
+def movie_info():
+    _init()
+    return list(MOVIE_INFO.values())

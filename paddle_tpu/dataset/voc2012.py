@@ -1,0 +1,45 @@
+"""Pascal VOC2012 segmentation readers (<- python/paddle/dataset/voc2012.py).
+
+Samples: (image float32 CHW [3, H, W], label int32 HW segmentation mask,
+21 classes incl. background). Synthetic fallback paints one rectangular
+object per image.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+CLASSES = 21
+_SYNTH = {"trainval": 200, "train": 150, "val": 50}
+
+
+def reader_creator(sub_name):
+    def reader():
+        rng = np.random.RandomState({"trainval": 50, "train": 51,
+                                     "val": 52}[sub_name])
+        for _ in range(_SYNTH[sub_name]):
+            h, w = rng.randint(64, 128, 2)
+            cls = rng.randint(1, CLASSES)
+            img = rng.rand(3, h, w).astype("float32")
+            label = np.zeros((h, w), np.int32)
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            y1, x1 = rng.randint(h // 2, h), rng.randint(w // 2, w)
+            label[y0:y1, x0:x1] = cls
+            img[cls % 3, y0:y1, x0:x1] += 0.5  # visible object signal
+            yield img, label
+
+    return reader
+
+
+def train():
+    """trainval split (<- voc2012.py:67)."""
+    return reader_creator("trainval")
+
+
+def test():
+    return reader_creator("train")
+
+
+def val():
+    return reader_creator("val")

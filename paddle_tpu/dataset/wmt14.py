@@ -1,0 +1,62 @@
+"""WMT14 en->fr readers (<- python/paddle/dataset/wmt14.py).
+
+Samples: (src_ids, trg_ids_with_<s>, trg_next_ids_with_<e>). Dicts are
+truncated to dict_size with <s>/<e>/<unk> reserved at 0/1/2. Synthetic
+fallback emits an invertible toy translation task (trg = src reversed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "gen", "get_dict"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+_SYNTH = {"train": 1500, "test": 150, "gen": 50}
+
+
+def _dicts(dict_size):
+    src = {START: 0, END: 1, UNK: 2}
+    trg = {START: 0, END: 1, UNK: 2}
+    for i in range(dict_size - 3):
+        src["s%d" % i] = i + 3
+        trg["t%d" % i] = i + 3
+    return src, trg
+
+
+def reader_creator(split, dict_size):
+    def reader():
+        rng = np.random.RandomState({"train": 0, "test": 1, "gen": 2}[split])
+        for _ in range(_SYNTH[split]):
+            n = rng.randint(3, 12)
+            src_ids = rng.randint(3, dict_size, n).astype(np.int64)
+            trg_ids = src_ids[::-1].copy()  # toy but learnable mapping
+            yield (list(src_ids),
+                   [0] + list(trg_ids),
+                   list(trg_ids) + [1])
+
+    return reader
+
+
+def train(dict_size):
+    return reader_creator("train", dict_size)
+
+
+def test(dict_size):
+    return reader_creator("test", dict_size)
+
+
+def gen(dict_size):
+    return reader_creator("gen", dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); id->word when reverse (<- wmt14.py:151)."""
+    src, trg = _dicts(dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

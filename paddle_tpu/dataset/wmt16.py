@@ -1,0 +1,83 @@
+"""WMT16 multimodal en<->de readers (<- python/paddle/dataset/wmt16.py).
+
+Samples: (src_ids, trg_ids_with_<s>, trg_next_ids_with_<e>); per-language
+dictionaries with <s>/<e>/<unk> at 0/1/2. Synthetic fallback mirrors
+wmt14's invertible toy task with language-tagged vocabularies.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+_SYNTH = {"train": 1500, "test": 150, "validation": 150}
+
+
+def _lang_dict(lang, dict_size):
+    d = {START_MARK: 0, END_MARK: 1, UNK_MARK: 2}
+    for i in range(dict_size - 3):
+        d["%s%d" % (lang, i)] = i + 3
+    return d
+
+
+def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
+    src_dict_size = min(src_dict_size, TOTAL_EN_WORDS if src_lang == "en"
+                        else TOTAL_DE_WORDS)
+    trg_dict_size = min(trg_dict_size, TOTAL_DE_WORDS if src_lang == "en"
+                        else TOTAL_EN_WORDS)
+    return src_dict_size, trg_dict_size
+
+
+def reader_creator(split, src_dict_size, trg_dict_size, src_lang):
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+
+    def reader():
+        rng = np.random.RandomState(
+            {"train": 10, "test": 11, "validation": 12}[split])
+        for _ in range(_SYNTH[split]):
+            n = rng.randint(3, 12)
+            lim = min(src_dict_size, trg_dict_size)
+            src_ids = rng.randint(3, lim, n).astype(np.int64)
+            trg_ids = src_ids[::-1].copy()
+            yield (list(src_ids),
+                   [0] + list(trg_ids),
+                   list(trg_ids) + [1])
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    return reader_creator("train", src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    return reader_creator("test", src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    return reader_creator("validation", src_dict_size, trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    dict_size = min(dict_size, TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS)
+    d = _lang_dict(lang, dict_size)
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d

@@ -139,7 +139,10 @@ def print_op(ctx, ins, attrs):
     summarize = attrs.get("summarize", -1)
     first_n = attrs.get("first_n", -1)
     shown = x.reshape(-1)[:summarize] if summarize and summarize > 0 else x
-    count = {"n": 0}  # closure state survives across executions of the jit
+    # first_n counts per IR op, not per compilation: key the counter on the
+    # op's attrs-dict identity, which is stable across retraces of the same
+    # program (a trace-local closure would reset on every jit cache miss)
+    count = _PRINT_COUNTS.setdefault(id(attrs), {"n": 0})
 
     def _host_print(val):
         if first_n is None or first_n < 0 or count["n"] < first_n:
@@ -148,3 +151,6 @@ def print_op(ctx, ins, attrs):
 
     jax.debug.callback(_host_print, shown)
     return {"Out": [x]}
+
+
+_PRINT_COUNTS: dict = {}

@@ -453,14 +453,20 @@ def spp(ctx, ins, attrs):
              no_grad=True, stochastic=True)
 def random_crop(ctx, ins, attrs):
     """Random spatial crop (<- random_crop_op.cc): crops the trailing dims of
-    every batch element to attrs['shape'] at a random offset drawn from the
-    functional PRNG (the reference threads an integer Seed tensor; the PRNG
-    key plays that role, and SeedOut keeps the slot shape for parity)."""
+    every batch element to attrs['shape'] at a random offset. When a Seed
+    tensor is provided, offsets derive deterministically from it (the
+    reference's seed-engine contract: same seed -> same crops) and SeedOut
+    carries seed+1 so chained crops differ; otherwise the executor's
+    functional PRNG drives the crop."""
     x = ins["X"][0]
     crop = list(attrs["shape"])
     k = len(crop)
     lead = x.shape[: x.ndim - k]
-    key = ctx.next_key()
+    seed_in = ins["Seed"][0] if ins.get("Seed") and ins["Seed"][0] is not None else None
+    if seed_in is not None:
+        key = jax.random.PRNGKey(seed_in.reshape(-1)[0].astype(jnp.uint32))
+    else:
+        key = ctx.next_key()
     maxs = jnp.array([x.shape[x.ndim - k + i] - crop[i] for i in range(k)], jnp.int32)
     nbatch = int(np.prod(lead)) if lead else 1
     offs = jax.random.randint(key, (nbatch, k), 0, maxs + 1, jnp.int32)
@@ -470,5 +476,6 @@ def random_crop(ctx, ins, attrs):
         return lax.dynamic_slice(xi, tuple(oi), tuple(crop))
 
     out = jax.vmap(crop_one)(flat, offs).reshape(tuple(lead) + tuple(crop))
-    seed = ins["Seed"][0] if ins.get("Seed") and ins["Seed"][0] is not None else jnp.zeros((1,), jnp.int32)
-    return {"Out": [out], "SeedOut": [seed]}
+    seed_out = (seed_in.reshape(-1)[:1] + 1 if seed_in is not None
+                else jnp.zeros((1,), jnp.int32))
+    return {"Out": [out], "SeedOut": [seed_out]}

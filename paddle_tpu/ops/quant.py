@@ -25,7 +25,10 @@ def fake_quantize_abs_max(ctx, ins, attrs):
     x = ins["X"][0]
     bit_length = attrs.get("bit_length", 8)
     bin_cnt = (1 << (bit_length - 1)) - 1
-    scale = jnp.max(jnp.abs(x))
+    # stop_gradient: the STE grad must be pure identity (reference grad is
+    # dX = dOut); a differentiable scale would leak -x*127/scale^2 into the
+    # max-|x| element through the vjp-derived grad
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
     scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
     out = _ste_round(x / scale * bin_cnt)
     out = jnp.clip(out, -bin_cnt, bin_cnt)
@@ -37,15 +40,24 @@ def fake_quantize_abs_max(ctx, ins, attrs):
              outputs=("Out", "OutScale", "OutScales"),
              diff_inputs=("X",), no_grad=False)
 def fake_quantize_range_abs_max(ctx, ins, attrs):
-    """Running-max variant used in QAT: scale = max(|x|, decayed history)."""
+    """Running-max variant used in QAT: scale = max(|x|, decayed history).
+
+    The reference keeps a window_size-deep history of per-step scales and
+    takes its max; here the history is one exponentially-decayed scalar
+    (decay = 1 - 1/window_size), a stateless approximation that likewise
+    forgets outliers after ~window_size steps without carrying the window
+    buffer through the compiled step.
+    """
     x = ins["X"][0]
     bit_length = attrs.get("bit_length", 8)
+    window_size = max(int(attrs.get("window_size", 10000)), 1)
     is_test = attrs.get("is_test", False) or ctx.is_test
     bin_cnt = (1 << (bit_length - 1)) - 1
     in_scale = (ins["InScale"][0].reshape(-1)[0]
                 if ins.get("InScale") and ins["InScale"][0] is not None else jnp.float32(0))
-    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
-    scale = jnp.where(is_test, in_scale, jnp.maximum(cur, in_scale))
+    cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x))).astype(jnp.float32)
+    decayed = in_scale * jnp.float32(1.0 - 1.0 / window_size)
+    scale = jnp.where(is_test, in_scale, jnp.maximum(cur, decayed))
     scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
     out = jnp.clip(_ste_round(x / scale * bin_cnt), -bin_cnt, bin_cnt)
     return {"Out": [out], "OutScale": [scale.reshape(1)],
@@ -55,7 +67,8 @@ def fake_quantize_range_abs_max(ctx, ins, attrs):
 @register_op("fake_dequantize_max_abs", inputs=("X", "Scale"), outputs=("Out",),
              diff_inputs=("X",))
 def fake_dequantize_max_abs(ctx, ins, attrs):
-    """<- fake_dequantize_op.cc: Out = Scale * X / max_range."""
+    """<- fake_dequantize_op.cc: Out = Scale * X / max_range (in X's dtype)."""
     x, scale = ins["X"][0], ins["Scale"][0]
     max_range = attrs.get("max_range", 127.0)
-    return {"Out": [x.astype(jnp.float32) * scale.reshape(-1)[0] / max_range]}
+    s = scale.reshape(-1)[0].astype(x.dtype)
+    return {"Out": [x * s / jnp.asarray(max_range, x.dtype)]}

@@ -1,0 +1,31 @@
+"""The benchmark driver (<- benchmark/fluid/fluid_benchmark.py) runs
+end-to-end and prints the examples/sec contract line."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(extra):
+    cmd = [sys.executable, os.path.join(REPO, "benchmark", "fluid_benchmark.py"),
+           "--device", "CPU", "--iterations", "2", "--skip_batch_num", "1",
+           "--batch_size", "4"] + extra
+    # strip the test-process jax env (conftest.py) — the driver manages its own
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                         env=env, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "examples/sec" in out.stdout
+    assert "last loss" in out.stdout
+    return out.stdout
+
+
+def test_mnist_single_device():
+    _run(["--model", "mnist"])
+
+
+def test_mnist_multi_device():
+    out = _run(["--model", "mnist", "--num_devices", "2"])
+    assert "examples/sec" in out

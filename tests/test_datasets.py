@@ -1,0 +1,114 @@
+"""Dataset module contracts (<- python/paddle/dataset/tests): shapes,
+dtypes, dict consistency — everything runs on the synthetic fallback."""
+import numpy as np
+
+from paddle_tpu.dataset import (conll05, flowers, image, imikolov, movielens,
+                                mq2007, sentiment, voc2012, wmt14, wmt16)
+
+
+def test_imikolov_ngram_and_seq():
+    d = imikolov.build_dict(min_word_freq=1)
+    assert "<unk>" in d and "<s>" in d and "<e>" in d
+    grams = list(imikolov.train(d, 5)())
+    assert len(grams) > 100
+    assert all(len(g) == 5 for g in grams[:50])
+    assert max(max(g) for g in grams[:50]) < len(d)
+    seqs = list(imikolov.test(d, -1, imikolov.DataType.SEQ)())
+    src, trg = seqs[0]
+    assert src[0] == d["<s>"] and trg[-1] == d["<e>"]
+    assert src[1:] == trg[:-1]
+
+
+def test_movielens_contract():
+    samples = list(movielens.train()())
+    uid, gender, age, job, mid, cats, title, rating = samples[0]
+    assert 1 <= uid <= movielens.max_user_id()
+    assert gender in (0, 1)
+    assert 0 <= age < len(movielens.age_table)
+    assert 0 <= job <= movielens.max_job_id()
+    assert 1 <= mid <= movielens.max_movie_id()
+    assert all(c in movielens.movie_categories().values() for c in cats)
+    assert all(t in movielens.get_movie_title_dict().values() for t in title)
+    assert 1.0 <= rating[0] <= 5.0
+    assert len(list(movielens.test()())) > 0
+    assert len(movielens.user_info()) > 0 and len(movielens.movie_info()) > 0
+
+
+def test_conll05_contract():
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    emb = conll05.get_embedding()
+    assert emb.shape[0] == len(word_dict)
+    sample = next(conll05.test()())
+    assert len(sample) == 9
+    words, c2, c1, c0, p1, p2, pred, mark, labels = sample
+    n = len(words)
+    assert all(len(s) == n for s in sample)
+    assert set(mark) <= {0, 1}
+    assert max(labels) < len(label_dict)
+
+
+def test_sentiment_contract():
+    wd = sentiment.get_word_dict()
+    tr = list(sentiment.train()())
+    te = list(sentiment.test()())
+    assert len(tr) == sentiment.NUM_TRAINING_INSTANCES
+    assert len(tr) + len(te) == sentiment.NUM_TOTAL_INSTANCES
+    ids, label = tr[0]
+    assert label in (0, 1)
+    assert max(ids) < len(wd)
+
+
+def test_wmt14_contract():
+    sd, td = wmt14.get_dict(1000, reverse=False)
+    assert sd[wmt14.START] == 0 and sd[wmt14.END] == 1 and sd[wmt14.UNK] == 2
+    src, trg, trg_next = next(wmt14.train(1000)())
+    assert trg[0] == 0 and trg_next[-1] == 1
+    assert trg[1:] == trg_next[:-1]
+    assert max(src) < 1000
+
+
+def test_wmt16_contract():
+    d = wmt16.get_dict("en", 800)
+    assert len(d) == 800
+    src, trg, trg_next = next(wmt16.train(800, 800, "en")())
+    assert trg[0] == 0 and trg_next[-1] == 1
+    rid = wmt16.get_dict("de", 800, reverse=True)
+    assert rid[0] == wmt16.START_MARK
+
+
+def test_flowers_contract():
+    img, label = next(flowers.train()())
+    assert img.shape == (3 * 224 * 224,)
+    assert img.dtype == np.float32
+    assert 0 <= label < 102
+
+
+def test_mq2007_formats():
+    score, feat = next(mq2007.train(format="pointwise")())
+    assert len(feat) == mq2007.FEATURE_DIM
+    label, better, worse = next(mq2007.train(format="pairwise")())
+    assert label[0] == 1 and len(better) == len(worse) == mq2007.FEATURE_DIM
+    labels, feats = next(mq2007.train(format="listwise")())
+    assert feats.shape[1] == mq2007.FEATURE_DIM and len(labels) == len(feats)
+
+
+def test_voc2012_contract():
+    img, label = next(voc2012.train()())
+    assert img.ndim == 3 and img.shape[0] == 3
+    assert label.shape == img.shape[1:]
+    assert label.max() < voc2012.CLASSES
+
+
+def test_image_transforms():
+    rng = np.random.RandomState(0)
+    im = rng.rand(100, 80, 3).astype("float32") * 255
+    short = image.resize_short(im, 64)
+    assert min(short.shape[:2]) == 64
+    crop = image.center_crop(short, 48)
+    assert crop.shape[:2] == (48, 48)
+    chw = image.to_chw(crop)
+    assert chw.shape == (3, 48, 48)
+    out = image.simple_transform(im, 72, 64, is_train=True,
+                                 mean=[127.0, 127.0, 127.0],
+                                 rng=np.random.RandomState(1))
+    assert out.shape == (3, 64, 64) and out.dtype == np.float32
