@@ -19,6 +19,15 @@ Public surface mirrors ``python/paddle/fluid``:
 from . import ops  # registers the op library
 from . import clip, initializer, layers, optimizer, regularizer, unique_name  # noqa: F401
 from . import dataset, io, metrics, profiler, reader  # noqa: F401
+from . import concurrency, master  # noqa: F401
+from .concurrency import (  # noqa: F401
+    Go,
+    Select,
+    channel_close,
+    channel_recv,
+    channel_send,
+    make_channel,
+)
 from .param_attr import ParamAttr  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .trainer import (  # noqa: F401

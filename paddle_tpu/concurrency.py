@@ -1,0 +1,244 @@
+"""CSP concurrency: channels, go, select
+(<- python/paddle/fluid/concurrency.py, framework/channel.h,
+operators/channel_{send,recv,create,close}_op.cc, select_op.cc, go_op.cc).
+
+Re-imagined for TPU: the reference lowers Go/Select into IR ops its C++
+executor runs on threads; under XLA a compiled program is a single
+data-parallel computation, so CSP's task-parallel role moves wholly to the
+host runtime — coordinating reader pipelines, checkpoint writers, pserver-
+style clients and the double-buffer feeders (exactly where the reference
+used channels internally, e.g. reader/blocking_queue.h). The public
+surface keeps the reference's names with Go-like semantics: bounded or
+rendezvous channels, close-drain, blocking select.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Go", "make_channel", "channel_send", "channel_recv", "channel_close",
+    "Select", "Channel", "ChannelClosed", "go",
+]
+
+
+class ChannelClosed(Exception):
+    """Send on a closed channel (<- channel.h SendOnClosed semantics)."""
+
+
+class Channel:
+    """Go-style channel (<- framework/channel.h Buffered/UnBuffered).
+
+    capacity == 0 is a rendezvous channel: send blocks until a receiver has
+    taken the value. close() wakes all waiters; receives drain remaining
+    buffered values then return (default, False) like the reference's
+    channel_recv Status output.
+    """
+
+    def __init__(self, capacity: int = 0, dtype: Any = None):
+        self.capacity = capacity
+        self.dtype = dtype  # kept for API parity; values are host objects
+        # buffered: _buf holds raw values. rendezvous (capacity 0): _buf holds
+        # [value, taken] cells so a timed-out sender can withdraw its own
+        # offer — a send that reports False must not be delivered later.
+        self._buf: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._rendezvous_done = threading.Condition(self._lock)
+        self._closed = False
+
+    # -- core ops --
+    def send(self, value, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            if self._closed:
+                raise ChannelClosed("send on closed channel")
+            if self.capacity > 0:
+                while len(self._buf) >= self.capacity:
+                    if not self._not_full.wait(timeout):
+                        return False
+                    if self._closed:
+                        raise ChannelClosed("send on closed channel")
+                self._buf.append(value)
+                self._not_empty.notify()
+                return True
+            # rendezvous: offer a cell, wait until a receiver takes it
+            cell = [value, False]
+            self._buf.append(cell)
+            self._not_empty.notify()
+            while not cell[1]:
+                if self._closed:
+                    # Go panics a sender blocked on a closing channel; the
+                    # untaken offer is withdrawn so close-drain never
+                    # delivers it
+                    try:
+                        self._buf.remove(cell)
+                    except ValueError:
+                        pass
+                    raise ChannelClosed("channel closed during send")
+                if not self._rendezvous_done.wait(timeout):
+                    if cell[1]:
+                        return True  # taken in the final race window
+                    self._buf.remove(cell)  # withdraw: False means NOT sent
+                    return False
+            return True
+
+    def recv(self, default=None, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Returns (value, ok); ok=False when closed-and-drained
+        (<- channel_recv_op.cc Status output)."""
+        with self._lock:
+            while not self._buf and not self._closed:
+                if not self._not_empty.wait(timeout):
+                    return default, False
+            if self._buf:
+                if self.capacity > 0:
+                    v = self._buf.popleft()
+                    self._not_full.notify()
+                else:
+                    cell = self._buf.popleft()
+                    cell[1] = True
+                    v = cell[0]
+                    self._rendezvous_done.notify_all()
+                return v, True
+            return default, False  # closed and drained
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            self._rendezvous_done.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def can_recv(self) -> bool:
+        with self._lock:
+            return bool(self._buf) or self._closed
+
+    def can_send(self) -> bool:
+        with self._lock:
+            return (not self._closed and
+                    (self.capacity == 0 or len(self._buf) < self.capacity))
+
+    def __iter__(self):
+        while True:
+            v, ok = self.recv()
+            if not ok:
+                return
+            yield v
+
+
+def make_channel(dtype=None, capacity: int = 0) -> Channel:
+    """<- concurrency.py:279 make_channel."""
+    return Channel(capacity=capacity, dtype=dtype)
+
+
+def channel_send(channel: Channel, value, is_copy: bool = False) -> bool:
+    """<- concurrency.py:335 channel_send (is_copy kept for parity)."""
+    return channel.send(value)
+
+
+def channel_recv(channel: Channel, return_value=None) -> Tuple[Any, bool]:
+    """<- concurrency.py:385 channel_recv: returns (value, ok)."""
+    return channel.recv(default=return_value)
+
+
+def channel_close(channel: Channel) -> None:
+    """<- concurrency.py:429 channel_close."""
+    channel.close()
+
+
+def go(fn: Callable, *args, **kwargs) -> threading.Thread:
+    """Run fn concurrently (<- go_op.cc: executes a sub-block on a new
+    thread). Returns the (daemon) thread."""
+    t = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=True)
+    t.start()
+    return t
+
+
+class Go:
+    """Context-manager flavor of ``go`` for API parity with the reference's
+    ``with fluid.Go():`` block. The body runs *in the calling thread* to
+    collect a callable via ``.call`` — pass the function explicitly::
+
+        with Go() as g:
+            g.call(producer, ch)
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.threads: List[threading.Thread] = []
+
+    def __enter__(self):
+        return self
+
+    def call(self, fn: Callable, *args, **kwargs):
+        self.threads.append(go(fn, *args, **kwargs))
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+    def join(self, timeout: Optional[float] = None):
+        for t in self.threads:
+            t.join(timeout)
+
+
+class Select:
+    """Blocking select over channel operations (<- select_op.cc, Go select).
+
+    ::
+
+        sel = Select()
+        sel.on_recv(ch1, lambda v: ...)
+        sel.on_send(ch2, value, lambda: ...)
+        sel.on_default(lambda: ...)      # optional: makes select non-blocking
+        sel.run()                        # executes exactly one ready case
+    """
+
+    _POLL = 0.005
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self._cases: List[tuple] = []
+        self._default: Optional[Callable] = None
+
+    def on_recv(self, channel: Channel, callback: Callable[[Any], Any]):
+        self._cases.append(("recv", channel, None, callback))
+        return self
+
+    def on_send(self, channel: Channel, value, callback: Optional[Callable] = None):
+        self._cases.append(("send", channel, value, callback))
+        return self
+
+    def on_default(self, callback: Callable):
+        self._default = callback
+        return self
+
+    def run(self, timeout: Optional[float] = None):
+        """Waits until one case fires; returns its callback result."""
+        deadline = None if timeout is None else (
+            threading.TIMEOUT_MAX if timeout < 0 else timeout)
+        waited = 0.0
+        while True:
+            for kind, ch, value, cb in self._cases:
+                if kind == "recv" and ch.can_recv():
+                    v, ok = ch.recv(timeout=self._POLL)
+                    if ok or ch.closed:
+                        return cb(v) if cb else v
+                elif kind == "send" and ch.can_send():
+                    try:
+                        if ch.send(value, timeout=self._POLL):
+                            return cb() if cb else None
+                    except ChannelClosed:
+                        continue
+            if self._default is not None:
+                return self._default()
+            time.sleep(self._POLL)
+            waited += self._POLL
+            if deadline is not None and waited >= deadline:
+                raise TimeoutError("select timed out")

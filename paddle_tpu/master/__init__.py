@@ -1,0 +1,11 @@
+"""Fault-tolerant distributed data plane (<- go/ layer: master service,
+etcd-backed stores, trainer clients).
+
+The Go layer's job — survive trainer/master crashes during long runs by
+making dataset consumption a re-queueable task protocol with durable
+snapshots — is unchanged on TPU; only the compute plane moved into XLA.
+"""
+from .client import Client, master_reader  # noqa: F401
+from .rpc import MasterRPCClient, MasterServer  # noqa: F401
+from .service import MasterService, Task, partition  # noqa: F401
+from .store import FileStore, InMemStore  # noqa: F401

@@ -1,0 +1,119 @@
+"""Trainer-side master client (<- go/master/client.go + the Python binding
+python/paddle/v2/master/client.py:24).
+
+``Client`` drives the task protocol; ``master_reader`` adapts it into a
+reader-creator so a trainer consumes the fault-tolerant task queue exactly
+like any other reader (the v2 trainer did the same via cloud_reader).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from .rpc import MasterRPCClient
+from .service import MasterService, Task
+
+
+class Client:
+    """Works against a local MasterService or a remote endpoint string."""
+
+    def __init__(self, master, poll_interval: float = 0.05):
+        if isinstance(master, str):
+            self._rpc: Optional[MasterRPCClient] = MasterRPCClient(master)
+            self._svc: Optional[MasterService] = None
+        else:
+            self._rpc = None
+            self._svc = master
+        self.poll_interval = poll_interval
+
+    # -- protocol --
+    def set_dataset(self, chunks: Sequence[str], chunks_per_task: int = 1):
+        if self._rpc:
+            self._rpc.call("set_dataset", list(chunks), chunks_per_task)
+        else:
+            self._svc.set_dataset(chunks, chunks_per_task)
+
+    @property
+    def ready(self) -> bool:
+        if self._rpc:
+            return self._rpc.call("ready")
+        return self._svc.ready
+
+    def get_task(self, wait: bool = True) -> Optional[Task]:
+        while True:
+            if not self.ready:
+                # dataset not registered yet: an empty queue is "not started",
+                # not "pass finished" — keep polling
+                if not wait:
+                    return None
+                time.sleep(self.poll_interval)
+                continue
+            if self._rpc:
+                d = self._rpc.call("get_task")
+                t = None if d is None else Task(**d)
+            else:
+                t = self._svc.get_task()
+            if t is not None or not wait:
+                return t
+            if self.pass_finished():
+                return None
+            time.sleep(self.poll_interval)
+
+    def task_finished(self, task_id: int) -> bool:
+        if self._rpc:
+            return self._rpc.call("task_finished", task_id)
+        return self._svc.task_finished(task_id)
+
+    def task_failed(self, task_id: int) -> bool:
+        if self._rpc:
+            return self._rpc.call("task_failed", task_id)
+        return self._svc.task_failed(task_id)
+
+    def pass_finished(self) -> bool:
+        if self._rpc:
+            return self._rpc.call("pass_finished")
+        return self._svc.pass_finished()
+
+    def new_pass(self, epoch: Optional[int] = None) -> int:
+        if self._rpc:
+            return self._rpc.call("new_pass", epoch)
+        return self._svc.new_pass(epoch)
+
+    def close(self):
+        if self._rpc:
+            self._rpc.close()
+
+
+def master_reader(client: Client, chunk_reader: Callable[[str], Iterable],
+                  pass_num: int = 1):
+    """Reader-creator over the master's task queue.
+
+    chunk_reader(chunk) yields the records of one chunk (e.g. a RecordIO
+    scanner over the chunk path). Records of a task only count as consumed
+    when the whole task finished — a crashed trainer's task is re-served to
+    another trainer by the master's timeout (<- go/master timeout semantics).
+    """
+
+    def reader():
+        for p in range(pass_num):
+            epoch = None
+            while True:
+                task = client.get_task(wait=True)
+                if task is None:
+                    break  # pass finished
+                epoch = task.epoch
+                try:
+                    for chunk in task.chunks:
+                        for rec in chunk_reader(chunk):
+                            yield rec
+                except Exception:
+                    client.task_failed(task.id)
+                    raise
+                client.task_finished(task.id)
+            if p + 1 < pass_num and epoch is not None:
+                # a trainer that received zero tasks must not advance the
+                # pass (epoch=None would bypass the idempotency guard and
+                # re-serve an extra pass)
+                client.new_pass(epoch)
+
+    return reader

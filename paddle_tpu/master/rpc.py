@@ -1,0 +1,141 @@
+"""Line-JSON RPC for the master service (<- go/connection/conn.go + net/rpc,
+and the v2 Python binding python/paddle/v2/master/client.py which talked to
+it through cgo).
+
+One request per line: {"method": ..., "params": [...]} -> {"result": ...} |
+{"error": ...}. Deliberately minimal — the master protocol is four calls —
+and dependency-free (socketserver), mirroring how the reference test suite
+spawns a real server locally and drives a client against it
+(test_dist_train.py:27-46 pattern).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Any, Optional, Tuple
+
+from .service import MasterService, Task
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line.decode())
+                method = req["method"]
+                params = req.get("params", [])
+                svc = self.server.service  # type: ignore[attr-defined]
+                if method == "set_dataset":
+                    svc.set_dataset(params[0], params[1])
+                    result = True
+                elif method == "get_task":
+                    t = svc.get_task()
+                    result = None if t is None else t.__dict__
+                elif method == "task_finished":
+                    result = svc.task_finished(params[0])
+                elif method == "task_failed":
+                    result = svc.task_failed(params[0])
+                elif method == "pass_finished":
+                    result = svc.pass_finished()
+                elif method == "new_pass":
+                    result = svc.new_pass(*params)
+                elif method == "ready":
+                    result = svc.ready
+                else:
+                    raise ValueError(f"unknown method {method!r}")
+                resp = {"result": result}
+            except Exception as e:  # report, keep serving
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer(socketserver.ThreadingTCPServer):
+    """TCP front of MasterService. ``with MasterServer(svc) as s: s.endpoint``
+    — serves on a background thread until close()."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, service: Optional[MasterService] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.service = service if service is not None else MasterService()
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server_address[:2]
+        return f"{host}:{port}"
+
+    def close(self):
+        self.shutdown()
+        self.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class MasterRPCClient:
+    """Blocking line-JSON RPC client with reconnect
+    (<- go/master/client.go connection handling)."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr: Tuple[str, int] = (host, int(port))
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        self._sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self._file = self._sock.makefile("rwb")
+
+    # safe to resend after a dropped connection: repeating them cannot
+    # double-assign work (get_task is NOT here — a lost response would leave
+    # a ghost pending task accruing timeout failures)
+    _IDEMPOTENT = frozenset({"set_dataset", "task_finished", "task_failed",
+                             "pass_finished", "new_pass", "ready"})
+
+    def call(self, method: str, *params) -> Any:
+        retryable = method in self._IDEMPOTENT
+        with self._lock:
+            for attempt in (0, 1):  # one transparent reconnect
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._file.write(
+                        (json.dumps({"method": method, "params": list(params)})
+                         + "\n").encode())
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("master closed connection")
+                    resp = json.loads(line.decode())
+                    if "error" in resp:
+                        raise RuntimeError(f"master error: {resp['error']}")
+                    return resp["result"]
+                except (OSError, ConnectionError):
+                    self.close()
+                    if attempt or not retryable:
+                        raise
+        return None
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._file = None

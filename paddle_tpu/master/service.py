@@ -1,0 +1,189 @@
+"""Fault-tolerant dataset master (<- go/master/service.go).
+
+The reference's Go master splits a dataset (RecordIO chunk list) into tasks,
+hands them to trainers over RPC, re-queues tasks whose trainer died
+(per-task timeout, service.go:341 checkTimeoutFunc), discards tasks failing
+more than failureMax times (:313 processFailedTask), and snapshots its queue
+state so a restarted master resumes where it left off (:166-229).
+
+This is exactly the host-side coordination TPU training still needs (the
+compute plane is XLA; the data plane stays a task queue), so the port is
+semantic: same state machine, Python threading + pluggable KV store instead
+of goroutines + etcd. The RPC surface lives in rpc.py; this module is the
+single-process core the reference also tests directly.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_TIMEOUT = 1.0
+DEFAULT_FAILURE_MAX = 3
+
+
+@dataclass
+class Task:
+    """<- go/master/service.go Task/taskEntry."""
+
+    id: int
+    chunks: List[str]
+    epoch: int = 0
+    num_failure: int = 0
+
+
+def partition(chunks: Sequence[str], chunks_per_task: int) -> List[Task]:
+    """<- service.go:106 partition: group chunks into tasks."""
+    chunks_per_task = max(int(chunks_per_task), 1)
+    tasks = []
+    for i in range(0, len(chunks), chunks_per_task):
+        tasks.append(Task(id=len(tasks), chunks=list(chunks[i:i + chunks_per_task])))
+    return tasks
+
+
+class MasterService:
+    """Task-queue state machine (<- go/master/service.go Service)."""
+
+    def __init__(self, store=None, timeout: float = DEFAULT_TIMEOUT,
+                 failure_max: int = DEFAULT_FAILURE_MAX):
+        from .store import InMemStore
+
+        self.store = store if store is not None else InMemStore()
+        self.timeout = timeout
+        self.failure_max = failure_max
+        self._lock = threading.Lock()
+        self.todo: List[Task] = []
+        self.pending: Dict[int, Task] = {}
+        self.done: List[Task] = []
+        self.failed: List[Task] = []
+        self._deadlines: Dict[int, float] = {}
+        self._cur_epoch = 0
+        self._ready = threading.Event()
+        self._recover()
+
+    # -- dataset registration --
+    def set_dataset(self, chunks: Sequence[str], chunks_per_task: int = 1):
+        """<- master RPC SetDataset: idempotent first-writer-wins."""
+        with self._lock:
+            if self._ready.is_set():
+                return  # already initialized (another trainer won the race)
+            self.todo = partition(chunks, chunks_per_task)
+            self._snapshot_locked()
+            # set inside the lock: a concurrent set_dataset must observe
+            # is_set() before it can re-partition
+            self._ready.set()
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    # -- task protocol --
+    def get_task(self) -> Optional[Task]:
+        """<- service.go GetTask: hand out a todo task and arm its timer.
+        Returns None when nothing is available right now — either another
+        trainer's task is still pending (caller retries) or the pass is
+        finished (``pass_finished``; call ``new_pass`` to re-serve)."""
+        with self._lock:
+            self._check_timeouts_locked()
+            if not self.todo:
+                return None
+            t = self.todo.pop(0)
+            t.epoch = self._cur_epoch
+            self.pending[t.id] = t
+            self._deadlines[t.id] = time.monotonic() + self.timeout
+            self._snapshot_locked()
+            return Task(id=t.id, chunks=list(t.chunks), epoch=t.epoch,
+                        num_failure=t.num_failure)
+
+    def task_finished(self, task_id: int) -> bool:
+        """<- service.go TaskFinished."""
+        with self._lock:
+            t = self.pending.pop(task_id, None)
+            if t is None:
+                return False
+            self._deadlines.pop(task_id, None)
+            t.num_failure = 0
+            self.done.append(t)
+            self._snapshot_locked()
+            return True
+
+    def task_failed(self, task_id: int) -> bool:
+        """<- service.go TaskFailed -> processFailedTask (:313)."""
+        with self._lock:
+            t = self.pending.pop(task_id, None)
+            if t is None:
+                return False
+            self._deadlines.pop(task_id, None)
+            self._process_failed_locked(t)
+            self._snapshot_locked()
+            return True
+
+    def pass_finished(self) -> bool:
+        """True when every task of the current pass is done."""
+        with self._lock:
+            self._check_timeouts_locked()
+            return not self.todo and not self.pending
+
+    def new_pass(self, epoch: Optional[int] = None) -> int:
+        """Re-serve the done set as the next pass (<- the Go master's pass
+        cycle, made explicit). Idempotent across trainers: passing the epoch
+        a trainer just finished advances at most once; returns the current
+        epoch."""
+        with self._lock:
+            self._check_timeouts_locked()
+            if (not self.todo and not self.pending and self.done
+                    and (epoch is None or epoch == self._cur_epoch)):
+                self._next_pass_locked()
+                self._snapshot_locked()
+            return self._cur_epoch
+
+    # -- internals (call with lock held) --
+    def _process_failed_locked(self, t: Task):
+        t.num_failure += 1
+        if t.num_failure > self.failure_max:
+            self.failed.append(t)  # discarded (service.go:322)
+        else:
+            self.todo.append(t)  # retry at the back of the queue
+
+    def _check_timeouts_locked(self):
+        """<- service.go:341 checkTimeoutFunc: expire overdue pending tasks."""
+        now = time.monotonic()
+        for tid, deadline in list(self._deadlines.items()):
+            if deadline <= now:
+                t = self.pending.pop(tid)
+                del self._deadlines[tid]
+                self._process_failed_locked(t)
+
+    def _next_pass_locked(self):
+        self._cur_epoch += 1
+        self.todo = self.done
+        self.done = []
+
+    # -- snapshot / recover (<- service.go:166-229 snapshot/recover) --
+    def _snapshot_locked(self):
+        state = {
+            "epoch": self._cur_epoch,
+            "todo": [t.__dict__ for t in self.todo],
+            # pending tasks are re-queued on recovery — their trainers are
+            # assumed dead across a master restart (the Go master does the
+            # same by saving pending into todo)
+            "pending": [t.__dict__ for t in self.pending.values()],
+            "done": [t.__dict__ for t in self.done],
+            "failed": [t.__dict__ for t in self.failed],
+        }
+        self.store.save(json.dumps(state).encode())
+
+    def _recover(self):
+        raw = self.store.load()
+        if not raw:
+            return
+        state = json.loads(raw.decode())
+        mk = lambda d: Task(**d)
+        self._cur_epoch = state["epoch"]
+        self.todo = [mk(d) for d in state["todo"]] + [mk(d) for d in state["pending"]]
+        self.done = [mk(d) for d in state["done"]]
+        self.failed = [mk(d) for d in state["failed"]]
+        if self.todo or self.done:
+            self._ready.set()

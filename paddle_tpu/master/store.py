@@ -1,0 +1,140 @@
+"""KV stores backing master snapshots and worker registration
+(<- go/master/inmem_store.go, go/master/etcd_client.go, go/pserver/
+etcd_client.go).
+
+etcd itself is not available in this environment; the contract the Go layer
+actually uses is tiny — save/load one snapshot blob, register/list live
+workers with TTL, single-writer lock — so the stand-ins implement exactly
+that: InMemStore for tests (the reference's inmem_store.go plays the same
+role) and FileStore for crash-resilient multi-process runs (atomic rename,
+fsync'd, CRC-checked like the Go pserver checkpoint, service.go:346).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+
+class InMemStore:
+    """<- go/master/inmem_store.go: Save/Load/Shutdown under a mutex."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf: Optional[bytes] = None
+        self._kv: Dict[str, tuple] = {}  # key -> (value, expiry)
+
+    def save(self, data: bytes) -> None:
+        with self._lock:
+            self._buf = bytes(data)
+
+    def load(self) -> Optional[bytes]:
+        with self._lock:
+            return self._buf
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None) -> None:
+        with self._lock:
+            self._kv[key] = (value, None if ttl is None else time.time() + ttl)
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            v = self._kv.get(key)
+            if v is None or (v[1] is not None and v[1] < time.time()):
+                return None
+            return v[0]
+
+    def list(self, prefix: str) -> Dict[str, str]:
+        with self._lock:
+            now = time.time()
+            return {k: v for k, (v, exp) in self._kv.items()
+                    if k.startswith(prefix) and (exp is None or exp >= now)}
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class FileStore:
+    """Durable stand-in for etcd: snapshot blob with CRC32 + atomic rename
+    (<- go/pserver/service.go:346 checkpoint write: tmp file, CRC, rename),
+    K/V entries as JSON files with mtime-based TTL."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._snap = os.path.join(root, "snapshot.bin")
+        self._kv_dir = os.path.join(root, "kv")
+        os.makedirs(self._kv_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def save(self, data: bytes) -> None:
+        with self._lock:
+            tmp = self._snap + ".tmp"
+            crc = zlib.crc32(data) & 0xFFFFFFFF
+            with open(tmp, "wb") as f:
+                f.write(crc.to_bytes(4, "little"))
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snap)  # atomic on POSIX
+
+    def load(self) -> Optional[bytes]:
+        with self._lock:
+            if not os.path.exists(self._snap):
+                return None
+            with open(self._snap, "rb") as f:
+                raw = f.read()
+            if len(raw) < 4:
+                return None
+            crc, data = int.from_bytes(raw[:4], "little"), raw[4:]
+            if zlib.crc32(data) & 0xFFFFFFFF != crc:
+                raise IOError(f"snapshot {self._snap} failed CRC check")
+            return data
+
+    def _kv_path(self, key: str) -> str:
+        return os.path.join(self._kv_dir, key.replace("/", "%2F") + ".json")
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None) -> None:
+        p = self._kv_path(key)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"value": value,
+                       "expiry": None if ttl is None else time.time() + ttl}, f)
+        os.replace(tmp, p)
+
+    def get(self, key: str) -> Optional[str]:
+        p = self._kv_path(key)
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            d = json.load(f)
+        if d["expiry"] is not None and d["expiry"] < time.time():
+            return None
+        return d["value"]
+
+    def list(self, prefix: str) -> Dict[str, str]:
+        out = {}
+        for fn in os.listdir(self._kv_dir):
+            if not fn.endswith(".json"):
+                continue
+            key = fn[:-5].replace("%2F", "/")
+            if key.startswith(prefix):
+                v = self.get(key)
+                if v is not None:
+                    out[key] = v
+        return out
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._kv_path(key))
+        except FileNotFoundError:
+            pass
+
+    def shutdown(self) -> None:
+        pass

@@ -10,6 +10,8 @@ the aggregate table that EnableProfiler/DisableProfiler printed.
 from __future__ import annotations
 
 import contextlib
+import json
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -17,6 +19,8 @@ from typing import Dict, List, Optional
 import jax
 
 _events: Dict[str, List[float]] = defaultdict(list)
+# timestamped records for the timeline tool: (name, start_s, dur_s, tid)
+_records: List[tuple] = []
 _enabled = False
 _trace_dir: Optional[str] = None
 
@@ -39,7 +43,10 @@ class RecordEvent:
     def __exit__(self, *exc):
         self._scope.__exit__(*exc)
         if _enabled:
-            _events[self.name].append(time.perf_counter() - self._t0)
+            dur = time.perf_counter() - self._t0
+            _events[self.name].append(dur)
+            _records.append((self.name, self._t0, dur,
+                             threading.get_ident() & 0xFFFF))
         return False
 
 
@@ -71,6 +78,18 @@ def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None)
 def reset_profiler():
     """<- profiler.py reset_profiler."""
     _events.clear()
+    _records.clear()
+
+
+def dump_profile(path: str):
+    """Write the raw timestamped host-event records as JSON — the input of
+    tools/timeline.py (the analogue of the reference's profiler.proto file
+    consumed by its timeline tool)."""
+    with open(path, "w") as f:
+        json.dump({"events": [
+            {"name": n, "start": t0, "dur": dur, "tid": tid}
+            for (n, t0, dur, tid) in _records
+        ]}, f)
 
 
 def summary(sorted_key: str = "total") -> str:

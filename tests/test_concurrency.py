@@ -1,0 +1,119 @@
+"""CSP channels/go/select (<- framework/channel_test.cc,
+concurrency_test.cc, tests/no_test_concurrency.py scenarios)."""
+import threading
+import time
+
+import paddle_tpu as fluid
+from paddle_tpu.concurrency import Channel, ChannelClosed, Select, go
+
+
+def test_buffered_channel_fifo_and_close_drain():
+    ch = fluid.make_channel(capacity=3)
+    for i in range(3):
+        assert fluid.channel_send(ch, i)
+    fluid.channel_close(ch)
+    got = [fluid.channel_recv(ch)[0] for _ in range(3)]
+    assert got == [0, 1, 2]
+    v, ok = fluid.channel_recv(ch, return_value=-1)
+    assert not ok and v == -1
+
+
+def test_send_on_closed_raises():
+    ch = fluid.make_channel(capacity=1)
+    fluid.channel_close(ch)
+    try:
+        fluid.channel_send(ch, 1)
+        assert False, "expected ChannelClosed"
+    except ChannelClosed:
+        pass
+
+
+def test_unbuffered_rendezvous():
+    """capacity=0: send blocks until a receiver takes the value
+    (<- channel.h UnBuffered)."""
+    ch = fluid.make_channel(capacity=0)
+    order = []
+
+    def sender():
+        order.append("send-start")
+        ch.send("x")
+        order.append("send-done")
+
+    t = go(sender)
+    time.sleep(0.1)
+    assert "send-done" not in order  # blocked on rendezvous
+    v, ok = ch.recv()
+    t.join(2)
+    assert ok and v == "x"
+    assert order == ["send-start", "send-done"]
+
+
+def test_producer_consumer_pipeline():
+    """Fibonacci-style producer/consumer over channels
+    (<- concurrency_test.cc)."""
+    ch = fluid.make_channel(capacity=2)
+    quit_ch = fluid.make_channel(capacity=0)
+    result = []
+
+    def producer():
+        a, b = 0, 1
+        while True:
+            sel = Select()
+            done = {}
+            sel.on_send(ch, a, lambda: done.setdefault("sent", True))
+            sel.on_recv(quit_ch, lambda v: done.setdefault("quit", True))
+            sel.run()
+            if "quit" in done:
+                return
+            a, b = b, a + b
+
+    t = go(producer)
+    for _ in range(10):
+        v, ok = ch.recv()
+        assert ok
+        result.append(v)
+    quit_ch.send(None)
+    t.join(2)
+    assert result == [0, 1, 1, 2, 3, 5, 8, 13, 21, 34]
+
+
+def test_select_default_nonblocking():
+    ch = fluid.make_channel(capacity=1)
+    sel = Select().on_recv(ch, lambda v: ("got", v)).on_default(lambda: "empty")
+    assert sel.run() == "empty"
+    ch.send(7)
+    assert sel.run() == ("got", 7)
+
+
+def test_go_context_manager():
+    ch = fluid.make_channel(capacity=10)
+    with fluid.Go() as g:
+        g.call(lambda: [ch.send(i) for i in range(5)])
+    g.join(2)
+    assert [ch.recv()[0] for _ in range(5)] == [0, 1, 2, 3, 4]
+
+
+def test_rendezvous_send_timeout_withdraws_offer():
+    """A send that reports False must never be delivered later."""
+    ch = fluid.make_channel(capacity=0)
+    assert ch.send("ghost", timeout=0.05) is False
+    v, ok = ch.recv(timeout=0.05)
+    assert not ok and v is None  # the withdrawn offer is gone
+
+
+def test_close_during_blocked_rendezvous_send_raises():
+    ch = fluid.make_channel(capacity=0)
+    errs = []
+
+    def sender():
+        try:
+            ch.send("x")
+        except ChannelClosed:
+            errs.append("closed")
+
+    t = go(sender)
+    time.sleep(0.05)
+    ch.close()
+    t.join(2)
+    assert errs == ["closed"]
+    assert ch.recv()[1] is False  # withdrawn, not delivered

@@ -1,0 +1,50 @@
+"""tools/: timeline conversion and API-signature dump
+(<- tools/timeline.py, tools/print_signatures.py)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profiler_dump_and_timeline(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+    profiler.stop_profiler(profile_path=str(tmp_path / "table.txt"))
+    prof = tmp_path / "prof.json"
+    profiler.dump_profile(str(prof))
+    data = json.loads(prof.read_text())
+    names = [e["name"] for e in data["events"]]
+    assert "outer" in names and "inner" in names
+
+    out = tmp_path / "timeline.json"
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "timeline.py"),
+         "--profile_path", str(prof), "--timeline_path", str(out)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    trace = json.loads(out.read_text())
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in evs} >= {"outer", "inner"}
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in evs)
+
+
+def test_print_signatures(tmp_path):
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "print_signatures.py"),
+         "paddle_tpu"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) > 200  # the API surface is large
+    assert any(l.startswith("paddle_tpu.layers.nn.conv2d ") for l in lines)
+    assert "api digest:" in r.stderr

@@ -1,0 +1,71 @@
+"""Dump all public API signatures for stability diffing
+(<- tools/print_signatures.py: prints every public callable's argspec,
+md5-able so CI catches accidental API breaks).
+
+Usage::
+
+    python tools/print_signatures.py paddle_tpu > api.spec
+"""
+from __future__ import annotations
+
+import hashlib
+import importlib
+import inspect
+import os
+import pkgutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _signature(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def iter_api(module_name: str):
+    mod = importlib.import_module(module_name)
+    seen = set()
+    mods = [(module_name, mod)]
+    if hasattr(mod, "__path__"):
+        for info in pkgutil.walk_packages(mod.__path__, prefix=module_name + "."):
+            try:
+                mods.append((info.name, importlib.import_module(info.name)))
+            except Exception:
+                continue
+    for name, m in sorted(mods):
+        for attr in sorted(dir(m)):
+            if attr.startswith("_"):
+                continue
+            obj = getattr(m, attr)
+            if not (inspect.isfunction(obj) or inspect.isclass(obj)):
+                continue
+            if getattr(obj, "__module__", "").split(".")[0] != module_name.split(".")[0]:
+                continue  # re-exported third-party symbol
+            key = f"{name}.{attr}"
+            if key in seen:
+                continue
+            seen.add(key)
+            if inspect.isclass(obj):
+                yield key, f"class{_signature(obj)}"
+                for mname, meth in sorted(vars(obj).items()):
+                    if mname.startswith("_") or not inspect.isfunction(meth):
+                        continue
+                    yield f"{key}.{mname}", _signature(meth)
+            else:
+                yield key, _signature(obj)
+
+
+def main():
+    module_name = sys.argv[1] if len(sys.argv) > 1 else "paddle_tpu"
+    lines = [f"{k} {sig}" for k, sig in iter_api(module_name)]
+    for line in lines:
+        print(line)
+    digest = hashlib.md5("\n".join(lines).encode()).hexdigest()
+    print(f"# api digest: {digest}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Convert a dumped profile to Chrome-trace JSON (<- tools/timeline.py:114,
+which converts profiler.proto to chrome://tracing format).
+
+Input: the JSON written by ``paddle_tpu.profiler.dump_profile`` (host
+events). Device-side traces are produced directly by jax.profiler in
+TensorBoard/perfetto format — this tool covers the host-event timeline the
+reference's CPU events occupied.
+
+Usage::
+
+    python tools/timeline.py --profile_path prof.json --timeline_path out.json
+    # open chrome://tracing (or ui.perfetto.dev) and load out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+class _ChromeTraceFormatter:
+    """<- tools/timeline.py _ChromeTraceFormatter: same event schema."""
+
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def emit_pid(self, name, pid):
+        self._metadata.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+
+    def emit_region(self, timestamp_us, duration_us, pid, tid, category, name,
+                    args=None):
+        self._events.append({
+            "ph": "X", "cat": category, "name": name, "pid": pid, "tid": tid,
+            "ts": timestamp_us, "dur": duration_us, "args": args or {},
+        })
+
+    def format_to_string(self, pretty=False):
+        trace = {"traceEvents": self._metadata + self._events}
+        return json.dumps(trace, indent=4 if pretty else None,
+                          separators=None if pretty else (",", ":"))
+
+
+def to_chrome_trace(profile: dict, pretty=False) -> str:
+    f = _ChromeTraceFormatter()
+    f.emit_pid("host", 0)
+    events = profile.get("events", [])
+    t0 = min((e["start"] for e in events), default=0.0)
+    for e in events:
+        f.emit_region(
+            timestamp_us=(e["start"] - t0) * 1e6,
+            duration_us=e["dur"] * 1e6,
+            pid=0,
+            tid=e.get("tid", 0),
+            category="host",
+            name=e["name"],
+        )
+    return f.format_to_string(pretty)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--profile_path", type=str, required=True,
+                        help="profile JSON from paddle_tpu.profiler.dump_profile")
+    parser.add_argument("--timeline_path", type=str, required=True,
+                        help="output Chrome-trace JSON")
+    args = parser.parse_args()
+    with open(args.profile_path) as f:
+        profile = json.load(f)
+    with open(args.timeline_path, "w") as f:
+        f.write(to_chrome_trace(profile, pretty=True))
+    print("timeline written to", args.timeline_path)
+
+
+if __name__ == "__main__":
+    main()
