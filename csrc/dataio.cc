@@ -1,0 +1,346 @@
+// Native data pipeline: buddy-allocated batch buffers + multi-file
+// shuffle/batch/prefetch readers over RecordIO files.
+//
+// Re-implements, TPU-host-side, the reference's native data plane:
+//   * memory/detail/buddy_allocator.h:33 (BuddyAllocator over a SystemAllocator
+//     arena) -> `pt_buddy_*`: power-of-two buddy system backing the batch
+//     staging buffers handed to the feeder (the role pinned host memory
+//     played for GPU transfers).
+//   * operators/reader/create_shuffle_reader_op.cc (buffered shuffle),
+//     create_batch_reader_op.cc (batch assembly),
+//     create_double_buffer_reader_op.cc:39 + blocking_queue.h (prefetch
+//     thread + bounded queue), open_files/multi-file reading ->
+//     `dio_pipeline_*`: worker threads scan RecordIO shards, shuffle within a
+//     reservoir, pack fixed-size records into contiguous batch buffers.
+//
+// Records must be fixed-size (record_bytes) — the dense-tensor case the
+// batcher packs without copies on the Python side; the variable-size case
+// stays on the per-record rio_* API.
+#include "recordio.cc"  // reuse crc/scanner + the extern "C" record API
+
+#include <algorithm>
+#include <atomic>
+#include <random>
+
+namespace {
+
+// --- buddy allocator (<- memory/detail/buddy_allocator.h) ------------------
+struct Buddy {
+  std::vector<uint8_t> arena;
+  size_t min_log2;
+  size_t levels;                          // arena_log2 - min_log2 + 1
+  std::vector<std::vector<size_t>> free_; // per-level free block offsets
+  // offset -> level, for frees and double-free detection
+  std::vector<int8_t> level_of;           // indexed by offset >> min_log2
+  std::mutex mu;
+  size_t used = 0;
+
+  static size_t log2ceil(size_t v) {
+    size_t l = 0;
+    while ((size_t(1) << l) < v) l++;
+    return l;
+  }
+
+  Buddy(size_t total, size_t min_block) {
+    size_t total_log2 = log2ceil(total);
+    min_log2 = log2ceil(min_block < 16 ? 16 : min_block);
+    if (total_log2 < min_log2) total_log2 = min_log2;
+    arena.resize(size_t(1) << total_log2);
+    levels = total_log2 - min_log2 + 1;
+    free_.resize(levels);
+    free_[levels - 1].push_back(0);  // one max-size block
+    level_of.assign(size_t(1) << (total_log2 - min_log2), -1);
+  }
+
+  void* alloc(size_t n) {
+    if (n == 0) n = 1;
+    size_t want = log2ceil(n);
+    if (want < min_log2) want = min_log2;
+    size_t lvl = want - min_log2;
+    if (lvl >= levels) return nullptr;
+    std::lock_guard<std::mutex> g(mu);
+    size_t l = lvl;
+    while (l < levels && free_[l].empty()) l++;
+    if (l == levels) return nullptr;  // out of memory
+    size_t off = free_[l].back();
+    free_[l].pop_back();
+    while (l > lvl) {  // split down, freeing the upper buddy
+      l--;
+      size_t buddy_off = off + (size_t(1) << (l + min_log2));
+      free_[l].push_back(buddy_off);
+    }
+    level_of[off >> min_log2] = static_cast<int8_t>(lvl);
+    used += size_t(1) << (lvl + min_log2);
+    return arena.data() + off;
+  }
+
+  int free_block(void* p) {
+    std::lock_guard<std::mutex> g(mu);
+    size_t off = static_cast<uint8_t*>(p) - arena.data();
+    size_t idx = off >> min_log2;
+    if (idx >= level_of.size() || level_of[idx] < 0) return -1;  // bad/double free
+    size_t lvl = level_of[idx];
+    level_of[idx] = -1;
+    used -= size_t(1) << (lvl + min_log2);
+    // coalesce with free buddies upward (<- buddy_allocator merge)
+    while (lvl + 1 < levels) {
+      size_t size = size_t(1) << (lvl + min_log2);
+      size_t buddy = off ^ size;
+      auto& fl = free_[lvl];
+      auto it = std::find(fl.begin(), fl.end(), buddy);
+      if (it == fl.end()) break;
+      fl.erase(it);
+      off = std::min(off, buddy);
+      lvl++;
+    }
+    free_[lvl].push_back(off);
+    return 0;
+  }
+};
+
+// --- shuffle/batch/prefetch pipeline ---------------------------------------
+struct Pipeline {
+  std::vector<std::string> files;
+  uint32_t record_bytes;
+  uint32_t batch_size;
+  uint32_t shuffle_buf;  // 0 = no shuffle
+  bool drop_last;
+  Buddy* buddy;          // owns batch buffers
+  bool own_buddy;
+
+  std::deque<uint8_t*> ready;  // filled batch buffers
+  size_t capacity = 8;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  bool done = false;
+  // read lock-free by the worker's scan loop; also part of cv predicates
+  std::atomic<bool> closed{false};
+  std::string error;
+  std::thread worker;
+  uint8_t* current = nullptr;    // buffer owned by the consumer
+  uint8_t* tail_buf = nullptr;   // the one zero-padded short batch, if any
+  uint32_t tail_count = 0;       // its true record count
+
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      closed = true;
+    }
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    if (worker.joinable()) worker.join();
+    if (current) buddy->free_block(current);
+    for (auto* b : ready) buddy->free_block(b);
+    if (own_buddy) delete buddy;
+  }
+
+  void emit(uint8_t* buf) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_push.wait(lk, [this] { return closed || ready.size() < capacity; });
+    if (closed) {
+      buddy->free_block(buf);
+      return;
+    }
+    ready.push_back(buf);
+    cv_pop.notify_one();
+  }
+
+  void run(uint64_t seed) {
+    std::mt19937_64 rng(seed);
+    std::vector<std::vector<uint8_t>> reservoir;  // shuffle buffer
+    uint8_t* batch = nullptr;
+    uint32_t in_batch = 0;
+
+    auto push_record = [&](const uint8_t* rec) {
+      if (!batch) {
+        batch = static_cast<uint8_t*>(
+            buddy->alloc(size_t(batch_size) * record_bytes));
+        if (!batch) {
+          std::lock_guard<std::mutex> g(mu);
+          error = "buddy arena exhausted";
+          closed = true;
+          return false;
+        }
+        in_batch = 0;
+      }
+      memcpy(batch + size_t(in_batch) * record_bytes, rec, record_bytes);
+      if (++in_batch == batch_size) {
+        emit(batch);
+        batch = nullptr;
+      }
+      return true;
+    };
+
+    auto feed = [&](const uint8_t* rec) {
+      if (shuffle_buf == 0) return push_record(rec);
+      if (reservoir.size() < shuffle_buf) {
+        reservoir.emplace_back(rec, rec + record_bytes);
+        return true;
+      }
+      // swap a random resident out (create_shuffle_reader buffered shuffle)
+      size_t j = rng() % reservoir.size();
+      std::vector<uint8_t> out = std::move(reservoir[j]);
+      reservoir[j].assign(rec, rec + record_bytes);
+      return push_record(out.data());
+    };
+
+    std::vector<size_t> order(files.size());
+    for (size_t i = 0; i < order.size(); i++) order[i] = i;
+    if (shuffle_buf) std::shuffle(order.begin(), order.end(), rng);
+
+    for (size_t fi : order) {
+      if (closed) break;
+      void* sc = rio_scanner_open(files[fi].c_str());
+      if (!sc) {
+        std::lock_guard<std::mutex> g(mu);
+        error = "cannot open " + files[fi];
+        break;
+      }
+      uint32_t len;
+      const uint8_t* rec;
+      while (!closed && (rec = rio_next(sc, &len)) != nullptr) {
+        if (len != record_bytes) {
+          std::lock_guard<std::mutex> g(mu);
+          error = "record size mismatch in " + files[fi];
+          rio_scanner_close(sc);
+          goto finish;
+        }
+        if (!feed(rec)) break;
+      }
+      // nullptr from rio_next is EOF only when the scanner reports no
+      // error; a CRC/truncation failure must not silently truncate data
+      const char* scan_err = rio_scanner_error(sc);
+      if (scan_err && *scan_err) {
+        std::lock_guard<std::mutex> g(mu);
+        error = std::string(scan_err) + " in " + files[fi];
+        rio_scanner_close(sc);
+        goto finish;
+      }
+      rio_scanner_close(sc);
+    }
+    // drain the reservoir in random order
+    if (shuffle_buf) {
+      std::shuffle(reservoir.begin(), reservoir.end(), rng);
+      for (auto& r : reservoir) {
+        if (closed) break;
+        if (!push_record(r.data())) break;
+      }
+    }
+    if (batch && !closed) {
+      if (drop_last || in_batch == 0) {
+        buddy->free_block(batch);
+      } else {
+        // zero-pad the tail so the buffer is fully defined; tag the buffer
+        // itself with its true count BEFORE emitting so the consumer can
+        // never observe it untagged (timing-independent, unlike inferring
+        // from done/queue-empty)
+        memset(batch + size_t(in_batch) * record_bytes, 0,
+               size_t(batch_size - in_batch) * record_bytes);
+        {
+          std::lock_guard<std::mutex> g(mu);
+          tail_buf = batch;
+          tail_count = in_batch;
+        }
+        emit(batch);
+      }
+      batch = nullptr;
+    }
+  finish : {
+    std::lock_guard<std::mutex> g(mu);
+    done = true;
+    cv_pop.notify_all();
+  }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- buddy allocator ----
+void* pt_buddy_create(uint64_t total_bytes, uint64_t min_block) {
+  return new Buddy(total_bytes, min_block);
+}
+void* pt_buddy_alloc(void* h, uint64_t n) { return static_cast<Buddy*>(h)->alloc(n); }
+int pt_buddy_free(void* h, void* p) { return static_cast<Buddy*>(h)->free_block(p); }
+uint64_t pt_buddy_used(void* h) {
+  auto* b = static_cast<Buddy*>(h);
+  std::lock_guard<std::mutex> g(b->mu);
+  return b->used;
+}
+uint64_t pt_buddy_capacity(void* h) { return static_cast<Buddy*>(h)->arena.size(); }
+void pt_buddy_destroy(void* h) { delete static_cast<Buddy*>(h); }
+
+// ---- pipeline ----
+// paths: '\n'-separated file list. Returns nullptr on immediate failure.
+void* dio_pipeline_open(const char* paths, uint32_t record_bytes,
+                        uint32_t batch_size, uint32_t shuffle_buf,
+                        uint64_t seed, uint32_t capacity, int drop_last,
+                        uint64_t arena_bytes) {
+  auto* p = new Pipeline();
+  const char* s = paths;
+  while (*s) {
+    const char* e = strchr(s, '\n');
+    if (!e) e = s + strlen(s);
+    if (e > s) p->files.emplace_back(s, e - s);
+    s = *e ? e + 1 : e;
+  }
+  if (p->files.empty() || record_bytes == 0 || batch_size == 0) {
+    delete p;
+    return nullptr;
+  }
+  p->record_bytes = record_bytes;
+  p->batch_size = batch_size;
+  p->shuffle_buf = shuffle_buf;
+  p->drop_last = drop_last != 0;
+  if (capacity) p->capacity = capacity;
+  // buddy blocks are power-of-two: size the arena in rounded-up blocks so
+  // capacity+2 batches always fit
+  size_t block = size_t(1) << Buddy::log2ceil(size_t(batch_size) * record_bytes);
+  size_t need = block * (p->capacity + 2);
+  if (arena_bytes < need) arena_bytes = need;
+  p->buddy = new Buddy(arena_bytes, 256);
+  p->own_buddy = true;
+  p->worker = std::thread([p, seed] { p->run(seed); });
+  return p;
+}
+
+// Blocking: returns the next batch buffer (batch_size*record_bytes bytes,
+// valid until the following call) or nullptr at end/error. *count receives
+// the number of real records in the batch (== batch_size except a padded
+// final batch).
+const uint8_t* dio_pipeline_next(void* h, uint32_t* count) {
+  auto* p = static_cast<Pipeline*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->current) {
+    auto* c = p->current;
+    p->current = nullptr;
+    lk.unlock();
+    p->buddy->free_block(c);
+    lk.lock();
+  }
+  p->cv_pop.wait(lk, [p] { return p->done || !p->ready.empty(); });
+  if (p->ready.empty()) return nullptr;
+  p->current = p->ready.front();
+  p->ready.pop_front();
+  p->cv_push.notify_one();
+  // the padded tail batch is tagged by pointer; every other batch is full
+  *count = (p->current == p->tail_buf) ? p->tail_count : p->batch_size;
+  return p->current;
+}
+
+const char* dio_pipeline_error(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  return p->error.c_str();
+}
+
+uint64_t dio_pipeline_mem_used(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  std::lock_guard<std::mutex> g(p->buddy->mu);
+  return p->buddy->used;
+}
+
+void dio_pipeline_close(void* h) { delete static_cast<Pipeline*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,488 @@
+// Native inference-model loader: parse a saved model directory
+// (`__model__` JSON program + .npy parameter files) from C++.
+//
+// <- paddle/fluid/inference/io.{h,cc} (Load/LoadPersistables: read the
+// serialized program + its persistable tensors so a C++ deployment can run
+// without Python) and paddle/fluid/framework/{program_desc,op_desc}.h (IR
+// deserialization). The execution engine here is XLA rather than the
+// reference's C++ op kernels, so this library owns the deployment-side
+// *loading* contract: program structure (blocks/ops/vars, feed/fetch
+// targets) and parameter tensors, validated and exposed through a C API
+// (consumed by tests via ctypes and by the `demo_loader` main below, the
+// analogue of inference/tests/book/ loaders).
+//
+// Self-contained: minimal JSON parser + .npy (v1/v2) reader, no deps.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+// --- minimal JSON ----------------------------------------------------------
+struct JValue;
+using JPtr = std::shared_ptr<JValue>;
+
+struct JValue {
+  enum Kind { Null, Bool, Num, Str, Arr, Obj } kind = Null;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JPtr> arr;
+  std::map<std::string, JPtr> obj;
+
+  const JValue* get(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : it->second.get();
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  std::string error;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+
+  bool fail(const char* msg) {
+    if (error.empty()) error = msg;
+    return false;
+  }
+
+  bool parse_string(std::string* out) {
+    if (p >= end || *p != '"') return fail("expected string");
+    p++;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        p++;
+        if (p >= end) return fail("bad escape");
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u': {  // keep raw \uXXXX (names are ASCII in practice)
+            if (end - p < 5) return fail("bad \\u escape");
+            out->append("\\u").append(p + 1, 4);
+            p += 4;
+            break;
+          }
+          default: out->push_back(*p);
+        }
+        p++;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return fail("unterminated string");
+    p++;  // closing quote
+    return true;
+  }
+
+  JPtr parse() {
+    ws();
+    auto v = std::make_shared<JValue>();
+    if (p >= end) {
+      fail("unexpected end");
+      return nullptr;
+    }
+    if (*p == '{') {
+      v->kind = JValue::Obj;
+      p++;
+      ws();
+      if (p < end && *p == '}') {
+        p++;
+        return v;
+      }
+      while (true) {
+        ws();
+        std::string key;
+        if (!parse_string(&key)) return nullptr;
+        ws();
+        if (p >= end || *p != ':') {
+          fail("expected ':'");
+          return nullptr;
+        }
+        p++;
+        JPtr child = parse();
+        if (!child) return nullptr;
+        v->obj[key] = child;
+        ws();
+        if (p < end && *p == ',') {
+          p++;
+          continue;
+        }
+        if (p < end && *p == '}') {
+          p++;
+          return v;
+        }
+        fail("expected ',' or '}'");
+        return nullptr;
+      }
+    }
+    if (*p == '[') {
+      v->kind = JValue::Arr;
+      p++;
+      ws();
+      if (p < end && *p == ']') {
+        p++;
+        return v;
+      }
+      while (true) {
+        JPtr child = parse();
+        if (!child) return nullptr;
+        v->arr.push_back(child);
+        ws();
+        if (p < end && *p == ',') {
+          p++;
+          continue;
+        }
+        if (p < end && *p == ']') {
+          p++;
+          return v;
+        }
+        fail("expected ',' or ']'");
+        return nullptr;
+      }
+    }
+    if (*p == '"') {
+      v->kind = JValue::Str;
+      if (!parse_string(&v->str)) return nullptr;
+      return v;
+    }
+    if (!strncmp(p, "true", 4)) {
+      v->kind = JValue::Bool;
+      v->b = true;
+      p += 4;
+      return v;
+    }
+    if (!strncmp(p, "false", 5)) {
+      v->kind = JValue::Bool;
+      p += 5;
+      return v;
+    }
+    if (!strncmp(p, "null", 4)) {
+      p += 4;
+      return v;
+    }
+    char* num_end = nullptr;
+    v->num = strtod(p, &num_end);
+    if (num_end == p) {
+      fail("bad token");
+      return nullptr;
+    }
+    v->kind = JValue::Num;
+    p = num_end;
+    return v;
+  }
+};
+
+// --- .npy reader (format spec v1.0/2.0, C-order only) ----------------------
+struct Npy {
+  std::string dtype;          // numpy descr, e.g. "<f4"
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;
+};
+
+bool load_npy(const std::string& path, Npy* out, std::string* err) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  uint8_t magic[8];
+  if (fread(magic, 1, 8, f) != 8 || memcmp(magic, "\x93NUMPY", 6) != 0) {
+    *err = "bad npy magic in " + path;
+    fclose(f);
+    return false;
+  }
+  uint32_t hlen = 0;
+  if (magic[6] == 1) {
+    uint16_t h16;
+    if (fread(&h16, 2, 1, f) != 1) { fclose(f); *err = "bad npy header"; return false; }
+    hlen = h16;
+  } else {
+    if (fread(&hlen, 4, 1, f) != 1) { fclose(f); *err = "bad npy header"; return false; }
+  }
+  std::string header(hlen, '\0');
+  if (fread(header.data(), 1, hlen, f) != hlen) {
+    *err = "truncated npy header";
+    fclose(f);
+    return false;
+  }
+  // parse the Python-dict header textually
+  auto find_val = [&](const char* key) -> std::string {
+    size_t k = header.find(key);
+    if (k == std::string::npos) return "";
+    size_t c = header.find(':', k);
+    size_t e = c + 1;
+    while (e < header.size() && header[e] == ' ') e++;
+    if (header[e] == '\'') {
+      size_t q = header.find('\'', e + 1);
+      return header.substr(e + 1, q - e - 1);
+    }
+    if (header[e] == '(') {
+      size_t q = header.find(')', e);
+      return header.substr(e, q - e + 1);
+    }
+    size_t q = header.find_first_of(",}", e);
+    return header.substr(e, q - e);
+  };
+  out->dtype = find_val("'descr'");
+  if (find_val("'fortran_order'").find("True") != std::string::npos) {
+    *err = "fortran-order npy unsupported";
+    fclose(f);
+    return false;
+  }
+  std::string shp = find_val("'shape'");
+  out->shape.clear();
+  for (size_t i = 0; i < shp.size();) {
+    if (isdigit(shp[i])) {
+      char* e2;
+      out->shape.push_back(strtoll(shp.c_str() + i, &e2, 10));
+      i = e2 - shp.c_str();
+    } else {
+      i++;
+    }
+  }
+  long pos = ftell(f);
+  fseek(f, 0, SEEK_END);
+  long fend = ftell(f);
+  fseek(f, pos, SEEK_SET);
+  out->data.resize(fend - pos);
+  if (fread(out->data.data(), 1, out->data.size(), f) != out->data.size()) {
+    *err = "truncated npy data";
+    fclose(f);
+    return false;
+  }
+  fclose(f);
+  return true;
+}
+
+// --- url-unquote (io.py quotes var names for filesystem safety) ------------
+std::string url_quote(const std::string& s) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '_' || c == '.' || c == '-' || c == '~') {
+      out.push_back(c);
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 15]);
+    }
+  }
+  return out;
+}
+
+struct Model {
+  JPtr meta;
+  std::vector<std::string> feeds, fetches;
+  struct Param {
+    std::string name;
+    Npy tensor;
+  };
+  std::vector<Param> params;
+  size_t num_ops = 0, num_vars = 0, num_blocks = 0;
+  std::string error;
+  std::string scratch;  // returned c_str storage
+};
+
+bool load_model(const std::string& dir, Model* m) {
+  std::string path = dir + "/__model__";
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) {
+    m->error = "cannot open " + path;
+    return false;
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string text(n, '\0');
+  if (fread(text.data(), 1, n, f) != static_cast<size_t>(n)) {
+    m->error = "cannot read " + path;
+    fclose(f);
+    return false;
+  }
+  fclose(f);
+  JParser jp{text.data(), text.data() + text.size()};
+  m->meta = jp.parse();
+  if (!m->meta) {
+    m->error = "JSON parse error: " + jp.error;
+    return false;
+  }
+  const JValue* prog = m->meta->get("program");
+  const JValue* feeds = m->meta->get("feed_names");
+  const JValue* fetches = m->meta->get("fetch_names");
+  if (!prog || !feeds || !fetches) {
+    m->error = "__model__ missing program/feed_names/fetch_names";
+    return false;
+  }
+  for (auto& v : feeds->arr) m->feeds.push_back(v->str);
+  for (auto& v : fetches->arr) m->fetches.push_back(v->str);
+
+  // structural validation + persistable discovery (<- inference/io.cc Load:
+  // walk the program, load every persistable var)
+  const JValue* blocks = prog->get("blocks");
+  if (!blocks || blocks->arr.empty()) {
+    m->error = "program has no blocks";
+    return false;
+  }
+  m->num_blocks = blocks->arr.size();
+  // the exporter persists persistables *referenced as op inputs*
+  // (io.py save_inference_model); mirror that filter so vars left in the
+  // pruned program's var table but unused by its ops are not demanded
+  std::vector<std::string> persistables;
+  std::map<std::string, bool> referenced;
+  for (auto& blk : blocks->arr) {
+    const JValue* ops = blk->get("ops");
+    const JValue* vars = blk->get("vars");
+    if (ops) {
+      m->num_ops += ops->arr.size();
+      for (auto& op : ops->arr) {
+        const JValue* ins = op->get("inputs");
+        if (!ins) continue;
+        for (auto& slot : ins->obj)
+          for (auto& nm : slot.second->arr) referenced[nm->str] = true;
+      }
+    }
+    if (!vars) continue;
+    m->num_vars += vars->arr.size();
+    for (auto& var : vars->arr) {
+      const JValue* p = var->get("persistable");
+      const JValue* name = var->get("name");
+      if (p && p->kind == JValue::Bool && p->b && name &&
+          referenced.count(name->str))
+        persistables.push_back(name->str);
+    }
+  }
+  for (auto& name : persistables) {
+    Model::Param param;
+    param.name = name;
+    std::string err;
+    std::string fpath = dir + "/" + url_quote(name) + ".npy";
+    if (!load_npy(fpath, &param.tensor, &err)) {
+      // every persistable the exported program references must be on disk
+      // (feed vars are not persistable); a missing/corrupt weight is a
+      // broken model, not an optional extra
+      m->error = "parameter '" + name + "': " + err;
+      return false;
+    }
+    m->params.push_back(std::move(param));
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptinf_load(const char* dirname) {
+  auto* m = new Model();
+  if (!load_model(dirname, m)) {
+    // keep handle alive so the caller can read the error, flag via kind
+    m->num_blocks = 0;
+  }
+  return m;
+}
+
+const char* ptinf_error(void* h) { return static_cast<Model*>(h)->error.c_str(); }
+int ptinf_ok(void* h) { return static_cast<Model*>(h)->error.empty() ? 1 : 0; }
+
+uint64_t ptinf_num_ops(void* h) { return static_cast<Model*>(h)->num_ops; }
+uint64_t ptinf_num_vars(void* h) { return static_cast<Model*>(h)->num_vars; }
+uint64_t ptinf_num_blocks(void* h) { return static_cast<Model*>(h)->num_blocks; }
+uint64_t ptinf_num_params(void* h) { return static_cast<Model*>(h)->params.size(); }
+
+const char* ptinf_feed_names(void* h) {
+  auto* m = static_cast<Model*>(h);
+  m->scratch.clear();
+  for (auto& s : m->feeds) {
+    if (!m->scratch.empty()) m->scratch += "\n";
+    m->scratch += s;
+  }
+  return m->scratch.c_str();
+}
+
+const char* ptinf_fetch_names(void* h) {
+  auto* m = static_cast<Model*>(h);
+  m->scratch.clear();
+  for (auto& s : m->fetches) {
+    if (!m->scratch.empty()) m->scratch += "\n";
+    m->scratch += s;
+  }
+  return m->scratch.c_str();
+}
+
+const char* ptinf_param_name(void* h, uint64_t i) {
+  auto* m = static_cast<Model*>(h);
+  return i < m->params.size() ? m->params[i].name.c_str() : "";
+}
+
+const char* ptinf_param_dtype(void* h, uint64_t i) {
+  auto* m = static_cast<Model*>(h);
+  return i < m->params.size() ? m->params[i].tensor.dtype.c_str() : "";
+}
+
+int ptinf_param_ndim(void* h, uint64_t i) {
+  auto* m = static_cast<Model*>(h);
+  return i < m->params.size() ? static_cast<int>(m->params[i].tensor.shape.size())
+                              : -1;
+}
+
+int64_t ptinf_param_dim(void* h, uint64_t i, int d) {
+  auto* m = static_cast<Model*>(h);
+  if (i >= m->params.size()) return -1;
+  auto& s = m->params[i].tensor.shape;
+  return d < static_cast<int>(s.size()) ? s[d] : -1;
+}
+
+const uint8_t* ptinf_param_data(void* h, uint64_t i, uint64_t* nbytes) {
+  auto* m = static_cast<Model*>(h);
+  if (i >= m->params.size()) {
+    *nbytes = 0;
+    return nullptr;
+  }
+  *nbytes = m->params[i].tensor.data.size();
+  return m->params[i].tensor.data.data();
+}
+
+void ptinf_close(void* h) { delete static_cast<Model*>(h); }
+
+}  // extern "C"
+
+// --- demo main (<- paddle/fluid/inference demo / tests/book loaders) -------
+#ifdef PTINF_DEMO_MAIN
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  void* h = ptinf_load(argv[1]);
+  if (!ptinf_ok(h)) {
+    fprintf(stderr, "load failed: %s\n", ptinf_error(h));
+    return 1;
+  }
+  printf("model: %llu blocks, %llu ops, %llu vars, %llu params\n",
+         (unsigned long long)ptinf_num_blocks(h), (unsigned long long)ptinf_num_ops(h),
+         (unsigned long long)ptinf_num_vars(h), (unsigned long long)ptinf_num_params(h));
+  printf("feeds: %s\n", ptinf_feed_names(h));
+  printf("fetches: %s\n", ptinf_fetch_names(h));
+  for (uint64_t i = 0; i < ptinf_num_params(h); i++) {
+    uint64_t nbytes;
+    ptinf_param_data(h, i, &nbytes);
+    printf("param %s dtype=%s ndim=%d bytes=%llu\n", ptinf_param_name(h, i),
+           ptinf_param_dtype(h, i), ptinf_param_ndim(h, i),
+           (unsigned long long)nbytes);
+  }
+  ptinf_close(h);
+  return 0;
+}
+#endif

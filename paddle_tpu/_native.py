@@ -1,0 +1,60 @@
+"""Shared build-and-load for the csrc/ native components.
+
+One place owns the compile-if-stale + atomic-rename + process-wide-cache
+pattern (<- the role cmake/generic.cmake's cc_library played for the
+reference's native tree) so compiler flags and cache invalidation stay
+consistent across recordio / dataio / inference_loader bindings.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Sequence
+
+CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "csrc")
+CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu")
+
+_LIBS: Dict[str, ctypes.CDLL] = {}
+_LOCK = threading.Lock()
+
+_BASE_FLAGS = ["-O2", "-std=c++17", "-fPIC", "-pthread", "-I", CSRC_DIR]
+
+
+def build_artifact(name: str, srcs: Sequence[str], *, shared: bool = True,
+                   extra_flags: Sequence[str] = (),
+                   deps: Sequence[str] = ()) -> str:
+    """Compile csrc sources into CACHE_DIR/name if stale; returns the path.
+
+    deps: additional files whose mtime invalidates the artifact (e.g. an
+    #include'd source that is not on the compile line).
+    """
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    out = os.path.join(CACHE_DIR, name)
+    paths = [os.path.join(CSRC_DIR, s) if not os.path.isabs(s) else s
+             for s in srcs]
+    dep_paths = paths + [os.path.join(CSRC_DIR, d) if not os.path.isabs(d) else d
+                         for d in deps]
+    newest = max(os.path.getmtime(p) for p in dep_paths)
+    if not os.path.exists(out) or os.path.getmtime(out) < newest:
+        cmd = (["g++"] + _BASE_FLAGS + list(extra_flags)
+               + (["-shared"] if shared else []) + paths + ["-o", out + ".tmp"])
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(out + ".tmp", out)
+    return out
+
+
+def load_library(name: str, srcs: Sequence[str],
+                 extra_flags: Sequence[str] = (),
+                 deps: Sequence[str] = ()) -> ctypes.CDLL:
+    """Build (if stale) and dlopen a csrc shared library, cached per process."""
+    with _LOCK:
+        lib = _LIBS.get(name)
+        if lib is None:
+            so = build_artifact(name, srcs, shared=True,
+                                extra_flags=extra_flags, deps=deps)
+            lib = ctypes.CDLL(so)
+            _LIBS[name] = lib
+        return lib

@@ -1,0 +1,145 @@
+"""Deployment-side inference loading (<- paddle/fluid/inference/io.{h,cc},
+contrib/inference/paddle_inference_api.h).
+
+Two surfaces:
+* ``Predictor`` — Python: load an exported model dir and run it through the
+  XLA executor (the PaddlePredictor role).
+* ``NativeModelLoader`` — ctypes binding over csrc/inference_loader.cc: the
+  C++ loader a non-Python deployment uses to read the exported program +
+  parameters (inference/io.cc Load parity); also buildable as a standalone
+  ``demo_loader`` binary (inference demo analogue).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ._native import build_artifact, load_library
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def build_demo_loader() -> str:
+    """Build the standalone C++ loader binary (PTINF_DEMO_MAIN)."""
+    return build_artifact("demo_loader", ["inference_loader.cc"], shared=False,
+                          extra_flags=["-DPTINF_DEMO_MAIN"])
+
+
+def _lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            lib = load_library("libptinf.so", ["inference_loader.cc"])
+            lib.ptinf_load.restype = ctypes.c_void_p
+            lib.ptinf_load.argtypes = [ctypes.c_char_p]
+            for fn in ("ptinf_error", "ptinf_feed_names", "ptinf_fetch_names",
+                       "ptinf_param_dtype"):
+                getattr(lib, fn).restype = ctypes.c_char_p
+                getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            lib.ptinf_param_name.restype = ctypes.c_char_p
+            lib.ptinf_param_name.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.ptinf_param_dtype.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.ptinf_ok.restype = ctypes.c_int
+            lib.ptinf_ok.argtypes = [ctypes.c_void_p]
+            for fn in ("ptinf_num_ops", "ptinf_num_vars", "ptinf_num_blocks",
+                       "ptinf_num_params"):
+                getattr(lib, fn).restype = ctypes.c_uint64
+                getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            lib.ptinf_param_ndim.restype = ctypes.c_int
+            lib.ptinf_param_ndim.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.ptinf_param_dim.restype = ctypes.c_int64
+            lib.ptinf_param_dim.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                            ctypes.c_int]
+            lib.ptinf_param_data.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.ptinf_param_data.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                             ctypes.POINTER(ctypes.c_uint64)]
+            lib.ptinf_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        return _LIB
+
+
+class NativeModelLoader:
+    """Load an exported model directory through the C++ loader."""
+
+    def __init__(self, dirname: str):
+        self._lib = _lib()
+        self._h = self._lib.ptinf_load(os.fspath(dirname).encode())
+        if not self._lib.ptinf_ok(self._h):
+            err = self._lib.ptinf_error(self._h).decode()
+            self._lib.ptinf_close(self._h)
+            self._h = None
+            raise IOError(err)
+
+    @property
+    def num_ops(self) -> int:
+        return self._lib.ptinf_num_ops(self._h)
+
+    @property
+    def num_vars(self) -> int:
+        return self._lib.ptinf_num_vars(self._h)
+
+    @property
+    def num_blocks(self) -> int:
+        return self._lib.ptinf_num_blocks(self._h)
+
+    @property
+    def feed_names(self) -> List[str]:
+        s = self._lib.ptinf_feed_names(self._h).decode()
+        return s.split("\n") if s else []
+
+    @property
+    def fetch_names(self) -> List[str]:
+        s = self._lib.ptinf_fetch_names(self._h).decode()
+        return s.split("\n") if s else []
+
+    def params(self) -> Dict[str, np.ndarray]:
+        out = {}
+        n = self._lib.ptinf_num_params(self._h)
+        for i in range(n):
+            name = self._lib.ptinf_param_name(self._h, i).decode()
+            dtype = np.dtype(self._lib.ptinf_param_dtype(self._h, i).decode())
+            ndim = self._lib.ptinf_param_ndim(self._h, i)
+            shape = tuple(self._lib.ptinf_param_dim(self._h, i, d)
+                          for d in range(ndim))
+            nbytes = ctypes.c_uint64(0)
+            ptr = self._lib.ptinf_param_data(self._h, i, ctypes.byref(nbytes))
+            buf = ctypes.string_at(ptr, nbytes.value)
+            out[name] = np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+        return out
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptinf_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Predictor:
+    """Python predictor over an exported dir (PaddlePredictor role,
+    <- contrib/inference/paddle_inference_api.h)."""
+
+    def __init__(self, dirname: str, place=None, scope=None):
+        from . import io as model_io
+        from .core.executor import Executor, Scope
+
+        self.scope = scope or Scope()
+        self.exe = Executor(place) if place is not None else Executor()
+        self.program, self.feed_names, self.fetch_names = (
+            model_io.load_inference_model(dirname, self.exe, scope=self.scope))
+
+    def run(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        missing = set(self.feed_names) - set(feeds)
+        if missing:
+            raise ValueError(f"missing feeds: {sorted(missing)}")
+        return self.exe.run(self.program, feed=feeds,
+                            fetch_list=self.fetch_names, scope=self.scope)

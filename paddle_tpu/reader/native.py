@@ -1,0 +1,148 @@
+"""Native data pipeline binding (csrc/dataio.cc): buddy-allocated, threaded
+shuffle/batch/prefetch over RecordIO shards.
+
+<- the reference's C++ reader-op stack (operators/reader/create_{shuffle,
+batch,double_buffer}_reader_op.cc over recordio) and the BuddyAllocator
+(memory/detail/buddy_allocator.h) that backed its staging buffers. Python
+only sees finished batches as numpy arrays — parsing, shuffling, batching
+and prefetch all happen off the GIL in C++ worker threads.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .._native import load_library
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            lib = load_library("libdataio.so", ["dataio.cc"],
+                               deps=["recordio.cc"])
+            lib.pt_buddy_create.restype = ctypes.c_void_p
+            lib.pt_buddy_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+            lib.pt_buddy_alloc.restype = ctypes.c_void_p
+            lib.pt_buddy_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.pt_buddy_free.restype = ctypes.c_int
+            lib.pt_buddy_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.pt_buddy_used.restype = ctypes.c_uint64
+            lib.pt_buddy_used.argtypes = [ctypes.c_void_p]
+            lib.pt_buddy_capacity.restype = ctypes.c_uint64
+            lib.pt_buddy_capacity.argtypes = [ctypes.c_void_p]
+            lib.pt_buddy_destroy.argtypes = [ctypes.c_void_p]
+            lib.dio_pipeline_open.restype = ctypes.c_void_p
+            lib.dio_pipeline_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32,
+                ctypes.c_int, ctypes.c_uint64]
+            lib.dio_pipeline_next.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.dio_pipeline_next.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32)]
+            lib.dio_pipeline_error.restype = ctypes.c_char_p
+            lib.dio_pipeline_error.argtypes = [ctypes.c_void_p]
+            lib.dio_pipeline_mem_used.restype = ctypes.c_uint64
+            lib.dio_pipeline_mem_used.argtypes = [ctypes.c_void_p]
+            lib.dio_pipeline_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        return _LIB
+
+
+class BuddyAllocator:
+    """Host arena with buddy alloc/free (<- memory/detail/buddy_allocator.h).
+
+    Exposed mainly for tests/diagnostics — the pipeline embeds its own.
+    """
+
+    def __init__(self, total_bytes: int, min_block: int = 256):
+        self._lib = _lib()
+        self._h = self._lib.pt_buddy_create(total_bytes, min_block)
+
+    def alloc(self, n: int) -> Optional[int]:
+        p = self._lib.pt_buddy_alloc(self._h, n)
+        return p or None
+
+    def free(self, p: int) -> bool:
+        return self._lib.pt_buddy_free(self._h, p) == 0
+
+    @property
+    def used(self) -> int:
+        return self._lib.pt_buddy_used(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.pt_buddy_capacity(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.pt_buddy_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeBatchLoader:
+    """Iterate numpy batches assembled by the C++ pipeline.
+
+    Records must be fixed-size; ``dtype``/``shape`` describe one record
+    (shape excludes the batch dim). The final short batch is yielded
+    truncated to its true length (drop_last=False) or dropped.
+    """
+
+    def __init__(self, files: Sequence[str], record_shape, dtype="float32",
+                 batch_size: int = 32, shuffle_buf: int = 0, seed: int = 0,
+                 capacity: int = 8, drop_last: bool = False,
+                 arena_bytes: int = 0):
+        self._lib = _lib()
+        self.dtype = np.dtype(dtype)
+        self.record_shape = tuple(int(s) for s in record_shape)
+        self.record_bytes = int(np.prod(self.record_shape)) * self.dtype.itemsize
+        self.batch_size = batch_size
+        paths = "\n".join(os.fspath(f) for f in files).encode()
+        self._h = self._lib.dio_pipeline_open(
+            paths, self.record_bytes, batch_size, shuffle_buf, seed, capacity,
+            int(drop_last), arena_bytes)
+        if not self._h:
+            raise IOError(f"cannot open native pipeline over {list(files)!r}")
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        count = ctypes.c_uint32(0)
+        while True:
+            ptr = self._lib.dio_pipeline_next(self._h, ctypes.byref(count))
+            if not ptr:
+                err = self._lib.dio_pipeline_error(self._h)
+                if err:
+                    raise IOError(err.decode())
+                return
+            n = count.value
+            buf = ctypes.string_at(ptr, self.batch_size * self.record_bytes)
+            arr = np.frombuffer(buf, dtype=self.dtype).reshape(
+                (self.batch_size,) + self.record_shape)
+            yield arr[:n]
+
+    @property
+    def mem_used(self) -> int:
+        return self._lib.dio_pipeline_mem_used(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.dio_pipeline_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

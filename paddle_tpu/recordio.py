@@ -8,38 +8,20 @@ library on first use with g++ (cached under ~/.cache/paddle_tpu).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Iterator, Optional
 
+from ._native import load_library
+
 _LIB = None
 _LIB_LOCK = threading.Lock()
-
-_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "csrc", "recordio.cc")
-_CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu")
-
-
-def _build_lib() -> str:
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    so = os.path.join(_CACHE_DIR, "librecordio.so")
-    if (not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(_CSRC)):
-        subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             _CSRC, "-o", so + ".tmp"],
-            check=True, capture_output=True,
-        )
-        os.replace(so + ".tmp", so)
-    return so
 
 
 def _lib():
     global _LIB
     with _LIB_LOCK:
         if _LIB is None:
-            lib = ctypes.CDLL(_build_lib())
+            lib = load_library("librecordio.so", ["recordio.cc"])
             lib.rio_writer_open.restype = ctypes.c_void_p
             lib.rio_writer_open.argtypes = [ctypes.c_char_p]
             lib.rio_write.restype = ctypes.c_int

@@ -1,0 +1,168 @@
+"""Native C++ runtime components: buddy allocator, shuffle/batch/prefetch
+pipeline, and the C++ inference-model loader
+(<- memory/malloc_test.cc, operators/reader tests, inference/io.cc +
+inference/tests/book loaders)."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio
+from paddle_tpu.inference import NativeModelLoader, Predictor, build_demo_loader
+from paddle_tpu.reader.native import BuddyAllocator, NativeBatchLoader
+
+
+def test_buddy_alloc_free_coalesce():
+    b = BuddyAllocator(1 << 16, 256)
+    p1 = b.alloc(1000)
+    p2 = b.alloc(5000)
+    assert b.used == 1024 + 8192
+    assert b.free(p1)
+    assert not b.free(p1)  # double free rejected
+    assert b.free(p2)
+    assert b.used == 0
+    # full coalescing: the whole arena is allocatable again
+    assert b.alloc((1 << 16) - 1) is not None
+    b.close()
+
+
+def test_buddy_exhaustion_returns_none():
+    b = BuddyAllocator(1 << 12, 256)
+    assert b.alloc(1 << 13) is None  # larger than arena
+    p = b.alloc(1 << 12)
+    assert p is not None and b.alloc(256) is None  # exhausted
+    b.close()
+
+
+def _write_shards(tmp_path, n_files=3, per_file=25, width=6):
+    files, ids = [], []
+    for f in range(n_files):
+        path = str(tmp_path / f"part{f}.rio")
+        w = recordio.Writer(path)
+        for j in range(per_file):
+            r = np.arange(width, dtype="float32")
+            r[0] = f * 100 + j
+            ids.append(f * 100 + j)
+            w.write(r.tobytes())
+        w.close()
+        files.append(path)
+    return files, ids
+
+
+def test_native_loader_ordered_and_short_tail(tmp_path):
+    files, ids = _write_shards(tmp_path)
+    loader = NativeBatchLoader(files, record_shape=[6], batch_size=8)
+    batches = list(loader)
+    got = np.concatenate([b[:, 0] for b in batches]).astype(int).tolist()
+    assert got == ids
+    assert batches[-1].shape[0] == 75 % 8
+    loader.close()
+
+
+def test_native_loader_shuffle_deterministic(tmp_path):
+    files, ids = _write_shards(tmp_path)
+    g = [np.concatenate([b[:, 0] for b in
+                         NativeBatchLoader(files, [6], batch_size=8,
+                                           shuffle_buf=40, seed=s)])
+         .astype(int).tolist() for s in (7, 7, 8)]
+    assert sorted(g[0]) == sorted(ids)
+    assert g[0] == g[1]        # same seed -> same order
+    assert g[0] != g[2]        # different seed -> different order
+    assert g[0] != ids         # actually shuffled
+
+
+def test_native_loader_drop_last_and_record_mismatch(tmp_path):
+    files, _ = _write_shards(tmp_path)
+    ld = list(NativeBatchLoader(files, [6], batch_size=8, drop_last=True))
+    assert len(ld) == 9 and all(b.shape[0] == 8 for b in ld)
+    with pytest.raises(IOError):
+        list(NativeBatchLoader(files, [5], batch_size=8))  # wrong record size
+
+
+def _export_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        pred = fluid.layers.fc(h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=5)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe, main_program=main,
+                                  scope=scope)
+    return d, scope, pred.name
+
+
+def test_cpp_inference_loader_matches_python(tmp_path):
+    d, scope, pred_name = _export_model(tmp_path)
+    m = NativeModelLoader(d)
+    assert m.feed_names == ["x"]
+    assert m.fetch_names == [pred_name]
+    assert m.num_blocks >= 1 and m.num_ops >= 2
+    params = m.params()
+    assert len(params) == 4  # 2 weights + 2 biases
+    for name, arr in params.items():
+        np.testing.assert_array_equal(arr, np.asarray(scope.get(name)))
+    m.close()
+
+
+def test_cpp_loader_error_on_missing_dir(tmp_path):
+    with pytest.raises(IOError):
+        NativeModelLoader(str(tmp_path / "nope"))
+
+
+def test_demo_loader_binary(tmp_path):
+    d, _, _ = _export_model(tmp_path)
+    exe = build_demo_loader()
+    out = subprocess.run([exe, d], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "feeds: x" in out.stdout
+    assert "4 params" in out.stdout
+
+
+def test_python_predictor_roundtrip(tmp_path):
+    d, scope, pred_name = _export_model(tmp_path)
+    p = Predictor(d, place=fluid.CPUPlace())
+    x = np.random.RandomState(0).rand(5, 4).astype("float32")
+    out, = p.run({"x": x})
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(5), rtol=1e-5)
+
+
+def test_native_loader_feeds_training(tmp_path):
+    """Native pipeline -> executor: the full host data plane in C++."""
+    files, _ = _write_shards(tmp_path, n_files=2, per_file=32, width=5)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    losses = []
+    for epoch in range(3):
+        for batch in NativeBatchLoader(files, [5], batch_size=16,
+                                       shuffle_buf=32, seed=epoch):
+            lv, = exe.run(main, feed={"x": batch[:, 1:], "y": batch[:, :1]},
+                          fetch_list=[loss], scope=scope)
+            losses.append(float(lv))
+    assert losses[-1] < losses[0]
+
+
+def test_native_loader_corrupt_shard_raises(tmp_path):
+    """A CRC-corrupted shard must error, not silently truncate the data."""
+    files, _ = _write_shards(tmp_path, n_files=1, per_file=20, width=6)
+    with open(files[0], "r+b") as f:
+        f.seek(-8, os.SEEK_END)  # flip a payload byte in the last chunk
+        b = f.read(1)
+        f.seek(-8, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(IOError, match="crc"):
+        for _ in NativeBatchLoader(files, [6], batch_size=4):
+            pass
