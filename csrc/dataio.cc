@@ -192,9 +192,11 @@ struct Pipeline {
       if (closed) break;
       void* sc = rio_scanner_open(files[fi].c_str());
       if (!sc) {
+        // like the CRC path: stop emitting entirely — draining the reservoir
+        // would hand the consumer shuffled partial data before the error
         std::lock_guard<std::mutex> g(mu);
         error = "cannot open " + files[fi];
-        break;
+        goto finish;
       }
       uint32_t len;
       const uint8_t* rec;

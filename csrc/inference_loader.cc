@@ -339,20 +339,24 @@ bool load_model(const std::string& dir, Model* m) {
   // the exporter persists persistables *referenced as op inputs*
   // (io.py save_inference_model); mirror that filter so vars left in the
   // pruned program's var table but unused by its ops are not demanded
-  std::vector<std::string> persistables;
+  // pass 1: collect op-input references across ALL blocks (a weight declared
+  // in block 0 may be consumed only inside a sub-block's ops)
   std::map<std::string, bool> referenced;
   for (auto& blk : blocks->arr) {
     const JValue* ops = blk->get("ops");
-    const JValue* vars = blk->get("vars");
-    if (ops) {
-      m->num_ops += ops->arr.size();
-      for (auto& op : ops->arr) {
-        const JValue* ins = op->get("inputs");
-        if (!ins) continue;
-        for (auto& slot : ins->obj)
-          for (auto& nm : slot.second->arr) referenced[nm->str] = true;
-      }
+    if (!ops) continue;
+    m->num_ops += ops->arr.size();
+    for (auto& op : ops->arr) {
+      const JValue* ins = op->get("inputs");
+      if (!ins) continue;
+      for (auto& slot : ins->obj)
+        for (auto& nm : slot.second->arr) referenced[nm->str] = true;
     }
+  }
+  // pass 2: persistable ∧ referenced anywhere -> expected on disk
+  std::vector<std::string> persistables;
+  for (auto& blk : blocks->arr) {
+    const JValue* vars = blk->get("vars");
     if (!vars) continue;
     m->num_vars += vars->arr.size();
     for (auto& var : vars->arr) {

@@ -19,6 +19,7 @@ to them functionally), with buffer donation so updates happen in place in HBM.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -263,13 +264,41 @@ class Executor:
         # the reference's per-op RecordEvent in the interpreter hot loop
         # (operator.cc RunImpl); ops fused into one XLA program leave only
         # block-granularity host events, finer grain lives in device traces
+        from ..flags import get_flag
+
+        benchmark = get_flag("benchmark")
+        t0 = time.perf_counter() if benchmark else 0.0
         with RecordEvent(f"executor_run/block{block_idx}"):
             fetches, new_state = fn(feed_vals, readonly, donated, key)
             for n in state_out_names:
                 scope.set(n, new_state[n])
             if return_numpy:
                 fetches = [np.asarray(v) for v in fetches]
+        if get_flag("check_nan_inf"):
+            # <- FLAGS_check_nan_inf (operator.cc RunImpl tail): scan every
+            # produced tensor; here that is the fetches + updated state of
+            # the compiled block
+            self._check_nan_inf(fetch_names, fetches, state_out_names, new_state)
+        if benchmark:
+            # <- FLAGS_benchmark: per-run device-complete timing (numpy
+            # conversion above already synced) + host memory usage
+            jax.block_until_ready(new_state if new_state else fetches)
+            print(f"[benchmark] block{block_idx} run {time.perf_counter() - t0:.6f}s "
+                  f"feed={len(feed_vals)} fetch={len(fetches)} "
+                  f"state_out={len(state_out_names)}", flush=True)
         return fetches
+
+    @staticmethod
+    def _check_nan_inf(fetch_names, fetches, state_out_names, new_state):
+        for name, v in list(zip(fetch_names, fetches)) + [
+            (n, new_state[n]) for n in state_out_names
+        ]:
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+                raise FloatingPointError(
+                    f"check_nan_inf: variable {name!r} contains NaN/Inf "
+                    f"(first bad index {np.argwhere(~np.isfinite(arr))[0].tolist()})"
+                )
 
     # -- compilation --
     def _compile(self, program: Program, block_idx: int, feed_names, fetch_names, sig):

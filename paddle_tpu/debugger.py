@@ -1,0 +1,99 @@
+"""Program visualization (<- python/paddle/fluid/debugger.py + graphviz.py,
+details/ssa_graph_printer.{h,cc}, BuildStrategy.debug_graphviz_path_).
+
+``draw_block_graphviz`` renders a block's dataflow as a .dot file (ops as
+boxes, variables as ellipses, nested sub-blocks as clusters) —
+chrome/graphviz-viewable without extra dependencies. ``pprint_program``
+gives the textual dump (debugger.py pprint_program_codes role).
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from .core.ir import Block, Program
+
+__all__ = ["draw_block_graphviz", "pprint_program"]
+
+
+def _q(s: str) -> str:
+    return '"' + s.replace('"', r"\"") + '"'
+
+
+def _emit_block(block: Block, lines, drawn_vars: Set[str], highlights,
+                prefix: str = "b0"):
+    program = block.program
+    for oi, op in enumerate(block.ops):
+        op_id = f"{prefix}_op{oi}"
+        lines.append(f"  {op_id} [shape=box, style=rounded, "
+                     f"label={_q(op.type)}];")
+        for n in op.input_names:
+            if not n:
+                continue
+            var_id = "var_" + n
+            if n not in drawn_vars:
+                drawn_vars.add(n)
+                color = ', style=filled, fillcolor="#fdeeee"' if n in highlights else ""
+                lines.append(f"  {_q(var_id)} [shape=ellipse, label={_q(n)}{color}];")
+            lines.append(f"  {_q(var_id)} -> {op_id};")
+        for n in op.output_names:
+            if not n:
+                continue
+            var_id = "var_" + n
+            if n not in drawn_vars:
+                drawn_vars.add(n)
+                color = ', style=filled, fillcolor="#fdeeee"' if n in highlights else ""
+                lines.append(f"  {_q(var_id)} [shape=ellipse, label={_q(n)}{color}];")
+            lines.append(f"  {op_id} -> {_q(var_id)};")
+        # nested blocks (while/cond/recurrent bodies) as clusters
+        subs = []
+        for key in ("sub_block", "sub_true", "sub_false"):
+            sub_idx = op.attr(key, None)
+            if isinstance(sub_idx, int):
+                subs.append(sub_idx)
+            elif isinstance(sub_idx, (list, tuple)):
+                subs.extend(i for i in sub_idx if isinstance(i, int))
+        for k, bi in enumerate(subs):
+            if not isinstance(bi, int) or bi >= len(program.blocks):
+                continue
+            sub_prefix = f"{prefix}_op{oi}_sub{k}"
+            lines.append(f"  subgraph cluster_{sub_prefix} {{")
+            lines.append(f'    label="{op.type} block {bi}"; color=gray;')
+            _emit_block(program.blocks[bi], lines, drawn_vars, highlights,
+                        prefix=sub_prefix)
+            lines.append("  }")
+            lines.append(f"  {op_id} -> {sub_prefix}_op0 [style=dashed];")
+
+
+def draw_block_graphviz(block: Block, highlights: Optional[Set[str]] = None,
+                        path: str = "/tmp/temp.dot") -> str:
+    """<- debugger.py draw_block_graphviz: write a .dot of the block."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    _emit_block(block, lines, set(), highlights)
+    lines.append("}")
+    text = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def pprint_program(program: Program) -> str:
+    """Textual IR dump, one op per line with slots and attrs."""
+    out = []
+    for bi, block in enumerate(program.blocks):
+        out.append(f"block {bi} (parent {block.parent_idx}):")
+        for v in block.vars.values():
+            flags = []
+            if v.persistable:
+                flags.append("persistable")
+            if v.is_data:
+                flags.append("data")
+            out.append(f"  var {v.name}: {v.dtype} {v.shape} "
+                       f"{' '.join(flags)}".rstrip())
+        for op in block.ops:
+            ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items() if v)
+            outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items() if v)
+            attrs = {k: v for k, v in op.attrs.items() if k != "sub_block"}
+            out.append(f"  {op.type}({ins}) -> {outs}"
+                       + (f"  attrs={attrs}" if attrs else ""))
+    return "\n".join(out)

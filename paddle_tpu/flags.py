@@ -1,0 +1,88 @@
+"""Runtime flags (<- the reference's gflags plane: FLAGS_check_nan_inf
+scanning op outputs in operator.cc RunImpl, FLAGS_benchmark forcing per-op
+sync + memory logging in executor.cc:342, FLAGS_fraction_of_gpu_memory_to_use
+in gpu_info.cc, exposed to Python via InitGflags, framework/init.cc:32).
+
+TPU mapping: per-op guards become per-compiled-block guards (ops fuse into
+one XLA program); memory flags govern the host buddy arena rather than a
+GPU pool. Flags are set programmatically, via ``init_gflags(argv)``
+(reference's fluid.__init__ path), or env vars ``PT_FLAG_<NAME>``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Sequence
+
+_DEFAULTS: Dict[str, Any] = {
+    # raise if any fetched/updated tensor contains NaN/Inf after a block run
+    # (<- FLAGS_check_nan_inf, operator.cc tail of RunImpl)
+    "check_nan_inf": False,
+    # log per-run timing + host arena usage (<- FLAGS_benchmark,
+    # executor.cc:342-345,362)
+    "benchmark": False,
+    # compiled-program cache entries per Executor (<- the reference's program
+    # cache, executor.py:204)
+    "executor_cache_capacity": 64,
+    # host staging arena budget for native loaders (<- the role
+    # FLAGS_fraction_of_gpu_memory_to_use played for the GPU pool)
+    "host_arena_bytes": 1 << 28,
+    # print an XLA cost-analysis summary at compile time
+    "log_compile": False,
+}
+
+_flags: Dict[str, Any] = {}
+
+
+def _coerce(name: str, value: Any) -> Any:
+    proto = _DEFAULTS[name]
+    if isinstance(proto, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return type(proto)(value)
+
+
+def _load_env():
+    for name in _DEFAULTS:
+        env = os.environ.get("PT_FLAG_" + name.upper())
+        if env is not None and name not in _flags:
+            _flags[name] = _coerce(name, env)
+
+
+_load_env()
+
+
+def get_flag(name: str) -> Any:
+    if name not in _DEFAULTS:
+        raise KeyError(f"unknown flag {name!r}; known: {sorted(_DEFAULTS)}")
+    return _flags.get(name, _DEFAULTS[name])
+
+
+def set_flag(name: str, value: Any) -> None:
+    if name not in _DEFAULTS:
+        raise KeyError(f"unknown flag {name!r}; known: {sorted(_DEFAULTS)}")
+    _flags[name] = _coerce(name, value)
+
+
+def set_flags(d: Dict[str, Any]) -> None:
+    for k, v in d.items():
+        set_flag(k, v)
+
+
+def init_gflags(argv: Sequence[str] = ()) -> List[str]:
+    """Parse ``--name=value`` args (<- InitGflags, framework/init.cc:32);
+    returns unrecognized args, like gflags does."""
+    rest = []
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            name, value = a[2:].split("=", 1)
+            name = name.replace("-", "_")
+            if name in _DEFAULTS:
+                set_flag(name, value)
+                continue
+        rest.append(a)
+    return rest
+
+
+def flags() -> Dict[str, Any]:
+    return {k: get_flag(k) for k in _DEFAULTS}

@@ -37,13 +37,12 @@ def _lib():
             lib = load_library("libptinf.so", ["inference_loader.cc"])
             lib.ptinf_load.restype = ctypes.c_void_p
             lib.ptinf_load.argtypes = [ctypes.c_char_p]
-            for fn in ("ptinf_error", "ptinf_feed_names", "ptinf_fetch_names",
-                       "ptinf_param_dtype"):
+            for fn in ("ptinf_error", "ptinf_feed_names", "ptinf_fetch_names"):
                 getattr(lib, fn).restype = ctypes.c_char_p
                 getattr(lib, fn).argtypes = [ctypes.c_void_p]
-            lib.ptinf_param_name.restype = ctypes.c_char_p
-            lib.ptinf_param_name.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-            lib.ptinf_param_dtype.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            for fn in ("ptinf_param_name", "ptinf_param_dtype"):
+                getattr(lib, fn).restype = ctypes.c_char_p
+                getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_uint64]
             lib.ptinf_ok.restype = ctypes.c_int
             lib.ptinf_ok.argtypes = [ctypes.c_void_p]
             for fn in ("ptinf_num_ops", "ptinf_num_vars", "ptinf_num_blocks",
@@ -108,8 +107,9 @@ class NativeModelLoader:
                           for d in range(ndim))
             nbytes = ctypes.c_uint64(0)
             ptr = self._lib.ptinf_param_data(self._h, i, ctypes.byref(nbytes))
-            buf = ctypes.string_at(ptr, nbytes.value)
-            out[name] = np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+            # one copy: view the C++ buffer directly, then materialize
+            view = np.ctypeslib.as_array(ptr, shape=(nbytes.value,))
+            out[name] = view.view(dtype).reshape(shape).copy()
         return out
 
     def close(self):

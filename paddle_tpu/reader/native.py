@@ -110,14 +110,25 @@ class NativeBatchLoader:
         self.record_shape = tuple(int(s) for s in record_shape)
         self.record_bytes = int(np.prod(self.record_shape)) * self.dtype.itemsize
         self.batch_size = batch_size
-        paths = "\n".join(os.fspath(f) for f in files).encode()
-        self._h = self._lib.dio_pipeline_open(
-            paths, self.record_bytes, batch_size, shuffle_buf, seed, capacity,
+        self._open_args = (
+            "\n".join(os.fspath(f) for f in files).encode(),
+            self.record_bytes, batch_size, shuffle_buf, seed, capacity,
             int(drop_last), arena_bytes)
+        self._files = list(files)
+        self._h = self._lib.dio_pipeline_open(*self._open_args)
         if not self._h:
-            raise IOError(f"cannot open native pipeline over {list(files)!r}")
+            raise IOError(f"cannot open native pipeline over {self._files!r}")
+        self._consumed = False
 
     def __iter__(self) -> Iterator[np.ndarray]:
+        # the C++ pipeline is one-shot; transparently re-open for each fresh
+        # iteration so epoch loops see the full dataset every time
+        if self._consumed:
+            self.close()
+            self._h = self._lib.dio_pipeline_open(*self._open_args)
+            if not self._h:
+                raise IOError(f"cannot re-open native pipeline over {self._files!r}")
+        self._consumed = True
         count = ctypes.c_uint32(0)
         while True:
             ptr = self._lib.dio_pipeline_next(self._h, ctypes.byref(count))

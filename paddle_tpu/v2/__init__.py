@@ -1,0 +1,35 @@
+"""paddle.v2-compatible API (<- python/paddle/v2/: layer DSL, topology,
+parameters, SGD trainer, events, infer).
+
+The reference's v2 stack compiled a lazy layer DSL into ModelConfig protos
+executed by the C++ gserver engine (v2/layer.py, v2/topology.py,
+trainer/config_parser.py). Here the same DSL lowers onto the Fluid-
+equivalent IR (paddle_tpu.layers) and runs through the XLA executor — one
+engine instead of two, same user surface: build layers, create parameters,
+train with SGD + event callbacks, infer.
+"""
+from . import activation, attr, data_type, event, pooling  # noqa: F401
+from . import layer, optimizer  # noqa: F401
+from . import networks  # noqa: F401
+from .parameters import Parameters, create as _params_create  # noqa: F401
+from .trainer import SGD  # noqa: F401
+from .inference import infer  # noqa: F401
+from .. import dataset, reader  # noqa: F401  (shared data plane)
+
+
+class parameters:  # namespace parity: paddle.v2.parameters.create(...)
+    create = staticmethod(_params_create)
+    Parameters = Parameters
+
+
+def init(use_gpu: bool = False, trainer_count: int = 1, **kwargs):
+    """<- paddle.v2.init: device/trainer bootstrap. Device selection on TPU
+    happens per-Executor; the arguments are accepted for compatibility."""
+    return None
+
+
+def batch(reader_creator, batch_size, drop_last: bool = True):
+    """<- paddle.v2.minibatch.batch."""
+    from ..reader import decorator
+
+    return decorator.batch(reader_creator, batch_size, drop_last=drop_last)

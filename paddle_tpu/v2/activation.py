@@ -1,0 +1,28 @@
+"""<- python/paddle/v2/activation.py (trainer_config_helpers activations)."""
+
+
+class _Act:
+    name = None
+
+    def __repr__(self):
+        return f"<activation {self.name}>"
+
+
+class Linear(_Act):
+    name = None
+
+
+class Relu(_Act):
+    name = "relu"
+
+
+class Sigmoid(_Act):
+    name = "sigmoid"
+
+
+class Tanh(_Act):
+    name = "tanh"
+
+
+class Softmax(_Act):
+    name = "softmax"

@@ -1,0 +1,18 @@
+"""<- python/paddle/v2/attr.py: parameter attributes."""
+from ..param_attr import ParamAttr
+
+
+def Param(name=None, initial_std=None, initial_mean=None, learning_rate=None,
+          l2_rate=None, **kwargs):
+    """Map the v2 ParameterAttribute surface onto ParamAttr."""
+    init = None
+    if initial_std is not None or initial_mean is not None:
+        from ..initializer import NormalInitializer
+
+        init = NormalInitializer(loc=initial_mean or 0.0,
+                                 scale=initial_std if initial_std is not None else 0.01)
+    return ParamAttr(name=name, initializer=init,
+                     learning_rate=learning_rate if learning_rate is not None else 1.0)
+
+
+ParameterAttribute = Param

@@ -1,0 +1,31 @@
+"""Input type declarations (<- python/paddle/v2/data_type.py /
+trainer_config_helpers/data_sources): describe one reader column so the
+trainer can convert python samples into dense feeds."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class InputType:
+    kind: str       # dense | int | int_seq | dense_seq
+    dim: int
+    seq_len: int = 0  # max length for *_seq kinds (padded; 0 = infer 128)
+
+
+def dense_vector(dim: int) -> InputType:
+    return InputType("dense", dim)
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType("int", value_range)
+
+
+def integer_value_sequence(value_range: int, seq_len: int = 0) -> InputType:
+    """Variable-length id sequence -> dense padded ids + length feed
+    (the LoD redesign: SURVEY §5.7)."""
+    return InputType("int_seq", value_range, seq_len)
+
+
+def dense_vector_sequence(dim: int, seq_len: int = 0) -> InputType:
+    return InputType("dense_seq", dim, seq_len)

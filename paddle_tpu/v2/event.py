@@ -1,0 +1,40 @@
+"""<- python/paddle/v2/event.py: training callbacks."""
+from __future__ import annotations
+
+
+class WithMetric:
+    def __init__(self, evaluator=None):
+        self.evaluator = evaluator
+
+
+class BeginPass:
+    def __init__(self, pass_id: int):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id: int, evaluator=None, result=None):
+        super().__init__(evaluator)
+        self.pass_id = pass_id
+        self.result = result
+
+
+class BeginIteration:
+    def __init__(self, pass_id: int, batch_id: int):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id: int, batch_id: int, cost: float,
+                 evaluator=None):
+        super().__init__(evaluator)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+
+
+class TestResult(WithMetric):
+    def __init__(self, evaluator=None, cost: float = 0.0):
+        super().__init__(evaluator)
+        self.cost = cost

@@ -1,0 +1,38 @@
+"""<- python/paddle/v2/inference.py: paddle.v2.infer(output_layer,
+parameters, input)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.executor import Executor, Scope
+from .layer import Layer, to_program
+from .parameters import LazyParameters
+
+
+def infer(output_layer, parameters, input: Sequence, feeding=None,
+          field: str = "value", place=None):
+    """Build the inference program for output_layer, copy parameter values
+    from the (trained) parameter pool, run the input batch."""
+    outputs = (output_layer if isinstance(output_layer, (list, tuple))
+               else [output_layer])
+    main, startup, outs, feed_order, ctx = to_program(list(outputs))
+
+    scope = Scope()
+    exe = Executor(place) if place is not None else Executor()
+    exe.run(startup, scope=scope, seed=0)
+    # overwrite fresh init with the trained values
+    src = parameters.materialized if isinstance(parameters, LazyParameters) else parameters
+    if src is None:
+        raise ValueError("parameters must come from a trained v2 trainer")
+    for name in src.names():
+        if scope.get(name) is not None:
+            scope.set(name, src.get(name))
+
+    from .trainer import make_feed
+
+    feed = make_feed(ctx, input, feeding)
+    results = exe.run(main, feed=feed, fetch_list=[o.name for o in outs],
+                      scope=scope)
+    return results[0] if len(results) == 1 else results

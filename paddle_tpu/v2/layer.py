@@ -1,0 +1,264 @@
+"""v2 layer DSL (<- python/paddle/v2/layer.py + topology.py +
+trainer/config_parser.py, 4.4k LoC).
+
+Layers are *lazy* nodes: calling ``fc(input=x, size=10)`` records a node,
+nothing executes. ``to_program(outputs)`` walks the DAG and emits the Fluid-
+equivalent IR through paddle_tpu.layers — the role config_parser.py played
+compiling the DSL into ModelConfig protos for gserver.
+
+Sequence inputs follow the dense redesign (SURVEY §5.7): an
+integer_value_sequence data layer materializes ids [N, L] plus a hidden
+``<name>@len`` length feed, which sequence layers (pooling, lstmemory)
+consume as the mask.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .. import layers as F
+from ..core.ir import Program, program_guard
+from . import activation as act_mod
+from . import pooling as pooling_mod
+from .data_type import InputType
+
+__all__ = ["data", "fc", "embedding", "pooling", "lstmemory", "gru",
+           "concat", "cross_entropy_cost", "classification_cost",
+           "square_error_cost", "mse_cost", "max_id", "dropout", "parse_network"]
+
+_DEFAULT_SEQ_LEN = 128
+
+
+class Layer:
+    """One lazy DSL node."""
+
+    _counter = [0]
+
+    def __init__(self, kind: str, parents: Sequence["Layer"], build: Callable,
+                 name: Optional[str] = None, input_type: Optional[InputType] = None):
+        Layer._counter[0] += 1
+        self.kind = kind
+        self.name = name or f"__{kind}_{Layer._counter[0]}__"
+        self.parents = list(parents)
+        self.build = build  # build(ctx, parent_vars) -> Variable
+        self.input_type = input_type  # data layers only
+
+    def __repr__(self):
+        return f"<v2.layer {self.kind} {self.name!r}>"
+
+
+class BuildContext:
+    def __init__(self):
+        self.vars: Dict[int, object] = {}   # id(layer) -> built Variable
+        self.lengths: Dict[int, object] = {}  # id(layer) -> length Variable
+        self.data_layers: List[Layer] = []
+
+
+def _build(layer: Layer, ctx: BuildContext):
+    if id(layer) in ctx.vars:
+        return ctx.vars[id(layer)]
+    parent_vars = [_build(p, ctx) for p in layer.parents]
+    v = layer.build(ctx, parent_vars)
+    ctx.vars[id(layer)] = v
+    return v
+
+
+def _seq_length(layer: Layer, ctx: BuildContext):
+    """The length var attached to the nearest sequence data ancestor."""
+    if id(layer) in ctx.lengths:
+        return ctx.lengths[id(layer)]
+    for p in layer.parents:
+        _build(p, ctx)  # ensure ancestors (and their lengths) exist
+        l = _seq_length(p, ctx)
+        if l is not None:
+            return l
+    return None
+
+
+def to_program(outputs: Sequence[Layer], main: Optional[Program] = None,
+               startup: Optional[Program] = None):
+    """Compile the DAG reachable from ``outputs`` into (main, startup,
+    feed_order, ctx) — the topology.Topology role.
+
+    Builds under a fresh unique_name generator: rebuilding the same DAG
+    (trainer then infer) must produce the SAME parameter names, or the
+    trained-value copy in infer()/init_from_tar silently matches nothing.
+    """
+    from .. import unique_name
+
+    main = main or Program()
+    startup = startup or Program()
+    ctx = BuildContext()
+    with unique_name.guard():
+        with program_guard(main, startup):
+            outs = [_build(o, ctx) for o in outputs]
+    feed_order = [l.name for l in ctx.data_layers]
+    return main, startup, outs, feed_order, ctx
+
+
+parse_network = to_program
+
+
+# --- data -------------------------------------------------------------------
+
+
+def data(name: str, type: InputType, **kw) -> Layer:
+    def build(ctx, _parents):
+        if type.kind == "dense":
+            v = F.data(name, shape=[type.dim], dtype="float32")
+        elif type.kind == "int":
+            v = F.data(name, shape=[1], dtype="int64")
+        elif type.kind in ("int_seq", "dense_seq"):
+            L = type.seq_len or _DEFAULT_SEQ_LEN
+            if type.kind == "int_seq":
+                v = F.data(name, shape=[L], dtype="int64")
+            else:
+                v = F.data(name, shape=[L, type.dim], dtype="float32")
+            length = F.data(name + "@len", shape=[-1], dtype="int32",
+                            append_batch_size=False)
+            ctx.lengths[id(layer)] = length
+        else:
+            raise ValueError(f"unknown input type {type.kind}")
+        return v
+
+    layer = Layer("data", [], build, name=name, input_type=type)
+
+    def build_and_register(ctx, parents):
+        if layer not in ctx.data_layers:
+            ctx.data_layers.append(layer)
+        return build(ctx, parents)
+
+    layer.build = build_and_register
+    return layer
+
+
+# --- computation layers -----------------------------------------------------
+
+
+def _act_name(a) -> Optional[str]:
+    if a is None:
+        return None
+    if isinstance(a, type):
+        a = a()
+    return a.name
+
+
+def fc(input, size: int, act=None, param_attr=None, bias_attr=None,
+       name=None, **kw) -> Layer:
+    ins = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx, parents):
+        # v2 fc over a sequence applies per-timestep (gserver applied fc to
+        # each time step's row); dense redesign: flatten only the feature dim
+        ndim = (parents[0].shape is not None and len(parents[0].shape)) or 2
+        return F.fc(list(parents) if len(parents) > 1 else parents[0],
+                    size=size, act=_act_name(act), param_attr=param_attr,
+                    bias_attr=bias_attr,
+                    num_flatten_dims=2 if ndim == 3 else 1)
+
+    return Layer("fc", ins, build, name=name)
+
+
+def embedding(input, size: int, param_attr=None, name=None, **kw) -> Layer:
+    def build(ctx, parents):
+        dict_size = input.input_type.dim if input.input_type else None
+        if dict_size is None:
+            raise ValueError("v2 embedding needs an integer data layer input")
+        return F.embedding(parents[0], size=[dict_size, size],
+                           param_attr=param_attr)
+
+    return Layer("embedding", [input], build, name=name)
+
+
+def pooling(input, pooling_type=pooling_mod.Max, name=None, **kw) -> Layer:
+    ptype = pooling_type.name if hasattr(pooling_type, "name") else str(pooling_type)
+
+    def build(ctx, parents):
+        length = _seq_length(layer, ctx)
+        return F.sequence_pool(parents[0], ptype, length=length)
+
+    layer = Layer("pooling", [input], build, name=name)
+    return layer
+
+
+def lstmemory(input, size: Optional[int] = None, reverse: bool = False,
+              name=None, **kw) -> Layer:
+    """<- v2 lstmemory: input is the gate projection [N, T, 4H] (pair with a
+    4*size fc, as in the reference) OR any sequence feature, in which case
+    the projection fc is inserted."""
+
+    def build(ctx, parents):
+        x = parents[0]
+        h = size
+        if h is None:
+            if x.shape is None or x.shape[-1] % 4 != 0:
+                raise ValueError("lstmemory needs size= or a [.,.,4H] input")
+            h = x.shape[-1] // 4
+        if x.shape is not None and x.shape[-1] != 4 * h:
+            x = F.fc(x, size=4 * h, num_flatten_dims=2, bias_attr=False)
+        length = _seq_length(layer, ctx)
+        hidden, _cell = F.dynamic_lstm(x, size=h, length=length,
+                                       is_reverse=reverse)
+        return hidden
+
+    layer = Layer("lstmemory", [input], build, name=name)
+    return layer
+
+
+def gru(input, size: int, reverse: bool = False, name=None, **kw) -> Layer:
+    def build(ctx, parents):
+        x = parents[0]
+        if x.shape is None or x.shape[-1] != 3 * size:
+            x = F.fc(x, size=3 * size, num_flatten_dims=2, bias_attr=False)
+        length = _seq_length(layer, ctx)
+        return F.dynamic_gru(x, size=size, length=length, is_reverse=reverse)
+
+    layer = Layer("gru", [input], build, name=name)
+    return layer
+
+
+def concat(input: Sequence[Layer], name=None, **kw) -> Layer:
+    def build(ctx, parents):
+        return F.concat(list(parents), axis=-1)
+
+    return Layer("concat", list(input), build, name=name)
+
+
+def dropout(input, dropout_rate: float = 0.5, name=None, **kw) -> Layer:
+    def build(ctx, parents):
+        return F.dropout(parents[0], dropout_prob=dropout_rate)
+
+    return Layer("dropout", [input], build, name=name)
+
+
+def max_id(input, name=None, **kw) -> Layer:
+    def build(ctx, parents):
+        return F.argmax(parents[0], axis=-1)
+
+    return Layer("max_id", [input], build, name=name)
+
+
+# --- costs ------------------------------------------------------------------
+
+
+def classification_cost(input, label, name=None, **kw) -> Layer:
+    """softmax classifier cost (<- v2 classification_cost): the input layer
+    should already end in Softmax activation (as in the reference)."""
+
+    def build(ctx, parents):
+        pred, lab = parents
+        return F.mean(F.cross_entropy(pred, lab))
+
+    return Layer("classification_cost", [input, label], build, name=name)
+
+
+cross_entropy_cost = classification_cost
+
+
+def square_error_cost(input, label, name=None, **kw) -> Layer:
+    def build(ctx, parents):
+        return F.mean(F.square_error_cost(parents[0], parents[1]))
+
+    return Layer("square_error_cost", [input, label], build, name=name)
+
+
+mse_cost = square_error_cost

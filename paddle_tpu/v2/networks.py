@@ -1,0 +1,33 @@
+"""<- python/paddle/v2/networks.py (trainer_config_helpers/networks.py):
+canned sub-networks built from the layer DSL."""
+from __future__ import annotations
+
+from . import activation, pooling
+from . import layer as L
+
+
+def simple_lstm(input, size: int, reverse: bool = False, **kw):
+    """fc(4*size) + lstmemory (<- networks.simple_lstm)."""
+    proj = L.fc(input, size=size * 4, act=None, bias_attr=False)
+    return L.lstmemory(proj, size=size, reverse=reverse)
+
+
+def simple_gru(input, size: int, reverse: bool = False, **kw):
+    proj = L.fc(input, size=size * 3, act=None, bias_attr=False)
+    return L.gru(proj, size=size, reverse=reverse)
+
+
+def sequence_conv_pool(input, context_len: int, hidden_size: int,
+                       pool_type=pooling.Max, **kw):
+    """embedding-sequence -> fc window approx of context conv -> pool
+    (<- networks.sequence_conv_pool role for text classifiers)."""
+    conv = L.fc(input, size=hidden_size, act=activation.Tanh())
+    return L.pooling(conv, pooling_type=pool_type)
+
+
+def bidirectional_lstm(input, size: int, return_concat: bool = True, **kw):
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_concat:
+        return L.concat([fwd, bwd])
+    return fwd, bwd

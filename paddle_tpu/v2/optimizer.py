@@ -1,0 +1,35 @@
+"""<- python/paddle/v2/optimizer.py: thin wrappers selecting the Fluid-
+equivalent optimizer (the reference wrapped the C++ swig optimizers)."""
+from __future__ import annotations
+
+from .. import optimizer as fl_opt
+
+
+class _V2Optimizer:
+    def __init__(self, inner):
+        self.inner = inner
+
+
+def Momentum(momentum=0.9, learning_rate=1e-3, regularization=None,
+             model_average=None, **kw):
+    return _V2Optimizer(fl_opt.Momentum(learning_rate=learning_rate,
+                                        momentum=momentum))
+
+
+def Adam(learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+    return _V2Optimizer(fl_opt.Adam(learning_rate=learning_rate, beta1=beta1,
+                                    beta2=beta2, epsilon=epsilon))
+
+
+def AdaGrad(learning_rate=1e-3, epsilon=1e-6, **kw):
+    return _V2Optimizer(fl_opt.Adagrad(learning_rate=learning_rate,
+                                       epsilon=epsilon))
+
+
+def RMSProp(learning_rate=1e-3, rho=0.95, epsilon=1e-6, **kw):
+    return _V2Optimizer(fl_opt.RMSProp(learning_rate=learning_rate, rho=rho,
+                                       epsilon=epsilon))
+
+
+def SGDOptimizer(learning_rate=1e-3, **kw):
+    return _V2Optimizer(fl_opt.SGD(learning_rate=learning_rate))

@@ -1,0 +1,84 @@
+"""<- python/paddle/v2/parameters.py: dict-like parameter pool created from
+a topology; get/set numpy values, serialize to tar-like dirs."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Parameters:
+    """Holds the startup program + scope behind the v2 surface."""
+
+    def __init__(self, main, startup, scope, executor):
+        self._main = main
+        self._startup = startup
+        self.scope = scope
+        self._exe = executor
+
+    def names(self) -> List[str]:
+        """Model parameters only — optimizer accumulators and LR counters
+        are persistable too but are not part of the v2 parameter pool."""
+        return [v.name for v in self._main.list_vars()
+                if v.persistable and getattr(v, "_param_attr", None) is not None]
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def get(self, name: str) -> np.ndarray:
+        v = self.scope.get(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+    __getitem__ = get
+
+    def set(self, name: str, value: np.ndarray) -> None:
+        self.scope.set(name, np.asarray(value))
+
+    __setitem__ = set
+
+    def to_tar(self, f) -> None:
+        """Serialize all parameters into an npz stream (tar role)."""
+        np.savez(f, **{n: self.get(n) for n in self.names()})
+
+    @staticmethod
+    def from_tar(f) -> Dict[str, np.ndarray]:
+        data = np.load(f)
+        return {k: data[k] for k in data.files}
+
+    def init_from_tar(self, f) -> None:
+        for k, v in Parameters.from_tar(f).items():
+            if self.scope.get(k) is not None:
+                self.set(k, v)
+
+
+def create(cost_or_layers) -> "LazyParameters":
+    """<- paddle.v2.parameters.create(topology): defers materialization to
+    the trainer (which owns the program build), recording the request."""
+    return LazyParameters(cost_or_layers)
+
+
+class LazyParameters:
+    def __init__(self, outputs):
+        self.outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        self.materialized: Optional[Parameters] = None
+        self._pending_tar = None
+
+    def init_from_tar(self, f):
+        if self.materialized is not None:
+            self.materialized.init_from_tar(f)
+        else:
+            self._pending_tar = Parameters.from_tar(f)
+
+    def __getattr__(self, item):
+        m = self.__dict__.get("materialized")
+        if m is not None:
+            return getattr(m, item)
+        raise AttributeError(
+            f"Parameters not materialized yet (build a trainer first): {item}")
+
+    def __getitem__(self, name):
+        if self.materialized is None:
+            raise KeyError("Parameters not materialized yet")
+        return self.materialized[name]

@@ -1,0 +1,13 @@
+"""<- python/paddle/v2/pooling.py: sequence pooling type markers."""
+
+
+class Max:
+    name = "MAX"
+
+
+class Avg:
+    name = "AVERAGE"
+
+
+class Sum:
+    name = "SUM"

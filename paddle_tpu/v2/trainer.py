@@ -1,0 +1,124 @@
+"""<- python/paddle/v2/trainer.py:37 SGD: build the topology, run passes
+over a reader with event callbacks (train :137, test :217)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.executor import Executor, Scope
+from ..core.ir import program_guard
+from . import event as v2_event
+from .layer import Layer, to_program
+from .parameters import LazyParameters, Parameters
+
+
+def _pad_sequences(col, maxlen):
+    ids = np.zeros((len(col), maxlen), np.int64)
+    lens = np.zeros((len(col),), np.int32)
+    for i, seq in enumerate(col):
+        seq = list(seq)[:maxlen]
+        ids[i, : len(seq)] = seq
+        lens[i] = len(seq)
+    return ids, lens
+
+
+def make_feed(ctx, batch: Sequence, feeding: Optional[Dict[str, int]] = None):
+    """Convert one v2-style minibatch (list of sample tuples) into the dense
+    feed dict the executor takes (<- v2 DataFeeder / py_paddle feeding)."""
+    data_layers = ctx.data_layers
+    if feeding is None:
+        feeding = {l.name: i for i, l in enumerate(data_layers)}
+    cols = list(zip(*batch))
+    feed = {}
+    for l in data_layers:
+        col = cols[feeding[l.name]]
+        t = l.input_type
+        if t.kind == "dense":
+            feed[l.name] = np.asarray(col, np.float32).reshape(len(col), t.dim)
+        elif t.kind == "int":
+            feed[l.name] = np.asarray(col, np.int64).reshape(len(col), 1)
+        elif t.kind == "int_seq":
+            maxlen = t.seq_len or 128
+            ids, lens = _pad_sequences(col, maxlen)
+            feed[l.name] = ids
+            feed[l.name + "@len"] = lens
+        elif t.kind == "dense_seq":
+            maxlen = t.seq_len or 128
+            dense = np.zeros((len(col), maxlen, t.dim), np.float32)
+            lens = np.zeros((len(col),), np.int32)
+            for i, seq in enumerate(col):
+                seq = np.asarray(seq, np.float32)[:maxlen]
+                dense[i, : len(seq)] = seq
+                lens[i] = len(seq)
+            feed[l.name] = dense
+            feed[l.name + "@len"] = lens
+    return feed
+
+
+class SGD:
+    """v2 trainer facade over the XLA executor."""
+
+    def __init__(self, cost: Layer, parameters: LazyParameters,
+                 update_equation=None, extra_layers: Optional[Sequence[Layer]] = None,
+                 is_local: bool = True, place=None):
+        from . import optimizer as v2_opt
+
+        outputs = [cost] + list(extra_layers or [])
+        self.cost_layer = cost
+        (self.main, self.startup, outs, self.feed_order, self._ctx) = (
+            to_program(outputs))
+        self.cost_var = outs[0]
+        if update_equation is None:
+            update_equation = v2_opt.SGDOptimizer(learning_rate=1e-3)
+        inner_opt = getattr(update_equation, "inner", update_equation)
+        with program_guard(self.main, self.startup):
+            inner_opt.minimize(self.cost_var, self.startup)
+        self.test_program = None  # built lazily from a clone pre-optimizer
+
+        self.scope = Scope()
+        self.exe = Executor(place) if place is not None else Executor()
+        self.exe.run(self.startup, scope=self.scope, seed=0)
+        parameters.materialized = Parameters(self.main, self.startup,
+                                             self.scope, self.exe)
+        if parameters._pending_tar:
+            for k, v in parameters._pending_tar.items():
+                if self.scope.get(k) is not None:
+                    parameters.materialized.set(k, v)
+        self.parameters = parameters
+
+    # -- feeding -------------------------------------------------------------
+    def _make_feed(self, batch: Sequence, feeding: Optional[Dict[str, int]]):
+        return make_feed(self._ctx, batch, feeding)
+
+    # -- train/test ----------------------------------------------------------
+    def train(self, reader: Callable, num_passes: int = 1,
+              event_handler: Optional[Callable] = None, feeding=None):
+        event_handler = event_handler or (lambda e: None)
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            batch_id = 0
+            for batch in reader():
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = self._make_feed(batch, feeding)
+                cost, = self.exe.run(self.main, feed=feed,
+                                     fetch_list=[self.cost_var],
+                                     scope=self.scope)
+                event_handler(v2_event.EndIteration(pass_id, batch_id,
+                                                    float(np.mean(cost))))
+                batch_id += 1
+            event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader: Callable, feeding=None) -> v2_event.TestResult:
+        if self.test_program is None:
+            self.test_program = self.main.clone(for_test=True)
+        costs: List[float] = []
+        for batch in reader():
+            feed = self._make_feed(batch, feeding)
+            cost, = self.exe.run(self.test_program, feed=feed,
+                                 fetch_list=[self.cost_var], scope=self.scope)
+            costs.append(float(np.mean(cost)))
+        return v2_event.TestResult(cost=float(np.mean(costs)) if costs else 0.0)
+
+    def save_parameter_to_tar(self, f):
+        self.parameters.materialized.to_tar(f)

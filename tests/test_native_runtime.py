@@ -166,3 +166,13 @@ def test_native_loader_corrupt_shard_raises(tmp_path):
     with pytest.raises(IOError, match="crc"):
         for _ in NativeBatchLoader(files, [6], batch_size=4):
             pass
+
+
+def test_native_loader_reiterates_for_epochs(tmp_path):
+    """Epoch loops over one loader see the full dataset every epoch."""
+    files, ids = _write_shards(tmp_path, n_files=1, per_file=8, width=4)
+    loader = NativeBatchLoader(files, [4], batch_size=4)
+    for epoch in range(3):
+        got = np.concatenate([b[:, 0] for b in loader]).astype(int).tolist()
+        assert got == ids, f"epoch {epoch} lost data"
+    loader.close()

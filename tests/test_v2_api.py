@@ -1,0 +1,184 @@
+"""v2 API layer (<- python/paddle/v2 tests: layer DSL -> topology ->
+SGD.train with events -> infer), running on the XLA executor."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+
+def _xor_reader():
+    """Learnable 2-feature task."""
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(256):
+            x = rng.rand(2).astype("float32")
+            y = int((x[0] > 0.5) != (x[1] > 0.5))
+            yield x, y
+
+    return reader
+
+
+def test_v2_train_classifier_and_infer():
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(2))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    hidden = paddle.layer.fc(x, size=16, act=paddle.activation.Tanh())
+    hidden2 = paddle.layer.fc(hidden, size=16, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(hidden2, size=2, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    opt = paddle.optimizer.Adam(learning_rate=0.05)
+    import paddle_tpu as fluid
+
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=opt,
+                                 place=fluid.CPUPlace())
+    costs = []
+    trainer.train(
+        paddle.batch(_xor_reader(), batch_size=32),
+        num_passes=12,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+    )
+    assert costs[-1] < costs[0] * 0.6
+
+    result = trainer.test(paddle.batch(_xor_reader(), batch_size=32))
+    assert result.cost < costs[0]
+
+    # infer on a fresh program with the trained parameters
+    probe = [((0.9, 0.1), 1), ((0.1, 0.12), 0), ((0.2, 0.8), 1)]
+    out = paddle.infer(output_layer=pred, parameters=params,
+                       input=probe, feeding={"x": 0, "label": 1},
+                       place=fluid.CPUPlace())
+    assert out.shape == (3, 2)
+    assert np.argmax(out[0]) == 1 and np.argmax(out[1]) == 0
+
+    # parameter pool surface
+    names = params.names()
+    assert len(names) == 6
+    blob = io.BytesIO()
+    params.to_tar(blob)
+    blob.seek(0)
+    params2 = paddle.parameters.create(cost)
+    params2.init_from_tar(blob)  # pre-materialization: stashed
+
+
+def test_v2_sequence_classifier():
+    """integer_value_sequence -> embedding -> simple_lstm -> pooling."""
+    rng = np.random.RandomState(1)
+    V, L = 50, 12
+
+    def reader():
+        for _ in range(128):
+            n = rng.randint(4, L + 1)
+            # class = whether first token is even
+            ids = rng.randint(0, V, n)
+            yield list(ids), int(ids[0] % 2)
+
+    import paddle_tpu as fluid
+    from paddle_tpu.v2 import networks
+
+    seq = paddle.layer.data(
+        "words", paddle.data_type.integer_value_sequence(V, seq_len=L))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(seq, size=16)
+    lstm = networks.simple_lstm(emb, size=16)
+    pooled = paddle.layer.pooling(lstm, pooling_type=paddle.pooling.Max)
+    pred = paddle.layer.fc(pooled, size=2, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=paddle.optimizer.Adam(
+                                     learning_rate=0.02),
+                                 place=fluid.CPUPlace())
+    costs = []
+    trainer.train(paddle.batch(reader, batch_size=32), num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0]
+
+
+def test_flags_and_nan_check():
+    import paddle_tpu as fluid
+
+    fluid.set_flag("check_nan_inf", True)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2], dtype="float32")
+            out = fluid.layers.log(x)  # log(-1) -> NaN
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(FloatingPointError, match="NaN"):
+            exe.run(main, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                    fetch_list=[out.name])
+        # clean inputs pass
+        r, = exe.run(main, feed={"x": np.array([[1.0, 2.0]], "float32")},
+                     fetch_list=[out.name])
+        assert np.isfinite(r).all()
+    finally:
+        fluid.set_flag("check_nan_inf", False)
+    # init_gflags parses --flag=value and returns the rest
+    rest = fluid.init_gflags(["--benchmark=false", "--not-a-flag=1", "prog"])
+    assert rest == ["--not-a-flag=1", "prog"]
+    assert fluid.get_flag("benchmark") is False
+
+
+def test_debugger_graphviz_and_pprint(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu.debugger import draw_block_graphviz, pprint_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        ten = fluid.layers.fill_constant([1], "int64", 5)
+        cond_v = fluid.layers.less_than(i, ten)
+        w = fluid.layers.While(cond_v)
+        with w.block():
+            i2 = fluid.layers.increment(i)
+            fluid.layers.assign(i2, i)
+            fluid.layers.assign(fluid.layers.less_than(i2, ten), cond_v)
+    dot = draw_block_graphviz(main.global_block(),
+                              path=str(tmp_path / "g.dot"))
+    text = open(dot).read()
+    assert "digraph" in text and "fc" not in text or "mul" in text or "while" in text
+    assert "subgraph cluster" in text  # the while body renders nested
+    dump = pprint_program(main)
+    assert "block 0" in dump and "while" in dump
+
+
+def test_v2_infer_uses_trained_weights():
+    """Rebuilding the DAG for infer must reuse the SAME parameter names so
+    trained values actually transfer (regression: fresh-init inference)."""
+    import paddle_tpu as fluid
+
+    rng = np.random.RandomState(3)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    label = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=paddle.optimizer.Adam(0.1),
+                                 place=fluid.CPUPlace())
+
+    def reader():
+        for _ in range(64):
+            xv = rng.rand(4).astype("float32")
+            yield xv, [xv.sum()]
+
+    probe = [((1.0, 1.0, 1.0, 1.0), (0.0,))]
+    before = paddle.infer(output_layer=pred, parameters=params, input=probe,
+                          place=fluid.CPUPlace())
+    trainer.train(paddle.batch(reader, batch_size=16), num_passes=20)
+    after = paddle.infer(output_layer=pred, parameters=params, input=probe,
+                        place=fluid.CPUPlace())
+    assert not np.allclose(before, after), "infer ignored training"
+    # 80 Adam steps get near (not exactly at) sum()=4; fresh init sits ~0
+    assert abs(float(after[0, 0]) - 4.0) < 1.0
