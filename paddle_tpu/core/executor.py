@@ -192,8 +192,10 @@ class Executor:
         self.place = place or default_place()
         self.amp = amp
         self._device = self.place.jax_device()
+        from ..flags import get_flag
+
         self._cache: Dict[Any, Any] = {}
-        self._cache_capacity = 32
+        self._cache_capacity = int(get_flag("executor_cache_capacity"))
         self._step_seed = 0
 
     # -- public API --
@@ -228,12 +230,17 @@ class Executor:
         cache_key = (id(program), program.version, block_idx, sig,
                      tuple(fetch_names), self.amp)
 
+        from ..flags import get_flag
         from ..profiler import RecordEvent  # lazy: profiler imports jax
 
         entry = self._cache.get(cache_key)
         if entry is None:
+            t_c = time.perf_counter()
             with RecordEvent("executor_compile"):
                 entry = self._compile(program, block_idx, feed_names, fetch_names, sig)
+            if get_flag("log_compile"):
+                print(f"[compile] block{block_idx} sig={sig} "
+                      f"{time.perf_counter() - t_c:.3f}s", flush=True)
             self._cache[cache_key] = entry
             # bounded LRU: mutating a program between runs (append_backward in
             # a loop, etc.) would otherwise accumulate stale executables
@@ -264,8 +271,6 @@ class Executor:
         # the reference's per-op RecordEvent in the interpreter hot loop
         # (operator.cc RunImpl); ops fused into one XLA program leave only
         # block-granularity host events, finer grain lives in device traces
-        from ..flags import get_flag
-
         benchmark = get_flag("benchmark")
         t0 = time.perf_counter() if benchmark else 0.0
         with RecordEvent(f"executor_run/block{block_idx}"):
@@ -294,7 +299,11 @@ class Executor:
             (n, new_state[n]) for n in state_out_names
         ]:
             arr = np.asarray(v)
-            if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+            # ml_dtypes floats (bfloat16/float8) report kind 'V', and the AMP
+            # path is exactly where NaN scans matter most
+            is_float = (arr.dtype.kind == "f"
+                        or arr.dtype.name.startswith(("bfloat", "float8")))
+            if is_float and not np.all(np.isfinite(arr)):
                 raise FloatingPointError(
                     f"check_nan_inf: variable {name!r} contains NaN/Inf "
                     f"(first bad index {np.argwhere(~np.isfinite(arr))[0].tolist()})"

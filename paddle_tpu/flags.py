@@ -22,11 +22,9 @@ _DEFAULTS: Dict[str, Any] = {
     "benchmark": False,
     # compiled-program cache entries per Executor (<- the reference's program
     # cache, executor.py:204)
-    "executor_cache_capacity": 64,
-    # host staging arena budget for native loaders (<- the role
-    # FLAGS_fraction_of_gpu_memory_to_use played for the GPU pool)
-    "host_arena_bytes": 1 << 28,
-    # print an XLA cost-analysis summary at compile time
+    "executor_cache_capacity": 32,
+    # print a one-line summary (block, feed signature, compile seconds) every
+    # time a program (re)compiles — retrace-storm debugging
     "log_compile": False,
 }
 

@@ -110,24 +110,34 @@ class NativeBatchLoader:
         self.record_shape = tuple(int(s) for s in record_shape)
         self.record_bytes = int(np.prod(self.record_shape)) * self.dtype.itemsize
         self.batch_size = batch_size
-        self._open_args = (
-            "\n".join(os.fspath(f) for f in files).encode(),
-            self.record_bytes, batch_size, shuffle_buf, seed, capacity,
-            int(drop_last), arena_bytes)
+        self._paths = "\n".join(os.fspath(f) for f in files).encode()
+        self._seed = seed
+        self._shuffle_buf = shuffle_buf
+        self._capacity = capacity
+        self._drop_last = int(drop_last)
+        self._arena_bytes = arena_bytes
         self._files = list(files)
-        self._h = self._lib.dio_pipeline_open(*self._open_args)
-        if not self._h:
-            raise IOError(f"cannot open native pipeline over {self._files!r}")
+        self._epoch = 0
+        self._h = self._open(seed)
         self._consumed = False
+
+    def _open(self, seed):
+        h = self._lib.dio_pipeline_open(
+            self._paths, self.record_bytes, self.batch_size, self._shuffle_buf,
+            seed, self._capacity, self._drop_last, self._arena_bytes)
+        if not h:
+            raise IOError(f"cannot open native pipeline over {self._files!r}")
+        return h
 
     def __iter__(self) -> Iterator[np.ndarray]:
         # the C++ pipeline is one-shot; transparently re-open for each fresh
-        # iteration so epoch loops see the full dataset every time
+        # iteration so epoch loops see the full dataset every time, with a
+        # per-epoch seed so shuffled order differs across passes (the
+        # reference's per-pass reshuffle semantics)
         if self._consumed:
             self.close()
-            self._h = self._lib.dio_pipeline_open(*self._open_args)
-            if not self._h:
-                raise IOError(f"cannot re-open native pipeline over {self._files!r}")
+            self._epoch += 1
+            self._h = self._open(self._seed + self._epoch)
         self._consumed = True
         count = ctypes.c_uint32(0)
         while True:

@@ -18,6 +18,9 @@ def infer(output_layer, parameters, input: Sequence, feeding=None,
     outputs = (output_layer if isinstance(output_layer, (list, tuple))
                else [output_layer])
     main, startup, outs, feed_order, ctx = to_program(list(outputs))
+    # inference must run in test mode (dropout off etc.) — same
+    # clone(for_test=True) step SGD.test() takes
+    main = main.clone(for_test=True)
 
     scope = Scope()
     exe = Executor(place) if place is not None else Executor()

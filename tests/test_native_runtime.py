@@ -176,3 +176,14 @@ def test_native_loader_reiterates_for_epochs(tmp_path):
         got = np.concatenate([b[:, 0] for b in loader]).astype(int).tolist()
         assert got == ids, f"epoch {epoch} lost data"
     loader.close()
+
+
+def test_native_loader_epoch_reshuffle(tmp_path):
+    """Shuffled epochs see different orders but the same multiset."""
+    files, ids = _write_shards(tmp_path, n_files=2, per_file=32, width=4)
+    loader = NativeBatchLoader(files, [4], batch_size=8, shuffle_buf=32, seed=5)
+    e1 = np.concatenate([b[:, 0] for b in loader]).astype(int).tolist()
+    e2 = np.concatenate([b[:, 0] for b in loader]).astype(int).tolist()
+    assert sorted(e1) == sorted(e2) == sorted(ids)
+    assert e1 != e2  # per-epoch reshuffle
+    loader.close()
