@@ -44,6 +44,11 @@ class ExecContext:
     def __init__(self, key=None, block_runner=None, is_test: bool = False,
                  amp: bool = False):
         self._key = key
+        # the step's base key, NOT advanced by next_key: ops that must see
+        # identical randomness in their forward and grad invocations (e.g.
+        # recompute segments) fold a static op tag into this instead of
+        # consuming the sequential chain
+        self.base_key = key
         self.block_runner = block_runner
         self.is_test = is_test
         # auto-mixed-precision: matmul/conv kernels compute in bf16 with f32

@@ -843,3 +843,58 @@ __all__ += [
     "lod_tensor_to_array", "array_to_lod_tensor", "split_lod_tensor",
     "merge_lod_tensor", "shrink_memory", "Print",
 ]
+
+
+class recompute(_BlockGuard):
+    """Rematerialization region (the jax.checkpoint re-imagining of
+    transpiler/memory_optimization_transpiler.py)::
+
+        with layers.recompute():
+            h = layers.fc(x, 512, act="relu")
+            h = layers.fc(h, 512, act="relu")
+        pred = layers.fc(h, 10, act="softmax")
+
+    Everything inside the region is compiled as one checkpointed segment:
+    its activations are dropped after the forward and recomputed during the
+    backward pass — trading FLOPs for HBM, the TPU-native memory
+    optimization the reference approximated with liveness-based var reuse.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        from ..core.ir import default_main_program
+
+        self.program = default_main_program()
+        super().__init__(self.program)
+
+    def __enter__(self):
+        self.parent = self.program.current_block()
+        super().__enter__()  # pushes a fresh sub-block
+        self.sub = self.program.current_block()
+        return self
+
+    def __exit__(self, exc_type, *a):
+        super().__exit__(exc_type, *a)
+        if exc_type is not None:
+            return False
+        sub, parent = self.sub, self.parent
+        reads, writes = _block_reads_writes(sub)
+        hold = _outer_names(reads, sub, parent)
+        # surface every segment-produced var to the parent so downstream
+        # layers resolve names and shapes exactly as if the ops ran inline
+        for n in writes:
+            sv = sub.vars.get(n)
+            if sv is not None and not parent.has_var(n):
+                parent.create_var(n, dtype=sv.dtype, shape=sv.shape,
+                                  stop_gradient=sv.stop_gradient)
+        op = parent.append_op(
+            "recompute",
+            {"Hold": hold},
+            {"Out": list(writes)},
+            {"sub_block": sub.idx, "hold_names": hold,
+             "out_names": list(writes)},
+        )
+        infer_and_create_outputs(op, parent)
+        return False
+
+
+__all__ += ["recompute"]

@@ -653,3 +653,31 @@ def random_crop(x, shape, seed=None, name: Optional[str] = None):
                      {"Out": [out], "SeedOut": [seed_out]},
                      {"shape": list(shape)})
     return out
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    q_block: int = 128, k_block: int = 128,
+                    name: Optional[str] = None):
+    """Fused attention over [N, T, H, D] tensors (Pallas kernel on TPU,
+    blockwise-fallback elsewhere; ops/pallas_attention.py). The reference
+    had no attention op at all — its transformer benchmark composed
+    matmul+softmax (test_parallel_executor_transformer.py); this is the
+    TPU-native fusion of that pattern."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "flash_attention", {"Q": [q], "K": [k], "V": [v]}, {"Out": [out]},
+        {"causal": causal, "scale": scale, "q_block": q_block,
+         "k_block": k_block},
+    )
+    return out
+
+
+def slice(input, axes, starts, ends, name: Optional[str] = None):
+    """<- layers slice / slice_op.cc."""
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", {"Input": [input]}, {"Out": [out]},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends)})
+    return out

@@ -19,3 +19,8 @@ from .book import (  # noqa: F401
     understand_sentiment_stacked_lstm,
     word2vec,
 )
+from .transformer import (  # noqa: F401
+    multi_head_attention,
+    transformer_encoder,
+    transformer_lm,
+)

@@ -1,0 +1,137 @@
+"""Transformer family (<- the reference's transformer benchmark,
+python/paddle/fluid/tests/unittests/test_parallel_executor_transformer.py +
+benchmark models/machine_translation.py context).
+
+The reference had no attention op — its transformer composed matmul+softmax
+primitives per head. TPU-native design: QKV projections are single fused
+MXU matmuls, attention runs through the ``flash_attention`` op (Pallas
+kernel on TPU, blockwise fallback elsewhere), and long sequences can swap in
+ring attention over an 'sp' mesh axis (parallel/context_parallel.py).
+Tensor-parallel FFN/attention shardings come from ``ParamAttr(sharding=...)``
+as in the other model families.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+from ..initializer import NumpyArrayInitializer
+
+
+def _pos_encoding_table(max_len: int, d_model: int) -> np.ndarray:
+    """Sinusoidal position encoding (Vaswani et al.)."""
+    pos = np.arange(max_len)[:, None].astype("float64")
+    i = np.arange(d_model)[None, :].astype("float64")
+    angle = pos / np.power(10000.0, 2 * (i // 2) / d_model)
+    table = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+    return table.astype("float32")
+
+
+def multi_head_attention(q_in, kv_in, d_model: int, n_heads: int,
+                         causal: bool = False, name: str = "mha",
+                         tp_shard: bool = False):
+    """Projections -> flash_attention -> output projection.
+
+    q_in/kv_in: [N, T, d_model]. With ``tp_shard`` the head projections are
+    column-sharded and the output projection row-sharded over the 'tp' mesh
+    axis (Megatron layout: the all-reduce lands after the output matmul).
+    """
+    assert d_model % n_heads == 0
+    d_head = d_model // n_heads
+
+    def attr(suffix, shard):
+        return ParamAttr(f"{name}.{suffix}", sharding=shard if tp_shard else None)
+
+    row = attr("out.w", ("tp", None))
+    q = layers.fc(q_in, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=attr("q.w", (None, "tp")))
+    k = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=attr("k.w", (None, "tp")))
+    v = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=attr("v.w", (None, "tp")))
+    t = q_in.shape[1]
+    qh = layers.reshape(q, [0, t, n_heads, d_head])
+    kh = layers.reshape(k, [0, kv_in.shape[1], n_heads, d_head])
+    vh = layers.reshape(v, [0, kv_in.shape[1], n_heads, d_head])
+    ctx = layers.flash_attention(qh, kh, vh, causal=causal)
+    ctx = layers.reshape(ctx, [0, t, d_model])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=row)
+
+
+def _ffn(x, d_model: int, d_ff: int, name: str, tp_shard: bool = False):
+    up = ParamAttr(f"{name}.up.w", sharding=(None, "tp")) if tp_shard else \
+        ParamAttr(f"{name}.up.w")
+    down = ParamAttr(f"{name}.down.w", sharding=("tp", None)) if tp_shard else \
+        ParamAttr(f"{name}.down.w")
+    h = layers.fc(x, size=d_ff, num_flatten_dims=2, act="relu", param_attr=up)
+    return layers.fc(h, size=d_model, num_flatten_dims=2, param_attr=down)
+
+
+def encoder_layer(x, d_model: int, n_heads: int, d_ff: int, causal: bool,
+                  name: str, tp_shard: bool = False, use_recompute: bool = False):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x))."""
+
+    def body(x):
+        a = layers.layer_norm(x, begin_norm_axis=2)
+        a = multi_head_attention(a, a, d_model, n_heads, causal=causal,
+                                 name=f"{name}.attn", tp_shard=tp_shard)
+        x = layers.elementwise_add(x, a)
+        f = layers.layer_norm(x, begin_norm_axis=2)
+        f = _ffn(f, d_model, d_ff, f"{name}.ffn", tp_shard=tp_shard)
+        return layers.elementwise_add(x, f)
+
+    if use_recompute:
+        with layers.recompute():
+            out = body(x)
+        return out
+    return body(x)
+
+
+def transformer_lm(ids, labels, vocab_size: int, max_len: int,
+                   d_model: int = 128, n_heads: int = 4, n_layers: int = 2,
+                   d_ff: int = 512, tp_shard: bool = False,
+                   use_recompute: bool = False):
+    """Decoder-only (causal) language model.
+
+    ids/labels: [N, T] int64 with T <= max_len (labels = ids shifted by
+    one). Returns (logits [N, T, V], avg_loss).
+    """
+    from ..layer_helper import LayerHelper
+
+    t = int(ids.shape[1])
+    assert t <= max_len, f"sequence length {t} exceeds max_len {max_len}"
+    emb = layers.embedding(ids, size=[vocab_size, d_model],
+                           param_attr=ParamAttr("tlm.emb"))
+    # positions broadcast over the batch: [1, max_len, D] parameter
+    # initialized to the sinusoidal table (learnable, as most modern LMs do),
+    # sliced to the actual sequence length
+    helper = LayerHelper("tlm_pos")
+    pos = helper.create_parameter(
+        ParamAttr("tlm.pos", initializer=NumpyArrayInitializer(
+            _pos_encoding_table(max_len, d_model)[None])),
+        [1, max_len, d_model], "float32")
+    if t < max_len:
+        pos = layers.slice(pos, axes=[1], starts=[0], ends=[t])
+    x = layers.elementwise_add(emb, pos)
+    for i in range(n_layers):
+        x = encoder_layer(x, d_model, n_heads, d_ff, causal=True,
+                          name=f"tlm.l{i}", tp_shard=tp_shard,
+                          use_recompute=use_recompute)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr("tlm.out.w"))
+    labels3 = layers.reshape(labels, [0, t, 1])
+    loss = layers.softmax_with_cross_entropy(logits, labels3)
+    avg_loss = layers.reduce_mean(loss)
+    return logits, avg_loss
+
+
+def transformer_encoder(x, n_layers: int, d_model: int, n_heads: int,
+                        d_ff: int, name: str = "enc", tp_shard: bool = False):
+    """Bidirectional encoder stack over [N, T, d_model] features."""
+    for i in range(n_layers):
+        x = encoder_layer(x, d_model, n_heads, d_ff, causal=False,
+                          name=f"{name}.l{i}", tp_shard=tp_shard)
+    return layers.layer_norm(x, begin_norm_axis=2)

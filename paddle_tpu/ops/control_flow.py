@@ -76,8 +76,14 @@ def _scalar_bool(x):
 
 
 def _sub_ctx(ctx: ExecContext, key) -> ExecContext:
-    return ExecContext(key=key, block_runner=ctx.block_runner,
-                       is_test=ctx.is_test, amp=ctx.amp)
+    sub = ExecContext(key=key, block_runner=ctx.block_runner,
+                      is_test=ctx.is_test, amp=ctx.amp)
+    # nested blocks inside a recompute segment inherit the remat marker
+    # (pallas fallbacks must hold through while/cond bodies too) and the
+    # step's base key (so fold_in-derived randomness stays fwd/grad-stable)
+    sub.in_remat = getattr(ctx, "in_remat", False)
+    sub.base_key = getattr(ctx, "base_key", None)
+    return sub
 
 
 # ---------------------------------------------------------------------------
@@ -272,3 +278,50 @@ def array_read(ctx, ins, attrs):
 @register_op("array_length", inputs=("Len",), outputs=("Out",), no_grad=True)
 def array_length(ctx, ins, attrs):
     return {"Out": [jnp.reshape(ins["Len"][0], ()).astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# recompute: rematerialized segment (jax.checkpoint) — the TPU-native form of
+# the reference's memory_optimization_transpiler (activations of the segment
+# are NOT kept for backward; they are recomputed from the segment inputs,
+# trading MXU FLOPs for HBM).
+# ---------------------------------------------------------------------------
+
+
+@register_op("recompute", inputs=("Hold",), outputs=("Out",),
+             diff_inputs=("Hold",))
+def recompute_op(ctx, ins, attrs):
+    """Run ``sub_block`` under jax.checkpoint.
+
+    attrs: sub_block, hold_names (segment inputs, read from outside),
+    out_names (vars the segment produces, surfaced to the parent).
+    The grad op is the default vjp of this kernel — vjp of a checkpointed
+    function re-executes the segment on backward, and prevent_cse stops XLA
+    from folding that recompute back into the stored forward.
+    """
+    hold_names = list(attrs["hold_names"])
+    out_names = list(attrs["out_names"])
+    runner = ctx.block_runner
+    sub_idx = attrs["sub_block"]
+    # the segment key must be IDENTICAL in the forward op and in the grad
+    # op's vjp replay (both re-run this kernel in the same trace) — consuming
+    # ctx.next_key() would hand them different positions of the sequential
+    # chain and stochastic segment ops (dropout) would use different masks
+    # for loss vs gradients. Fold the static sub-block index into the step's
+    # base key instead: stable per op, unique per segment.
+    base = getattr(ctx, "base_key", None)
+    key = (jax.random.fold_in(base, sub_idx) if base is not None else None)
+
+    def segment(*hold_vals):
+        env = dict(zip(hold_names, hold_vals))
+        sub = _sub_ctx(ctx, key)
+        # pallas_call cannot be traced under the checkpoint transform
+        # (pl.program_id needs a grid context the remat re-trace lacks);
+        # kernels with a pallas fast path consult this and use their
+        # XLA-composed equivalent inside remat segments
+        sub.in_remat = True
+        runner.run_block(sub_idx, env, sub)
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.checkpoint(segment)(*ins["Hold"])
+    return {"Out": list(outs)}

@@ -125,6 +125,14 @@ def _flash_grad_maker(op, no_grad_set):
              grad_maker=_flash_grad_maker)
 def flash_attention_op(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    if getattr(ctx, "in_remat", False):
+        # inside a recompute segment: pallas_call can't trace under
+        # jax.checkpoint — use the exact XLA-composed attention instead
+        from ..parallel.context_parallel import dense_attention
+
+        return {"Out": [dense_attention(q, k, v,
+                                        causal=attrs.get("causal", False),
+                                        scale=attrs.get("scale"))]}
     return {"Out": [flash_attention_fwd(
         q, k, v,
         causal=attrs.get("causal", False),

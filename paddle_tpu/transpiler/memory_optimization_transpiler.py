@@ -6,9 +6,11 @@ HLO program and XLA's buffer assignment already performs the reuse this
 transpiler implemented by renaming vars. What remains useful at our level:
 
 * ``memory_optimize(program)`` runs the same liveness analysis and returns
-  the reuse statistics (so tooling parity holds and tests can assert on it),
-  and flags the program so the executor enables rematerialization
-  (jax.checkpoint-style) for grad ops when requested.
+  the reuse statistics (so tooling parity holds and tests can assert on it).
+* Rematerialization — the optimization that actually moves the needle on
+  TPU HBM — is explicit: wrap segments in ``layers.recompute()`` and their
+  activations are dropped after the forward and recomputed in the backward
+  (jax.checkpoint; see ops/control_flow.py recompute_op).
 * ``release_memory`` (<- release_memory): drops non-persistable fetch targets
   early — a no-op under XLA, kept for API parity.
 """

@@ -209,3 +209,62 @@ def test_array_write_read_in_while():
     (av, pv), _ = _run(main, startup, {}, [arr, picked])
     np.testing.assert_allclose(av[:, 0], [0, 1, 4, 9, 16, 25])
     assert float(pv[0]) == 4.0
+
+
+def test_recompute_segment_matches_inline():
+    """A jax.checkpoint'd segment computes the same fwd/bwd as inline ops
+    (<- memory_optimization_transpiler role, TPU-native remat)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import append_backward, grad_var_name
+
+    def build(use_recompute):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[6], dtype="float32")
+            x.stop_gradient = False
+            x.is_data = False
+            if use_recompute:
+                with fluid.layers.recompute():
+                    h = fluid.layers.fc(x, size=8, act="relu",
+                                        param_attr=fluid.ParamAttr("w1"),
+                                        bias_attr=fluid.ParamAttr("b1"))
+                    h2 = fluid.layers.fc(h, size=8, act="tanh",
+                                         param_attr=fluid.ParamAttr("w2"),
+                                         bias_attr=fluid.ParamAttr("b2"))
+            else:
+                h = fluid.layers.fc(x, size=8, act="relu",
+                                    param_attr=fluid.ParamAttr("w1"),
+                                    bias_attr=fluid.ParamAttr("b1"))
+                h2 = fluid.layers.fc(h, size=8, act="tanh",
+                                     param_attr=fluid.ParamAttr("w2"),
+                                     bias_attr=fluid.ParamAttr("b2"))
+            pred = fluid.layers.fc(h2, size=3,
+                                   param_attr=fluid.ParamAttr("w3"),
+                                   bias_attr=fluid.ParamAttr("b3"))
+            loss = fluid.layers.mean(pred)
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=42)
+        xv = np.random.RandomState(0).rand(4, 6).astype("float32")
+        fetches = [loss.name, grad_var_name("x"), grad_var_name("w1"),
+                   grad_var_name("w2")]
+        return exe.run(main, feed={"x": xv}, fetch_list=fetches, scope=scope)
+
+    plain = build(False)
+    remat = build(True)
+    for p, r in zip(plain, remat):
+        np.testing.assert_allclose(r, p, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_downstream_shape_inference():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        with fluid.layers.recompute():
+            h = fluid.layers.fc(x, size=16, act="relu")
+        assert main.current_block().var(h.name).shape == (-1, 16)
+        pred = fluid.layers.fc(h, size=2)  # shape inference works downstream
+        assert pred.shape == (-1, 2)

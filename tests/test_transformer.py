@@ -1,0 +1,155 @@
+"""Transformer LM (<- test_parallel_executor_transformer.py role): causal
+masking correctness through the flash_attention path, training convergence,
+recompute equivalence, tp-sharded multi-device step."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer_lm
+
+
+def _build(vocab=60, T=16, recompute=False, tp_shard=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+        labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+        logits, loss = transformer_lm(ids, labels, vocab_size=vocab,
+                                      max_len=T, d_model=32, n_heads=2,
+                                      n_layers=2, d_ff=64,
+                                      use_recompute=recompute,
+                                      tp_shard=tp_shard)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(3e-3).minimize(loss, startup)
+    return main, startup, ids, labels, logits, loss, test_prog
+
+
+def test_causal_masking_through_flash_attention():
+    """Changing a future token must not affect logits at earlier positions."""
+    main, startup, ids, labels, logits, loss, test_prog = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=7)
+    rng = np.random.RandomState(0)
+    a = rng.randint(0, 60, (2, 16)).astype("int64")
+    b = a.copy()
+    b[:, 10:] = rng.randint(0, 60, (2, 6))  # perturb the future
+    lab = np.roll(a, -1, axis=1)
+    la, = exe.run(test_prog, feed={"ids": a, "labels": lab},
+                  fetch_list=[logits.name], scope=scope)
+    lb, = exe.run(test_prog, feed={"ids": b, "labels": lab},
+                  fetch_list=[logits.name], scope=scope)
+    np.testing.assert_allclose(la[:, :10], lb[:, :10], rtol=1e-4, atol=1e-5)
+    assert not np.allclose(la[:, 10:], lb[:, 10:])
+
+
+def test_lm_learns_copy_task():
+    """Predict-next on a repeating sequence: loss must fall well below
+    uniform entropy."""
+    vocab, T = 30, 16
+    main, startup, ids, labels, logits, loss, _tp = _build(vocab=vocab, T=T)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=1)
+    rng = np.random.RandomState(2)
+    losses = []
+    for step in range(60):
+        start = rng.randint(0, vocab, (16, 1))
+        seq = (start + np.arange(T)[None, :]) % vocab  # deterministic +1 chain
+        lab = (seq + 1) % vocab
+        lv, = exe.run(main, feed={"ids": seq.astype("int64"),
+                                  "labels": lab.astype("int64")},
+                      fetch_list=[loss.name], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < 1.0 < losses[0]  # uniform = ln(30) ~ 3.4
+
+
+def test_recompute_transformer_matches():
+    """use_recompute changes memory behavior, not numerics."""
+    outs = {}
+    for remat in (False, True):
+        main, startup, ids, labels, logits, loss, _tp = _build(recompute=remat)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=5)
+        a = np.random.RandomState(3).randint(0, 60, (2, 16)).astype("int64")
+        lab = np.roll(a, -1, axis=1)
+        for _ in range(3):
+            lv, = exe.run(main, feed={"ids": a, "labels": lab},
+                          fetch_list=[loss.name], scope=scope)
+        outs[remat] = float(lv)
+    np.testing.assert_allclose(outs[True], outs[False], rtol=1e-4)
+
+
+def test_transformer_tp_multi_device():
+    """dp x tp sharded training step on the virtual CPU mesh."""
+    import jax
+
+    from paddle_tpu.parallel import ParallelExecutor, make_mesh
+
+    main, startup, ids, labels, logits, loss, _tp = _build(tp_shard=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=9)
+    mesh = make_mesh({"dp": 2, "tp": 2}, devices=jax.devices("cpu")[:4])
+    pe = ParallelExecutor(use_tpu=False, loss_name=loss.name,
+                          main_program=main, scope=scope, mesh=mesh)
+    rng = np.random.RandomState(4)
+    a = rng.randint(0, 60, (8, 16)).astype("int64")
+    lab = np.roll(a, -1, axis=1)
+    lv, = pe.run(fetch_list=[loss.name], feed={"ids": a, "labels": lab})
+    assert np.isfinite(float(np.asarray(lv).mean()))
+
+
+def test_lm_shorter_than_max_len():
+    """T < max_len: positions slice down, labels reshape to T."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[8], dtype="int64")
+        labels = fluid.layers.data("labels", shape=[8], dtype="int64")
+        logits, loss = transformer_lm(ids, labels, vocab_size=40, max_len=32,
+                                      d_model=16, n_heads=2, n_layers=1,
+                                      d_ff=32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    a = np.random.RandomState(0).randint(0, 40, (2, 8)).astype("int64")
+    lg, lv = exe.run(main, feed={"ids": a, "labels": a},
+                     fetch_list=[logits.name, loss.name], scope=scope)
+    assert lg.shape == (2, 8, 40) and np.isfinite(lv).all()
+
+
+def test_recompute_dropout_consistent_grads():
+    """Stochastic op inside a remat segment: forward loss and analytic grads
+    must see the SAME dropout mask (regression: key chain divergence)."""
+    from paddle_tpu.core import append_backward, grad_var_name
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        x.stop_gradient = False
+        x.is_data = False
+        with fluid.layers.recompute():
+            h = fluid.layers.fc(x, size=8, act="relu",
+                                param_attr=fluid.ParamAttr("rw"),
+                                bias_attr=False)
+            h = fluid.layers.dropout(h, dropout_prob=0.5)
+        loss = fluid.layers.mean(h)
+    append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=11)
+    xv = np.ones((4, 8), "float32")
+    lv, gx = exe.run(main, feed={"x": xv},
+                     fetch_list=[loss.name, grad_var_name("x")],
+                     scope=scope, seed=3)
+    # numeric check against the SAME seed: grad of mean(dropout(relu(xW)))
+    # wrt x must be consistent with the loss's own mask — verify via
+    # directional finite difference at fixed seed
+    eps = 1e-3
+    d = np.random.RandomState(1).randn(4, 8).astype("float32")
+    lp, = exe.run(main, feed={"x": xv + eps * d}, fetch_list=[loss.name],
+                  scope=scope, seed=3)
+    lm, = exe.run(main, feed={"x": xv - eps * d}, fetch_list=[loss.name],
+                  scope=scope, seed=3)
+    numeric = (float(lp) - float(lm)) / (2 * eps)
+    analytic = float((gx * d).sum())
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-4)
