@@ -129,9 +129,11 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
 
 
 def transformer_encoder(x, n_layers: int, d_model: int, n_heads: int,
-                        d_ff: int, name: str = "enc", tp_shard: bool = False):
+                        d_ff: int, name: str = "enc", tp_shard: bool = False,
+                        use_recompute: bool = False):
     """Bidirectional encoder stack over [N, T, d_model] features."""
     for i in range(n_layers):
         x = encoder_layer(x, d_model, n_heads, d_ff, causal=False,
-                          name=f"{name}.l{i}", tp_shard=tp_shard)
+                          name=f"{name}.l{i}", tp_shard=tp_shard,
+                          use_recompute=use_recompute)
     return layers.layer_norm(x, begin_norm_axis=2)

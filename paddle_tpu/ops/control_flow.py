@@ -79,10 +79,13 @@ def _sub_ctx(ctx: ExecContext, key) -> ExecContext:
     sub = ExecContext(key=key, block_runner=ctx.block_runner,
                       is_test=ctx.is_test, amp=ctx.amp)
     # nested blocks inside a recompute segment inherit the remat marker
-    # (pallas fallbacks must hold through while/cond bodies too) and the
-    # step's base key (so fold_in-derived randomness stays fwd/grad-stable)
+    # (pallas fallbacks must hold through while/cond bodies too). The base
+    # key becomes this body's PER-ITERATION key: a recompute segment inside
+    # a scan/while body must draw different randomness each timestep (one
+    # shared dropout mask across T steps would silently bias training),
+    # while still being stable across the segment's own checkpoint replay.
     sub.in_remat = getattr(ctx, "in_remat", False)
-    sub.base_key = getattr(ctx, "base_key", None)
+    sub.base_key = key
     return sub
 
 

@@ -48,3 +48,31 @@ def test_print_signatures(tmp_path):
     assert len(lines) > 200  # the API surface is large
     assert any(l.startswith("paddle_tpu.layers.nn.conv2d ") for l in lines)
     assert "api digest:" in r.stderr
+
+
+def test_kube_gen_job():
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kube_gen_job.py"),
+         "--name", "resnet", "--image", "repo/pt:latest", "--hosts", "3",
+         "--tpu", "v5e-8", "--cmd", "python bench.py"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert out.count("kind: Job") == 3
+    assert "kind: Service" in out
+    assert 'PADDLE_TRAINERS_NUM' in out and '"3"' in out
+    assert "resnet-0.resnet:8476,resnet-1.resnet:8476" in out
+    assert 'google.com/tpu: "v5e-8"' in out
+
+
+def test_paddle_cli_version():
+    # strip test-process jax env: the axon plugin rejects JAX_PLATFORMS=cpu
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "paddle_cli.py"),
+         "version"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "paddle_tpu" in r.stdout and "ops registered:" in r.stdout

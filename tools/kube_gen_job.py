@@ -1,0 +1,100 @@
+"""Generate Kubernetes job manifests for multi-host training
+(<- benchmark/fluid/kube_gen_job.py + kube_templates/).
+
+The reference emitted pserver+trainer job pairs wired by PADDLE_* env vars;
+on TPU the pserver plane is gone, so this emits one indexed Job per host
+whose pods bootstrap jax.distributed through the SAME env protocol
+paddle_tpu.distributed.init_distributed consumes:
+PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID.
+
+Usage::
+
+    python tools/kube_gen_job.py --name resnet --image myrepo/paddle-tpu \
+        --hosts 4 --tpu v5e-16 \
+        --cmd "python benchmark/fluid_benchmark.py --model resnet" > job.yaml
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def gen_job(name: str, image: str, hosts: int, tpu: str, cmd: str,
+            cpu: str = "8", memory: str = "32Gi", port: int = 8476) -> str:
+    """Render one manifest per host, joined by '---' (plain text YAML —
+    dependency-free, like the reference's template dicts)."""
+    docs = []
+    endpoints = ",".join(f"{name}-{i}.{name}:{port}" for i in range(hosts))
+    for host_id in range(hosts):
+        docs.append(f"""\
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {name}-{host_id}
+  labels:
+    app: {name}
+spec:
+  backoffLimit: 0
+  template:
+    metadata:
+      labels:
+        app: {name}
+        host-id: "{host_id}"
+    spec:
+      restartPolicy: Never
+      hostname: {name}-{host_id}
+      subdomain: {name}
+      containers:
+      - name: trainer
+        image: {image}
+        command: ["/bin/sh", "-c"]
+        args: ["{cmd}"]
+        env:
+        - name: PADDLE_TRAINER_ENDPOINTS
+          value: "{endpoints}"
+        - name: PADDLE_TRAINERS_NUM
+          value: "{hosts}"
+        - name: PADDLE_TRAINER_ID
+          value: "{host_id}"
+        - name: JAX_PLATFORMS
+          value: "tpu"
+        ports:
+        - containerPort: {port}
+        resources:
+          requests:
+            cpu: "{cpu}"
+            memory: {memory}
+            google.com/tpu: "{tpu}"
+          limits:
+            google.com/tpu: "{tpu}"
+""")
+    svc = f"""\
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}
+spec:
+  clusterIP: None
+  selector:
+    app: {name}
+  ports:
+  - port: {port}
+"""
+    return "---\n".join(docs + [svc])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--name", required=True)
+    p.add_argument("--image", required=True)
+    p.add_argument("--hosts", type=int, default=1)
+    p.add_argument("--tpu", default="v5e-8", help="TPU resource request")
+    p.add_argument("--cmd", required=True)
+    p.add_argument("--cpu", default="8")
+    p.add_argument("--memory", default="32Gi")
+    args = p.parse_args()
+    print(gen_job(args.name, args.image, args.hosts, args.tpu, args.cmd,
+                  cpu=args.cpu, memory=args.memory))
+
+
+if __name__ == "__main__":
+    main()

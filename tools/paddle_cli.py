@@ -1,0 +1,58 @@
+"""`paddle`-style CLI (<- paddle/scripts/submit_local.sh.in: the `paddle`
+wrapper exposing train/version subcommands around paddle_trainer).
+
+Subcommands:
+  train    — launch a local training run of a benchmark model
+             (the paddle_trainer role; flags forward to the benchmark driver)
+  version  — print framework/runtime versions
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cmd_version():
+    sys.path.insert(0, REPO)
+    import jax
+
+    import paddle_tpu
+
+    print("paddle_tpu (TPU-native Paddle-capability framework)")
+    print("  jax:", jax.__version__)
+    try:
+        platforms = sorted({d.platform for d in jax.devices()})
+    except RuntimeError as e:  # no device/backend in this environment
+        platforms = [f"unavailable ({e})"]
+    print("  backends:", ", ".join(platforms))
+    from paddle_tpu.core.registry import registered_ops
+
+    print("  ops registered:", len(registered_ops()))
+
+
+def cmd_train(argv):
+    driver = os.path.join(REPO, "benchmark", "fluid_benchmark.py")
+    os.execv(sys.executable, [sys.executable, driver] + argv)
+
+
+def main():
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
+        print(__doc__)
+        print("usage: paddle_cli.py {train|version} [args...]")
+        return 0
+    sub = sys.argv[1]
+    if sub == "version":
+        cmd_version()
+        return 0
+    if sub == "train":
+        cmd_train(sys.argv[2:])
+        return 0  # unreachable (execv)
+    print(f"unknown subcommand {sub!r}; use train|version")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
