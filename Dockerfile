@@ -1,0 +1,29 @@
+# paddle_tpu runtime image (<- the reference's Dockerfile, re-targeted at
+# TPU hosts: jax[tpu] replaces the CUDA/cuDNN stack; g++ stays for the
+# native csrc/ components, which compile on first use).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+        "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        numpy pytest
+
+WORKDIR /workspace/paddle_tpu
+COPY paddle_tpu/ paddle_tpu/
+COPY csrc/ csrc/
+COPY tools/ tools/
+COPY benchmark/ benchmark/
+COPY tests/ tests/
+COPY bench.py README.md ./
+
+# warm the native components (buddy allocator / recordio / dataio / loader)
+RUN python -c "from paddle_tpu.reader.native import _lib; _lib()" \
+    && python -c "from paddle_tpu.inference import _lib; _lib()"
+
+# multi-host pods get PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM /
+# PADDLE_TRAINER_ID from tools/kube_gen_job.py manifests
+ENTRYPOINT ["python"]
+CMD ["benchmark/fluid_benchmark.py", "--model", "resnet", "--device", "TPU"]

@@ -170,3 +170,27 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP(MetricBase):
+    """Mean-average-precision accumulator (<- metrics.py:538 DetectionMAP):
+    feed it the per-batch mAP from the ``detection_map`` op and read the
+    running mean."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._total = 0.0
+        self._count = 0
+
+    def reset(self):
+        self._total = 0.0
+        self._count = 0
+
+    def update(self, value, weight=1):
+        self._total += float(np.asarray(value).mean()) * weight
+        self._count += weight
+
+    def eval(self):
+        if self._count == 0:
+            raise ValueError("DetectionMAP.eval() before any update()")
+        return self._total / self._count

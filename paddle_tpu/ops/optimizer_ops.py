@@ -217,8 +217,12 @@ def proximal_adagrad(ctx, ins, attrs):
     no_grad=True,
 )
 def average_accumulates(ctx, ins, attrs):
-    """Sliding parameter average state machine (<- average_accumulates_op.cc,
-    used by ModelAverage, optimizer.py:929)."""
+    """Sliding parameter average state machine (<- average_accumulates_op.h,
+    used by ModelAverage, optimizer.py:929). Invariant the consumer relies
+    on: sum_1+sum_2 hold exactly num_accumulates samples and sum_3 holds
+    exactly old_num_accumulates samples, so
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) is the true
+    window average."""
     p = ins["param"][0]
     s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
     num_acc = ins["in_num_accumulates"][0]
@@ -227,28 +231,31 @@ def average_accumulates(ctx, ins, attrs):
     avg_window = attrs.get("average_window", 0.0)
     max_avg = attrs.get("max_average_window", 10000)
     min_avg = attrs.get("min_average_window", 10000)
+    k_max_chunk = 16384  # <- kMaxNumAccumulates: numeric chunking of sum_1
 
     num_upd = num_upd + 1
     num_acc = num_acc + 1
     s1 = s1 + p
-    window = jnp.maximum(
-        jnp.asarray(min_avg, jnp.int64),
-        jnp.minimum(jnp.asarray(max_avg, jnp.int64), (num_upd * avg_window).astype(jnp.int64)),
-    )
-    roll = num_acc >= window
-    s2n = jnp.where(roll, s2 + s1, s2)
-    s1n = jnp.where(roll, jnp.zeros_like(s1), s1)
-    old_n = jnp.where(roll, old_num + num_acc, old_num)
-    num_accn = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
-    roll2 = old_n > 2 * window
-    s3n = jnp.where(roll2, s2n, s3)
-    s2n = jnp.where(roll2, jnp.zeros_like(s2n), s2n)
-    old_n2 = jnp.where(roll2, jnp.zeros_like(old_n), old_n)
+    # chunk overflow: periodically fold sum_1 into sum_2 (same sample pool)
+    chunk = num_upd % k_max_chunk == 0
+    s2 = jnp.where(chunk, s2 + s1, s2)
+    s1 = jnp.where(chunk, jnp.zeros_like(s1), s1)
+    # window complete: rotate the CURRENT pool into sum_3 wholesale, carrying
+    # its sample count into old_num (the reference's condition)
+    window = jnp.minimum(
+        jnp.asarray(max_avg, jnp.int64),
+        (num_upd * avg_window).astype(jnp.int64))
+    roll = (num_acc >= min_avg) & (num_acc >= window)
+    s3 = jnp.where(roll, s1 + s2, s3)
+    old_num = jnp.where(roll, num_acc, old_num)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(roll, jnp.zeros_like(s2), s2)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
     return {
-        "out_sum_1": [s1n],
-        "out_sum_2": [s2n],
-        "out_sum_3": [s3n],
-        "out_num_accumulates": [num_accn],
-        "out_old_num_accumulates": [old_n2],
+        "out_sum_1": [s1],
+        "out_sum_2": [s2],
+        "out_sum_3": [s3],
+        "out_num_accumulates": [num_acc],
+        "out_old_num_accumulates": [old_num],
         "out_num_updates": [num_upd],
     }

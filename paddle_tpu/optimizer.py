@@ -10,7 +10,7 @@ optimizers.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .core.autodiff import append_backward
 from .core.ir import Program, Variable, default_startup_program
@@ -346,3 +346,165 @@ DecayedAdagradOptimizer = DecayedAdagrad
 AdadeltaOptimizer = Adadelta
 RMSPropOptimizer = RMSProp
 FtrlOptimizer = Ftrl
+
+
+class ProximalGD(Optimizer):
+    """<- optimizer.py ProximalGDOptimizer / proximal_gd_op.cc."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _append_optimize_op(self, block, param, grad):
+        block.append_op(
+            "proximal_gd",
+            {"Param": [param], "Grad": [grad],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param]},
+            {"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ProximalAdagrad(Optimizer):
+    """<- optimizer.py ProximalAdagradOptimizer / proximal_adagrad_op.cc."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _create_accumulators(self, param, startup):
+        self._add_accumulator("moment", param, startup)
+
+    def _append_optimize_op(self, block, param, grad):
+        m = self._accumulators["moment"][param.name]
+        block.append_op(
+            "proximal_adagrad",
+            {"Param": [param], "Grad": [grad], "Moment": [m],
+             "LearningRate": [self._lr_for_param(param)]},
+            {"ParamOut": [param], "MomentOut": [m]},
+            {"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ModelAverage:
+    """Sliding average of parameters for evaluation
+    (<- optimizer.py:929 ModelAverage + average_accumulates_op.cc).
+
+    Construct AFTER ``optimizer.minimize`` so the accumulate ops land behind
+    the updates; during training every step feeds the sum windows. ``apply``
+    swaps parameters to their window average (restoring on context exit),
+    exactly the reference's usage::
+
+        model_average = fluid.optimizer.ModelAverage(0.15)
+        ...
+        with model_average.apply(exe, scope):
+            evaluate(...)
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, main_program=None,
+                 startup_program=None):
+        from .core.ir import default_main_program, default_startup_program
+
+        self.avg_window = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        program = main_program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block()
+        self._state: List[Tuple[str, Dict[str, str]]] = []
+        params = [v for v in program.list_vars()
+                  if getattr(v, "_param_attr", None) is not None and v.persistable]
+        for p in params:
+            names = {}
+            for suffix, shape, fill in [
+                ("sum_1", p.shape, 0.0), ("sum_2", p.shape, 0.0),
+                ("sum_3", p.shape, 0.0), ("num_accumulates", (), 0.0),
+                ("old_num_accumulates", (), 0.0), ("num_updates", (), 0.0),
+            ]:
+                n = unique_name.generate(f"{p.name}.avg_{suffix}")
+                dtype = p.dtype if suffix.startswith("sum") else DataType.INT64
+                block.create_var(n, dtype=dtype, shape=shape, persistable=True,
+                                 stop_gradient=True)
+                sb = startup.global_block()
+                sb.create_var(n, dtype=dtype, shape=shape, persistable=True)
+                sb.append_op("fill_constant", outputs={"Out": [n]},
+                             attrs={"shape": list(shape), "value": fill,
+                                    "dtype": dtype})
+                names[suffix] = n
+            block.append_op(
+                "average_accumulates",
+                {"param": [p.name], "in_sum_1": [names["sum_1"]],
+                 "in_sum_2": [names["sum_2"]], "in_sum_3": [names["sum_3"]],
+                 "in_num_accumulates": [names["num_accumulates"]],
+                 "in_old_num_accumulates": [names["old_num_accumulates"]],
+                 "in_num_updates": [names["num_updates"]]},
+                {"out_sum_1": [names["sum_1"]], "out_sum_2": [names["sum_2"]],
+                 "out_sum_3": [names["sum_3"]],
+                 "out_num_accumulates": [names["num_accumulates"]],
+                 "out_old_num_accumulates": [names["old_num_accumulates"]],
+                 "out_num_updates": [names["num_updates"]]},
+                {"average_window": self.avg_window,
+                 "min_average_window": self.min_window,
+                 "max_average_window": self.max_window},
+            )
+            self._state.append((p.name, names))
+        self._saved: Dict[str, Any] = {}
+
+    def _averaged(self, scope, names, dtype) -> Any:
+        import numpy as np
+
+        vals = {k: scope.get(v) for k, v in names.items()}
+        missing = [names[k] for k, v in vals.items() if v is None]
+        if missing:
+            raise RuntimeError(
+                f"ModelAverage accumulators missing from scope: {missing}; "
+                f"run the startup program (and at least one training step)")
+        s = (np.asarray(vals["sum_1"]) + np.asarray(vals["sum_2"])
+             + np.asarray(vals["sum_3"]))
+        cnt = (int(np.asarray(vals["num_accumulates"]))
+               + int(np.asarray(vals["old_num_accumulates"])))
+        return (s / max(cnt, 1)).astype(dtype)
+
+    def apply(self, executor=None, scope=None, need_restore: bool = True):
+        import contextlib
+
+        import numpy as np
+
+        from .core.executor import global_scope
+
+        scope = scope or global_scope()
+
+        @contextlib.contextmanager
+        def guard():
+            # compute EVERY average before mutating the scope: a failure on
+            # parameter k must not leave parameters 0..k-1 swapped
+            averaged = {}
+            saved = {}
+            for pname, names in self._state:
+                cur = scope.get(pname)
+                averaged[pname] = self._averaged(scope, names,
+                                                 np.asarray(cur).dtype)
+                saved[pname] = cur
+            self._saved = saved
+            for pname, value in averaged.items():
+                scope.set(pname, value)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor, scope)
+
+        return guard()
+
+    def restore(self, executor=None, scope=None):
+        from .core.executor import global_scope
+
+        scope = scope or global_scope()
+        for pname, value in self._saved.items():
+            scope.set(pname, value)
+        self._saved = {}
+
+
+ProximalGDOptimizer = ProximalGD
+ProximalAdagradOptimizer = ProximalAdagrad

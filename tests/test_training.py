@@ -81,3 +81,104 @@ def test_amp_training_converges():
                         fetch_list=[loss], scope=scope)
         losses.append(float(lv))
     assert losses[-1] < losses[0] * 0.5
+
+
+def test_proximal_optimizers_step():
+    import paddle_tpu as fluid
+
+    for cls, kw in [(fluid.optimizer.ProximalGD, {"l1": 0.01, "l2": 0.01}),
+                    (fluid.optimizer.ProximalAdagrad, {"l1": 0.001})]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            cls(learning_rate=0.05, **kw).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=0)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(16, 4).astype("float32")
+        yv = xv.sum(1, keepdims=True).astype("float32")
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss], scope=scope)[0])
+                  for _ in range(25)]
+        assert losses[-1] < losses[0], cls.__name__
+
+
+def test_model_average_apply_restore():
+    """<- optimizer.py ModelAverage: averaged params during apply(), exact
+    originals after."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr("mw"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+        ma = fluid.optimizer.ModelAverage(0.5, min_average_window=2,
+                                          max_average_window=4,
+                                          main_program=main,
+                                          startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    rng = np.random.RandomState(1)
+    for _ in range(6):
+        xv = rng.rand(8, 2).astype("float32")
+        yv = xv.sum(1, keepdims=True)
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+    current = np.asarray(scope.get("mw")).copy()
+    with ma.apply(exe, scope):
+        averaged = np.asarray(scope.get("mw")).copy()
+        assert not np.allclose(averaged, current)  # swapped to the average
+    np.testing.assert_array_equal(np.asarray(scope.get("mw")), current)
+
+
+def test_detection_map_metric():
+    import paddle_tpu as fluid
+
+    m = fluid.metrics.DetectionMAP()
+    m.update(0.5)
+    m.update(np.array([0.7]))
+    assert abs(m.eval() - 0.6) < 1e-6
+    m.reset()
+    m.update(1.0)
+    assert m.eval() == 1.0
+
+
+def test_model_average_exact_under_constant_params():
+    """lr=0 -> params never change -> the window average must equal the
+    params exactly, including after sum_3 rotations (regression: the old
+    state machine dropped sum_3's sample count from the denominator)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr("cw"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss, startup)
+        ma = fluid.optimizer.ModelAverage(0.5, min_average_window=2,
+                                          max_average_window=4,
+                                          main_program=main,
+                                          startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    rng = np.random.RandomState(1)
+    for _ in range(30):  # long enough for several window rotations
+        xv = rng.rand(4, 2).astype("float32")
+        exe.run(main, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                fetch_list=[loss], scope=scope)
+    const_w = np.asarray(scope.get("cw")).copy()
+    with ma.apply(exe, scope):
+        np.testing.assert_allclose(np.asarray(scope.get("cw")), const_w,
+                                   rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(scope.get("cw")), const_w)
