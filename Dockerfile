@@ -20,7 +20,8 @@ COPY tests/ tests/
 COPY bench.py README.md ./
 
 # warm the native components (buddy allocator / recordio / dataio / loader)
-RUN python -c "from paddle_tpu.reader.native import _lib; _lib()" \
+RUN python -c "from paddle_tpu.recordio import _lib; _lib()" \
+    && python -c "from paddle_tpu.reader.native import _lib; _lib()" \
     && python -c "from paddle_tpu.inference import _lib; _lib()"
 
 # multi-host pods get PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM /

@@ -464,7 +464,11 @@ class ModelAverage:
              + np.asarray(vals["sum_3"]))
         cnt = (int(np.asarray(vals["num_accumulates"]))
                + int(np.asarray(vals["old_num_accumulates"])))
-        return (s / max(cnt, 1)).astype(dtype)
+        if cnt == 0:
+            raise RuntimeError(
+                "ModelAverage.apply() before any training step: the average "
+                "window is empty (zero accumulated samples)")
+        return (s / cnt).astype(dtype)
 
     def apply(self, executor=None, scope=None, need_restore: bool = True):
         import contextlib

@@ -194,3 +194,43 @@ def test_rnn_encoder_decoder():
                       "trg_len": trg_len, "trg_next": trg_next}
     losses, _, _ = _train(main, startup, feed, avg_cost, steps=50)
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_image_classification(tmp_path):
+    """<- book/03.image_classification (test_image_classification_train.py):
+    resnet-cifar10 trains, exports, reloads, infers."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet_cifar10
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = resnet_cifar10(img, label, depth=20, class_dim=10)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(1e-3).minimize(avg_cost, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    rng = np.random.RandomState(0)
+    # class-separable synthetic cifar (channel mean encodes the class)
+    def batch(n=16):
+        y = rng.randint(0, 10, (n, 1)).astype("int64")
+        x = rng.rand(n, 3, 32, 32).astype("float32") * 0.3
+        x[np.arange(n), y[:, 0] % 3] += (y[:, 0, None, None] / 10.0)
+        return x, y
+    losses = []
+    for _ in range(12):
+        x, y = batch()
+        lv, = exe.run(main, feed={"img": x, "label": y},
+                      fetch_list=[avg_cost], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]
+    d = str(tmp_path / "ic")
+    fluid.io.save_inference_model(d, ["img"], [pred], exe, main_program=test_prog,
+                                  scope=scope)
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe, scope=scope)
+    x, y = batch(4)
+    out, = exe.run(prog, feed={"img": x}, fetch_list=fetches, scope=scope)
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(1), np.ones(4), rtol=1e-4)

@@ -182,3 +182,26 @@ def test_model_average_exact_under_constant_params():
         np.testing.assert_allclose(np.asarray(scope.get("cw")), const_w,
                                    rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(scope.get("cw")), const_w)
+
+
+def test_model_average_apply_before_training_raises():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+        ma = fluid.optimizer.ModelAverage(0.5, min_average_window=2,
+                                          max_average_window=4,
+                                          main_program=main,
+                                          startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="empty"):
+        with ma.apply(exe, scope):
+            pass

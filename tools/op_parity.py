@@ -1,0 +1,143 @@
+"""Audit the op inventory against the reference's operator surface
+(<- the role tools/print_signatures.py + the op-bench scripts played for
+API-stability; SURVEY.md §2b is the source list).
+
+Prints three sections: ops matched 1:1 by name, reference ops covered by a
+renamed/redesigned equivalent (with the mapping), and anything uncovered.
+Exit code 1 if uncovered ops exist — CI-able.
+
+Usage::  python tools/op_parity.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# SURVEY.md §2b inventory (reference op registration names)
+REFERENCE_OPS = """
+mul matmul fc bilinear_tensor_product
+conv2d conv3d conv2d_transpose conv_shift depthwise_conv2d spp im2sequence
+batch_norm layer_norm lrn l1_norm norm clip_by_norm
+pool2d pool3d pool2d_with_index maxout unpool
+relu sigmoid tanh softmax sequence_softmax prelu exp abs ceil floor round
+reciprocal log square softplus softsign sqrt brelu leaky_relu soft_relu elu
+relu6 pow stanh hard_shrink thresholded_relu hard_sigmoid swish
+elementwise_add elementwise_sub elementwise_mul elementwise_div
+elementwise_max elementwise_min elementwise_pow
+reduce_sum reduce_mean reduce_max reduce_min reduce_prod cumsum arg_max
+arg_min top_k
+cross_entropy softmax_with_cross_entropy sigmoid_cross_entropy_with_logits
+hinge_loss huber_loss smooth_l1_loss squared_l2_distance log_loss rank_loss
+margin_rank_loss modified_huber_loss warpctc nce linear_chain_crf
+crf_decoding mean cos_sim
+lstm lstmp lstm_unit gru gru_unit row_conv
+sequence_concat sequence_conv sequence_erase sequence_expand sequence_pool
+sequence_reshape sequence_slice sequence_softmax lod_reset lod_rank_table
+lod_tensor_to_array array_to_lod_tensor split_lod_tensor merge_lod_tensor
+reorder_lod_tensor_by_rank max_sequence_len shrink_rnn_memory
+rnn_memory_helper edit_distance ctc_align chunk_eval beam_search
+beam_search_decode
+while recurrent conditional_block is_empty less_than less_equal greater_than
+greater_equal equal not_equal logical_and logical_or logical_xor logical_not
+increment tensor_array_read_write parallel_do
+sgd momentum adam adamax adagrad decayed_adagrad adadelta rmsprop ftrl
+proximal_gd proximal_adagrad average_accumulates
+lookup_table lookup_sparse_table split_selected_rows split_ids merge_ids
+one_hot
+reshape transpose concat split split_byref expand gather scatter pad crop
+slice reverse shape cast assign assign_value fill_constant
+fill_constant_batch_size_like fill_zeros_like sum scale minus sign clip
+multiplex
+uniform_random gaussian_random random_crop dropout
+bilinear_interp roi_pool prior_box multiclass_nms box_coder iou_similarity
+bipartite_match target_assign mine_hard_examples polygon_box_transform
+detection_map
+accuracy auc precision_recall mean_iou positive_negative_pair
+feed fetch save load save_combine load_combine print
+fake_dequantize_max_abs label_smooth
+send recv send_barrier fetch_barrier prefetch listen_and_serv gen_nccl_id
+nccl_all_reduce channel_send channel_recv channel_create channel_close
+select go
+""".split()
+
+# reference op -> how this framework provides the capability
+REDESIGNED = {
+    "fc": "layers.fc -> mul+sum+bias (one fused MXU matmul under XLA)",
+    "soft_relu": "softplus functor (same curve family; activations.py)",
+    "conditional_block": "cond / row_cond ops (lax.cond lowering)",
+    "tensor_array_read_write": "array_read / array_write / array_length ops",
+    "parallel_do": "ParallelExecutor mesh sharding (SSA-replication path removed)",
+    "rnn_memory_helper": "recurrent op carries memories inside one lax.scan",
+    "split_byref": "split op (no by-ref aliasing under functional XLA)",
+    "lookup_sparse_table": "sharded embedding tables (transpiler + ctr models)",
+    "split_selected_rows": "slice_vars_round_robin + mesh sharding (transpiler)",
+    "split_ids": "transpiler id-sharding (distribute_transpiler)",
+    "merge_ids": "transpiler id-merge (distribute_transpiler)",
+    "feed": "Executor.run feed dict (donated inputs)",
+    "fetch": "Executor.run fetch_list",
+    "save": "io.save_vars / save_persistables",
+    "load": "io.load_vars / load_persistables",
+    "save_combine": "io.save_persistables (one dir per save)",
+    "load_combine": "io.load_persistables",
+    "send": "XLA collectives over ICI (transpiler emits structure only)",
+    "recv": "XLA collectives over ICI",
+    "send_barrier": "program-order effect of compiled collectives",
+    "fetch_barrier": "program-order effect of compiled collectives",
+    "prefetch": "sharded-embedding gather (ctr models / transpiler)",
+    "listen_and_serv": "pserver plane deleted: sharded params + reduce_scatter",
+    "gen_nccl_id": "distributed.init_distributed (jax.distributed bootstrap)",
+    "nccl_all_reduce": "GSPMD all-reduce inside the compiled step",
+    "channel_send": "concurrency.channel_send (host runtime)",
+    "channel_recv": "concurrency.channel_recv",
+    "channel_create": "concurrency.make_channel",
+    "channel_close": "concurrency.channel_close",
+    "select": "concurrency.Select",
+    "go": "concurrency.go / Go",
+    "bilinear_interp": "bilinear_interp op (also nearest_interp)",
+    "smooth_l1_loss": "smooth_l1_loss op",
+}
+
+ALIASES = {  # registered under a different name
+    "soft_relu": "softplus",
+    "conditional_block": "cond",
+    "tensor_array_read_write": "array_write",
+    "rnn_memory_helper": "recurrent",
+    "split_byref": "split",
+}
+
+
+def audit():
+    from paddle_tpu.core.registry import registered_ops
+
+    reg = set(registered_ops())
+    matched, mapped, missing = [], [], []
+    for op in REFERENCE_OPS:
+        if op in reg or ALIASES.get(op) in reg:
+            matched.append(op)
+        elif op in REDESIGNED:
+            mapped.append((op, REDESIGNED[op]))
+        else:
+            missing.append(op)
+    extra = sorted(reg - set(REFERENCE_OPS) - set(ALIASES.values()))
+    return matched, mapped, missing, extra
+
+
+def main():
+    matched, mapped, missing, extra = audit()
+    print(f"matched by name: {len(matched)}")
+    print(f"covered by redesign: {len(mapped)}")
+    for op, how in mapped:
+        print(f"  {op:28s} -> {how}")
+    print(f"net-new ops beyond the reference: {len(extra)}")
+    print("  " + " ".join(extra))
+    if missing:
+        print(f"UNCOVERED ({len(missing)}): {' '.join(missing)}")
+        return 1
+    print("UNCOVERED: none")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
