@@ -665,8 +665,11 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     TPU-native fusion of that pattern."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
+    # per-query logsumexp saved for the FlashAttention-2 backward kernels
+    lse = helper.create_variable_for_type_inference("float32")
     helper.append_op(
-        "flash_attention", {"Q": [q], "K": [k], "V": [v]}, {"Out": [out]},
+        "flash_attention", {"Q": [q], "K": [k], "V": [v]},
+        {"Out": [out], "LSE": [lse]},
         {"causal": causal, "scale": scale, "q_block": q_block,
          "k_block": k_block},
     )

@@ -1,17 +1,17 @@
-"""Pallas TPU flash attention.
+"""Pallas TPU flash attention (forward + backward kernels).
 
 The reference has no attention op at all — its transformer benchmark builds
 attention from matmul+softmax primitives (SURVEY.md §5.7). Here attention is
 a first-class op whose forward is a Pallas kernel: per (batch*head, q-block)
 grid cell, K/V stream through VMEM in blocks under an online-softmax
 accumulator, so the [Tq, Tk] logits matrix never materializes in HBM —
-the flash-attention memory profile the MXU wants.
+the flash-attention memory profile the MXU wants. The forward also emits
+the per-query logsumexp (LSE), and the backward is the FlashAttention-2
+recipe: one kernel accumulates dQ over K-blocks, a second accumulates
+dK/dV over Q-blocks, both reconstructing P = exp(logits - lse) from the
+saved LSE instead of storing the attention matrix.
 
-Backward (round 1): recompute through the dense formulation under jax.vjp —
-correct, and XLA still fuses it reasonably; a Pallas backward kernel is a
-planned optimization.
-
-On non-TPU backends the same kernel runs in interpreter mode (tests), so
+On non-TPU backends the same kernels run in interpreter mode (tests), so
 numerical behavior is identical everywhere.
 """
 from __future__ import annotations
@@ -29,7 +29,33 @@ from ..core.registry import register_op
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, causal, q_block):
+def _interpret_default():
+    # interpret anywhere except a real TPU (jax.default_device overrides
+    # the backend the computation actually lands on)
+    dev = jax.config.jax_default_device
+    platform = dev.platform if dev is not None else jax.default_backend()
+    return platform != "tpu"
+
+
+def _causal_mask(logits, qi, q_block, j, block_k, bq):
+    q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+    return jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+
+
+def _causal_hi(qi, q_block, block_k, n_blocks):
+    """First K-block index fully above the causal diagonal for q-block qi —
+    the exclusive upper bound of the K-loop (FlashAttention-2 bound)."""
+    return jnp.minimum(n_blocks, ((qi + 1) * q_block + block_k - 1) // block_k)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                  causal, q_block):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [bq, d]
     bq, d = q.shape
@@ -44,9 +70,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, causal, q_block
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
-            q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+            logits = _causal_mask(logits, qi, q_block, j, block_k, bq)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         p = jnp.exp(logits - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -59,28 +83,35 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, causal, q_block
     o0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    o, m, l = lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+    # causal: K-blocks entirely above the diagonal contribute nothing — skip
+    # them (roughly halves the FLOPs; FlashAttention-2 loop bounds)
+    hi = _causal_hi(qi, q_block, block_k, n_blocks) if causal else n_blocks
+    o, m, l = lax.fori_loop(0, hi, body, (o0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    # lse is laid out [bh, n_q_blocks, q_block]; the out block spans ALL
+    # q-blocks (full last-two dims — the Mosaic sublane/lane rule) and each
+    # sequential grid step writes its own row
+    lse_ref[0, qi] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None,
-                        q_block=128, k_block=128, interpret=None):
-    """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+                        q_block=128, k_block=128, interpret=None,
+                        return_lse=False):
+    """q,k,v: [B, T, H, D] -> out [B, T, H, D] (and lse [B, T, H])."""
     b, t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
-        # interpret anywhere except a real TPU (jax.default_device overrides
-        # the backend the computation actually lands on)
-        dev = jax.config.jax_default_device
-        platform = dev.platform if dev is not None else jax.default_backend()
-        interpret = platform != "tpu"
+        interpret = _interpret_default()
     q_block = min(q_block, t)
     k_block = min(k_block, t)
     if t % q_block or t % k_block:
         # ragged tail: fall back to the dense path
-        from ..parallel.context_parallel import dense_attention
+        if not return_lse:
+            from ..parallel.context_parallel import dense_attention
 
-        return dense_attention(q, k, v, causal=causal, scale=scale)
+            return dense_attention(q, k, v, causal=causal, scale=scale)
+        return _dense_attention_with_lse(q, k, v, causal, sc)
 
     qh = jnp.moveaxis(q, 2, 1).reshape(b * h, t, d)
     kh = jnp.moveaxis(k, 2, 1).reshape(b * h, t, d)
@@ -88,7 +119,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
 
     kernel = functools.partial(_flash_kernel, scale=sc, block_k=k_block,
                                causal=causal, q_block=q_block)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // q_block),
         in_specs=[
@@ -96,11 +127,200 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t // q_block, q_block), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t // q_block, q_block), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    out = jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
+    if not return_lse:
+        return out
+    lse = jnp.moveaxis(lse.reshape(b, h, t), 1, 2)  # [B, T, H]
+    return out, lse
+
+
+def _dense_attention_with_lse(q, k, v, causal, sc):
+    """One [B,H,T,T] logits pass yielding both the attention output and its
+    per-query logsumexp (the fallback when the Pallas layout can't apply)."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sc
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # [B,H,T]
+    p = jnp.exp(logits - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return out, jnp.moveaxis(lse, 1, 2)  # out [B,T,H,D], lse [B,T,H]
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2): dQ kernel over K-blocks, dK/dV kernel over
+# Q-blocks; P is reconstructed from the saved LSE, delta = rowsum(dO * O).
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, scale, block_k, causal, q_block):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)      # [bq, d]
+    do = do_ref[0].astype(jnp.float32)    # [bq, d]
+    lse = lse_ref[0, qi].astype(jnp.float32)      # [bq] (full-block layout)
+    delta = delta_ref[0, qi].astype(jnp.float32)  # [bq]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    n_blocks = t // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = _causal_mask(logits, qi, q_block, j, block_k, bq)
+        p = jnp.exp(logits - lse[:, None])                       # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale                   # [bq, bk]
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    hi = _causal_hi(qi, q_block, block_k, n_blocks) if causal else n_blocks
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, scale, block_q, causal, k_block):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)  # [bk, d]
+    bk, d = k.shape
+    t = q_ref.shape[1]
+    n_blocks = t // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, i].astype(jnp.float32)      # [bq] (rank-3 layout)
+        delta = delta_ref[0, i].astype(jnp.float32)  # [bq]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale          # [bq, bk]
+        if causal:
+            logits = _causal_mask(logits, i, block_q, ki, bk, block_q)
+        p = jnp.exp(logits - lse[:, None])
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    # causal: Q-blocks entirely before this K-block see none of it — skip
+    lo = (ki * k_block) // block_q if causal else 0
+    dk, dv = lax.fori_loop(lo, n_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
+                        q_block=128, k_block=128, interpret=None):
+    """FlashAttention-2 backward. All of q/k/v/out/do: [B, T, H, D];
+    lse: [B, T, H]. Returns (dq, dk, dv)."""
+    b, t, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    q_block = min(q_block, t)
+    k_block = min(k_block, t)
+    if t % q_block or t % k_block:
+        return _dense_bwd(q, k, v, do, causal, scale)
+
+    def fold(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, t, -1)
+
+    qh, kh, vh, doh = fold(q), fold(k), fold(v), fold(do)
+    # lse/delta in the [bh, n_q_blocks, q_block] layout the kernels block on
+    n_q = t // q_block
+    lseh = jnp.moveaxis(lse, 2, 1).reshape(b * h, n_q, q_block)
+    delta = jnp.sum(doh.astype(jnp.float32)
+                    * fold(out).astype(jnp.float32),
+                    axis=-1).reshape(b * h, n_q, q_block)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=sc,
+                                  block_k=k_block, causal=causal,
+                                  q_block=q_block)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, t // q_block),
+        in_specs=[
+            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t // q_block, q_block), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t // q_block, q_block), lambda bh, i: (bh, 0, 0)),
+        ],
         out_specs=pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qh, kh, vh)
-    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
+    )(qh, kh, vh, doh, lseh, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, scale=sc,
+                                   block_q=q_block, causal=causal,
+                                   k_block=k_block)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, t // k_block),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, t // q_block, q_block), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, t // q_block, q_block), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lseh, delta)
+
+    def unfold(x):
+        return jnp.moveaxis(x.reshape(b, h, t, d), 1, 2)
+
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+def _dense_bwd(q, k, v, do, causal, scale):
+    from ..parallel.context_parallel import dense_attention
+
+    _, vjp = jax.vjp(
+        lambda q, k, v: dense_attention(q, k, v, causal=causal, scale=scale),
+        q, k, v)
+    return vjp(do)
+
+
+# ---------------------------------------------------------------------------
+# op registration
+# ---------------------------------------------------------------------------
 
 
 def _flash_grad_maker(op, no_grad_set):
@@ -110,6 +330,8 @@ def _flash_grad_maker(op, no_grad_set):
             "Q": list(op.inputs["Q"]),
             "K": list(op.inputs["K"]),
             "V": list(op.inputs["V"]),
+            "Out": list(op.outputs["Out"]),
+            "LSE": list(op.outputs.get("LSE", [])),
             "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]],
         },
         "outputs": {
@@ -121,40 +343,44 @@ def _flash_grad_maker(op, no_grad_set):
     }]
 
 
-@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
+@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out", "LSE"),
              grad_maker=_flash_grad_maker)
 def flash_attention_op(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    causal = attrs.get("causal", False)
+    scale = attrs.get("scale")
     if getattr(ctx, "in_remat", False):
         # inside a recompute segment: pallas_call can't trace under
         # jax.checkpoint — use the exact XLA-composed attention instead
-        from ..parallel.context_parallel import dense_attention
-
-        return {"Out": [dense_attention(q, k, v,
-                                        causal=attrs.get("causal", False),
-                                        scale=attrs.get("scale"))]}
-    return {"Out": [flash_attention_fwd(
-        q, k, v,
-        causal=attrs.get("causal", False),
-        scale=attrs.get("scale"),
-        q_block=attrs.get("q_block", 128),
-        k_block=attrs.get("k_block", 128),
-    )]}
+        d = q.shape[-1]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        out, lse = _dense_attention_with_lse(q, k, v, causal, sc)
+        return {"Out": [out], "LSE": [lse]}
+    out, lse = flash_attention_fwd(
+        q, k, v, causal=causal, scale=scale,
+        q_block=attrs.get("q_block", 128), k_block=attrs.get("k_block", 128),
+        return_lse=True,
+    )
+    return {"Out": [out], "LSE": [lse]}
 
 
 @register_op("flash_attention_grad",
-             inputs=("Q", "K", "V", "Out@GRAD"),
+             inputs=("Q", "K", "V", "Out", "LSE", "Out@GRAD"),
              outputs=("Q@GRAD", "K@GRAD", "V@GRAD"), no_grad=True)
 def flash_attention_grad_op(ctx, ins, attrs):
-    """Backward: dense recompute under jax.vjp (flash bwd kernel planned)."""
-    from ..parallel.context_parallel import dense_attention
-
+    """FlashAttention-2 backward kernels (dense-vjp fallback for ragged
+    shapes or remat segments)."""
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     g = ins["Out@GRAD"][0]
-    _, vjp = jax.vjp(
-        lambda q, k, v: dense_attention(q, k, v,
-                                        causal=attrs.get("causal", False),
-                                        scale=attrs.get("scale")),
-        q, k, v)
-    gq, gk, gv = vjp(g)
+    causal = attrs.get("causal", False)
+    scale = attrs.get("scale")
+    out = ins["Out"][0] if ins.get("Out") and ins["Out"][0] is not None else None
+    lse = ins["LSE"][0] if ins.get("LSE") and ins["LSE"][0] is not None else None
+    if out is None or lse is None or getattr(ctx, "in_remat", False):
+        gq, gk, gv = _dense_bwd(q, k, v, g, causal, scale)
+    else:
+        gq, gk, gv = flash_attention_bwd(
+            q, k, v, out, lse, g, causal=causal, scale=scale,
+            q_block=attrs.get("q_block", 128),
+            k_block=attrs.get("k_block", 128))
     return {"Q@GRAD": [gq], "K@GRAD": [gk], "V@GRAD": [gv]}
