@@ -384,3 +384,35 @@ def flash_attention_grad_op(ctx, ins, attrs):
             q_block=attrs.get("q_block", 128),
             k_block=attrs.get("k_block", 128))
     return {"Q@GRAD": [gq], "K@GRAD": [gk], "V@GRAD": [gv]}
+
+
+# ---------------------------------------------------------------------------
+# jax-level differentiable entry point: pallas_call has no automatic jvp/vjp,
+# so raw-jax users (and future ring/flash composition) get a custom_vjp
+# pairing the forward and FA-2 backward kernels. The IR-level op above keeps
+# its own grad maker (the executor path doesn't go through jax.grad).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, q_block=128,
+                    k_block=128):
+    """Differentiable flash attention over [B, T, H, D] (jax.grad-ready)."""
+    return flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                               q_block=q_block, k_block=k_block)
+
+
+def _fa_fwd(q, k, v, causal, scale, q_block, k_block):
+    out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                   q_block=q_block, k_block=k_block,
+                                   return_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, scale, q_block, k_block, res, g):
+    q, k, v, out, lse = res
+    return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                               scale=scale, q_block=q_block, k_block=k_block)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -108,3 +108,20 @@ def test_flash_bwd_mixed_block_sizes_causal(qb, kb):
     np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-3, atol=2e-4)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-3, atol=2e-4)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_custom_vjp_flash_under_jax_grad(causal):
+    """jax.grad flows through the pallas kernels via the custom_vjp."""
+    from paddle_tpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.RandomState(4)
+    q = rng.randn(1, 32, 2, 8).astype("float32")
+    with jax.default_device(jax.devices("cpu")[0]), \
+         jax.default_matmul_precision("highest"):
+        g_flash = jax.grad(lambda x: jnp.sum(
+            flash_attention(x, x, x, causal, None, 16, 16) ** 2))(jnp.asarray(q))
+        g_dense = jax.grad(lambda x: jnp.sum(
+            dense_attention(x, x, x, causal=causal) ** 2))(jnp.asarray(q))
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense),
+                               rtol=2e-3, atol=2e-4)
