@@ -86,3 +86,29 @@ def test_op_parity_audit_clean():
         capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-500:]
     assert "UNCOVERED: none" in r.stdout
+
+
+def test_profiler_device_trace_dir(tmp_path):
+    """trace_dir engages jax.profiler and produces trace artifacts
+    (<- §5.1 device_tracer/CUPTI contract)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(x, size=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    d = str(tmp_path / "trace")
+    with profiler.profiler(trace_dir=d):
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                    fetch_list=[y.name], scope=scope)
+    found = []
+    for root, _dirs, files in os.walk(d):
+        found.extend(files)
+    assert found, "no trace artifacts written"
