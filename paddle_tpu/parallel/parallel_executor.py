@@ -85,6 +85,28 @@ class ParallelExecutor:
         self._cache: Dict[Any, Any] = {}
         self._step_seed = 0
         self._placed = False
+        # every array this executor creates must live on the mesh's backend:
+        # the axon TPU plugin registers itself as the default jax backend, so
+        # an unpinned PRNGKey/device_put would land on the TPU even when the
+        # mesh is the virtual CPU mesh, and resharding a TPU-committed array
+        # onto a CPU mesh forces _multi_slice on the TPU backend
+        self._device0 = self.mesh.devices.flat[0]
+
+    def _to_mesh_host(self, v):
+        """Pull a cross-backend device array through host memory.
+
+        jax.device_put from (e.g.) a TPU array to a CPU-mesh sharding slices
+        on the *source* backend; going via numpy keeps placement entirely on
+        the mesh's own backend.
+        """
+        if isinstance(v, jax.Array):
+            try:
+                src_platform = next(iter(v.devices())).platform
+            except Exception:
+                return v
+            if src_platform != self._device0.platform:
+                return np.asarray(v)
+        return v
 
     # -- parameter placement (<- BCastParamsToGPUs, parallel_executor.cc:134) --
     def _place_state(self, names: Sequence[str]):
@@ -108,7 +130,7 @@ class ParallelExecutor:
                         spec[d] = "dp"
                         sh = NamedSharding(self.mesh, PartitionSpec(*spec))
                         break
-            self.scope.set(n, jax.device_put(v, sh))
+            self.scope.set(n, jax.device_put(self._to_mesh_host(v), sh))
 
     def _feed_sharding(self, arr):
         spec = [None] * np.ndim(arr)
@@ -123,6 +145,12 @@ class ParallelExecutor:
         return_numpy: bool = True,
         seed: Optional[int] = None,
     ) -> List[np.ndarray]:
+        # pin ALL placement (feed device_puts, the PRNG key, parameter
+        # placement on first run) to the mesh's device pool — see _device0
+        with jax.default_device(self._device0):
+            return self._run_pinned(fetch_list, feed, return_numpy, seed)
+
+    def _run_pinned(self, fetch_list, feed, return_numpy, seed):
         feed = feed or {}
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
         feed_names = tuple(sorted(feed))
