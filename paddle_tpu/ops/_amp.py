@@ -1,0 +1,54 @@
+"""Shared AMP (bf16 mixed-precision) dtype policy helpers.
+
+One place for the rules every kernel applies under ``ctx.amp``:
+
+* activations flow bf16 end-to-end (HBM bandwidth is the bottleneck);
+* master parameters stay f32 in the scope — kernels cast them to bf16 at
+  the point of use, and the vjp of that cast accumulates the param grad
+  back in f32 automatically;
+* matmul/conv accumulate in f32 (requested explicitly via
+  ``preferred_element_type``) and store bf16;
+* precision-sensitive math (softmax/log/normalization statistics) computes
+  in f32 and casts the result back to the activation dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def low_precision(dtype) -> bool:
+    """True for sub-32-bit floats (bf16/f16/f8...)."""
+    return jnp.issubdtype(dtype, jnp.floating) and jnp.finfo(dtype).bits < 32
+
+
+def amp_operand(ctx, *xs):
+    """Cast float operands to bf16 when AMP is on (matmul/conv inputs)."""
+    if getattr(ctx, "amp", False):
+        return tuple(
+            x.astype(jnp.bfloat16)
+            if x is not None and jnp.issubdtype(x.dtype, jnp.floating) else x
+            for x in xs)
+    return xs
+
+
+def recurrent_cast(amp: bool, weights=(), carries=()):
+    """AMP recipe for recurrences (lstm/gru/lstmp/attention decoder):
+    weights go bf16 once outside the scan, carries go f32 — the recurrent
+    state is an accumulator across T steps and bf16 drift compounds; step
+    bodies cast the carry to the weight dtype right before each matmul.
+    Returns (weights, carries) unchanged when ``amp`` is False."""
+    if amp:
+        weights = tuple(w.astype(jnp.bfloat16) for w in weights)
+        carries = tuple(c.astype(jnp.float32) for c in carries)
+    return weights, carries
+
+
+def f32_compute(ctx, x):
+    """Upcast a low-precision tensor to f32 for precision-sensitive math.
+
+    The caller is responsible for casting the result back (``x.dtype``) if
+    the value feeds further bf16 activation flow.
+    """
+    if getattr(ctx, "amp", False) and low_precision(x.dtype):
+        return x.astype(jnp.float32)
+    return x

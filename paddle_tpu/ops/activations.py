@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ._amp import f32_compute as _f32_compute
 
 
 def _register_act(name, fn):
@@ -56,12 +57,20 @@ for _n, _f in _ACTS.items():
 
 @register_op("softmax", inputs=("X",), outputs=("Out",))
 def softmax(ctx, ins, attrs):
-    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+    """AMP: the exp/normalize runs in f32 (the rowmax subtraction needs the
+    mantissa) but the result is stored back in the activation dtype —
+    attention probabilities are the largest tensor in a transformer and must
+    not be materialized f32. Loss-head consumers (cross_entropy) re-upcast."""
+    x = ins["X"][0]
+    xf = _f32_compute(ctx, x)
+    return {"Out": [jax.nn.softmax(xf, axis=attrs.get("axis", -1)).astype(x.dtype)]}
 
 
 @register_op("log_softmax", inputs=("X",), outputs=("Out",))
 def log_softmax(ctx, ins, attrs):
-    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+    x = ins["X"][0]
+    xf = _f32_compute(ctx, x)
+    return {"Out": [jax.nn.log_softmax(xf, axis=attrs.get("axis", -1)).astype(x.dtype)]}
 
 
 @register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",))

@@ -24,22 +24,28 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ._amp import recurrent_cast as _recurrent_cast
 
 
 def _attend(h, enc, enc_mask, wa):
-    """Luong general attention: scores = h Wa enc^T, masked softmax, context."""
-    q = h @ wa  # [N, H]
+    """Luong general attention: scores = h Wa enc^T, masked softmax, context.
+
+    Dtype-driven AMP: callers cast ``wa``/``enc`` to bf16 and carry ``h`` in
+    f32; the matmuls then run bf16 while the softmax normalizes in f32.
+    """
+    q = h.astype(wa.dtype) @ wa  # [N, H]
     scores = jnp.einsum("nh,nth->nt", q, enc)
-    scores = jnp.where(enc_mask, scores, jnp.finfo(scores.dtype).min)
+    scores = jnp.where(enc_mask, scores.astype(jnp.float32),
+                       jnp.finfo(jnp.float32).min)
     alpha = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("nt,nth->nh", alpha, enc)
+    ctx = jnp.einsum("nt,nth->nh", alpha.astype(enc.dtype), enc)
     return ctx, alpha
 
 
 def _decoder_step(emb_t, h_prev, c_prev, enc, enc_mask, wa, wx, wh, b):
     ctx, alpha = _attend(h_prev, enc, enc_mask, wa)
-    inp = jnp.concatenate([emb_t, ctx], axis=-1)
-    gates = inp @ wx + h_prev @ wh + b
+    inp = jnp.concatenate([emb_t, ctx.astype(emb_t.dtype)], axis=-1)
+    gates = inp.astype(wx.dtype) @ wx + h_prev.astype(wh.dtype) @ wh + b
     i, f, c_bar, o = jnp.split(gates, 4, axis=-1)
     c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(c_bar)
     h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
@@ -64,6 +70,9 @@ def attention_lstm_decoder(ctx_, ins, attrs):
          else jnp.zeros((wx.shape[1],), emb.dtype))
     n, td, _ = emb.shape
     ts = enc.shape[1]
+    (wa, wx, wh, enc, emb), (h0, c0) = _recurrent_cast(
+        getattr(ctx_, "amp", False),
+        weights=(wa, wx, wh, enc, emb), carries=(h0, c0))
     enc_mask = jnp.arange(ts)[None, :] < enc_len.reshape(-1, 1)
     trg_len = (ins["TrgLength"][0] if ins.get("TrgLength") and ins["TrgLength"][0] is not None
                else jnp.full((n,), td, jnp.int32))

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ._amp import f32_compute as _f32_compute
 
 
 def _gather_label(x, label):
@@ -23,6 +24,7 @@ def _gather_label(x, label):
 @register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",), diff_inputs=("X",))
 def cross_entropy(ctx, ins, attrs):
     x, label = ins["X"][0], ins["Label"][0]
+    x = _f32_compute(ctx, x)  # AMP: the log and the per-example loss stay f32
     eps = 1e-12
     if attrs.get("soft_label", False):
         y = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
@@ -39,6 +41,7 @@ def cross_entropy(ctx, ins, attrs):
 )
 def softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
+    logits = _f32_compute(ctx, logits)  # AMP: loss head stays f32
     log_p = jax.nn.log_softmax(logits, axis=-1)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)

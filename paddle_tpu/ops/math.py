@@ -13,6 +13,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ._amp import amp_operand as _amp_cast
+from ._amp import low_precision as _low_prec
 
 
 def _flatten2(x, num_col_dims):
@@ -26,13 +28,20 @@ def _flatten2(x, num_col_dims):
     return x.reshape(lead, rest)
 
 
-def _amp_cast(ctx, *xs):
-    """Under AMP, feed the MXU bf16 operands (f32 accumulation is preserved
-    via preferred_element_type at the call sites)."""
+def _dot_dtypes(ctx, *dtypes):
+    """(preferred_element_type, storage dtype) for a dot product.
+
+    The accumulator is always the promoted f32 type (requested explicitly —
+    the MXU accumulates f32 anyway, but interpret/CPU paths would not);
+    under AMP the *stored* result is bf16, with the convert fused into the
+    dot's epilogue so activations stay bf16 in HBM.
+    """
+    acc = functools.reduce(jnp.promote_types, dtypes)
+    if not jnp.issubdtype(acc, jnp.floating):
+        return None, acc
     if getattr(ctx, "amp", False):
-        return tuple(x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating)
-                     else x for x in xs)
-    return xs
+        return jnp.float32, jnp.bfloat16
+    return acc, acc
 
 
 @register_op("mul", inputs=("X", "Y"), outputs=("Out",))
@@ -40,12 +49,11 @@ def mul(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
     xnc = attrs.get("x_num_col_dims", 1)
     ync = attrs.get("y_num_col_dims", 1)
-    acc = jnp.promote_types(x.dtype, y.dtype)
+    pref, store = _dot_dtypes(ctx, x.dtype, y.dtype)
     x2, y2 = _amp_cast(ctx, _flatten2(x, xnc), _flatten2(y, ync))
-    out = jnp.dot(x2, y2,
-                  preferred_element_type=None if x2.dtype != acc else acc)
+    out = jnp.dot(x2, y2, preferred_element_type=pref)
     out_shape = x.shape[:xnc] + y.shape[ync:]
-    return {"Out": [out.reshape(out_shape).astype(acc)]}
+    return {"Out": [out.reshape(out_shape).astype(store)]}
 
 
 @register_op("matmul", inputs=("X", "Y"), outputs=("Out",))
@@ -55,7 +63,9 @@ def matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if attrs.get("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    pref, store = _dot_dtypes(ctx, x.dtype, y.dtype)
+    xc, yc = _amp_cast(ctx, x, y)
+    out = jnp.matmul(xc, yc, preferred_element_type=pref).astype(store)
     alpha = attrs.get("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha
@@ -78,6 +88,20 @@ def _register_elementwise(name, fn):
     @register_op(f"elementwise_{name}", inputs=("X", "Y"), outputs=("Out",))
     def impl(ctx, ins, attrs, _fn=fn):
         x, y = ins["X"][0], ins["Y"][0]
+        if (getattr(ctx, "amp", False)
+                and jnp.issubdtype(x.dtype, jnp.floating)
+                and jnp.issubdtype(y.dtype, jnp.floating)
+                and _low_prec(x.dtype) != _low_prec(y.dtype)):
+            # AMP: a bf16 activation meeting a *broadcast* f32 param (fc
+            # bias, scale vector, ...) stays bf16 instead of promoting the
+            # whole activation back to f32. A same-size f32 operand keeps
+            # its precision (deliberately-f32 values like the loss head
+            # must not be silently downcast by an elementwise op).
+            xs, ys = x.size, y.size
+            if _low_prec(x.dtype) and ys < xs:
+                y = y.astype(x.dtype)
+            elif _low_prec(y.dtype) and xs < ys:
+                x = x.astype(y.dtype)
         y = _broadcast_y(x, y, attrs.get("axis", -1))
         return {"Out": [_fn(x, y)]}
 

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ._amp import recurrent_cast as _recurrent_cast
 
 _ACT = {
     "sigmoid": jax.nn.sigmoid,
@@ -28,10 +29,17 @@ _ACT = {
 
 
 def _lstm_scan(x, h0, c0, w, bias, peephole, length, gate_act, cell_act, cand_act,
-               is_reverse=False):
-    """x: [N, T, 4H] (input projection already applied), w: [H, 4H]."""
+               is_reverse=False, amp=False):
+    """x: [N, T, 4H] (input projection already applied), w: [H, 4H].
+
+    AMP recipe for recurrences: the carry (h, c) stays f32 — the cell state
+    is an accumulator across T steps and bf16 drift compounds — while the
+    recurrent matmul runs bf16 (h cast per step, weight cast once). The
+    scan's carry dtype is then stable by construction.
+    """
     n, t, h4 = x.shape
     h = h4 // 4
+    (w,), (h0, c0) = _recurrent_cast(amp, weights=(w,), carries=(h0, c0))
     if is_reverse:
         # reverse within valid region
         idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
@@ -43,7 +51,7 @@ def _lstm_scan(x, h0, c0, w, bias, peephole, length, gate_act, cell_act, cand_ac
     def step(carry, inp):
         h_prev, c_prev = carry
         xt, m = inp
-        gates = xt + h_prev @ w
+        gates = xt + h_prev.astype(w.dtype) @ w
         i, f, c_bar, o = jnp.split(gates + bias, 4, axis=-1)
         if peephole is not None:
             p_i, p_f, p_o = jnp.split(peephole, 3)
@@ -104,6 +112,7 @@ def lstm(ctx, ins, attrs):
     hidden, cell, hT, cT = _lstm_scan(
         x, h0, c0, w, bias, peephole, length, gate_act, cell_act, cand_act,
         is_reverse=attrs.get("is_reverse", False),
+        amp=getattr(ctx, "amp", False),
     )
     return {"Hidden": [hidden], "Cell": [cell], "LastH": [hT], "LastC": [cT]}
 
@@ -129,6 +138,8 @@ def gru(ctx, ins, attrs):
               else jnp.full((n,), t, jnp.int32))
     gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
     cand_act = _ACT[attrs.get("activation", "tanh")]
+    (w_ur, w_c), (h0,) = _recurrent_cast(
+        getattr(ctx, "amp", False), weights=(w_ur, w_c), carries=(h0,))
     is_reverse = attrs.get("is_reverse", False)
     if is_reverse:
         idx = length.reshape(-1, 1) - 1 - jnp.arange(t)[None, :]
@@ -139,9 +150,11 @@ def gru(ctx, ins, attrs):
 
     def step(h_prev, inp):
         xt, m = inp
-        ur = gate_act(xt[:, : 2 * h] + h_prev @ w_ur + bias[: 2 * h])
+        ur = gate_act(xt[:, : 2 * h] + h_prev.astype(w_ur.dtype) @ w_ur
+                      + bias[: 2 * h])
         u, r = ur[:, :h], ur[:, h:]
-        c = cand_act(xt[:, 2 * h :] + (r * h_prev) @ w_c + bias[2 * h :])
+        c = cand_act(xt[:, 2 * h :] + (r * h_prev).astype(w_c.dtype) @ w_c
+                     + bias[2 * h :])
         h_new = u * h_prev + (1 - u) * c
         m = m[:, None]
         h_out = m * h_new + (1 - m) * h_prev
@@ -233,13 +246,15 @@ def lstmp(ctx, ins, attrs):
     cell_act = _ACT[attrs.get("cell_activation", "tanh")]
     cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
     proj_act = _ACT[attrs.get("proj_activation", "tanh")]
+    (w, w_proj), (r0, c0) = _recurrent_cast(
+        getattr(ctx, "amp", False), weights=(w, w_proj), carries=(r0, c0))
     xs = jnp.moveaxis(x, 1, 0)
     step_mask = (jnp.arange(t)[:, None] < length.reshape(1, -1)).astype(x.dtype)
 
     def step(carry, inp):
         r_prev, c_prev = carry
         xt, m = inp
-        gates = xt + r_prev @ w + bias
+        gates = xt + r_prev.astype(w.dtype) @ w + bias
         i, f, c_bar, o = jnp.split(gates, 4, axis=-1)
         if peephole is not None:
             p_i, p_f, p_o = jnp.split(peephole, 3)
@@ -251,7 +266,7 @@ def lstmp(ctx, ins, attrs):
             o = o + c_new * p_o
         o = gate_act(o)
         h_new = o * cell_act(c_new)
-        r_new = proj_act(h_new @ w_proj)
+        r_new = proj_act(h_new.astype(w_proj.dtype) @ w_proj)
         m = m[:, None]
         r_out = m * r_new + (1 - m) * r_prev
         c_out = m * c_new + (1 - m) * c_prev

@@ -155,7 +155,8 @@ print("GUARDED-DRYRUN-OK")
     env = dict(os.environ)
     if env.get("JAX_PLATFORMS") == "cpu":
         del env["JAX_PLATFORMS"]
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, cwd=REPO, env=env, timeout=900)
     assert out.returncode == 0, (out.stdout + out.stderr)[-4000:]

@@ -1,0 +1,165 @@
+"""Perf lab: hand-written pure-JAX ResNet-50 train step as a throughput
+ceiling reference for bench.py.
+
+The framework's bench (bench.py) runs ResNet-50 through the Program->XLA
+executor. This script runs the *same math* written directly in jax, so the
+difference isolates framework-introduced overhead (op-boundary casts, BN
+materialization, grad recomputation that XLA failed to CSE, ...) from
+chip/XLA limits. Variants:
+
+  python tools/perf_lab.py nchw    # framework's layout
+  python tools/perf_lab.py nhwc    # TPU-preferred logical layout
+
+Prints images/sec and analytic MFU (12.3 GFLOP/img fwd+bwd on a
+~197 TFLOP/s bf16 v5e chip).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 128
+IMAGE = 224
+CLASSES = 1000
+GFLOP_PER_IMG = 12.3
+PEAK_TFLOPS = 197.0
+
+
+def _conv(x, w, stride, layout):
+    if layout == "nchw":
+        dn = ("NCHW", "OIHW", "NCHW")
+        pads = [(w.shape[2] // 2, w.shape[2] // 2)] * 2
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        pads = [(w.shape[0] // 2, w.shape[0] // 2)] * 2
+    return jax.lax.conv_general_dilated(
+        x, w.astype(jnp.bfloat16), (stride, stride), pads,
+        dimension_numbers=dn)
+
+
+def _bn(x, p, layout, training=True):
+    caxis = 1 if layout == "nchw" else 3
+    axes = tuple(i for i in range(4) if i != caxis)
+    shape = [1] * 4
+    shape[caxis] = -1
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    inv = jax.lax.rsqrt(var + 1e-5)
+    y = (xf - mean.reshape(shape)) * inv.reshape(shape) * p["scale"].reshape(shape) \
+        + p["bias"].reshape(shape)
+    return y.astype(x.dtype)
+
+
+def init_params(rng, layout):
+    params = {}
+
+    def conv_p(name, cin, cout, k):
+        fan = cin * k * k
+        w = rng.randn(cout, cin, k, k).astype(np.float32) * np.sqrt(2.0 / fan)
+        if layout == "nhwc":
+            w = w.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        params[name + "_w"] = w
+        params[name + "_bn"] = {
+            "scale": np.ones(cout, np.float32),
+            "bias": np.zeros(cout, np.float32),
+        }
+        return name
+
+    blocks = []
+    conv_p("stem", 3, 64, 7)
+    cin = 64
+    for stage, (cmid, n, stride) in enumerate(
+            [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]):
+        for i in range(n):
+            name = f"s{stage}b{i}"
+            s = stride if i == 0 else 1
+            conv_p(name + "_c1", cin, cmid, 1)
+            conv_p(name + "_c2", cmid, cmid, 3)
+            conv_p(name + "_c3", cmid, cmid * 4, 1)
+            if cin != cmid * 4 or s != 1:
+                conv_p(name + "_sc", cin, cmid * 4, 1)
+            blocks.append((name, s, cin != cmid * 4 or s != 1))
+            cin = cmid * 4
+    params["fc_w"] = (rng.randn(2048, CLASSES).astype(np.float32)
+                     * np.sqrt(1.0 / 2048))
+    params["fc_b"] = np.zeros(CLASSES, np.float32)
+    return params, blocks
+
+
+def forward(params, blocks, img, label, layout):
+    x = img.astype(jnp.bfloat16)
+    if layout == "nhwc":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    x = _bn(_conv(x, params["stem_w"], 2, layout), params["stem_bn"], layout)
+    x = jax.nn.relu(x)
+    wdims = (1, 2) if layout == "nhwc" else (2, 3)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        tuple(3 if i in wdims else 1 for i in range(4)),
+        tuple(2 if i in wdims else 1 for i in range(4)),
+        [(1, 1) if i in wdims else (0, 0) for i in range(4)])
+    for name, stride, has_sc in blocks:
+        short = x
+        if has_sc:
+            short = _bn(_conv(x, params[name + "_sc_w"], stride, layout),
+                        params[name + "_sc_bn"], layout)
+        y = jax.nn.relu(_bn(_conv(x, params[name + "_c1_w"], stride, layout),
+                            params[name + "_c1_bn"], layout))
+        y = jax.nn.relu(_bn(_conv(y, params[name + "_c2_w"], 1, layout),
+                            params[name + "_c2_bn"], layout))
+        y = _bn(_conv(y, params[name + "_c3_w"], 1, layout),
+                params[name + "_c3_bn"], layout)
+        x = jax.nn.relu(short + y)
+    x = jnp.mean(x.astype(jnp.float32), axis=wdims)  # [N, 2048]
+    logits = x.astype(jnp.bfloat16) @ params["fc_w"].astype(jnp.bfloat16)
+    logits = logits.astype(jnp.float32) + params["fc_b"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, label, axis=1))
+
+
+def main():
+    layout = sys.argv[1] if len(sys.argv) > 1 else "nchw"
+    rng = np.random.RandomState(0)
+    params, blocks = init_params(rng, layout)
+    dev = jax.devices()[0]
+    params = jax.device_put(params, dev)
+    img = jax.device_put(rng.randn(BATCH, 3, IMAGE, IMAGE).astype(np.float32), dev)
+    label = jax.device_put(rng.randint(0, CLASSES, (BATCH, 1)), dev)
+    velo = jax.tree.map(jnp.zeros_like, params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, velo, img, label):
+        loss, grads = jax.value_and_grad(
+            lambda p: forward(p, blocks, img, label, layout))(params)
+        velo = jax.tree.map(lambda v, g: 0.9 * v + g, velo, grads)
+        params = jax.tree.map(lambda p, v: p - 0.1 * v, params, velo)
+        return params, velo, loss
+
+    for _ in range(5):
+        params, velo, loss = step(params, velo, img, label)
+    float(loss)
+
+    def run_n(n):
+        nonlocal params, velo
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, velo, loss = step(params, velo, img, label)
+        float(loss)
+        return time.perf_counter() - t0
+
+    t1, t2 = run_n(10), run_n(50)
+    dt = (t2 - t1) / 40
+    img_s = BATCH / dt
+    mfu = img_s * GFLOP_PER_IMG / 1e3 / PEAK_TFLOPS
+    print(f"pure-jax resnet50 {layout}: {img_s:.1f} img/s  "
+          f"step {dt*1e3:.2f} ms  MFU {mfu*100:.1f}%")
+
+
+if __name__ == "__main__":
+    main()
