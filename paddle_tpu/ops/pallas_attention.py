@@ -235,10 +235,36 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc):
+    """FA-2 backward math in dense form, honoring the PROVIDED lse — the
+    probabilities p = exp(s - lse) may be normalized against a *global*
+    softmax (ring attention blocks), so this must not renormalize locally.
+    q/do: [B,Tq,H,D]; k/v: [B,Tk,H,D]; out/lse from the global merge."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
+    p = jnp.exp(s - jnp.moveaxis(lse, 1, 2)[..., None])  # [B,H,Tq,Tk]
+    if causal:
+        tq, tk = p.shape[-2], p.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        p = jnp.where(mask[None, None], p, 0.0)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [B,Tq,H]
+    ds = p * (dp - jnp.moveaxis(delta, 1, 2)[..., None]) * sc
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
                         q_block=128, k_block=128, interpret=None):
     """FlashAttention-2 backward. All of q/k/v/out/do: [B, T, H, D];
-    lse: [B, T, H]. Returns (dq, dk, dv)."""
+    lse: [B, T, H]. Returns (dq, dk, dv). The provided lse is honored as-is
+    (it may be a globally-merged ring LSE), including in the ragged-shape
+    dense fallback."""
     b, t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
@@ -246,7 +272,7 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
     q_block = min(q_block, t)
     k_block = min(k_block, t)
     if t % q_block or t % k_block:
-        return _dense_bwd(q, k, v, do, causal, scale)
+        return _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc)
 
     def fold(x):
         return jnp.moveaxis(x, 2, 1).reshape(b * h, t, -1)

@@ -67,20 +67,171 @@ def _merge(acc, new):
     return o, m, l
 
 
+def _merge_normalized(o1, lse1, o2, lse2):
+    """Merge two NORMALIZED partial attention results via their LSEs.
+    o_i: [B,T,H,D] f32, lse_i: [B,T,H] f32 (-inf = no contributions)."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    a1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - m_safe))
+    a2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - m_safe))
+    denom = jnp.maximum(a1 + a2, 1e-38)
+    o = (o1 * a1[..., None] + o2 * a2[..., None]) / denom[..., None]
+    lse = jnp.where(a1 + a2 == 0.0, -jnp.inf, m_safe + jnp.log(denom))
+    return o, lse
+
+
+def _flash_ring_local(*, axis, n_shards, causal, sc, interpret):
+    """shard_map-local ring attention over the Pallas flash kernel.
+
+    Forward: each ring step runs the flash kernel on the resident K/V block
+    (causal on the diagonal block, dense below it, skipped above it) and
+    merges the normalized (out, lse) pairs — the O(T^2) logits never
+    materialize. Backward (custom_vjp): a second ring pass where the
+    rotating (k, v) carry their grad accumulators; each step runs the FA-2
+    backward kernels against the GLOBAL lse (so p = exp(s - lse) are the
+    exact global probabilities) — dq accumulates locally, dk/dv ride the
+    ring home. This is the FlashAttention-2 recipe distributed over ICI.
+    """
+    from ..ops.pallas_attention import flash_attention_bwd, flash_attention_fwd
+
+    neg_inf = jnp.float32(-jnp.inf)
+    perm = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+
+    def blk_diag(args):
+        q, k, v = args
+        o, l = flash_attention_fwd(q, k, v, causal=True, scale=sc,
+                                   return_lse=True, interpret=interpret)
+        return o, l
+
+    def blk_full(args):
+        q, k, v = args
+        o, l = flash_attention_fwd(q, k, v, causal=False, scale=sc,
+                                   return_lse=True, interpret=interpret)
+        return o, l
+
+    def blk_skip(args):
+        q, _, _ = args
+        return jnp.zeros_like(q), jnp.full(q.shape[:3], neg_inf)
+
+    def ring_fwd(q, k, v):
+        idx = lax.axis_index(axis)
+        o0 = jnp.zeros(q.shape, jnp.float32)
+        l0 = jnp.full(q.shape[:3], neg_inf)
+
+        def body(i, carry):
+            (o, l), (k_i, v_i) = carry
+            src = (idx + i) % n_shards
+            if causal:
+                o_n, l_n = lax.cond(
+                    src == idx, blk_diag,
+                    lambda a: lax.cond(src < idx, blk_full, blk_skip, a),
+                    (q, k_i, v_i))
+            else:
+                o_n, l_n = blk_full((q, k_i, v_i))
+            o, l = _merge_normalized(o, l, o_n.astype(jnp.float32), l_n)
+            k_n = lax.ppermute(k_i, axis, perm)
+            v_n = lax.ppermute(v_i, axis, perm)
+            return (o, l), (k_n, v_n)
+
+        (o, l), _ = lax.fori_loop(0, n_shards, body, ((o0, l0), (k, v)))
+        return o.astype(q.dtype), l
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        o, _ = ring_fwd(q, k, v)
+        return o
+
+    def ring_fwd_rule(q, k, v):
+        o, l = ring_fwd(q, k, v)
+        return o, (q, k, v, o, l)
+
+    def ring_bwd_rule(res, do):
+        q, k, v, out, lse = res
+        idx = lax.axis_index(axis)
+
+        def bwd_diag(args):
+            k_j, v_j = args
+            return flash_attention_bwd(q, k_j, v_j, out, lse, do,
+                                       causal=True, scale=sc,
+                                       interpret=interpret)
+
+        def bwd_full(args):
+            k_j, v_j = args
+            return flash_attention_bwd(q, k_j, v_j, out, lse, do,
+                                       causal=False, scale=sc,
+                                       interpret=interpret)
+
+        def bwd_skip(args):
+            k_j, v_j = args
+            return jnp.zeros_like(q), jnp.zeros_like(k_j), jnp.zeros_like(v_j)
+
+        def body(i, carry):
+            dq, k_j, v_j, dk_j, dv_j = carry
+            src = (idx + i) % n_shards
+            if causal:
+                dq_n, dk_n, dv_n = lax.cond(
+                    src == idx, bwd_diag,
+                    lambda a: lax.cond(src < idx, bwd_full, bwd_skip, a),
+                    (k_j, v_j))
+            else:
+                dq_n, dk_n, dv_n = bwd_full((k_j, v_j))
+            dq = dq + dq_n.astype(jnp.float32)
+            dk_j = dk_j + dk_n.astype(jnp.float32)
+            dv_j = dv_j + dv_n.astype(jnp.float32)
+            # k/v rotate WITH their grad accumulators; after n steps both
+            # are home with one contribution from every device
+            k_j = lax.ppermute(k_j, axis, perm)
+            v_j = lax.ppermute(v_j, axis, perm)
+            dk_j = lax.ppermute(dk_j, axis, perm)
+            dv_j = lax.ppermute(dv_j, axis, perm)
+            return dq, k_j, v_j, dk_j, dv_j
+
+        dq0 = jnp.zeros(q.shape, jnp.float32)
+        dq, _, _, dk, dv = lax.fori_loop(
+            0, n_shards, body,
+            (dq0, k, v, jnp.zeros(k.shape, jnp.float32),
+             jnp.zeros(v.shape, jnp.float32)))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring.defvjp(ring_fwd_rule, ring_bwd_rule)
+    return ring
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
                    scale: Optional[float] = None,
-                   batch_axis: Optional[str] = None):
+                   batch_axis: Optional[str] = None,
+                   impl: str = "flash",
+                   interpret: Optional[bool] = None):
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q,k,v: [B, T, H, D] global arrays (or shardings compatible with
     P(batch_axis, axis, None, None)). Returns [B, T, H, D] with the same
-    sharding as q.
+    sharding as q. ``impl='flash'`` (default) runs the Pallas flash kernel
+    per K/V shard with LSE ring merging; ``impl='dense'`` keeps the
+    XLA-composed per-block softmax (oracle / debugging, and the path to use
+    inside ``jax.checkpoint`` regions — pallas_call cannot trace under
+    remat; the IR-level recompute op already falls back the same way).
+    ``interpret`` overrides Pallas interpret mode; by default it follows the
+    MESH's devices (a CPU mesh on a TPU-default host must interpret).
     """
+    if impl not in ("flash", "dense"):
+        raise ValueError(f"ring_attention impl must be 'flash' or 'dense', "
+                         f"got {impl!r}")
     d = q.shape[-1]
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     n_shards = mesh.shape[axis]
     t_local = q.shape[1] // n_shards
     spec = P(batch_axis, axis, None, None)
+
+    if impl == "flash":
+        if interpret is None:
+            interpret = any(d.platform != "tpu"
+                            for d in mesh.devices.flat)
+        local = _flash_ring_local(axis=axis, n_shards=n_shards,
+                                  causal=causal, sc=sc, interpret=interpret)
+        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        return fn(q, k, v)
 
     def local_fn(q, k, v):
         # q,k,v: local shards [B, T/sp, H, D]
