@@ -280,7 +280,7 @@ def array_read(ctx, ins, attrs):
 
 @register_op("array_length", inputs=("Len",), outputs=("Out",), no_grad=True)
 def array_length(ctx, ins, attrs):
-    return {"Out": [jnp.reshape(ins["Len"][0], ()).astype(jnp.int64)]}
+    return {"Out": [jnp.reshape(ins["Len"][0], ()).astype(jnp.int32)]}
 
 
 # ---------------------------------------------------------------------------
@@ -318,10 +318,12 @@ def recompute_op(ctx, ins, attrs):
     def segment(*hold_vals):
         env = dict(zip(hold_names, hold_vals))
         sub = _sub_ctx(ctx, key)
-        # pallas_call cannot be traced under the checkpoint transform
-        # (pl.program_id needs a grid context the remat re-trace lacks);
-        # kernels with a pallas fast path consult this and use their
-        # XLA-composed equivalent inside remat segments
+        # inside this segment, gradients come from jax.vjp of the whole
+        # checkpointed function rather than IR-level grad ops; kernels built
+        # on primitives WITHOUT an AD rule (bare pallas_call) consult this
+        # marker and switch to a differentiable form — e.g. flash_attention
+        # routes through its custom_vjp entry point (pallas_attention.py),
+        # which remat replays as a unit
         sub.in_remat = True
         runner.run_block(sub_idx, env, sub)
         return tuple(env[n] for n in out_names)

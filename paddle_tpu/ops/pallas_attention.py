@@ -376,11 +376,18 @@ def flash_attention_op(ctx, ins, attrs):
     causal = attrs.get("causal", False)
     scale = attrs.get("scale")
     if getattr(ctx, "in_remat", False):
-        # inside a recompute segment: pallas_call can't trace under
-        # jax.checkpoint — use the exact XLA-composed attention instead
-        d = q.shape[-1]
-        sc = scale if scale is not None else 1.0 / (d ** 0.5)
-        out, lse = _dense_attention_with_lse(q, k, v, causal, sc)
+        # inside a recompute segment the segment body is differentiated by
+        # jax.vjp directly (not via IR grad ops), and a bare pallas_call has
+        # no AD rule — so use the custom_vjp entry point: remat replays the
+        # Pallas forward as a unit and the FA-2 backward kernels provide the
+        # grads. The LSE residual is grad-irrelevant here (grads flow
+        # through the custom_vjp, and nothing outside the segment reads the
+        # LSE of an op inside it), so emit a stop_gradient placeholder
+        # rather than paying a second pass to extract it.
+        out = flash_attention(q, k, v, causal, scale,
+                              attrs.get("q_block", 128),
+                              attrs.get("k_block", 128))
+        lse = lax.stop_gradient(jnp.zeros(q.shape[:3], jnp.float32))
         return {"Out": [out], "LSE": [lse]}
     out, lse = flash_attention_fwd(
         q, k, v, causal=causal, scale=scale,
