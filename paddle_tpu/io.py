@@ -5,10 +5,19 @@ save/load_inference_model io.py:551,654, checkpoints io.py:802,882) and
 save_op.cc/load_op.cc tensor serialization.
 
 Format: one directory per save; each variable is a .npy file (name URL-quoted
-for filesystem safety), the program a JSON IR file (``__model__``). Sharded
-jax arrays are gathered to host before writing; loading re-places them on the
-executor's device at first use. Checkpoints keep the reference's numbered
-``checkpoint_N`` + ``_SUCCESS`` marker protocol so resume semantics match.
+for filesystem safety), the program a JSON IR file (``__model__``).
+Checkpoints keep the reference's numbered ``checkpoint_N`` + ``_SUCCESS``
+marker protocol so resume semantics match.
+
+Sharded arrays (ParallelExecutor-placed params on a multi-device mesh) are
+saved WITHOUT a host gather: each non-replica shard writes its own
+``<name>.shard<K>.npy`` (shard-sized host transfer only) plus a
+``<name>.shards.json`` descriptor recording the global shape and per-shard
+slice indices — the TPU re-expression of the reference pservers
+checkpointing their own parameter shards (go/pserver/service.go:346).
+Loading re-places each shard directly on its device when the live value's
+sharding matches the descriptor; otherwise it stitches the global array on
+host as a compatibility fallback.
 """
 from __future__ import annotations
 
@@ -26,19 +35,104 @@ from .core.ir import Program, Variable, default_main_program
 MODEL_FILENAME = "__model__"
 SUCCESS_MARKER = "_SUCCESS"
 CHECKPOINT_PREFIX = "checkpoint"
+SHARD_META_SUFFIX = ".shards.json"
 
 
 def _var_path(dirname: str, name: str) -> str:
     return os.path.join(dirname, urllib.parse.quote(name, safe="") + ".npy")
 
 
+def _shard_meta_path(dirname: str, name: str) -> str:
+    return os.path.join(dirname,
+                        urllib.parse.quote(name, safe="") + SHARD_META_SUFFIX)
+
+
 def _is_persistable(var: Variable) -> bool:
     return bool(var.persistable)
 
 
+def _is_multi_shard(val) -> bool:
+    import jax
+
+    return (isinstance(val, jax.Array)
+            and len(val.sharding.device_set) > 1
+            and not val.sharding.is_fully_replicated)
+
+
+def _slice_bounds(index, shape):
+    """Normalize a shard's index (tuple of slices) to [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _save_sharded(dirname: str, name: str, val) -> None:
+    """Per-shard save: each non-replica shard becomes its own .npy (only a
+    shard-sized device->host transfer), indexed by a JSON descriptor. The
+    global array is never materialized on host."""
+    base = urllib.parse.quote(name, safe="")
+    meta = {"global_shape": list(val.shape), "dtype": str(val.dtype),
+            "shards": []}
+    k = 0
+    for sh in val.addressable_shards:
+        if sh.replica_id != 0:
+            continue  # replicas carry identical data
+        fname = f"{base}.shard{k}.npy"
+        np.save(os.path.join(dirname, fname), np.asarray(sh.data))
+        meta["shards"].append({
+            "file": fname,
+            "index": _slice_bounds(sh.index, val.shape),
+        })
+        k += 1
+    with open(_shard_meta_path(dirname, name), "w") as f:
+        json.dump(meta, f)
+
+
+def _load_sharded(dirname: str, name: str, current=None):
+    """Load a per-shard save. If the live value ``current`` is sharded with
+    the same per-device slices, each shard file is device_put straight onto
+    its device (no host gather). Otherwise the global array is stitched on
+    host (compatibility: mesh changed between save and load)."""
+    import jax
+
+    with open(_shard_meta_path(dirname, name)) as f:
+        meta = json.load(f)
+    shape = tuple(meta["global_shape"])
+    by_index = {tuple(tuple(b) for b in s["index"]): s["file"]
+                for s in meta["shards"]}
+
+    if _is_multi_shard(current) and tuple(current.shape) == shape:
+        sharding = current.sharding
+        idx_map = sharding.addressable_devices_indices_map(shape)
+        arrays = []
+        ok = True
+        for dev, index in idx_map.items():
+            key = tuple(tuple(b) for b in _slice_bounds(index, shape))
+            fname = by_index.get(key)
+            if fname is None:
+                ok = False
+                break
+            data = np.load(os.path.join(dirname, fname))
+            arrays.append(jax.device_put(data, dev))
+        if ok:
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+
+    # fallback: stitch the global array on host
+    out = np.empty(shape, dtype=meta["dtype"])
+    for s in meta["shards"]:
+        sl = tuple(slice(a, b) for a, b in s["index"])
+        out[sl] = np.load(os.path.join(dirname, s["file"]))
+    return out
+
+
 def save_vars(executor, dirname, main_program=None, vars: Optional[Sequence] = None,
               predicate=None, scope: Optional[Scope] = None):
-    """<- io.py save_vars. Writes each selected var's ndarray."""
+    """<- io.py save_vars. Writes each selected var's ndarray; multi-device
+    sharded values are written per-shard (see module docstring)."""
     program = main_program or default_main_program()
     scope = scope or global_scope()
     if vars is None:
@@ -49,7 +143,10 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[Sequence] = N
         val = scope.get(name)
         if val is None:
             raise RuntimeError(f"variable {name!r} has no value in scope")
-        np.save(_var_path(dirname, name), np.asarray(val))
+        if _is_multi_shard(val):
+            _save_sharded(dirname, name, val)
+        else:
+            np.save(_var_path(dirname, name), np.asarray(val))
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
@@ -60,6 +157,9 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         vars = [v for v in program.list_vars() if (predicate or _is_persistable)(v)]
     for v in vars:
         name = v if isinstance(v, str) else v.name
+        if os.path.exists(_shard_meta_path(dirname, name)):
+            scope.set(name, _load_sharded(dirname, name, scope.get(name)))
+            continue
         path = _var_path(dirname, name)
         if not os.path.exists(path):
             raise FileNotFoundError(f"no saved value for variable {name!r} at {path}")
@@ -133,6 +233,9 @@ def load_inference_model(dirname, executor, scope=None):
     scope = scope or global_scope()
     for v in program.list_vars():
         if v.persistable:
+            if os.path.exists(_shard_meta_path(dirname, v.name)):
+                scope.set(v.name, _load_sharded(dirname, v.name, scope.get(v.name)))
+                continue
             path = _var_path(dirname, v.name)
             if os.path.exists(path):
                 scope.set(v.name, np.load(path))
