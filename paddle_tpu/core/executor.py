@@ -325,11 +325,24 @@ class Executor:
 
 
 def _to_device_array(v, program: Program, name: str, device=None):
-    """numpy / python value -> jax array, respecting the declared var dtype."""
+    """numpy / python value -> jax array, respecting the declared var dtype.
+
+    int64 policy (types.py): device ints are int32. int64 feeds are
+    range-checked here (a cheap host-side minmax) and cast explicitly —
+    an id >= 2^31 raises instead of silently truncating.
+    """
     if isinstance(v, jax.Array):
         return v
     arr = np.asarray(v)
     var = program.global_block().find_var_recursive(name)
     if var is not None and var.dtype is not None:
         arr = arr.astype(var.dtype.np_dtype, copy=False)
+    if arr.dtype == np.int64:
+        if arr.size and (arr.max() > np.iinfo(np.int32).max
+                         or arr.min() < np.iinfo(np.int32).min):
+            raise OverflowError(
+                f"feed {name!r} holds int64 values outside the int32 range; "
+                f"the device integer width is int32 (see types.py int64 "
+                f"policy) — re-index ids below 2^31")
+        arr = arr.astype(np.int32)
     return jax.device_put(arr, device)

@@ -81,7 +81,14 @@ _TO_JNP = {
     DataType.UINT8: jnp.uint8,
     DataType.INT16: jnp.int16,
     DataType.INT32: jnp.int32,
-    DataType.INT64: jnp.int64,
+    # int64 POLICY: the device-side integer width is int32 (jax x64 stays
+    # off — the TPU has no native 64-bit int path and enabling x64 globally
+    # would double every index tensor). INT64 remains a declarable IR dtype
+    # for API parity and host IO (np_dtype above is int64), but lowers to
+    # int32 on device; the executor range-checks int64 FEEDS against int32
+    # bounds and raises instead of truncating silently (executor.py
+    # _to_device_array). Ids/vocab >= 2^31 are out of contract.
+    DataType.INT64: jnp.int32,
     DataType.FP16: jnp.float16,
     DataType.FP32: jnp.float32,
     DataType.FP64: jnp.float64,

@@ -61,7 +61,7 @@ def mean_iou(ctx, ins, attrs):
     n = attrs["num_classes"]
     pred = pred.reshape(-1).astype(jnp.int32)
     label = label.reshape(-1).astype(jnp.int32)
-    conf = jnp.zeros((n, n), jnp.int64).at[label, pred].add(1)
+    conf = jnp.zeros((n, n), jnp.int32).at[label, pred].add(1)
     inter = jnp.diagonal(conf)
     union = conf.sum(0) + conf.sum(1) - inter
     valid = union > 0

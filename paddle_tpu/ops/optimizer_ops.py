@@ -243,8 +243,8 @@ def average_accumulates(ctx, ins, attrs):
     # window complete: rotate the CURRENT pool into sum_3 wholesale, carrying
     # its sample count into old_num (the reference's condition)
     window = jnp.minimum(
-        jnp.asarray(max_avg, jnp.int64),
-        (num_upd * avg_window).astype(jnp.int64))
+        jnp.asarray(max_avg, jnp.int32),
+        (num_upd * avg_window).astype(jnp.int32))
     roll = (num_acc >= min_avg) & (num_acc >= window)
     s3 = jnp.where(roll, s1 + s2, s3)
     old_num = jnp.where(roll, num_acc, old_num)

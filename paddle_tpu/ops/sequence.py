@@ -288,7 +288,7 @@ def lod_rank_table(ctx, ins, attrs):
 @register_op("max_sequence_len", inputs=("RankTable",), outputs=("Out",),
              no_grad=True)
 def max_sequence_len(ctx, ins, attrs):
-    return {"Out": [jnp.max(ins["RankTable"][0]).astype(jnp.int64)]}
+    return {"Out": [jnp.max(ins["RankTable"][0]).astype(jnp.int32)]}
 
 
 @register_op("reorder_lod_tensor_by_rank", inputs=("X", "RankTable"),

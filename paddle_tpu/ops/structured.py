@@ -134,8 +134,8 @@ def crf_decoding(ctx, ins, attrs):
     label = label[0] if label else None
     if label is not None:
         label = jnp.reshape(label, (n, t)).astype(path.dtype)
-        return {"ViterbiPath": [((path == label) & mask).astype(jnp.int64)]}
-    return {"ViterbiPath": [path.astype(jnp.int64)]}
+        return {"ViterbiPath": [((path == label) & mask).astype(jnp.int32)]}
+    return {"ViterbiPath": [path.astype(jnp.int32)]}
 
 
 @register_op("warpctc", inputs=("Logits", "Label", "LogitsLength", "LabelLength"),
@@ -240,8 +240,8 @@ def ctc_align(ctx, ins, attrs):
     out = jnp.full((n, t + 1), pad_value, jnp.int32)
     rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, t))
     out = out.at[rows, slot].set(jnp.where(keep, x, pad_value))
-    return {"Output": [out[:, :t].astype(jnp.int64)],
-            "OutLength": [keep.sum(axis=1).astype(jnp.int64)]}
+    return {"Output": [out[:, :t].astype(jnp.int32)],
+            "OutLength": [keep.sum(axis=1).astype(jnp.int32)]}
 
 
 @register_op("chunk_eval", inputs=("Inference", "Label", "Length"),
@@ -304,6 +304,6 @@ def chunk_eval(ctx, ins, attrs):
     r = jnp.where(num_lbl > 0, correct / num_lbl, 0.0).astype(jnp.float32)
     f1 = jnp.where(p + r > 0, 2 * p * r / (p + r), 0.0).astype(jnp.float32)
     return {"Precision": [p], "Recall": [r], "F1-Score": [f1],
-            "NumInferChunks": [num_inf.astype(jnp.int64)],
-            "NumLabelChunks": [num_lbl.astype(jnp.int64)],
-            "NumCorrectChunks": [correct.astype(jnp.int64)]}
+            "NumInferChunks": [num_inf.astype(jnp.int32)],
+            "NumLabelChunks": [num_lbl.astype(jnp.int32)],
+            "NumCorrectChunks": [correct.astype(jnp.int32)]}
