@@ -1,2 +1,3 @@
 from .mesh import make_mesh, sharding_for  # noqa: F401
 from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy  # noqa: F401
+from .pipeline import gpipe  # noqa: F401

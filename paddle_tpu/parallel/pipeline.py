@@ -1,0 +1,78 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe-style microbatching).
+
+The reference has no pipeline engine (its model-parallel story is layer-wise
+placement); this is the TPU-native implementation the 'pp' axis in
+``mesh.MESH_AXES`` promises: stage parameters are stacked on a leading axis
+and sharded ``P('pp')`` so each device owns one stage, and microbatches flow
+stage-to-stage over ICI via ``lax.ppermute`` inside ``shard_map``. The
+schedule is the classic GPipe fill-drain: M microbatches over S stages take
+M + S - 1 ticks, every device running the SAME stage function on its own
+weights each tick (SPMD — no per-stage programs to compile).
+
+``jax.grad`` through the schedule IS the pipeline backward: ppermute
+transposes to the reverse rotation, so backward microbatches drain in the
+opposite direction, exactly GPipe's backward pass.
+
+Restrictions (deliberate, minimal-but-real):
+  * stages are structurally homogeneous (same ``fn``, different weights) —
+    the transformer-stack case; embed/head layers run outside the pipeline;
+  * the microbatch count must divide the batch.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
+          axis: str = "pp", microbatches: int = 4):
+    """Run ``x`` through S pipeline stages of ``fn`` with GPipe scheduling.
+
+    fn(params_one_stage, x_mb) -> y_mb  must keep the microbatch shape
+    (stage i's output feeds stage i+1's input).
+    stage_params: pytree whose leaves have leading dim S == mesh.shape[axis]
+    (stacked per-stage weights; the caller shards or this call shards them
+    ``P('pp')``). x: [B, ...] with B % microbatches == 0.
+    Returns y: [B, ...] replicated over the pp axis.
+    """
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % microbatches:
+        raise ValueError(f"batch {batch} not divisible by microbatches "
+                         f"{microbatches}")
+    mb = batch // microbatches
+
+    def local(params, x):
+        # params leaves: [1, ...] (this device's stage); x: full batch
+        w = jax.tree.map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        xs = x.reshape((microbatches, mb) + x.shape[1:])
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        carry = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        outs = []
+        for t in range(microbatches + n_stages - 1):
+            # stage 0 injects microbatch t while filling; other stages (and
+            # stage 0 after the fill) consume what rotated in last tick
+            inject = xs[min(t, microbatches - 1)]
+            state = jnp.where(stage == 0, inject, carry)
+            y = fn(w, state)
+            if t >= n_stages - 1:
+                # the last stage emits microbatch t-(S-1)
+                outs.append(jnp.where(stage == n_stages - 1, y,
+                                      jnp.zeros_like(y)))
+            carry = lax.ppermute(y, axis, perm)
+        # only the last stage holds real outputs; psum replicates them
+        out = lax.psum(jnp.stack(outs), axis)
+        return out.reshape((batch,) + out.shape[2:])
+
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    fn_sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(), check_vma=False)
+    return fn_sharded(stage_params, x)
