@@ -12,9 +12,11 @@ reduce_strategy selects replicated vs sharded parameter placement.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -24,7 +26,17 @@ from .mesh import make_mesh, param_sharding, replicated
 
 
 class BuildStrategy:
-    """<- details/build_strategy.h:24 {kAllReduce, kReduce}."""
+    """<- details/build_strategy.h:24 {kAllReduce, kReduce}.
+
+    ``async_mode`` is the TPU re-expression of the reference's async pserver
+    training (listen_and_serv_op.cc RunAsyncLoop): LOCAL SGD. Each dp worker
+    takes ``local_sgd_steps`` fully-local optimizer steps (no gradient
+    collective at all — the analogue of workers pushing/pulling a stale
+    pserver param copy at their own pace), then the workers' parameters are
+    averaged over ICI. Staleness is bounded by the period instead of being
+    unbounded like the pserver queue, which is the sound collective version
+    of the same throughput-over-consistency trade.
+    """
 
     class ReduceStrategy:
         AllReduce = 0  # replicated params, gradient all-reduce (default)
@@ -33,6 +45,8 @@ class BuildStrategy:
     def __init__(self):
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.debug_graphviz_path = ""
+        self.async_mode = False
+        self.local_sgd_steps = 4  # sync period when async_mode is on
 
 
 class ExecutionStrategy:
@@ -82,6 +96,12 @@ class ParallelExecutor:
             raise ValueError("ParallelExecutor mesh must have a 'dp' axis")
         self.loss_name = loss_name
         self.amp = amp
+        self.async_mode = bool(getattr(self.build_strategy, "async_mode", False)
+                               or getattr(self.program, "_async_mode", False))
+        self.local_sgd_steps = int(getattr(self.build_strategy,
+                                           "local_sgd_steps", 4))
+        self._runs_since_sync = 0
+        self._avg_fn = None
         self._cache: Dict[Any, Any] = {}
         self._step_seed = 0
         self._placed = False
@@ -107,6 +127,77 @@ class ParallelExecutor:
             if src_platform != self._device0.platform:
                 return np.asarray(v)
         return v
+
+    # -- local SGD (async_mode) ---------------------------------------------
+    def _place_state_stacked(self, names: Sequence[str]):
+        """async_mode placement: every state var becomes [dp, *shape] sharded
+        P('dp') — each worker owns a full, independently-evolving copy."""
+        dp = self.mesh.shape["dp"]
+        sh = NamedSharding(self.mesh, PartitionSpec("dp"))
+        for n in names:
+            v = self.scope.get(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} missing from scope; run the startup program first"
+                )
+            arr = np.asarray(self._to_mesh_host(v))
+            self.scope.set(
+                n, jax.device_put(np.broadcast_to(arr, (dp,) + arr.shape), sh))
+
+    def _build_local_sgd_step(self, step, feed_sig_names):
+        """Wrap the traced step in shard_map: per-worker params (leading dp
+        dim), per-worker batch shard, NO collectives inside — local SGD."""
+        from jax import shard_map
+        from jax import lax
+
+        mesh = self.mesh
+
+        def local_fn(feed_vals, readonly, donated, key):
+            readonly = {k: v[0] for k, v in readonly.items()}
+            donated = {k: v[0] for k, v in donated.items()}
+            key = jax.random.fold_in(key, lax.axis_index("dp"))
+            fetches, new_state = step(feed_vals, readonly, donated, key)
+            return ([f[None] for f in fetches],
+                    {k: v[None] for k, v in new_state.items()})
+
+        def feed_spec(ndim):
+            return PartitionSpec(*(("dp",) + (None,) * (ndim - 1))) if ndim \
+                else PartitionSpec()
+
+        def wrapped(feed_vals, readonly, donated, key):
+            in_specs = (
+                {k: feed_spec(v.ndim) for k, v in feed_vals.items()},
+                {k: PartitionSpec("dp") for k in readonly},
+                {k: PartitionSpec("dp") for k in donated},
+                PartitionSpec(),
+            )
+            fn = shard_map(
+                local_fn, mesh=mesh, in_specs=in_specs,
+                out_specs=(PartitionSpec("dp"), PartitionSpec("dp")),
+                check_vma=False)
+            return fn(feed_vals, readonly, donated, key)
+
+        return wrapped
+
+    def _sync_workers(self, state_names: Sequence[str]):
+        """Average the workers' float state over dp (the local-SGD sync)."""
+        avg = self._avg_fn
+        if avg is None:
+            sh = NamedSharding(self.mesh, PartitionSpec("dp"))
+
+            @functools.partial(jax.jit, out_shardings=sh)
+            def avg(x):
+                return jnp.broadcast_to(jnp.mean(x, axis=0), x.shape)
+
+            # cache: a fresh closure per sync would defeat jit's cache and
+            # recompile the average at every period
+            self._avg_fn = avg
+
+        for n in state_names:
+            v = self.scope.get(n)
+            if (isinstance(v, jax.Array) and v.ndim >= 1
+                    and jnp.issubdtype(v.dtype, jnp.floating)):
+                self.scope.set(n, avg(v))
 
     # -- parameter placement (<- BCastParamsToGPUs, parallel_executor.cc:134) --
     def _place_state(self, names: Sequence[str]):
@@ -175,8 +266,13 @@ class ParallelExecutor:
             step, readonly_names, donated_names, state_out = build_step_fn(
                 self.program, 0, feed_names, fetch_names, amp=self.amp
             )
+            if self.async_mode:
+                step = self._build_local_sgd_step(step, feed_names)
             if not self._placed:
-                self._place_state(readonly_names + donated_names)
+                if self.async_mode:
+                    self._place_state_stacked(readonly_names + donated_names)
+                else:
+                    self._place_state(readonly_names + donated_names)
                 self._placed = True
             jitted = jax.jit(step, donate_argnums=(2,))
             entry = (jitted, readonly_names, donated_names, state_out)
@@ -193,6 +289,22 @@ class ParallelExecutor:
             fetches, new_state = fn(feed_vals, readonly, donated, key)
         for n in state_out:
             self.scope.set(n, new_state[n])
+        if self.async_mode:
+            self._runs_since_sync += 1
+            if self._runs_since_sync >= self.local_sgd_steps:
+                self._sync_workers(state_out)
+                self._runs_since_sync = 0
         if return_numpy:
-            fetches = [np.asarray(v) for v in fetches]
+            fetches = [self._merge_fetch(np.asarray(v)) if self.async_mode
+                       else np.asarray(v) for v in fetches]
         return fetches
+
+    @staticmethod
+    def _merge_fetch(arr: np.ndarray) -> np.ndarray:
+        """async_mode fetches arrive stacked [dp, ...] — per-worker scalars
+        (losses, stacked to rank 1) merge to their mean; everything of rank
+        >= 2 is a per-worker batch shard and concatenates back to the global
+        batch (the reference PE's fetch merge semantics)."""
+        if arr.ndim <= 1:
+            return arr.mean() if np.issubdtype(arr.dtype, np.floating) else arr[0]
+        return arr.reshape((-1,) + arr.shape[2:])

@@ -83,16 +83,16 @@ class DistributeTranspiler:
 
         ``pservers`` is accepted for API parity; its host list is ignored —
         the device mesh (ParallelExecutor's 'dp' axis spanning all hosts'
-        chips) plays that role. sync_mode=False raises: async SGD has no
-        sound collective analogue (deviation documented in the module
-        docstring).
+        chips) plays that role. ``sync_mode=False`` (the reference's async
+        pserver training, listen_and_serv_op.cc:166 RunAsyncLoop) maps to
+        LOCAL SGD: the program is marked async and ParallelExecutor runs
+        each dp worker's optimizer fully locally, averaging parameters every
+        BuildStrategy.local_sgd_steps — bounded staleness instead of the
+        pserver queue's unbounded staleness.
         """
-        if not sync_mode:
-            raise NotImplementedError(
-                "async pserver mode is intentionally unsupported on TPU; "
-                "use sync collective training (the default)"
-            )
         program = program or default_main_program()
+        if not sync_mode:
+            program._async_mode = True
         self._program = program
         self.trainer_id = trainer_id
         self.trainers = trainers
