@@ -220,25 +220,33 @@ class Select:
         return self
 
     def run(self, timeout: Optional[float] = None):
-        """Waits until one case fires; returns its callback result."""
-        deadline = None if timeout is None else (
-            threading.TIMEOUT_MAX if timeout < 0 else timeout)
-        waited = 0.0
+        """Waits until one case fires; returns its callback result.
+
+        The deadline is absolute (time.monotonic) and per-case waits are
+        clamped to the time remaining, so the call cannot overshoot
+        ``timeout`` by the per-case poll intervals."""
+        deadline = None if timeout is None or timeout < 0 else (
+            time.monotonic() + timeout)
+
+        def remaining():
+            if deadline is None:
+                return self._POLL
+            return min(self._POLL, max(deadline - time.monotonic(), 0.0))
+
         while True:
             for kind, ch, value, cb in self._cases:
                 if kind == "recv" and ch.can_recv():
-                    v, ok = ch.recv(timeout=self._POLL)
+                    v, ok = ch.recv(timeout=remaining())
                     if ok or ch.closed:
                         return cb(v) if cb else v
                 elif kind == "send" and ch.can_send():
                     try:
-                        if ch.send(value, timeout=self._POLL):
+                        if ch.send(value, timeout=remaining()):
                             return cb() if cb else None
                     except ChannelClosed:
                         continue
             if self._default is not None:
                 return self._default()
-            time.sleep(self._POLL)
-            waited += self._POLL
-            if deadline is not None and waited >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError("select timed out")
+            time.sleep(remaining())

@@ -128,21 +128,64 @@ def label_smooth(ctx, ins, attrs):
     return {"Out": [(1.0 - eps) * x + eps / k]}
 
 
-@register_op("print", inputs=("In",), outputs=("Out",), no_grad=True)
+def _print_grad_maker(op, no_grad_set):
+    """<- print_op.cc PrintOpProtoAndCheckGradOpMaker: the gradient passes
+    straight through (Out@GRAD -> In@GRAD), printed when print_phase says."""
+    from ..core.ir import grad_var_name
+
+    return [{
+        "type": "print_grad",
+        "inputs": {"Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]]},
+        "outputs": {"In@GRAD": [
+            "" if n in no_grad_set else grad_var_name(n) for n in op.inputs["In"]
+        ]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+@register_op("print", inputs=("In",), outputs=("Out",),
+             grad_maker=_print_grad_maker)
 def print_op(ctx, ins, attrs):
     """Debug print (<- print_op.cc): identity passthrough that prints the
     tensor from inside the compiled program via a host callback at execution
-    time, honoring first_n (prints stop after N executions) and summarize
-    (truncate to the first N elements) like the reference."""
+    time, honoring first_n (prints stop after N executions), summarize
+    (truncate to the first N elements), and print_phase like the reference.
+    Gradients pass through unchanged."""
     x = ins["In"][0]
-    msg = attrs.get("message", "") or ""
+    if attrs.get("print_phase", "both").lower() == "backward":
+        return {"Out": [x]}
+    return {"Out": [_print_emit(ctx, ins["In"][0], attrs)]}
+
+
+@register_op("print_grad", inputs=("Out@GRAD",), outputs=("In@GRAD",),
+             no_grad=True)
+def print_grad_op(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    if attrs.get("print_phase", "both").lower() == "forward":
+        return {"In@GRAD": [g]}
+    # NOTE: attrs is the grad op's own persistent IR dict — _print_emit mints
+    # its tag in place, so the first_n counter survives jit retraces (a
+    # per-call dict(attrs) copy here would reset it on every recompilation)
+    return {"In@GRAD": [_print_emit(ctx, g, attrs, msg_suffix="@GRAD ")]}
+
+
+def _print_emit(ctx, x, attrs, msg_suffix=""):
+    msg = (attrs.get("message", "") or "") + msg_suffix
     summarize = attrs.get("summarize", -1)
     first_n = attrs.get("first_n", -1)
     shown = x.reshape(-1)[:summarize] if summarize and summarize > 0 else x
-    # first_n counts per IR op, not per compilation: key the counter on the
-    # op's attrs-dict identity, which is stable across retraces of the same
-    # program (a trace-local closure would reset on every jit cache miss)
-    count = _PRINT_COUNTS.setdefault(id(attrs), {"n": 0})
+    if not _host_callbacks_supported():
+        # the axon tunnel backend rejects host send/recv at execution time
+        # (UNIMPLEMENTED); Print degrades to identity there rather than
+        # failing the whole program — fetch the tensor to inspect it
+        return x
+    # first_n counts per IR op, not per compilation: key the counter by a
+    # stable per-op tag minted at first trace and stored INTO attrs (id()
+    # of a dead dict can be recycled, inheriting an exhausted counter)
+    tag = attrs.get("_print_tag")
+    if tag is None:
+        tag = attrs["_print_tag"] = f"print{len(_PRINT_COUNTS)}"
+    count = _PRINT_COUNTS.setdefault(tag, {"n": 0})
 
     def _host_print(val):
         if first_n is None or first_n < 0 or count["n"] < first_n:
@@ -150,7 +193,28 @@ def print_op(ctx, ins, attrs):
             print(f"{msg}{val}", flush=True)
 
     jax.debug.callback(_host_print, shown)
-    return {"Out": [x]}
+    return x
+
+
+def _host_callbacks_supported() -> bool:
+    """False when the computation targets the axon tunnel backend.
+
+    The Executor/ParallelExecutor always pin ``jax.default_device`` to the
+    target place/mesh before tracing, so inside the framework the check is
+    precise. Bare callers tracing without a pinned default on a machine
+    where axon is the default backend conservatively get the identity
+    degrade (the callback would abort at execution time there).
+    """
+    dev = jax.config.jax_default_device
+    if dev is not None and dev.platform != "tpu":
+        return True
+    try:
+        import jax.extend.backend as jeb
+
+        version = getattr(jeb.get_backend(), "platform_version", "")
+    except Exception:
+        return True
+    return "axon" not in version
 
 
 _PRINT_COUNTS: dict = {}
