@@ -38,6 +38,14 @@ S2S_HIDDEN = 512
 S2S_BATCH = 64
 S2S_LEN = 32
 
+TLM_VOCAB = 32000
+TLM_D = 1024
+TLM_HEADS = 16
+TLM_LAYERS = 8
+TLM_FF = 4096
+TLM_T = 1024
+TLM_BATCH = 8
+
 
 def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS):
     """Per-step device time via two pipelined timings (N1 vs N2 steps each
@@ -159,7 +167,65 @@ def bench_seq2seq():
     }))
 
 
+def bench_transformer_lm():
+    """Decoder-only LM (flash attention, AMP) — the MXU-shaped workload;
+    net-new beyond the reference's benchmark suite (SURVEY.md §5.7)."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_lm
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        ids = fluid.layers.data("ids", shape=[TLM_T], dtype="int64")
+        labels = fluid.layers.data("labels", shape=[TLM_T], dtype="int64")
+        _, loss = transformer_lm(ids, labels, vocab_size=TLM_VOCAB,
+                                 max_len=TLM_T, d_model=TLM_D,
+                                 n_heads=TLM_HEADS, n_layers=TLM_LAYERS,
+                                 d_ff=TLM_FF)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
+
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=13)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    X = jax.device_put(
+        rng.randint(0, TLM_VOCAB, (TLM_BATCH, TLM_T)).astype("int32"), dev)
+    feed = {"ids": X, "labels": X}
+
+    step_time = _slope_time(
+        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+        lambda: exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope),
+        warmup=3, iters=20,
+    )
+    tokens = TLM_BATCH * TLM_T
+    tok_s = tokens / step_time
+    # analytic FLOPs/token: 6*N (fwd+bwd matmuls) + causal attention term
+    n_params = (TLM_LAYERS * (4 * TLM_D * TLM_D + 2 * TLM_D * TLM_FF)
+                + TLM_VOCAB * TLM_D)
+    flops_per_token = 6 * n_params + 6 * TLM_LAYERS * TLM_D * TLM_T
+    mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
+    print(json.dumps({
+        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # net-new workload; no reference number exists
+        "mfu": round(mfu, 4),
+        "step_ms": round(step_time * 1e3, 2),
+    }))
+
+
 def main():
+    try:
+        bench_transformer_lm()
+    except Exception as e:
+        print(json.dumps({
+            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec", "vs_baseline": None,
+            "error": str(e)[:200],
+        }))
     try:
         bench_seq2seq()
     except Exception as e:  # the flagship line must survive a seq2seq failure

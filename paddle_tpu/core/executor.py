@@ -324,19 +324,11 @@ class Executor:
         self._cache.clear()
 
 
-def _to_device_array(v, program: Program, name: str, device=None):
-    """numpy / python value -> jax array, respecting the declared var dtype.
-
-    int64 policy (types.py): device ints are int32. int64 feeds are
-    range-checked here (a cheap host-side minmax) and cast explicitly —
-    an id >= 2^31 raises instead of silently truncating.
-    """
-    if isinstance(v, jax.Array):
-        return v
-    arr = np.asarray(v)
-    var = program.global_block().find_var_recursive(name)
-    if var is not None and var.dtype is not None:
-        arr = arr.astype(var.dtype.np_dtype, copy=False)
+def coerce_int64_feed(arr: np.ndarray, name: str) -> np.ndarray:
+    """int64 policy (types.py): device ints are int32. int64 feeds are
+    range-checked (a cheap host-side minmax) and cast explicitly — an id
+    >= 2^31 raises instead of silently truncating. Shared by Executor and
+    ParallelExecutor so feed semantics cannot drift."""
     if arr.dtype == np.int64:
         if arr.size and (arr.max() > np.iinfo(np.int32).max
                          or arr.min() < np.iinfo(np.int32).min):
@@ -345,4 +337,16 @@ def _to_device_array(v, program: Program, name: str, device=None):
                 f"the device integer width is int32 (see types.py int64 "
                 f"policy) — re-index ids below 2^31")
         arr = arr.astype(np.int32)
+    return arr
+
+
+def _to_device_array(v, program: Program, name: str, device=None):
+    """numpy / python value -> jax array, respecting the declared var dtype."""
+    if isinstance(v, jax.Array):
+        return v
+    arr = np.asarray(v)
+    var = program.global_block().find_var_recursive(name)
+    if var is not None and var.dtype is not None:
+        arr = arr.astype(var.dtype.np_dtype, copy=False)
+    arr = coerce_int64_feed(arr, name)
     return jax.device_put(arr, device)

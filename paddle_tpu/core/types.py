@@ -138,10 +138,13 @@ class Place:
     device_id: int = 0
 
     def jax_device(self) -> jax.Device:
+        # LOCAL devices only: under multi-host jax.distributed, jax.devices()
+        # lists every host's devices and a Place must never resolve to a
+        # remote one (a host can't commit arrays there)
         try:
-            devs = jax.devices(self.kind)
+            devs = jax.local_devices(backend=self.kind)
         except RuntimeError:
-            devs = jax.devices()  # fall back (e.g. TPUPlace on CPU-only CI)
+            devs = jax.local_devices()  # e.g. TPUPlace on CPU-only CI
         return devs[self.device_id % len(devs)]
 
     def __repr__(self) -> str:  # matches reference-style printing

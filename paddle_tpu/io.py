@@ -72,23 +72,43 @@ def _slice_bounds(index, shape):
 def _save_sharded(dirname: str, name: str, val) -> None:
     """Per-shard save: each non-replica shard becomes its own .npy (only a
     shard-sized device->host transfer), indexed by a JSON descriptor. The
-    global array is never materialized on host."""
+    global array is never materialized on host.
+
+    Multi-host safe: shard filenames encode the slice bounds (no collisions
+    between hosts writing to a shared directory — each host writes exactly
+    its own addressable shards), and each host writes its own descriptor
+    (``.shards.p<K>.json``); loading merges all descriptors."""
+    import jax
+
     base = urllib.parse.quote(name, safe="")
     meta = {"global_shape": list(val.shape), "dtype": str(val.dtype),
             "shards": []}
-    k = 0
     for sh in val.addressable_shards:
         if sh.replica_id != 0:
             continue  # replicas carry identical data
-        fname = f"{base}.shard{k}.npy"
+        bounds = _slice_bounds(sh.index, val.shape)
+        tag = "_".join(f"{a}x{b}" for a, b in bounds)
+        fname = f"{base}.shard{tag}.npy"
         np.save(os.path.join(dirname, fname), np.asarray(sh.data))
-        meta["shards"].append({
-            "file": fname,
-            "index": _slice_bounds(sh.index, val.shape),
-        })
-        k += 1
-    with open(_shard_meta_path(dirname, name), "w") as f:
+        meta["shards"].append({"file": fname, "index": bounds})
+    mpath = _shard_meta_path(dirname, name)
+    if jax.process_count() > 1:
+        mpath = mpath[: -len(SHARD_META_SUFFIX)] + \
+            f".shards.p{jax.process_index()}.json"
+    with open(mpath, "w") as f:
         json.dump(meta, f)
+
+
+def _shard_descriptors(dirname: str, name: str):
+    """All shard descriptor files for ``name`` (single- or multi-host)."""
+    import glob
+
+    base = os.path.join(dirname, urllib.parse.quote(name, safe=""))
+    out = []
+    if os.path.exists(base + SHARD_META_SUFFIX):
+        out.append(base + SHARD_META_SUFFIX)
+    out.extend(sorted(glob.glob(base + ".shards.p*.json")))
+    return out
 
 
 def _load_sharded(dirname: str, name: str, current=None):
@@ -98,11 +118,19 @@ def _load_sharded(dirname: str, name: str, current=None):
     host (compatibility: mesh changed between save and load)."""
     import jax
 
-    with open(_shard_meta_path(dirname, name)) as f:
-        meta = json.load(f)
+    meta = None
+    by_index = {}
+    for mpath in _shard_descriptors(dirname, name):
+        with open(mpath) as f:
+            m = json.load(f)
+        meta = meta or m
+        for s in m["shards"]:
+            by_index[tuple(tuple(b) for b in s["index"])] = s["file"]
+    if meta is None:
+        raise FileNotFoundError(f"no shard descriptors for {name!r} in {dirname}")
+    meta = dict(meta, shards=[{"index": [list(b) for b in k], "file": v}
+                              for k, v in by_index.items()])
     shape = tuple(meta["global_shape"])
-    by_index = {tuple(tuple(b) for b in s["index"]): s["file"]
-                for s in meta["shards"]}
 
     if _is_multi_shard(current) and tuple(current.shape) == shape:
         sharding = current.sharding
@@ -137,6 +165,8 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[Sequence] = N
     scope = scope or global_scope()
     if vars is None:
         vars = [v for v in program.list_vars() if (predicate or _is_persistable)(v)]
+    import jax
+
     os.makedirs(dirname, exist_ok=True)
     for v in vars:
         name = v if isinstance(v, str) else v.name
@@ -145,7 +175,9 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[Sequence] = N
             raise RuntimeError(f"variable {name!r} has no value in scope")
         if _is_multi_shard(val):
             _save_sharded(dirname, name, val)
-        else:
+        elif jax.process_index() == 0:
+            # replicated/unsharded values are identical on every host —
+            # exactly one writer avoids shared-filesystem races
             np.save(_var_path(dirname, name), np.asarray(val))
 
 
@@ -157,7 +189,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         vars = [v for v in program.list_vars() if (predicate or _is_persistable)(v)]
     for v in vars:
         name = v if isinstance(v, str) else v.name
-        if os.path.exists(_shard_meta_path(dirname, name)):
+        if _shard_descriptors(dirname, name):
             scope.set(name, _load_sharded(dirname, name, scope.get(name)))
             continue
         path = _var_path(dirname, name)
@@ -233,7 +265,7 @@ def load_inference_model(dirname, executor, scope=None):
     scope = scope or global_scope()
     for v in program.list_vars():
         if v.persistable:
-            if os.path.exists(_shard_meta_path(dirname, v.name)):
+            if _shard_descriptors(dirname, v.name):
                 scope.set(v.name, _load_sharded(dirname, v.name, scope.get(v.name)))
                 continue
             path = _var_path(dirname, v.name)
@@ -249,11 +281,29 @@ def load_inference_model(dirname, executor, scope=None):
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
                     max_num_checkpoints=3, scope=None, step=None):
+    import jax
+
     os.makedirs(checkpoint_dir, exist_ok=True)
     serial = _next_checkpoint_serial(checkpoint_dir) if step is None else step
     cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
     os.makedirs(cur, exist_ok=True)
     save_persistables(executor, cur, main_program, scope=scope)
+    if jax.process_count() > 1:
+        # every host must finish its shard writes before the chief marks the
+        # checkpoint complete (<- pservers each checkpointing their shard,
+        # master marking completion)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"checkpoint_{serial}_written")
+        if jax.process_index() == 0:
+            with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
+                f.write(str(trainer_id))
+            _scroll_delete(checkpoint_dir, max_num_checkpoints)
+        # second barrier: non-chief hosts must not race ahead before the
+        # marker exists — their next _next_checkpoint_serial would reuse N
+        # (overwriting these shards) and desynchronize the barrier keys
+        multihost_utils.sync_global_devices(f"checkpoint_{serial}_marked")
+        return serial
     with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
         f.write(str(trainer_id))
     _scroll_delete(checkpoint_dir, max_num_checkpoints)

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..core.executor import Scope, build_step_fn, global_scope
+from ..core.executor import Scope, build_step_fn, coerce_int64_feed, global_scope
 from ..core.ir import Program, default_main_program
 from .mesh import make_mesh, param_sharding, replicated
 
@@ -98,10 +98,21 @@ class ParallelExecutor:
         self.amp = amp
         self.async_mode = bool(getattr(self.build_strategy, "async_mode", False)
                                or getattr(self.program, "_async_mode", False))
+        if self.async_mode and jax.process_count() > 1:
+            raise NotImplementedError(
+                "local-SGD async_mode is single-controller for now: the "
+                "stacked per-worker placement and the global loss merge are "
+                "not multi-host aware — use sync collective training "
+                "(the default) across hosts")
         self.local_sgd_steps = int(getattr(self.build_strategy,
                                            "local_sgd_steps", 4))
         self._runs_since_sync = 0
         self._avg_fn = None
+        # multi-host SPMD (jax.distributed initialized, mesh spans hosts):
+        # feeds are PROCESS-LOCAL batch shards assembled into global arrays,
+        # fetches return the replicated value (or this host's shard of a
+        # batch output) — the reference's per-trainer data reading
+        self._multiprocess = jax.process_count() > 1
         self._cache: Dict[Any, Any] = {}
         self._step_seed = 0
         self._placed = False
@@ -109,8 +120,12 @@ class ParallelExecutor:
         # the axon TPU plugin registers itself as the default jax backend, so
         # an unpinned PRNGKey/device_put would land on the TPU even when the
         # mesh is the virtual CPU mesh, and resharding a TPU-committed array
-        # onto a CPU mesh forces _multi_slice on the TPU backend
-        self._device0 = self.mesh.devices.flat[0]
+        # onto a CPU mesh forces _multi_slice on the TPU backend. Multi-host:
+        # pin to this PROCESS's first mesh device (a remote device cannot be
+        # a default_device)
+        pid = jax.process_index()
+        mine = [d for d in self.mesh.devices.flat if d.process_index == pid]
+        self._device0 = mine[0] if mine else self.mesh.devices.flat[0]
 
     def _to_mesh_host(self, v):
         """Pull a cross-backend device array through host memory.
@@ -120,6 +135,11 @@ class ParallelExecutor:
         the mesh's own backend.
         """
         if isinstance(v, jax.Array):
+            if self._multiprocess:
+                # multi-host: a locally-committed array cannot device_put
+                # onto a global sharding (cross-host reshard); go via host —
+                # every process holds the same startup value
+                return np.asarray(v)
             try:
                 src_platform = next(iter(v.devices())).platform
             except Exception:
@@ -221,7 +241,17 @@ class ParallelExecutor:
                         spec[d] = "dp"
                         sh = NamedSharding(self.mesh, PartitionSpec(*spec))
                         break
-            self.scope.set(n, jax.device_put(self._to_mesh_host(v), sh))
+            val = self._to_mesh_host(v)
+            if self._multiprocess:
+                # build the global array from this host's copy of the value
+                # (identical on every host — startup ran with one seed);
+                # make_array_from_callback places only addressable shards
+                # and avoids device_put's cross-host verification collective
+                arr = np.asarray(val)
+                self.scope.set(n, jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx]))
+            else:
+                self.scope.set(n, jax.device_put(val, sh))
 
     def _feed_sharding(self, arr):
         spec = [None] * np.ndim(arr)
@@ -251,12 +281,18 @@ class ParallelExecutor:
             var = self.program.global_block().find_var_recursive(k)
             if var is not None and var.dtype is not None:
                 arr = arr.astype(var.dtype.np_dtype, copy=False)
+            arr = coerce_int64_feed(arr, k)
+            sh = self._feed_sharding(arr)
+            if self._multiprocess:
+                # each host feeds its own slice of the global batch
+                feed_vals[k] = jax.make_array_from_process_local_data(sh, arr)
+                continue
             if arr.ndim and arr.shape[0] % self.mesh.shape["dp"] != 0:
                 raise ValueError(
                     f"feed {k!r}: global batch {arr.shape[0]} not divisible by "
                     f"dp={self.mesh.shape['dp']}"
                 )
-            feed_vals[k] = jax.device_put(arr, self._feed_sharding(arr))
+            feed_vals[k] = jax.device_put(arr, sh)
 
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
         key_cache = (id(self.program), self.program.version, sig,
@@ -285,6 +321,13 @@ class ParallelExecutor:
             self._step_seed += 1
             seed = self._step_seed
         key = jax.random.PRNGKey(np.uint32(seed))
+        if self._multiprocess:
+            # the key must be a global (replicated) array: a locally-committed
+            # input cannot enter a multi-host jit
+            karr = np.asarray(key)
+            key = jax.make_array_from_callback(
+                karr.shape, NamedSharding(self.mesh, PartitionSpec()),
+                lambda idx: karr[idx])
         with self.mesh:
             fetches, new_state = fn(feed_vals, readonly, donated, key)
         for n in state_out:
@@ -295,9 +338,32 @@ class ParallelExecutor:
                 self._sync_workers(state_out)
                 self._runs_since_sync = 0
         if return_numpy:
-            fetches = [self._merge_fetch(np.asarray(v)) if self.async_mode
-                       else np.asarray(v) for v in fetches]
+            fetches = [self._merge_fetch(self._fetch_np(v)) if self.async_mode
+                       else self._fetch_np(v) for v in fetches]
         return fetches
+
+    def _fetch_np(self, v) -> np.ndarray:
+        """Fetch -> numpy. Multi-host: a replicated value reads this host's
+        copy; a sharded value yields THIS HOST's portion (e.g. the local
+        batch this process fed), stitched from its non-replica shards along
+        whatever dims are actually sharded."""
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            if v.sharding.is_fully_replicated or v.ndim == 0:
+                return np.asarray(v.addressable_shards[0].data)
+            shards = [s for s in v.addressable_shards if s.replica_id == 0]
+            starts = [min((s.index[d].start or 0) for s in shards)
+                      for d in range(v.ndim)]
+            stops = [max((s.index[d].stop if s.index[d].stop is not None
+                          else v.shape[d]) for s in shards)
+                     for d in range(v.ndim)]
+            out = np.empty([b - a for a, b in zip(starts, stops)], v.dtype)
+            for s in shards:
+                sl = tuple(slice((i.start or 0) - a,
+                                 (i.stop if i.stop is not None else dim) - a)
+                           for i, a, dim in zip(s.index, starts, v.shape))
+                out[sl] = np.asarray(s.data)
+            return out
+        return np.asarray(v)
 
     @staticmethod
     def _merge_fetch(arr: np.ndarray) -> np.ndarray:

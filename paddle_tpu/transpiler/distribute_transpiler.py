@@ -15,8 +15,9 @@ choosing *shardings*:
 * distributed (sparse) lookup tables -> embedding tables sharded on the
   vocab dim (see slice_vars_round_robin for the same block-split math as
   the reference); the gather/scatter-add collectives replace prefetch ops.
-* async mode has no collective analogue and is intentionally dropped
-  (documented deviation, SURVEY.md §7.7).
+* async mode -> LOCAL SGD (ParallelExecutor BuildStrategy.async_mode):
+  fully-local worker steps with periodic parameter averaging — bounded
+  staleness replacing the pserver queue's unbounded staleness.
 
 The class keeps the reference's call surface (transpile / get_trainer_program
 / get_pserver_program) so migration is mechanical.
