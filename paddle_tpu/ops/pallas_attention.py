@@ -96,7 +96,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None,
-                        q_block=128, k_block=128, interpret=None,
+                        q_block=512, k_block=512, interpret=None,
                         return_lse=False):
     """q,k,v: [B, T, H, D] -> out [B, T, H, D] (and lse [B, T, H])."""
     b, t, h, d = q.shape
@@ -260,7 +260,7 @@ def _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc):
 
 
 def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
-                        q_block=128, k_block=128, interpret=None):
+                        q_block=512, k_block=512, interpret=None):
     """FlashAttention-2 backward. All of q/k/v/out/do: [B, T, H, D];
     lse: [B, T, H]. Returns (dq, dk, dv). The provided lse is honored as-is
     (it may be a globally-merged ring LSE), including in the ragged-shape
@@ -385,13 +385,13 @@ def flash_attention_op(ctx, ins, attrs):
         # LSE of an op inside it), so emit a stop_gradient placeholder
         # rather than paying a second pass to extract it.
         out = flash_attention(q, k, v, causal, scale,
-                              attrs.get("q_block", 128),
-                              attrs.get("k_block", 128))
+                              attrs.get("q_block", 512),
+                              attrs.get("k_block", 512))
         lse = lax.stop_gradient(jnp.zeros(q.shape[:3], jnp.float32))
         return {"Out": [out], "LSE": [lse]}
     out, lse = flash_attention_fwd(
         q, k, v, causal=causal, scale=scale,
-        q_block=attrs.get("q_block", 128), k_block=attrs.get("k_block", 128),
+        q_block=attrs.get("q_block", 512), k_block=attrs.get("k_block", 512),
         return_lse=True,
     )
     return {"Out": [out], "LSE": [lse]}
@@ -414,8 +414,8 @@ def flash_attention_grad_op(ctx, ins, attrs):
     else:
         gq, gk, gv = flash_attention_bwd(
             q, k, v, out, lse, g, causal=causal, scale=scale,
-            q_block=attrs.get("q_block", 128),
-            k_block=attrs.get("k_block", 128))
+            q_block=attrs.get("q_block", 512),
+            k_block=attrs.get("k_block", 512))
     return {"Q@GRAD": [gq], "K@GRAD": [gk], "V@GRAD": [gv]}
 
 
@@ -428,8 +428,8 @@ def flash_attention_grad_op(ctx, ins, attrs):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, q_block=128,
-                    k_block=128):
+def flash_attention(q, k, v, causal=False, scale=None, q_block=512,
+                    k_block=512):
     """Differentiable flash attention over [B, T, H, D] (jax.grad-ready)."""
     return flash_attention_fwd(q, k, v, causal=causal, scale=scale,
                                q_block=q_block, k_block=k_block)
