@@ -306,6 +306,29 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
     return loss
 
 
+def fused_linear_cross_entropy(input, size: int, label, param_attr=None,
+                               bias_attr=None, chunk: int = 4096, name=None):
+    """Streamed LM head: cross_entropy(softmax(input @ W + b), label) with
+    the vocab dim scanned in chunks — the [N, size] logits never
+    materialize (net-new beyond the reference; see the op docstring).
+    Shares its weight with an ordinary ``fc`` head when given the same
+    ParamAttr name, so an inference-time logits path can coexist."""
+    helper = LayerHelper("fused_linear_cross_entropy", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr, name=name)
+    in_dim = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [in_dim, size], input.dtype)
+    bias = (helper.create_parameter(bias_attr, [size], input.dtype,
+                                    is_bias=True)
+            if bias_attr is not False else None)
+    loss = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    helper.append_op("fused_linear_cross_entropy", ins, {"Loss": [loss]},
+                     {"chunk": chunk})
+    return loss
+
+
 def sigmoid_cross_entropy_with_logits(x, label, name=None):
     helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)

@@ -92,7 +92,7 @@ def encoder_layer(x, d_model: int, n_heads: int, d_ff: int, causal: bool,
 def transformer_lm(ids, labels, vocab_size: int, max_len: int,
                    d_model: int = 128, n_heads: int = 4, n_layers: int = 2,
                    d_ff: int = 512, tp_shard: bool = False,
-                   use_recompute: bool = False):
+                   use_recompute: bool = False, fused_head: bool = False):
     """Decoder-only (causal) language model.
 
     ids/labels: [N, T] int64 with T <= max_len (labels = ids shifted by
@@ -120,10 +120,25 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
                           name=f"tlm.l{i}", tp_shard=tp_shard,
                           use_recompute=use_recompute)
     x = layers.layer_norm(x, begin_norm_axis=2)
+    # logits path (inference / fetching): ordinary fc. The training loss
+    # shares its weight+bias BY NAME with the streamed head below; when the
+    # logits are not fetched, XLA dead-code-eliminates this matmul.
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
-                       param_attr=ParamAttr("tlm.out.w"))
+                       param_attr=ParamAttr("tlm.out.w"),
+                       bias_attr=ParamAttr("tlm.out.b"))
     labels3 = layers.reshape(labels, [0, t, 1])
-    loss = layers.softmax_with_cross_entropy(logits, labels3)
+    if fused_head:
+        # streamed LM head: vocab scanned in chunks under an online
+        # logsumexp — the [N,T,V] logits never materialize in HBM. This is
+        # a MEMORY feature (huge-vocab / long-sequence configs where the
+        # logits don't fit): measured ~10% slower than the dense head at
+        # V=32k/T=1024 on-chip because the checkpointed backward recomputes
+        # each chunk's logits (one extra matmul pass). Default off.
+        loss = layers.fused_linear_cross_entropy(
+            x, vocab_size, labels3, param_attr=ParamAttr("tlm.out.w"),
+            bias_attr=ParamAttr("tlm.out.b"))
+    else:
+        loss = layers.softmax_with_cross_entropy(logits, labels3)
     avg_loss = layers.reduce_mean(loss)
     return logits, avg_loss
 

@@ -9,8 +9,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core.registry import register_op
+from ._amp import amp_operand as _amp_operand
 from ._amp import f32_compute as _f32_compute
 
 
@@ -48,6 +50,68 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     else:
         loss = -_gather_label(log_p, label)
     return {"Softmax": [jnp.exp(log_p)], "Loss": [loss]}
+
+
+@register_op(
+    "fused_linear_cross_entropy",
+    inputs=("X", "W", "Bias", "Label"),
+    outputs=("Loss",),
+    diff_inputs=("X", "W", "Bias"),
+)
+def fused_linear_cross_entropy(ctx, ins, attrs):
+    """Streamed LM head: softmax cross-entropy of ``X @ W (+ Bias)`` without
+    ever materializing the [N, V] logits in HBM. Net-new beyond the
+    reference (whose head is fc + softmax_with_cross_entropy): the vocab dim
+    is scanned in chunks under an online logsumexp, each chunk wrapped in
+    jax.checkpoint so the backward recomputes its logits instead of saving
+    them — the flash-attention trick applied to the vocabulary dimension.
+    Accumulation is f32; X/W enter the MXU in bf16 under AMP."""
+    x, w, label = ins["X"][0], ins["W"][0], ins["Label"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    chunk = int(attrs.get("chunk", 4096))
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    v = w.shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    ids = label.reshape(-1).astype(jnp.int32)
+
+    (x2,) = _amp_operand(ctx, x2)
+    chunk = min(chunk, v)
+    n_chunks = -(-v // chunk)
+
+    def one_chunk(carry, c_idx):
+        m, s, picked = carry
+        # slice W per chunk (never a padded/transposed copy of the full
+        # weight — at the huge-vocab scale this op exists for, that copy
+        # would dwarf the logits saving). The last chunk's start clamps to
+        # v - chunk; the validity mask below de-duplicates the overlap.
+        start = jnp.minimum(c_idx * chunk, v - chunk)
+        (w_i,) = _amp_operand(ctx, lax.dynamic_slice(w, (0, start), (d, chunk)))
+        logits = jnp.dot(x2, w_i, preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + lax.dynamic_slice(bias, (start,), (chunk,))
+        col = start + jnp.arange(chunk)
+        valid = col >= c_idx * chunk  # columns this chunk is responsible for
+        logits = jnp.where(valid[None, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        # the label's logit, if it falls in this chunk's window
+        hi = jnp.minimum((c_idx + 1) * chunk, v)
+        in_chunk = (ids >= c_idx * chunk) & (ids < hi)
+        local = jnp.clip(ids - start, 0, chunk - 1)
+        got = jnp.take_along_axis(logits, local[:, None], axis=-1)[:, 0]
+        picked = jnp.where(in_chunk, got, picked)
+        return (m_new, s, picked), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    p0 = jnp.zeros((n,), jnp.float32)
+    (m, s, picked), _ = lax.scan(jax.checkpoint(one_chunk), (m0, s0, p0),
+                                 jnp.arange(n_chunks))
+    loss = (m + jnp.log(s)) - picked
+    return {"Loss": [loss.reshape(lead + (1,))]}
 
 
 @register_op(

@@ -62,6 +62,47 @@ def test_lm_learns_copy_task():
     assert losses[-1] < 1.0 < losses[0]  # uniform = ln(30) ~ 3.4
 
 
+def test_fused_linear_cross_entropy_matches_dense_head():
+    """Streamed LM head (vocab scanned in chunks, logits never materialized)
+    reproduces fc + softmax_with_cross_entropy exactly: losses and trained
+    weights after several optimizer steps, incl. a chunk size that does not
+    divide the vocab (clamped-slice/masking path)."""
+
+    def build(fused, V=1000, D=16, chunk=300):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[D], dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            if fused:
+                loss = fluid.layers.fused_linear_cross_entropy(
+                    x, V, label, param_attr=fluid.ParamAttr("head.w"),
+                    bias_attr=fluid.ParamAttr("head.b"), chunk=chunk)
+            else:
+                logits = fluid.layers.fc(
+                    x, size=V, param_attr=fluid.ParamAttr("head.w"),
+                    bias_attr=fluid.ParamAttr("head.b"))
+                loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+            avg = fluid.layers.mean(loss)
+            fluid.optimizer.SGD(0.1).minimize(avg, startup)
+        return main, startup, avg
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 16).astype("float32")
+    Y = rng.randint(0, 1000, (32, 1)).astype("int64")
+    res = {}
+    for fused in (False, True):
+        main, startup, avg = build(fused)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope, seed=5)
+        ls = [float(exe.run(main, feed={"x": X, "label": Y},
+                            fetch_list=[avg], scope=scope)[0])
+              for _ in range(4)]
+        res[fused] = (ls, np.asarray(scope.get("head.w")))
+    np.testing.assert_allclose(res[True][0], res[False][0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(res[True][1], res[False][1], rtol=1e-4, atol=1e-6)
+
+
 def test_recompute_transformer_matches():
     """use_recompute changes memory behavior, not numerics."""
     outs = {}
