@@ -35,7 +35,7 @@ ITERS = 50
 S2S_VOCAB = 30000
 S2S_EMBED = 512
 S2S_HIDDEN = 512
-S2S_BATCH = 64
+S2S_BATCH = 128  # step time is flat 64->128 (scan-bound); 256 regresses
 S2S_LEN = 32
 
 TLM_VOCAB = 32000
