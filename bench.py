@@ -40,7 +40,7 @@ S2S_LEN = 32
 
 TLM_VOCAB = 32000
 TLM_D = 1024
-TLM_HEADS = 16
+TLM_HEADS = 8   # d_head = 128: full MXU contraction width in the attention kernels (16 heads/d_head 64 = 36% MFU; 8 heads = 49%)
 TLM_LAYERS = 8
 TLM_FF = 4096
 TLM_T = 1024

@@ -44,12 +44,17 @@ def cross_entropy(ctx, ins, attrs):
 def softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
     logits = _f32_compute(ctx, logits)  # AMP: loss head stays f32
-    log_p = jax.nn.log_softmax(logits, axis=-1)
     if attrs.get("soft_label", False):
+        log_p = jax.nn.log_softmax(logits, axis=-1)
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
-    else:
-        loss = -_gather_label(log_p, label)
-    return {"Softmax": [jnp.exp(log_p)], "Loss": [loss]}
+        return {"Softmax": [jnp.exp(log_p)], "Loss": [loss]}
+    # hard labels: loss = lse - picked directly — the full log-softmax
+    # tensor never materializes (for an LM head that tensor is
+    # [N*T, vocab] f32, the biggest buffer in the step); the Softmax
+    # output is computed lazily and dead-code-eliminated when unused
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    loss = lse - _gather_label(logits, label)
+    return {"Softmax": [jnp.exp(logits - lse)], "Loss": [loss]}
 
 
 @register_op(
