@@ -273,21 +273,51 @@ def test_local_sgd_async_mode_converges():
     assert losses[-1] < losses[0] * 0.6, losses[::6]
 
 
+def _run_two_process_workers(worker_src: str, extra_env=None, timeout=300):
+    """Spawn the same worker script as 2 jax.distributed processes over
+    localhost (PADDLE_* env protocol, pure CPU jax — axon plugin and the
+    virtual-device XLA_FLAGS are stripped). Returns both ranks' outputs;
+    kills stragglers if one rank hangs."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.pop("XLA_FLAGS", None)  # 1 CPU device per process
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TRAINER_ENDPOINTS"] = f"127.0.0.1:{port},127.0.0.1:{port + 1}"
+    env["PADDLE_TRAINERS_NUM"] = "2"
+    env.update(extra_env or {})
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for i in range(2):
+        e = dict(env)
+        e["PADDLE_TRAINER_ID"] = str(i)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker_src], stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, cwd=repo, env=e))
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout)[0])
+    finally:
+        for p in procs:  # a hung peer must not leak past the test
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
 def test_multihost_bootstrap_two_processes():
     """REAL 2-process cluster formation through the PADDLE_* env protocol
     (init_distributed <- gen_nccl_id + pserver bootstrap): coordination
     service over localhost gRPC, then a cross-process collective. Each
     subprocess drops the axon plugin (PYTHONPATH) so pure CPU jax hosts the
     2-process world."""
-    import os
-    import subprocess
-    import sys
-    import socket
-
-    with socket.socket() as s:  # free port for the coordinator
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
     worker = r'''
 import os, sys
 from paddle_tpu.distributed import init_distributed, trainer_id, trainer_num, RoleMaker
@@ -303,21 +333,7 @@ val = mhu.process_allgather(jnp.array([float(jax.process_index() + 1)]))
 assert val.reshape(-1).tolist() == [1.0, 2.0], val
 print("WORKER-OK", trainer_id(), flush=True)
 '''
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env.pop("XLA_FLAGS", None)  # 1 CPU device per process
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PADDLE_TRAINER_ENDPOINTS"] = f"127.0.0.1:{port},127.0.0.1:{port + 1}"
-    env["PADDLE_TRAINERS_NUM"] = "2"
-    procs = []
-    for i in range(2):
-        e = dict(env)
-        e["PADDLE_TRAINER_ID"] = str(i)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", worker], stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True, cwd=os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))), env=e))
-    outs = [p.communicate(timeout=240)[0] for p in procs]
+    outs = _run_two_process_workers(worker)
     for i, o in enumerate(outs):
         assert f"WORKER-OK {i}" in o, f"rank {i}:\n{o[-2000:]}"
 
@@ -329,13 +345,6 @@ def test_multihost_parallel_executor_training_matches():
     single-process run on the full batch — the reference's multi-node
     NCCL2 collective mode (gen_nccl_id + per-trainer readers) end to end."""
     import os
-    import socket
-    import subprocess
-    import sys
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
 
     worker = r'''
 import os, sys
@@ -391,24 +400,9 @@ descs = glob.glob(os.path.join(ckpt, "checkpoint_0", "*.shards.p*.json"))
 assert descs, "expected per-host shard descriptors"
 print("CKPT-OK", rank, flush=True)
 '''
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env.pop("XLA_FLAGS", None)  # 1 CPU device per process, not the virtual 8
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PADDLE_TRAINER_ENDPOINTS"] = f"127.0.0.1:{port},127.0.0.1:{port + 1}"
-    env["PADDLE_TRAINERS_NUM"] = "2"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     import tempfile
     ckpt_dir = tempfile.mkdtemp(prefix="mh_ckpt_")
-    env["MH_CKPT_DIR"] = ckpt_dir
-    procs = []
-    for i in range(2):
-        e = dict(env)
-        e["PADDLE_TRAINER_ID"] = str(i)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", worker], stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True, cwd=repo, env=e))
-    outs = [p.communicate(timeout=300)[0] for p in procs]
+    outs = _run_two_process_workers(worker, extra_env={"MH_CKPT_DIR": ckpt_dir})
     import re
     loss_lines = []
     for i, o in enumerate(outs):
@@ -440,6 +434,42 @@ print("CKPT-OK", rank, flush=True)
                         scope=scope)
         ref.append(float(lv))
     np.testing.assert_allclose(loss_lines[0], ref, rtol=1e-4, atol=1e-6)
+
+
+def test_multihost_ring_attention_matches_dense():
+    """Ring attention with the sequence sharded ACROSS HOSTS: 2 processes,
+    1 CPU device each, sp=2 mesh — the flash ring's ppermute rides the
+    cross-process collective plane and matches the dense oracle."""
+    worker = r'''
+import os, sys
+import numpy as np
+from paddle_tpu.distributed import init_distributed
+assert init_distributed()
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from paddle_tpu.parallel.context_parallel import dense_attention, ring_attention
+from paddle_tpu.parallel.mesh import make_mesh
+
+mesh = make_mesh({"sp": 2}, devices=jax.devices())
+rng = np.random.RandomState(0)
+b, t, h, d = 1, 16, 2, 8
+qh = rng.randn(b, t, h, d).astype("float32")
+sh = NamedSharding(mesh, P(None, "sp", None, None))
+# each host contributes its local half of the sequence
+lo, hi = (0, t // 2) if jax.process_index() == 0 else (t // 2, t)
+q = jax.make_array_from_process_local_data(sh, qh[:, lo:hi])
+out = ring_attention(q, q, q, mesh, axis="sp", causal=True)
+# local shard of the result vs the dense oracle computed host-side
+local = np.asarray(out.addressable_shards[0].data)
+ref = np.asarray(dense_attention(jnp.asarray(qh), jnp.asarray(qh),
+                                 jnp.asarray(qh), causal=True))[:, lo:hi]
+assert np.allclose(local, ref, rtol=2e-4, atol=2e-5), np.abs(local - ref).max()
+print("RING-OK", jax.process_index(), flush=True)
+'''
+    outs = _run_two_process_workers(worker)
+    for i, o in enumerate(outs):
+        assert f"RING-OK {i}" in o, f"rank {i}:\n{o[-2000:]}"
 
 
 def test_slice_vars_round_robin_matches_reference_math():
