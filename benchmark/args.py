@@ -44,6 +44,11 @@ def parse_args(argv=None):
                         help="Wrap the timed loop in the profiler and print "
                              "the event table.")
     parser.add_argument("--no_test", action="store_true")
+    parser.add_argument("--fetch_interval", type=int, default=1,
+                        help="fetch the loss every N iterations (1 = the "
+                             "reference's per-step fetch; larger values keep "
+                             "the device pipelined — on the axon tunnel a "
+                             "per-step fetch costs ~80 ms of RPC latency)")
     parser.add_argument("--seed", type=int, default=0)
     # model-specific
     parser.add_argument("--class_num", type=int, default=1000)
