@@ -1,8 +1,8 @@
 """Benchmark: flagship training throughput on one TPU chip (AMP bf16).
 
-Prints one JSON line per workload — seq2seq NMT first, then the ResNet-50
-flagship LAST so tail-parsers that take the final JSON line get the
-BASELINE.json headline metric:
+Prints one JSON line per workload — transformer LM, then seq2seq NMT, then
+the ResNet-50 flagship LAST so tail-parsers that take the final JSON line
+get the BASELINE.json headline metric:
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
 Workloads mirror benchmark/fluid/fluid_benchmark.py --model resnet /
