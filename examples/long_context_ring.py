@@ -1,0 +1,62 @@
+"""Long-context attention: the sequence sharded over an 'sp' ring.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python examples/long_context_ring.py --devices cpu
+
+Exact causal attention with per-device memory O(T/sp) and NO quadratic
+term: each ring step runs the Pallas flash kernel on the resident K/V
+shard while the next shard is in flight over ICI (lax.ppermute), partial
+results merge through their logsumexps, and the backward is a second ring
+pass of the FlashAttention-2 kernels. At T=32k/H8/D128 the per-device temp
+memory is 0.09 GB where single-device dense attention would need >34 GB
+for the logits alone (docs/perf.md).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+
+from paddle_tpu.parallel.context_parallel import dense_attention, ring_attention
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default=None, choices=[None, "cpu", "tpu"])
+    ap.add_argument("--seq_len", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices(args.devices) if args.devices else jax.devices()
+    sp = len(devices)
+    mesh = make_mesh({"sp": sp}, devices=devices)
+    print(f"ring over sp={sp}, global T={args.seq_len}, "
+          f"T/device={args.seq_len // sp}")
+
+    rng = np.random.RandomState(0)
+    b, h, d = 1, 4, 64
+    q = rng.randn(b, args.seq_len, h, d).astype("float32")
+
+    # pin the single-device oracle to the same device pool in full precision
+    # (an accelerator plugin may otherwise run it in bf16 elsewhere)
+    with jax.default_device(devices[0]), \
+            jax.default_matmul_precision("highest"):
+        out = np.asarray(ring_attention(q, q, q, mesh, axis="sp", causal=True))
+        ref = np.asarray(dense_attention(q, q, q, causal=True))
+        err = np.abs(out - ref).max()
+        print(f"ring vs dense oracle max err: {err:.2e}")
+
+        # gradients flow through the ring (custom_vjp FA-2 backward ring)
+        g = jax.grad(lambda q: jnp.sum(
+            ring_attention(q, q, q, mesh, axis="sp", causal=True) ** 2))(q)
+    print(f"grad through the ring OK, |dq| mean {float(np.abs(g).mean()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Single-device training, the fluid workflow end to end.
+
+    python examples/train_mnist.py [--device cpu|tpu]
+
+Builds LeNet-5 through the Program/layers API, trains with Adam under
+bf16 AMP, evaluates on a held-out split with the ``clone(for_test)``
+program, and exports an inference model that ``paddle_tpu.inference``
+(or the C++ loader in csrc/) can serve.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import lenet5
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default="tpu", choices=["cpu", "tpu"])
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch_size", type=int, default=128)
+    args = ap.parse_args()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data("img", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, loss, acc = lenet5(img, label)
+        test_prog = main_prog.clone(for_test=True)
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+
+    place = fluid.TPUPlace(0) if args.device == "tpu" else fluid.CPUPlace()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+
+    import paddle_tpu.dataset.mnist as mnist
+    train = list(mnist.train()())
+    X = np.stack([s[0].reshape(1, 28, 28) for s in train]).astype("float32")
+    Y = np.array([s[1] for s in train], "int64")[:, None]
+    n_test = min(2048, len(X) // 10)
+    Xte, Yte = X[:n_test], Y[:n_test]
+    Xtr, Ytr = X[n_test:], Y[n_test:]
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        sel = rng.randint(0, len(Xtr), args.batch_size)
+        lv, = exe.run(main_prog, feed={"img": Xtr[sel], "label": Ytr[sel]},
+                      fetch_list=[loss], scope=scope)
+        if (step + 1) % 100 == 0:
+            print(f"step {step + 1}: loss {float(lv):.4f}")
+
+    correct = 0
+    for i in range(0, n_test, 256):
+        xb, yb = Xte[i:i + 256], Yte[i:i + 256]
+        a, = exe.run(test_prog, feed={"img": xb, "label": yb},
+                     fetch_list=[acc], scope=scope)
+        correct += float(a) * len(xb)  # weight by batch size (ragged tail)
+    print(f"test accuracy: {correct / n_test:.4f}")
+
+    out = tempfile.mkdtemp(prefix="mnist_model_")
+    fluid.io.save_inference_model(out, ["img"], [pred], exe,
+                                  main_program=main_prog, scope=scope)
+    print(f"inference model exported to {out}")
+
+
+if __name__ == "__main__":
+    main()

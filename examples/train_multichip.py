@@ -1,0 +1,73 @@
+"""Data+tensor-parallel training over a device mesh (one process).
+
+    # 8 virtual CPU devices (works anywhere):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python examples/train_multichip.py --devices cpu
+    # or on a TPU pod slice: python examples/train_multichip.py
+
+ParallelExecutor compiles ONE SPMD step over a dp x tp mesh: the batch
+splits over 'dp', ParamAttr(sharding=...) column/row-shards the MLP over
+'tp', and XLA GSPMD inserts every collective (gradient all-reduce over dp,
+activation all-reduce over tp) inside the step. ZeRO-style parameter
+sharding is one BuildStrategy knob away; sharded params checkpoint
+per-shard with no host gather.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import ParallelExecutor, make_mesh
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default=None, choices=[None, "cpu", "tpu"])
+    ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data("x", shape=[64], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        # Megatron-style pair: column-sharded up, row-sharded down
+        h = fluid.layers.fc(x, size=256, act="relu",
+                            param_attr=fluid.ParamAttr(sharding=(None, "tp")))
+        h = fluid.layers.fc(h, size=64, act="relu",
+                            param_attr=fluid.ParamAttr(sharding=("tp", None)))
+        pred = fluid.layers.fc(h, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Momentum(0.1, 0.9).minimize(loss, startup)
+
+    import jax
+    devices = jax.devices(args.devices) if args.devices else jax.devices()
+    mesh = make_mesh({"dp": args.dp, "tp": args.tp}, devices=devices)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace() if args.devices == "cpu"
+                         else fluid.default_place())
+    exe.run(startup, scope=scope, seed=0)
+    pe = ParallelExecutor(use_tpu=args.devices != "cpu", loss_name=loss.name,
+                          main_program=main_prog, scope=scope, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1024, 64).astype("float32")
+    Y = np.argmax(X[:, :10], axis=1).astype("int64")[:, None]
+    for step in range(args.steps):
+        sel = rng.randint(0, 1024, 32 * args.dp)  # global batch
+        lv, = pe.run(fetch_list=[loss.name],
+                     feed={"x": X[sel], "label": Y[sel]})
+        if (step + 1) % 10 == 0:
+            print(f"step {step + 1}: loss {float(lv):.4f}")
+    print("done; params stay sharded on the mesh between steps")
+
+
+if __name__ == "__main__":
+    main()

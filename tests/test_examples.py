@@ -1,0 +1,43 @@
+"""The examples/ scripts actually run (CPU, small settings)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, os.path.join("examples", script), *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
+    return out.stdout
+
+
+def test_train_mnist_example():
+    out = _run("train_mnist.py", "--device", "cpu", "--steps", "60")
+    assert "test accuracy:" in out
+    acc = float(out.split("test accuracy:")[1].split()[0])
+    assert acc > 0.8, out
+    assert "inference model exported" in out
+
+
+def test_train_multichip_example():
+    out = _run("train_multichip.py", "--devices", "cpu", "--dp", "4",
+               "--tp", "2", "--steps", "20")
+    assert "loss" in out and "done" in out
+
+
+def test_long_context_ring_example():
+    out = _run("long_context_ring.py", "--devices", "cpu", "--seq_len", "64")
+    assert "max err" in out
+    err = float(out.split("max err:")[1].split()[0])
+    assert err < 1e-3, out
+    assert "grad through the ring OK" in out
