@@ -83,6 +83,40 @@ def test_amp_training_converges():
     assert losses[-1] < losses[0] * 0.5
 
 
+def test_amp_master_state_stays_f32_all_optimizers():
+    """The AMP contract: after training steps under amp=True, every float
+    in the scope (params, optimizer accumulators, BN running stats) is
+    still f32 — bf16 lives only in the activation stream inside the step."""
+    for opt in (fluid.optimizer.SGD(0.1),
+                fluid.optimizer.Momentum(0.1, 0.9),
+                fluid.optimizer.Adam(0.01),
+                fluid.optimizer.Adagrad(0.01),
+                fluid.optimizer.RMSProp(0.01)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[1, 8, 8], dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            c = fluid.layers.conv2d(img, 4, 3, act=None, bias_attr=False)
+            b = fluid.layers.batch_norm(c, act="relu")
+            pred = fluid.layers.fc(b, size=3, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+            opt.minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace(), amp=True)
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=1)
+        X = np.random.RandomState(0).randn(16, 1, 8, 8).astype("float32")
+        Y = np.random.RandomState(1).randint(0, 3, (16, 1)).astype("int64")
+        for _ in range(3):
+            exe.run(main, feed={"img": X, "label": Y}, fetch_list=[loss],
+                    scope=scope)
+        name = type(opt).__name__
+        for n in scope.var_names():
+            v = scope.get(n)
+            dt = str(getattr(v, "dtype", ""))
+            assert "bfloat16" not in dt and "float16" not in dt, \
+                f"{name}: scope var {n} leaked to {dt}"
+
+
 def test_proximal_optimizers_step():
     import paddle_tpu as fluid
 
