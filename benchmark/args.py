@@ -44,6 +44,11 @@ def parse_args(argv=None):
                         help="Wrap the timed loop in the profiler and print "
                              "the event table.")
     parser.add_argument("--no_test", action="store_true")
+    parser.add_argument("--slope_timing", action="store_true",
+                        help="time N1 vs N2 pipelined windows and report the "
+                             "slope (robust to tunnel/RPC latency and to "
+                             "fixed per-window overheads; bench.py's method). "
+                             "iterations counts the larger window")
     parser.add_argument("--fetch_interval", type=int, default=1,
                         help="fetch the loss every N iterations (1 = the "
                              "reference's per-step fetch; larger values keep "
