@@ -48,24 +48,12 @@ TLM_BATCH = 8
 
 
 def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS):
-    """Per-step device time via two pipelined timings (N1 vs N2 steps each
-    closed by one scalar fetch): the axon tunnel's block_until_ready returns
-    before device completion and a per-step fetch pays ~80 ms RPC latency,
-    so the slope isolates true step time."""
-    def run_n(n):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            run_step()
-        fetch()
-        return time.perf_counter() - t0
+    """Per-step device time via the shared slope method (the axon tunnel's
+    block_until_ready returns before device completion and a per-step fetch
+    pays ~80 ms RPC latency, so the slope isolates true step time)."""
+    from paddle_tpu.profiler import slope_time
 
-    for _ in range(warmup):
-        run_step()
-    fetch()
-    n1, n2 = iters // 5, iters
-    t1 = run_n(n1)
-    t2 = run_n(n2)
-    return (t2 - t1) / (n2 - n1)
+    return slope_time(run_step, fetch, warmup=warmup, iters=iters, prime=True)
 
 
 def bench_resnet():

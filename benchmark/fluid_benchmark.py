@@ -99,24 +99,17 @@ def train(args):
     losses = []
 
     if args.slope_timing:
-        # bench.py's method: two pipelined windows each closed by one fetch;
-        # the slope cancels per-window fixed costs (tunnel RPC, re-uploads)
-        def window(n):
-            t0 = time.time()
-            for _ in range(n - 1):
-                run(feed, False)
-            losses.append(float(np.asarray(run(feed, True)[0]).mean()))
-            return time.time() - t0
+        if not args.use_fake_data:
+            raise SystemExit("--slope_timing requires --use_fake_data: the "
+                             "slope method times a reused device-resident "
+                             "batch; per-step host data generation/transfer "
+                             "would pollute the slope")
+        from paddle_tpu.profiler import slope_time
 
-        n2 = max(args.iterations, 10)
-        n1 = max(n2 // 5, 2)
-        window(n1)  # priming window: absorbs idle-tunnel transients
-        t1 = window(n1)
-        t2 = window(n2)
-        step_time = (t2 - t1) / (n2 - n1)
-        if step_time <= 0:  # transient hit a window anyway; fall back
-            print("(slope degenerate — reporting the large-window mean)")
-            step_time = t2 / n2
+        step_time = slope_time(
+            lambda: run(feed, False),
+            lambda: losses.append(float(np.asarray(run(feed, True)[0]).mean())),
+            warmup=0, iters=args.iterations, prime=True)
         eps = examples_per_batch / step_time
         print("\nSlope timing: %.5f s/step, %.5f examples/sec\n"
               % (step_time, eps))

@@ -116,3 +116,39 @@ def profiler(state: str = "All", sorted_key: str = "total",
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+def slope_time(run_step, fetch, warmup: int = 5, iters: int = 50,
+               prime: bool = False) -> float:
+    """Per-step device seconds via the slope of two pipelined windows.
+
+    Each window issues run_step() n-1 times then one fetch() (a call that
+    synchronizes on a fetched value); the slope (t2-t1)/(n2-n1) cancels
+    fixed per-window costs — RPC round trips, executable re-uploads —
+    which on tunneled backends dwarf the step itself. ``prime=True`` runs
+    one discarded window first to absorb idle-link transients. A
+    degenerate (non-positive) slope falls back to the large-window mean.
+    Shared by bench.py and benchmark/fluid_benchmark.py --slope_timing.
+    """
+    import time as _time
+
+    def window(n):
+        t0 = _time.perf_counter()
+        for _ in range(n - 1):
+            run_step()
+        fetch()
+        return _time.perf_counter() - t0
+
+    for _ in range(warmup):
+        run_step()
+    fetch()
+    n2 = max(iters, 10)
+    n1 = max(n2 // 5, 2)
+    if prime:
+        window(n1)
+    t1 = window(n1)
+    t2 = window(n2)
+    step = (t2 - t1) / (n2 - n1)
+    if step <= 0:
+        step = t2 / n2
+    return step
