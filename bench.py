@@ -19,7 +19,6 @@ TPU v5 lite). The chip's measured big-matmul rate is ~191 TFLOP/s
 from __future__ import annotations
 
 import json
-import time
 
 import numpy as np
 

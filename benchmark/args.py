@@ -35,7 +35,8 @@ def parse_args(argv=None):
     parser.add_argument("--num_devices", type=int, default=1,
                         help=">1 runs the mesh-sharded ParallelExecutor "
                              "(data parallel over the 'dp' axis).")
-    parser.add_argument("--use_fake_data", action="store_true", default=True,
+    parser.add_argument("--use_fake_data", default=True,
+                        action=argparse.BooleanOptionalAction,
                         help="Synthetic device-side data (reference "
                              "--use_fake_data); real datasets need a cache.")
     parser.add_argument("--amp", action="store_true",

@@ -98,12 +98,6 @@ class ParallelExecutor:
         self.amp = amp
         self.async_mode = bool(getattr(self.build_strategy, "async_mode", False)
                                or getattr(self.program, "_async_mode", False))
-        if self.async_mode and jax.process_count() > 1:
-            raise NotImplementedError(
-                "local-SGD async_mode is single-controller for now: the "
-                "stacked per-worker placement and the global loss merge are "
-                "not multi-host aware — use sync collective training "
-                "(the default) across hosts")
         self.local_sgd_steps = int(getattr(self.build_strategy,
                                            "local_sgd_steps", 4))
         self._runs_since_sync = 0
@@ -151,7 +145,9 @@ class ParallelExecutor:
     # -- local SGD (async_mode) ---------------------------------------------
     def _place_state_stacked(self, names: Sequence[str]):
         """async_mode placement: every state var becomes [dp, *shape] sharded
-        P('dp') — each worker owns a full, independently-evolving copy."""
+        P('dp') — each worker owns a full, independently-evolving copy.
+        make_array_from_callback places only addressable shards, so this
+        works identically single- and multi-controller."""
         dp = self.mesh.shape["dp"]
         sh = NamedSharding(self.mesh, PartitionSpec("dp"))
         for n in names:
@@ -161,8 +157,9 @@ class ParallelExecutor:
                     f"variable {n!r} missing from scope; run the startup program first"
                 )
             arr = np.asarray(self._to_mesh_host(v))
-            self.scope.set(
-                n, jax.device_put(np.broadcast_to(arr, (dp,) + arr.shape), sh))
+            stacked = np.broadcast_to(arr, (dp,) + arr.shape)
+            self.scope.set(n, jax.make_array_from_callback(
+                stacked.shape, sh, lambda idx, a=stacked: a[idx]))
 
     def _build_local_sgd_step(self, step, feed_sig_names):
         """Wrap the traced step in shard_map: per-worker params (leading dp
@@ -177,6 +174,14 @@ class ParallelExecutor:
             donated = {k: v[0] for k, v in donated.items()}
             key = jax.random.fold_in(key, lax.axis_index("dp"))
             fetches, new_state = step(feed_vals, readonly, donated, key)
+            # float scalar fetches (losses) pmean over ALL workers inside
+            # the step — every host then reports the global mean even though
+            # no gradient collective runs; batch-shaped and non-float
+            # fetches stay per-worker (matching _merge_fetch's contract)
+            fetches = [lax.pmean(f, "dp")
+                       if jnp.ndim(f) == 0 and jnp.issubdtype(f.dtype, jnp.floating)
+                       else f
+                       for f in fetches]
             return ([f[None] for f in fetches],
                     {k: v[None] for k, v in new_state.items()})
 

@@ -130,14 +130,12 @@ def slope_time(run_step, fetch, warmup: int = 5, iters: int = 50,
     degenerate (non-positive) slope falls back to the large-window mean.
     Shared by bench.py and benchmark/fluid_benchmark.py --slope_timing.
     """
-    import time as _time
-
     def window(n):
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         for _ in range(n - 1):
             run_step()
         fetch()
-        return _time.perf_counter() - t0
+        return time.perf_counter() - t0
 
     for _ in range(warmup):
         run_step()
