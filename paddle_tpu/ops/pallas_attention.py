@@ -37,6 +37,22 @@ def _interpret_default():
     return platform != "tpu"
 
 
+def _fit_block(t, blk):
+    """Largest viable Pallas block size for a length-t axis: a divisor of t
+    not exceeding the requested block, preferring lane-aligned (×128) then
+    sublane-aligned (×8) sizes. Returns None when no aligned divisor exists
+    (truly ragged length) — only then is the dense fallback justified.
+    Without this, a T divisible by 128 but not by the 512 default (768,
+    1280, ring-attention shards of those) would silently take the O(T²)
+    dense path and defeat the op's memory guarantee."""
+    blk = min(blk, t)
+    for align in (128, 8):
+        for b in range(blk - blk % align, 0, -align):
+            if t % b == 0:
+                return b
+    return None
+
+
 def _causal_mask(logits, qi, q_block, j, block_k, bq):
     q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
     k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -103,9 +119,9 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _interpret_default()
-    q_block = min(q_block, t)
-    k_block = min(k_block, t)
-    if t % q_block or t % k_block:
+    q_block = _fit_block(t, q_block)
+    k_block = _fit_block(t, k_block)
+    if q_block is None or k_block is None:
         # ragged tail: fall back to the dense path
         if not return_lse:
             from ..parallel.context_parallel import dense_attention
@@ -269,9 +285,9 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _interpret_default()
-    q_block = min(q_block, t)
-    k_block = min(k_block, t)
-    if t % q_block or t % k_block:
+    q_block = _fit_block(t, q_block)
+    k_block = _fit_block(t, k_block)
+    if q_block is None or k_block is None:
         return _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc)
 
     def fold(x):
@@ -383,11 +399,13 @@ def flash_attention_op(ctx, ins, attrs):
         # grads. The LSE residual is grad-irrelevant here (grads flow
         # through the custom_vjp, and nothing outside the segment reads the
         # LSE of an op inside it), so emit a stop_gradient placeholder
-        # rather than paying a second pass to extract it.
+        # rather than paying a second pass to extract it. NaN, not zeros:
+        # if the no-outside-reader assumption is ever violated the consumer
+        # fails loudly instead of silently computing with zeros.
         out = flash_attention(q, k, v, causal, scale,
                               attrs.get("q_block", 512),
                               attrs.get("k_block", 512))
-        lse = lax.stop_gradient(jnp.zeros(q.shape[:3], jnp.float32))
+        lse = lax.stop_gradient(jnp.full(q.shape[:3], jnp.nan, jnp.float32))
         return {"Out": [out], "LSE": [lse]}
     out, lse = flash_attention_fwd(
         q, k, v, causal=causal, scale=scale,

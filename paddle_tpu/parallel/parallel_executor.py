@@ -157,7 +157,21 @@ class ParallelExecutor:
                     f"variable {n!r} missing from scope; run the startup program first"
                 )
             arr = np.asarray(self._to_mesh_host(v))
-            stacked = np.broadcast_to(arr, (dp,) + arr.shape)
+            # a value restored from an async-mode checkpoint is ALREADY
+            # stacked [dp, *var.shape]; broadcasting it again would produce
+            # [dp, dp, ...] and a confusing trace-time shape error on resume
+            var = self.program.global_block().find_var_recursive(n)
+            vshape = None
+            if var is not None and var.shape is not None:
+                vs = tuple(int(s) for s in var.shape)
+                if all(s >= 0 for s in vs):
+                    vshape = vs
+            already_stacked = (
+                vshape is not None and arr.ndim >= 1 and arr.shape[0] == dp
+                and tuple(arr.shape[1:]) == vshape
+                and tuple(arr.shape) != vshape)
+            stacked = arr if already_stacked else np.broadcast_to(
+                arr, (dp,) + arr.shape)
             self.scope.set(n, jax.make_array_from_callback(
                 stacked.shape, sh, lambda idx, a=stacked: a[idx]))
 
@@ -264,6 +278,13 @@ class ParallelExecutor:
             spec[0] = "dp"
         return NamedSharding(self.mesh, PartitionSpec(*spec))
 
+    def _check_batch_divisible(self, name, arr):
+        if arr.ndim and arr.shape[0] % self.mesh.shape["dp"] != 0:
+            raise ValueError(
+                f"feed {name!r}: global batch {arr.shape[0]} not divisible "
+                f"by dp={self.mesh.shape['dp']}"
+            )
+
     def place_feed(self, feed: Dict[str, Any]) -> Dict[str, Any]:
         """Pre-place a feed dict on the mesh (dp-sharded batch dim) so a
         REUSED batch is transferred once instead of per run() call —
@@ -280,6 +301,9 @@ class ParallelExecutor:
                 if self._multiprocess:
                     out[k] = jax.make_array_from_process_local_data(sh, arr)
                 else:
+                    # same validation as run(): fail with the framework's
+                    # error, not an opaque JAX sharding error
+                    self._check_batch_divisible(k, arr)
                     out[k] = jax.device_put(arr, sh)
             return out
 
@@ -319,11 +343,7 @@ class ParallelExecutor:
                 # each host feeds its own slice of the global batch
                 feed_vals[k] = jax.make_array_from_process_local_data(sh, arr)
                 continue
-            if arr.ndim and arr.shape[0] % self.mesh.shape["dp"] != 0:
-                raise ValueError(
-                    f"feed {k!r}: global batch {arr.shape[0]} not divisible by "
-                    f"dp={self.mesh.shape['dp']}"
-                )
+            self._check_batch_divisible(k, arr)
             feed_vals[k] = jax.device_put(arr, sh)
 
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
@@ -383,6 +403,14 @@ class ParallelExecutor:
             if v.sharding.is_fully_replicated or v.ndim == 0:
                 return np.asarray(v.addressable_shards[0].data)
             shards = [s for s in v.addressable_shards if s.replica_id == 0]
+            if not shards:
+                # this host holds only replica copies (e.g. a P('tp') value
+                # with 'dp' spanning hosts): shard data is identical per
+                # index, so dedupe by index and stitch from any replica
+                by_index = {}
+                for s in v.addressable_shards:
+                    by_index.setdefault(tuple(map(str, s.index)), s)
+                shards = list(by_index.values())
             starts = [min((s.index[d].start or 0) for s in shards)
                       for d in range(v.ndim)]
             stops = [max((s.index[d].stop if s.index[d].stop is not None

@@ -105,6 +105,52 @@ def test_tp_sharded_param_via_param_attr():
     assert losses[-1] < losses[0]
 
 
+def test_place_feed_rejects_indivisible_batch():
+    main, startup, loss = _build_model()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope, seed=5)
+    mesh = make_mesh({"dp": 8}, devices=jax.devices("cpu"))
+    pe = ParallelExecutor(use_tpu=False, main_program=main, scope=scope,
+                          mesh=mesh)
+    X, Y = _data(n=63)  # 63 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible by dp"):
+        pe.place_feed({"img": X, "label": Y})
+
+
+def test_async_mode_checkpoint_resume_no_double_stack():
+    """ADVICE r2: restoring async-mode (local SGD) state — saved stacked
+    [dp, ...] — into a fresh ParallelExecutor must not broadcast it again
+    to [dp, dp, ...]."""
+    X, Y = _data()
+    main, startup, loss = _build_model()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope, seed=5)
+    mesh = make_mesh({"dp": 8}, devices=jax.devices("cpu"))
+    bs = BuildStrategy()
+    bs.async_mode = True
+    bs.local_sgd_steps = 2
+    pe = ParallelExecutor(use_tpu=False, main_program=main, scope=scope,
+                          mesh=mesh, build_strategy=bs)
+    for _ in range(3):
+        pe.run(fetch_list=[loss.name], feed={"img": X, "label": Y})
+    # "checkpoint": host copies of the (stacked) state, as io.save would see
+    saved = {n: np.asarray(scope.get(n)) for n in scope.var_names()}
+    # restore into a fresh scope + fresh executor
+    scope2 = fluid.Scope()
+    exe.run(startup, scope=scope2, seed=7)
+    for n, v in saved.items():
+        scope2.set(n, v)
+    pe2 = ParallelExecutor(use_tpu=False, main_program=main, scope=scope2,
+                           mesh=mesh, build_strategy=bs)
+    l0 = float(pe2.run(fetch_list=[loss.name], feed={"img": X, "label": Y})[0])
+    l3 = None
+    for _ in range(3):
+        l3 = float(pe2.run(fetch_list=[loss.name],
+                           feed={"img": X, "label": Y})[0])
+    assert np.isfinite(l0) and np.isfinite(l3)
+
+
 def test_dryrun_multichip_stays_on_mesh_backend():
     """Regression for round-1 driver failure (MULTICHIP_r01.json).
 
