@@ -44,8 +44,12 @@ def _fit_block(t, blk):
     (truly ragged length) — only then is the dense fallback justified.
     Without this, a T divisible by 128 but not by the 512 default (768,
     1280, ring-attention shards of those) would silently take the O(T²)
-    dense path and defeat the op's memory guarantee."""
+    dense path and defeat the op's memory guarantee. A requested block that
+    divides T exactly is always honored (the pre-r3 contract), so explicit
+    q_block/k_block choices and small-T routings are unchanged."""
     blk = min(blk, t)
+    if t % blk == 0:
+        return blk
     for align in (128, 8):
         for b in range(blk - blk % align, 0, -align):
             if t % b == 0:

@@ -116,11 +116,11 @@ def test_fit_block_prefers_aligned_divisors():
     assert _fit_block(1024, 512) == 512
     assert _fit_block(768, 512) == 384    # divisor of 768, lane-aligned
     assert _fit_block(1280, 512) == 256   # largest ×128 divisor ≤ 512
-    assert _fit_block(96, 512) == 96      # sublane-aligned fallback
+    assert _fit_block(96, 512) == 96      # exact divisibility honored
     assert _fit_block(32, 16) == 16       # explicit small blocks unchanged
-    assert _fit_block(40, 512) == 40
-    assert _fit_block(100, 512) is None   # no ×8 divisor -> dense
-    assert _fit_block(7, 512) is None     # truly ragged -> dense
+    assert _fit_block(100, 512) == 100    # pre-r3 contract: blk=T runs Pallas
+    assert _fit_block(1000, 24) == 8      # unaligned request -> ×8 divisor
+    assert _fit_block(998, 512) is None   # truly ragged -> dense
 
 
 @pytest.mark.parametrize("t", [96, 768])

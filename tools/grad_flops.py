@@ -1,0 +1,114 @@
+"""Measure the IR-autodiff recompute tax: compiled-FLOP ratio of a
+fwd+bwd+update training step vs the forward-only program.
+
+core/registry.py's generic_grad_impl computes every grad op as jax.vjp over
+a re-run of the forward kernel inside the same traced block, relying on
+XLA CSE to fold the recomputation into the original forward (<- the
+reference instead saves forward vars for grad ops, backward.py:280). This
+tool makes that reliance a measured number: the analytic ideal for
+matmul-dominated models is ~3x forward (fwd + dX + dW), so a healthy
+compiled ratio is ~<=3.5; a regression toward ~5-6x means CSE stopped
+folding the replays.
+
+Usage: python tools/grad_flops.py [--model transformer|mlp|resnet]
+(CPU or TPU; FLOP counts come from XLA cost analysis, not wall clock.)
+Also imported by tests/test_autodiff.py::test_grad_flops_ratio_bounded.
+"""
+import argparse
+
+
+def build_programs(model="transformer"):
+    import paddle_tpu as fluid
+
+    if model == "transformer":
+        from paddle_tpu.models.transformer import transformer_lm
+
+        d, layers, heads, t, bs, vocab = 256, 2, 2, 128, 2, 1000
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            tok = fluid.layers.data("tokens", shape=[t], dtype="int64")
+            lbl = fluid.layers.data("labels", shape=[t], dtype="int64")
+            _, loss = transformer_lm(tok, lbl, vocab_size=vocab, max_len=t,
+                                     d_model=d, n_heads=heads,
+                                     n_layers=layers, d_ff=4 * d)
+            fwd = main.clone(for_test=False)
+            fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+        feeds = {"tokens": ((bs, t), "int64", vocab),
+                 "labels": ((bs, t), "int64", vocab)}
+    elif model == "mlp":
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[256], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, size=512, act="relu")
+            h = fluid.layers.fc(h, size=512, act="relu")
+            p = fluid.layers.fc(h, size=10, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+            fwd = main.clone(for_test=False)
+            fluid.optimizer.SGD(0.1).minimize(loss, startup)
+        feeds = {"x": ((64, 256), "float32", None), "y": ((64, 1), "int64", 10)}
+    else:
+        raise SystemExit(f"unknown model {model}")
+    return main, fwd, startup, loss, feeds
+
+
+def compiled_flops(program, feeds, fetch_names, amp=False):
+    import jax
+    import numpy as np
+
+    from paddle_tpu.core.executor import build_step_fn
+
+    feed_names = tuple(sorted(feeds))
+    step, readonly, donated, state_out = build_step_fn(
+        program, 0, feed_names, fetch_names, amp=amp)
+
+    rng = np.random.RandomState(0)
+    cpu = jax.devices("cpu")[0]
+
+    def mk(shape, dtype, hi):
+        if dtype == "int64":
+            return jax.device_put(
+                rng.randint(0, hi, shape).astype("int32"), cpu)
+        return jax.device_put(rng.randn(*shape).astype(dtype), cpu)
+
+    feed_vals = {k: mk(*feeds[k]) for k in feed_names}
+
+    # state comes from the startup program run on CPU
+    import paddle_tpu as fluid
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(_STARTUP, scope=scope, seed=3)
+    ro = {n: jax.device_put(scope.get(n), cpu) for n in readonly}
+    do = {n: jax.device_put(scope.get(n), cpu) for n in donated}
+    key = jax.random.PRNGKey(0)
+    with jax.default_device(cpu):
+        lowered = jax.jit(step).lower(feed_vals, ro, do, key)
+        cost = lowered.compile().cost_analysis()
+    return float(cost.get("flops", 0.0))
+
+
+_STARTUP = None
+
+
+def measure(model="transformer", amp=False):
+    global _STARTUP
+    main, fwd, startup, loss, feeds = build_programs(model)
+    _STARTUP = startup
+    f_fwd = compiled_flops(fwd, feeds, [loss.name], amp=amp)
+    f_train = compiled_flops(main, feeds, [loss.name], amp=amp)
+    ratio = f_train / f_fwd if f_fwd else float("nan")
+    return f_fwd, f_train, ratio
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="transformer")
+    ap.add_argument("--amp", action="store_true")
+    args = ap.parse_args()
+    f, t, r = measure(args.model, args.amp)
+    print(f"{args.model}: forward {f/1e9:.3f} GFLOP  train-step {t/1e9:.3f} "
+          f"GFLOP  ratio {r:.2f} (ideal ~3, healthy <=3.6)")

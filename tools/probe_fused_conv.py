@@ -1,0 +1,164 @@
+"""On-chip probe: fused Pallas conv+BN kernels vs the XLA op chain at the
+ResNet-50 bs128 layer shapes. Run from /root/repo on the real TPU:
+
+    python tools/probe_fused_conv.py [--batch 128]
+
+Timing is tunnel-proof: the unit under test is a TWO-LAYER cell
+(normalize+relu -> conv -> stats, twice, the second layer consuming the
+first's raw output and batch statistics — exactly the framework's
+training-mode dataflow), iterated inside jax.lax.fori_loop with the cell
+output feeding the next iteration (serialized, un-hoistable, un-DCE-able).
+Per-cell time is the slope between two trip counts, so dispatch/RPC
+constants cancel; the fetch is the tiny stats carry (a real host transfer —
+the tunnel's block_until_ready returns early).
+"""
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.ops.pallas_conv import (bn_affine, fused_conv3x3_bn,
+                                        fused_matmul_bn, moments_from_sums)
+
+
+def affine_from_stats(st, count, gamma=1.1, beta=0.05):
+    mean, var = moments_from_sums(st, count)
+    return bn_affine(mean, var, jnp.full_like(mean, gamma),
+                     jnp.full_like(mean, beta))
+
+
+def xla_layer_mm(x, w, a, b):
+    xf = jnp.maximum(x.astype(jnp.float32) * a + b, 0.0).astype(jnp.bfloat16)
+    y = jax.lax.dot_general(xf, w, (((1,), (0,)), ((), ())))
+    yf = y.astype(jnp.float32)
+    return y, jnp.stack([jnp.sum(yf, 0), jnp.sum(yf * yf, 0)])
+
+
+def pallas_layer_mm(x, w, a, b):
+    return fused_matmul_bn(x, w, (a, b))
+
+
+def xla_layer_c3(x, w, a, b):
+    xf = jnp.maximum(x.astype(jnp.float32) * a + b, 0.0).astype(jnp.bfloat16)
+    y = jax.lax.conv_general_dilated(
+        xf, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    yf = y.astype(jnp.float32)
+    return y, jnp.stack([jnp.sum(yf, (0, 1, 2)), jnp.sum(yf * yf, (0, 1, 2))])
+
+
+def pallas_layer_c3(x, w, a, b):
+    return fused_conv3x3_bn(x, w, (a, b))
+
+
+def make_cell_loop(layer, w1, w2, count):
+    """(x, a, b, n) -> stats carry after n chained two-layer cells."""
+
+    def cell(x, a, b):
+        y1, st1 = layer(x, w1, a, b)
+        a1, b1 = affine_from_stats(st1, count)
+        y2, st2 = layer(y1, w2, a1, b1)
+        a2, b2 = affine_from_stats(st2, count)
+        return y2, a2, b2, st2
+
+    def run(x, a, b, n):
+        def body(_, carry):
+            x, a, b, _st = carry
+            y2, a2, b2, st2 = cell(x, a, b)
+            return (y2, a2, b2, st2)
+
+        st0 = jnp.zeros((2, x.shape[-1] if x.ndim == 2 else w2.shape[-1]),
+                        jnp.float32)
+        out = jax.lax.fori_loop(0, n, body, (x, a, b, st0))
+        return out[3]
+
+    return jax.jit(run)
+
+
+def slope_cell_ms(jfn, x, a, b, n1=10, n2=110, reps=3):
+    np.asarray(jfn(x, a, b, 2))  # compile + warm
+
+    def t(n):
+        t0 = time.perf_counter()
+        np.asarray(jfn(x, a, b, n))
+        return time.perf_counter() - t0
+
+    slopes = []
+    for _ in range(reps):
+        t1, t2 = t(n1), t(n2)
+        slopes.append((t2 - t1) / (n2 - n1))
+    return float(np.median(slopes)) * 1e3  # ms per cell (2 layers)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--only", choices=["mm", "c3"], default=None)
+    args = ap.parse_args()
+    B = args.batch
+    dev = jax.devices()[0]
+    rng = np.random.RandomState(0)
+
+    print("== 1x1 conv cell: K->N->K (two fused matmuls) ==", flush=True)
+    for hw, k, n in ([] if args.only == "c3" else
+                     [(56, 256, 64), (28, 512, 128), (14, 1024, 256),
+                      (7, 2048, 512)]):
+        m = B * hw * hw
+        x = jax.device_put(rng.randn(m, k).astype(np.float32) * 0.5,
+                           dev).astype(jnp.bfloat16)
+        w1 = jax.device_put(rng.randn(k, n).astype(np.float32) * 0.05,
+                            dev).astype(jnp.bfloat16)
+        w2 = jax.device_put(rng.randn(n, k).astype(np.float32) * 0.05,
+                            dev).astype(jnp.bfloat16)
+        a, b = bn_affine(jnp.zeros(k), jnp.ones(k), jnp.ones(k) * 1.1,
+                         jnp.zeros(k) + 0.05)
+        gf = 2 * 2 * m * k * n / 1e9  # two layers
+        res = {}
+        carries = {}
+        for name, layer in [("xla", xla_layer_mm), ("pallas", pallas_layer_mm)]:
+            jfn = make_cell_loop(layer, w1, w2, m)
+            carries[name] = jfn(x, a, b, 1)
+            res[name] = slope_cell_ms(jfn, x, a, b)
+        c_x, c_p = carries["xla"], carries["pallas"]
+        serr = float(jnp.max(jnp.abs(c_x - c_p) / (jnp.abs(c_x) + 1e3)))
+        print(f"M={m:7d} K={k:4d} N={n:4d}: xla {res['xla']:7.3f} ms "
+              f"({gf/res['xla']:6.1f} TF/s)  pallas {res['pallas']:7.3f} ms "
+              f"({gf/res['pallas']:6.1f} TF/s)  serr {serr:.2e}", flush=True)
+
+    print("== 3x3 conv cell (two fused 3x3 convs, K->K) ==", flush=True)
+    for hw, k in ([] if args.only == "mm" else
+                  [(56, 64), (28, 128), (14, 256), (7, 512)]):
+        x = jax.device_put(
+            rng.randn(B, hw, hw, k).astype(np.float32) * 0.5, dev
+        ).astype(jnp.bfloat16)
+        w1 = jax.device_put(
+            rng.randn(3, 3, k, k).astype(np.float32) * 0.05, dev
+        ).astype(jnp.bfloat16)
+        w2 = jax.device_put(
+            rng.randn(3, 3, k, k).astype(np.float32) * 0.05, dev
+        ).astype(jnp.bfloat16)
+        a, b = bn_affine(jnp.zeros(k), jnp.ones(k), jnp.ones(k) * 1.1,
+                         jnp.zeros(k) + 0.05)
+        count = B * hw * hw
+        gf = 2 * 2 * B * hw * hw * 9 * k * k / 1e9
+        res = {}
+        carries = {}
+        for name, layer in [("xla", xla_layer_c3), ("pallas", pallas_layer_c3)]:
+            jfn = make_cell_loop(layer, w1, w2, count)
+            carries[name] = jfn(x, a, b, 1)
+            res[name] = slope_cell_ms(jfn, x, a, b)
+        c_x, c_p = carries["xla"], carries["pallas"]
+        serr = float(jnp.max(jnp.abs(c_x - c_p) / (jnp.abs(c_x) + 1e3)))
+        print(f"HW={hw:3d} K={k:4d}: xla {res['xla']:7.3f} ms "
+              f"({gf/res['xla']:6.1f} TF/s)  pallas {res['pallas']:7.3f} ms "
+              f"({gf/res['pallas']:6.1f} TF/s)  serr {serr:.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,187 @@
+"""Decompose ResNet-50 step time on-chip: where exactly do the BN
+milliseconds live (fwd stats/normalize vs backward reductions)?
+
+Variants (pure-JAX NHWC, bf16 activations, momentum update, one-pass BN
+stats — the bench-equivalent config from docs/perf.md):
+
+  std        : training BN (batch stats, full backward)
+  nostatgrad : batch stats under stop_gradient — BN backward collapses to
+               dx = a * dy (no mean(dy)/mean(dy*xhat) reduction terms)
+  affine     : no stats at all — y = scale*x + bias (BN removed, affine kept)
+
+For each, measures full train-step AND forward-only (loss) time with the
+slope method. The differences isolate:
+  fwd BN cost        = fwd(std) - fwd(affine)
+  bwd BN cost        = [step(std)-fwd(std)] - [step(affine)-fwd(affine)]
+  bwd reduction cost = step(std) - step(nostatgrad)
+
+Usage: python tools/probe_resnet_split.py [--batch 128]
+"""
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from tools.perf_lab import init_params  # noqa: E402
+
+BATCH = 128
+IMAGE = 224
+CLASSES = 1000
+
+
+DOT_1X1 = False
+NO_DW = False      # stop_gradient on conv weights: isolates the dX chain
+NO_DX = False      # stop_gradient on conv inputs: isolates dW cost
+
+
+def _conv(x, w, stride):
+    if NO_DW:
+        w = jax.lax.stop_gradient(w)
+    if NO_DX:
+        x = jax.lax.stop_gradient(x)
+    if DOT_1X1 and w.shape[0] == 1 and w.shape[1] == 1:
+        # 1x1 conv as an explicit matmul over [N*H*W, K]: XLA's conv
+        # backward emitter runs dX/dW far below matmul speed; as dots the
+        # whole bwd is MXU-shaped
+        if stride != 1:
+            x = x[:, ::stride, ::stride, :]
+        n, h, wd, k = x.shape
+        y = jax.lax.dot_general(
+            x.reshape(n * h * wd, k), w.astype(jnp.bfloat16)[0, 0],
+            (((1,), (0,)), ((), ())))
+        return y.reshape(n, h, wd, -1)
+    pads = [(w.shape[0] // 2, w.shape[0] // 2)] * 2
+    return jax.lax.conv_general_dilated(
+        x, w.astype(jnp.bfloat16), (stride, stride), pads,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, p, mode):
+    if mode == "bf16affine":
+        return x * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    if mode == "affine":
+        y = xf * p["scale"] + p["bias"]
+        return y.astype(x.dtype)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.maximum(jnp.mean(xf * xf, axis=(0, 1, 2)) - mean * mean, 0.0)
+    if mode == "nostatgrad":
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
+    inv = jax.lax.rsqrt(var + 1e-5)
+    if mode == "bf16apply":
+        # stats reductions stay f32; the folded per-channel affine is cast
+        # to bf16 and the normalize applies in bf16 arithmetic, so the
+        # whole backward chain between convs flows bf16 (half the bytes)
+        a = (inv * p["scale"]).astype(x.dtype)
+        b = (p["bias"] - mean * inv * p["scale"]).astype(x.dtype)
+        return x * a + b
+    y = (xf - mean) * inv * p["scale"] + p["bias"]
+    return y.astype(x.dtype)
+
+
+def forward(params, blocks, img, label, mode):
+    x = img.astype(jnp.bfloat16)
+    x = jnp.transpose(x, (0, 2, 3, 1))
+    x = _bn(_conv(x, params["stem_w"], 2), params["stem_bn"], mode)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        [(0, 0), (1, 1), (1, 1), (0, 0)])
+    for name, stride, has_sc in blocks:
+        short = x
+        if has_sc:
+            short = _bn(_conv(x, params[name + "_sc_w"], stride),
+                        params[name + "_sc_bn"], mode)
+        y = jax.nn.relu(_bn(_conv(x, params[name + "_c1_w"], stride),
+                            params[name + "_c1_bn"], mode))
+        y = jax.nn.relu(_bn(_conv(y, params[name + "_c2_w"], 1),
+                            params[name + "_c2_bn"], mode))
+        y = _bn(_conv(y, params[name + "_c3_w"], 1),
+                params[name + "_c3_bn"], mode)
+        x = jax.nn.relu(short + y)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x.astype(jnp.bfloat16) @ params["fc_w"].astype(jnp.bfloat16)
+    logits = logits.astype(jnp.float32) + params["fc_b"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, label, axis=1))
+
+
+def slope(fn, sync, n1=10, n2=50):
+    for _ in range(5):
+        fn()
+    sync()
+    def win(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        sync()
+        return time.perf_counter() - t0
+    win(n1)
+    t1, t2 = win(n1), win(n2)
+    dt = (t2 - t1) / (n2 - n1)
+    return dt if dt > 0 else t2 / n2
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--modes", default="std,nostatgrad,affine")
+    ap.add_argument("--dot_1x1", action="store_true")
+    ap.add_argument("--no_dw", action="store_true")
+    ap.add_argument("--no_dx", action="store_true")
+    args = ap.parse_args()
+    global DOT_1X1, NO_DW, NO_DX
+    DOT_1X1 = args.dot_1x1
+    NO_DW = args.no_dw
+    NO_DX = args.no_dx
+    b = args.batch
+    rng = np.random.RandomState(0)
+    params, blocks = init_params(rng, "nhwc")
+    dev = jax.devices()[0]
+    params = jax.device_put(params, dev)
+    img = jax.device_put(rng.randn(b, 3, IMAGE, IMAGE).astype(np.float32), dev)
+    label = jax.device_put(rng.randint(0, CLASSES, (b, 1)), dev)
+
+    for mode in args.modes.split(","):
+        velo = jax.tree.map(jnp.zeros_like, params)
+        p = jax.device_put(params, dev)
+
+        @jax.jit
+        def step(params, velo, img, label, _m=mode):
+            loss, grads = jax.value_and_grad(
+                lambda q: forward(q, blocks, img, label, _m))(params)
+            velo = jax.tree.map(lambda v, g: 0.9 * v + g, velo, grads)
+            params = jax.tree.map(lambda p, v: p - 0.1 * v, params, velo)
+            return params, velo, loss
+
+        @jax.jit
+        def fwd(params, img, label, _m=mode):
+            return forward(params, blocks, img, label, _m)
+
+        state = {"p": p, "v": velo, "l": None}
+
+        def run_step():
+            state["p"], state["v"], state["l"] = step(
+                state["p"], state["v"], img, label)
+
+        t_step = slope(run_step, lambda: float(state["l"])) * 1e3
+
+        lbox = {"l": None}
+
+        def run_fwd():
+            lbox["l"] = fwd(state["p"], img, label)
+
+        t_fwd = slope(run_fwd, lambda: float(lbox["l"])) * 1e3
+        print(f"{mode:10s}: step {t_step:6.2f} ms ({b/t_step*1e3:7.1f} img/s)"
+              f"   fwd-only {t_fwd:6.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
