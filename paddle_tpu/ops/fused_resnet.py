@@ -1,0 +1,291 @@
+"""Fused bottleneck residual block: the TPU answer to cuDNN's fused
+spatial batch norm (<- paddle/fluid/operators/batch_norm_op.cu.cc:26-150).
+
+A ResNet bottleneck in training mode is, per conv layer, five HBM passes
+under XLA (conv write, stats read, normalize read+write, next-conv read)
+plus a backward where dX, dW and the BN reductions each re-read the same
+gradients and activations. This module composes the pallas_conv kernels so
+that per layer exactly ONE raw conv-output tensor is written and read —
+BN-apply+relu rides the next kernel's prologue, BN statistics ride the
+producing kernel's epilogue, and the backward's dX + dW + BN reductions
+share a single read of (gradient, activation).
+
+`bottleneck_fused` is a jax.custom_vjp over [N, H, W, C] bf16 activations,
+covering the stride-1 identity bottleneck blocks (12 of ResNet-50's 16).
+
+STATUS (r3, measured — docs/perf.md "ResNet roofline"): the XLA-native
+path remains the framework's default engine. On-chip, XLA's whole-graph
+fusion already achieves fused-level HBM traffic, and the opaque custom-call
+boundaries around these kernels DE-fuse the surrounding glue (standalone
+convert/reduce passes), making the full model SLOWER despite the combined
+backward kernel itself beating XLA's equivalent work. The kernels and this
+block stay in-tree as numerically-pinned building blocks
+(tests/test_pallas_conv.py) and as the documented measured attempt; the
+only callers are the tests and tools/probe_resnet_split.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_conv import (bn_affine, bn_bwd_coefs, fused_bwd_conv3x3_bn,
+                          fused_bwd_matmul_bn, fused_conv3x3_bn,
+                          fused_matmul_bn, moments_from_sums)
+
+EPS = 1e-5
+
+
+def _fold(stats, gamma, beta, count):
+    mean, var = moments_from_sums(stats, count)
+    a, b = bn_affine(mean, var, gamma, beta, EPS)
+    return mean, var, a, b
+
+
+@jax.custom_vjp
+def bottleneck_fused(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    """Identity-shortcut bottleneck: zout = relu(BN3(conv3) + z).
+
+    z: [N, H, W, C4] bf16 (a REAL activation — the previous block's
+    materialized output). w1: [C4, C] (1x1), w2: [3, 3, C, C] (HWIO),
+    w3: [C, C4]; g*/b* the BN scale/bias pairs. Returns (zout,
+    (mean1, var1, mean2, var2, mean3, var3)) — batch moments for the
+    caller's running-stat update (non-differentiable)."""
+    zout, stats, _res = _bottleneck_fwd_impl(
+        z, w1, w2, w3, g1, b1, g2, b2, g3, b3)
+    return zout, stats
+
+
+def _bottleneck_fwd_impl(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    n, h, wd, c4 = z.shape
+    c = w1.shape[1]
+    m = n * h * wd
+    z2 = z.reshape(m, c4)
+    y1, st1 = fused_matmul_bn(z2, w1, affine=None, stats=True)
+    mean1, var1, a1, b1f = _fold(st1, g1, b1, m)
+    y2, st2 = fused_conv3x3_bn(y1.reshape(n, h, wd, c), w2, (a1, b1f),
+                               relu=True, stats=True)
+    mean2, var2, a2, b2f = _fold(st2, g2, b2, m)
+    y3, st3 = fused_matmul_bn(y2.reshape(m, c), w3, (a2, b2f), relu=True,
+                              stats=True)
+    mean3, var3, a3, b3f = _fold(st3, g3, b3, m)
+    q = (y3.astype(jnp.float32) * a3[None, :] + b3f[None, :]
+         + z2.astype(jnp.float32))
+    zout2 = jnp.maximum(q, 0.0).astype(z.dtype)
+    zout = zout2.reshape(n, h, wd, c4)
+    stats = (mean1, var1, mean2, var2, mean3, var3)
+    res = (z, zout, y1, y2, y3,
+           (mean1, var1, a1, b1f), (mean2, var2, a2, b2f),
+           (mean3, var3, a3, b3f), (w1, w2, w3), (g1, g2, g3))
+    return zout, stats, res
+
+
+def _bottleneck_fwd(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    zout, stats, res = _bottleneck_fwd_impl(
+        z, w1, w2, w3, g1, b1, g2, b2, g3, b3)
+    return (zout, stats), res
+
+
+def _bottleneck_bwd(res, cts):
+    dzout = cts[0]  # stats cotangents are zero (running-stat updates are
+    # stop_gradient on the caller side)
+    (z, zout, y1, y2, y3, bn1, bn2, bn3, ws, gs) = res
+    mean1, var1, a1, b1f = bn1
+    mean2, var2, a2, b2f = bn2
+    mean3, var3, a3, b3f = bn3
+    w1, w2, w3 = ws
+    g1, g2, g3 = gs
+    n, h, wd, c4 = z.shape
+    m = n * h * wd
+    c = w1.shape[1]
+
+    # join backward: j = dn3 = dzout masked by the output relu — also the
+    # identity-shortcut gradient. XLA fuses this with the j/y3 reductions.
+    dz2 = dzout.reshape(m, c4)
+    j = jnp.where(zout.reshape(m, c4) > 0, dz2.astype(jnp.float32), 0.0)
+    s1_3 = jnp.sum(j, axis=0)
+    s2_3 = jnp.sum(j * y3.astype(jnp.float32), axis=0)
+    jj = j.astype(z.dtype)
+    al3, be3, de3, dg3, db3 = bn_bwd_coefs(s1_3, s2_3, mean3, var3, g3, m,
+                                           EPS)
+
+    # conv3 (1x1, C -> C4): P2, dW3, sums for BN2
+    p2, dw3, st_p2 = fused_bwd_matmul_bn(
+        jj, y3, y2.reshape(m, c), w3, coefs=(al3, be3, de3),
+        xaffine=(a2, b2f), xrelu=True, stats=True)
+    al2, be2, de2, dg2, db2 = bn_bwd_coefs(st_p2[0], st_p2[1], mean2, var2,
+                                           g2, m, EPS)
+
+    # conv2 (3x3, C -> C): P1, dW2, sums for BN1
+    p1, dw2, st_p1 = fused_bwd_conv3x3_bn(
+        p2.reshape(n, h, wd, c), y2.reshape(n, h, wd, c),
+        y1.reshape(n, h, wd, c), w2, coefs=(al2, be2, de2),
+        xaffine=(a1, b1f), xrelu=True, stats=True)
+    al1, be1, de1, dg1, db1 = bn_bwd_coefs(st_p1[0], st_p1[1], mean1, var1,
+                                           g1, m, EPS)
+
+    # conv1 (1x1, C4 -> C): dZ_main, dW1 (input is the real activation z)
+    dz_main, dw1, _ = fused_bwd_matmul_bn(
+        p1.reshape(m, c), y1, z.reshape(m, c4), w1,
+        coefs=(al1, be1, de1), xaffine=None, stats=False)
+
+    dz = (dz_main.astype(jnp.float32) + j).astype(z.dtype).reshape(z.shape)
+    return (dz, dw1.astype(w1.dtype), dw2.astype(w2.dtype),
+            dw3.astype(w3.dtype), dg1.astype(g1.dtype), db1.astype(g1.dtype),
+            dg2.astype(g2.dtype), db2.astype(g2.dtype),
+            dg3.astype(g3.dtype), db3.astype(g3.dtype))
+
+
+bottleneck_fused.defvjp(_bottleneck_fwd, _bottleneck_bwd)
+
+
+@jax.custom_vjp
+def bottleneck_hybrid(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    """Identity-shortcut bottleneck, hybrid engine selection (measured on
+    chip, tools/probe_fused_conv.py): XLA forward — its conv emitter already
+    rides the HBM bound and beats the Pallas im2col 3x3 by ~2.5x — plus the
+    Pallas combined backward for the two 1x1 layers, where one kernel's
+    read of (gradient, activation) yields dX, dW and the BN reductions that
+    XLA computes with separate convs and reduce passes. The 3x3 backward
+    stays on XLA's conv kernels."""
+    zout, stats, _ = _hybrid_fwd_impl(z, w1, w2, w3, g1, b1, g2, b2, g3, b3)
+    return zout, stats
+
+
+def _hybrid_fwd_impl(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    n, h, wd, c4 = z.shape
+    c = w1.shape[1]
+    m = n * h * wd
+    z2 = z.astype(jnp.bfloat16).reshape(m, c4)
+    y1 = jax.lax.dot_general(z2, w1.astype(jnp.bfloat16),
+                             (((1,), (0,)), ((), ()))).astype(jnp.bfloat16)
+    y1f = y1.astype(jnp.float32)
+    st1 = jnp.stack([jnp.sum(y1f, 0), jnp.sum(y1f * y1f, 0)])
+    mean1, var1, a1, b1f = _fold(st1, g1, b1, m)
+    xhat1 = jnp.maximum(y1f * a1 + b1f, 0.0).astype(jnp.bfloat16)
+    y2 = jax.lax.conv_general_dilated(
+        xhat1.reshape(n, h, wd, c), w2.astype(jnp.bfloat16), (1, 1),
+        [(1, 1), (1, 1)], dimension_numbers=("NHWC", "HWIO", "NHWC")
+    ).astype(jnp.bfloat16)
+    y2f = y2.astype(jnp.float32)
+    st2 = jnp.stack([jnp.sum(y2f, (0, 1, 2)), jnp.sum(y2f * y2f, (0, 1, 2))])
+    mean2, var2, a2, b2f = _fold(st2, g2, b2, m)
+    xhat2 = jnp.maximum(y2f * a2 + b2f, 0.0).astype(jnp.bfloat16)
+    y3 = jax.lax.dot_general(xhat2.reshape(m, c), w3.astype(jnp.bfloat16),
+                             (((1,), (0,)), ((), ()))).astype(jnp.bfloat16)
+    y3f = y3.astype(jnp.float32)
+    st3 = jnp.stack([jnp.sum(y3f, 0), jnp.sum(y3f * y3f, 0)])
+    mean3, var3, a3, b3f = _fold(st3, g3, b3, m)
+    q = y3f * a3 + b3f + z2.astype(jnp.float32)
+    zout = jnp.maximum(q, 0.0).astype(z.dtype).reshape(z.shape)
+    stats = (mean1, var1, mean2, var2, mean3, var3)
+    res = (z, zout, y1, y2, y3,
+           (mean1, var1, a1, b1f), (mean2, var2, a2, b2f),
+           (mean3, var3, a3, b3f), (w1, w2, w3), (g1, g2, g3))
+    return zout, stats, res
+
+
+def _hybrid_fwd(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    zout, stats, res = _hybrid_fwd_impl(z, w1, w2, w3, g1, b1, g2, b2,
+                                        g3, b3)
+    return (zout, stats), res
+
+
+def _hybrid_bwd(res, cts):
+    dzout = cts[0]
+    (z, zout, y1, y2, y3, bn1, bn2, bn3, ws, gs) = res
+    mean1, var1, a1, b1f = bn1
+    mean2, var2, a2, b2f = bn2
+    mean3, var3, a3, b3f = bn3
+    w1, w2, w3 = ws
+    g1, g2, g3 = gs
+    n, h, wd, c4 = z.shape
+    m = n * h * wd
+    c = w1.shape[1]
+
+    dz2 = dzout.reshape(m, c4)
+    j = jnp.where(zout.reshape(m, c4) > 0, dz2.astype(jnp.float32), 0.0)
+    s1_3 = jnp.sum(j, axis=0)
+    s2_3 = jnp.sum(j * y3.astype(jnp.float32), axis=0)
+    jj = j.astype(jnp.bfloat16)
+    al3, be3, de3, dg3, db3 = bn_bwd_coefs(s1_3, s2_3, mean3, var3, g3, m,
+                                           EPS)
+
+    # conv3 (1x1): one Pallas pass -> P2, dW3, BN2 sums
+    p2, dw3, st_p2 = fused_bwd_matmul_bn(
+        jj, y3, y2.reshape(m, c), w3, coefs=(al3, be3, de3),
+        xaffine=(a2, b2f), xrelu=True, stats=True)
+    al2, be2, de2, dg2, db2 = bn_bwd_coefs(st_p2[0], st_p2[1], mean2, var2,
+                                           g2, m, EPS)
+
+    # conv2 (3x3): XLA backward (its conv kernels beat the im2col Pallas
+    # form on-chip); corrections are XLA elementwise around it
+    g2c = (p2.astype(jnp.float32) * al2 + y2.reshape(m, c).astype(jnp.float32)
+           * be2 + de2).astype(jnp.bfloat16).reshape(n, h, wd, c)
+    y1f = y1.astype(jnp.float32)
+    pre1 = y1f * a1 + b1f
+    xhat1 = jnp.maximum(pre1, 0.0).astype(jnp.bfloat16).reshape(n, h, wd, c)
+    _, conv_vjp = jax.vjp(
+        lambda xx, ww: jax.lax.conv_general_dilated(
+            xx, ww, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")),
+        xhat1, w2.astype(jnp.bfloat16))
+    dxhat1, dw2 = conv_vjp(g2c)
+    p1 = jnp.where(pre1 > 0.0, dxhat1.reshape(m, c).astype(jnp.float32), 0.0)
+    s1_1 = jnp.sum(p1, axis=0)
+    s2_1 = jnp.sum(p1 * y1f, axis=0)
+    al1, be1, de1, dg1, db1 = bn_bwd_coefs(s1_1, s2_1, mean1, var1, g1, m,
+                                           EPS)
+
+    # conv1 (1x1): one Pallas pass -> dZ_main, dW1
+    dz_main, dw1, _ = fused_bwd_matmul_bn(
+        p1.astype(jnp.bfloat16), y1, z.reshape(m, c4), w1,
+        coefs=(al1, be1, de1), xaffine=None, stats=False)
+
+    dz = (dz_main.astype(jnp.float32) + j).astype(z.dtype).reshape(z.shape)
+    return (dz, dw1.astype(w1.dtype), dw2.astype(w2.dtype),
+            dw3.astype(w3.dtype), dg1.astype(g1.dtype), db1.astype(g1.dtype),
+            dg2.astype(g2.dtype), db2.astype(g2.dtype),
+            dg3.astype(g3.dtype), db3.astype(g3.dtype))
+
+
+bottleneck_hybrid.defvjp(_hybrid_fwd, _hybrid_bwd)
+
+
+def bottleneck_reference(z, w1, w2, w3, g1, b1, g2, b2, g3, b3):
+    """Dense-XLA oracle with identical math (bf16 activations, f32 BN):
+    used by tests and as documentation of the fused block's semantics."""
+    n, h, wd, c4 = z.shape
+
+    def bn(x, gamma, beta):
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean,
+                          0.0)
+        a, b = bn_affine(mean, var, gamma, beta, EPS)
+        return (xf * a + b), (mean, var)
+
+    y1 = jax.lax.dot_general(z.astype(jnp.bfloat16).reshape(-1, c4),
+                             w1.astype(jnp.bfloat16),
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    y1 = y1.astype(jnp.bfloat16)
+    x1, (m1, v1) = bn(y1, g1, b1)
+    x1 = jnp.maximum(x1, 0.0).astype(jnp.bfloat16).reshape(n, h, wd, -1)
+    # no preferred_element_type: lax's conv transpose rule requires the
+    # cotangent dtype to match the operands (cf. ops/nn.py conv2d AMP note)
+    y2 = jax.lax.conv_general_dilated(
+        x1, w2.astype(jnp.bfloat16), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x2, (m2, v2) = bn(y2, g2, b2)
+    x2 = jnp.maximum(x2, 0.0).astype(jnp.bfloat16).reshape(-1, w2.shape[3])
+    y3 = jax.lax.dot_general(x2, w3.astype(jnp.bfloat16),
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    y3 = y3.astype(jnp.bfloat16)
+    x3, (m3, v3) = bn(y3, g3, b3)
+    q = x3 + z.astype(jnp.float32).reshape(-1, c4)
+    zout = jnp.maximum(q, 0.0).astype(z.dtype).reshape(z.shape)
+    return zout, (m1, v1, m2, v2, m3, v3)

@@ -1,0 +1,227 @@
+"""Fused conv+BN Pallas kernels vs dense-XLA oracles (interpreter mode on
+CPU = the same kernels the TPU runs). These kernels are the measured
+fused-bottleneck attempt documented in docs/perf.md §resnet-roofline: the
+forward matmul form matches XLA's HBM-bound rate on chip, the combined
+backward yields dX+dW+BN-reductions in one pass, and the full-block
+compositions are numerically pinned here even though the XLA-native path
+remains the default engine (fusion-boundary analysis in docs/perf.md)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas_conv import (bn_affine, bn_bwd_coefs,
+                                        fused_bwd_conv3x3_bn,
+                                        fused_bwd_matmul_bn,
+                                        fused_conv3x3_bn, fused_matmul_bn)
+
+
+@pytest.fixture(autouse=True)
+def _cpu_highest():
+    with jax.default_device(jax.devices("cpu")[0]), \
+         jax.default_matmul_precision("highest"):
+        yield
+
+
+def _affine(k):
+    return bn_affine(jnp.zeros(k), jnp.ones(k), jnp.ones(k) * 1.1,
+                     jnp.zeros(k) + 0.05)
+
+
+def test_fused_matmul_bn_matches_xla_chain():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 16).astype("float32"))
+    w = jnp.asarray(rng.randn(16, 8).astype("float32") * 0.2)
+    a, b = _affine(16)
+    y, st = fused_matmul_bn(x, w, (a, b), interpret=True, block_m=16)
+    xh = jnp.maximum(x * a + b, 0).astype(jnp.bfloat16)
+    ref = xh @ w.astype(jnp.bfloat16)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref, np.float32),
+        rtol=0.02, atol=0.05)
+    rf = ref.astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(st), np.asarray(jnp.stack([rf.sum(0), (rf * rf).sum(0)])),
+        rtol=0.02, atol=0.5)
+
+
+def test_fused_conv3x3_bn_matches_xla_conv():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 8, 16).astype("float32"))
+    w = jnp.asarray(rng.randn(3, 3, 16, 8).astype("float32") * 0.2)
+    a, b = _affine(16)
+    y, st = fused_conv3x3_bn(x, w, (a, b), interpret=True)
+    xh = jnp.maximum(x * a + b, 0).astype(jnp.bfloat16)
+    ref = jax.lax.conv_general_dilated(
+        xh, w.astype(jnp.bfloat16), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref, np.float32),
+        rtol=0.02, atol=0.1)
+
+
+def _layer_oracle_1x1(p, yout, yin, w, coefs, xaff, xrelu):
+    """Dense math for what the combined bwd kernel computes."""
+    pf = p.astype(jnp.float32)
+    if coefs is not None:
+        al, be, de = coefs
+        g = pf * al + yout.astype(jnp.float32) * be + de
+    else:
+        g = pf
+    g16 = g.astype(jnp.bfloat16)
+    if xaff is not None:
+        n = yin.astype(jnp.float32) * xaff[0] + xaff[1]
+        xhat16 = (jnp.maximum(n, 0.0) if xrelu else n).astype(jnp.bfloat16)
+    else:
+        xhat16 = yin.astype(jnp.bfloat16)
+    dw = (xhat16.astype(jnp.float32).T @ g16.astype(jnp.float32))
+    dx = g16.astype(jnp.float32) @ w.astype(jnp.bfloat16).astype(
+        jnp.float32).T
+    if xaff is not None and xrelu:
+        dx = jnp.where(n > 0, dx, 0.0)
+    s = jnp.stack([dx.sum(0), (dx * yin.astype(jnp.float32)).sum(0)])
+    return dx, dw, s
+
+
+def test_fused_bwd_matmul_bn_matches_oracle():
+    rng = np.random.RandomState(2)
+    m, k, n = 32, 8, 16
+    p = jnp.asarray(rng.randn(m, n).astype("float32"))
+    yout = jnp.asarray(rng.randn(m, n).astype("float32"))
+    yin = jnp.asarray(rng.randn(m, k).astype("float32"))
+    w = jnp.asarray(rng.randn(k, n).astype("float32") * 0.2)
+    coefs = (jnp.ones(n) * 1.2, jnp.ones(n) * -0.1, jnp.ones(n) * 0.03)
+    xaff = _affine(k)
+    pin, dw, st = fused_bwd_matmul_bn(p, yout, yin, w, coefs=coefs,
+                                      xaffine=xaff, xrelu=True, stats=True,
+                                      interpret=True, block_m=16)
+    rx, rw, rs = _layer_oracle_1x1(p, yout, yin, w, coefs, xaff, True)
+    np.testing.assert_allclose(np.asarray(pin, np.float32), np.asarray(rx),
+                               rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(rw), rtol=0.05,
+                               atol=0.3)
+    np.testing.assert_allclose(np.asarray(st), np.asarray(rs), rtol=0.05,
+                               atol=0.3)
+
+
+def test_fused_bwd_conv3x3_bn_matches_conv_vjp():
+    rng = np.random.RandomState(3)
+    nimg, h, k, c = 2, 6, 8, 8
+    p = jnp.asarray(rng.randn(nimg, h, h, c).astype("float32"))
+    yout = jnp.asarray(rng.randn(nimg, h, h, c).astype("float32"))
+    yin = jnp.asarray(rng.randn(nimg, h, h, k).astype("float32"))
+    w = jnp.asarray(rng.randn(3, 3, k, c).astype("float32") * 0.2)
+    coefs = (jnp.ones(c) * 1.2, jnp.ones(c) * -0.1, jnp.ones(c) * 0.03)
+    xaff = _affine(k)
+    pin, dw, st = fused_bwd_conv3x3_bn(p, yout, yin, w, coefs=coefs,
+                                       xaffine=xaff, xrelu=True, stats=True,
+                                       interpret=True)
+    # oracle: corrected g through the conv vjp
+    g = (p * coefs[0] + yout * coefs[1] + coefs[2]).astype(jnp.bfloat16)
+    n_pre = yin * xaff[0] + xaff[1]
+    xhat = jnp.maximum(n_pre, 0.0).astype(jnp.bfloat16)
+    _, vjp = jax.vjp(
+        lambda xx, ww: jax.lax.conv_general_dilated(
+            xx, ww, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")),
+        xhat, w.astype(jnp.bfloat16))
+    dxhat, rw = vjp(g)
+    rx = jnp.where(n_pre > 0, dxhat.astype(jnp.float32), 0.0)
+    np.testing.assert_allclose(np.asarray(pin, np.float32), np.asarray(rx),
+                               rtol=0.05, atol=0.1)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(rw, np.float32),
+                               rtol=0.05, atol=0.5)
+    rs = jnp.stack([rx.sum((0, 1, 2)), (rx * yin).sum((0, 1, 2))])
+    np.testing.assert_allclose(np.asarray(st), np.asarray(rs), rtol=0.05,
+                               atol=0.5)
+
+
+@pytest.mark.parametrize("which", ["fused", "hybrid"])
+def test_bottleneck_blocks_match_reference(which, monkeypatch):
+    import paddle_tpu.ops.pallas_conv as pc
+
+    monkeypatch.setattr(pc, "_interpret_default", lambda: True)
+    from paddle_tpu.ops.fused_resnet import (bottleneck_fused,
+                                             bottleneck_hybrid,
+                                             bottleneck_reference)
+
+    fn = bottleneck_fused if which == "fused" else bottleneck_hybrid
+    rng = np.random.RandomState(4)
+    nimg, h, c = 1, 8, 4
+    c4 = 4 * c
+    z = jnp.asarray(rng.randn(nimg, h, h, c4).astype("float32") * 0.5,
+                    dtype=jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(c4, c).astype("float32") * 0.1)
+    w2 = jnp.asarray(rng.randn(3, 3, c, c).astype("float32") * 0.1)
+    w3 = jnp.asarray(rng.randn(c, c4).astype("float32") * 0.1)
+    g1 = jnp.ones(c) * 1.1
+    b1 = jnp.zeros(c) + 0.05
+    g2 = jnp.ones(c) * 0.9
+    b2 = jnp.zeros(c) - 0.02
+    g3 = jnp.ones(c4) * 1.05
+    b3 = jnp.zeros(c4) + 0.01
+    args = (z, w1, w2, w3, g1, b1, g2, b2, g3, b3)
+
+    zf, stf = fn(*args)
+    zr, str_ = bottleneck_reference(*args)
+    np.testing.assert_allclose(np.asarray(zf, np.float32),
+                               np.asarray(zr, np.float32), rtol=0.05,
+                               atol=0.1)
+    for sf, sr in zip(stf, str_):
+        np.testing.assert_allclose(np.asarray(sf), np.asarray(sr),
+                                   rtol=0.02, atol=0.01)
+
+    def loss(f):
+        def go(*a):
+            zo, _ = f(*a)
+            return jnp.sum(zo.astype(jnp.float32) ** 2)
+        return go
+
+    gf = jax.grad(loss(fn), argnums=tuple(range(10)))(*args)
+    gr = jax.grad(loss(bottleneck_reference), argnums=tuple(range(10)))(*args)
+    for a, b in zip(gf, gr):
+        aa = np.asarray(a, np.float32)
+        bb = np.asarray(b, np.float32)
+        scale = np.abs(bb).max() + 1e-6
+        assert np.abs(aa - bb).max() / scale < 0.03
+
+
+def test_bn_bwd_coefs_reproduce_jax_bn_grad():
+    """The per-channel linearization equals jax.grad through batch norm."""
+    rng = np.random.RandomState(5)
+    m, c = 64, 4
+    y = jnp.asarray(rng.randn(m, c).astype("float32"))
+    dn = jnp.asarray(rng.randn(m, c).astype("float32"))
+    gamma = jnp.ones(c) * 1.3
+    beta = jnp.zeros(c) + 0.1
+    eps = 1e-5
+
+    def bn_out(y):
+        mean = jnp.mean(y, axis=0)
+        var = jnp.mean(y * y, axis=0) - mean * mean
+        return (y - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+    _, vjp = jax.vjp(bn_out, y)
+    (ref,) = vjp(dn)
+    mean = jnp.mean(y, axis=0)
+    var = jnp.mean(y * y, axis=0) - mean * mean
+    s1 = dn.sum(0)
+    s2 = (dn * y).sum(0)
+    al, be, de, dg, db = bn_bwd_coefs(s1, s2, mean, var, gamma, m, eps)
+    got = dn * al + y * be + de
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+    # dgamma/dbeta
+    def bn_params(p):
+        g, b = p
+        mean = jnp.mean(y, axis=0)
+        var = jnp.mean(y * y, axis=0) - mean * mean
+        return (y - mean) * jax.lax.rsqrt(var + eps) * g + b
+
+    _, vjp2 = jax.vjp(bn_params, (gamma, beta))
+    ((rdg, rdb),) = vjp2(dn)
+    np.testing.assert_allclose(np.asarray(dg), np.asarray(rdg), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(rdb), rtol=1e-4,
+                               atol=1e-5)

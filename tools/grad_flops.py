@@ -10,7 +10,7 @@ matmul-dominated models is ~3x forward (fwd + dX + dW), so a healthy
 compiled ratio is ~<=3.5; a regression toward ~5-6x means CSE stopped
 folding the replays.
 
-Usage: python tools/grad_flops.py [--model transformer|mlp|resnet]
+Usage: python tools/grad_flops.py [--model transformer|mlp]
 (CPU or TPU; FLOP counts come from XLA cost analysis, not wall clock.)
 Also imported by tests/test_autodiff.py::test_grad_flops_ratio_bounded.
 """
@@ -52,7 +52,7 @@ def build_programs(model="transformer"):
     return main, fwd, startup, loss, feeds
 
 
-def compiled_flops(program, feeds, fetch_names, amp=False):
+def compiled_flops(program, startup, feeds, fetch_names, amp=False):
     import jax
     import numpy as np
 
@@ -78,7 +78,7 @@ def compiled_flops(program, feeds, fetch_names, amp=False):
 
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
-    exe.run(_STARTUP, scope=scope, seed=3)
+    exe.run(startup, scope=scope, seed=3)
     ro = {n: jax.device_put(scope.get(n), cpu) for n in readonly}
     do = {n: jax.device_put(scope.get(n), cpu) for n in donated}
     key = jax.random.PRNGKey(0)
@@ -88,15 +88,10 @@ def compiled_flops(program, feeds, fetch_names, amp=False):
     return float(cost.get("flops", 0.0))
 
 
-_STARTUP = None
-
-
 def measure(model="transformer", amp=False):
-    global _STARTUP
     main, fwd, startup, loss, feeds = build_programs(model)
-    _STARTUP = startup
-    f_fwd = compiled_flops(fwd, feeds, [loss.name], amp=amp)
-    f_train = compiled_flops(main, feeds, [loss.name], amp=amp)
+    f_fwd = compiled_flops(fwd, startup, feeds, [loss.name], amp=amp)
+    f_train = compiled_flops(main, startup, feeds, [loss.name], amp=amp)
     ratio = f_train / f_fwd if f_fwd else float("nan")
     return f_fwd, f_train, ratio
 

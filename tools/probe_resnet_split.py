@@ -87,25 +87,49 @@ def _bn(x, p, mode):
 
 
 def forward(params, blocks, img, label, mode):
+    fused = mode in ("fusedblocks", "hybridblocks")
+    bn_mode = "std"
     x = img.astype(jnp.bfloat16)
     x = jnp.transpose(x, (0, 2, 3, 1))
-    x = _bn(_conv(x, params["stem_w"], 2), params["stem_bn"], mode)
+    x = _bn(_conv(x, params["stem_w"], 2), params["stem_bn"], bn_mode if fused else mode)
     x = jax.nn.relu(x)
     x = jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
         [(0, 0), (1, 1), (1, 1), (0, 0)])
-    for name, stride, has_sc in blocks:
+    block_fn = None
+    if fused:
+        from paddle_tpu.ops.fused_resnet import (bottleneck_fused,
+                                                 bottleneck_hybrid)
+        block_fn = (bottleneck_hybrid if mode == "hybridblocks"
+                    else bottleneck_fused)
+
+    def xla_block(x, name, stride, has_sc, m):
         short = x
         if has_sc:
             short = _bn(_conv(x, params[name + "_sc_w"], stride),
-                        params[name + "_sc_bn"], mode)
+                        params[name + "_sc_bn"], m)
         y = jax.nn.relu(_bn(_conv(x, params[name + "_c1_w"], stride),
-                            params[name + "_c1_bn"], mode))
+                            params[name + "_c1_bn"], m))
         y = jax.nn.relu(_bn(_conv(y, params[name + "_c2_w"], 1),
-                            params[name + "_c2_bn"], mode))
+                            params[name + "_c2_bn"], m))
         y = _bn(_conv(y, params[name + "_c3_w"], 1),
-                params[name + "_c3_bn"], mode)
-        x = jax.nn.relu(short + y)
+                params[name + "_c3_bn"], m)
+        return jax.nn.relu(short + y)
+
+    for name, stride, has_sc in blocks:
+        if fused and not has_sc and stride == 1:
+            x, _stats = block_fn(
+                x, params[name + "_c1_w"][0, 0],
+                params[name + "_c2_w"], params[name + "_c3_w"][0, 0],
+                params[name + "_c1_bn"]["scale"],
+                params[name + "_c1_bn"]["bias"],
+                params[name + "_c2_bn"]["scale"],
+                params[name + "_c2_bn"]["bias"],
+                params[name + "_c3_bn"]["scale"],
+                params[name + "_c3_bn"]["bias"])
+        else:
+            x = xla_block(x, name, stride, has_sc,
+                          bn_mode if fused else mode)
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
     logits = x.astype(jnp.bfloat16) @ params["fc_w"].astype(jnp.bfloat16)
     logits = logits.astype(jnp.float32) + params["fc_b"]
