@@ -152,7 +152,7 @@ def _collect_block_io(
 
 
 def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names,
-                  amp: bool = False):
+                  amp: bool = False, mesh=None):
     """Trace a block into a pure function
     ``step(feed, readonly, donated, key) -> (fetches, new_state)``.
 
@@ -171,7 +171,7 @@ def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names,
         env.update(readonly)
         env.update(donated)
         env.update(feed_vals)
-        ctx = ExecContext(key=key, amp=amp)
+        ctx = ExecContext(key=key, amp=amp, mesh=mesh)
         ctx.block_runner = builder
         builder.run_block(block_idx, env, ctx)
         fetches = []

@@ -42,7 +42,11 @@ class ExecContext:
     """
 
     def __init__(self, key=None, block_runner=None, is_test: bool = False,
-                 amp: bool = False):
+                 amp: bool = False, mesh=None):
+        # the ParallelExecutor's device mesh (None under the single-device
+        # Executor): ops that internally shard_map (pipelined stacks, ring
+        # attention) read the axis sizes from here
+        self.mesh = mesh
         self._key = key
         # the step's base key, NOT advanced by next_key: ops that must see
         # identical randomness in their forward and grad invocations (e.g.

@@ -707,3 +707,57 @@ def slice(input, axes, starts, ends, name: Optional[str] = None):
                      {"axes": list(axes), "starts": list(starts),
                       "ends": list(ends)})
     return out
+
+
+def pipelined_transformer_stack(x, n_stages: int, layers_per_stage: int,
+                                n_heads: int, d_ff: int, causal: bool = True,
+                                microbatches: int = 4, remat: bool = False,
+                                name: Optional[str] = None):
+    """A stack of S*L homogeneous pre-LN decoder layers carried by ONE op
+    with parameters stacked [S, L, ...] and sharded over the 'pp' mesh axis
+    (ops/pipelined_stack.py). Under a ParallelExecutor whose mesh has
+    pp == n_stages the stack runs the GPipe schedule
+    (parallel/pipeline.py); on a single device it runs sequentially with
+    identical math. This is the layers-API reachability for pipeline
+    parallelism (SURVEY.md §2c 'pp')."""
+    from ..initializer import ConstantInitializer, XavierInitializer
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("pipelined_transformer_stack", name=name)
+    d = int(x.shape[-1])
+    nm = name or "pp_stack"
+    s, l = int(n_stages), int(layers_per_stage)
+
+    def param(suffix, shape, is_bias=False, fan=None, one=False):
+        init = None
+        if one:
+            init = ConstantInitializer(1.0)
+        elif fan is not None:
+            init = XavierInitializer(fan_in=fan[0], fan_out=fan[1])
+        sharding = ("pp",) + (None,) * (len(shape) - 1)
+        return helper.create_parameter(
+            ParamAttr(f"{nm}.{suffix}", initializer=init, sharding=sharding),
+            shape, is_bias=is_bias)
+
+    inputs = {
+        "X": [x],
+        "LN1Scale": [param("ln1s", [s, l, d], one=True)],
+        "LN1Bias": [param("ln1b", [s, l, d], is_bias=True)],
+        "WQ": [param("wq", [s, l, d, d], fan=(d, d))],
+        "WK": [param("wk", [s, l, d, d], fan=(d, d))],
+        "WV": [param("wv", [s, l, d, d], fan=(d, d))],
+        "WO": [param("wo", [s, l, d, d], fan=(d, d))],
+        "LN2Scale": [param("ln2s", [s, l, d], one=True)],
+        "LN2Bias": [param("ln2b", [s, l, d], is_bias=True)],
+        "WUp": [param("wup", [s, l, d, d_ff], fan=(d, d_ff))],
+        "BUp": [param("bup", [s, l, d_ff], is_bias=True)],
+        "WDown": [param("wdown", [s, l, d_ff, d], fan=(d_ff, d))],
+        "BDown": [param("bdown", [s, l, d], is_bias=True)],
+    }
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "pipelined_transformer_stack", inputs, {"Out": [out]},
+        {"n_heads": int(n_heads), "causal": bool(causal),
+         "microbatches": int(microbatches), "remat": bool(remat)},
+    )
+    return out

@@ -92,11 +92,18 @@ def encoder_layer(x, d_model: int, n_heads: int, d_ff: int, causal: bool,
 def transformer_lm(ids, labels, vocab_size: int, max_len: int,
                    d_model: int = 128, n_heads: int = 4, n_layers: int = 2,
                    d_ff: int = 512, tp_shard: bool = False,
-                   use_recompute: bool = False, fused_head: bool = False):
+                   use_recompute: bool = False, fused_head: bool = False,
+                   pp_stages: int = 0, pp_microbatches: int = 4):
     """Decoder-only (causal) language model.
 
     ids/labels: [N, T] int64 with T <= max_len (labels = ids shifted by
     one). Returns (logits [N, T, V], avg_loss).
+
+    ``pp_stages > 0`` routes the layer stack through the
+    ``pipelined_transformer_stack`` op (embedding and LM head stay outside
+    the pipeline): under a ParallelExecutor whose mesh has a 'pp' axis of
+    that size the stack runs the GPipe schedule; single-device execution
+    keeps identical sequential math.
     """
     from ..layer_helper import LayerHelper
 
@@ -115,10 +122,26 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
     if t < max_len:
         pos = layers.slice(pos, axes=[1], starts=[0], ends=[t])
     x = layers.elementwise_add(emb, pos)
-    for i in range(n_layers):
-        x = encoder_layer(x, d_model, n_heads, d_ff, causal=True,
-                          name=f"tlm.l{i}", tp_shard=tp_shard,
-                          use_recompute=use_recompute)
+    if pp_stages:
+        if tp_shard:
+            raise NotImplementedError(
+                "pp_stages does not compose with tp_shard yet: the "
+                "pipelined stack has no tensor-parallel weight layout, so "
+                "tp_shard would be silently dropped")
+        if n_layers % pp_stages:
+            raise ValueError(
+                f"n_layers {n_layers} not divisible by pp_stages "
+                f"{pp_stages}")
+        x = layers.pipelined_transformer_stack(
+            x, n_stages=pp_stages, layers_per_stage=n_layers // pp_stages,
+            n_heads=n_heads, d_ff=d_ff, causal=True,
+            microbatches=pp_microbatches, remat=use_recompute,
+            name="tlm.pp")
+    else:
+        for i in range(n_layers):
+            x = encoder_layer(x, d_model, n_heads, d_ff, causal=True,
+                              name=f"tlm.l{i}", tp_shard=tp_shard,
+                              use_recompute=use_recompute)
     x = layers.layer_norm(x, begin_norm_axis=2)
     # logits path (inference / fetching): ordinary fc. The training loss
     # shares its weight+bias BY NAME with the streamed head below; when the

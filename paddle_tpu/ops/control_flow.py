@@ -77,7 +77,8 @@ def _scalar_bool(x):
 
 def _sub_ctx(ctx: ExecContext, key) -> ExecContext:
     sub = ExecContext(key=key, block_runner=ctx.block_runner,
-                      is_test=ctx.is_test, amp=ctx.amp)
+                      is_test=ctx.is_test, amp=ctx.amp,
+                      mesh=getattr(ctx, "mesh", None))
     # nested blocks inside a recompute segment inherit the remat marker
     # (pallas fallbacks must hold through while/cond bodies too). The base
     # key becomes this body's PER-ITERATION key: a recompute segment inside

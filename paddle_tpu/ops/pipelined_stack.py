@@ -1,0 +1,119 @@
+"""Pipelined transformer stack op: the layers-API entry to the 'pp' axis.
+
+<- capability target: the reference's layer-wise model parallelism
+(gserver/gradientmachines/ParallelNeuralNetwork.h) re-expressed as GPipe
+over a TPU mesh (SURVEY.md §2c 'pp' axis). One IR op carries the WHOLE
+stack of S*L homogeneous pre-LN decoder layers with parameters stacked
+[S, L, ...]; under a ParallelExecutor whose mesh has a 'pp' axis of size
+S the kernel runs parallel/pipeline.py's lax.scan GPipe schedule
+(parameters sharded P('pp'), microbatches rotating over ICI), and under a
+single device (or pp=1) it runs the stages sequentially — identical math,
+so single-device tests pin the pipeline's numerics.
+
+The layer math mirrors models/transformer.py encoder_layer exactly
+(pre-LN, flash attention via the custom_vjp entry point, relu FFN) with
+the ops/_amp.py dtype policy: bf16 matmul operands under AMP, f32
+normalization statistics, f32 master weights cast at point of use.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ._amp import low_precision
+from .pallas_attention import flash_attention
+
+_EPS = 1e-5
+
+
+def _ln(x, scale, bias):
+    xf = x.astype(jnp.float32) if low_precision(x.dtype) else x
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                      - mean * mean, 0.0)
+    y = (xf - mean) * lax.rsqrt(var + _EPS)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _dot(x, w, amp):
+    if amp:
+        x = x.astype(jnp.bfloat16)
+        w = w.astype(jnp.bfloat16)
+    out = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+    return out.astype(x.dtype if amp else out.dtype)
+
+
+def _decoder_layer(p, x, n_heads, causal, amp):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). p: single-layer dict."""
+    mb, t, d = x.shape
+    d_head = d // n_heads
+    a = _ln(x, p["ln1s"], p["ln1b"])
+    q = _dot(a, p["wq"], amp).reshape(mb, t, n_heads, d_head)
+    k = _dot(a, p["wk"], amp).reshape(mb, t, n_heads, d_head)
+    v = _dot(a, p["wv"], amp).reshape(mb, t, n_heads, d_head)
+    ctx_v = flash_attention(q, k, v, causal, None)
+    ctx_v = ctx_v.reshape(mb, t, d)
+    x = x + _dot(ctx_v, p["wo"], amp).astype(x.dtype)
+    f = _ln(x, p["ln2s"], p["ln2b"])
+    h = _dot(f, p["wup"], amp) + p["bup"].astype(
+        jnp.bfloat16 if amp else p["bup"].dtype)
+    h = jax.nn.relu(h)
+    f = _dot(h, p["wdown"], amp) + p["bdown"].astype(
+        jnp.bfloat16 if amp else p["bdown"].dtype)
+    return x + f.astype(x.dtype)
+
+
+_SLOTS = ("LN1Scale", "LN1Bias", "WQ", "WK", "WV", "WO",
+          "LN2Scale", "LN2Bias", "WUp", "BUp", "WDown", "BDown")
+_KEYS = ("ln1s", "ln1b", "wq", "wk", "wv", "wo",
+         "ln2s", "ln2b", "wup", "bup", "wdown", "bdown")
+
+
+@register_op("pipelined_transformer_stack",
+             inputs=("X",) + _SLOTS, outputs=("Out",),
+             diff_inputs=("X",) + _SLOTS)
+def pipelined_transformer_stack(ctx, ins, attrs):
+    x = ins["X"][0]
+    params = {k: ins[slot][0] for k, slot in zip(_KEYS, _SLOTS)}
+    n_heads = int(attrs["n_heads"])
+    causal = bool(attrs.get("causal", True))
+    microbatches = int(attrs.get("microbatches", 4))
+    remat = bool(attrs.get("remat", False))
+    amp = bool(getattr(ctx, "amp", False))
+    n_stages = params["wq"].shape[0]
+    layers_per_stage = params["wq"].shape[1]
+
+    def stage_fn(p_stage, x_mb):
+        # p_stage leaves: [L, ...]
+        out = x_mb
+        for l in range(layers_per_stage):
+            p_l = {k: v[l] for k, v in p_stage.items()}
+            out = _decoder_layer(p_l, out, n_heads, causal, amp)
+        return out
+
+    mesh = getattr(ctx, "mesh", None)
+    has_pp = (mesh is not None and "pp" in mesh.axis_names
+              and mesh.shape["pp"] > 1)
+    if has_pp and mesh.shape["pp"] != n_stages:
+        raise ValueError(
+            f"pipelined_transformer_stack built with {n_stages} stages but "
+            f"the mesh 'pp' axis has size {mesh.shape['pp']}; a silent "
+            f"sequential fallback would all-gather the stage weights every "
+            f"step — rebuild the model with pp_stages={mesh.shape['pp']} "
+            f"or resize the mesh")
+    if has_pp and n_stages > 1:
+        from ..parallel.pipeline import gpipe
+
+        out = gpipe(stage_fn, params, x, mesh, axis="pp",
+                    microbatches=microbatches, remat=remat,
+                    batch_axes=("dp",))
+    else:
+        # sequential semantics (single device / pp=1): same math, so this
+        # path is the numerical oracle for the pipelined one
+        out = x
+        body = jax.checkpoint(stage_fn) if remat else stage_fn
+        for s in range(n_stages):
+            out = body({k: v[s] for k, v in params.items()}, out)
+    return {"Out": [out]}

@@ -352,7 +352,8 @@ class ParallelExecutor:
         entry = self._cache.get(key_cache)
         if entry is None:
             step, readonly_names, donated_names, state_out = build_step_fn(
-                self.program, 0, feed_names, fetch_names, amp=self.amp
+                self.program, 0, feed_names, fetch_names, amp=self.amp,
+                mesh=self.mesh
             )
             if self.async_mode:
                 step = self._build_local_sgd_step(step, feed_names)

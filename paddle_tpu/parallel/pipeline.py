@@ -1,21 +1,41 @@
 """Pipeline parallelism over the 'pp' mesh axis (GPipe-style microbatching).
 
 The reference has no pipeline engine (its model-parallel story is layer-wise
-placement); this is the TPU-native implementation the 'pp' axis in
-``mesh.MESH_AXES`` promises: stage parameters are stacked on a leading axis
-and sharded ``P('pp')`` so each device owns one stage, and microbatches flow
-stage-to-stage over ICI via ``lax.ppermute`` inside ``shard_map``. The
-schedule is the classic GPipe fill-drain: M microbatches over S stages take
-M + S - 1 ticks, every device running the SAME stage function on its own
-weights each tick (SPMD — no per-stage programs to compile).
+placement, gserver/gradientmachines/ParallelNeuralNetwork.h); this is the
+TPU-native implementation the 'pp' axis in ``mesh.MESH_AXES`` promises:
+stage parameters are stacked on a leading axis and sharded ``P('pp')`` so
+each device owns one stage, and microbatches flow stage-to-stage over ICI
+via ``lax.ppermute`` inside ``shard_map``. The schedule is the classic
+GPipe fill-drain: M microbatches over S stages take M + S - 1 ticks, every
+device running the SAME stage function on its own weights each tick (SPMD —
+one compiled program, no per-stage executables).
+
+The tick loop is a ``lax.scan`` (compile time and HLO size are O(1) in the
+tick count; round 2's Python unroll scaled linearly). Each tick emits the
+last stage's output as a scan OUTPUT (not a carry), so reverse-mode AD
+saves O(1) per tick rather than re-saving the whole output buffer.
 
 ``jax.grad`` through the schedule IS the pipeline backward: ppermute
-transposes to the reverse rotation, so backward microbatches drain in the
-opposite direction, exactly GPipe's backward pass.
+transposes to the reverse rotation and the scan transposes to a reverse
+scan, so backward microbatches drain in the opposite direction — exactly
+GPipe's backward pass.
+
+Memory (documented in lieu of a 1F1B scheduler): reverse-mode over the
+scan keeps, per tick, the carry activation plus ``fn``'s internal
+residuals — O((M+S-1) * (mb activation + fn residuals)) per device. With
+``remat=True`` each tick's ``fn`` is ``jax.checkpoint``-ed, cutting the
+per-tick cost to the carry alone: peak activation residency is then the
+textbook GPipe O(M) microbatch buffer. A true 1F1B schedule would bound
+residency at O(S) by interleaving forward and backward ticks, but that
+requires a hand-scheduled backward (custom_vjp over the whole pipeline)
+that no longer composes with ``jax.grad`` of the surrounding program; the
+remat knob plus GPipe residency is the deliberate trade until a 1F1B
+custom_vjp is worth that loss of composability.
 
 Restrictions (deliberate, minimal-but-real):
   * stages are structurally homogeneous (same ``fn``, different weights) —
     the transformer-stack case; embed/head layers run outside the pipeline;
+  * ``fn`` keeps the microbatch shape (stage i feeds stage i+1);
   * the microbatch count must divide the batch.
 """
 from __future__ import annotations
@@ -30,49 +50,62 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
-          axis: str = "pp", microbatches: int = 4):
+          axis: str = "pp", microbatches: int = 4, remat: bool = False,
+          batch_axes: tuple = ("dp",)):
     """Run ``x`` through S pipeline stages of ``fn`` with GPipe scheduling.
 
-    fn(params_one_stage, x_mb) -> y_mb  must keep the microbatch shape
-    (stage i's output feeds stage i+1's input).
+    fn(params_one_stage, x_mb) -> y_mb  must keep the microbatch shape.
     stage_params: pytree whose leaves have leading dim S == mesh.shape[axis]
-    (stacked per-stage weights; the caller shards or this call shards them
-    ``P('pp')``). x: [B, ...] with B % microbatches == 0.
-    Returns y: [B, ...] replicated over the pp axis.
+    (stacked per-stage weights; sharded ``P('pp')`` by this call).
+    x: [B, ...]. remat: checkpoint each tick's ``fn`` (see module
+    docstring). ``batch_axes``: mesh axes (those present) the batch dim is
+    sharded over — under a dp x pp mesh each dp replica pipelines only its
+    own batch shard instead of redundantly recomputing the global batch.
+    Returns y: [B, ...], batch-sharded the same way and replicated over pp.
     """
     n_stages = mesh.shape[axis]
+    data_axes = tuple(a for a in batch_axes
+                      if a in mesh.axis_names and a != axis)
+    dp_total = 1
+    for a in data_axes:
+        dp_total *= mesh.shape[a]
     batch = x.shape[0]
-    if batch % microbatches:
+    if batch % (microbatches * dp_total):
         raise ValueError(f"batch {batch} not divisible by microbatches "
-                         f"{microbatches}")
-    mb = batch // microbatches
+                         f"{microbatches} x data shards {dp_total}")
+    mb = batch // dp_total // microbatches
+    stage_fn = jax.checkpoint(fn) if remat else fn
 
     def local(params, x):
-        # params leaves: [1, ...] (this device's stage); x: full batch
+        # params leaves: [1, ...] (this device's stage); x: this data
+        # shard's batch (the full batch when no data axis is present)
         w = jax.tree.map(lambda p: p[0], params)
         stage = lax.axis_index(axis)
+        local_batch = x.shape[0]
         xs = x.reshape((microbatches, mb) + x.shape[1:])
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        ticks = microbatches + n_stages - 1
 
-        carry = jnp.zeros((mb,) + x.shape[1:], x.dtype)
-        outs = []
-        for t in range(microbatches + n_stages - 1):
+        def tick(carry, t):
             # stage 0 injects microbatch t while filling; other stages (and
             # stage 0 after the fill) consume what rotated in last tick
-            inject = xs[min(t, microbatches - 1)]
+            inject = lax.dynamic_index_in_dim(
+                xs, jnp.minimum(t, microbatches - 1), 0, keepdims=False)
             state = jnp.where(stage == 0, inject, carry)
-            y = fn(w, state)
-            if t >= n_stages - 1:
-                # the last stage emits microbatch t-(S-1)
-                outs.append(jnp.where(stage == n_stages - 1, y,
-                                      jnp.zeros_like(y)))
-            carry = lax.ppermute(y, axis, perm)
-        # only the last stage holds real outputs; psum replicates them
-        out = lax.psum(jnp.stack(outs), axis)
-        return out.reshape((batch,) + out.shape[2:])
+            y = stage_fn(w, state)
+            emit = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
+            return lax.ppermute(y, axis, perm), emit
+
+        carry0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        _, emits = lax.scan(tick, carry0, jnp.arange(ticks))
+        # the last stage emits microbatch t-(S-1) at tick t; psum replicates
+        outs = emits[n_stages - 1:]
+        out = lax.psum(outs, axis)
+        return out.reshape((local_batch,) + out.shape[2:])
 
     pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    xspec = P(data_axes if data_axes else None)
     fn_sharded = shard_map(
         local, mesh=mesh,
-        in_specs=(pspec, P()), out_specs=P(), check_vma=False)
+        in_specs=(pspec, xspec), out_specs=xspec, check_vma=False)
     return fn_sharded(stage_params, x)
