@@ -1,0 +1,196 @@
+"""Elastic training: worker-loss detection + automatic restart from the
+latest sharded checkpoint.
+
+<- the reference's Go fault-tolerance plane: the master re-queues work from
+dead workers (go/master/service.go:313-356 checkTimeoutFunc) and pserver
+clients re-resolve membership from etcd on change
+(go/pserver/client/etcd_client.go:35-110). A jax.distributed world is a
+FIXED topology — a lost process breaks every in-flight collective — so the
+TPU-native re-expression of elastic membership is supervisor-driven
+restart: detect the loss (process exit OR missed heartbeats, which also
+catches hangs), tear the incarnation down, re-form the cluster, and resume
+from the newest complete per-shard checkpoint (io.save_checkpoint's
+_SUCCESS-marked serials, which multi-host barriers keep consistent).
+
+Roles:
+  ElasticSupervisor — owns the heartbeat master (master/rpc.py), spawns the
+      worker processes with fresh coordinator endpoints per incarnation,
+      monitors exit codes + heartbeat TTL, restarts up to ``max_restarts``.
+  ElasticWorker — worker-side helper: per-step heartbeat to the master and
+      checkpoint-resume (returns the step to continue from).
+
+Driven end-to-end by tests/test_distributed.py::
+test_elastic_recovery_restarts_from_checkpoint (2-process localhost
+cluster, one worker hangs mid-run, the job resumes and converges).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .master.rpc import MasterRPCClient, MasterServer
+
+
+def _free_ports(n: int) -> List[int]:
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class ElasticSupervisor:
+    """Spawn-and-watch loop for an n-worker localhost training job.
+
+    worker_argv: the command each worker runs (the supervisor adds the
+    PADDLE_* cluster env, PADDLE_MASTER_ENDPOINT and PADDLE_ELASTIC_GEN).
+    A worker is declared lost when its process exits nonzero OR when its
+    last heartbeat is older than ``heartbeat_ttl`` (after an initial
+    ``startup_grace`` for cluster formation). On loss: every survivor is
+    killed (their collectives are wedged anyway) and the job restarts —
+    workers are expected to resume via ElasticWorker.resume_step.
+    """
+
+    def __init__(self, worker_argv: Sequence[str], n_workers: int,
+                 heartbeat_ttl: float = 15.0, startup_grace: float = 120.0,
+                 max_restarts: int = 3, poll_interval: float = 0.5,
+                 env: Optional[Dict[str, str]] = None, cwd: Optional[str] = None,
+                 on_event: Optional[Callable[[str], None]] = None):
+        self.worker_argv = list(worker_argv)
+        self.n_workers = n_workers
+        self.heartbeat_ttl = heartbeat_ttl
+        self.startup_grace = startup_grace
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.on_event = on_event or (lambda msg: None)
+        self.restarts = 0
+        self.outputs: List[List[str]] = []  # per incarnation, per rank
+
+    def _spawn(self, server: MasterServer) -> List[subprocess.Popen]:
+        gen = server.service.new_generation()
+        ports = _free_ports(self.n_workers)
+        endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+        procs = []
+        for i in range(self.n_workers):
+            e = dict(os.environ)
+            for k, v in self.env.items():
+                if v is None:
+                    e.pop(k, None)  # None = unset (e.g. strip PYTHONPATH)
+                else:
+                    e[k] = v
+            e["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+            e["PADDLE_TRAINERS_NUM"] = str(self.n_workers)
+            e["PADDLE_TRAINER_ID"] = str(i)
+            e["PADDLE_MASTER_ENDPOINT"] = server.endpoint
+            e["PADDLE_ELASTIC_GEN"] = str(gen)
+            procs.append(subprocess.Popen(
+                self.worker_argv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, cwd=self.cwd, env=e))
+        self.on_event(f"spawned generation {gen} ({self.n_workers} workers)")
+        return procs
+
+    def _kill_all(self, procs):
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=30)[0] or "")
+            except Exception:
+                outs.append("")
+        self.outputs.append(outs)
+
+    def run(self) -> int:
+        """Run to completion; returns the number of restarts performed.
+        Raises RuntimeError when max_restarts is exhausted."""
+        with MasterServer() as server:
+            for _attempt in range(self.max_restarts + 1):
+                procs = self._spawn(server)
+                t0 = time.monotonic()
+                failed = None
+                while True:
+                    time.sleep(self.poll_interval)
+                    codes = [p.poll() for p in procs]
+                    if any(c not in (None, 0) for c in codes):
+                        failed = f"worker exit codes {codes}"
+                        break
+                    if all(c == 0 for c in codes):
+                        self._kill_all(procs)
+                        return self.restarts
+                    hb = server.service.live_workers(self.heartbeat_ttl)
+                    # the first beat precedes the (compile-heavy) first
+                    # step, so the grace window holds until every worker
+                    # has COMPLETED a step (reported step >= 1) — a slow
+                    # first compile must not read as a wedged worker
+                    steps = hb["steps"]
+                    all_progressed = (
+                        len(steps) == self.n_workers
+                        and all(s >= 1 for s in steps.values()))
+                    waited = time.monotonic() - t0
+                    if all_progressed or waited > self.startup_grace:
+                        missing = [i for i in range(self.n_workers)
+                                   if i not in hb["live"]
+                                   and codes[i] is None]
+                        if missing:
+                            failed = (f"heartbeat lost for workers {missing} "
+                                      f"(steps {hb['steps']})")
+                            break
+                self.on_event(f"incarnation failed: {failed}")
+                self._kill_all(procs)
+                if _attempt == self.max_restarts:
+                    break
+                self.restarts += 1
+            raise RuntimeError(
+                f"elastic job failed: {failed}; gave up after "
+                f"{self.restarts} restarts (max_restarts="
+                f"{self.max_restarts})")
+
+
+class ElasticWorker:
+    """Worker-side elastic plumbing: heartbeats + checkpoint resume."""
+
+    def __init__(self, master_endpoint: Optional[str] = None,
+                 worker_id: Optional[int] = None):
+        self.endpoint = master_endpoint or os.environ.get(
+            "PADDLE_MASTER_ENDPOINT")
+        self.worker_id = (int(os.environ.get("PADDLE_TRAINER_ID", 0))
+                          if worker_id is None else worker_id)
+        self._client = (MasterRPCClient(self.endpoint)
+                        if self.endpoint else None)
+
+    def heartbeat(self, step: int):
+        """Report liveness + progress; call once per training step. A hung
+        step therefore reads as a lost worker after the TTL — that is the
+        point (background-thread beats would mask wedged collectives). The
+        beat carries this incarnation's generation so a stale pre-restart
+        worker cannot pollute the successor's registry."""
+        if self._client is not None:
+            gen = os.environ.get("PADDLE_ELASTIC_GEN")
+            self._client.call("heartbeat", self.worker_id, int(step),
+                              None if gen is None else int(gen))
+
+    def resume_step(self, executor, checkpoint_dir, main_program=None,
+                    scope=None) -> int:
+        """Load the newest complete checkpoint into ``scope`` and return
+        the step to continue FROM (serial + 1); 0 when none exists."""
+        from . import io as fio
+
+        try:
+            serial = fio.load_checkpoint(executor, checkpoint_dir,
+                                         main_program=main_program,
+                                         scope=scope)
+            return serial + 1
+        except FileNotFoundError:
+            return 0

@@ -46,6 +46,14 @@ class _Handler(socketserver.StreamRequestHandler):
                     result = svc.new_pass(*params)
                 elif method == "ready":
                     result = svc.ready
+                elif method == "heartbeat":
+                    result = svc.heartbeat(*params)
+                elif method == "live_workers":
+                    result = svc.live_workers(params[0])
+                elif method == "new_generation":
+                    result = svc.new_generation()
+                elif method == "generation":
+                    result = svc.generation()
                 else:
                     raise ValueError(f"unknown method {method!r}")
                 resp = {"result": result}

@@ -61,7 +61,52 @@ class MasterService:
         self._deadlines: Dict[int, float] = {}
         self._cur_epoch = 0
         self._ready = threading.Event()
+        # elastic worker membership (<- the Go plane's etcd re-resolution,
+        # go/pserver/client/etcd_client.go:35-110): workers heartbeat with
+        # their step, the supervisor polls liveness per GENERATION (bumped
+        # on every restart so stale pre-restart heartbeats never mask a
+        # dead worker in the new incarnation)
+        self._generation = 0
+        self._heartbeats: Dict[int, float] = {}  # worker_id -> monotonic
+        self._worker_steps: Dict[int, int] = {}
         self._recover()
+
+    # -- elastic membership --
+    def heartbeat(self, worker_id: int, step: int,
+                  generation: Optional[int] = None) -> int:
+        """Record a liveness beat; returns the current generation. A beat
+        carrying a STALE generation is dropped — a pre-restart worker's
+        last RPC racing past new_generation() must not re-register its id
+        in the new incarnation (it would mask a genuinely dead successor)."""
+        with self._lock:
+            if generation is not None and int(generation) != self._generation:
+                return self._generation
+            self._heartbeats[int(worker_id)] = time.monotonic()
+            self._worker_steps[int(worker_id)] = int(step)
+            return self._generation
+
+    def live_workers(self, ttl: float):
+        """Worker ids whose last beat is within ``ttl`` seconds, plus their
+        last reported steps: {"live": [...], "steps": {id: step}}."""
+        now = time.monotonic()
+        with self._lock:
+            live = sorted(w for w, t in self._heartbeats.items()
+                          if now - t <= ttl)
+            return {"live": live,
+                    "steps": {str(w): s for w, s in self._worker_steps.items()}}
+
+    def new_generation(self) -> int:
+        """Start a new worker incarnation (supervisor calls this before
+        every (re)spawn); clears the previous generation's beats."""
+        with self._lock:
+            self._generation += 1
+            self._heartbeats.clear()
+            self._worker_steps.clear()
+            return self._generation
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
 
     # -- dataset registration --
     def set_dataset(self, chunks: Sequence[str], chunks_per_task: int = 1):

@@ -207,10 +207,14 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
     q,k,v: [B, T, H, D] global arrays (or shardings compatible with
     P(batch_axis, axis, None, None)). Returns [B, T, H, D] with the same
     sharding as q. ``impl='flash'`` (default) runs the Pallas flash kernel
-    per K/V shard with LSE ring merging; ``impl='dense'`` keeps the
-    XLA-composed per-block softmax (oracle / debugging, and the path to use
-    inside ``jax.checkpoint`` regions — pallas_call cannot trace under
-    remat; the IR-level recompute op already falls back the same way).
+    per K/V shard with LSE ring merging — and because the local ring is a
+    ``jax.custom_vjp`` (the same remat-safe entry-point pattern as the
+    flash_attention op, ops/pallas_attention.py), it composes with
+    ``jax.checkpoint``: remat replays the kernel forward as a unit and the
+    FA-2 ring backward provides the grads
+    (tests/test_distributed.py::test_flash_ring_under_remat). Long context
+    + recompute therefore keep the flash memory profile; ``impl='dense'``
+    remains as the XLA-composed oracle for debugging.
     ``interpret`` overrides Pallas interpret mode; by default it follows the
     MESH's devices (a CPU mesh on a TPU-default host must interpret).
     """

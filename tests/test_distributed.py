@@ -4,9 +4,13 @@ import jax
 import numpy as np
 import pytest
 
+import os as _os
+
 import paddle_tpu as fluid
 from paddle_tpu.parallel import ParallelExecutor, make_mesh
 from paddle_tpu.parallel.context_parallel import dense_attention, ring_attention
+
+REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
 
 
 def test_ring_attention_matches_dense():
@@ -759,3 +763,150 @@ def test_pp_stack_param_sharded_over_pp_axis():
     assert not wq.sharding.is_fully_replicated
     spec = wq.sharding.spec
     assert spec and spec[0] == "pp"
+
+
+def test_flash_ring_under_remat():
+    """VERDICT r2 item 6: long context + recompute together. The flash ring
+    (custom_vjp) must compose with jax.checkpoint — fwd AND grads match the
+    dense oracle with the remat wrapper in place, on the sp mesh."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.context_parallel import (dense_attention,
+                                                      ring_attention)
+
+    n_sp = 4
+    mesh = make_mesh({"sp": n_sp}, devices=jax.devices("cpu")[:n_sp])
+    rng = np.random.RandomState(7)
+    q = rng.randn(1, 8 * n_sp, 2, 8).astype("float32")
+
+    def remat_ring(x):
+        body = jax.checkpoint(
+            lambda y: ring_attention(y, y, y, mesh, axis="sp", causal=True))
+        return jnp.sum(body(x) ** 2)
+
+    def remat_dense(x):
+        body = jax.checkpoint(
+            lambda y: dense_attention(y, y, y, causal=True))
+        return jnp.sum(body(x) ** 2)
+
+    with jax.default_device(jax.devices("cpu")[0]), \
+         jax.default_matmul_precision("highest"):
+        xr = jnp.asarray(q)
+        # eager shard_map under checkpoint is unsupported; jit is the
+        # real execution mode anyway
+        np.testing.assert_allclose(float(jax.jit(remat_ring)(xr)),
+                                   float(jax.jit(remat_dense)(xr)),
+                                   rtol=2e-4)
+        g_ring = jax.jit(jax.grad(remat_ring))(xr)
+        g_dense = jax.jit(jax.grad(remat_dense))(xr)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_flash_under_remat_lowers_to_mosaic_on_tpu():
+    """When a TPU backend is present, the remat-wrapped flash custom_vjp
+    must still lower to Mosaic custom-calls (the kernel is not silently
+    replaced by a dense fallback under jax.checkpoint)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_attention import flash_attention
+
+    tpus = [d for d in jax.devices() if d.platform == "tpu"] if \
+        jax.default_backend() != "cpu" else []
+    try:
+        tpus = tpus or [d for d in jax.devices("tpu")]
+    except Exception:
+        pass
+    if not tpus:
+        pytest.skip("no TPU backend in this environment")
+
+    def f(x):
+        body = jax.checkpoint(
+            lambda y: flash_attention(y, y, y, True, None, 128, 128))
+        return body(x).astype(jnp.float32).sum()
+
+    with jax.default_device(tpus[0]):
+        hlo = jax.jit(jax.grad(f)).lower(
+            jnp.zeros((1, 256, 2, 64), jnp.bfloat16)).as_text()
+    assert "tpu_custom_call" in hlo, \
+        "flash kernel lost to a dense fallback under remat"
+
+
+def test_elastic_recovery_restarts_from_checkpoint(tmp_path):
+    """VERDICT r2 item 7 (<- go/master/service.go:313 task re-queue +
+    go/pserver/client/etcd_client.go:35 membership re-resolution): a worker
+    HANGS mid-training (wedged collective — only heartbeat staleness can
+    see it); the supervisor detects the loss, kills the incarnation,
+    respawns, and the workers resume from the latest complete sharded
+    checkpoint and converge."""
+    import sys
+
+    from paddle_tpu.elastic import ElasticSupervisor
+
+    worker = r'''
+import os, sys, time
+import numpy as np
+from paddle_tpu.distributed import init_distributed
+from paddle_tpu.elastic import ElasticWorker
+assert init_distributed()
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.parallel import ParallelExecutor, make_mesh
+
+rank = jax.process_index()
+ew = ElasticWorker()
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.layers.data("x", shape=[8], dtype="float32")
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    pred = fluid.layers.fc(h, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.3).minimize(loss, startup)
+scope = fluid.Scope()
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup, scope=scope, seed=12)
+ckpt = os.environ["ELASTIC_CKPT_DIR"]
+start = ew.resume_step(exe, ckpt, main_program=main, scope=scope)
+print("RESUME", rank, start, flush=True)
+mesh = make_mesh({"dp": 2}, devices=jax.devices())
+pe = ParallelExecutor(use_tpu=False, main_program=main, scope=scope, mesh=mesh)
+rng = np.random.RandomState(0)
+X = rng.randn(32, 8).astype("float32")
+Y = np.argmax(X[:, :4], axis=1).astype("int64")[:, None]
+lo, hi = (0, 16) if rank == 0 else (16, 32)
+for step in range(start, 8):
+    ew.heartbeat(step)
+    if step == 3 and start == 0 and rank == 1:
+        print("HANGING", rank, flush=True)
+        time.sleep(3600)  # simulated wedge: process alive, no progress
+    (lv,) = pe.run(fetch_list=[loss.name], feed={"x": X[lo:hi], "label": Y[lo:hi]})
+    print("STEP", rank, step, round(float(lv), 6), flush=True)
+    fluid.io.save_checkpoint(exe, ckpt, main_program=main, scope=scope,
+                             step=step)
+print("DONE", rank, flush=True)
+'''
+    sup = ElasticSupervisor(
+        [sys.executable, "-c", worker], n_workers=2,
+        heartbeat_ttl=8.0, startup_grace=180.0, max_restarts=2,
+        env={"PYTHONPATH": None, "XLA_FLAGS": None, "JAX_PLATFORMS": "cpu",
+             "ELASTIC_CKPT_DIR": str(tmp_path)},
+        cwd=REPO_ROOT)
+    restarts = sup.run()
+    assert restarts == 1, (restarts, [o[-800:] for oo in sup.outputs for o in oo])
+    # incarnation 1 hung at step 3; incarnation 2 resumed from a saved step
+    final = sup.outputs[-1]
+    assert any("DONE 0" in o for o in final), final[0][-800:]
+    import re
+
+    resumes = []
+    for o in final:
+        m = re.search(r"RESUME \d+ (\d+)", o)
+        assert m, f"worker died before RESUME:\n{o[-1500:]}"
+        resumes.append(int(m.group(1)))
+    assert all(r >= 3 for r in resumes), resumes
+    # convergence across the restart: last loss well below the first
+    all_out = "\n".join(o for oo in sup.outputs for o in oo)
+    losses = [float(m.group(2)) for m in
+              re.finditer(r"STEP 0 (\d+) ([0-9.eE+-]+)", all_out)]
+    assert losses and losses[-1] < losses[0], losses
