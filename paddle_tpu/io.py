@@ -157,6 +157,94 @@ def _load_sharded(dirname: str, name: str, current=None):
     return out
 
 
+def reshard_sharded_var(dirname: str, name: str, new_rows: Optional[int] = None,
+                        new_shards: Optional[int] = None,
+                        out_dirname: Optional[str] = None,
+                        init: str = "zeros", init_scale: float = 0.01,
+                        seed: int = 0) -> dict:
+    """Checkpoint-level grow/re-partition of a per-shard-saved variable.
+
+    This is the re-shard-to-grow path docs/design.md §10 promises in place
+    of the reference's auto-growth ``lookup_sparse_table`` hash buckets
+    (lookup_sparse_table_op.cc:60-120): when a vocab outgrows its headroom,
+    grow the table OFFLINE at checkpoint level — no host gather of the full
+    table; each NEW shard is assembled only from the OLD shard files that
+    overlap its row range, so peak memory is O(shard), not O(table).
+
+    new_rows: new size of dim 0 (>= old; None keeps it). new_shards: number
+    of equal dim-0 shards to write (None keeps the old shard count). Rows
+    beyond the old size are 'zeros' or 'normal'(0, init_scale). Writes
+    ``<name>.shard*.npy`` + descriptor into ``out_dirname`` (defaults to
+    ``dirname``; old shard files are removed when rewriting in place).
+    Returns the new descriptor dict."""
+    out_dirname = out_dirname or dirname
+    os.makedirs(out_dirname, exist_ok=True)
+    meta = None
+    by_index = {}
+    for mpath in _shard_descriptors(dirname, name):
+        with open(mpath) as f:
+            m = json.load(f)
+        meta = meta or m
+        for s in m["shards"]:
+            by_index[tuple(tuple(b) for b in s["index"])] = s["file"]
+    if meta is None:
+        raise FileNotFoundError(f"no shard descriptors for {name!r} in {dirname}")
+    old_shape = tuple(meta["global_shape"])
+    old_rows = old_shape[0]
+    rows = int(new_rows) if new_rows is not None else old_rows
+    if rows < old_rows:
+        raise ValueError(f"cannot shrink {name!r}: {old_rows} -> {rows}")
+    n_shards = int(new_shards) if new_shards is not None else len(by_index)
+    if rows % n_shards:
+        raise ValueError(f"new rows {rows} not divisible by {n_shards} shards")
+    # old shards sorted by their dim-0 start for overlap lookup
+    olds = sorted(by_index.items(), key=lambda kv: kv[0][0][0])
+    for idx, _f in olds:
+        if any(a != 0 or b != d for (a, b), d in zip(idx[1:], old_shape[1:])):
+            raise NotImplementedError(
+                f"{name!r} is sharded beyond dim 0; reshard supports "
+                f"row-sharded (vocab) tables")
+    rng = np.random.RandomState(seed)
+    base = urllib.parse.quote(name, safe="")
+    new_meta = {"global_shape": [rows] + list(old_shape[1:]),
+                "dtype": meta["dtype"], "shards": []}
+    per = rows // n_shards
+    written = []
+    for k in range(n_shards):
+        a, b = k * per, (k + 1) * per
+        block = np.empty((per,) + old_shape[1:], dtype=meta["dtype"])
+        if init == "normal":
+            block[...] = rng.normal(
+                0.0, init_scale, block.shape).astype(meta["dtype"])
+        else:
+            block[...] = 0
+        for idx, fname in olds:
+            oa, ob = idx[0]
+            lo, hi = max(a, oa), min(b, ob, old_rows)
+            if lo >= hi:
+                continue
+            data = np.load(os.path.join(dirname, fname))
+            block[lo - a:hi - a] = data[lo - oa:hi - oa]
+        bounds = [[a, b]] + [[0, d] for d in old_shape[1:]]
+        tag = "_".join(f"{x}x{y}" for x, y in bounds)
+        out_f = f"{base}.shard{tag}.npy"
+        np.save(os.path.join(out_dirname, out_f), block)
+        written.append(out_f)
+        new_meta["shards"].append({"file": out_f, "index": bounds})
+    if os.path.abspath(out_dirname) == os.path.abspath(dirname):
+        for _idx, fname in olds:
+            if fname not in written:
+                try:
+                    os.remove(os.path.join(dirname, fname))
+                except FileNotFoundError:
+                    pass
+        for mpath in _shard_descriptors(dirname, name):
+            os.remove(mpath)
+    with open(_shard_meta_path(out_dirname, name), "w") as f:
+        json.dump(new_meta, f)
+    return new_meta
+
+
 def save_vars(executor, dirname, main_program=None, vars: Optional[Sequence] = None,
               predicate=None, scope: Optional[Scope] = None):
     """<- io.py save_vars. Writes each selected var's ndarray; multi-device

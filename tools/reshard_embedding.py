@@ -1,0 +1,38 @@
+"""Grow / re-partition a per-shard-saved embedding table at checkpoint
+level (<- the reference's auto-growth lookup_sparse_table semantics,
+lookup_sparse_table_op.cc:60-120, re-expressed as the offline
+re-shard-to-grow path of docs/design.md §10).
+
+    python tools/reshard_embedding.py CKPT_DIR VAR_NAME \
+        [--rows N] [--shards K] [--out DIR] [--init zeros|normal]
+
+Streams old shard files into the new partition (peak memory = one shard).
+"""
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dirname")
+    ap.add_argument("name")
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--shards", type=int, default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--init", choices=["zeros", "normal"], default="zeros")
+    ap.add_argument("--init_scale", type=float, default=0.01)
+    args = ap.parse_args()
+    from paddle_tpu.io import reshard_sharded_var
+
+    meta = reshard_sharded_var(args.dirname, args.name, new_rows=args.rows,
+                               new_shards=args.shards,
+                               out_dirname=args.out, init=args.init,
+                               init_scale=args.init_scale)
+    print(f"{args.name}: {meta['global_shape']} in "
+          f"{len(meta['shards'])} shards")
+
+
+if __name__ == "__main__":
+    main()
