@@ -220,6 +220,14 @@ class ParallelExecutor:
 
     def _sync_workers(self, state_names: Sequence[str]):
         """Average the workers' float state over dp (the local-SGD sync)."""
+        # barrier: the step executable carries its own collective (the loss
+        # pmean) — launching the averaging executable (all-reduce) while
+        # some device threads are still inside the step interleaves two
+        # collectives' rendezvous across executables and deadlocks XLA:CPU
+        # ("cross_module ... expected 8, got 6"). Wait for the step's
+        # outputs before enqueueing the sync.
+        jax.block_until_ready([self.scope.get(n) for n in state_names
+                               if isinstance(self.scope.get(n), jax.Array)])
         avg = self._avg_fn
         if avg is None:
             sh = NamedSharding(self.mesh, PartitionSpec("dp"))

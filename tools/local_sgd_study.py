@@ -1,0 +1,103 @@
+"""Local-SGD quality study: final loss vs sync SGD at EQUAL step counts.
+
+The async_mode docstring (parallel/parallel_executor.py BuildStrategy)
+claims local SGD is "the sound collective version" of the reference's
+async pserver trade (listen_and_serv_op.cc:166 RunAsyncLoop); this tool
+quantifies the trade the claim glosses over: how much final-loss quality
+each sync period K costs on the LM workload at the same number of steps.
+
+    python tools/local_sgd_study.py [--steps 120] [--dp 8]
+
+Run on the virtual CPU mesh (deterministic); the numbers feed
+docs/perf.md's local-SGD table and the data-driven default of
+BuildStrategy.local_sgd_steps.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_variant(local_sgd_steps, steps, dp, seed=5):
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.parallel import BuildStrategy, ParallelExecutor, make_mesh
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[32], dtype="int64")
+        lbl = fluid.layers.data("lbl", shape=[32], dtype="int64")
+        _, loss = transformer_lm(ids, lbl, vocab_size=128, max_len=32,
+                                 d_model=32, n_heads=2, n_layers=2, d_ff=64)
+        fluid.optimizer.Adam(2e-3).minimize(loss, startup)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope, seed=seed)
+    mesh = make_mesh({"dp": dp}, devices=jax.devices("cpu")[:dp])
+    bs = BuildStrategy()
+    if local_sgd_steps is not None:
+        bs.async_mode = True
+        bs.local_sgd_steps = local_sgd_steps
+    pe = ParallelExecutor(use_tpu=False, main_program=main, scope=scope,
+                          mesh=mesh, build_strategy=bs)
+    rng = np.random.RandomState(0)
+    # learnable synthetic grammar: next token = (tok * 3 + 1) % vocab
+    def batch(n=32):
+        start = rng.randint(0, 128, (n, 1))
+        seq = [start]
+        for _ in range(32):
+            seq.append((seq[-1] * 3 + 1) % 128)
+        arr = np.concatenate(seq, axis=1)
+        return arr[:, :32].astype("int64"), arr[:, 1:33].astype("int64")
+
+    last = []
+    for i in range(steps):
+        x, y = batch()
+        (lv,) = pe.run(fetch_list=[loss.name], feed={"ids": x, "lbl": y})
+        if i >= steps - 10:
+            last.append(float(lv))
+    return sum(last) / len(last)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--dp", type=int, default=8)
+    ap.add_argument("--variant", default=None,
+                    help="internal: run one variant in-process")
+    args = ap.parse_args()
+    if args.variant is not None:
+        k = None if args.variant == "sync" else int(args.variant)
+        print(f"FINAL {run_variant(k, args.steps, args.dp):.4f}", flush=True)
+        return
+    # one subprocess per variant: XLA's in-process CPU collectives deadlock
+    # when a second executor generation starts in the same process
+    import subprocess
+
+    rows = [("sync", "sync"), ("K=1", "1"), ("K=4", "4"), ("K=16", "16")]
+    for name, v in rows:
+        out = subprocess.run(
+            [sys.executable, __file__, "--variant", v,
+             "--steps", str(args.steps), "--dp", str(args.dp)],
+            capture_output=True, text=True, timeout=1200)
+        line = [l for l in out.stdout.splitlines() if l.startswith("FINAL")]
+        val = line[0].split()[1] if line else f"FAILED\n{out.stdout[-500:]}" \
+            f"{out.stderr[-500:]}"
+        print(f"{name:6s}: final loss (mean of last 10 steps) {val}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
