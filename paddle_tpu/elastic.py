@@ -29,23 +29,36 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from .master.rpc import MasterRPCClient, MasterServer
 
 
-def _free_ports(n: int) -> List[int]:
+def _reserve_ports(n: int) -> List[socket.socket]:
+    """Bind n ephemeral ports and KEEP the sockets open; the caller closes
+    them immediately before spawning the workers that re-bind them. The
+    bound window shrinks the bind-then-reuse race to the spawn instant
+    (it cannot be eliminated without workers binding port 0 themselves and
+    reporting back); a residual collision surfaces as a worker exit and is
+    named as a possibility in the supervisor's failure event."""
     socks = []
     try:
         for _ in range(n):
+            # no SO_REUSEADDR: the reservation socket never listens (no
+            # TIME_WAIT to bypass), and REUSEADDR on the holder would let
+            # any other REUSEADDR binder take the port DURING the hold —
+            # defeating the exclusion. Workers re-bind after close()
+            # without needing it.
             s = socket.socket()
             s.bind(("127.0.0.1", 0))
             socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
+    except Exception:
         for s in socks:
             s.close()
+        raise
+    return socks
 
 
 class ElasticSupervisor:
@@ -76,12 +89,14 @@ class ElasticSupervisor:
         self.on_event = on_event or (lambda msg: None)
         self.restarts = 0
         self.outputs: List[List[str]] = []  # per incarnation, per rank
+        self._logs: List = []  # open per-rank log files, current incarnation
 
     def _spawn(self, server: MasterServer) -> List[subprocess.Popen]:
         gen = server.service.new_generation()
-        ports = _free_ports(self.n_workers)
-        endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
-        procs = []
+        socks = _reserve_ports(self.n_workers)
+        endpoints = ",".join(
+            f"127.0.0.1:{s.getsockname()[1]}" for s in socks)
+        envs = []
         for i in range(self.n_workers):
             e = dict(os.environ)
             for k, v in self.env.items():
@@ -94,8 +109,20 @@ class ElasticSupervisor:
             e["PADDLE_TRAINER_ID"] = str(i)
             e["PADDLE_MASTER_ENDPOINT"] = server.endpoint
             e["PADDLE_ELASTIC_GEN"] = str(gen)
+            envs.append(e)
+        # Workers log to temp files, not pipes: a PIPE nobody drains blocks
+        # the worker inside print after ~64KB, stops its heartbeats, and the
+        # supervisor would kill a healthy job as hung (advisor r3, medium).
+        for s in socks:
+            s.close()  # released at the last instant before the re-bind
+        procs = []
+        self._logs = []
+        for e in envs:
+            logf = tempfile.TemporaryFile(mode="w+", encoding="utf-8",
+                                          errors="replace")
+            self._logs.append(logf)
             procs.append(subprocess.Popen(
-                self.worker_argv, stdout=subprocess.PIPE,
+                self.worker_argv, stdout=logf,
                 stderr=subprocess.STDOUT, text=True, cwd=self.cwd, env=e))
         self.on_event(f"spawned generation {gen} ({self.n_workers} workers)")
         return procs
@@ -104,12 +131,21 @@ class ElasticSupervisor:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        outs = []
         for p in procs:
             try:
-                outs.append(p.communicate(timeout=30)[0] or "")
+                p.wait(timeout=30)
+            except Exception:
+                pass
+        outs = []
+        for logf in self._logs:
+            try:
+                logf.seek(0)
+                outs.append(logf.read())
             except Exception:
                 outs.append("")
+            finally:
+                logf.close()
+        self._logs = []
         self.outputs.append(outs)
 
     def run(self) -> int:
@@ -124,7 +160,10 @@ class ElasticSupervisor:
                     time.sleep(self.poll_interval)
                     codes = [p.poll() for p in procs]
                     if any(c not in (None, 0) for c in codes):
-                        failed = f"worker exit codes {codes}"
+                        failed = (f"worker exit codes {codes} (early exits "
+                                  f"can also mean an endpoint port was "
+                                  f"grabbed by another process between "
+                                  f"reservation and worker bind)")
                         break
                     if all(c == 0 for c in codes):
                         self._kill_all(procs)

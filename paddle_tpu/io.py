@@ -228,9 +228,37 @@ def reshard_sharded_var(dirname: str, name: str, new_rows: Optional[int] = None,
         bounds = [[a, b]] + [[0, d] for d in old_shape[1:]]
         tag = "_".join(f"{x}x{y}" for x, y in bounds)
         out_f = f"{base}.shard{tag}.npy"
-        np.save(os.path.join(out_dirname, out_f), block)
+        out_path = os.path.join(out_dirname, out_f)
+        np.save(out_path, block)
+        # make the shard durable BEFORE the descriptor that references it
+        # commits — a descriptor surviving a crash must not point at
+        # truncated shard files
+        with open(out_path, "rb") as sf:
+            os.fsync(sf.fileno())
         written.append(out_f)
         new_meta["shards"].append({"file": out_f, "index": bounds})
+    # Crash safety: commit the new descriptor FIRST (atomic tmp+replace),
+    # only then remove stale files. The old ordering deleted every
+    # descriptor before writing the new one; a crash in that window left
+    # the only copy of the table as orphan shard files with no descriptor
+    # (advisor r3). os.replace atomically supersedes the old single-host
+    # descriptor; per-host ``.shards.p*.json`` descriptors and stale shard
+    # files are garbage-collected after the commit point.
+    meta_path = _shard_meta_path(out_dirname, name)
+    tmp_path = meta_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(new_meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_path, meta_path)
+    try:
+        dirfd = os.open(out_dirname, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)  # persist the rename + new directory entries
+        finally:
+            os.close(dirfd)
+    except OSError:
+        pass  # directory fsync is best-effort on exotic filesystems
     if os.path.abspath(out_dirname) == os.path.abspath(dirname):
         for _idx, fname in olds:
             if fname not in written:
@@ -239,9 +267,8 @@ def reshard_sharded_var(dirname: str, name: str, new_rows: Optional[int] = None,
                 except FileNotFoundError:
                     pass
         for mpath in _shard_descriptors(dirname, name):
-            os.remove(mpath)
-    with open(_shard_meta_path(out_dirname, name), "w") as f:
-        json.dump(new_meta, f)
+            if os.path.abspath(mpath) != os.path.abspath(meta_path):
+                os.remove(mpath)
     return new_meta
 
 

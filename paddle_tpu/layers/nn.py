@@ -712,6 +712,7 @@ def slice(input, axes, starts, ends, name: Optional[str] = None):
 def pipelined_transformer_stack(x, n_stages: int, layers_per_stage: int,
                                 n_heads: int, d_ff: int, causal: bool = True,
                                 microbatches: int = 4, remat: bool = False,
+                                tp_shard: bool = False,
                                 name: Optional[str] = None):
     """A stack of S*L homogeneous pre-LN decoder layers carried by ONE op
     with parameters stacked [S, L, ...] and sharded over the 'pp' mesh axis
@@ -725,39 +726,46 @@ def pipelined_transformer_stack(x, n_stages: int, layers_per_stage: int,
 
     helper = LayerHelper("pipelined_transformer_stack", name=name)
     d = int(x.shape[-1])
+    if d % int(n_heads):
+        raise ValueError(
+            f"d_model {d} not divisible by n_heads {int(n_heads)}")
     nm = name or "pp_stack"
     s, l = int(n_stages), int(layers_per_stage)
 
-    def param(suffix, shape, is_bias=False, fan=None, one=False):
+    def param(suffix, shape, is_bias=False, fan=None, one=False, tp=None):
         init = None
         if one:
             init = ConstantInitializer(1.0)
         elif fan is not None:
             init = XavierInitializer(fan_in=fan[0], fan_out=fan[1])
-        sharding = ("pp",) + (None,) * (len(shape) - 1)
+        sharding = ["pp"] + [None] * (len(shape) - 1)
+        if tp_shard and tp is not None:
+            sharding[tp] = "tp"
         return helper.create_parameter(
-            ParamAttr(f"{nm}.{suffix}", initializer=init, sharding=sharding),
+            ParamAttr(f"{nm}.{suffix}", initializer=init,
+                      sharding=tuple(sharding)),
             shape, is_bias=is_bias)
 
     inputs = {
         "X": [x],
         "LN1Scale": [param("ln1s", [s, l, d], one=True)],
         "LN1Bias": [param("ln1b", [s, l, d], is_bias=True)],
-        "WQ": [param("wq", [s, l, d, d], fan=(d, d))],
-        "WK": [param("wk", [s, l, d, d], fan=(d, d))],
-        "WV": [param("wv", [s, l, d, d], fan=(d, d))],
-        "WO": [param("wo", [s, l, d, d], fan=(d, d))],
+        "WQ": [param("wq", [s, l, d, d], fan=(d, d), tp=-1)],
+        "WK": [param("wk", [s, l, d, d], fan=(d, d), tp=-1)],
+        "WV": [param("wv", [s, l, d, d], fan=(d, d), tp=-1)],
+        "WO": [param("wo", [s, l, d, d], fan=(d, d), tp=-2)],
         "LN2Scale": [param("ln2s", [s, l, d], one=True)],
         "LN2Bias": [param("ln2b", [s, l, d], is_bias=True)],
-        "WUp": [param("wup", [s, l, d, d_ff], fan=(d, d_ff))],
-        "BUp": [param("bup", [s, l, d_ff], is_bias=True)],
-        "WDown": [param("wdown", [s, l, d_ff, d], fan=(d_ff, d))],
+        "WUp": [param("wup", [s, l, d, d_ff], fan=(d, d_ff), tp=-1)],
+        "BUp": [param("bup", [s, l, d_ff], is_bias=True, tp=-1)],
+        "WDown": [param("wdown", [s, l, d_ff, d], fan=(d_ff, d), tp=-2)],
         "BDown": [param("bdown", [s, l, d], is_bias=True)],
     }
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(
         "pipelined_transformer_stack", inputs, {"Out": [out]},
         {"n_heads": int(n_heads), "causal": bool(causal),
-         "microbatches": int(microbatches), "remat": bool(remat)},
+         "microbatches": int(microbatches), "remat": bool(remat),
+         "tp_shard": bool(tp_shard)},
     )
     return out

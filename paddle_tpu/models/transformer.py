@@ -123,11 +123,6 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
         pos = layers.slice(pos, axes=[1], starts=[0], ends=[t])
     x = layers.elementwise_add(emb, pos)
     if pp_stages:
-        if tp_shard:
-            raise NotImplementedError(
-                "pp_stages does not compose with tp_shard yet: the "
-                "pipelined stack has no tensor-parallel weight layout, so "
-                "tp_shard would be silently dropped")
         if n_layers % pp_stages:
             raise ValueError(
                 f"n_layers {n_layers} not divisible by pp_stages "
@@ -136,7 +131,7 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
             x, n_stages=pp_stages, layers_per_stage=n_layers // pp_stages,
             n_heads=n_heads, d_ff=d_ff, causal=True,
             microbatches=pp_microbatches, remat=use_recompute,
-            name="tlm.pp")
+            tp_shard=tp_shard, name="tlm.pp")
     else:
         for i in range(n_layers):
             x = encoder_layer(x, d_model, n_heads, d_ff, causal=True,

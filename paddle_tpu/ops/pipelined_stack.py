@@ -45,23 +45,36 @@ def _dot(x, w, amp):
     return out.astype(x.dtype if amp else out.dtype)
 
 
-def _decoder_layer(p, x, n_heads, causal, amp):
-    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). p: single-layer dict."""
+def _decoder_layer(p, x, n_heads, causal, amp, tp_axis=None):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). p: single-layer dict.
+
+    ``tp_axis``: when set, the layer runs as one Megatron shard inside a
+    shard_map — wq/wk/wv/wup (and bup) hold this device's column slice,
+    wo/wdown hold the row slice, and the two row matmuls produce partial
+    sums reduced with ``lax.psum`` over the axis BEFORE the residual add /
+    output bias, which keeps x and the LN statistics replicated across tp.
+    """
     mb, t, d = x.shape
     d_head = d // n_heads
+    n_heads_local = p["wq"].shape[-1] // d_head  # n_heads/tp under a shard
     a = _ln(x, p["ln1s"], p["ln1b"])
-    q = _dot(a, p["wq"], amp).reshape(mb, t, n_heads, d_head)
-    k = _dot(a, p["wk"], amp).reshape(mb, t, n_heads, d_head)
-    v = _dot(a, p["wv"], amp).reshape(mb, t, n_heads, d_head)
+    q = _dot(a, p["wq"], amp).reshape(mb, t, n_heads_local, d_head)
+    k = _dot(a, p["wk"], amp).reshape(mb, t, n_heads_local, d_head)
+    v = _dot(a, p["wv"], amp).reshape(mb, t, n_heads_local, d_head)
     ctx_v = flash_attention(q, k, v, causal, None)
-    ctx_v = ctx_v.reshape(mb, t, d)
-    x = x + _dot(ctx_v, p["wo"], amp).astype(x.dtype)
+    ctx_v = ctx_v.reshape(mb, t, n_heads_local * d_head)
+    attn = _dot(ctx_v, p["wo"], amp)
+    if tp_axis is not None:
+        attn = lax.psum(attn, tp_axis)
+    x = x + attn.astype(x.dtype)
     f = _ln(x, p["ln2s"], p["ln2b"])
     h = _dot(f, p["wup"], amp) + p["bup"].astype(
         jnp.bfloat16 if amp else p["bup"].dtype)
     h = jax.nn.relu(h)
-    f = _dot(h, p["wdown"], amp) + p["bdown"].astype(
-        jnp.bfloat16 if amp else p["bdown"].dtype)
+    f = _dot(h, p["wdown"], amp)
+    if tp_axis is not None:
+        f = lax.psum(f, tp_axis)
+    f = f + p["bdown"].astype(jnp.bfloat16 if amp else p["bdown"].dtype)
     return x + f.astype(x.dtype)
 
 
@@ -85,17 +98,34 @@ def pipelined_transformer_stack(ctx, ins, attrs):
     n_stages = params["wq"].shape[0]
     layers_per_stage = params["wq"].shape[1]
 
+    mesh = getattr(ctx, "mesh", None)
+    has_pp = (mesh is not None and "pp" in mesh.axis_names
+              and mesh.shape["pp"] > 1)
+    # tensor parallelism composes INSIDE the pipeline's shard_map: when the
+    # model was BUILT with tp_shard and the mesh carries a 'tp' axis, the
+    # stage weights are Megatron-sharded over it and the stage function
+    # does the matching psums (shard_map is manual over every mesh axis,
+    # so GSPMD cannot do it for us there). A stack built without tp_shard
+    # ignores the mesh's tp axis — weights stay replicated over it.
+    tp_axis = ("tp" if bool(attrs.get("tp_shard", False)) and has_pp
+               and "tp" in mesh.axis_names and mesh.shape["tp"] > 1
+               else None)
+    if tp_axis is not None:
+        tp = mesh.shape["tp"]
+        if n_heads % tp or params["wup"].shape[-1] % tp:
+            raise ValueError(
+                f"n_heads {n_heads} and d_ff {params['wup'].shape[-1]} "
+                f"must be divisible by the tp axis size {tp}")
+
     def stage_fn(p_stage, x_mb):
         # p_stage leaves: [L, ...]
         out = x_mb
         for l in range(layers_per_stage):
             p_l = {k: v[l] for k, v in p_stage.items()}
-            out = _decoder_layer(p_l, out, n_heads, causal, amp)
+            out = _decoder_layer(p_l, out, n_heads, causal, amp,
+                                 tp_axis=tp_axis)
         return out
 
-    mesh = getattr(ctx, "mesh", None)
-    has_pp = (mesh is not None and "pp" in mesh.axis_names
-              and mesh.shape["pp"] > 1)
     if has_pp and mesh.shape["pp"] != n_stages:
         raise ValueError(
             f"pipelined_transformer_stack built with {n_stages} stages but "
@@ -104,11 +134,25 @@ def pipelined_transformer_stack(ctx, ins, attrs):
             f"step — rebuild the model with pp_stages={mesh.shape['pp']} "
             f"or resize the mesh")
     if has_pp and n_stages > 1:
+        from jax.sharding import PartitionSpec as P
+
         from ..parallel.pipeline import gpipe
 
+        if tp_axis is not None:
+            # Megatron layout per stage: column-sharded wq/wk/wv/wup (+bup),
+            # row-sharded wo/wdown; LN params and bdown replicated over tp
+            col = P("pp", None, None, tp_axis)
+            row = P("pp", None, tp_axis, None)
+            rep2 = P("pp", None, None)
+            pspecs = {"ln1s": rep2, "ln1b": rep2, "wq": col, "wk": col,
+                      "wv": col, "wo": row, "ln2s": rep2, "ln2b": rep2,
+                      "wup": col, "bup": P("pp", None, tp_axis),
+                      "wdown": row, "bdown": rep2}
+        else:
+            pspecs = None
         out = gpipe(stage_fn, params, x, mesh, axis="pp",
                     microbatches=microbatches, remat=remat,
-                    batch_axes=("dp",))
+                    batch_axes=("dp",), param_specs=pspecs)
     else:
         # sequential semantics (single device / pp=1): same math, so this
         # path is the numerical oracle for the pipelined one

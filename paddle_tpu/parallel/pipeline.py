@@ -51,7 +51,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
           axis: str = "pp", microbatches: int = 4, remat: bool = False,
-          batch_axes: tuple = ("dp",)):
+          batch_axes: tuple = ("dp",), param_specs: Any = None):
     """Run ``x`` through S pipeline stages of ``fn`` with GPipe scheduling.
 
     fn(params_one_stage, x_mb) -> y_mb  must keep the microbatch shape.
@@ -61,6 +61,10 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
     docstring). ``batch_axes``: mesh axes (those present) the batch dim is
     sharded over — under a dp x pp mesh each dp replica pipelines only its
     own batch shard instead of redundantly recomputing the global batch.
+    ``param_specs``: optional pytree of PartitionSpecs overriding the
+    default ``P(axis)`` per leaf — this is how tensor parallelism composes
+    with the pipeline (Megatron-sharded stage weights over a 'tp' axis; the
+    stage ``fn`` is then responsible for the matching ``lax.psum``s).
     Returns y: [B, ...], batch-sharded the same way and replicated over pp.
     """
     n_stages = mesh.shape[axis]
@@ -103,7 +107,8 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
         out = lax.psum(outs, axis)
         return out.reshape((local_batch,) + out.shape[2:])
 
-    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    pspec = (param_specs if param_specs is not None
+             else jax.tree.map(lambda _: P(axis), stage_params))
     xspec = P(data_axes if data_axes else None)
     fn_sharded = shard_map(
         local, mesh=mesh,

@@ -2,6 +2,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.core import append_backward
@@ -91,6 +92,7 @@ def test_stop_gradient_blocks_flow():
     assert "x" in names and "w" not in names
 
 
+@pytest.mark.slow
 def test_grad_flops_ratio_bounded():
     """The IR grad ops recompute forwards via jax.vjp (registry.py
     generic_grad_impl), relying on XLA CSE to fold the replays into the

@@ -68,6 +68,7 @@ def test_word2vec():
     assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
 def test_understand_sentiment(net):
     rng = np.random.RandomState(2)
@@ -132,6 +133,7 @@ def test_recommender_system():
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_label_semantic_roles():
     rng = np.random.RandomState(4)
     N, T, WD, MD, LD = 16, 8, 50, 2, 5
@@ -169,6 +171,7 @@ def test_label_semantic_roles():
     assert (path_v == target).mean() > 0.8
 
 
+@pytest.mark.slow
 def test_rnn_encoder_decoder():
     rng = np.random.RandomState(5)
     N, TS, TT, SV, TV = 16, 7, 6, 30, 25
@@ -196,6 +199,7 @@ def test_rnn_encoder_decoder():
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_image_classification(tmp_path):
     """<- book/03.image_classification (test_image_classification_train.py):
     resnet-cifar10 trains, exports, reloads, infers."""

@@ -309,6 +309,7 @@ class TestRoiPool(OpTest):
         self.check_output()
 
 
+@pytest.mark.slow
 def test_multiclass_nms_basic():
     """Two overlapping boxes of one class -> keep higher-score one; empty
     slots carry label -1."""
@@ -431,6 +432,7 @@ def test_detection_output_layer():
     assert np.asarray(res).shape == (b, 4, 6)
 
 
+@pytest.mark.slow
 def test_ssd_mobilenet_model():
     """End-to-end SSD model: train step produces finite loss; inference
     produces fixed-capacity detections."""

@@ -137,6 +137,7 @@ def test_fused_bwd_conv3x3_bn_matches_conv_vjp():
                                atol=0.5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("which", ["fused", "hybrid"])
 def test_bottleneck_blocks_match_reference(which, monkeypatch):
     import paddle_tpu.ops.pallas_conv as pc

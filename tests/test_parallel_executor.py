@@ -151,6 +151,7 @@ def test_async_mode_checkpoint_resume_no_double_stack():
     assert np.isfinite(l0) and np.isfinite(l3)
 
 
+@pytest.mark.dist
 def test_dryrun_multichip_stays_on_mesh_backend():
     """Regression for round-1 driver failure (MULTICHIP_r01.json).
 

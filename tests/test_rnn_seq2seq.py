@@ -1,5 +1,6 @@
 """RNN ops vs numpy references + seq2seq training/decoding end-to-end."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models.seq2seq import Seq2SeqAttention
@@ -93,6 +94,7 @@ def test_dynamic_lstm_layer_trains():
     assert losses[-1] < losses[0] * 0.7, losses[::8]
 
 
+@pytest.mark.slow
 def test_seq2seq_attention_learns_copy_task():
     np.random.seed(0)
     vocab, t = 12, 6

@@ -1,5 +1,6 @@
 """tools/: timeline conversion and API-signature dump
 (<- tools/timeline.py, tools/print_signatures.py)."""
+import pytest
 import json
 import os
 import subprocess
@@ -37,6 +38,7 @@ def test_profiler_dump_and_timeline(tmp_path):
     assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in evs)
 
 
+@pytest.mark.dist
 def test_print_signatures(tmp_path):
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     r = subprocess.run(
@@ -66,6 +68,7 @@ def test_kube_gen_job():
     assert 'google.com/tpu: "v5e-8"' in out
 
 
+@pytest.mark.dist
 def test_paddle_cli_version():
     # strip test-process jax env: the axon plugin rejects JAX_PLATFORMS=cpu
     env = {k: v for k, v in os.environ.items()
@@ -92,7 +95,6 @@ def test_profiler_device_trace_dir(tmp_path):
     """trace_dir engages jax.profiler and produces trace artifacts
     (<- §5.1 device_tracer/CUPTI contract)."""
     import numpy as np
-
     import paddle_tpu as fluid
     from paddle_tpu import profiler
 

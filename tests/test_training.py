@@ -1,4 +1,5 @@
 """End-to-end training convergence (book-test style, SURVEY.md §4)."""
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
@@ -83,6 +84,7 @@ def test_amp_training_converges():
     assert losses[-1] < losses[0] * 0.5
 
 
+@pytest.mark.slow
 def test_amp_master_state_stays_f32_all_optimizers():
     """The AMP contract: after training steps under amp=True, every float
     in the scope (params, optimizer accumulators, BN running stats) is

@@ -2,6 +2,7 @@
 masking correctness through the flash_attention path, training convergence,
 recompute equivalence, tp-sharded multi-device step."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models import transformer_lm
@@ -41,6 +42,7 @@ def test_causal_masking_through_flash_attention():
     assert not np.allclose(la[:, 10:], lb[:, 10:])
 
 
+@pytest.mark.slow
 def test_lm_learns_copy_task():
     """Predict-next on a repeating sequence: loss must fall well below
     uniform entropy."""
@@ -103,6 +105,7 @@ def test_fused_linear_cross_entropy_matches_dense_head():
     np.testing.assert_allclose(res[True][1], res[False][1], rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_recompute_transformer_matches():
     """use_recompute changes memory behavior, not numerics."""
     outs = {}
@@ -120,6 +123,7 @@ def test_recompute_transformer_matches():
     np.testing.assert_allclose(outs[True], outs[False], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_transformer_tp_multi_device():
     """dp x tp sharded training step on the virtual CPU mesh."""
     import jax

@@ -21,6 +21,7 @@ def _xor_reader():
     return reader
 
 
+@pytest.mark.slow
 def test_v2_train_classifier_and_infer():
     paddle.init(use_gpu=False, trainer_count=1)
     x = paddle.layer.data("x", paddle.data_type.dense_vector(2))
@@ -67,6 +68,7 @@ def test_v2_train_classifier_and_infer():
     params2.init_from_tar(blob)  # pre-materialization: stashed
 
 
+@pytest.mark.slow
 def test_v2_sequence_classifier():
     """integer_value_sequence -> embedding -> simple_lstm -> pooling."""
     rng = np.random.RandomState(1)
