@@ -39,7 +39,7 @@ S2S_LEN = 32
 
 TLM_VOCAB = 32000
 TLM_D = 1024
-TLM_HEADS = 8   # d_head = 128: full MXU contraction width in the attention kernels (16 heads/d_head 64 = 36% MFU; 8 heads = 49%)
+TLM_HEADS = 8   # d_head = 128 (62% MFU; 16 heads/d_head 64 runs 50% after the r4 small-head kernel fixes — docs/perf.md)
 TLM_LAYERS = 8
 TLM_FF = 4096
 TLM_T = 1024

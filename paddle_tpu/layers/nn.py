@@ -679,13 +679,15 @@ def random_crop(x, shape, seed=None, name: Optional[str] = None):
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    q_block: int = 128, k_block: int = 128,
+                    q_block: int = 512, k_block: int = 512,
+                    heads_per_block: Optional[int] = None,
                     name: Optional[str] = None):
     """Fused attention over [N, T, H, D] tensors (Pallas kernel on TPU,
     blockwise-fallback elsewhere; ops/pallas_attention.py). The reference
     had no attention op at all — its transformer benchmark composed
     matmul+softmax (test_parallel_executor_transformer.py); this is the
-    TPU-native fusion of that pattern."""
+    TPU-native fusion of that pattern. ``heads_per_block`` overrides the
+    small-head packing (default 128//d_head, VMEM-clamped)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     # per-query logsumexp saved for the FlashAttention-2 backward kernels
@@ -694,7 +696,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         "flash_attention", {"Q": [q], "K": [k], "V": [v]},
         {"Out": [out], "LSE": [lse]},
         {"causal": causal, "scale": scale, "q_block": q_block,
-         "k_block": k_block},
+         "k_block": k_block, "heads_per_block": heads_per_block},
     )
     return out
 

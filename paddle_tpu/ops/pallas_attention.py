@@ -57,10 +57,37 @@ def _fit_block(t, blk):
     return None
 
 
-def _causal_mask(logits, qi, q_block, j, block_k, bq):
-    q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-    k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+def _causal_mask3(logits, qi, q_block, j, block_k, hb, bq):
+    """[hb, bq, bk] variant for multi-head blocks (same mask per head)."""
+    shape = (hb, bq, block_k)
+    q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, shape, 1)
+    k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, shape, 2)
     return jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+
+
+def _heads_per_block(h, d, hpb, t):
+    """How many heads share one grid cell (default 128//d, clamped to a
+    divisor of h). Small heads (d < 128) leave the MXU contraction
+    half-filled and double the sequential grid; batching 128//d heads per
+    cell amortizes the per-cell loop/DMA overhead. Measured at MODEL level
+    (transformer_lm d_model=1024 n_heads=16, slope-timed, spread <0.2 ms):
+    hb=2 86.3 ms/step vs hb=1 97.6 ms — 13% faster; with the native-bf16
+    operand fix below the pair lifts d_head=64 from r3's 36% to ~41% MFU.
+    (Microbench A/B under tunnel jitter is NOT reliable for this decision —
+    tools/probe_small_head.py spreads swung 3x; trust the model bench.)
+    ``hpb`` overrides; the pack must divide the head count, and the
+    default backs off when the packed full-T K/V blocks would crowd VMEM
+    (long-context shards keep hb=1 rather than risking a Mosaic OOM)."""
+    if hpb is None:
+        hpb = max(1, 128 // max(d, 1))
+        # fwd holds K+V [hb, t, d] blocks (bf16) per cell; stay well under
+        # the ~16 MB VMEM so double-buffering and f32 logits still fit
+        while hpb > 1 and hpb * t * d * 2 * 2 > 4 * 1024 * 1024:
+            hpb //= 2
+    hpb = max(1, min(hpb, h))
+    while h % hpb:
+        hpb -= 1
+    return hpb
 
 
 def _causal_hi(qi, q_block, block_k, n_blocks):
@@ -76,48 +103,58 @@ def _causal_hi(qi, q_block, block_k, n_blocks):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
                   causal, q_block):
+    """One grid cell = ``hb`` heads x one q-block. All matmuls are batched
+    over the leading head dim (hb=1 reproduces the classic layout; hb>1 is
+    the small-head packing — see _heads_per_block)."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    bq, d = q.shape
-    t = k_ref.shape[1]
+    # matmul operands stay in their native (bf16 under AMP) dtype — the MXU
+    # multiplies bf16 natively and accumulates f32 via
+    # preferred_element_type; upcasting operands to f32 forces multi-pass
+    # f32 matmuls at a fraction of peak (measured 2.2 -> 1.1 ms on the
+    # B8 T1024 H16 D64 fwd+bwd microbench). Softmax statistics stay f32.
+    q = q_ref[0]  # [hb, bq, d]
+    hb, bq, d = q.shape
+    t = k_ref.shape[2]
     n_blocks = t // block_k
+    bdims = (((2,), (2,)), ((0,), (0,)))   # contract d, batch heads
 
     def body(j, carry):
         o, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, :, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, :, pl.ds(j * block_k, block_k), :]
         logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            q, k, bdims,
+            preferred_element_type=jnp.float32) * scale  # [hb, bq, bk] f32
         if causal:
-            logits = _causal_mask(logits, qi, q_block, j, block_k, bq)
+            logits = _causal_mask3(logits, qi, q_block, j, block_k, hb, bq)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-        p = jnp.exp(logits - m_new[:, None])
+        p = jnp.exp(logits - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((2,), (1,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
-        o_new = o * alpha[:, None] + pv
+        o_new = o * alpha[..., None] + pv
         return o_new, m_new, l_new
 
-    o0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
+    o0 = jnp.zeros((hb, bq, d), jnp.float32)
+    m0 = jnp.full((hb, bq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hb, bq), jnp.float32)
     # causal: K-blocks entirely above the diagonal contribute nothing — skip
     # them (roughly halves the FLOPs; FlashAttention-2 loop bounds)
     hi = _causal_hi(qi, q_block, block_k, n_blocks) if causal else n_blocks
     o, m, l = lax.fori_loop(0, hi, body, (o0, m0, l0))
     l_safe = jnp.maximum(l, 1e-20)
-    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
-    # lse is laid out [bh, n_q_blocks, q_block]; the out block spans ALL
-    # q-blocks (full last-two dims — the Mosaic sublane/lane rule) and each
-    # sequential grid step writes its own row
-    lse_ref[0, qi] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+    o_ref[0] = (o / l_safe[..., None]).astype(o_ref.dtype)
+    # lse is laid out [bh/hb, hb, n_q_blocks, q_block]; the out block spans
+    # ALL q-blocks (full last-two dims — the Mosaic sublane/lane rule) and
+    # each sequential grid step writes its own row
+    lse_ref[0, :, qi] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None,
                         q_block=512, k_block=512, interpret=None,
-                        return_lse=False):
+                        return_lse=False, heads_per_block=None):
     """q,k,v: [B, T, H, D] -> out [B, T, H, D] (and lse [B, T, H])."""
     b, t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -132,28 +169,33 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
 
             return dense_attention(q, k, v, causal=causal, scale=scale)
         return _dense_attention_with_lse(q, k, v, causal, sc)
+    hb = _heads_per_block(h, d, heads_per_block, t)
+    g = b * h // hb
 
-    qh = jnp.moveaxis(q, 2, 1).reshape(b * h, t, d)
-    kh = jnp.moveaxis(k, 2, 1).reshape(b * h, t, d)
-    vh = jnp.moveaxis(v, 2, 1).reshape(b * h, t, d)
+    def fold(x):
+        return jnp.moveaxis(x, 2, 1).reshape(g, hb, t, d)
+
+    qh, kh, vh = fold(q), fold(k), fold(v)
 
     kernel = functools.partial(_flash_kernel, scale=sc, block_k=k_block,
                                causal=causal, q_block=q_block)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, t // q_block),
+        grid=(g, t // q_block),
         in_specs=[
-            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, hb, q_block, d), lambda bh, i: (bh, 0, i, 0)),
+            pl.BlockSpec((1, hb, t, d), lambda bh, i: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, t, d), lambda bh, i: (bh, 0, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t // q_block, q_block), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, hb, q_block, d), lambda bh, i: (bh, 0, i, 0)),
+            pl.BlockSpec((1, hb, t // q_block, q_block),
+                         lambda bh, i: (bh, 0, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t // q_block, q_block), jnp.float32),
+            jax.ShapeDtypeStruct((g, hb, t, d), q.dtype),
+            jax.ShapeDtypeStruct((g, hb, t // q_block, q_block),
+                                 jnp.float32),
         ],
         interpret=interpret,
     )(qh, kh, vh)
@@ -188,66 +230,69 @@ def _dense_attention_with_lse(q, k, v, causal, sc):
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, scale, block_k, causal, q_block):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)      # [bq, d]
-    do = do_ref[0].astype(jnp.float32)    # [bq, d]
-    lse = lse_ref[0, qi].astype(jnp.float32)      # [bq] (full-block layout)
-    delta = delta_ref[0, qi].astype(jnp.float32)  # [bq]
-    bq, d = q.shape
-    t = k_ref.shape[1]
+    q = q_ref[0]      # [hb, bq, d] native dtype (bf16 under AMP)
+    do = do_ref[0]    # [hb, bq, d]
+    lse = lse_ref[0, :, qi].astype(jnp.float32)      # [hb, bq]
+    delta = delta_ref[0, :, qi].astype(jnp.float32)  # [hb, bq]
+    hb, bq, d = q.shape
+    t = k_ref.shape[2]
     n_blocks = t // block_k
+    bdims = (((2,), (2,)), ((0,), (0,)))
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, :, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, :, pl.ds(j * block_k, block_k), :]
         logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            q, k, bdims, preferred_element_type=jnp.float32) * scale
         if causal:
-            logits = _causal_mask(logits, qi, q_block, j, block_k, bq)
-        p = jnp.exp(logits - lse[:, None])                       # [bq, bk]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+            logits = _causal_mask3(logits, qi, q_block, j, block_k, hb, bq)
+        p = jnp.exp(logits - lse[..., None])                 # [hb, bq, bk]
+        dp = jax.lax.dot_general(do, v, bdims,
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale                   # [bq, bk]
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale             # [hb, bq, bk]
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
 
     hi = _causal_hi(qi, q_block, block_k, n_blocks) if causal else n_blocks
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((hb, bq, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, scale, block_q, causal, k_block):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)  # [bk, d]
-    bk, d = k.shape
-    t = q_ref.shape[1]
+    k = k_ref[0]  # [hb, bk, d] native dtype (bf16 under AMP)
+    v = v_ref[0]  # [hb, bk, d]
+    hb, bk, d = k.shape
+    t = q_ref.shape[2]
     n_blocks = t // block_q
+    bdims = (((2,), (2,)), ((0,), (0,)))
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, i].astype(jnp.float32)      # [bq] (rank-3 layout)
-        delta = delta_ref[0, i].astype(jnp.float32)  # [bq]
+        q = q_ref[0, :, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, :, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, :, i].astype(jnp.float32)      # [hb, bq]
+        delta = delta_ref[0, :, i].astype(jnp.float32)  # [hb, bq]
         logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale          # [bq, bk]
+            q, k, bdims, preferred_element_type=jnp.float32) * scale
         if causal:
-            logits = _causal_mask(logits, i, block_q, ki, bk, block_q)
-        p = jnp.exp(logits - lse[:, None])
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+            logits = _causal_mask3(logits, i, block_q, ki, bk, hb, block_q)
+        p = jnp.exp(logits - lse[..., None])             # [hb, bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, bdims,
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
         return dk, dv
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk0 = jnp.zeros((hb, bk, d), jnp.float32)
+    dv0 = jnp.zeros((hb, bk, d), jnp.float32)
     # causal: Q-blocks entirely before this K-block see none of it — skip
     lo = (ki * k_block) // block_q if causal else 0
     dk, dv = lax.fori_loop(lo, n_blocks, body, (dk0, dv0))
@@ -280,7 +325,8 @@ def _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc):
 
 
 def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
-                        q_block=512, k_block=512, interpret=None):
+                        q_block=512, k_block=512, interpret=None,
+                        heads_per_block=None):
     """FlashAttention-2 backward. All of q/k/v/out/do: [B, T, H, D];
     lse: [B, T, H]. Returns (dq, dk, dv). The provided lse is honored as-is
     (it may be a globally-merged ring LSE), including in the ragged-shape
@@ -293,34 +339,38 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
     k_block = _fit_block(t, k_block)
     if q_block is None or k_block is None:
         return _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc)
+    hb = _heads_per_block(h, d, heads_per_block, t)
+    g = b * h // hb
 
     def fold(x):
-        return jnp.moveaxis(x, 2, 1).reshape(b * h, t, -1)
+        return jnp.moveaxis(x, 2, 1).reshape(g, hb, t, -1)
 
     qh, kh, vh, doh = fold(q), fold(k), fold(v), fold(do)
-    # lse/delta in the [bh, n_q_blocks, q_block] layout the kernels block on
+    # lse/delta in the [g, hb, n_q_blocks, q_block] layout the kernels
+    # block on
     n_q = t // q_block
-    lseh = jnp.moveaxis(lse, 2, 1).reshape(b * h, n_q, q_block)
+    lseh = jnp.moveaxis(lse, 2, 1).reshape(g, hb, n_q, q_block)
     delta = jnp.sum(doh.astype(jnp.float32)
                     * fold(out).astype(jnp.float32),
-                    axis=-1).reshape(b * h, n_q, q_block)
+                    axis=-1).reshape(g, hb, n_q, q_block)
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=sc,
                                   block_k=k_block, causal=causal,
                                   q_block=q_block)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b * h, t // q_block),
+        grid=(g, t // q_block),
         in_specs=[
-            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t // q_block, q_block), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t // q_block, q_block), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, hb, q_block, d), lambda bh, i: (bh, 0, i, 0)),
+            pl.BlockSpec((1, hb, t, d), lambda bh, i: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, t, d), lambda bh, i: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, q_block, d), lambda bh, i: (bh, 0, i, 0)),
+            pl.BlockSpec((1, hb, n_q, q_block), lambda bh, i: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, n_q, q_block), lambda bh, i: (bh, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, q_block, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=pl.BlockSpec((1, hb, q_block, d),
+                               lambda bh, i: (bh, 0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((g, hb, t, d), q.dtype),
         interpret=interpret,
     )(qh, kh, vh, doh, lseh, delta)
 
@@ -329,22 +379,22 @@ def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
                                    k_block=k_block)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, t // k_block),
+        grid=(g, t // k_block),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, t // q_block, q_block), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, t // q_block, q_block), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, hb, t, d), lambda bh, j: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, k_block, d), lambda bh, j: (bh, 0, j, 0)),
+            pl.BlockSpec((1, hb, k_block, d), lambda bh, j: (bh, 0, j, 0)),
+            pl.BlockSpec((1, hb, t, d), lambda bh, j: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, n_q, q_block), lambda bh, j: (bh, 0, 0, 0)),
+            pl.BlockSpec((1, hb, n_q, q_block), lambda bh, j: (bh, 0, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, k_block, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, hb, k_block, d), lambda bh, j: (bh, 0, j, 0)),
+            pl.BlockSpec((1, hb, k_block, d), lambda bh, j: (bh, 0, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+            jax.ShapeDtypeStruct((g, hb, t, d), k.dtype),
+            jax.ShapeDtypeStruct((g, hb, t, d), v.dtype),
         ],
         interpret=interpret,
     )(qh, kh, vh, doh, lseh, delta)
@@ -408,13 +458,14 @@ def flash_attention_op(ctx, ins, attrs):
         # fails loudly instead of silently computing with zeros.
         out = flash_attention(q, k, v, causal, scale,
                               attrs.get("q_block", 512),
-                              attrs.get("k_block", 512))
+                              attrs.get("k_block", 512),
+                              attrs.get("heads_per_block"))
         lse = lax.stop_gradient(jnp.full(q.shape[:3], jnp.nan, jnp.float32))
         return {"Out": [out], "LSE": [lse]}
     out, lse = flash_attention_fwd(
         q, k, v, causal=causal, scale=scale,
         q_block=attrs.get("q_block", 512), k_block=attrs.get("k_block", 512),
-        return_lse=True,
+        return_lse=True, heads_per_block=attrs.get("heads_per_block"),
     )
     return {"Out": [out], "LSE": [lse]}
 
@@ -437,7 +488,8 @@ def flash_attention_grad_op(ctx, ins, attrs):
         gq, gk, gv = flash_attention_bwd(
             q, k, v, out, lse, g, causal=causal, scale=scale,
             q_block=attrs.get("q_block", 512),
-            k_block=attrs.get("k_block", 512))
+            k_block=attrs.get("k_block", 512),
+            heads_per_block=attrs.get("heads_per_block"))
     return {"Q@GRAD": [gq], "K@GRAD": [gk], "V@GRAD": [gv]}
 
 
@@ -449,25 +501,28 @@ def flash_attention_grad_op(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None, q_block=512,
-                    k_block=512):
+                    k_block=512, heads_per_block=None):
     """Differentiable flash attention over [B, T, H, D] (jax.grad-ready)."""
     return flash_attention_fwd(q, k, v, causal=causal, scale=scale,
-                               q_block=q_block, k_block=k_block)
+                               q_block=q_block, k_block=k_block,
+                               heads_per_block=heads_per_block)
 
 
-def _fa_fwd(q, k, v, causal, scale, q_block, k_block):
+def _fa_fwd(q, k, v, causal, scale, q_block, k_block, heads_per_block):
     out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
                                    q_block=q_block, k_block=k_block,
-                                   return_lse=True)
+                                   return_lse=True,
+                                   heads_per_block=heads_per_block)
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, scale, q_block, k_block, res, g):
+def _fa_bwd(causal, scale, q_block, k_block, heads_per_block, res, g):
     q, k, v, out, lse = res
     return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
-                               scale=scale, q_block=q_block, k_block=k_block)
+                               scale=scale, q_block=q_block, k_block=k_block,
+                               heads_per_block=heads_per_block)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,80 @@
+"""On-chip probe: flash-attention fwd+bwd rate vs heads_per_block packing.
+
+The d_head<128 configs leave the MXU contraction half-filled and double the
+sequential Pallas grid; packing 128//d heads per grid cell
+(ops/pallas_attention.py::_heads_per_block) amortizes the per-cell loop/DMA
+overhead. This probe measures the packed vs unpacked kernels at the
+docs/perf.md microbench shape (B8 T1024 H16 D64) with slope timing and a
+data-dependent chain that consumes ALL kernel outputs (dq+dk+dv feed the
+next step — XLA would DCE an unused dkv kernel and fake the number).
+
+Usage: python tools/probe_small_head.py B,T,H,D,hpb,qb,kb [...]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, ".")
+from paddle_tpu.ops.pallas_attention import (flash_attention_bwd,
+                                             flash_attention_fwd)
+
+PEAK = 191e12  # measured bf16 matmul ceiling on this chip (docs/perf.md)
+
+
+def bench(B, T, H, D, hpb, qb, kb, reps=5, n1=None, n2=None):
+    dev = [d for d in jax.devices() if d.platform == "tpu"][0]
+    rng = np.random.RandomState(0)
+    try:
+        q = jax.device_put(rng.randn(B, T, H, D).astype(np.float32),
+                           dev).astype(jnp.bfloat16)
+        k = jax.device_put(rng.randn(B, T, H, D).astype(np.float32),
+                           dev).astype(jnp.bfloat16)
+        v = jax.device_put(rng.randn(B, T, H, D).astype(np.float32),
+                           dev).astype(jnp.bfloat16)
+        c = jnp.bfloat16(1e-3)
+
+        def step(qq):
+            out, lse = flash_attention_fwd(
+                qq, k, v, causal=True, q_block=qb, k_block=kb,
+                interpret=False, return_lse=True, heads_per_block=hpb)
+            dq, dk, dv = flash_attention_bwd(
+                qq, k, v, out, lse, out, causal=True, q_block=qb,
+                k_block=kb, interpret=False, heads_per_block=hpb)
+            return (dq + dk + dv).astype(qq.dtype)
+
+        def make(n):
+            @jax.jit
+            def run(qq):
+                return lax.fori_loop(0, n,
+                                     lambda i, x: step(x) * c + x, qq)
+            return run
+
+        step1 = make(1)
+        from paddle_tpu.profiler import slope_time
+        ts = []
+        for _ in range(reps):
+            ts.append(slope_time(
+                lambda: step1(q),
+                lambda: step1(q).block_until_ready(),
+                warmup=3, iters=60, prime=True))
+        ts.sort()
+        dt = ts[len(ts) // 2]  # median: robust to tunnel-weather outliers
+        flops = B * H * 7 * 2 * T * T * D * 0.5  # causal fwd+bwd matmuls
+        print(f"B{B} T{T} H{H} D{D} hpb={hpb} qb={qb} kb={kb}: "
+              f"{dt*1e3:.3f} ms  MFU {flops/dt/PEAK*100:.1f}%  "
+              f"(spread {ts[-1]/ts[0]:.2f}x)", flush=True)
+    except Exception as e:  # noqa: BLE001 - probe reports and continues
+        print(f"B{B} T{T} H{H} D{D} hpb={hpb} qb={qb} kb={kb}: "
+              f"FAIL {str(e)[:90]}", flush=True)
+
+
+if __name__ == "__main__":
+    specs = sys.argv[1:] or ["8,1024,16,64,1,512,512",
+                             "8,1024,16,64,2,1024,512",
+                             "8,1024,8,128,1,512,512"]
+    for spec in specs:
+        bench(*[int(x) for x in spec.split(",")])

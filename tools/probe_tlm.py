@@ -1,0 +1,73 @@
+"""Model-level A/B probe: transformer_lm step time vs attention config.
+
+Model-level slope timing is the reliable instrument on the tunneled chip
+(spread <0.2 ms/step; kernel microbenches swing 3x with weather —
+docs/perf.md). Usage: python tools/probe_tlm.py n_heads [qb kb]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+from bench import (PEAK_TFLOPS, TLM_BATCH, TLM_D, TLM_LAYERS, TLM_T,  # noqa: E402
+                   TLM_VOCAB, _slope_time)
+
+
+def run(n_heads, qb=512, kb=512):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tmod
+    from paddle_tpu import layers
+
+    # route the model's attention through the requested block config
+    orig = layers.flash_attention
+
+    def fa(q, k, v, causal=False, scale=None, q_block=qb, k_block=kb,
+           name=None):
+        return orig(q, k, v, causal=causal, scale=scale, q_block=qb,
+                    k_block=kb, name=name)
+
+    tmod.layers.flash_attention = fa
+    try:
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[TLM_T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[TLM_T], dtype="int64")
+            _, loss = tmod.transformer_lm(
+                ids, labels, vocab_size=TLM_VOCAB, max_len=TLM_T,
+                d_model=TLM_D, n_heads=n_heads, n_layers=TLM_LAYERS,
+                d_ff=4 * TLM_D)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
+    finally:
+        tmod.layers.flash_attention = orig
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=17)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    X = jax.device_put(
+        rng.randint(0, TLM_VOCAB, (TLM_BATCH, TLM_T)).astype("int32"), dev)
+    feed = {"ids": X, "labels": X}
+    step_time, spread = _slope_time(
+        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+        lambda: exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope),
+        warmup=2, iters=10)
+    tok_s = TLM_BATCH * TLM_T / step_time
+    n_params = TLM_LAYERS * 12 * TLM_D * TLM_D + TLM_VOCAB * TLM_D
+    flops_per_token = 6 * n_params + 6 * TLM_LAYERS * TLM_D * TLM_T
+    mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
+    print(json.dumps({
+        "n_heads": n_heads, "qb": qb, "kb": kb, "tok_s": round(tok_s, 1),
+        "mfu": round(mfu, 4), "step_ms": round(step_time * 1e3, 2),
+        "spread_ms": round(spread * 1e3, 2)}))
+
+
+if __name__ == "__main__":
+    n_heads = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    qb = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    kb = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+    run(n_heads, qb, kb)
