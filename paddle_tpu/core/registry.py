@@ -10,10 +10,13 @@
   drift from the implementation.
 * Grad ops are emitted at the IR level like GradOpDescMaker
   (grad_op_desc_maker.h:34) but their kernels default to ``jax.vjp`` of the
-  forward kernel, *recomputing the forward inside the same traced block* —
-  XLA's CSE merges the recomputation with the original forward, so this costs
-  nothing at runtime while keeping every grad numerically consistent with the
-  forward by construction.
+  forward kernel. The executor primes a per-trace vjp cache
+  (``forward_with_vjp``) so the grad op reuses the forward's residuals;
+  without it the grad replays the forward in-trace, which XLA CSE folds for
+  elementwise/matmul ops but NOT for scan-based recurrences (two
+  structurally-different while loops both run — seq2seq trace evidence in
+  docs/perf.md). Grads stay numerically consistent with the forward by
+  construction either way.
 """
 from __future__ import annotations
 
@@ -58,6 +61,12 @@ class ExecContext:
         # auto-mixed-precision: matmul/conv kernels compute in bf16 with f32
         # accumulation while parameters stay f32 (the TPU-native AMP recipe)
         self.amp = amp
+        # trace-level vjp cache (see forward_with_vjp): forward op types the
+        # current block will differentiate generically run under jax.vjp so
+        # their <type>_grad reuses the residuals instead of replaying the
+        # forward. Keyed by tracer identity — self-invalidating.
+        self.vjp_cache: Dict[Any, Any] = {}
+        self.vjp_wanted_types: set = set()
 
     def next_key(self):
         if self._key is None:
@@ -292,25 +301,79 @@ def _float_slots(opdef: OpDef, ins: SlotValues) -> List[str]:
     return out
 
 
+def _leaf_ids(slot_values) -> tuple:
+    return tuple(
+        (s, tuple(id(v) for v in vs))
+        for s, vs in sorted(slot_values.items()) if vs
+    )
+
+
+def _vjp_cache_key(fwd_def: "OpDef", fwd_ins: SlotValues,
+                   outs: SlotValues, attrs) -> tuple:
+    """Identity of one forward-op invocation within the current trace:
+    op type + attrs + the exact input AND output tracer objects. Including
+    the outputs makes two same-type ops on identical inputs (e.g. two
+    dropouts that each consumed a PRNG subkey) distinguishable, and makes
+    the key self-invalidating when a var was overwritten between the
+    forward and its grad op (id mismatch -> cache miss -> safe replay)."""
+    return (fwd_def.type,
+            repr(sorted((k, repr(v)) for k, v in (attrs or {}).items())),
+            _leaf_ids(fwd_ins), _leaf_ids(outs))
+
+
+def _fwd_closure(fwd_def: "OpDef", ctx: "ExecContext", frozen: SlotValues,
+                 attrs):
+    def fwd(live_ins):
+        outs = fwd_def.impl(ctx, {**frozen, **live_ins}, attrs)
+        # only float outputs participate in the vjp
+        return {s: [o for o in vs] for s, vs in outs.items()}
+
+    return fwd
+
+
+def forward_with_vjp(fwd_def: "OpDef", ctx: "ExecContext", ins: SlotValues,
+                     attrs) -> SlotValues:
+    """Run a forward op under ``jax.vjp`` and cache the residual closure so
+    the generically-derived ``<type>_grad`` later in the SAME trace reuses
+    it instead of replaying the forward. For elementwise/matmul ops XLA's
+    CSE already merges the replay, but for ``lax.scan``-based recurrences
+    (lstm / gru / attention decoder) the primal and replay while-loops are
+    structurally different and BOTH run — trace-measured ~1.5 ms/step on
+    the seq2seq bench (tools/trace_ops.py). The executor only routes op
+    types listed in ``ctx.vjp_wanted_types`` through here, so inference
+    programs and custom-grad ops pay nothing."""
+    fwd_ins = {s: ins[s] for s in fwd_def.input_slots if ins.get(s)}
+    diff_slots = _float_slots(fwd_def, fwd_ins)
+    frozen = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
+    live = {s: fwd_ins[s] for s in diff_slots}
+    outs, vjp = jax.vjp(_fwd_closure(fwd_def, ctx, frozen, attrs), live)
+    key = _vjp_cache_key(fwd_def, fwd_ins, outs, attrs)
+    ctx.vjp_cache[key] = (outs, vjp, diff_slots)
+    return outs
+
+
 def generic_grad_impl(fwd_type: str):
-    """Kernel for ``<fwd>_grad`` built from ``jax.vjp`` over the forward kernel."""
+    """Kernel for ``<fwd>_grad`` built from ``jax.vjp`` over the forward
+    kernel — reusing the forward's cached vjp (forward_with_vjp) when the
+    executor primed one, replaying the forward otherwise."""
     fwd_def = get_op_def(fwd_type)
 
     def impl(ctx: ExecContext, ins: SlotValues, attrs: Dict[str, Any]) -> SlotValues:
         fwd_ins = {s: ins[s] for s in fwd_def.input_slots if ins.get(s)}
         diff_slots = _float_slots(fwd_def, fwd_ins)
-        frozen = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
-        live = {s: fwd_ins[s] for s in diff_slots}
-
-        def fwd(live_ins):
-            outs = fwd_def.impl(ctx, {**frozen, **live_ins}, attrs)
-            # only float outputs participate in the vjp
-            return {
-                s: [o for o in vs]
-                for s, vs in outs.items()
-            }
-
-        outs, vjp = jax.vjp(fwd, live)
+        cached = None
+        cache = getattr(ctx, "vjp_cache", None)
+        if cache:
+            fwd_outs = {s: ins[s] for s in fwd_def.output_slots if ins.get(s)}
+            key = _vjp_cache_key(fwd_def, fwd_ins, fwd_outs, attrs)
+            cached = cache.pop(key, None)
+        if cached is not None:
+            outs, vjp, diff_slots = cached
+        else:
+            frozen = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
+            live = {s: fwd_ins[s] for s in diff_slots}
+            outs, vjp = jax.vjp(_fwd_closure(fwd_def, ctx, frozen, attrs),
+                                live)
         # cotangents: provided grads where present, zeros elsewhere
         cot = {}
         for slot, vals in outs.items():
@@ -338,6 +401,25 @@ def generic_grad_impl(fwd_type: str):
     return impl
 
 
+def generic_grad_fwd_types(block) -> set:
+    """Forward op types whose grads in ``block`` use the GENERIC
+    vjp-derived kernel (ops with hand-written grad kernels — flash
+    attention, the CE head — handle their own residuals and are excluded).
+    The executor routes these forwards through forward_with_vjp."""
+    wanted = set()
+    for op in block.ops:
+        if not op.type.endswith("_grad"):
+            continue
+        fwd_type = op.type[: -len("_grad")]
+        if fwd_type not in _REGISTRY:
+            continue
+        ensure_grad_op_registered(op.type)
+        gdef = _REGISTRY.get(op.type)
+        if gdef is not None and getattr(gdef.impl, "_derived_generic", False):
+            wanted.add(fwd_type)
+    return wanted
+
+
 def ensure_grad_op_registered(grad_type: str) -> None:
     """Lazily register ``<fwd>_grad`` kernels derived from the forward."""
     if grad_type in _REGISTRY or not grad_type.endswith("_grad"):
@@ -346,9 +428,11 @@ def ensure_grad_op_registered(grad_type: str) -> None:
     if fwd_type not in _REGISTRY:
         raise KeyError(f"no forward op {fwd_type!r} for grad op {grad_type!r}")
     fwd = _REGISTRY[fwd_type]
+    derived_impl = generic_grad_impl(fwd_type)
+    derived_impl._derived_generic = True  # executor: eligible for vjp cache
     _REGISTRY[grad_type] = OpDef(
         type=grad_type,
-        impl=generic_grad_impl(fwd_type),
+        impl=derived_impl,
         input_slots=tuple(fwd.input_slots)
         + tuple(fwd.output_slots)
         + tuple(s + GRAD_SUFFIX for s in fwd.output_slots),

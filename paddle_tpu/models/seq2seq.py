@@ -50,8 +50,17 @@ class Seq2SeqAttention:
         enc_last_cell = seq_layers.sequence_last_step(enc_cell, src_length)
         return enc_out, enc_last, enc_last_cell
 
-    def build_train(self, src_ids, src_length, trg_ids, trg_length, trg_next_ids):
-        """Returns (avg_loss, per_token_loss)."""
+    def build_train(self, src_ids, src_length, trg_ids, trg_length, trg_next_ids,
+                    fused_head: bool = False):
+        """Returns (avg_loss, per_token_loss).
+
+        ``fused_head``: route the vocab head through
+        ``fused_linear_cross_entropy`` (chunked vocab under an online
+        logsumexp) — a MEMORY feature for huge-vocab configs. Measured at
+        this model's V=30k it is ~20% SLOWER than the dense head (the
+        checkpointed backward's extra matmul pass outweighs the
+        elementwise savings; docs/perf.md "Sequence workloads"), so it
+        stays off by default and exists for beyond-HBM vocab sizes."""
         enc_out, h0, c0 = self._encode(src_ids, src_length)
         trg_emb = layers.embedding(trg_ids, size=[self.trg_vocab, self.embed_dim],
                                    param_attr=ParamAttr(self.p["trg_emb"]))
@@ -61,11 +70,18 @@ class Seq2SeqAttention:
             param_attr=[ParamAttr(self.p["attn_w"]), ParamAttr(self.p["dec_wx"]),
                         ParamAttr(self.p["dec_wh"]), ParamAttr(self.p["dec_b"])],
         )
-        logits = layers.fc(dec_hidden, size=self.trg_vocab, num_flatten_dims=2,
-                           param_attr=ParamAttr(self.p["out_w"]),
-                           bias_attr=ParamAttr(self.p["out_b"]))
-        loss = layers.softmax_with_cross_entropy(logits, trg_next_ids)
         tmax = int(trg_ids.shape[1])
+        if fused_head:
+            labels3 = layers.reshape(trg_next_ids, [0, tmax, 1])
+            loss = layers.fused_linear_cross_entropy(
+                dec_hidden, self.trg_vocab, labels3,
+                param_attr=ParamAttr(self.p["out_w"]),
+                bias_attr=ParamAttr(self.p["out_b"]))
+        else:
+            logits = layers.fc(dec_hidden, size=self.trg_vocab, num_flatten_dims=2,
+                               param_attr=ParamAttr(self.p["out_w"]),
+                               bias_attr=ParamAttr(self.p["out_b"]))
+            loss = layers.softmax_with_cross_entropy(logits, trg_next_ids)
         # per-token loss is pad-masked before being exposed: positions past
         # trg_length carry no signal (callers use it for per-position stats)
         mask = seq_layers.sequence_mask(trg_length, maxlen=tmax, dtype=loss.dtype)

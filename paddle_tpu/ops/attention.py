@@ -27,14 +27,18 @@ from ..core.registry import register_op
 from ._amp import recurrent_cast as _recurrent_cast
 
 
-def _attend(h, enc, enc_mask, wa):
+def _attend(h, enc, enc_mask, encw):
     """Luong general attention: scores = h Wa enc^T, masked softmax, context.
 
-    Dtype-driven AMP: callers cast ``wa``/``enc`` to bf16 and carry ``h`` in
-    f32; the matmuls then run bf16 while the softmax normalizes in f32.
+    ``encw`` is enc @ Wa^T, precomputed ONCE outside the recurrence —
+    (h Wa) . enc == h . (enc Wa^T), so hoisting the projection onto the
+    (step-invariant) encoder states removes one [N, H] x [H, H] matmul
+    from every scan step (the decoder runs T of them, fwd and bwd).
+
+    Dtype-driven AMP: callers cast ``encw``/``enc`` to bf16 and carry ``h``
+    in f32; the matmuls then run bf16 while the softmax normalizes in f32.
     """
-    q = h.astype(wa.dtype) @ wa  # [N, H]
-    scores = jnp.einsum("nh,nth->nt", q, enc)
+    scores = jnp.einsum("nh,nth->nt", h.astype(encw.dtype), encw)
     scores = jnp.where(enc_mask, scores.astype(jnp.float32),
                        jnp.finfo(jnp.float32).min)
     alpha = jax.nn.softmax(scores, axis=-1)
@@ -42,10 +46,15 @@ def _attend(h, enc, enc_mask, wa):
     return ctx, alpha
 
 
-def _decoder_step(emb_t, h_prev, c_prev, enc, enc_mask, wa, wx, wh, b):
-    ctx, alpha = _attend(h_prev, enc, enc_mask, wa)
-    inp = jnp.concatenate([emb_t, ctx.astype(emb_t.dtype)], axis=-1)
-    gates = inp.astype(wx.dtype) @ wx + h_prev.astype(wh.dtype) @ wh + b
+def _decoder_step(pre_t, h_prev, c_prev, enc, enc_mask, encw, wch, b):
+    """One attention-LSTM step. ``pre_t`` is this step's share of the
+    embedding projection (computed for ALL steps in one batched matmul
+    outside the scan — the per-step scan body then runs a single fused
+    [N, H+H] x [2H, 4H] matmul over [ctx, h] instead of three small ones;
+    the recurrence itself is the only work that must stay sequential)."""
+    ctx, alpha = _attend(h_prev, enc, enc_mask, encw)
+    ch = jnp.concatenate([ctx, h_prev.astype(ctx.dtype)], axis=-1)
+    gates = pre_t + ch.astype(wch.dtype) @ wch + b
     i, f, c_bar, o = jnp.split(gates, 4, axis=-1)
     c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(c_bar)
     h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
@@ -77,18 +86,28 @@ def attention_lstm_decoder(ctx_, ins, attrs):
     trg_len = (ins["TrgLength"][0] if ins.get("TrgLength") and ins["TrgLength"][0] is not None
                else jnp.full((n,), td, jnp.int32))
     step_mask = (jnp.arange(td)[:, None] < trg_len.reshape(1, -1)).astype(emb.dtype)
+    # hoist the embedding half of the input projection out of the scan:
+    # wx rows split [emb | ctx]; emb @ wx_e is context-independent, so it
+    # runs as ONE [N*Td, E] x [E, 4H] MXU matmul instead of Td small ones
+    e = emb.shape[-1]
+    wx_e, wx_c = wx[:e], wx[e:]
+    pre = jnp.einsum("nte,eg->ntg", emb, wx_e)
+    # fuse the two remaining per-step matmuls: [ctx, h] @ [[wx_c], [wh]]
+    wch = jnp.concatenate([wx_c, wh], axis=0)
+    # hoist the attention projection onto the (fixed) encoder states
+    encw = jnp.einsum("ntj,ij->nti", enc, wa)
 
     def step(carry, inp):
         h_prev, c_prev = carry
-        emb_t, m = inp
+        pre_t, m = inp
         h_new, c_new, ctx_t, _ = _decoder_step(
-            emb_t, h_prev, c_prev, enc, enc_mask, wa, wx, wh, b)
+            pre_t, h_prev, c_prev, enc, enc_mask, encw, wch, b)
         m = m[:, None]
         h_out = m * h_new + (1 - m) * h_prev
         c_out = m * c_new + (1 - m) * c_prev
         return (h_out, c_out), (h_out * m, ctx_t * m)
 
-    (_, _), (hs, ctxs) = lax.scan(step, (h0, c0), (jnp.moveaxis(emb, 1, 0), step_mask))
+    (_, _), (hs, ctxs) = lax.scan(step, (h0, c0), (jnp.moveaxis(pre, 1, 0), step_mask))
     return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "Context": [jnp.moveaxis(ctxs, 0, 1)]}
 
 
@@ -138,11 +157,20 @@ def attention_lstm_beam_decode(ctx_, ins, attrs):
     ids0 = jnp.full((n, K, L), eos, jnp.int32)
     finished0 = jnp.zeros((n, K), bool)
 
+    # same split/fuse as the training decoder (see attention_lstm_decoder):
+    # tokens are data-dependent so the emb projection stays per step, but
+    # it still fuses with the gate add, and [ctx, h] shares one matmul
+    e_dim = table.shape[1]
+    wx_e, wx_c = wx[:e_dim], wx[e_dim:]
+    wch = jnp.concatenate([wx_c, wh], axis=0)
+    encwK = jnp.repeat(jnp.einsum("ntj,ij->nti", enc, wa), K, axis=0)
+
     def step(carry, t):
         tokens, scores, hK, cK, ids, finished = carry
         emb_t = table[tokens.reshape(-1)]  # [N*K, E]
-        h_new, c_new, _, _ = _decoder_step(emb_t, hK, cK, encK, enc_maskK,
-                                           wa, wx, wh, b)
+        pre_t = emb_t.astype(wx_e.dtype) @ wx_e
+        h_new, c_new, _, _ = _decoder_step(pre_t, hK, cK, encK, enc_maskK,
+                                           encwK, wch, b)
         logp = jax.nn.log_softmax(h_new @ ow + ob)  # [N*K, V]
         logp = logp.reshape(n, K, v)
         # finished beams only extend with EOS at zero cost

@@ -11,9 +11,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.ir import grad_var_name
 from ..core.registry import register_op
 from ._amp import amp_operand as _amp_operand
 from ._amp import f32_compute as _f32_compute
+from ._amp import low_precision as _low_precision
 
 
 def _gather_label(x, label):
@@ -35,16 +37,60 @@ def cross_entropy(ctx, ins, attrs):
     return {"Y": [y]}
 
 
+def _swce_grad_maker(op, no_grad_set):
+    """Explicit grad: dLogits is rebuilt from the (bf16) logits and the
+    Loss forward output — NOT from the Softmax output. The vjp-derived
+    grad kept exp(logits - lse) as a residual, which for an LM/NMT head
+    materializes the [N*T, V] f32 softmax in HBM purely for the backward
+    (trace-measured ~2-3 ms/step of casts+subs on the 30k-vocab seq2seq
+    bench, tools/trace_ops.py). With this maker the Softmax output is
+    dead unless explicitly consumed, and XLA DCEs its computation."""
+    inputs = {
+        "Logits": list(op.inputs["Logits"]),
+        "Label": list(op.inputs["Label"]),
+        "Loss": list(op.outputs["Loss"]),
+        "Loss@GRAD": [grad_var_name(n) for n in op.outputs["Loss"]],
+        # optional: autodiff nulls this out when nothing consumed Softmax,
+        # which is the common (training) case
+        "Softmax@GRAD": [grad_var_name(n) for n in op.outputs["Softmax"]],
+    }
+    return [{
+        "type": "softmax_with_cross_entropy_grad",
+        "inputs": inputs,
+        "outputs": {
+            "Logits@GRAD": ["" if n in no_grad_set else grad_var_name(n)
+                            for n in op.inputs["Logits"]],
+        },
+        "attrs": dict(op.attrs),
+    }]
+
+
 @register_op(
     "softmax_with_cross_entropy",
     inputs=("Logits", "Label"),
     outputs=("Softmax", "Loss"),
     diff_inputs=("Logits",),
+    grad_maker=_swce_grad_maker,
 )
 def softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
-    logits = _f32_compute(ctx, logits)  # AMP: loss head stays f32
+    # compute on [N*T, V]: 3D [N, T, V] logits give XLA's layout assignment
+    # two reasonable row-major choices and the backward ate a 1.5 ms pure
+    # layout copy of the 0.5 GB dlogits (hlo_stats, seq2seq bench); in 2D
+    # the reshapes are bitcasts and every consumer agrees on {1,0}
+    lead = logits.shape[:-1]
+    if logits.ndim > 2:
+        v = logits.shape[-1]
+        logits = logits.reshape(-1, v)
+        # soft labels are a distribution over V; hard labels flatten to [N]
+        label = (label.reshape(-1, v) if attrs.get("soft_label", False)
+                 else label.reshape(-1))
+        out = softmax_with_cross_entropy(
+            ctx, {"Logits": [logits], "Label": [label]}, attrs)
+        return {"Softmax": [out["Softmax"][0].reshape(lead + (-1,))],
+                "Loss": [out["Loss"][0].reshape(lead + (1,))]}
     if attrs.get("soft_label", False):
+        logits = _f32_compute(ctx, logits)
         log_p = jax.nn.log_softmax(logits, axis=-1)
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
         return {"Softmax": [jnp.exp(log_p)], "Loss": [loss]}
@@ -52,9 +98,92 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     # tensor never materializes (for an LM head that tensor is
     # [N*T, vocab] f32, the biggest buffer in the step); the Softmax
     # output is computed lazily and dead-code-eliminated when unused
+    # (the explicit grad above never reads it)
+    if getattr(ctx, "amp", False) and _low_precision(logits.dtype):
+        # AMP: statistics accumulate f32 WITHOUT materializing an f32 copy
+        # of the [N, V] logits. An up-front astype feeds max+sum+gather and
+        # XLA materializes it as a standalone convert pass (trace-measured
+        # 1.5 ms/step on the 30k-vocab seq2seq bench); structuring each
+        # reduction as its own cast->sub->exp chain with a single consumer
+        # lets every pass read the bf16 logits directly. max in bf16 is
+        # exact (comparisons), exp/log/sum stay f32.
+        m = jnp.max(logits, axis=-1, keepdims=True).astype(jnp.float32)
+        s = jnp.sum(jnp.exp(logits.astype(jnp.float32) - m),
+                    axis=-1, keepdims=True)
+        lse = m + jnp.log(s)
+        loss = lse - _gather_label(logits, label).astype(jnp.float32)
+        softmax = jnp.exp(logits.astype(jnp.float32) - lse)
+        return {"Softmax": [softmax], "Loss": [loss]}
     lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
     loss = lse - _gather_label(logits, label)
     return {"Softmax": [jnp.exp(logits - lse)], "Loss": [loss]}
+
+
+@register_op(
+    "softmax_with_cross_entropy_grad",
+    inputs=("Logits", "Label", "Loss", "Loss@GRAD", "Softmax@GRAD"),
+    outputs=("Logits@GRAD",),
+    no_grad=True,
+)
+def softmax_with_cross_entropy_grad(ctx, ins, attrs):
+    """dLogits = (softmax - target) * dLoss with softmax REBUILT in the
+    backward: for hard labels lse = loss + picked_logit (both cheap, no
+    [N, V] residual), so exp(logits - lse) fuses into the consuming
+    matmul's operand instead of living in HBM between fwd and bwd. The
+    rare Softmax-consumer path adds the softmax jacobian term."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    g = ins["Loss@GRAD"][0]
+    gs = (ins["Softmax@GRAD"][0]
+          if ins.get("Softmax@GRAD") and ins["Softmax@GRAD"][0] is not None
+          else None)
+    lead = logits.shape[:-1]
+    if logits.ndim > 2:  # flatten to 2D — see forward
+        v = logits.shape[-1]
+        flat = {
+            "Logits": [logits.reshape(-1, v)],
+            "Label": [label.reshape(-1, v)
+                      if attrs.get("soft_label", False)
+                      else label.reshape(-1)],
+            "Loss": [ins["Loss"][0].reshape(-1, 1)],
+            "Loss@GRAD": [None if g is None else g.reshape(-1, 1)],
+            "Softmax@GRAD": [None if gs is None else gs.reshape(-1, v)],
+        }
+        out = softmax_with_cross_entropy_grad(ctx, flat, attrs)
+        return {"Logits@GRAD": [out["Logits@GRAD"][0].reshape(
+            lead + (v,))]}
+    amp_lp = getattr(ctx, "amp", False) and _low_precision(logits.dtype)
+    if not amp_lp:
+        logits = _f32_compute(ctx, logits)
+    soft = attrs.get("soft_label", False)
+    if soft or gs is not None:
+        lf = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1, keepdims=True)
+        p = jnp.exp(lf - lse)
+    else:
+        loss = ins["Loss"][0]
+        picked = _gather_label(logits, label).astype(jnp.float32)
+        lse = loss + picked  # loss = lse - picked, both [N, 1]
+        # single-consumer cast->sub->exp chain: fuses into the dlogits
+        # pass reading bf16 logits directly (see forward)
+        p = jnp.exp(logits.astype(jnp.float32) - lse)
+    if soft:
+        # exact derivative for (possibly unnormalized) soft targets:
+        # d/dlogits[-sum(label * log_softmax)] = p * sum(label) - label
+        target = label
+        g_p = jnp.sum(label, axis=-1, keepdims=True)
+    else:
+        g_p = None
+        lbl = label.squeeze(-1) if label.ndim == logits.ndim else label
+        target = jax.nn.one_hot(lbl.astype(jnp.int32), logits.shape[-1],
+                                dtype=p.dtype)
+    # Loss@GRAD can be nulled (Softmax-only consumers, e.g. distillation):
+    # a missing cotangent means zero contribution, as the generic vjp did
+    p_term = p * g_p if g_p is not None else p
+    dlogits = (p_term - target) * g if g is not None else jnp.zeros_like(p)
+    if gs is not None:
+        # d/dlogits of softmax output: p * (gs - sum(gs * p))
+        dlogits = dlogits + p * (gs - jnp.sum(gs * p, axis=-1, keepdims=True))
+    return {"Logits@GRAD": [dlogits.astype(ins["Logits"][0].dtype)]}
 
 
 @register_op(

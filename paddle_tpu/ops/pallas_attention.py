@@ -80,9 +80,11 @@ def _heads_per_block(h, d, hpb, t):
     (long-context shards keep hb=1 rather than risking a Mosaic OOM)."""
     if hpb is None:
         hpb = max(1, 128 // max(d, 1))
-        # fwd holds K+V [hb, t, d] blocks (bf16) per cell; stay well under
-        # the ~16 MB VMEM so double-buffering and f32 logits still fit
-        while hpb > 1 and hpb * t * d * 2 * 2 > 4 * 1024 * 1024:
+        # the dkv backward holds FOUR full-T [hb, t, d] bf16 blocks per
+        # cell (Q, K, V, dO) — twice the forward's K+V — so budget that,
+        # staying well under the ~16 MB VMEM for double-buffering and the
+        # f32 logits/accumulators
+        while hpb > 1 and hpb * t * d * 2 * 4 > 4 * 1024 * 1024:
             hpb //= 2
     hpb = max(1, min(hpb, h))
     while h % hpb:

@@ -1,0 +1,107 @@
+"""Seq2seq step-time decomposition probe (slope-timed, on-chip).
+
+Variants: full train step / forward-only / encoder-only train /
+decoder-without-attention train — ablation locates the scan-bound cost
+the same way tools/perf_lab.py does for ResNet.
+Usage: python tools/probe_s2s.py [batch] [len]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+
+def build(batch, length, mode):
+    import paddle_tpu as fluid
+    from paddle_tpu.models.seq2seq import Seq2SeqAttention
+
+    V, E, H = 30000, 512, 512
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[length], dtype="int64")
+        src_len = fluid.layers.data("src_len", shape=[], dtype="int64")
+        trg = fluid.layers.data("trg", shape=[length], dtype="int64")
+        trg_len = fluid.layers.data("trg_len", shape=[], dtype="int64")
+        trg_next = fluid.layers.data("trg_next", shape=[length], dtype="int64")
+        model = Seq2SeqAttention(V, V, embed_dim=E, hidden=H)
+        if mode in ("encoder_only", "enc_fwd"):
+            enc_out, h0, c0 = model._encode(src, src_len)
+            avg = fluid.layers.reduce_mean(enc_out)
+        elif mode in ("lstm_fwd", "lstm_train"):
+            from paddle_tpu.layers import sequence as seq_layers
+            gin = fluid.layers.data("gin", shape=[length, 4 * 512],
+                                    dtype="float32")
+            enc_out, enc_cell = seq_layers.dynamic_lstm(
+                gin, 512, length=src_len,
+                param_attr=fluid.ParamAttr("s2s.enc.w"),
+                bias_attr=fluid.ParamAttr("s2s.enc.b"))
+            avg = fluid.layers.cast(fluid.layers.reduce_mean(enc_out),
+                                    "float32")
+        elif mode in ("embproj", "embproj_fwd"):
+            from paddle_tpu.param_attr import ParamAttr
+            src_emb = fluid.layers.embedding(
+                src, size=[30000, 512], param_attr=ParamAttr("s2s.src_emb.w"))
+            gate_in = fluid.layers.fc(src_emb, size=4 * 512,
+                                      num_flatten_dims=2, bias_attr=False,
+                                      param_attr=ParamAttr("s2s.src_proj.w"))
+            avg = fluid.layers.cast(fluid.layers.reduce_mean(gate_in),
+                                    "float32")
+        elif mode == "nohead":
+            enc_out, h0, c0 = model._encode(src, src_len)
+            trg_emb = fluid.layers.embedding(
+                trg, size=[30000, 512],
+                param_attr=fluid.ParamAttr("s2s.trg_emb.w"))
+            from paddle_tpu.layers import sequence as seq_layers
+            dec_hidden, _, _ = seq_layers.attention_decoder(
+                trg_emb, enc_out, src_len, h0, c0, 512, trg_length=trg_len)
+            avg = fluid.layers.reduce_mean(dec_hidden)
+        else:
+            avg, _ = model.build_train(src, src_len, trg, trg_len, trg_next,
+                                       fused_head=(mode == "train_fused"))
+        if "fwd" not in mode:
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg, startup)
+    return main, startup, avg
+
+
+def run(batch, length, mode):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.profiler import slope_time
+
+    main, startup, avg = build(batch, length, mode)
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=11)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    V = 30000
+    feed = {
+        "src": jax.device_put(rng.randint(0, V, (batch, length)).astype("int32"), dev),
+        "gin": jax.device_put(rng.randn(batch, length, 4 * 512).astype("float32"), dev),
+        "src_len": jax.device_put(np.full((batch,), length, "int32"), dev),
+        "trg": jax.device_put(rng.randint(0, V, (batch, length)).astype("int32"), dev),
+        "trg_len": jax.device_put(np.full((batch,), length, "int32"), dev),
+        "trg_next": jax.device_put(rng.randint(0, V, (batch, length)).astype("int32"), dev),
+    }
+    ts = []
+    for _ in range(3):
+        ts.append(slope_time(
+            lambda: exe.run(main, feed=feed, fetch_list=[], scope=scope),
+            lambda: exe.run(main, feed=feed, fetch_list=[avg], scope=scope),
+            warmup=3, iters=150, prime=True))
+    ts.sort()
+    print(json.dumps({"mode": mode, "batch": batch, "len": length,
+                      "step_ms": round(ts[1] * 1e3, 3),
+                      "spread": round(ts[-1] / ts[0], 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    length = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    modes = sys.argv[3].split(",") if len(sys.argv) > 3 else [
+        "train", "fwd_only", "encoder_only"]
+    for m in modes:
+        run(batch, length, m)

@@ -1,0 +1,46 @@
+"""Parse a jax.profiler xplane.pb into a per-op device-time table.
+
+The r3 ResNet roofline was built from an ad-hoc version of this; now a
+tool: aggregates device self-time by operation type (and top ops by name),
+excluding IDLE — on a tunneled chip most wall-clock is inter-step idle, so
+only relative device time is meaningful.
+Usage: python tools/trace_ops.py <xplane.pb> [top_n]
+"""
+import json
+import sys
+
+
+def load(pb):
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data([pb], "framework_op_stats", {})
+    obj = json.loads(data) if isinstance(data, (str, bytes)) else data
+    table = obj[0]
+    cols = [c["id"] for c in table["cols"]]
+    rows = [[cell["v"] for cell in r["c"]] for r in table["rows"]]
+    return cols, rows
+
+
+def main(pb, top_n=25):
+    cols, rows = load(pb)
+    i_dev = cols.index("host_or_device")
+    i_type = cols.index("type")
+    i_name = cols.index("operation")
+    i_self = cols.index("total_self_time")
+    dev_rows = [r for r in rows if r[i_dev] == "Device" and r[i_type] != "IDLE"]
+    total = sum(r[i_self] for r in dev_rows)
+    by_type = {}
+    for r in dev_rows:
+        by_type[r[i_type]] = by_type.get(r[i_type], 0.0) + r[i_self]
+    print(f"device busy time: {total/1e3:.2f} ms (trace total, all steps)")
+    print("\n-- by op type --")
+    for t, us in sorted(by_type.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"{us/1e3:9.2f} ms  {us/total*100:5.1f}%  {t}")
+    print("\n-- top ops by name --")
+    for r in sorted(dev_rows, key=lambda r: -r[i_self])[:top_n]:
+        print(f"{r[i_self]/1e3:9.2f} ms  {r[i_self]/total*100:5.1f}%  "
+              f"{r[i_type]:20s} {str(r[i_name])[:80]}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
