@@ -28,7 +28,8 @@ import numpy as np
 
 from .ir import Block, Operator, Program, default_main_program
 from .registry import (ExecContext, ensure_grad_op_registered,
-                       forward_with_vjp, generic_grad_fwd_types, get_op_def)
+                       forward_with_vjp, fwd_instance_key,
+                       generic_grad_fwd_instances, get_op_def)
 from .types import Place, default_place
 
 
@@ -81,7 +82,7 @@ class BlockProgramBuilder:
     def run_block(self, block_idx: int, env: Dict[str, Any], ctx: ExecContext) -> Dict[str, Any]:
         """Interpret ``block_idx``'s ops over ``env`` (traced, not executed)."""
         block = self.program.blocks[block_idx]
-        ctx.vjp_wanted_types |= generic_grad_fwd_types(block)
+        ctx.vjp_wanted_types |= generic_grad_fwd_instances(block)
         for op in block.ops:
             self.run_op(op, env, ctx)
         return env
@@ -104,11 +105,12 @@ class BlockProgramBuilder:
                         f"with an earlier op"
                     )
             ins[slot] = vals
-        if op.type in ctx.vjp_wanted_types:
-            # a generically-derived <type>_grad follows in this block: run
-            # the forward under jax.vjp so the grad op reuses the residuals
-            # instead of replaying the forward (scan-based recurrences
-            # otherwise run twice — registry.forward_with_vjp)
+        if fwd_instance_key(op) in ctx.vjp_wanted_types:
+            # THIS instance's generically-derived <type>_grad follows in
+            # the block: run the forward under jax.vjp so the grad op
+            # reuses the residuals instead of replaying the forward
+            # (scan-based recurrences otherwise run twice —
+            # registry.forward_with_vjp)
             outs = forward_with_vjp(opdef, ctx, ins, op.attrs)
         else:
             outs = opdef.impl(ctx, ins, op.attrs)

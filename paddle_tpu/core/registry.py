@@ -401,22 +401,39 @@ def generic_grad_impl(fwd_type: str):
     return impl
 
 
-def generic_grad_fwd_types(block) -> set:
-    """Forward op types whose grads in ``block`` use the GENERIC
-    vjp-derived kernel (ops with hand-written grad kernels — flash
-    attention, the CE head — handle their own residuals and are excluded).
-    The executor routes these forwards through forward_with_vjp."""
+def fwd_instance_key(op) -> tuple:
+    """Identity of one forward op INSTANCE: type + its output var names.
+    The generic grad desc carries the forward's outputs as inputs under the
+    same slot names, so both sides can compute this key from the IR."""
+    opdef = _REGISTRY.get(op.type)
+    slots = opdef.output_slots if opdef is not None else sorted(op.outputs)
+    return (op.type,) + tuple(
+        tuple(op.outputs.get(s, ())) for s in slots)
+
+
+def generic_grad_fwd_instances(block) -> set:
+    """Keys (fwd_instance_key) of the forward op INSTANCES whose grads in
+    ``block`` use the GENERIC vjp-derived kernel (ops with hand-written
+    grad kernels — flash attention, the CE head — handle their own
+    residuals and are excluded). The executor routes exactly these
+    forwards through forward_with_vjp; same-type forwards off the grad
+    path (metric branches, inference heads) are not linearized and leave
+    nothing in the cache."""
     wanted = set()
     for op in block.ops:
         if not op.type.endswith("_grad"):
             continue
         fwd_type = op.type[: -len("_grad")]
-        if fwd_type not in _REGISTRY:
+        fwd_def = _REGISTRY.get(fwd_type)
+        if fwd_def is None:
             continue
         ensure_grad_op_registered(op.type)
         gdef = _REGISTRY.get(op.type)
-        if gdef is not None and getattr(gdef.impl, "_derived_generic", False):
-            wanted.add(fwd_type)
+        if gdef is None or not getattr(gdef.impl, "_derived_generic", False):
+            continue
+        # the grad op's inputs carry the forward's outputs slot-by-slot
+        wanted.add((fwd_type,) + tuple(
+            tuple(op.inputs.get(s, ())) for s in fwd_def.output_slots))
     return wanted
 
 

@@ -1,0 +1,59 @@
+"""Per-fusion HBM-traffic audit from a jax.profiler xplane.pb.
+
+The roofline instrument VERDICT r3 asked for: every HLO instruction's
+device self-time, measured memory bandwidth, FLOP rate, and bound_by
+verdict, bucketed by category — so "X is bandwidth-bound" is a table, not
+an assertion. Bytes moved per fusion = measured BW x self-time.
+Usage: python tools/hlo_audit.py <xplane.pb> [steps] [top_n]
+"""
+import json
+import sys
+
+
+def main(pb, steps=10, top_n=30):
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data([pb], "hlo_stats", {})
+    obj = json.loads(data) if isinstance(data, (str, bytes)) else data
+    table = obj[0] if isinstance(obj, list) else obj
+    cols = [c["id"] for c in table["cols"]]
+    rows = [[c["v"] for c in r["c"]] for r in table["rows"]]
+    ix = {c: cols.index(c) for c in (
+        "category", "hlo_op_name", "tf_op_name", "occurrences",
+        "total_self_time", "measured_memory_bw", "normalized_flop_rate",
+        "bound_by", "hlo_op_expression")}
+    total_us = sum(r[ix["total_self_time"]] for r in rows)
+    print(f"device busy {total_us/1e3:.1f} ms total / {steps} steps = "
+          f"{total_us/1e3/steps:.2f} ms/step")
+    # by category
+    cats = {}
+    for r in rows:
+        c = r[ix["category"]]
+        t = r[ix["total_self_time"]]
+        gb = r[ix["measured_memory_bw"]] * t / 1e9  # GB/s * us -> KB... see below
+        cats.setdefault(c, [0.0, 0.0])
+        cats[c][0] += t
+        cats[c][1] += gb
+    print("\n-- by category (per step) --")
+    print(f"{'ms':>8} {'%':>6} {'GB moved':>9}  category")
+    for c, (t, gb) in sorted(cats.items(), key=lambda kv: -kv[1][0]):
+        # measured_memory_bw is GB/s; t in us -> bytes = bw*1e9 * t*1e-6
+        print(f"{t/1e3/steps:8.3f} {t/total_us*100:6.1f} "
+              f"{gb*1e3/steps:9.3f}  {c}")
+    print(f"\n-- top {top_n} instructions (per step) --")
+    print(f"{'ms':>7} {'BW GB/s':>8} {'TF/s':>7} {'bound':>10}  op")
+    for r in sorted(rows, key=lambda r: -r[ix["total_self_time"]])[:top_n]:
+        t = r[ix["total_self_time"]] / steps / 1e3
+        bw = r[ix["measured_memory_bw"]]
+        fl = r[ix["normalized_flop_rate"]] / 1e3
+        name = str(r[ix["tf_op_name"]])[:46]
+        expr = str(r[ix["hlo_op_expression"]])
+        shape = expr.split(" = ")[1].split(" ")[0][:28] if " = " in expr else ""
+        print(f"{t:7.3f} {bw:8.1f} {fl:7.1f} {str(r[ix['bound_by']]):>10}  "
+              f"{r[ix['category']][:18]:18s} {shape:28s} {name}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1],
+         int(sys.argv[2]) if len(sys.argv) > 2 else 10,
+         int(sys.argv[3]) if len(sys.argv) > 3 else 30)
