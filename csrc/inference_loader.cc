@@ -1,17 +1,21 @@
-// Native inference-model loader: parse a saved model directory
+// Native inference runtime: load AND EXECUTE a saved model directory
 // (`__model__` JSON program + .npy parameter files) from C++.
 //
-// <- paddle/fluid/inference/io.{h,cc} (Load/LoadPersistables: read the
-// serialized program + its persistable tensors so a C++ deployment can run
-// without Python) and paddle/fluid/framework/{program_desc,op_desc}.h (IR
-// deserialization). The execution engine here is XLA rather than the
-// reference's C++ op kernels, so this library owns the deployment-side
-// *loading* contract: program structure (blocks/ops/vars, feed/fetch
-// targets) and parameter tensors, validated and exposed through a C API
-// (consumed by tests via ctypes and by the `demo_loader` main below, the
-// analogue of inference/tests/book/ loaders).
+// <- paddle/fluid/inference/io.{h,cc} (Load) + framework/executor.cc
+// (Executor::Run on the loaded ProgramDesc — the reference's C++ side runs
+// the program, see inference/tests/book/test_inference_recognize_digits.cc
+// and train/demo/demo_trainer.cc). The TPU compute path of this framework
+// is JAX/XLA from Python; this file is the DEPLOYMENT story: a
+// dependency-free C++ interpreter over the same serialized IR, covering
+// the inference op surface of the book models (fc = mul+add+act, conv2d,
+// pool2d, batch_norm(is_test), softmax, ...), CPU f32, exact op-for-op
+// program order — so a C++ server can load `save_inference_model` output
+// and serve it with zero Python. Exposed through a C API (ctypes tests +
+// the `demo_loader` main below).
 //
 // Self-contained: minimal JSON parser + .npy (v1/v2) reader, no deps.
+#include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -282,6 +286,16 @@ std::string url_quote(const std::string& s) {
   return out;
 }
 
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
 struct Model {
   JPtr meta;
   std::vector<std::string> feeds, fetches;
@@ -293,6 +307,13 @@ struct Model {
   size_t num_ops = 0, num_vars = 0, num_blocks = 0;
   std::string error;
   std::string scratch;  // returned c_str storage
+  // built on first ptinf_exec: params converted to f32 ONCE (read-only
+  // across runs); only the fetch tensors of the last run are retained
+  std::map<std::string, struct Tensor> param_cache;
+  bool param_cache_ready = false;
+  std::map<std::string, struct Tensor> fetch_results;
+  Model();
+  ~Model();
 };
 
 bool load_model(const std::string& dir, Model* m) {
@@ -384,6 +405,471 @@ bool load_model(const std::string& dir, Model* m) {
   return true;
 }
 
+// --- C++ executor over the loaded program (f32, block 0, op-for-op) -------
+using Env = std::map<std::string, Tensor>;
+
+double jnum(const JValue* op, const char* key, double dflt) {
+  const JValue* a = op->get("attrs");
+  if (!a) return dflt;
+  const JValue* v = a->get(key);
+  if (!v) return dflt;
+  if (v->kind == JValue::Num) return v->num;
+  if (v->kind == JValue::Bool) return v->b ? 1 : 0;
+  return dflt;
+}
+
+std::vector<int64_t> jints(const JValue* op, const char* key,
+                           std::vector<int64_t> dflt) {
+  const JValue* a = op->get("attrs");
+  if (!a) return dflt;
+  const JValue* v = a->get(key);
+  if (!v) return dflt;
+  if (v->kind == JValue::Num) return {(int64_t)v->num};
+  if (v->kind != JValue::Arr) return dflt;
+  std::vector<int64_t> out;
+  for (auto& e : v->arr) out.push_back((int64_t)e->num);
+  return out;
+}
+
+std::string in_name(const JValue* op, const char* slot, size_t i = 0) {
+  const JValue* ins = op->get("inputs");
+  if (!ins) return "";
+  const JValue* s = ins->get(slot);
+  if (!s || s->arr.size() <= i) return "";
+  return s->arr[i]->str;
+}
+
+std::string out_name(const JValue* op, const char* slot, size_t i = 0) {
+  const JValue* outs = op->get("outputs");
+  if (!outs) return "";
+  const JValue* s = outs->get(slot);
+  if (!s || s->arr.size() <= i) return "";
+  return s->arr[i]->str;
+}
+
+// reference elementwise broadcast: align y's dims to x starting at `axis`
+// (ops/math.py::_broadcast_y); then numpy-style trailing broadcast
+bool ew_binary(const Tensor& x, const Tensor& y, int axis, char kind,
+               Tensor* out, std::string* err) {
+  int xr = (int)x.shape.size(), yr = (int)y.shape.size();
+  if (axis < 0) axis = xr - yr;
+  if (axis < 0 || axis + yr > xr) {
+    *err = "elementwise: cannot align shapes";
+    return false;
+  }
+  std::vector<int64_t> ys(xr, 1);
+  for (int i = 0; i < yr; i++) ys[axis + i] = y.shape[i];
+  for (int i = 0; i < xr; i++) {
+    if (ys[i] != 1 && ys[i] != x.shape[i]) {
+      *err = "elementwise: incompatible broadcast dim";
+      return false;
+    }
+  }
+  out->shape = x.shape;
+  out->data.resize(x.numel());
+  std::vector<int64_t> xstr(xr, 1), ystr(xr, 1);
+  for (int i = xr - 2; i >= 0; i--) xstr[i] = xstr[i + 1] * x.shape[i + 1];
+  std::vector<int64_t> ycum(xr, 0);
+  int64_t s = 1;
+  for (int i = xr - 1; i >= 0; i--) {
+    ycum[i] = (ys[i] == 1) ? 0 : s;
+    s *= ys[i];
+  }
+  std::vector<int64_t> idx(xr, 0);
+  int64_t n = x.numel();
+  for (int64_t f = 0; f < n; f++) {
+    int64_t yoff = 0, rem = f;
+    for (int i = 0; i < xr; i++) {
+      int64_t c = rem / xstr[i];
+      rem -= c * xstr[i];
+      if (ycum[i]) yoff += c * ycum[i];
+    }
+    float a = x.data[f], b = y.data[yoff], r = 0;
+    switch (kind) {
+      case '+': r = a + b; break;
+      case '-': r = a - b; break;
+      case '*': r = a * b; break;
+      case '/': r = a / b; break;
+    }
+    out->data[f] = r;
+  }
+  return true;
+}
+
+struct Exec {
+  const Model* m;
+  Env env;
+  std::string error;
+
+  bool fail(const std::string& e) {
+    error = e;
+    return false;
+  }
+
+  Tensor* get(const std::string& name) {
+    auto it = env.find(name);
+    if (it != env.end()) return &it->second;
+    auto pit = const_cast<Model*>(m)->param_cache.find(name);
+    return pit == const_cast<Model*>(m)->param_cache.end() ? nullptr
+                                                           : &pit->second;
+  }
+
+  bool need(const JValue* op, const char* slot, Tensor** t) {
+    std::string n = in_name(op, slot);
+    if (n.empty()) return fail(std::string("missing input slot ") + slot);
+    *t = get(n);
+    if (!*t) return fail("no value for var '" + n + "'");
+    return true;
+  }
+
+  bool run_op(const JValue* op);
+  bool run(const std::vector<std::string>& fetches);
+};
+
+bool Exec::run_op(const JValue* op) {
+  const std::string type = op->get("type") ? op->get("type")->str : "";
+  if (type == "feed" || type == "fetch") return true;  // env-resolved
+  if (type == "mul" || type == "matmul") {
+    Tensor *x, *y;
+    if (!need(op, "X", &x) || !need(op, "Y", &y)) return false;
+    int xnc = (int)jnum(op, "x_num_col_dims", 1);
+    int ync = (int)jnum(op, "y_num_col_dims", 1);
+    bool tx = false, ty = false;
+    if (type == "matmul") {
+      tx = jnum(op, "transpose_X", 0) != 0;
+      ty = jnum(op, "transpose_Y", 0) != 0;
+      if (x->shape.size() != 2 || y->shape.size() != 2)
+        return fail("matmul: only rank-2 supported in native runtime");
+      xnc = 1;
+      ync = 1;
+    }
+    int64_t M = 1, K = 1, K2 = 1, N = 1;
+    for (int i = 0; i < xnc; i++) M *= x->shape[i];
+    for (size_t i = xnc; i < x->shape.size(); i++) K *= x->shape[i];
+    for (int i = 0; i < ync; i++) K2 *= y->shape[i];
+    for (size_t i = ync; i < y->shape.size(); i++) N *= y->shape[i];
+    if (tx) std::swap(M, K);
+    if (ty) std::swap(K2, N);
+    if (K != K2) return fail(type + ": contraction mismatch");
+    Tensor out;
+    if (type == "matmul") {
+      out.shape = {M, N};
+    } else {
+      out.shape.assign(x->shape.begin(), x->shape.begin() + xnc);
+      for (size_t i = ync; i < y->shape.size(); i++)
+        out.shape.push_back(y->shape[i]);
+    }
+    out.data.assign(M * N, 0.f);
+    const float* X = x->data.data();
+    const float* Y = y->data.data();
+    for (int64_t i = 0; i < M; i++)
+      for (int64_t k = 0; k < K; k++) {
+        float a = tx ? X[k * M + i] : X[i * K + k];
+        if (a == 0.f) continue;
+        float* o = &out.data[i * N];
+        const float* yr = ty ? nullptr : &Y[k * N];
+        if (!ty) {
+          for (int64_t j = 0; j < N; j++) o[j] += a * yr[j];
+        } else {
+          for (int64_t j = 0; j < N; j++) o[j] += a * Y[j * K + k];
+        }
+      }
+    if (type == "matmul") {
+      float alpha = (float)jnum(op, "alpha", 1.0);
+      if (alpha != 1.f)
+        for (auto& v : out.data) v *= alpha;
+    }
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "elementwise_add" || type == "elementwise_sub" ||
+      type == "elementwise_mul" || type == "elementwise_div") {
+    Tensor *x, *y;
+    if (!need(op, "X", &x) || !need(op, "Y", &y)) return false;
+    char k = type == "elementwise_add"   ? '+'
+             : type == "elementwise_sub" ? '-'
+             : type == "elementwise_mul" ? '*'
+                                         : '/';
+    Tensor out;
+    std::string err;
+    if (!ew_binary(*x, *y, (int)jnum(op, "axis", -1), k, &out, &err))
+      return fail(type + ": " + err);
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "relu" || type == "sigmoid" || type == "tanh" ||
+      type == "exp" || type == "sqrt" || type == "abs") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    Tensor out = *x;
+    for (auto& v : out.data) {
+      if (type == "relu") v = v > 0 ? v : 0;
+      else if (type == "sigmoid") v = 1.f / (1.f + std::exp(-v));
+      else if (type == "tanh") v = std::tanh(v);
+      else if (type == "exp") v = std::exp(v);
+      else if (type == "sqrt") v = std::sqrt(v);
+      else v = std::fabs(v);
+    }
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "softmax") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    int rank = (int)x->shape.size();
+    int axis = (int)jnum(op, "axis", -1);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= rank) return fail("softmax: bad axis");
+    Tensor out = *x;
+    int64_t A = x->shape[axis], inner = 1, outer = 1;
+    for (int i = axis + 1; i < rank; i++) inner *= x->shape[i];
+    for (int i = 0; i < axis; i++) outer *= x->shape[i];
+    for (int64_t o = 0; o < outer; o++)
+      for (int64_t in = 0; in < inner; in++) {
+        float* base = &out.data[o * A * inner + in];
+        float mx = base[0];
+        for (int64_t a = 1; a < A; a++)
+          mx = std::max(mx, base[a * inner]);
+        float s = 0;
+        for (int64_t a = 0; a < A; a++) {
+          float e = std::exp(base[a * inner] - mx);
+          base[a * inner] = e;
+          s += e;
+        }
+        for (int64_t a = 0; a < A; a++) base[a * inner] /= s;
+      }
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "scale") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    float sc = (float)jnum(op, "scale", 1.0);
+    float bias = (float)jnum(op, "bias", 0.0);
+    bool after = jnum(op, "bias_after_scale", 1) != 0;
+    Tensor out = *x;
+    for (auto& v : out.data)
+      v = after ? v * sc + bias : (v + bias) * sc;
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "reshape") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    auto want = jints(op, "shape", {});
+    Tensor out;
+    out.data = x->data;
+    int64_t known = 1, infer = -1;
+    for (size_t i = 0; i < want.size(); i++) {
+      int64_t d = want[i];
+      if (d == 0) d = x->shape[i];  // 0 = copy input dim (reference rule)
+      if (d == -1) {
+        infer = (int64_t)i;
+        out.shape.push_back(-1);
+        continue;
+      }
+      known *= d;
+      out.shape.push_back(d);
+    }
+    if (infer >= 0) out.shape[infer] = x->numel() / known;
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "batch_norm") {
+    Tensor *x, *scale, *bias, *mean, *var;
+    if (!need(op, "X", &x) || !need(op, "Scale", &scale) ||
+        !need(op, "Bias", &bias) || !need(op, "Mean", &mean) ||
+        !need(op, "Variance", &var))
+      return false;
+    float eps = (float)jnum(op, "epsilon", 1e-5);
+    // inference mode: normalize with the loaded running statistics
+    int64_t C = x->shape.size() > 1 ? x->shape[1] : x->shape[0];
+    int64_t spatial = 1;
+    for (size_t i = 2; i < x->shape.size(); i++) spatial *= x->shape[i];
+    int64_t Nb = x->shape.size() > 1 ? x->shape[0] : 1;
+    Tensor out = *x;
+    for (int64_t n = 0; n < Nb; n++)
+      for (int64_t c = 0; c < C; c++) {
+        float inv = 1.f / std::sqrt(var->data[c] + eps);
+        float a = scale->data[c] * inv;
+        float b = bias->data[c] - mean->data[c] * a;
+        float* p = &out.data[(n * C + c) * spatial];
+        for (int64_t s = 0; s < spatial; s++) p[s] = p[s] * a + b;
+      }
+    env[out_name(op, "Y")] = std::move(out);
+    return true;
+  }
+  if (type == "conv2d") {
+    Tensor *x, *w;
+    if (!need(op, "Input", &x) || !need(op, "Filter", &w)) return false;
+    auto strides = jints(op, "strides", {1, 1});
+    auto pads = jints(op, "paddings", {0, 0});
+    auto dil = jints(op, "dilations", {1, 1});
+    int64_t groups = (int64_t)jnum(op, "groups", 1);
+    if (groups < 1) groups = 1;
+    int64_t N = x->shape[0], C = x->shape[1], H = x->shape[2], W = x->shape[3];
+    int64_t O = w->shape[0], CI = w->shape[1], KH = w->shape[2], KW = w->shape[3];
+    if (C / groups != CI) return fail("conv2d: channel/group mismatch");
+    int64_t OH = (H + 2 * pads[0] - dil[0] * (KH - 1) - 1) / strides[0] + 1;
+    int64_t OW = (W + 2 * pads[1] - dil[1] * (KW - 1) - 1) / strides[1] + 1;
+    Tensor out;
+    out.shape = {N, O, OH, OW};
+    out.data.assign(N * O * OH * OW, 0.f);
+    int64_t opg = O / groups;
+    for (int64_t n = 0; n < N; n++)
+      for (int64_t o = 0; o < O; o++) {
+        int64_t g = o / opg;
+        for (int64_t ci = 0; ci < CI; ci++) {
+          int64_t c = g * CI + ci;
+          const float* xp = &x->data[(n * C + c) * H * W];
+          const float* wp = &w->data[(o * CI + ci) * KH * KW];
+          float* op_ = &out.data[(n * O + o) * OH * OW];
+          for (int64_t kh = 0; kh < KH; kh++)
+            for (int64_t kw = 0; kw < KW; kw++) {
+              float wv = wp[kh * KW + kw];
+              if (wv == 0.f) continue;
+              for (int64_t oh = 0; oh < OH; oh++) {
+                int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+                if (ih < 0 || ih >= H) continue;
+                for (int64_t ow = 0; ow < OW; ow++) {
+                  int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                  if (iw < 0 || iw >= W) continue;
+                  op_[oh * OW + ow] += wv * xp[ih * W + iw];
+                }
+              }
+            }
+        }
+      }
+    std::string bn = in_name(op, "Bias");
+    if (!bn.empty()) {
+      Tensor* b = get(bn);
+      if (!b) return fail("conv2d: bias var missing");
+      for (int64_t n = 0; n < N; n++)
+        for (int64_t o = 0; o < O; o++) {
+          float* op_ = &out.data[(n * O + o) * OH * OW];
+          for (int64_t i = 0; i < OH * OW; i++) op_[i] += b->data[o];
+        }
+    }
+    env[out_name(op, "Output")] = std::move(out);
+    return true;
+  }
+  if (type == "pool2d") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    std::string ptype = "max";
+    if (op->get("attrs") && op->get("attrs")->get("pooling_type"))
+      ptype = op->get("attrs")->get("pooling_type")->str;
+    auto ksize = jints(op, "ksize", {2, 2});
+    auto strides = jints(op, "strides", {1, 1});
+    auto pads = jints(op, "paddings", {0, 0});
+    bool exclusive = jnum(op, "exclusive", 1) != 0;
+    if (jnum(op, "adaptive", 0) != 0)
+      return fail("pool2d: adaptive pooling unsupported in native runtime");
+    int64_t N = x->shape[0], C = x->shape[1], H = x->shape[2], W = x->shape[3];
+    if (jnum(op, "global_pooling", 0) != 0) {
+      ksize = {H, W};
+      strides = {1, 1};
+      pads = {0, 0};
+    }
+    // ceil_mode: output size rounds UP (ops/nn.py _ceil_extra semantics —
+    // the window loop below already skips out-of-range taps, and exclusive
+    // averaging divides by the in-range count)
+    bool ceil_mode = jnum(op, "ceil_mode", 0) != 0;
+    auto osz = [&](int64_t sz, int64_t k, int64_t p, int64_t s) {
+      int64_t num = sz + 2 * p - k;
+      return (ceil_mode ? (num + s - 1) / s : num / s) + 1;
+    };
+    int64_t OH = osz(H, ksize[0], pads[0], strides[0]);
+    int64_t OW = osz(W, ksize[1], pads[1], strides[1]);
+    Tensor out;
+    out.shape = {N, C, OH, OW};
+    out.data.assign(N * C * OH * OW, 0.f);
+    for (int64_t n = 0; n < N; n++)
+      for (int64_t c = 0; c < C; c++) {
+        const float* xp = &x->data[(n * C + c) * H * W];
+        float* op_ = &out.data[(n * C + c) * OH * OW];
+        for (int64_t oh = 0; oh < OH; oh++)
+          for (int64_t ow = 0; ow < OW; ow++) {
+            float acc = ptype == "max" ? -3.4e38f : 0.f;
+            int64_t cnt = 0;
+            for (int64_t kh = 0; kh < ksize[0]; kh++)
+              for (int64_t kw = 0; kw < ksize[1]; kw++) {
+                int64_t ih = oh * strides[0] - pads[0] + kh;
+                int64_t iw = ow * strides[1] - pads[1] + kw;
+                if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+                float v = xp[ih * W + iw];
+                if (ptype == "max") acc = std::max(acc, v);
+                else acc += v;
+                cnt++;
+              }
+            if (ptype != "max")
+              acc /= exclusive ? std::max<int64_t>(cnt, 1)
+                               : ksize[0] * ksize[1];
+            op_[oh * OW + ow] = acc;
+          }
+      }
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "dropout") {
+    // inference semantics mirror ops/nn.py dropout is_test:
+    // downgrade_in_infer (default) scales by (1-p); upscale_in_train is
+    // identity at inference
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    std::string mode = "downgrade_in_infer";
+    if (op->get("attrs") && op->get("attrs")->get("dropout_implementation"))
+      mode = op->get("attrs")->get("dropout_implementation")->str;
+    float p = (float)jnum(op, "dropout_prob", 0.5);
+    Tensor out = *x;
+    if (mode != "upscale_in_train" && p != 0.f)
+      for (auto& v : out.data) v *= (1.f - p);
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "cast") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    env[out_name(op, "Out")] = *x;  // f32-only runtime
+    return true;
+  }
+  return fail("native runtime: unsupported op '" + type +
+              "' (the C++ interpreter covers the inference op surface of "
+              "the book models; extend csrc/inference_loader.cc)");
+}
+
+bool Exec::run(const std::vector<std::string>& fetches) {
+  const JValue* blocks = m->meta->get("program")->get("blocks");
+  const JValue* ops = blocks->arr[0]->get("ops");
+  if (!ops) return fail("block 0 has no ops");
+  for (auto& op : ops->arr)
+    if (!run_op(op.get())) return false;
+  for (auto& f : fetches)
+    if (!get(f)) return fail("fetch var '" + f + "' was not produced");
+  return true;
+}
+
+Model::Model() = default;
+Model::~Model() = default;
+
+bool param_to_tensor(const Model::Param& p, Tensor* t, std::string* err) {
+  t->shape = p.tensor.shape;
+  int64_t n = t->numel();
+  t->data.resize(n);
+  const std::string& dt = p.tensor.dtype;
+  if (dt == "<f4" || dt == "|f4" || dt == "=f4") {
+    memcpy(t->data.data(), p.tensor.data.data(), n * 4);
+  } else if (dt == "<f8") {
+    const double* s = (const double*)p.tensor.data.data();
+    for (int64_t i = 0; i < n; i++) t->data[i] = (float)s[i];
+  } else {
+    *err = "param '" + p.name + "': dtype " + dt +
+           " unsupported by the f32 native runtime";
+    return false;
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -460,6 +946,74 @@ const uint8_t* ptinf_param_data(void* h, uint64_t i, uint64_t* nbytes) {
 
 void ptinf_close(void* h) { delete static_cast<Model*>(h); }
 
+// --- execution C API -------------------------------------------------------
+// ptinf_exec: run block 0 of the loaded program over the given f32 feeds;
+// fetch results via ptinf_fetch_*. Returns 1 on success (0: ptinf_error).
+int ptinf_exec(void* h, const char** feed_names, const float** feed_data,
+               const int64_t** feed_shapes, const int* feed_ndims,
+               int n_feeds) {
+  auto* m = static_cast<Model*>(h);
+  if (!m->param_cache_ready) {
+    // convert weights to f32 ONCE; every exec reads them in place
+    for (auto& p : m->params) {
+      Tensor t;
+      std::string err;
+      if (!param_to_tensor(p, &t, &err)) {
+        m->error = err;
+        return 0;
+      }
+      m->param_cache[p.name] = std::move(t);
+    }
+    m->param_cache_ready = true;
+  }
+  Exec ex;
+  ex.m = m;
+  for (int i = 0; i < n_feeds; i++) {
+    Tensor t;
+    t.shape.assign(feed_shapes[i], feed_shapes[i] + feed_ndims[i]);
+    t.data.assign(feed_data[i], feed_data[i] + t.numel());
+    ex.env[feed_names[i]] = std::move(t);
+  }
+  if (!ex.run(m->fetches)) {
+    m->error = ex.error;
+    return 0;
+  }
+  m->error.clear();
+  m->fetch_results.clear();
+  for (auto& f : m->fetches) {
+    auto it = ex.env.find(f);
+    if (it != ex.env.end()) {
+      m->fetch_results[f] = std::move(it->second);
+    } else {
+      m->fetch_results[f] = *ex.get(f);  // param-aliased fetch: copy
+    }
+  }
+  return 1;
+}
+
+static Tensor* fetch_tensor(Model* m, uint64_t i) {
+  if (i >= m->fetches.size()) return nullptr;
+  auto it = m->fetch_results.find(m->fetches[i]);
+  return it == m->fetch_results.end() ? nullptr : &it->second;
+}
+
+const float* ptinf_fetch_data(void* h, uint64_t i, uint64_t* numel) {
+  Tensor* t = fetch_tensor(static_cast<Model*>(h), i);
+  *numel = t ? (uint64_t)t->numel() : 0;
+  return t ? t->data.data() : nullptr;
+}
+
+int ptinf_fetch_ndim(void* h, uint64_t i) {
+  Tensor* t = fetch_tensor(static_cast<Model*>(h), i);
+  return t ? (int)t->shape.size() : -1;
+}
+
+int64_t ptinf_fetch_dim(void* h, uint64_t i, int d) {
+  Tensor* t = fetch_tensor(static_cast<Model*>(h), i);
+  if (!t || d >= (int)t->shape.size()) return -1;
+  return t->shape[d];
+}
+
 }  // extern "C"
 
 // --- demo main (<- paddle/fluid/inference demo / tests/book loaders) -------
@@ -485,6 +1039,66 @@ int main(int argc, char** argv) {
     printf("param %s dtype=%s ndim=%d bytes=%llu\n", ptinf_param_name(h, i),
            ptinf_param_dtype(h, i), ptinf_param_ndim(h, i),
            (unsigned long long)nbytes);
+  }
+  if (argc > 2 && !strcmp(argv[2], "--run")) {
+    // EXECUTE: feed ones shaped from the program's var metadata (batch
+    // dim -1 -> --run's batch arg, default 2) and print each fetch —
+    // the C++ analogue of inference/tests/book loaders actually running
+    // the model.
+    int64_t batch = argc > 3 ? atoll(argv[3]) : 2;
+    auto* m = static_cast<Model*>(h);
+    const JValue* blocks = m->meta->get("program")->get("blocks");
+    std::vector<std::string> names;
+    std::vector<std::vector<float>> datas;
+    std::vector<std::vector<int64_t>> shapes;
+    for (auto& fname : m->feeds) {
+      std::vector<int64_t> shp;
+      for (auto& blk : blocks->arr) {
+        if (!shp.empty()) break;  // first declaration wins
+        const JValue* vars = blk->get("vars");
+        if (!vars) continue;
+        for (auto& var : vars->arr) {
+          const JValue* nm = var->get("name");
+          if (!nm || nm->str != fname) continue;
+          const JValue* sh = var->get("shape");
+          if (sh)
+            for (auto& d : sh->arr)
+              shp.push_back(d->num < 0 ? batch : (int64_t)d->num);
+          break;
+        }
+      }
+      if (shp.empty()) shp = {batch};
+      int64_t n = 1;
+      for (auto d : shp) n *= d;
+      names.push_back(fname);
+      shapes.push_back(shp);
+      datas.emplace_back(n, 1.0f);
+    }
+    std::vector<const char*> cn;
+    std::vector<const float*> cd;
+    std::vector<const int64_t*> cs;
+    std::vector<int> cnd;
+    for (size_t i = 0; i < names.size(); i++) {
+      cn.push_back(names[i].c_str());
+      cd.push_back(datas[i].data());
+      cs.push_back(shapes[i].data());
+      cnd.push_back((int)shapes[i].size());
+    }
+    if (!ptinf_exec(h, cn.data(), cd.data(), cs.data(), cnd.data(),
+                    (int)cn.size())) {
+      fprintf(stderr, "exec failed: %s\n", ptinf_error(h));
+      ptinf_close(h);
+      return 1;
+    }
+    for (uint64_t i = 0; i < m->fetches.size(); i++) {
+      uint64_t numel;
+      const float* p = ptinf_fetch_data(h, i, &numel);
+      double sum = 0;
+      for (uint64_t j = 0; j < numel; j++) sum += p[j];
+      printf("fetch %s numel=%llu sum=%.6f first=%.6f\n",
+             m->fetches[i].c_str(), (unsigned long long)numel, sum,
+             numel ? p[0] : 0.0f);
+    }
   }
   ptinf_close(h);
   return 0;

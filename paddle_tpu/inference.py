@@ -57,6 +57,21 @@ def _lib():
             lib.ptinf_param_data.restype = ctypes.POINTER(ctypes.c_uint8)
             lib.ptinf_param_data.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                              ctypes.POINTER(ctypes.c_uint64)]
+            lib.ptinf_exec.restype = ctypes.c_int
+            lib.ptinf_exec.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+            lib.ptinf_fetch_data.restype = ctypes.POINTER(ctypes.c_float)
+            lib.ptinf_fetch_data.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                             ctypes.POINTER(ctypes.c_uint64)]
+            lib.ptinf_fetch_ndim.restype = ctypes.c_int
+            lib.ptinf_fetch_ndim.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.ptinf_fetch_dim.restype = ctypes.c_int64
+            lib.ptinf_fetch_dim.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                            ctypes.c_int]
             lib.ptinf_close.argtypes = [ctypes.c_void_p]
             _LIB = lib
         return _LIB
@@ -111,6 +126,41 @@ class NativeModelLoader:
             view = np.ctypeslib.as_array(ptr, shape=(nbytes.value,))
             out[name] = view.view(dtype).reshape(shape).copy()
         return out
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """EXECUTE the loaded program in the C++ runtime (f32 interpreter
+        over block 0 — the reference's C++ Executor::Run role,
+        inference/io.h:30). Returns one array per fetch target."""
+        names = list(feed)
+        arrs = [np.ascontiguousarray(np.asarray(feed[n], dtype=np.float32))
+                for n in names]
+        c_names = (ctypes.c_char_p * len(names))(
+            *[n.encode() for n in names])
+        c_data = (ctypes.POINTER(ctypes.c_float) * len(names))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrs])
+        shapes = [np.asarray(a.shape, dtype=np.int64) for a in arrs]
+        c_shapes = (ctypes.POINTER(ctypes.c_int64) * len(names))(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+              for s in shapes])
+        c_ndims = (ctypes.c_int * len(names))(*[a.ndim for a in arrs])
+        ok = self._lib.ptinf_exec(self._h, c_names, c_data, c_shapes,
+                                  c_ndims, len(names))
+        if not ok:
+            raise RuntimeError(
+                "native execution failed: "
+                + self._lib.ptinf_error(self._h).decode())
+        outs = []
+        for i in range(len(self.fetch_names)):
+            numel = ctypes.c_uint64(0)
+            ptr = self._lib.ptinf_fetch_data(self._h, i,
+                                             ctypes.byref(numel))
+            ndim = self._lib.ptinf_fetch_ndim(self._h, i)
+            shape = tuple(self._lib.ptinf_fetch_dim(self._h, i, d)
+                          for d in range(ndim))
+            view = np.ctypeslib.as_array(ptr, shape=(numel.value,))
+            outs.append(view.reshape(shape).copy())
+        return outs
 
     def close(self):
         if getattr(self, "_h", None):
