@@ -475,7 +475,6 @@ bool ew_binary(const Tensor& x, const Tensor& y, int axis, char kind,
     ycum[i] = (ys[i] == 1) ? 0 : s;
     s *= ys[i];
   }
-  std::vector<int64_t> idx(xr, 0);
   int64_t n = x.numel();
   for (int64_t f = 0; f < n; f++) {
     int64_t yoff = 0, rem = f;
@@ -662,8 +661,13 @@ bool Exec::run_op(const JValue* op) {
     int64_t known = 1, infer = -1;
     for (size_t i = 0; i < want.size(); i++) {
       int64_t d = want[i];
-      if (d == 0) d = x->shape[i];  // 0 = copy input dim (reference rule)
+      if (d == 0) {  // 0 = copy input dim (reference rule)
+        if (i >= x->shape.size())
+          return fail("reshape: 0-dim index beyond input rank");
+        d = x->shape[i];
+      }
       if (d == -1) {
+        if (infer >= 0) return fail("reshape: more than one -1 dim");
         infer = (int64_t)i;
         out.shape.push_back(-1);
         continue;
@@ -671,7 +675,13 @@ bool Exec::run_op(const JValue* op) {
       known *= d;
       out.shape.push_back(d);
     }
-    if (infer >= 0) out.shape[infer] = x->numel() / known;
+    if (infer >= 0) {
+      if (known == 0 || x->numel() % known)
+        return fail("reshape: cannot infer -1 dim");
+      out.shape[infer] = x->numel() / known;
+    }
+    if (out.numel() != x->numel())
+      return fail("reshape: target numel mismatch");
     env[out_name(op, "Out")] = std::move(out);
     return true;
   }

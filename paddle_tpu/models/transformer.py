@@ -170,3 +170,70 @@ def transformer_encoder(x, n_layers: int, d_model: int, n_heads: int,
                           name=f"{name}.l{i}", tp_shard=tp_shard,
                           use_recompute=use_recompute)
     return layers.layer_norm(x, begin_norm_axis=2)
+
+
+def transformer_1f1b_train_step(params, ids, labels, mesh, n_heads: int,
+                                microbatches: int = 8, axis: str = "pp",
+                                amp: bool = False):
+    """One 1F1B-pipelined LM training step: (mean_loss, grads pytree).
+
+    The O(S)-residency training path for the pipelined transformer: the
+    stage math is ops/pipelined_stack._decoder_layer — the SAME function
+    the pipelined_transformer_stack op runs — and ``params`` uses the op's
+    stacked layout, so checkpoints interoperate:
+
+      params = {"emb": [V, D], "pos": [1, Tmax, D],
+                "stack": {ln1s/ln1b/wq/wk/wv/wo/ln2s/ln2b/wup/bup/
+                          wdown/bdown: [S, L, ...]},
+                "ln_s": [D], "ln_b": [D], "out_w": [D, V], "out_b": [V]}
+
+    Embedding runs before the pipeline (its grads chain through the
+    engine's dx); the final LN + LM head run inside the engine's
+    ``loss_grad_fn`` on the last stage, at the tick each microbatch exits —
+    that interleaving is what bounds activation residency at O(S) instead
+    of GPipe's O(M) (parallel/pipeline.py::one_f_one_b, which explains why
+    the IR op keeps GPipe: IR autodiff splits fwd/grad ops and cannot
+    interleave F with B)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pipelined_stack import _decoder_layer, _ln
+    from ..parallel.pipeline import one_f_one_b
+
+    t = ids.shape[1]
+
+    def stage_fn(w, x_mb):
+        out = x_mb
+        n_layers = w["wq"].shape[0]
+        for l in range(n_layers):
+            p_l = {k: v[l] for k, v in w.items()}
+            out = _decoder_layer(p_l, out, n_heads, True, amp)
+        return out
+
+    def head_loss(hp, y_mb, lbl_mb):
+        xn = _ln(y_mb.astype(jnp.float32), hp["ln_s"], hp["ln_b"])
+        logits = xn @ hp["out_w"] + hp["out_b"]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lbl_mb[..., None],
+                                     axis=-1)[..., 0]
+        return jnp.mean(lse - picked)
+
+    def loss_grad_fn(hp, y_mb, lbl_mb):
+        (loss, (dhp, dy)) = jax.value_and_grad(
+            head_loss, argnums=(0, 1))(hp, y_mb, lbl_mb)
+        return loss, dy, dhp
+
+    head_params = {"ln_s": params["ln_s"], "ln_b": params["ln_b"],
+                   "out_w": params["out_w"], "out_b": params["out_b"]}
+
+    def embed(ep, ids):
+        return ep["emb"][ids] + ep["pos"][:, :t]
+
+    emb_params = {"emb": params["emb"], "pos": params["pos"]}
+    x, emb_vjp = jax.vjp(embed, emb_params, ids)
+    loss, d_stack, d_head, dx = one_f_one_b(
+        stage_fn, loss_grad_fn, params["stack"], head_params, x, labels,
+        mesh, axis=axis, microbatches=microbatches)
+    d_emb, _ = emb_vjp(dx.astype(x.dtype))
+    grads = {"stack": d_stack, **d_head, **d_emb}
+    return loss, grads

@@ -20,17 +20,17 @@ transposes to the reverse rotation and the scan transposes to a reverse
 scan, so backward microbatches drain in the opposite direction — exactly
 GPipe's backward pass.
 
-Memory (documented in lieu of a 1F1B scheduler): reverse-mode over the
-scan keeps, per tick, the carry activation plus ``fn``'s internal
-residuals — O((M+S-1) * (mb activation + fn residuals)) per device. With
-``remat=True`` each tick's ``fn`` is ``jax.checkpoint``-ed, cutting the
-per-tick cost to the carry alone: peak activation residency is then the
-textbook GPipe O(M) microbatch buffer. A true 1F1B schedule would bound
-residency at O(S) by interleaving forward and backward ticks, but that
-requires a hand-scheduled backward (custom_vjp over the whole pipeline)
-that no longer composes with ``jax.grad`` of the surrounding program; the
-remat knob plus GPipe residency is the deliberate trade until a 1F1B
-custom_vjp is worth that loss of composability.
+Memory: reverse-mode over the ``gpipe`` scan keeps, per tick, the carry
+activation plus ``fn``'s internal residuals — O((M+S-1) * (mb activation
++ fn residuals)) per device. With ``remat=True`` each tick's ``fn`` is
+``jax.checkpoint``-ed, cutting the per-tick cost to the carry alone: peak
+activation residency is then the textbook GPipe O(M) microbatch buffer.
+``one_f_one_b`` below is the true 1F1B schedule bounding residency at
+O(S): it interleaves forward and backward microbatches in ONE loop, which
+is only possible when the engine owns the loss and gradients (see its
+docstring for why a custom_vjp cannot do this). Rule of thumb: embed a
+pipeline inside a larger differentiated program -> ``gpipe``; own the
+whole training step and care about M >> S memory -> ``one_f_one_b``.
 
 Restrictions (deliberate, minimal-but-real):
   * stages are structurally homogeneous (same ``fn``, different weights) —
@@ -114,3 +114,137 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
         local, mesh=mesh,
         in_specs=(pspec, xspec), out_specs=xspec, check_vma=False)
     return fn_sharded(stage_params, x)
+
+
+def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
+                mesh: Mesh, axis: str = "pp", microbatches: int = 4,
+                batch_axes: tuple = ("dp",), param_specs: Any = None):
+    """1F1B pipeline TRAINING step: loss + grads in ONE interleaved schedule.
+
+    Why this is a separate engine and not a grad rule on ``gpipe``: inside
+    a jitted program the backward only starts after the whole forward (the
+    loss is a global barrier), so any fwd/bwd-split formulation — including
+    a custom_vjp — must stash one activation per microbatch: O(M) per
+    device, GPipe's residency. True 1F1B interleaves forward and backward
+    microbatches in one loop, which means the engine must OWN the loss and
+    the gradients. This function is that loop; ``gpipe`` remains the
+    composable fallback for pipelines embedded in larger differentiated
+    programs (the pipelined_transformer_stack op uses it for exactly that
+    reason — IR autodiff splits fwd/grad ops).
+
+    Schedule (S stages, M microbatches, one F slot + one B slot per tick):
+      F(s, m) at tick s + m;  B(s, m) at tick 2(S-1) - s + m
+    so device s holds at most 2(S-1-s)+1 stashed stage INPUTS — O(S),
+    independent of M (GPipe-with-remat saves O(M+S) per-tick carries).
+    Total ticks: 2(S-1) + M. Backward recomputes each stage forward from
+    the stashed input via ``jax.vjp`` (the same replay remat pays).
+
+    stage_fn(w_stage, x_mb) -> y_mb                     (shape-preserving)
+    loss_grad_fn(head_params, y_mb, label_mb)
+        -> (loss_mb_scalar, dy_mb, dhead_mb)            (caller builds it
+        with jax.value_and_grad over the head+loss; it runs ONLY on the
+        last stage, at the tick its microbatch exits the stack)
+    Returns (mean_loss, stage_param_grads, head_param_grads, dx).
+    """
+    n_stages = mesh.shape[axis]
+    data_axes = tuple(a for a in batch_axes
+                      if a in mesh.axis_names and a != axis)
+    dp_total = 1
+    for a in data_axes:
+        dp_total *= mesh.shape[a]
+    batch = x.shape[0]
+    if batch % (microbatches * dp_total):
+        raise ValueError(f"batch {batch} not divisible by microbatches "
+                         f"{microbatches} x data shards {dp_total}")
+    mb = batch // dp_total // microbatches
+    M = microbatches
+    S = n_stages
+    stash_len = 2 * S  # >= max in-flight 2(S-1)+1
+
+    def local(params, head_p, x, labels):
+        w = jax.tree.map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        local_batch = x.shape[0]
+        xs = x.reshape((M, mb) + x.shape[1:])
+        lbls = labels.reshape((M, mb) + labels.shape[1:])
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+        ticks = 2 * (S - 1) + M
+
+        def tick(carry, t):
+            (act_in, grad_in, stash, dw, dhead, loss_sum) = carry
+            # ---- F phase -------------------------------------------------
+            mf = t - stage                       # this device's F microbatch
+            f_valid = (mf >= 0) & (mf < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            inject = lax.dynamic_index_in_dim(xs, mf_c, 0, keepdims=False)
+            x_in = jnp.where(stage == 0, inject, act_in)
+            y = stage_fn(w, x_in)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, x_in, mf_c % stash_len, 0)
+            # last stage: head loss + dy for the microbatch that just exited
+            loss_mb, dy, dh = loss_grad_fn(
+                head_p, y, lax.dynamic_index_in_dim(lbls, mf_c, 0,
+                                                    keepdims=False))
+            is_last = stage == S - 1
+            fmask = f_valid & is_last
+            loss_sum = loss_sum + jnp.where(fmask, loss_mb, 0.0)
+            dhead = jax.tree.map(
+                lambda a, g: a + jnp.where(fmask, g, jnp.zeros_like(g)),
+                dhead, dh)
+            # ---- B phase -------------------------------------------------
+            mbk = t - 2 * (S - 1) + stage        # this device's B microbatch
+            b_valid = (mbk >= 0) & (mbk < M)
+            mb_c = jnp.clip(mbk, 0, M - 1)
+            g_in = jnp.where(is_last, dy, grad_in)
+            x_saved = lax.dynamic_index_in_dim(stash, mb_c % stash_len, 0,
+                                               keepdims=False)
+            _, vjp = jax.vjp(stage_fn, w, x_saved)
+            dw_mb, dx_mb = vjp(g_in)
+            dw = jax.tree.map(
+                lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+                dw, dw_mb)
+            emit_dx = jnp.where((stage == 0) & b_valid, dx_mb,
+                                jnp.zeros_like(dx_mb))
+            # ---- rotate --------------------------------------------------
+            act_out = lax.ppermute(y, axis, fwd_perm)
+            grad_out = lax.ppermute(dx_mb, axis, bwd_perm)
+            return ((act_out, grad_out, stash, dw, dhead, loss_sum),
+                    emit_dx)
+
+        zeros_mb = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        stash0 = jnp.zeros((stash_len, mb) + x.shape[1:], x.dtype)
+        dw0 = jax.tree.map(jnp.zeros_like, w)
+        dhead0 = jax.tree.map(jnp.zeros_like, head_p)
+        carry0 = (zeros_mb, zeros_mb, stash0, dw0, dhead0,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, dw, dhead, loss_sum), emits = lax.scan(
+            tick, carry0, jnp.arange(ticks))
+        # B(0, m) lands at tick 2(S-1)+m; emits are zero elsewhere. psum
+        # replicates device 0's dx rows (and sums the per-stage zero rows)
+        dx_rows = lax.psum(emits[2 * (S - 1):], axis)
+        # every grad is scaled so the outputs are d(mean loss)/d(...): the
+        # per-microbatch seeds were d loss_mb/dy, and loss = mean_m loss_mb
+        # (pmean'd over dp below; each shard's dx carries the 1/dp factor
+        # of the global mean)
+        dx = dx_rows.reshape((local_batch,) + x.shape[1:]) / (M * dp_total)
+        # stage grads live per device (their stage); re-stack [1, ...]
+        dw = jax.tree.map(lambda g: g[None] / M, dw)
+        # head grads + loss were accumulated on the last stage only; share
+        dhead = jax.tree.map(lambda g: lax.psum(g, axis) / M, dhead)
+        loss = lax.psum(loss_sum, axis) / M
+        if data_axes:
+            loss = lax.pmean(loss, data_axes)
+            dhead = jax.tree.map(lambda g: lax.pmean(g, data_axes), dhead)
+            dw = jax.tree.map(lambda g: lax.pmean(g, data_axes), dw)
+        return loss, dw, dhead, dx
+
+    pspec = (param_specs if param_specs is not None
+             else jax.tree.map(lambda _: P(axis), stage_params))
+    xspec = P(data_axes if data_axes else None)
+    hspec = jax.tree.map(lambda _: P(), head_params)
+    fn_sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(pspec, hspec, xspec, xspec),
+        out_specs=(P(), pspec, hspec, xspec), check_vma=False)
+    return fn_sharded(stage_params, head_params, x, labels)

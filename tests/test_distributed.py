@@ -1042,3 +1042,244 @@ def test_reshard_grows_ctr_table(tmp_path):
     assert losses[-1] < losses[0], losses
     emb2 = scope2.get("ctr_embedding")
     assert not emb2.sharding.is_fully_replicated
+
+
+def _mlp_stage(w, x):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(x @ w["a"] + w["ba"])
+    return x + h @ w["d"]
+
+
+def _mlp_head(hp, y, lbl):
+    import jax
+    import jax.numpy as jnp
+
+    logits = y @ hp["w"] + hp["b"]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, lbl[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
+
+
+def _mk_1f1b_case(S=4, dm=8, dh=16, V=11, B=8):
+    rng = np.random.RandomState(4)
+    stage_params = {
+        "a": rng.randn(S, dm, dh).astype("float32") * 0.3,
+        "ba": np.zeros((S, dh), "float32"),
+        "d": rng.randn(S, dh, dm).astype("float32") * 0.3,
+    }
+    head = {"w": rng.randn(dm, V).astype("float32") * 0.3,
+            "b": np.zeros((V,), "float32")}
+    x = rng.randn(B, 3, dm).astype("float32")
+    lbl = rng.randint(0, V, (B, 3)).astype("int32")
+    return stage_params, head, x, lbl
+
+
+@pytest.mark.slow
+def test_one_f_one_b_matches_gpipe_grads():
+    """VERDICT r3 item 5: the 1F1B engine's loss AND every grad match
+    jax.grad through the GPipe schedule (same stage fn, same head) — the
+    interleaved hand-scheduled backward is numerically the pipeline
+    backward."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.pipeline import gpipe, one_f_one_b
+
+    S, M = 4, 8
+    stage_params, head, x, lbl = _mk_1f1b_case(S=S)
+    mesh = make_mesh({"pp": S}, devices=jax.devices("cpu")[:S])
+
+    def loss_grad_fn(hp, y_mb, lbl_mb):
+        loss, (dhp, dy) = jax.value_and_grad(
+            _mlp_head, argnums=(0, 1))(hp, y_mb, lbl_mb)
+        return loss, dy, dhp
+
+    loss, d_stack, d_head, dx = one_f_one_b(
+        _mlp_stage, loss_grad_fn, stage_params, head, x, lbl, mesh,
+        microbatches=M)
+
+    # oracle: mean over microbatches of the head loss on gpipe's output
+    def ref_loss(sp, hp, x):
+        y = gpipe(_mlp_stage, sp, x, mesh, microbatches=M)
+        return _mlp_head(hp, y, lbl)
+
+    ref, (g_sp, g_hp, g_x) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(stage_params, head, x)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for k in d_stack:
+        np.testing.assert_allclose(np.asarray(d_stack[k]),
+                                   np.asarray(g_sp[k]), rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+    for k in d_head:
+        np.testing.assert_allclose(np.asarray(d_head[k]),
+                                   np.asarray(g_hp[k]), rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(g_x),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_one_f_one_b_dp_composition():
+    """dp x pp: per-shard batches, grads match the single-mesh oracle."""
+    import jax
+
+    from paddle_tpu.parallel.pipeline import gpipe, one_f_one_b
+
+    S, M = 2, 4
+    stage_params, head, x, lbl = _mk_1f1b_case(S=S, B=8)
+    mesh = make_mesh({"dp": 2, "pp": S}, devices=jax.devices("cpu")[:4])
+
+    def loss_grad_fn(hp, y_mb, lbl_mb):
+        loss, (dhp, dy) = jax.value_and_grad(
+            _mlp_head, argnums=(0, 1))(hp, y_mb, lbl_mb)
+        return loss, dy, dhp
+
+    loss, d_stack, d_head, dx = one_f_one_b(
+        _mlp_stage, loss_grad_fn, stage_params, head, x, lbl, mesh,
+        microbatches=M)
+
+    import jax.numpy as jnp
+
+    def ref_loss(sp, hp, x):
+        y = gpipe(_mlp_stage, sp, x, mesh, microbatches=M)
+        return _mlp_head(hp, y, lbl)
+
+    ref, (g_sp, g_hp, g_x) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(stage_params, head, x)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for k in d_stack:
+        np.testing.assert_allclose(np.asarray(d_stack[k]),
+                                   np.asarray(g_sp[k]), rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+    for k in d_head:
+        np.testing.assert_allclose(np.asarray(d_head[k]),
+                                   np.asarray(g_hp[k]), rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(g_x),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_one_f_one_b_memory_envelope():
+    """The point of 1F1B: peak temp memory stays O(S) as microbatches grow,
+    while GPipe-remat's grows O(M). Measured with XLA memory_analysis on
+    the virtual mesh (the ring-attention envelope methodology)."""
+    import jax
+
+    from paddle_tpu.parallel.pipeline import gpipe, one_f_one_b
+
+    S = 4
+    dm, dh = 64, 256
+    rng = np.random.RandomState(0)
+    mesh = make_mesh({"pp": S}, devices=jax.devices("cpu")[:S])
+    head = {"w": rng.randn(dm, 17).astype("float32"),
+            "b": np.zeros((17,), "float32")}
+
+    def loss_grad_fn(hp, y_mb, lbl_mb):
+        loss, (dhp, dy) = jax.value_and_grad(
+            _mlp_head, argnums=(0, 1))(hp, y_mb, lbl_mb)
+        return loss, dy, dhp
+
+    def temp_bytes(M, engine):
+        sp = {"a": rng.randn(S, dm, dh).astype("float32"),
+              "ba": np.zeros((S, dh), "float32"),
+              "d": rng.randn(S, dh, dm).astype("float32")}
+        B = M * 4
+        x = rng.randn(B, 8, dm).astype("float32")
+        lbl = rng.randint(0, 17, (B, 8)).astype("int32")
+        if engine == "1f1b":
+            fn = lambda sp, hp, x: one_f_one_b(
+                _mlp_stage, loss_grad_fn, sp, hp, x, lbl, mesh,
+                microbatches=M)[0]
+            lowered = jax.jit(fn).lower(sp, head, x)
+        else:
+            def loss(sp, hp, x):
+                y = gpipe(_mlp_stage, sp, x, mesh, microbatches=M,
+                          remat=True)
+                return _mlp_head(hp, y, lbl)
+            lowered = jax.jit(jax.value_and_grad(loss, argnums=(0,))).lower(
+                sp, head, x)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    g8, g32 = temp_bytes(8, "gpipe"), temp_bytes(32, "gpipe")
+    f8, f32 = temp_bytes(8, "1f1b"), temp_bytes(32, "1f1b")
+    # growing M 4x: gpipe's temp grows ~linearly; 1f1b's stays near-flat
+    # (the batch itself grows with M here, so allow its linear term)
+    assert f32 < g32, (f8, f32, g8, g32)
+    gpipe_growth = g32 / max(g8, 1)
+    f1b_growth = f32 / max(f8, 1)
+    assert f1b_growth < gpipe_growth, (f8, f32, g8, g32)
+
+
+@pytest.mark.slow
+def test_transformer_1f1b_matches_sequential():
+    """Model-level wiring: transformer_1f1b_train_step (op-layout params,
+    _decoder_layer stage math) matches jax.value_and_grad of the same
+    model run sequentially on one device."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (_pos_encoding_table,
+                                               transformer_1f1b_train_step)
+    from paddle_tpu.ops.pipelined_stack import _decoder_layer
+
+    S, L, D, H, V, T, B, M = 2, 1, 16, 2, 23, 6, 8, 4
+    rng = np.random.RandomState(8)
+    sp = {
+        "ln1s": np.ones((S, L, D), "float32"),
+        "ln1b": np.zeros((S, L, D), "float32"),
+        "wq": rng.randn(S, L, D, D).astype("float32") * 0.2,
+        "wk": rng.randn(S, L, D, D).astype("float32") * 0.2,
+        "wv": rng.randn(S, L, D, D).astype("float32") * 0.2,
+        "wo": rng.randn(S, L, D, D).astype("float32") * 0.2,
+        "ln2s": np.ones((S, L, D), "float32"),
+        "ln2b": np.zeros((S, L, D), "float32"),
+        "wup": rng.randn(S, L, D, 2 * D).astype("float32") * 0.2,
+        "bup": np.zeros((S, L, 2 * D), "float32"),
+        "wdown": rng.randn(S, L, 2 * D, D).astype("float32") * 0.2,
+        "bdown": np.zeros((S, L, D), "float32"),
+    }
+    params = {
+        "emb": rng.randn(V, D).astype("float32") * 0.3,
+        "pos": _pos_encoding_table(T, D)[None],
+        "stack": sp,
+        "ln_s": np.ones((D,), "float32"),
+        "ln_b": np.zeros((D,), "float32"),
+        "out_w": rng.randn(D, V).astype("float32") * 0.3,
+        "out_b": np.zeros((V,), "float32"),
+    }
+    ids = rng.randint(0, V, (B, T)).astype("int32")
+    lbl = np.roll(ids, -1, axis=1).astype("int32")
+    mesh = make_mesh({"pp": S}, devices=jax.devices("cpu")[:S])
+
+    loss, grads = transformer_1f1b_train_step(
+        params, ids, lbl, mesh, n_heads=H, microbatches=M)
+
+    def ref_loss(p):
+        x = p["emb"][ids] + p["pos"][:, :T]
+        for s in range(S):
+            for l in range(L):
+                p_l = {k: v[s, l] for k, v in p["stack"].items()}
+                x = _decoder_layer(p_l, x, H, True, False)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.maximum(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                          - mean * mean, 0.0)
+        xn = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
+        xn = xn * p["ln_s"] + p["ln_b"]
+        logits = xn @ p["out_w"] + p["out_b"]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lbl[..., None],
+                                     axis=-1)[..., 0]
+        return jnp.mean(lse - picked)
+
+    ref, g = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for k in ("out_w", "out_b", "ln_s", "ln_b", "emb"):
+        np.testing.assert_allclose(np.asarray(grads[k]), np.asarray(g[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+    for k in grads["stack"]:
+        np.testing.assert_allclose(np.asarray(grads["stack"][k]),
+                                   np.asarray(g["stack"][k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
