@@ -1,0 +1,157 @@
+"""On-chip host-IO overlap probes (VERDICT r3 items 6+7).
+
+(a) input pipeline: train-step time fed per-step from the csrc
+    RecordIO->shuffle->batch pipeline vs device-resident data — the
+    double-buffer-reader overlap question, measured on the real chip.
+(b) host-table CTR: HostTableSession.run (serial gather -> step ->
+    update) vs run_prefetched (gather/update overlap the device step).
+
+Slope-timed; numbers land in docs/perf.md. Run: python tools/probe_host_io.py
+"""
+import json
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+
+def bench_input_pipeline():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import recordio
+    from paddle_tpu.profiler import slope_time
+    from paddle_tpu.reader.native import NativeBatchLoader
+
+    # LeNet-ish mnist workload: a realistic decode+feed payload without the
+    # tunnel-pathological 77 MB/step of ResNet bs128 (measured separately)
+    B, C, H, W = 256, 1, 28, 28
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[C, H, W], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        from paddle_tpu.models import lenet5
+        pred, loss, acc = lenet5(img, label)
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=1)
+
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    x_dev = jax.device_put(rng.rand(B, C, H, W).astype("float32"), dev)
+    y_dev = jax.device_put(rng.randint(0, 10, (B, 1)).astype("int32"), dev)
+
+    t_res = slope_time(
+        lambda: exe.run(main, feed={"img": x_dev, "label": y_dev},
+                        fetch_list=[], scope=scope),
+        lambda: exe.run(main, feed={"img": x_dev, "label": y_dev},
+                        fetch_list=[loss], scope=scope),
+        warmup=3, iters=40, prime=True)
+
+    # write a RecordIO shard of image+label records, stream through csrc
+    with tempfile.TemporaryDirectory() as d:
+        rec = np.empty(C * H * W + 1, "float32")
+        path = d + "/data.rio"
+        w = recordio.Writer(path)
+        for i in range(B * 8):
+            rec[:-1] = rng.rand(C * H * W)
+            rec[-1] = i % 10
+            w.write(rec.tobytes())
+        w.close()
+
+        def run_pipeline_epoch(n_fetch):
+            loader = NativeBatchLoader([path], record_shape=[C * H * W + 1],
+                                       batch_size=B, shuffle_buf=1024,
+                                       capacity=8, drop_last=True)
+            t0 = time.perf_counter()
+            steps = 0
+            last = None
+            for batch in loader:
+                feed = {"img": batch[:, :-1].reshape(B, C, H, W),
+                        "label": batch[:, -1:].astype("int64")}
+                last = exe.run(main, feed=feed,
+                               fetch_list=[loss] if steps == n_fetch else [],
+                               scope=scope)
+                steps += 1
+            np.asarray(last[0]) if last and last[0] is not None else None
+            return (time.perf_counter() - t0) / steps
+
+        run_pipeline_epoch(7)  # warmup/compile for host-fed shapes
+        t_pipe = min(run_pipeline_epoch(7) for _ in range(3))
+    print(json.dumps({
+        "probe": "input_pipeline_lenet_b256",
+        "device_resident_ms": round(t_res * 1e3, 3),
+        "csrc_pipeline_fed_ms": round(t_pipe * 1e3, 3),
+        "overhead_pct": round((t_pipe / t_res - 1) * 100, 1)}))
+
+
+def bench_host_table():
+    import paddle_tpu as fluid
+    from paddle_tpu.host_table import (HostEmbeddingTable, HostTableSession,
+                                       host_embedding)
+
+    V, E, S, B = 2_000_000, 32, 16, 1024
+    table = HostEmbeddingTable("probe", rows=V, dim=E, lr=0.1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = fluid.layers.data("dense", shape=[16], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="float32")
+        emb = host_embedding(table, batch_slots=S, program=main)
+        flat = fluid.layers.reshape(emb, [0, S * E])
+        x = fluid.layers.concat([flat, dense], axis=1)
+        x = fluid.layers.fc(x, size=256, act="relu")
+        x = fluid.layers.fc(x, size=256, act="relu")
+        logit = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=2)
+    sess = HostTableSession(exe, main, [table], scope=scope)
+    rng = np.random.RandomState(3)
+
+    def make_batches(n):
+        out = []
+        for _ in range(n):
+            ids = rng.randint(0, V, (B, S)).astype("int64")
+            dense_b = rng.randn(B, 16).astype("float32")
+            out.append(({"dense": dense_b,
+                         "label": (dense_b[:, :1] > 0).astype("float32")},
+                        {"probe": ids}))
+        return out
+
+    warm = make_batches(3)
+    for feed, ids in warm:
+        sess.run(feed=feed, ids=ids, fetch_list=[loss.name])
+
+    n = 30
+    batches = make_batches(n)
+    t0 = time.perf_counter()
+    for feed, ids in batches:
+        sess.run(feed=feed, ids=ids, fetch_list=[loss.name])
+    t_serial = (time.perf_counter() - t0) / n
+
+    batches = make_batches(n)
+    t0 = time.perf_counter()
+    for _ in sess.run_prefetched(batches, fetch_list=[loss.name]):
+        pass
+    t_overlap = (time.perf_counter() - t0) / n
+    print(json.dumps({
+        "probe": "host_table_ctr_b1024_s16_v2m",
+        "serial_ms": round(t_serial * 1e3, 3),
+        "prefetched_ms": round(t_overlap * 1e3, 3),
+        "overlap_gain_pct": round((1 - t_overlap / t_serial) * 100, 1)}))
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("both", "input"):
+        bench_input_pipeline()
+    if which in ("both", "table"):
+        bench_host_table()
