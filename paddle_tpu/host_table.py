@@ -65,15 +65,16 @@ class HostEmbeddingTable:
         if mmap_path:
             self.table = np.lib.format.open_memmap(
                 mmap_path, mode="w+", dtype=dtype, shape=(self.rows, self.dim))
-            # chunked init keeps peak host memory bounded
-            chunk = max(1, (64 << 20) // (self.dim * 4))
-            for lo in range(0, self.rows, chunk):
-                hi = min(self.rows, lo + chunk)
-                self.table[lo:hi] = rng.normal(
-                    0.0, init_scale, (hi - lo, self.dim)).astype(dtype)
         else:
-            self.table = rng.normal(
-                0.0, init_scale, (self.rows, self.dim)).astype(dtype)
+            self.table = np.empty((self.rows, self.dim), dtype)
+        # chunked init bounds peak host memory: an unchunked
+        # rng.normal(...).astype() materializes a float64 temporary twice
+        # the final table — ~3x the table's own footprint
+        chunk = max(1, (64 << 20) // (self.dim * 4))
+        for lo in range(0, self.rows, chunk):
+            hi = min(self.rows, lo + chunk)
+            self.table[lo:hi] = rng.normal(
+                0.0, init_scale, (hi - lo, self.dim)).astype(dtype)
         self._accum = None
         if optimizer == "adagrad":
             self._accum = (np.lib.format.open_memmap(
@@ -173,39 +174,90 @@ class HostTableSession:
 
     def run_prefetched(self, batches, fetch_list: List):
         """batches: iterable of (feed, ids) pairs. Yields each step's
-        fetches. The gather of batch i+1 and the update of batch i-1 run
-        on a worker thread while the device executes batch i."""
-        q: "queue.Queue" = queue.Queue(maxsize=2)
-        stop = object()
+        fetches.
 
-        def producer():
-            for feed, ids in batches:
-                rows = {n: self.tables[n].lookup(b) for n, b in ids.items()}
-                q.put((feed, ids, rows))
-            q.put(stop)
+        ALL table access (gather AND sparse update) lives on one worker
+        thread, so there is no unsynchronized read/write on the table and
+        the device step on the main thread overlaps both. The feed queue
+        holds ONE pre-gathered batch and the worker applies every queued
+        update before gathering, so a fed batch is stale by EXACTLY one
+        update (the async-pserver bounded-staleness semantic). Worker
+        exceptions propagate to the caller; closing the generator early
+        still applies every computed update (grads are enqueued before
+        the yield) and joins the thread."""
+        feed_q: "queue.Queue" = queue.Queue(maxsize=1)
+        grad_q: "queue.Queue" = queue.Queue()
+        STOP = object()
+        stopping = threading.Event()
+        worker_err: List[BaseException] = []
 
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        pending = None  # (ids, grads) awaiting host update
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            feed, ids, rows = item
-            full_feed = dict(feed)
-            for name, r in rows.items():
-                full_feed[self.tables[name].feed_name] = r
-            grad_names = [self.tables[n].grad_name for n in ids]
-            outs = self._run(full_feed, list(fetch_list) + grad_names)
-            if pending is not None:
-                for (name, id_batch), g in pending:
+        def apply_pending(block: bool):
+            while True:
+                try:
+                    item = grad_q.get(block=block) if block else                         grad_q.get_nowait()
+                except queue.Empty:
+                    return True
+                if item is STOP:
+                    return False
+                for (name, id_batch), g in item:
                     self.tables[name].apply_grads(id_batch, g)
-            n_user = len(fetch_list)
-            pending = [((name, id_batch), np.asarray(g))
-                       for (name, id_batch), g in
-                       zip(ids.items(), outs[n_user:])]
-            yield outs[:n_user]
-        if pending is not None:
-            for (name, id_batch), g in pending:
-                self.tables[name].apply_grads(id_batch, g)
-        t.join()
+
+        def worker():
+            try:
+                for feed, ids in batches:
+                    if stopping.is_set():
+                        break
+                    if not apply_pending(block=False):
+                        return
+                    rows = {n: self.tables[n].lookup(b)
+                            for n, b in ids.items()}
+                    feed_q.put((feed, ids, rows))
+                feed_q.put(STOP)
+                # drain every remaining update until the caller says stop
+                apply_pending(block=True)
+            except BaseException as e:  # noqa: BLE001 - repropagated below
+                worker_err.append(e)
+                # the queue may be full of an undelivered batch; displace
+                # it so the STOP poison pill ALWAYS lands (otherwise the
+                # consumer blocks forever on a dead worker)
+                while True:
+                    try:
+                        feed_q.put_nowait(STOP)
+                        break
+                    except queue.Full:
+                        try:
+                            feed_q.get_nowait()
+                        except queue.Empty:
+                            pass
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = feed_q.get()
+                if item is STOP:
+                    break
+                feed, ids, rows = item
+                full_feed = dict(feed)
+                for name, r in rows.items():
+                    full_feed[self.tables[name].feed_name] = r
+                grad_names = [self.tables[n].grad_name for n in ids]
+                outs = self._run(full_feed, list(fetch_list) + grad_names)
+                n_user = len(fetch_list)
+                # enqueue BEFORE yielding: an early generator close still
+                # gets this step's update applied by the worker's drain
+                grad_q.put([((name, id_batch), np.asarray(g))
+                            for (name, id_batch), g in
+                            zip(ids.items(), outs[n_user:])])
+                yield outs[:n_user]
+        finally:
+            stopping.set()
+            # unblock a worker stuck on the full feed queue
+            try:
+                feed_q.get_nowait()
+            except queue.Empty:
+                pass
+            grad_q.put(STOP)
+            t.join(timeout=60)
+            if worker_err:
+                raise worker_err[0]

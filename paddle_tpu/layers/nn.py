@@ -771,3 +771,52 @@ def pipelined_transformer_stack(x, n_stages: int, layers_per_stage: int,
          "tp_shard": bool(tp_shard)},
     )
     return out
+
+
+def nce(input, label, num_total_classes: int, num_neg_samples: int = 10,
+        param_attr=None, bias_attr=None, name: Optional[str] = None):
+    """Noise-contrastive estimation cost (<- layers/nn.py nce / nce_op.cc):
+    per-example cost [N, 1] against ``num_neg_samples`` uniform negatives.
+    The big-softmax trainer for word2vec-class models."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_total_classes, dim],
+                                "float32")
+    b = helper.create_parameter(bias_attr, [num_total_classes], "float32",
+                                is_bias=True)
+    cost = helper.create_variable_for_type_inference("float32")
+    sample_logits = helper.create_variable_for_type_inference("float32")
+    sample_labels = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "nce",
+        {"Input": [input], "Label": [label], "Weight": [w], "Bias": [b]},
+        {"Cost": [cost], "SampleLogits": [sample_logits],
+         "SampleLabels": [sample_labels]},
+        {"num_total_classes": int(num_total_classes),
+         "num_neg_samples": int(num_neg_samples)},
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes: int, param_attr=None,
+             bias_attr=None, name: Optional[str] = None):
+    """Hierarchical sigmoid cost [N, 1] over the default complete binary
+    tree (<- layers/nn.py hsigmoid / hierarchical_sigmoid_op.cc): O(log C)
+    per example instead of the full softmax — the other classic big-vocab
+    cost next to ``nce``."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_classes - 1, dim],
+                                "float32")
+    b = helper.create_parameter(bias_attr, [num_classes - 1], "float32",
+                                is_bias=True)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "hsigmoid",
+        {"X": [input], "Label": [label], "W": [w], "Bias": [b]},
+        {"Out": [out]},
+        {"num_classes": int(num_classes)},
+    )
+    return out

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.ir import grad_var_name
@@ -420,3 +421,52 @@ def nce_grad(ctx, ins, attrs):
     if bias is not None:
         out["Bias@GRAD"] = [grads[2]]
     return out
+
+
+@register_op(
+    "hsigmoid",
+    inputs=("X", "Label", "W", "Bias"),
+    outputs=("Out",),
+    diff_inputs=("X", "W", "Bias"),
+)
+def hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over the default complete binary tree
+    (<- hierarchical_sigmoid_op.cc): num_classes leaves, num_classes-1
+    internal nodes in heap order (children of p at 2p+1/2p+2, leaf of
+    class c at index c + C - 1). Loss = sum over the root->leaf path of
+    softplus(-side * (w_node . x + b_node)), side = +1 for a left edge.
+    Paths are padded to ceil(log2 C) levels and masked, so shapes stay
+    static. W: [C-1, dim]; Bias: [C-1]. The per-class losses form a
+    proper distribution: sum_c exp(-loss(c)) == 1."""
+    x, label, w = ins["X"][0], ins["Label"][0], ins["W"][0]
+    bias = (ins["Bias"][0]
+            if ins.get("Bias") and ins["Bias"][0] is not None else None)
+    num_classes = int(attrs["num_classes"])
+    if label.ndim > 1:
+        label = label[..., 0]
+    depth = max(1, int(np.ceil(np.log2(num_classes))))
+    # walk each label's leaf up to the root, recording (parent, side)
+    node = label.astype(jnp.int32) + (num_classes - 1)
+    parents, sides, valid = [], [], []
+    for _ in range(depth):
+        at_root = node == 0
+        parent = jnp.where(at_root, 0, (node - 1) // 2)
+        # left child of p is 2p+1 (odd index)
+        is_left = (node % 2) == 1
+        parents.append(jnp.where(at_root, 0, parent))
+        sides.append(jnp.where(is_left, 1.0, -1.0))
+        valid.append(~at_root)
+        node = parent
+    path = jnp.stack(parents, axis=-1)          # [N, D]
+    side = jnp.stack(sides, axis=-1).astype(jnp.float32)
+    mask = jnp.stack(valid, axis=-1).astype(jnp.float32)
+    xf = _f32_compute(ctx, x)
+    w_sel = w[path].astype(jnp.float32)         # [N, D, dim]
+    z = jnp.einsum("nd,nkd->nk", xf, w_sel)
+    if bias is not None:
+        z = z + bias[path].astype(jnp.float32)
+    # -log sigmoid(side*z) = softplus(-side*z), numerically stable form
+    a = -side * z
+    loss = jnp.sum(mask * (jnp.maximum(a, 0) + jnp.log1p(
+        jnp.exp(-jnp.abs(a)))), axis=-1, keepdims=True)
+    return {"Out": [loss]}

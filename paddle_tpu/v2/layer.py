@@ -23,7 +23,8 @@ from .data_type import InputType
 
 __all__ = ["data", "fc", "embedding", "pooling", "lstmemory", "gru",
            "concat", "cross_entropy_cost", "classification_cost",
-           "square_error_cost", "mse_cost", "max_id", "dropout", "parse_network"]
+           "square_error_cost", "mse_cost", "max_id", "dropout",
+           "nce_cost", "hsigmoid_cost", "parse_network"]
 
 _DEFAULT_SEQ_LEN = 128
 
@@ -262,3 +263,28 @@ def square_error_cost(input, label, name=None, **kw) -> Layer:
 
 
 mse_cost = square_error_cost
+
+
+def nce_cost(input, label, num_classes: int, num_neg_samples: int = 10,
+             name=None, **kw) -> Layer:
+    """Noise-contrastive estimation cost (<- v2 nce_layer /
+    trainer_config_helpers nce cost): the word2vec-class trainer that
+    replaces the full-vocab softmax with sampled logistic losses."""
+
+    def build(ctx, parents):
+        x, lab = parents
+        return F.mean(F.nce(x, lab, num_total_classes=num_classes,
+                            num_neg_samples=num_neg_samples))
+
+    return Layer("nce_cost", [input, label], build, name=name)
+
+
+def hsigmoid_cost(input, label, num_classes: int, name=None, **kw) -> Layer:
+    """Hierarchical sigmoid cost (<- v2 hsigmoid layer): O(log C) tree
+    softmax over the default complete binary tree."""
+
+    def build(ctx, parents):
+        x, lab = parents
+        return F.mean(F.hsigmoid(x, lab, num_classes=num_classes))
+
+    return Layer("hsigmoid_cost", [input, label], build, name=name)

@@ -394,3 +394,40 @@ def test_random_crop_int_seed():
     # explicit int seed makes the crop reproducible across executor seeds
     ov2, = exe.run(main, feed={"x": xv}, fetch_list=[out.name], seed=99)
     np.testing.assert_array_equal(ov, ov2)
+
+
+class TestHSigmoidOp(OpTest):
+    """hierarchical sigmoid vs a numpy walk of the complete binary tree
+    (<- hierarchical_sigmoid_op.cc contract), analytic vs numeric grads."""
+
+    op_type = "hsigmoid"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        n, dim, C = 6, 5, 7
+        x = rng.randn(n, dim).astype("float32") * 0.5
+        w = rng.randn(C - 1, dim).astype("float32") * 0.5
+        b = rng.randn(C - 1).astype("float32") * 0.2
+        lbl = rng.randint(0, C, (n, 1)).astype("int64")
+
+        def softplus(a):
+            return np.maximum(a, 0) + np.log1p(np.exp(-np.abs(a)))
+
+        out = np.zeros((n, 1), "float32")
+        for i in range(n):
+            node = int(lbl[i, 0]) + C - 1
+            while node > 0:
+                parent = (node - 1) // 2
+                side = 1.0 if node % 2 == 1 else -1.0
+                z = float(w[parent] @ x[i] + b[parent])
+                out[i, 0] += softplus(-side * z)
+                node = parent
+        self.inputs = {"X": x, "Label": lbl, "W": w, "Bias": b}
+        self.outputs = {"Out": out}
+        self.attrs = {"num_classes": C}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "W", "Bias"], "Out")

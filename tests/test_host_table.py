@@ -167,3 +167,35 @@ def test_host_table_prefetched_overlap_converges():
               sess.run_prefetched(batches(), fetch_list=[loss.name])]
     assert len(losses) == 40
     assert losses[-1] < losses[0] * 0.9, losses[::8]
+
+
+def test_host_table_prefetched_propagates_worker_errors():
+    """A bad id in a prefetched batch raises (with the real cause) instead
+    of deadlocking the consumer on a dead worker thread."""
+    V, E, S, B = 32, 4, 2, 8
+    table = HostEmbeddingTable("err", rows=V, dim=E)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _, dense, label = _data_vars(S)
+        emb = host_embedding(table, batch_slots=S, program=main)
+        loss = _tower(emb, dense, label, S, E)
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope, seed=1)
+    sess = HostTableSession(exe, main, [table], scope=scope)
+    rng = np.random.RandomState(0)
+
+    def batches():
+        ids_ok = rng.randint(0, V, (B, S)).astype("int64")
+        dense_b = rng.randn(B, 4).astype("float32")
+        feed = {"dense": dense_b,
+                "label": (dense_b[:, :1] > 0).astype("float32")}
+        yield feed, {"err": ids_ok}
+        bad = ids_ok.copy()
+        bad[0, 0] = V + 7  # out of range
+        yield feed, {"err": bad}
+
+    with pytest.raises(IndexError, match="out of range"):
+        for _ in sess.run_prefetched(batches(), fetch_list=[loss.name]):
+            pass

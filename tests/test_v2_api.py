@@ -184,3 +184,88 @@ def test_v2_infer_uses_trained_weights():
     assert not np.allclose(before, after), "infer ignored training"
     # 80 Adam steps get near (not exactly at) sum()=4; fresh init sits ~0
     assert abs(float(after[0, 0]) - 4.0) < 1.0
+
+
+@pytest.mark.slow
+def test_v2_sentiment_bilstm():
+    """The understand_sentiment book config through the v2-ONLY surface
+    (VERDICT r3 item 10): integer_value_sequence -> embedding ->
+    bidirectional_lstm -> max pooling -> softmax fc, trained to a
+    decreasing cost with the v2 SGD trainer."""
+    rng = np.random.RandomState(7)
+    V, L = 80, 16
+
+    def reader():
+        for _ in range(192):
+            n = rng.randint(6, L + 1)
+            ids = rng.randint(0, V, n)
+            # sentiment rule: positive iff more even than odd tokens
+            yield list(ids), int((ids % 2 == 0).sum() * 2 > n)
+
+    import paddle_tpu as fluid
+    from paddle_tpu.v2 import networks
+
+    seq = paddle.layer.data(
+        "words", paddle.data_type.integer_value_sequence(V, seq_len=L))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(seq, size=24)
+    bi = networks.bidirectional_lstm(emb, size=24)
+    pooled = paddle.layer.pooling(bi, pooling_type=paddle.pooling.Max)
+    pred = paddle.layer.fc(pooled, size=2, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02),
+        place=fluid.CPUPlace())
+    costs = []
+    trainer.train(paddle.batch(reader, batch_size=32), num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    first = np.mean(costs[:6])
+    last = np.mean(costs[-6:])
+    assert last < first * 0.8, (first, last)
+
+
+def test_v2_word2vec_nce_and_hsigmoid():
+    """word2vec-style big-vocab costs through the v2-ONLY surface
+    (VERDICT r3 item 10): context embedding -> nce_cost / hsigmoid_cost;
+    both train to a decreasing cost without ever building the full-vocab
+    softmax."""
+    rng = np.random.RandomState(9)
+    V = 64
+
+    def reader():
+        for _ in range(256):
+            ctx_ids = rng.randint(0, V, 4)
+            # deterministic next word: echo the first context token — the
+            # identity skip-gram every embedding can learn in a few passes
+            yield list(ctx_ids), int(ctx_ids[0])
+
+    import paddle_tpu as fluid
+
+    for cost_kind in ("nce", "hsigmoid"):
+        ctx = paddle.layer.data(
+            "ctx", paddle.data_type.integer_value_sequence(V, seq_len=4))
+        nxt = paddle.layer.data("next", paddle.data_type.integer_value(V))
+        emb = paddle.layer.embedding(ctx, size=32)
+        hidden = paddle.layer.pooling(emb,
+                                      pooling_type=paddle.pooling.Sum)
+        if cost_kind == "nce":
+            cost = paddle.layer.nce_cost(hidden, nxt, num_classes=V,
+                                         num_neg_samples=8)
+        else:
+            cost = paddle.layer.hsigmoid_cost(hidden, nxt, num_classes=V)
+        params = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Adam(learning_rate=0.05),
+            place=fluid.CPUPlace())
+        costs = []
+        trainer.train(paddle.batch(reader, batch_size=64), num_passes=10,
+                      event_handler=lambda e: costs.append(e.cost)
+                      if isinstance(e, paddle.event.EndIteration) else None)
+        first = np.mean(costs[:4])
+        last = np.mean(costs[-4:])
+        assert last < first * 0.9, (cost_kind, first, last)
