@@ -180,11 +180,13 @@ class HostTableSession:
         thread, so there is no unsynchronized read/write on the table and
         the device step on the main thread overlaps both. The feed queue
         holds ONE pre-gathered batch and the worker applies every queued
-        update before gathering, so a fed batch is stale by EXACTLY one
-        update (the async-pserver bounded-staleness semantic). Worker
-        exceptions propagate to the caller; closing the generator early
-        still applies every computed update (grads are enqueued before
-        the yield) and joins the thread."""
+        update before gathering, bounding staleness at TWO updates in
+        steady state (the worker pre-gathers batch k+1 while step k-1's
+        grads are still in flight — the async-pserver bounded-staleness
+        semantic). Worker exceptions propagate to the caller; closing the
+        generator early still applies every computed update (grads are
+        enqueued before the yield, and the worker drains them before
+        exiting) and joins the thread."""
         feed_q: "queue.Queue" = queue.Queue(maxsize=1)
         grad_q: "queue.Queue" = queue.Queue()
         STOP = object()
@@ -252,12 +254,16 @@ class HostTableSession:
                 yield outs[:n_user]
         finally:
             stopping.set()
-            # unblock a worker stuck on the full feed queue
-            try:
-                feed_q.get_nowait()
-            except queue.Empty:
-                pass
-            grad_q.put(STOP)
-            t.join(timeout=60)
+            grad_q.put(STOP)  # ordered after the last step's grads
+            # keep the feed queue drained until the worker exits — a
+            # single get could be refilled by an in-flight put
+            deadline = 60.0
+            while t.is_alive() and deadline > 0:
+                try:
+                    feed_q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+                deadline -= 0.05
             if worker_err:
                 raise worker_err[0]

@@ -139,7 +139,7 @@ def test_host_table_beyond_hbm_budget_trains_on_mesh(tmp_path):
 
 def test_host_table_prefetched_overlap_converges():
     """run_prefetched (gather i+1 + update i-1 overlap the device step,
-    bounded staleness 1 — the async-pserver semantic) still converges."""
+    bounded staleness — the async-pserver semantic) still converges."""
     V, E, S, B = 256, 8, 2, 32
     table = HostEmbeddingTable("pf", rows=V, dim=E, lr=0.3)
     main, startup = fluid.Program(), fluid.Program()
