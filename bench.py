@@ -158,7 +158,7 @@ def bench_seq2seq():
     step_time, spread = _slope_time(
         lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
         lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_loss], scope=scope),
-        warmup=3, iters=150, reps=5,
+        warmup=3, iters=250, reps=5,
     )
     tok_s = S2S_BATCH * S2S_LEN / step_time
     # analytic matmul FLOPs (fwd x3 for bwd): encoder LSTM + attention
