@@ -858,11 +858,29 @@ class recompute(_BlockGuard):
     its activations are dropped after the forward and recomputed during the
     backward pass — trading FLOPs for HBM, the TPU-native memory
     optimization the reference approximated with liveness-based var reuse.
+
+    ``policy`` selects SELECTIVE checkpointing (jax.checkpoint policies):
+      None / "nothing"  — save nothing, replay everything (max memory
+                          saving, one extra forward of FLOPs);
+      "dots"            — save matmul/conv outputs, replay only the cheap
+                          elementwise work (near-zero extra FLOPs; memory
+                          between full-remat and no-remat). The right
+                          default when activations fit but the full-remat
+                          replay tax shows up in step time — measured on
+                          the longcontext bench in docs/perf.md.
     """
 
-    def __init__(self, name: Optional[str] = None):
+    def __init__(self, name: Optional[str] = None,
+                 policy: Optional[str] = None):
         from ..core.ir import default_main_program
 
+        from ..ops.control_flow import RECOMPUTE_POLICIES
+
+        if policy not in RECOMPUTE_POLICIES:
+            raise ValueError(
+                f"unknown recompute policy {policy!r} (expected one of "
+                f"{sorted(k for k in RECOMPUTE_POLICIES if k)} or None)")
+        self.policy = policy
         self.program = default_main_program()
         super().__init__(self.program)
 
@@ -891,7 +909,7 @@ class recompute(_BlockGuard):
             {"Hold": hold},
             {"Out": list(writes)},
             {"sub_block": sub.idx, "hold_names": hold,
-             "out_names": list(writes)},
+             "out_names": list(writes), "policy": self.policy},
         )
         infer_and_create_outputs(op, parent)
         return False

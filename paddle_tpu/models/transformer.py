@@ -70,7 +70,8 @@ def _ffn(x, d_model: int, d_ff: int, name: str, tp_shard: bool = False):
 
 
 def encoder_layer(x, d_model: int, n_heads: int, d_ff: int, causal: bool,
-                  name: str, tp_shard: bool = False, use_recompute: bool = False):
+                  name: str, tp_shard: bool = False, use_recompute: bool = False,
+                  recompute_policy=None):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x))."""
 
     def body(x):
@@ -83,7 +84,7 @@ def encoder_layer(x, d_model: int, n_heads: int, d_ff: int, causal: bool,
         return layers.elementwise_add(x, f)
 
     if use_recompute:
-        with layers.recompute():
+        with layers.recompute(policy=recompute_policy):
             out = body(x)
         return out
     return body(x)
@@ -92,7 +93,8 @@ def encoder_layer(x, d_model: int, n_heads: int, d_ff: int, causal: bool,
 def transformer_lm(ids, labels, vocab_size: int, max_len: int,
                    d_model: int = 128, n_heads: int = 4, n_layers: int = 2,
                    d_ff: int = 512, tp_shard: bool = False,
-                   use_recompute: bool = False, fused_head: bool = False,
+                   use_recompute: bool = False, recompute_policy=None,
+                   fused_head: bool = False,
                    pp_stages: int = 0, pp_microbatches: int = 4):
     """Decoder-only (causal) language model.
 
@@ -109,6 +111,18 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
 
     t = int(ids.shape[1])
     assert t <= max_len, f"sequence length {t} exceeds max_len {max_len}"
+    if recompute_policy is not None:
+        from ..ops.control_flow import RECOMPUTE_POLICIES
+
+        if recompute_policy not in RECOMPUTE_POLICIES:
+            raise ValueError(
+                f"unknown recompute policy {recompute_policy!r}")
+        if pp_stages:
+            raise NotImplementedError(
+                "recompute_policy does not reach the pipelined stack yet "
+                "(its remat knob wraps the whole stage in jax.checkpoint); "
+                "a silent fallback to full remat would defeat the policy's "
+                "purpose — use pp_stages=0 or remat without a policy")
     emb = layers.embedding(ids, size=[vocab_size, d_model],
                            param_attr=ParamAttr("tlm.emb"))
     # positions broadcast over the batch: [1, max_len, D] parameter
@@ -136,7 +150,8 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
         for i in range(n_layers):
             x = encoder_layer(x, d_model, n_heads, d_ff, causal=True,
                               name=f"tlm.l{i}", tp_shard=tp_shard,
-                              use_recompute=use_recompute)
+                              use_recompute=use_recompute,
+                              recompute_policy=recompute_policy)
     x = layers.layer_norm(x, begin_norm_axis=2)
     # logits path (inference / fetching): ordinary fc. The training loss
     # shares its weight+bias BY NAME with the streamed head below; when the

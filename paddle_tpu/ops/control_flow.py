@@ -292,6 +292,18 @@ def array_length(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+# one registry for BOTH the build-time membership check (layers.recompute)
+# and the kernel dispatch — policies cannot drift between the two.
+# None/'nothing' = save nothing, full replay; 'dots' = selective
+# checkpointing keeping matmul/conv outputs (near-zero extra FLOPs,
+# memory between full remat and none)
+RECOMPUTE_POLICIES = {
+    None: None,
+    "nothing": None,
+    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
 @register_op("recompute", inputs=("Hold",), outputs=("Out",),
              diff_inputs=("Hold",))
 def recompute_op(ctx, ins, attrs):
@@ -329,5 +341,14 @@ def recompute_op(ctx, ins, attrs):
         runner.run_block(sub_idx, env, sub)
         return tuple(env[n] for n in out_names)
 
-    outs = jax.checkpoint(segment)(*ins["Hold"])
+    policy_name = attrs.get("policy")
+    if policy_name not in RECOMPUTE_POLICIES:
+        raise ValueError(
+            f"unknown recompute policy {policy_name!r} "
+            f"(expected one of {sorted(k for k in RECOMPUTE_POLICIES if k)}"
+            f" or None)")
+    policy = RECOMPUTE_POLICIES[policy_name]
+    ckpt = (jax.checkpoint(segment) if policy is None
+            else jax.checkpoint(segment, policy=policy))
+    outs = ckpt(*ins["Hold"])
     return {"Out": list(outs)}
