@@ -277,7 +277,7 @@ def bench_longcontext_lm():
     step_time, spread = _slope_time(
         lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
         lambda: exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope),
-        warmup=2, iters=10,
+        warmup=2, iters=30,
     )
     tok_s = LC_BATCH * LC_T / step_time
     n_params = (LC_LAYERS * (4 * LC_D * LC_D + 2 * LC_D * 4 * LC_D)
