@@ -286,3 +286,33 @@ def test_native_loader_epoch_reshuffle(tmp_path):
     assert sorted(e1) == sorted(e2) == sorted(ids)
     assert e1 != e2  # per-epoch reshuffle
     loader.close()
+
+
+@pytest.mark.slow
+def test_cpp_executes_resnet50_inference(tmp_path):
+    """The flagship book model served from C++: export resnet50's
+    inference clone and match the Python Executor's probabilities."""
+    from paddle_tpu.models import resnet50
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 64, 64], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred, avg_cost, acc = resnet50(img, label, class_dim=10)
+        test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=13)
+    d = str(tmp_path / "rn50")
+    fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                  main_program=test_prog, scope=scope)
+    x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+    dummy_lbl = np.zeros((2, 1), "int64")
+    ref, = exe.run(test_prog, feed={"img": x, "label": dummy_lbl},
+                   fetch_list=[pred], scope=scope)
+    m = NativeModelLoader(d)
+    out, = m.run({"img": x})
+    m.close()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-4)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-3, atol=1e-4)
