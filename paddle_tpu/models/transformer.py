@@ -252,3 +252,37 @@ def transformer_1f1b_train_step(params, ids, labels, mesh, n_heads: int,
     d_emb, _ = emb_vjp(dx.astype(x.dtype))
     grads = {"stack": d_stack, **d_head, **d_emb}
     return loss, grads
+
+
+def init_1f1b_lm_params(rng, n_stages: int, layers_per_stage: int,
+                        d_model: int, vocab_size: int, max_len: int,
+                        d_ff: int, scale: float = 0.2):
+    """The op-compatible parameter pytree transformer_1f1b_train_step
+    consumes — defined ONCE next to the step so every call site (tests,
+    examples) shares the stacked [S, L, ...] layout."""
+    S, L, D = n_stages, layers_per_stage, d_model
+
+    def w(*shape, s=scale):
+        return (rng.randn(*shape) * s).astype("float32")
+
+    stack = {
+        "ln1s": np.ones((S, L, D), "float32"),
+        "ln1b": np.zeros((S, L, D), "float32"),
+        "wq": w(S, L, D, D), "wk": w(S, L, D, D),
+        "wv": w(S, L, D, D), "wo": w(S, L, D, D),
+        "ln2s": np.ones((S, L, D), "float32"),
+        "ln2b": np.zeros((S, L, D), "float32"),
+        "wup": w(S, L, D, d_ff),
+        "bup": np.zeros((S, L, d_ff), "float32"),
+        "wdown": w(S, L, d_ff, D),
+        "bdown": np.zeros((S, L, D), "float32"),
+    }
+    return {
+        "emb": w(vocab_size, D, s=0.3),
+        "pos": _pos_encoding_table(max_len, D)[None],
+        "stack": stack,
+        "ln_s": np.ones((D,), "float32"),
+        "ln_b": np.zeros((D,), "float32"),
+        "out_w": w(D, vocab_size, s=0.3),
+        "out_b": np.zeros((vocab_size,), "float32"),
+    }

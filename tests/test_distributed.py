@@ -1220,35 +1220,13 @@ def test_transformer_1f1b_matches_sequential():
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.models.transformer import (_pos_encoding_table,
+    from paddle_tpu.models.transformer import (init_1f1b_lm_params,
                                                transformer_1f1b_train_step)
     from paddle_tpu.ops.pipelined_stack import _decoder_layer
 
     S, L, D, H, V, T, B, M = 2, 1, 16, 2, 23, 6, 8, 4
     rng = np.random.RandomState(8)
-    sp = {
-        "ln1s": np.ones((S, L, D), "float32"),
-        "ln1b": np.zeros((S, L, D), "float32"),
-        "wq": rng.randn(S, L, D, D).astype("float32") * 0.2,
-        "wk": rng.randn(S, L, D, D).astype("float32") * 0.2,
-        "wv": rng.randn(S, L, D, D).astype("float32") * 0.2,
-        "wo": rng.randn(S, L, D, D).astype("float32") * 0.2,
-        "ln2s": np.ones((S, L, D), "float32"),
-        "ln2b": np.zeros((S, L, D), "float32"),
-        "wup": rng.randn(S, L, D, 2 * D).astype("float32") * 0.2,
-        "bup": np.zeros((S, L, 2 * D), "float32"),
-        "wdown": rng.randn(S, L, 2 * D, D).astype("float32") * 0.2,
-        "bdown": np.zeros((S, L, D), "float32"),
-    }
-    params = {
-        "emb": rng.randn(V, D).astype("float32") * 0.3,
-        "pos": _pos_encoding_table(T, D)[None],
-        "stack": sp,
-        "ln_s": np.ones((D,), "float32"),
-        "ln_b": np.zeros((D,), "float32"),
-        "out_w": rng.randn(D, V).astype("float32") * 0.3,
-        "out_b": np.zeros((V,), "float32"),
-    }
+    params = init_1f1b_lm_params(rng, S, L, D, V, T, 2 * D)
     ids = rng.randint(0, V, (B, T)).astype("int32")
     lbl = np.roll(ids, -1, axis=1).astype("int32")
     mesh = make_mesh({"pp": S}, devices=jax.devices("cpu")[:S])

@@ -37,6 +37,11 @@ def test_train_multichip_example():
     assert "loss" in out and "done" in out
 
 
+def test_pipeline_1f1b_example():
+    out = _run("pipeline_1f1b.py", "--steps", "12", timeout=400)
+    assert "final loss" in out
+
+
 def test_long_context_ring_example():
     out = _run("long_context_ring.py", "--devices", "cpu", "--seq_len", "64")
     assert "max err" in out
