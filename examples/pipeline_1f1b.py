@@ -18,10 +18,16 @@ import argparse
 import os
 import sys
 
+
+# the device count must be fixed BEFORE jax imports, so peek at --stages
+# here rather than hardcoding a cap the flag could silently exceed
+_n = 8
+if "--stages" in sys.argv:
+    _n = max(_n, int(sys.argv[sys.argv.index("--stages") + 1]))
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+        flags + f" --xla_force_host_platform_device_count={_n}").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
