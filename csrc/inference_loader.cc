@@ -16,6 +16,7 @@
 // Self-contained: minimal JSON parser + .npy (v1/v2) reader, no deps.
 #include <algorithm>
 #include <cmath>
+#include <limits>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -561,10 +562,15 @@ bool Exec::run_op(const JValue* op) {
     out.data.assign(M * N, 0.f);
     const float* X = x->data.data();
     const float* Y = y->data.data();
+    // The a==0 skip is only valid when Y is finite: 0*NaN/0*Inf must
+    // propagate NaN exactly as the Python/XLA path does (advisor r4).
+    bool y_finite = true;
+    for (float yv : y->data)
+      if (!std::isfinite(yv)) { y_finite = false; break; }
     for (int64_t i = 0; i < M; i++)
       for (int64_t k = 0; k < K; k++) {
         float a = tx ? X[k * M + i] : X[i * K + k];
-        if (a == 0.f) continue;
+        if (a == 0.f && y_finite) continue;
         float* o = &out.data[i * N];
         const float* yr = ty ? nullptr : &Y[k * N];
         if (!ty) {
@@ -725,6 +731,11 @@ bool Exec::run_op(const JValue* op) {
     Tensor out;
     out.shape = {N, O, OH, OW};
     out.data.assign(N * O * OH * OW, 0.f);
+    // Zero-weight taps may only be skipped when the input is finite:
+    // 0*NaN must propagate NaN like the Python/XLA conv (advisor r4).
+    bool x_finite = true;
+    for (float xv : x->data)
+      if (!std::isfinite(xv)) { x_finite = false; break; }
     int64_t opg = O / groups;
     for (int64_t n = 0; n < N; n++)
       for (int64_t o = 0; o < O; o++) {
@@ -737,14 +748,21 @@ bool Exec::run_op(const JValue* op) {
           for (int64_t kh = 0; kh < KH; kh++)
             for (int64_t kw = 0; kw < KW; kw++) {
               float wv = wp[kh * KW + kw];
-              if (wv == 0.f) continue;
+              if (wv == 0.f && x_finite) continue;
+              // A non-finite weight must also multiply the implicit zero
+              // padding (NaN*0 = NaN at border outputs), matching
+              // lax.conv_general_dilated.
+              bool wv_finite = std::isfinite(wv);
               for (int64_t oh = 0; oh < OH; oh++) {
                 int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
-                if (ih < 0 || ih >= H) continue;
+                bool ih_in = ih >= 0 && ih < H;
+                if (!ih_in && wv_finite) continue;
                 for (int64_t ow = 0; ow < OW; ow++) {
                   int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
-                  if (iw < 0 || iw >= W) continue;
-                  op_[oh * OW + ow] += wv * xp[ih * W + iw];
+                  if (ih_in && iw >= 0 && iw < W)
+                    op_[oh * OW + ow] += wv * xp[ih * W + iw];
+                  else if (!wv_finite)
+                    op_[oh * OW + ow] += wv * 0.f;
                 }
               }
             }
@@ -800,7 +818,13 @@ bool Exec::run_op(const JValue* op) {
         float* op_ = &out.data[(n * C + c) * OH * OW];
         for (int64_t oh = 0; oh < OH; oh++)
           for (int64_t ow = 0; ow < OW; ow++) {
-            float acc = ptype == "max" ? -3.4e38f : 0.f;
+            // Empty-window edge (ceil_mode window fully in padding) is
+            // DEFINED to match the Python reduce_window semantics: max
+            // pools start from -inf, exclusive avg divides by the
+            // in-range count (0/0 -> NaN), matching ops/nn.py _pool_impl.
+            float acc = ptype == "max"
+                            ? -std::numeric_limits<float>::infinity()
+                            : 0.f;
             int64_t cnt = 0;
             for (int64_t kh = 0; kh < ksize[0]; kh++)
               for (int64_t kw = 0; kw < ksize[1]; kw++) {
@@ -808,13 +832,15 @@ bool Exec::run_op(const JValue* op) {
                 int64_t iw = ow * strides[1] - pads[1] + kw;
                 if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
                 float v = xp[ih * W + iw];
-                if (ptype == "max") acc = std::max(acc, v);
+                // NaN-propagating max (std::max keeps acc when v is NaN;
+                // lax.reduce_window/lax.max propagates it)
+                if (ptype == "max") { if (std::isnan(v) || v > acc) acc = v; }
                 else acc += v;
                 cnt++;
               }
             if (ptype != "max")
-              acc /= exclusive ? std::max<int64_t>(cnt, 1)
-                               : ksize[0] * ksize[1];
+              acc /= exclusive ? (float)cnt
+                               : (float)(ksize[0] * ksize[1]);
             op_[oh * OW + ow] = acc;
           }
       }

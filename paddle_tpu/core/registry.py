@@ -348,7 +348,12 @@ def forward_with_vjp(fwd_def: "OpDef", ctx: "ExecContext", ins: SlotValues,
     live = {s: fwd_ins[s] for s in diff_slots}
     outs, vjp = jax.vjp(_fwd_closure(fwd_def, ctx, frozen, attrs), live)
     key = _vjp_cache_key(fwd_def, fwd_ins, outs, attrs)
-    ctx.vjp_cache[key] = (outs, vjp, diff_slots)
+    # The entry holds STRONG references to the input tracers (not just
+    # their ids, which live in the key): CPython reuses ids of collected
+    # objects, so without the pin a freed input's id could be reused by a
+    # different value and produce a false cache hit instead of the
+    # intended miss->safe-replay (advisor r4).
+    ctx.vjp_cache[key] = (outs, vjp, diff_slots, fwd_ins)
     return outs
 
 
@@ -368,7 +373,7 @@ def generic_grad_impl(fwd_type: str):
             key = _vjp_cache_key(fwd_def, fwd_ins, fwd_outs, attrs)
             cached = cache.pop(key, None)
         if cached is not None:
-            outs, vjp, diff_slots = cached
+            outs, vjp, diff_slots, _ins_keepalive = cached
         else:
             frozen = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
             live = {s: fwd_ins[s] for s in diff_slots}

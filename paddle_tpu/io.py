@@ -38,6 +38,34 @@ CHECKPOINT_PREFIX = "checkpoint"
 SHARD_META_SUFFIX = ".shards.json"
 
 
+def _fsync_dir(path: str) -> None:
+    """Persist a directory's entries (renames); best-effort on exotic fs."""
+    try:
+        dirfd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    except OSError:
+        pass
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    """Durable atomic file publish: write ``path + '.tmp'`` via
+    ``write_fn(file)``, flush+fsync, os.replace into place; the temp file
+    never outlives a failed write."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
 def _var_path(dirname: str, name: str) -> str:
     return os.path.join(dirname, urllib.parse.quote(name, safe="") + ".npy")
 
@@ -229,14 +257,20 @@ def reshard_sharded_var(dirname: str, name: str, new_rows: Optional[int] = None,
         tag = "_".join(f"{x}x{y}" for x, y in bounds)
         out_f = f"{base}.shard{tag}.npy"
         out_path = os.path.join(out_dirname, out_f)
-        np.save(out_path, block)
-        # make the shard durable BEFORE the descriptor that references it
-        # commits — a descriptor surviving a crash must not point at
-        # truncated shard files
-        with open(out_path, "rb") as sf:
-            os.fsync(sf.fileno())
+        # Write to a temp name and os.replace into place: when growing in
+        # place the new shard's name can EQUAL a live shard's name (same
+        # per-shard bounds), and np.save directly onto it would leave the
+        # committed old descriptor pointing at a truncated file if we crash
+        # mid-write (advisor r4). The replace is atomic, and the overlap
+        # copy above guarantees the new content agrees with the old
+        # descriptor's view of those rows, so either file state is valid.
+        _atomic_write(out_path, lambda f: np.save(f, block))
         written.append(out_f)
         new_meta["shards"].append({"file": out_f, "index": bounds})
+    # Make every shard rename durable BEFORE the descriptor commits: a
+    # descriptor surviving a crash must not reference shard files whose
+    # directory entries were never persisted.
+    _fsync_dir(out_dirname)
     # Crash safety: commit the new descriptor FIRST (atomic tmp+replace),
     # only then remove stale files. The old ordering deleted every
     # descriptor before writing the new one; a crash in that window left
@@ -245,20 +279,9 @@ def reshard_sharded_var(dirname: str, name: str, new_rows: Optional[int] = None,
     # descriptor; per-host ``.shards.p*.json`` descriptors and stale shard
     # files are garbage-collected after the commit point.
     meta_path = _shard_meta_path(out_dirname, name)
-    tmp_path = meta_path + ".tmp"
-    with open(tmp_path, "w") as f:
-        json.dump(new_meta, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp_path, meta_path)
-    try:
-        dirfd = os.open(out_dirname, os.O_RDONLY)
-        try:
-            os.fsync(dirfd)  # persist the rename + new directory entries
-        finally:
-            os.close(dirfd)
-    except OSError:
-        pass  # directory fsync is best-effort on exotic filesystems
+    _atomic_write(meta_path,
+                  lambda f: f.write(json.dumps(new_meta).encode()))
+    _fsync_dir(out_dirname)  # persist the rename + new directory entries
     if os.path.abspath(out_dirname) == os.path.abspath(dirname):
         for _idx, fname in olds:
             if fname not in written:

@@ -316,3 +316,92 @@ def test_cpp_executes_resnet50_inference(tmp_path):
     assert out.shape == (2, 10)
     np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-4)
     np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_matmul_propagates_nan_through_zero(tmp_path):
+    """0 * NaN must be NaN in the native runtime too — the zero-skip fast
+    path may not swallow non-finite contributions (advisor r4)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[4, 3], dtype="float32")
+        out = fluid.layers.matmul(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "nan")
+    fluid.io.save_inference_model(d, ["x", "y"], [out], exe,
+                                  main_program=main, scope=scope)
+    xv = np.zeros((2, 4), "float32")          # zeros meet NaN in y
+    xv[1] = 1.0
+    yv = np.ones((4, 3), "float32")
+    yv[0, 0] = np.nan
+    ref, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out],
+                   scope=scope)
+    m = NativeModelLoader(d)
+    got, = m.run({"x": xv, "y": yv})
+    m.close()
+    ref = np.asarray(ref)
+    assert np.isnan(ref[0, 0]) and np.isnan(got[0, 0])
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(ref))
+    np.testing.assert_allclose(got[~np.isnan(ref)], ref[~np.isnan(ref)],
+                               rtol=1e-6)
+
+
+def test_cpp_conv_nan_weight_hits_padding(tmp_path):
+    """A non-finite conv weight must multiply the implicit zero padding
+    (NaN*0 = NaN at border outputs), matching lax.conv_general_dilated."""
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[1, 5, 5], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=1, filter_size=3,
+                                padding=1, bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w = np.random.RandomState(1).rand(1, 1, 3, 3).astype("float32")
+    w[0, 0, 0, 0] = np.nan
+    conv_op = next(op for op in main.current_block().ops
+                   if op.type == "conv2d")
+    scope.set(conv_op.inputs["Filter"][0], jnp.array(w))
+    d = str(tmp_path / "nanconv")
+    fluid.io.save_inference_model(d, ["img"], [c], exe, main_program=main,
+                                  scope=scope)
+    x = np.random.RandomState(0).rand(1, 1, 5, 5).astype("float32")
+    ref, = exe.run(main, feed={"img": x}, fetch_list=[c], scope=scope)
+    ref = np.asarray(ref)
+    m = NativeModelLoader(d)
+    got, = m.run({"img": x})
+    m.close()
+    assert np.isnan(ref).all()  # NaN tap touches every output window
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(ref))
+
+
+def test_cpp_pool_nan_and_empty_window(tmp_path):
+    """Max pool propagates NaN; a ceil_mode window fully in padding takes
+    the defined empty-window value (-inf for max), matching reduce_window."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1, 5, 5], dtype="float32")
+        p = fluid.layers.pool2d(x, pool_size=2, pool_stride=3,
+                                pool_padding=1, ceil_mode=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "nanpool")
+    fluid.io.save_inference_model(d, ["x"], [p], exe, main_program=main,
+                                  scope=scope)
+    xv = np.random.RandomState(2).rand(1, 1, 5, 5).astype("float32")
+    xv[0, 0, 0, 1] = np.nan
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[p], scope=scope)
+    ref = np.asarray(ref)
+    m = NativeModelLoader(d)
+    got, = m.run({"x": xv})
+    m.close()
+    assert got.shape == ref.shape
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(ref))
+    both = np.isfinite(ref)
+    np.testing.assert_allclose(got[both], ref[both], rtol=1e-6)
+    np.testing.assert_array_equal(np.isneginf(got), np.isneginf(ref))
