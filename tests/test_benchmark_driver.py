@@ -33,3 +33,4 @@ def test_mnist_single_device():
 def test_mnist_multi_device():
     out = _run(["--model", "mnist", "--num_devices", "2"])
     assert "examples/sec" in out
+

@@ -114,3 +114,30 @@ def test_profiler_device_trace_dir(tmp_path):
     for root, _dirs, files in os.walk(d):
         found.extend(files)
     assert found, "no trace artifacts written"
+
+
+def test_bench_self_comparison(tmp_path, capsys):
+    """bench.py carries its own in-repo baseline: vs_prev is populated from
+    the newest BENCH_r*.json and a >3% drop is flagged (VERDICT r4 item 6)."""
+    import importlib.util
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    prev = bench._prev_results()
+    assert "resnet50_train_images_per_sec_per_chip" in prev
+    val, tag = prev["resnet50_train_images_per_sec_per_chip"]
+    assert val > 0 and tag.startswith("r")
+    # regression path: 10% below previous flags the record and stderr
+    bench._PREV = {"m": (100.0, "r4")}
+    bench._emit({"metric": "m", "value": 90.0, "unit": "u"})
+    out = capsys.readouterr()
+    rec = json.loads(out.out.strip())
+    assert rec["regression"] is True and abs(rec["vs_prev"] - 0.9) < 1e-6
+    assert "regression" in out.err
+    # improvement path: no flag
+    bench._emit({"metric": "m", "value": 110.0, "unit": "u"})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert "regression" not in rec and rec["vs_prev"] > 1.0
