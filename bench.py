@@ -146,7 +146,10 @@ def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS, reps=3):
     return med, spread
 
 
-def bench_resnet():
+def build_resnet():
+    """(run_step, fetch) closures for the ResNet-50 bench workload — the
+    ONE place its program/feed are assembled (probe_trace.py traces the
+    same builders bench.py times, so audits measure the benched program)."""
     import jax
 
     import paddle_tpu as fluid
@@ -175,11 +178,14 @@ def bench_resnet():
         "label": jax.device_put(
             rng.randint(0, CLASSES, (BATCH, 1)).astype("int32"), dev),
     }
+    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+            lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                            scope=scope))
 
-    step_time, spread = _slope_time(
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_cost], scope=scope),
-    )
+
+def bench_resnet():
+    run_step, fetch = build_resnet()
+    step_time, spread = _slope_time(run_step, fetch)
     img_s = BATCH / step_time
     mfu = img_s * RESNET_GFLOP_PER_IMG / 1e3 / PEAK_TFLOPS
     _emit({
@@ -196,7 +202,8 @@ def bench_resnet():
     })
 
 
-def bench_seq2seq():
+def build_seq2seq():
+    """(run_step, fetch) for the seq2seq NMT bench workload."""
     import jax
 
     import paddle_tpu as fluid
@@ -233,15 +240,18 @@ def bench_seq2seq():
         "trg_next": jax.device_put(
             rng.randint(0, S2S_VOCAB, (S2S_BATCH, S2S_LEN)).astype("int32"), dev),
     }
+    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+            lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_loss],
+                            scope=scope))
 
+
+def bench_seq2seq():
+    run_step, fetch = build_seq2seq()
     # the ~10 ms step is small relative to tunnel jitter: long windows
     # (150 steps) + 5 reps keep the slope spread under 10% of the step
     # where 30-step windows swung 74% (VERDICT r3 item 2)
-    step_time, spread = _slope_time(
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_loss], scope=scope),
-        warmup=3, iters=250, reps=5,
-    )
+    step_time, spread = _slope_time(run_step, fetch,
+                                    warmup=3, iters=250, reps=5)
     tok_s = S2S_BATCH * S2S_LEN / step_time
     # analytic matmul FLOPs (fwd x3 for bwd): encoder LSTM + attention
     # decoder + vocab head, per trg token (embedding gathers excluded —
@@ -266,14 +276,14 @@ def bench_seq2seq():
     })
 
 
-def bench_transformer_lm():
-    """Decoder-only LM (flash attention, AMP) — the MXU-shaped workload;
-    net-new beyond the reference's benchmark suite (SURVEY.md §5.7)."""
+def build_transformer_lm(batch=None):
+    """(run_step, fetch) for the transformer-LM bench workload."""
     import jax
 
     import paddle_tpu as fluid
     from paddle_tpu.models.transformer import transformer_lm
 
+    batch = TLM_BATCH if batch is None else batch
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         ids = fluid.layers.data("ids", shape=[TLM_T], dtype="int64")
@@ -281,7 +291,7 @@ def bench_transformer_lm():
         _, loss = transformer_lm(ids, labels, vocab_size=TLM_VOCAB,
                                  max_len=TLM_T, d_model=TLM_D,
                                  n_heads=TLM_HEADS, n_layers=TLM_LAYERS,
-                                 d_ff=TLM_FF)
+                                 d_ff=TLM_FF, use_bias=False)
         fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
 
     place = fluid.default_place()
@@ -291,14 +301,20 @@ def bench_transformer_lm():
     rng = np.random.RandomState(0)
     dev = place.jax_device()
     X = jax.device_put(
-        rng.randint(0, TLM_VOCAB, (TLM_BATCH, TLM_T)).astype("int32"), dev)
+        rng.randint(0, TLM_VOCAB, (batch, TLM_T)).astype("int32"), dev)
     feed = {"ids": X, "labels": X}
+    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+            lambda: exe.run(main_prog, feed=feed, fetch_list=[loss],
+                            scope=scope))
 
-    step_time, spread = _slope_time(
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope),
-        warmup=3, iters=20,
-    )
+
+def bench_transformer_lm():
+    """Decoder-only LM (flash attention, AMP) — the MXU-shaped workload;
+    net-new beyond the reference's benchmark suite (SURVEY.md §5.7).
+    Bias-free FFN/head (the GPT-2/PaLM convention) as of r5: the head
+    bias grad alone was a 0.63 ms full pass over the [N*T, V] dlogits."""
+    run_step, fetch = build_transformer_lm()
+    step_time, spread = _slope_time(run_step, fetch, warmup=3, iters=20)
     tokens = TLM_BATCH * TLM_T
     tok_s = tokens / step_time
     # analytic FLOPs/token: 6*N (fwd+bwd matmuls) + causal attention term
@@ -324,13 +340,8 @@ LC_D = 1024
 LC_LAYERS = 4
 
 
-def bench_longcontext_lm():
-    """Long-context / huge-vocab LM: T=4096, V=100k. The dense LM head's
-    logits alone are [B*T, V] f32 = 1.6 GB with same-size grads; the
-    streamed fused_linear_cross_entropy head (chunked vocab under an online
-    logsumexp, per-chunk recompute) is the config where that feature PAYS
-    (docs/perf.md 'Streamed LM head') — this line makes it driver-visible.
-    Uses recompute through the layer stack for the T=4096 activations."""
+def build_longcontext_lm():
+    """(run_step, fetch) for the long-context LM bench workload."""
     import jax
 
     import paddle_tpu as fluid
@@ -343,7 +354,8 @@ def bench_longcontext_lm():
         _, loss = transformer_lm(ids, labels, vocab_size=LC_VOCAB,
                                  max_len=LC_T, d_model=LC_D, n_heads=8,
                                  n_layers=LC_LAYERS, d_ff=4 * LC_D,
-                                 use_recompute=True, fused_head=True)
+                                 use_recompute=True, fused_head=True,
+                                 use_bias=False)
         fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
 
     place = fluid.default_place()
@@ -355,12 +367,20 @@ def bench_longcontext_lm():
     X = jax.device_put(
         rng.randint(0, LC_VOCAB, (LC_BATCH, LC_T)).astype("int32"), dev)
     feed = {"ids": X, "labels": X}
+    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+            lambda: exe.run(main_prog, feed=feed, fetch_list=[loss],
+                            scope=scope))
 
-    step_time, spread = _slope_time(
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-        lambda: exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope),
-        warmup=2, iters=30,
-    )
+
+def bench_longcontext_lm():
+    """Long-context / huge-vocab LM: T=4096, V=100k. The dense LM head's
+    logits alone are [B*T, V] f32 = 1.6 GB with same-size grads; the
+    streamed fused_linear_cross_entropy head (chunked vocab under an online
+    logsumexp, per-chunk recompute) is the config where that feature PAYS
+    (docs/perf.md 'Streamed LM head') — this line makes it driver-visible.
+    Uses recompute through the layer stack for the T=4096 activations."""
+    run_step, fetch = build_longcontext_lm()
+    step_time, spread = _slope_time(run_step, fetch, warmup=2, iters=30)
     tok_s = LC_BATCH * LC_T / step_time
     n_params = (LC_LAYERS * (4 * LC_D * LC_D + 2 * LC_D * 4 * LC_D)
                 + LC_VOCAB * LC_D)

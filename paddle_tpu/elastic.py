@@ -221,15 +221,20 @@ class ElasticWorker:
                               None if gen is None else int(gen))
 
     def resume_step(self, executor, checkpoint_dir, main_program=None,
-                    scope=None) -> int:
+                    scope=None, host_tables=None) -> int:
         """Load the newest complete checkpoint into ``scope`` and return
-        the step to continue FROM (serial + 1); 0 when none exists."""
+        the step to continue FROM (serial + 1); 0 when none exists.
+        ``host_tables``: HostEmbeddingTable instances restored alongside
+        the device persistables — the pserver-resident parameter class the
+        reference's elastic plane recovered via its shard checkpoints
+        (go/pserver/service.go LoadCheckpoint)."""
         from . import io as fio
 
         try:
             serial = fio.load_checkpoint(executor, checkpoint_dir,
                                          main_program=main_program,
-                                         scope=scope)
+                                         scope=scope,
+                                         host_tables=host_tables)
             return serial + 1
         except FileNotFoundError:
             return 0

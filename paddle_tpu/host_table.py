@@ -32,8 +32,11 @@ Usage:
 """
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -98,6 +101,107 @@ class HostEmbeddingTable:
             raise IndexError(f"table {self.name!r}: id out of range")
         return np.asarray(self.table[ids.reshape(-1)]).reshape(
             ids.shape + (self.dim,))
+
+    # -- checkpoint / restore -------------------------------------------
+    # <- go/pserver/service.go:346 checkpoint(): parameter content +
+    # optimizer state serialized, CRC32-protected, published atomically
+    # (the reference writes to a fresh uuid path then flips the etcd meta;
+    # here each chunk lands via tmp+fsync+os.replace and meta.json is the
+    # commit point). Chunked so a 100M-row memmap streams — the table is
+    # never materialized twice in RAM ("flush, don't copy").
+
+    _CKPT_CHUNK_BYTES = 64 << 20
+
+    def _chunk_rows(self) -> int:
+        return max(1, self._CKPT_CHUNK_BYTES
+                   // (self.dim * self.table.dtype.itemsize))
+
+    def _arrays(self):
+        out = [("table", self.table)]
+        if self._accum is not None:
+            out.append(("accum", self._accum))
+        return out
+
+    def save(self, dirname: str) -> None:
+        """Checkpoint the table (and optimizer state) under ``dirname``.
+
+        Call at a step boundary — between ``run`` calls, or after the
+        ``run_prefetched`` generator is closed — so no update thread is
+        mutating the table. Layout: ``chunk_<arr>_<i>.bin`` raw row-major
+        slabs + ``meta.json`` (shapes, dtype, per-chunk CRC32) written
+        LAST and atomically: a crash mid-save leaves no meta, so a
+        half-written checkpoint can never be loaded."""
+        from .io import SUCCESS_MARKER, _atomic_write, _fsync_dir
+
+        os.makedirs(dirname, exist_ok=True)
+        if hasattr(self.table, "flush"):
+            self.table.flush()  # memmap: persist in-place training writes
+        if self._accum is not None and hasattr(self._accum, "flush"):
+            self._accum.flush()
+        chunk = self._chunk_rows()
+        meta = {
+            "name": self.name, "rows": self.rows, "dim": self.dim,
+            "lr": self.lr, "optimizer": self.optimizer,
+            "dtype": np.dtype(self.table.dtype).name,
+            "chunk_rows": chunk, "arrays": {},
+        }
+        for arr_name, arr in self._arrays():
+            crcs = []
+            for ci, lo in enumerate(range(0, self.rows, chunk)):
+                hi = min(self.rows, lo + chunk)
+                slab = np.ascontiguousarray(arr[lo:hi])
+                data = slab.view(np.uint8).reshape(-1).data
+                crcs.append(zlib.crc32(data) & 0xFFFFFFFF)
+                _atomic_write(
+                    os.path.join(dirname, f"chunk_{arr_name}_{ci:05d}.bin"),
+                    lambda f, d=data: f.write(d))
+            meta["arrays"][arr_name] = {
+                "dtype": np.dtype(arr.dtype).name, "crc32": crcs}
+        _atomic_write(os.path.join(dirname, "meta.json"),
+                      lambda f: f.write(json.dumps(meta).encode()))
+        with open(os.path.join(dirname, SUCCESS_MARKER), "w") as f:
+            f.write(self.name)
+        _fsync_dir(dirname)
+
+    def load(self, dirname: str) -> None:
+        """Restore table + optimizer state saved by ``save``. Verifies the
+        per-chunk CRC32 (a truncated or bit-flipped slab fails loudly, the
+        Go pserver's contract) and writes slab-by-slab into the existing
+        buffer — memmap tables restore without a full-size RAM copy."""
+        from .io import SUCCESS_MARKER
+
+        meta_path = os.path.join(dirname, "meta.json")
+        if not (os.path.exists(meta_path)
+                and os.path.exists(os.path.join(dirname, SUCCESS_MARKER))):
+            raise FileNotFoundError(
+                f"no complete host-table checkpoint under {dirname}")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if (meta["rows"], meta["dim"]) != (self.rows, self.dim):
+            raise ValueError(
+                f"host-table checkpoint shape {(meta['rows'], meta['dim'])} "
+                f"!= table {(self.rows, self.dim)}")
+        if meta["optimizer"] != self.optimizer:
+            raise ValueError(
+                f"host-table checkpoint optimizer {meta['optimizer']!r} != "
+                f"table {self.optimizer!r}")
+        chunk = int(meta["chunk_rows"])
+        for arr_name, arr in self._arrays():
+            info = meta["arrays"][arr_name]
+            dtype = np.dtype(info["dtype"])
+            for ci, lo in enumerate(range(0, self.rows, chunk)):
+                hi = min(self.rows, lo + chunk)
+                path = os.path.join(dirname, f"chunk_{arr_name}_{ci:05d}.bin")
+                with open(path, "rb") as f:
+                    raw = f.read()
+                if (zlib.crc32(raw) & 0xFFFFFFFF) != info["crc32"][ci]:
+                    raise IOError(
+                        f"host-table checkpoint corrupt: CRC mismatch in "
+                        f"{path}")
+                arr[lo:hi] = np.frombuffer(raw, dtype=dtype).reshape(
+                    hi - lo, self.dim)
+        if hasattr(self.table, "flush"):
+            self.table.flush()
 
     def apply_grads(self, ids: np.ndarray, grads: np.ndarray) -> None:
         """Sparse row update: deduplicate ids (sum their grads — the

@@ -418,7 +418,15 @@ def load_inference_model(dirname, executor, scope=None):
 
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
-                    max_num_checkpoints=3, scope=None, step=None):
+                    max_num_checkpoints=3, scope=None, step=None,
+                    host_tables=None):
+    """``host_tables``: HostEmbeddingTable instances checkpointed INSIDE the
+    same numbered dir, before its _SUCCESS marker — the reference's pserver
+    lookup-table checkpoint (checkpoint_notify table blocks,
+    distribute_transpiler.py:685-906; Go shard checkpoint with CRC + atomic
+    rename, go/pserver/service.go:346) re-expressed: host tables are the
+    TPU build's pserver-resident parameter class, so they commit or fail
+    with the step's device-side persistables as one unit."""
     import jax
 
     os.makedirs(checkpoint_dir, exist_ok=True)
@@ -426,6 +434,9 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
     cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
     os.makedirs(cur, exist_ok=True)
     save_persistables(executor, cur, main_program, scope=scope)
+    for table in (host_tables or []):
+        table.save(_host_table_dir(cur, table.name, jax.process_index(),
+                                   jax.process_count()))
     if jax.process_count() > 1:
         # every host must finish its shard writes before the chief marks the
         # checkpoint complete (<- pservers each checkpointing their shard,
@@ -449,14 +460,44 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
 
 
 def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
-                    serial=None):
+                    serial=None, host_tables=None):
     if serial is None:
         serial = _latest_checkpoint_serial(checkpoint_dir)
     if serial < 0:
         raise FileNotFoundError(f"no complete checkpoint under {checkpoint_dir}")
     cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
     load_persistables(executor, cur, main_program, scope=scope)
+    import jax
+
+    for table in (host_tables or []):
+        tdir = _host_table_dir(cur, table.name, jax.process_index(),
+                               jax.process_count())
+        try:
+            table.load(tdir)
+        except FileNotFoundError as e:
+            # distinct from "no checkpoint at all": the numbered checkpoint
+            # EXISTS (its device persistables are already in the scope) but
+            # lacks this table — resuming fresh here would silently pair
+            # step-N device params with junk host tables, so fail loudly
+            # (a plain FileNotFoundError would be swallowed by
+            # elastic.resume_step's fresh-start path)
+            raise IOError(
+                f"checkpoint {cur} has no host-table shard for "
+                f"{table.name!r} (expected {tdir}); it was probably saved "
+                f"without host_tables=[...]") from e
     return serial
+
+
+def _host_table_dir(cur: str, name: str, process_index: int,
+                    process_count: int) -> str:
+    """Host tables are PER-PROCESS state (each host is its own parameter
+    server, <- the reference's per-pserver shard checkpoints): in a
+    multi-host job every process writes its own subdir, so no two
+    processes race on the same chunk files over a shared filesystem."""
+    quoted = urllib.parse.quote(name, safe="")
+    if process_count > 1:
+        quoted += f"@p{process_index}"
+    return os.path.join(cur, "host_tables", quoted)
 
 
 def _checkpoint_serials(checkpoint_dir) -> List[int]:

@@ -1,0 +1,77 @@
+"""Round-5 transformer A/B probe: batch / bias / attention-packing variants.
+
+Model-level slope timing (the authoritative instrument, docs/perf.md).
+Usage: python tools/probe_tlm_r5.py "B[,nobias][,hb=N]" ...
+e.g. python tools/probe_tlm_r5.py 8 8,nobias 8,nobias,hb=2
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+from bench import (PEAK_TFLOPS, TLM_D, TLM_FF, TLM_LAYERS, TLM_T,  # noqa: E402
+                   TLM_VOCAB, _slope_time)
+
+
+def run(batch, use_bias=True, hb=None):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tmod
+
+    layers = tmod.layers
+    orig = layers.flash_attention
+    if hb is not None:
+        def fa(q, k, v, causal=False, scale=None, q_block=512, k_block=512,
+               heads_per_block=None, name=None):
+            return orig(q, k, v, causal=causal, scale=scale, q_block=q_block,
+                        k_block=k_block, heads_per_block=hb, name=name)
+        layers.flash_attention = fa
+    try:
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[TLM_T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[TLM_T], dtype="int64")
+            _, loss = tmod.transformer_lm(
+                ids, labels, vocab_size=TLM_VOCAB, max_len=TLM_T,
+                d_model=TLM_D, n_heads=8, n_layers=TLM_LAYERS,
+                d_ff=TLM_FF, use_bias=use_bias)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
+    finally:
+        layers.flash_attention = orig
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=13)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    X = jax.device_put(
+        rng.randint(0, TLM_VOCAB, (batch, TLM_T)).astype("int32"), dev)
+    feed = {"ids": X, "labels": X}
+    step_time, spread = _slope_time(
+        lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
+        lambda: exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope),
+        warmup=2, iters=max(10, 160 // batch))
+    tok_s = batch * TLM_T / step_time
+    n_params = (TLM_LAYERS * (4 * TLM_D * TLM_D + 2 * TLM_D * TLM_FF)
+                + TLM_VOCAB * TLM_D)
+    flops_per_token = 6 * n_params + 6 * TLM_LAYERS * TLM_D * TLM_T
+    mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
+    print(json.dumps({
+        "batch": batch, "bias": use_bias, "hb": hb,
+        "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
+        "step_ms": round(step_time * 1e3, 2),
+        "spread_ms": round(spread * 1e3, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    for spec in sys.argv[1:]:
+        parts = spec.split(",")
+        batch = int(parts[0])
+        use_bias = "nobias" not in parts[1:]
+        hb = None
+        for p in parts[1:]:
+            if p.startswith("hb="):
+                hb = int(p[3:])
+        run(batch, use_bias, hb)

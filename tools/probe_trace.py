@@ -1,0 +1,57 @@
+"""Capture a device trace of a bench.py workload and print the audit.
+
+Glue between bench.py's workload builders and the two audit views
+(tools/hlo_audit.py per-fusion roofline, tools/trace_ops.py per-op type):
+the traced program IS the benched program — both come from the same
+bench.build_* function, so a config change in bench.py cannot
+desynchronize the audit from the benchmark.
+
+Usage: python tools/probe_trace.py {tlm,s2s,resnet,longcontext} [steps]
+       [dir] [batch]
+"""
+import glob
+import os
+import sys
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+
+BUILDERS = {
+    "tlm": bench.build_transformer_lm,
+    "s2s": bench.build_seq2seq,
+    "resnet": bench.build_resnet,
+    "longcontext": bench.build_longcontext_lm,
+}
+
+
+def main():
+    workload = sys.argv[1] if len(sys.argv) > 1 else "tlm"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    out = sys.argv[3] if len(sys.argv) > 3 else f"/tmp/trace_{workload}"
+    import jax
+
+    kw = {}
+    if len(sys.argv) > 4:
+        kw["batch"] = int(sys.argv[4])
+    run_step, fetch = BUILDERS[workload](**kw)
+    for _ in range(3):
+        run_step()
+    fetch()
+    jax.profiler.start_trace(out)
+    for _ in range(steps - 1):
+        run_step()
+    fetch()
+    jax.profiler.stop_trace()
+    pbs = glob.glob(os.path.join(out, "**", "*.xplane.pb"), recursive=True)
+    pb = max(pbs, key=os.path.getmtime)
+    print(f"trace: {pb}\n")
+    import hlo_audit
+    import trace_ops
+
+    hlo_audit.main(pb, steps=steps, top_n=40)
+    print()
+    trace_ops.main(pb, top_n=15)
+
+
+if __name__ == "__main__":
+    main()
