@@ -15,6 +15,7 @@
 //
 // Self-contained: minimal JSON parser + .npy (v1/v2) reader, no deps.
 #include <algorithm>
+#include <cctype>
 #include <cmath>
 #include <limits>
 #include <cstdint>
@@ -867,6 +868,245 @@ bool Exec::run_op(const JValue* op) {
     Tensor* x;
     if (!need(op, "X", &x)) return false;
     env[out_name(op, "Out")] = *x;  // f32-only runtime
+    return true;
+  }
+  if (type == "sum") {
+    // elementwise sum over the X list (<- sum_op.cc; ops/basic.py sum)
+    const JValue* ins_j = op->get("inputs");
+    const JValue* xs = ins_j ? ins_j->get("X") : nullptr;
+    if (!xs || xs->arr.empty()) return fail("sum: no inputs");
+    Tensor out;
+    for (size_t i = 0; i < xs->arr.size(); i++) {
+      Tensor* t = get(xs->arr[i]->str);
+      if (!t) return fail("sum: no value for '" + xs->arr[i]->str + "'");
+      if (i == 0) {
+        out = *t;
+      } else {
+        if (t->shape != out.shape) return fail("sum: shape mismatch");
+        for (int64_t j = 0; j < out.numel(); j++) out.data[j] += t->data[j];
+      }
+    }
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "lookup_table" || type == "embedding") {
+    // ids arrive as the runtime's f32 tensors (exact for any real vocab);
+    // padding_idx rows emit zeros (ops/nn.py lookup_table)
+    Tensor *w, *ids;
+    if (!need(op, "W", &w) || !need(op, "Ids", &ids)) return false;
+    int64_t V = w->shape[0], E = w->shape[1];
+    std::vector<int64_t> ishape = ids->shape;
+    if (ishape.size() >= 2 && ishape.back() == 1) ishape.pop_back();
+    int64_t n = 1;
+    for (int64_t d : ishape) n *= d;
+    int64_t pad = (int64_t)jnum(op, "padding_idx", -1);
+    Tensor out;
+    out.shape = ishape;
+    out.shape.push_back(E);
+    out.data.assign(n * E, 0.f);
+    for (int64_t i = 0; i < n; i++) {
+      int64_t id = (int64_t)std::llround(ids->data[i]);
+      if (id < 0 || id >= V)
+        return fail("lookup_table: id out of range");
+      if (pad >= 0 && id == pad) continue;  // stays zero
+      memcpy(&out.data[i * E], &w->data[id * E], E * sizeof(float));
+    }
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "lstm") {
+    // dense-padded LSTM scan (ops/rnn.py lstm / <- lstm_op.cc): Input is
+    // the pre-projected [N, T, 4H] gate input; recurrence h @ W [H, 4H];
+    // gate order i, f, c(candidate), o; finished sequences freeze their
+    // carry and emit zeros (the shrink_rnn_memory semantic as a mask)
+    Tensor *x, *w;
+    if (!need(op, "Input", &x) || !need(op, "Weight", &w)) return false;
+    if (x->shape.size() != 3) return fail("lstm: Input must be [N, T, 4H]");
+    // explicit initial state is not implemented — refuse loudly rather
+    // than silently scanning from zeros (the file-wide contract)
+    if (!in_name(op, "H0").empty() || !in_name(op, "C0").empty())
+      return fail("lstm: H0/C0 initial state unsupported in native runtime");
+    int64_t N = x->shape[0], T = x->shape[1], H4 = x->shape[2], H = H4 / 4;
+    bool use_peep = jnum(op, "use_peepholes", 0) != 0;
+    std::vector<float> bias(H4, 0.f), peep(3 * H, 0.f);
+    std::string bname = in_name(op, "Bias");
+    if (!bname.empty()) {
+      Tensor* b = get(bname);
+      if (!b) return fail("lstm: bias var missing");
+      if (b->numel() < H4) return fail("lstm: bias too small");
+      memcpy(bias.data(), b->data.data(), H4 * sizeof(float));
+      if (use_peep) {
+        if (b->numel() < H4 + 3 * H) return fail("lstm: peephole bias small");
+        memcpy(peep.data(), &b->data[H4], 3 * H * sizeof(float));
+      }
+    }
+    std::vector<float> len(N, (float)T);
+    std::string lname = in_name(op, "Length");
+    if (!lname.empty()) {
+      Tensor* l = get(lname);
+      if (!l) return fail("lstm: length var missing");
+      for (int64_t i = 0; i < N; i++) len[i] = l->data[i];
+    }
+    bool reverse = jnum(op, "is_reverse", 0) != 0;
+    std::string acts[3] = {"sigmoid", "tanh", "tanh"};
+    const char* keys[3] = {"gate_activation", "cell_activation",
+                           "candidate_activation"};
+    const JValue* attrs_j = op->get("attrs");
+    for (int i = 0; i < 3; i++)
+      if (attrs_j && attrs_j->get(keys[i]) &&
+          attrs_j->get(keys[i])->kind == JValue::Str)
+        acts[i] = attrs_j->get(keys[i])->str;
+    auto act = [](const std::string& a, float v) -> float {
+      if (a == "sigmoid") return 1.f / (1.f + std::exp(-v));
+      if (a == "tanh") return std::tanh(v);
+      if (a == "relu") return v > 0 ? v : 0;
+      return v;  // identity
+    };
+    Tensor hidden, cell, lasth, lastc;
+    hidden.shape = {N, T, H};
+    hidden.data.assign(N * T * H, 0.f);
+    cell = hidden;
+    lasth.shape = {N, H};
+    lasth.data.assign(N * H, 0.f);
+    lastc = lasth;
+    // the h==0 skip below is only valid when W is finite: 0*NaN must
+    // propagate exactly as the XLA path does (same rule as mul/conv2d)
+    bool w_finite = true;
+    for (float wv : w->data)
+      if (!std::isfinite(wv)) { w_finite = false; break; }
+    std::vector<float> h(H), c(H), gates(H4), hrow(H), crow(H);
+    for (int64_t nidx = 0; nidx < N; nidx++) {
+      int64_t L = (int64_t)std::llround(len[nidx]);
+      if (L > T) L = T;
+      std::fill(h.begin(), h.end(), 0.f);
+      std::fill(c.begin(), c.end(), 0.f);
+      for (int64_t j = 0; j < L; j++) {
+        int64_t t = reverse ? (L - 1 - j) : j;
+        const float* xt = &x->data[(nidx * T + t) * H4];
+        for (int64_t g = 0; g < H4; g++) gates[g] = xt[g] + bias[g];
+        for (int64_t k = 0; k < H; k++) {
+          float hv = h[k];
+          if (hv == 0.f && w_finite) continue;
+          const float* wr = &w->data[k * H4];
+          for (int64_t g = 0; g < H4; g++) gates[g] += hv * wr[g];
+        }
+        for (int64_t k = 0; k < H; k++) {
+          float gi = gates[k], gf = gates[H + k];
+          float gc = gates[2 * H + k], go = gates[3 * H + k];
+          if (use_peep) {
+            gi += c[k] * peep[k];
+            gf += c[k] * peep[H + k];
+          }
+          float i_g = act(acts[0], gi);
+          float f_g = act(acts[0], gf);
+          float c_new = f_g * c[k] + i_g * act(acts[2], gc);
+          if (use_peep) go += c_new * peep[2 * H + k];
+          float o_g = act(acts[0], go);
+          crow[k] = c_new;
+          hrow[k] = o_g * act(acts[1], c_new);
+        }
+        std::copy(crow.begin(), crow.end(), c.begin());
+        std::copy(hrow.begin(), hrow.end(), h.begin());
+        // scan emits in processing order, then the reverse path re-indexes
+        // output row t' = L-1-j == t — so writes land at t either way
+        memcpy(&hidden.data[(nidx * T + t) * H], h.data(),
+               H * sizeof(float));
+        memcpy(&cell.data[(nidx * T + t) * H], c.data(), H * sizeof(float));
+      }
+      memcpy(&lasth.data[nidx * H], h.data(), H * sizeof(float));
+      memcpy(&lastc.data[nidx * H], c.data(), H * sizeof(float));
+    }
+    env[out_name(op, "Hidden")] = std::move(hidden);
+    if (!out_name(op, "Cell").empty())
+      env[out_name(op, "Cell")] = std::move(cell);
+    if (!out_name(op, "LastH").empty())
+      env[out_name(op, "LastH")] = std::move(lasth);
+    if (!out_name(op, "LastC").empty())
+      env[out_name(op, "LastC")] = std::move(lastc);
+    return true;
+  }
+  if (type == "sequence_pool") {
+    // masked pooling over the time dim (ops/sequence.py sequence_pool /
+    // <- sequence_pool_op.cc): X [N, T, D], optional Length [N]
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    if (x->shape.size() < 2) return fail("sequence_pool: rank < 2");
+    int64_t N = x->shape[0], T = x->shape[1];
+    int64_t D = 1;
+    for (size_t i = 2; i < x->shape.size(); i++) D *= x->shape[i];
+    std::string ptype = "SUM";
+    const JValue* attrs_j = op->get("attrs");
+    if (attrs_j && attrs_j->get("pooltype"))
+      ptype = attrs_j->get("pooltype")->str;
+    for (auto& ch : ptype) ch = (char)std::toupper(ch);
+    std::vector<float> len(N, (float)T);
+    std::string lname = in_name(op, "Length");
+    if (!lname.empty()) {
+      Tensor* l = get(lname);
+      if (!l) return fail("sequence_pool: length var missing");
+      for (int64_t i = 0; i < N; i++) len[i] = l->data[i];
+    }
+    Tensor out;
+    out.shape = {N};
+    for (size_t i = 2; i < x->shape.size(); i++)
+      out.shape.push_back(x->shape[i]);
+    if (out.shape.size() == 1) out.shape.push_back(D);
+    out.data.assign(N * D, 0.f);
+    for (int64_t n = 0; n < N; n++) {
+      int64_t L = (int64_t)std::llround(len[n]);
+      if (L > T) L = T;
+      if (L < 1 && (ptype == "LAST" || ptype == "FIRST")) L = 1;
+      for (int64_t d = 0; d < D; d++) {
+        float acc;
+        if (ptype == "MAX") {
+          acc = -std::numeric_limits<float>::max();  // jnp.finfo.min
+          for (int64_t t = 0; t < L; t++) {
+            float v = x->data[(n * T + t) * D + d];
+            if (std::isnan(v) || v > acc) acc = v;
+          }
+          if (L == 0) acc = -std::numeric_limits<float>::max();
+        } else if (ptype == "LAST") {
+          acc = x->data[(n * T + (L - 1)) * D + d];
+        } else if (ptype == "FIRST") {
+          acc = x->data[(n * T + 0) * D + d];
+        } else {  // SUM / AVERAGE / SQRT
+          acc = 0.f;
+          for (int64_t t = 0; t < L; t++)
+            acc += x->data[(n * T + t) * D + d];
+          float lf = (float)(L < 1 ? 1 : L);
+          if (ptype == "AVERAGE") acc /= lf;
+          else if (ptype == "SQRT") acc /= std::sqrt(lf);
+          else if (ptype != "SUM")
+            return fail("sequence_pool: unknown pooltype " + ptype);
+        }
+        out.data[n * D + d] = acc;
+      }
+    }
+    env[out_name(op, "Out")] = std::move(out);
+    std::string mi = out_name(op, "MaxIndex");
+    if (!mi.empty()) {
+      Tensor idx;
+      idx.shape = out.shape;
+      idx.data.assign(N * D, 0.f);
+      for (int64_t n = 0; n < N; n++) {
+        int64_t L = (int64_t)std::llround(len[n]);
+        if (L > T) L = T;
+        if (L < 1) L = 1;
+        for (int64_t d = 0; d < D; d++) {
+          float best = -std::numeric_limits<float>::max();
+          int64_t bi = 0;
+          for (int64_t t = 0; t < L; t++) {
+            float v = x->data[(n * T + t) * D + d];
+            // numpy/jnp argmax treats NaN as the max (first occurrence
+            // wins) — match it so MaxIndex agrees with the NaN Out
+            if (std::isnan(v)) { bi = t; break; }
+            if (v > best) { best = v; bi = t; }
+          }
+          idx.data[n * D + d] = (float)bi;
+        }
+      }
+      env[mi] = std::move(idx);
+    }
     return true;
   }
   return fail("native runtime: unsupported op '" + type +

@@ -435,8 +435,7 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
     os.makedirs(cur, exist_ok=True)
     save_persistables(executor, cur, main_program, scope=scope)
     for table in (host_tables or []):
-        table.save(_host_table_dir(cur, table.name, jax.process_index(),
-                                   jax.process_count()))
+        table.save(_host_table_dir(cur, table.name, jax.process_index()))
     if jax.process_count() > 1:
         # every host must finish its shard writes before the chief marks the
         # checkpoint complete (<- pservers each checkpointing their shard,
@@ -470,8 +469,7 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
     import jax
 
     for table in (host_tables or []):
-        tdir = _host_table_dir(cur, table.name, jax.process_index(),
-                               jax.process_count())
+        tdir = _host_table_dir(cur, table.name, jax.process_index())
         try:
             table.load(tdir)
         except FileNotFoundError as e:
@@ -483,21 +481,24 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
             # elastic.resume_step's fresh-start path)
             raise IOError(
                 f"checkpoint {cur} has no host-table shard for "
-                f"{table.name!r} (expected {tdir}); it was probably saved "
-                f"without host_tables=[...]") from e
+                f"{table.name!r} (expected {tdir}); either it was saved "
+                f"without host_tables=[...], or the job resized since the "
+                f"save (host-table shards are per-process and do not "
+                f"reshard — resume with the saved process count, then "
+                f"resize)") from e
     return serial
 
 
-def _host_table_dir(cur: str, name: str, process_index: int,
-                    process_count: int) -> str:
+def _host_table_dir(cur: str, name: str, process_index: int) -> str:
     """Host tables are PER-PROCESS state (each host is its own parameter
-    server, <- the reference's per-pserver shard checkpoints): in a
-    multi-host job every process writes its own subdir, so no two
-    processes race on the same chunk files over a shared filesystem."""
+    server, <- the reference's per-pserver shard checkpoints): every
+    process writes its own subdir, so no two processes race on the same
+    chunk files over a shared filesystem. The suffix is UNCONDITIONAL
+    (``@p0`` for single-process jobs too) so the path does not depend on
+    the process count at save time — a count-dependent name made a
+    1-process checkpoint unloadable after any elastic resize."""
     quoted = urllib.parse.quote(name, safe="")
-    if process_count > 1:
-        quoted += f"@p{process_index}"
-    return os.path.join(cur, "host_tables", quoted)
+    return os.path.join(cur, "host_tables", f"{quoted}@p{process_index}")
 
 
 def _checkpoint_serials(checkpoint_dir) -> List[int]:

@@ -62,9 +62,14 @@ def embedding(
     dtype="float32",
     name: Optional[str] = None,
 ):
-    """<- layers/nn.py embedding / lookup_table_op. ``is_sparse`` is accepted
-    for API parity; on TPU the gather's backward is a fused scatter-add, which
-    is the sparse path."""
+    """<- layers/nn.py embedding / lookup_table_op. ``is_sparse=True`` is
+    the SelectedRows path (<- lookup_table_op GradVarTypeInference +
+    sgd/adam SelectedRows kernels): the table's gradient stays (rows, ids)
+    and sgd/adam/adagrad update ONLY the gathered rows — no full-table
+    scatter-add, no whole-table optimizer pass. Sparse semantics are the
+    reference's lazy mode: untouched rows' Adam moments do not decay on
+    steps that miss them. Requires a single embedding use per table and no
+    regularizer/clip on the param (Optimizer._check_sparse_supported)."""
     helper = LayerHelper("embedding", param_attr=param_attr, name=name)
     w = helper.create_parameter(param_attr, size, dtype)
     out = helper.create_variable_for_type_inference(dtype)
@@ -72,7 +77,8 @@ def embedding(
         "lookup_table",
         {"W": [w], "Ids": [input]},
         {"Out": [out]},
-        {"padding_idx": -1 if padding_idx is None else padding_idx},
+        {"padding_idx": -1 if padding_idx is None else padding_idx,
+         "is_sparse": bool(is_sparse)},
     )
     return out
 

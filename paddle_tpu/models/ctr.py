@@ -22,14 +22,22 @@ def wide_deep_ctr(
     embed_dim: int = 16,
     hidden_sizes=(64, 32),
     shard_embeddings: bool = True,
+    sparse_update: bool = False,
 ):
     """sparse_ids: [N, S] int64 (S slots), dense_feats: [N, D] float32,
-    label: [N, 1] float32 in {0,1}. Returns (avg_loss, prob, auc_var)."""
+    label: [N, 1] float32 in {0,1}. Returns (avg_loss, prob, auc_var).
+
+    ``sparse_update``: SelectedRows grads on the big tables — the optimizer
+    touches only the batch's gathered rows (the reference's is_sparse CTR
+    path). Best with unsharded tables on one chip; under a vocab-sharded
+    GSPMD table the row scatter crosses shards, so the sharded default
+    keeps dense grads."""
     emb_attr = ParamAttr(
         name="ctr_embedding",
         sharding=("dp", None) if shard_embeddings else None,
     )
     emb = layers.embedding(sparse_ids, size=[sparse_vocab, embed_dim],
+                           is_sparse=sparse_update,
                            param_attr=emb_attr)  # [N, S, E]
     n_slots = int(sparse_ids.shape[1])
     deep_in = layers.reshape(emb, [0, n_slots * embed_dim])
@@ -61,13 +69,16 @@ def deepfm_ctr(
     embed_dim: int = 16,
     hidden_sizes=(64, 32),
     shard_embeddings: bool = True,
+    sparse_update: bool = False,
 ):
-    """DeepFM: first-order + pairwise FM interactions + deep tower."""
+    """DeepFM: first-order + pairwise FM interactions + deep tower.
+    ``sparse_update``: see wide_deep_ctr."""
     emb_attr = ParamAttr(
         name="deepfm_embedding",
         sharding=("dp", None) if shard_embeddings else None,
     )
     emb = layers.embedding(sparse_ids, size=[sparse_vocab, embed_dim],
+                           is_sparse=sparse_update,
                            param_attr=emb_attr)  # [N, S, E]
     n_slots = int(sparse_ids.shape[1])
 

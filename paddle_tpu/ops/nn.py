@@ -270,19 +270,53 @@ def batch_norm(ctx, ins, attrs):
     }
 
 
+def _ln_grad_maker(op, no_grad_set):
+    """Explicit grad: rebuilds xhat in the backward from the (bf16) input
+    and the saved per-row Mean/Variance instead of keeping an f32 residual.
+    The generic vjp saved (xf - mean) — a full f32 copy of the activation —
+    for EVERY layer_norm (17 of them on the bench transformer ≈ 0.5 GB of
+    residual writes+reads per step, hlo_audit r5); here the backward's
+    only large read is the bf16 x that is already resident."""
+    inputs = {
+        "X": list(op.inputs["X"]),
+        "Scale": list(op.inputs.get("Scale", [])),
+        "Bias": list(op.inputs.get("Bias", [])),
+        # programs that only declared Y (OpTest one-op programs) omit the
+        # saved stats; the grad kernel recomputes them from X
+        "Mean": list(op.outputs.get("Mean", [])),
+        "Variance": list(op.outputs.get("Variance", [])),
+        "Y@GRAD": [grad_var_name(n) for n in op.outputs["Y"]],
+    }
+    outputs = {}
+    for slot in ("X", "Scale", "Bias"):
+        names = op.inputs.get(slot, [])
+        outputs[slot + "@GRAD"] = [
+            "" if (not n or n in no_grad_set) else grad_var_name(n)
+            for n in names]
+    return [{"type": "layer_norm_grad", "inputs": inputs,
+             "outputs": outputs, "attrs": dict(op.attrs)}]
+
+
 @register_op("layer_norm", inputs=("X", "Scale", "Bias"),
-             outputs=("Y", "Mean", "Variance"), diff_inputs=("X", "Scale", "Bias"))
+             outputs=("Y", "Mean", "Variance"), diff_inputs=("X", "Scale", "Bias"),
+             grad_maker=_ln_grad_maker)
 def layer_norm(ctx, ins, attrs):
     x = ins["X"][0]
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    # f32 stats/arithmetic on low-precision activations (same rationale as
-    # batch_norm above); result cast back so bf16 flows through under AMP
-    xf = x.astype(jnp.float32) if _low_prec(x.dtype) else x
-    mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.maximum(  # single-pass stats; clamp f32 cancellation
-        jnp.mean(xf * xf, axis=axes, keepdims=True) - mean * mean, 0.0)
+    # f32 statistics on low-precision activations, but each as its OWN
+    # cast->reduce chain with a single consumer (the CE-head recipe,
+    # ops/loss.py): an up-front shared astype materializes a full f32 copy
+    # of the activation, separate chains fuse into passes reading bf16
+    # directly. Single-pass E[x²] stats; clamp f32 cancellation.
+    lp = _low_prec(x.dtype)
+    mean = jnp.mean(x.astype(jnp.float32) if lp else x, axis=axes,
+                    keepdims=True)
+    xsq = x.astype(jnp.float32) * x.astype(jnp.float32) if lp else x * x
+    var = jnp.maximum(
+        jnp.mean(xsq, axis=axes, keepdims=True) - mean * mean, 0.0)
+    xf = x.astype(jnp.float32) if lp else x
     y = (xf - mean) * lax.rsqrt(var + eps)
     scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
     bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
@@ -293,6 +327,60 @@ def layer_norm(ctx, ins, attrs):
         y = y + bias.reshape((1,) * begin + norm_shape)
     y = y.astype(x.dtype)
     return {"Y": [y], "Mean": [mean.squeeze(axes)], "Variance": [var.squeeze(axes)]}
+
+
+@register_op(
+    "layer_norm_grad",
+    inputs=("X", "Scale", "Bias", "Mean", "Variance", "Y@GRAD"),
+    outputs=("X@GRAD", "Scale@GRAD", "Bias@GRAD"),
+    no_grad=True,
+)
+def layer_norm_grad(ctx, ins, attrs):
+    """dX/dScale/dBias from x + saved row stats (no activation residual):
+    xhat = (x - mean) * rsqrt(var + eps)
+    dScale = sum_rows(g * xhat); dBias = sum_rows(g)
+    dX = inv * (dxhat - mean_f(dxhat) - xhat * mean_f(dxhat * xhat))
+    with dxhat = g * scale, means over the normalized axes per row."""
+    x = ins["X"][0]
+    g = ins["Y@GRAD"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    norm_shape = x.shape[begin:]
+    lead = tuple(range(begin))
+    kd = {"axis": axes, "keepdims": True}
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
+    bias_wanted = bool(ins.get("Bias")) and ins["Bias"][0] is not None
+    if g is None:
+        gf = jnp.zeros(x.shape, jnp.float32)
+    else:
+        gf = g.astype(jnp.float32)
+    stat_shape = x.shape[:begin] + (1,) * len(axes)
+    if ins.get("Mean") and ins["Mean"][0] is not None:
+        mean = ins["Mean"][0].reshape(stat_shape).astype(jnp.float32)
+        var = ins["Variance"][0].reshape(stat_shape).astype(jnp.float32)
+    else:  # stats not saved by the forward program: recompute from X
+        xf32 = x.astype(jnp.float32)
+        mean = jnp.mean(xf32, **kd)
+        var = jnp.maximum(jnp.mean(xf32 * xf32, **kd) - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    xhat = (x.astype(jnp.float32) - mean) * inv
+    out = {}
+    if scale is not None:
+        out["Scale@GRAD"] = [jnp.sum(gf * xhat, axis=lead).reshape(
+            scale.shape).astype(scale.dtype)]
+        dxhat = gf * scale.reshape((1,) * begin + norm_shape).astype(
+            jnp.float32)
+    else:
+        dxhat = gf
+    if bias_wanted:
+        b = ins["Bias"][0]
+        out["Bias@GRAD"] = [jnp.sum(gf, axis=lead).reshape(
+            b.shape).astype(b.dtype)]
+    dx = inv * (dxhat - jnp.mean(dxhat, **kd)
+                - xhat * jnp.mean(dxhat * xhat, **kd))
+    out["X@GRAD"] = [dx.astype(x.dtype)]
+    return out
 
 
 @register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"), diff_inputs=("X",))
@@ -355,11 +443,44 @@ def dropout_grad(ctx, ins, attrs):
     return {"X@GRAD": [ins["Out@GRAD"][0] * ins["Mask"][0]]}
 
 
-@register_op("lookup_table", inputs=("W", "Ids"), outputs=("Out",), diff_inputs=("W",))
+def _lookup_table_grad_maker(op, no_grad_set):
+    """``is_sparse=False``: generic vjp (gather backward = dense
+    scatter-add). ``is_sparse=True``: the SelectedRows path
+    (<- lookup_table_op.cc GradVarTypeInference switching W@GRAD to
+    SelectedRows + sgd/adam SelectedRows kernels, sgd_op.cc:72-76) —
+    the grad stays (rows, ids) and the optimizer touches only gathered
+    rows. On a [32k, 1024] bench-transformer table the dense path costs a
+    full-table scatter-add (0.63 ms) + whole-table Adam (1.26 ms); the
+    sparse path replaces both with passes over the ~8k touched rows."""
+    from ..core.registry import default_grad_op_descs
+
+    if not op.attrs.get("is_sparse", False):
+        return default_grad_op_descs(op, no_grad_set)
+    w = op.inputs["W"][0]
+    if w in no_grad_set:
+        return []
+    return [{
+        "type": "lookup_table_grad_sparse",
+        "inputs": {
+            "W": list(op.inputs["W"]),
+            "Ids": list(op.inputs["Ids"]),
+            "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]],
+        },
+        "outputs": {
+            "W@GRAD": [grad_var_name(w)],
+            "W@GRAD@IDS": [grad_var_name(w) + "@IDS"],
+        },
+        "attrs": dict(op.attrs),
+    }]
+
+
+@register_op("lookup_table", inputs=("W", "Ids"), outputs=("Out",),
+             diff_inputs=("W",), grad_maker=_lookup_table_grad_maker)
 def lookup_table(ctx, ins, attrs):
     """Embedding lookup (<- lookup_table_op.cc). The generic vjp turns the
     gather's backward into a scatter-add — the dense equivalent of the
-    reference's SelectedRows sparse gradient."""
+    reference's SelectedRows sparse gradient; ``is_sparse=True`` keeps the
+    gradient as (rows, ids) instead (see _lookup_table_grad_maker)."""
     w, ids = ins["W"][0], ins["Ids"][0]
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
     if squeeze_last:
@@ -377,6 +498,27 @@ def lookup_table(ctx, ins, attrs):
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
     return {"Out": [out]}
+
+
+@register_op("lookup_table_grad_sparse",
+             inputs=("W", "Ids", "Out@GRAD"),
+             outputs=("W@GRAD", "W@GRAD@IDS"), no_grad=True)
+def lookup_table_grad_sparse(ctx, ins, attrs):
+    """SelectedRows gradient: (row values [N_flat, E] f32, ids [N_flat]
+    int32), duplicates NOT merged — the optimizer's sparse path merges
+    (<- the reference's MergeAdd in selected_rows_functor running inside
+    the optimizer kernels). padding_idx rows get zero grad, matching the
+    dense vjp of the output mask."""
+    ids, g = ins["Ids"][0], ins["Out@GRAD"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    dim = g.shape[-1]
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    rows = g.reshape(-1, dim).astype(jnp.float32)  # f32 accumulation
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        rows = jnp.where((flat_ids == padding_idx)[:, None], 0.0, rows)
+    return {"W@GRAD": [rows], "W@GRAD@IDS": [flat_ids]}
 
 
 @register_op("one_hot", inputs=("X",), outputs=("Out",), no_grad=True)

@@ -94,6 +94,57 @@ class Optimizer:
     def _append_optimize_op(self, block, param: Variable, grad: Variable):
         raise NotImplementedError
 
+    @staticmethod
+    def _grad_ids(block, grad: Variable) -> Optional[Variable]:
+        """The SelectedRows companion: lookup_table(is_sparse=True) grads
+        come as (rows, ids) with the ids var named ``<grad>@IDS``
+        (<- the reference's W@GRAD being VarType SelectedRows)."""
+        return block.vars.get(grad.name + "@IDS")
+
+    def _check_sparse_supported(self, block, params_grads):
+        """Sparse (rows, ids) grads reach only the optimizers with a
+        SelectedRows kernel (sgd/adam/adagrad — matching the reference's
+        coverage) and do not compose with regularizers or gradient clip:
+        decay/clip of a whole table through row grads would be silently
+        wrong, and the reference's pserver path had the same boundary."""
+        # a sparse table used MORE THAN ONCE sends its row grads (and even
+        # its int ids) through autodiff's rename+sum dedup — elementwise
+        # sums of rows belonging to DIFFERENT id sets, silently updating
+        # wrong rows. Refuse: SelectedRows grads cannot be summed.
+        summed = set()
+        for op in block.ops:
+            if op.type == "sum":
+                for names in op.outputs.values():
+                    summed.update(names)
+        for p, g in params_grads:
+            if self._grad_ids(block, g) is None:
+                continue
+            if g.name in summed or g.name + "@IDS" in summed:
+                raise NotImplementedError(
+                    f"param {p.name!r}: an is_sparse embedding table must "
+                    f"be looked up exactly once per program (SelectedRows "
+                    f"row grads cannot be summed) — drop is_sparse=True or "
+                    f"split the table")
+            if not isinstance(self, (SGD, Adam, Adagrad)):
+                raise NotImplementedError(
+                    f"param {p.name!r} has a SelectedRows (is_sparse) "
+                    f"gradient but {type(self).__name__} has no sparse "
+                    f"kernel — use SGD, Adam, or Adagrad (the reference's "
+                    f"SelectedRows coverage), or drop is_sparse=True")
+            attr = getattr(p, "_param_attr", None)
+            if self.regularization is not None or (
+                    attr is not None and attr.regularizer is not None):
+                raise NotImplementedError(
+                    f"regularization on sparse-grad param {p.name!r} is "
+                    f"unsupported (whole-table decay through row grads "
+                    f"would be wrong) — drop is_sparse=True or the "
+                    f"regularizer")
+            if attr is not None and attr.gradient_clip is not None:
+                raise NotImplementedError(
+                    f"gradient_clip on sparse-grad param {p.name!r} is "
+                    f"unsupported — unmerged duplicate rows would be "
+                    f"mis-normed; drop is_sparse=True or the clip attr")
+
     # -- public --
     def minimize(
         self,
@@ -109,6 +160,7 @@ class Optimizer:
             for p, g in params_grads
             if getattr(p, "_param_attr", None) is None or p._param_attr.trainable
         ]
+        self._check_sparse_supported(loss.block, params_grads)
         self._apply_regularization(loss.block, params_grads)
         from .clip import append_gradient_clip_ops
 
@@ -132,11 +184,12 @@ class SGD(Optimizer):
     """<- optimizer.py SGDOptimizer / sgd_op.cc."""
 
     def _append_optimize_op(self, block, param, grad):
-        block.append_op(
-            "sgd",
-            {"Param": [param], "Grad": [grad], "LearningRate": [self._lr_for_param(param)]},
-            {"ParamOut": [param]},
-        )
+        ins = {"Param": [param], "Grad": [grad],
+               "LearningRate": [self._lr_for_param(param)]}
+        ids = self._grad_ids(block, grad)
+        if ids is not None:
+            ins["GradIds"] = [ids]
+        block.append_op("sgd", ins, {"ParamOut": [param]})
 
 
 class Momentum(Optimizer):
@@ -172,17 +225,21 @@ class Adam(Optimizer):
 
     def _append_optimize_op(self, block, param, grad):
         a = self._accumulators
+        ins = {
+            "Param": [param],
+            "Grad": [grad],
+            "Moment1": [a["moment1"][param.name]],
+            "Moment2": [a["moment2"][param.name]],
+            "LearningRate": [self._lr_for_param(param)],
+            "Beta1Pow": [a["beta1_pow"][param.name]],
+            "Beta2Pow": [a["beta2_pow"][param.name]],
+        }
+        ids = self._grad_ids(block, grad)
+        if ids is not None:  # lazy/sparse Adam over SelectedRows grads
+            ins["GradIds"] = [ids]
         block.append_op(
             "adam",
-            {
-                "Param": [param],
-                "Grad": [grad],
-                "Moment1": [a["moment1"][param.name]],
-                "Moment2": [a["moment2"][param.name]],
-                "LearningRate": [self._lr_for_param(param)],
-                "Beta1Pow": [a["beta1_pow"][param.name]],
-                "Beta2Pow": [a["beta2_pow"][param.name]],
-            },
+            ins,
             {
                 "ParamOut": [param],
                 "Moment1Out": [a["moment1"][param.name]],
@@ -237,10 +294,14 @@ class Adagrad(Optimizer):
 
     def _append_optimize_op(self, block, param, grad):
         m = self._accumulators["moment"][param.name]
+        ins = {"Param": [param], "Grad": [grad], "Moment": [m],
+               "LearningRate": [self._lr_for_param(param)]}
+        ids = self._grad_ids(block, grad)
+        if ids is not None:
+            ins["GradIds"] = [ids]
         block.append_op(
             "adagrad",
-            {"Param": [param], "Grad": [grad], "Moment": [m],
-             "LearningRate": [self._lr_for_param(param)]},
+            ins,
             {"ParamOut": [param], "MomentOut": [m]},
             {"epsilon": self._epsilon},
         )

@@ -182,16 +182,35 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
             y = stage_fn(w, x_in)
             stash = lax.dynamic_update_index_in_dim(
                 stash, x_in, mf_c % stash_len, 0)
-            # last stage: head loss + dy for the microbatch that just exited
-            loss_mb, dy, dh = loss_grad_fn(
-                head_p, y, lax.dynamic_index_in_dim(lbls, mf_c, 0,
-                                                    keepdims=False))
+            # last stage: head loss + dy for the microbatch that just
+            # exited. GATED under lax.cond, not computed-then-masked: for a
+            # real LM head (d x V matmul + its vjp) an ungated call would
+            # execute on every stage every tick — S-1 redundant head
+            # passes per tick whose masked results are discarded (VERDICT
+            # r4 item 8). The cond's predicate is stage-local, so only the
+            # last-stage device takes the head branch; the others take the
+            # zero branch. Wall-clock per tick is set by the last stage
+            # either way (the masked work overlapped it), so this is a
+            # per-device FLOP/energy fix — measured numbers in
+            # docs/perf.md "1F1B head gating".
             is_last = stage == S - 1
             fmask = f_valid & is_last
-            loss_sum = loss_sum + jnp.where(fmask, loss_mb, 0.0)
-            dhead = jax.tree.map(
-                lambda a, g: a + jnp.where(fmask, g, jnp.zeros_like(g)),
-                dhead, dh)
+            lbl_mb = lax.dynamic_index_in_dim(lbls, mf_c, 0, keepdims=False)
+
+            def run_head(args):
+                hp, y_mb, lbl = args
+                loss_mb, dy, dh = loss_grad_fn(hp, y_mb, lbl)
+                return loss_mb.astype(jnp.float32), dy, dh
+
+            def skip_head(args):
+                hp, y_mb, lbl = args
+                return (jnp.zeros((), jnp.float32), jnp.zeros_like(y_mb),
+                        jax.tree.map(jnp.zeros_like, hp))
+
+            loss_mb, dy, dh = lax.cond(fmask, run_head, skip_head,
+                                       (head_p, y, lbl_mb))
+            loss_sum = loss_sum + loss_mb
+            dhead = jax.tree.map(lambda a, g: a + g, dhead, dh)
             # ---- B phase -------------------------------------------------
             mbk = t - 2 * (S - 1) + stage        # this device's B microbatch
             b_valid = (mbk >= 0) & (mbk < M)

@@ -11,6 +11,8 @@ train with SGD + event callbacks, infer.
 from . import activation, attr, data_type, event, pooling  # noqa: F401
 from . import layer, optimizer  # noqa: F401
 from . import networks  # noqa: F401
+from . import config_parser  # noqa: F401  (the config-file front door)
+from .config_parser import parse_config, parse_model_config  # noqa: F401
 from .parameters import Parameters, create as _params_create  # noqa: F401
 from .trainer import SGD  # noqa: F401
 from .inference import infer  # noqa: F401

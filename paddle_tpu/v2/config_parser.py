@@ -1,0 +1,262 @@
+"""v2 config front door (<- python/paddle/trainer/config_parser.py, 4.4k LoC,
++ trainer_config_helpers/): compile a CONFIG — a reference-style Python
+config file or a declarative ModelConfig-like dict — into the v2 layer DSL,
+which ``to_program`` then lowers onto the Fluid-equivalent IR.
+
+The reference's front door was ``parse_config(some_config.py)``: the config
+file calls ``data_layer`` / ``fc_layer`` / ... / ``outputs(...)`` helpers and
+the parser emits a ModelConfig proto for gserver. Here the same helper names
+are bound to paddle_tpu.v2.layer nodes, so a v2 user's config FILE (not just
+a script importing our DSL) has an entry point::
+
+    cfg = parse_config("sentiment_config.py", "dict_dim=10000")
+    main, startup, outs, feed_order, _ = layer.to_program(cfg.outputs)
+
+Covered layer kinds = exactly the v2 DSL's (~17, see v2/layer.py); anything
+else raises with the layer name. Known deviations (README "v2 boundary"):
+
+* whether a data layer is a sequence comes from the config (``type=`` /
+  ``seq`` fields), not from a separate DataProvider — the reference split
+  this across config + dataprovider declarations;
+* proto-text ModelConfig files are not parsed — the declarative form is a
+  dict/JSON mirroring LayerConfig's {name, type, size, inputs, active_type}
+  fields (``parse_model_config``);
+* gserver's remaining ~200 layer types are out of scope (the Fluid-era
+  layers API is the supported surface at that breadth).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+from . import activation as act_mod
+from . import data_type
+from . import layer as L
+from . import pooling as pooling_mod
+
+__all__ = ["parse_config", "parse_model_config", "ParsedConfig"]
+
+
+@dataclass
+class ParsedConfig:
+    """What the parser hands back: the DSL output nodes + training settings
+    (the ModelConfig + OptimizationConfig pair of the reference)."""
+
+    outputs: List[L.Layer]
+    settings: Dict[str, Any] = field(default_factory=dict)
+    layers: Dict[str, L.Layer] = field(default_factory=dict)
+
+    def to_program(self, main=None, startup=None):
+        return L.to_program(self.outputs, main=main, startup=startup)
+
+
+# ---------------------------------------------------------------------------
+# Python-config-file form (<- parse_config + trainer_config_helpers names)
+# ---------------------------------------------------------------------------
+
+
+def _helper_namespace(state: dict, config_args: Dict[str, str]):
+    """The names a reference-style config file may call, bound to the DSL."""
+
+    def settings(**kw):
+        state["settings"].update(kw)
+
+    def outputs(*layers_):
+        flat = []
+        for o in layers_:
+            flat.extend(o if isinstance(o, (list, tuple)) else [o])
+        state["outputs"].extend(flat)
+
+    def get_config_arg(name, type_=str, default=None):
+        if name in config_args:
+            return type_(config_args[name])
+        return default
+
+    def data_layer(name, size, type=None, seq_len=0, **kw):
+        itype = type or data_type.dense_vector(size)
+        if seq_len and itype.kind.endswith("_seq"):
+            itype.seq_len = seq_len
+        return L.data(name, itype)
+
+    ns = {
+        # layers (reference helper names -> DSL)
+        "data_layer": data_layer,
+        "fc_layer": L.fc,
+        "embedding_layer": L.embedding,
+        "lstmemory": L.lstmemory,
+        "grumemory": L.gru,
+        "pooling_layer": L.pooling,
+        "concat_layer": L.concat,
+        "dropout_layer": L.dropout,
+        "maxid_layer": L.max_id,
+        "classification_cost": L.classification_cost,
+        "cross_entropy_cost": L.cross_entropy_cost,
+        "regression_cost": L.square_error_cost,
+        "mse_cost": L.mse_cost,
+        "nce_cost": L.nce_cost,
+        "hsigmoid_cost": L.hsigmoid_cost,
+        # activations (trainer_config_helpers class names)
+        "LinearActivation": act_mod.Linear,
+        "ReluActivation": act_mod.Relu,
+        "SigmoidActivation": act_mod.Sigmoid,
+        "TanhActivation": act_mod.Tanh,
+        "SoftmaxActivation": act_mod.Softmax,
+        # pooling types
+        "MaxPooling": pooling_mod.Max,
+        "AvgPooling": pooling_mod.Avg,
+        "SumPooling": pooling_mod.Sum,
+        # data types (so configs can declare sequence inputs)
+        "dense_vector": data_type.dense_vector,
+        "integer_value": data_type.integer_value,
+        "integer_value_sequence": data_type.integer_value_sequence,
+        "dense_vector_sequence": data_type.dense_vector_sequence,
+        # config plumbing
+        "settings": settings,
+        "outputs": outputs,
+        "get_config_arg": get_config_arg,
+    }
+    return ns
+
+
+def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
+    """Execute a reference-style v2 config (path to a .py file or its
+    source text) and collect its ``outputs``/``settings``.
+
+    ``config_arg_str``: the reference's "k1=v1,k2=v2" command-line config
+    args, readable in the config via ``get_config_arg``."""
+    config_args: Dict[str, str] = {}
+    for pair in (p for p in config_arg_str.split(",") if p):
+        k, _, v = pair.partition("=")
+        config_args[k.strip()] = v.strip()
+    state: Dict[str, Any] = {"outputs": [], "settings": {}}
+    source = config
+    filename = "<v2-config>"
+    if "\n" not in str(config):
+        filename = str(config)
+        with open(filename) as f:
+            source = f.read()
+    ns = _helper_namespace(state, config_args)
+    exec(compile(source, filename, "exec"), ns)
+    if not state["outputs"]:
+        raise ValueError(
+            "v2 config declared no outputs(...) — nothing to build")
+    named = {o.name: o for o in state["outputs"]}
+    return ParsedConfig(outputs=state["outputs"], settings=state["settings"],
+                        layers=named)
+
+
+# ---------------------------------------------------------------------------
+# Declarative dict/JSON form (<- proto/ModelConfig.proto LayerConfig fields)
+# ---------------------------------------------------------------------------
+
+_ACTS = {None: None, "": None, "linear": act_mod.Linear,
+         "relu": act_mod.Relu, "sigmoid": act_mod.Sigmoid,
+         "tanh": act_mod.Tanh, "softmax": act_mod.Softmax}
+
+_POOLS = {"max": pooling_mod.Max, "MAX": pooling_mod.Max,
+          "avg": pooling_mod.Avg, "AVERAGE": pooling_mod.Avg,
+          "sum": pooling_mod.Sum, "SUM": pooling_mod.Sum}
+
+
+def parse_model_config(cfg) -> ParsedConfig:
+    """Build the DSL from a ModelConfig-like dict (or JSON string/path)::
+
+        {"layers": [
+            {"name": "word", "type": "data", "size": 10000,
+             "seq": true, "seq_len": 64},
+            {"name": "emb",  "type": "embedding", "size": 128,
+             "inputs": ["word"]},
+            {"name": "lstm", "type": "lstmemory", "size": 128,
+             "inputs": ["emb"]},
+            {"name": "pool", "type": "pool", "pooling_type": "max",
+             "inputs": ["lstm"]},
+            {"name": "prob", "type": "fc", "size": 2,
+             "active_type": "softmax", "inputs": ["pool"]},
+            {"name": "cost", "type": "multi-class-cross-entropy",
+             "inputs": ["prob", "label"]},
+            ...],
+         "output_layer_names": ["cost"]}
+
+    Field names mirror LayerConfig (name/type/size/inputs/active_type,
+    ModelConfig.proto); ``seq``/``seq_len`` replace the reference's
+    dataprovider-side sequence declaration (see module docstring)."""
+    if isinstance(cfg, str):
+        if "\n" not in cfg and cfg.endswith(".json"):
+            with open(cfg) as f:
+                cfg = json.load(f)
+        else:
+            cfg = json.loads(cfg)
+    built: Dict[str, L.Layer] = {}
+
+    def parents(spec) -> List[L.Layer]:
+        names = spec.get("inputs", [])
+        missing = [n for n in names if n not in built]
+        if missing:
+            raise ValueError(
+                f"layer {spec.get('name')!r}: inputs {missing} not declared "
+                f"earlier (layers must be topologically ordered)")
+        return [built[n] for n in names]
+
+    for spec in cfg["layers"]:
+        name, kind = spec["name"], spec["type"]
+        size = spec.get("size", 0)
+        act = _ACTS.get(spec.get("active_type"))
+        if spec.get("active_type") not in _ACTS:
+            raise ValueError(
+                f"layer {name!r}: unknown active_type "
+                f"{spec.get('active_type')!r}")
+        ins = parents(spec)
+        if kind == "data":
+            if spec.get("seq"):
+                itype = data_type.integer_value_sequence(
+                    size, spec.get("seq_len", 0))
+            elif spec.get("dtype") == "int":
+                itype = data_type.integer_value(size)
+            else:
+                itype = data_type.dense_vector(size)
+            node = L.data(name, itype)
+        elif kind == "fc":
+            node = L.fc(ins if len(ins) > 1 else ins[0], size=size, act=act,
+                        name=name)
+        elif kind == "embedding":
+            node = L.embedding(ins[0], size=size, name=name)
+        elif kind == "lstmemory":
+            node = L.lstmemory(ins[0], size=size or None,
+                               reverse=spec.get("reversed", False), name=name)
+        elif kind == "gru":
+            node = L.gru(ins[0], size=size,
+                         reverse=spec.get("reversed", False), name=name)
+        elif kind == "pool":
+            ptype = _POOLS.get(spec.get("pooling_type", "max"))
+            if ptype is None:
+                raise ValueError(f"layer {name!r}: unknown pooling_type "
+                                 f"{spec.get('pooling_type')!r}")
+            node = L.pooling(ins[0], pooling_type=ptype, name=name)
+        elif kind == "concat":
+            node = L.concat(ins, name=name)
+        elif kind == "dropout":
+            node = L.dropout(ins[0], dropout_rate=spec.get("dropout_rate",
+                                                           0.5), name=name)
+        elif kind == "maxid":
+            node = L.max_id(ins[0], name=name)
+        elif kind in ("multi-class-cross-entropy", "classification_cost"):
+            node = L.classification_cost(ins[0], ins[1], name=name)
+        elif kind in ("square_error", "mse"):
+            node = L.square_error_cost(ins[0], ins[1], name=name)
+        elif kind == "nce":
+            node = L.nce_cost(ins[0], ins[1], num_classes=size,
+                              num_neg_samples=spec.get("num_neg_samples", 10),
+                              name=name)
+        elif kind == "hsigmoid":
+            node = L.hsigmoid_cost(ins[0], ins[1], num_classes=size,
+                                   name=name)
+        else:
+            raise ValueError(
+                f"layer {name!r}: v2 layer type {kind!r} is outside the "
+                f"covered set (see README 'v2 boundary')")
+        built[name] = node
+    out_names = cfg.get("output_layer_names") or [cfg["layers"][-1]["name"]]
+    outputs = [built[n] for n in out_names]
+    return ParsedConfig(outputs=outputs, settings=cfg.get("settings", {}),
+                        layers=built)

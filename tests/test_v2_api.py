@@ -269,3 +269,93 @@ def test_v2_word2vec_nce_and_hsigmoid():
         first = np.mean(costs[:4])
         last = np.mean(costs[-4:])
         assert last < first * 0.9, (cost_kind, first, last)
+
+
+SENTIMENT_CONFIG = """
+# reference-style v2 trainer config (<- demo/sentiment style config files)
+dict_dim = get_config_arg("dict_dim", int, 100)
+seq_len = get_config_arg("seq_len", int, 12)
+settings(batch_size=32, learning_rate=0.05)
+
+words = data_layer("words", size=dict_dim,
+                   type=integer_value_sequence(dict_dim, seq_len))
+label = data_layer("label", size=2, type=integer_value(2))
+emb = embedding_layer(words, size=16)
+lstm = lstmemory(emb, size=16)
+pooled = pooling_layer(lstm, pooling_type=MaxPooling)
+prob = fc_layer(pooled, size=2, act=SoftmaxActivation())
+cost = classification_cost(input=prob, label=label)
+outputs(cost)
+"""
+
+
+def test_v2_config_file_front_door(tmp_path):
+    """parse_config executes a reference-style config FILE (the
+    trainer_config_helpers surface) and the result trains end to end —
+    the config_parser.py front door (VERDICT r4 item 9)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.v2 import parse_config
+
+    path = tmp_path / "sentiment_config.py"
+    path.write_text(SENTIMENT_CONFIG)
+    cfg = parse_config(str(path), "dict_dim=50,seq_len=10")
+    assert cfg.settings["batch_size"] == 32
+    assert len(cfg.outputs) == 1
+
+    main, startup, outs, feed_order, _ = cfg.to_program()
+    assert set(feed_order) == {"words", "label"}
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=5)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, (32, 10)).astype("int64")
+    lengths = np.full((32,), 10, "int32")
+    labels = (ids[:, :1] % 2).astype("int64")
+    losses = []
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(0.05).minimize(outs[0], startup)
+    exe.run(startup, scope=scope, seed=5)
+    for _ in range(12):
+        lv, = exe.run(main, feed={"words": ids, "words@len": lengths,
+                                  "label": labels},
+                      fetch_list=[outs[0]], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.8, losses[::3]
+
+
+def test_v2_config_declarative_dict():
+    """parse_model_config: the ModelConfig-like dict/JSON form builds the
+    same DSL; unknown layer types name the boundary."""
+    import json
+
+    import pytest as _pytest
+
+    from paddle_tpu.v2 import parse_model_config
+
+    cfg = {
+        "layers": [
+            {"name": "x", "type": "data", "size": 8},
+            {"name": "label", "type": "data", "size": 2, "dtype": "int"},
+            {"name": "h", "type": "fc", "size": 16, "active_type": "tanh",
+             "inputs": ["x"]},
+            {"name": "prob", "type": "fc", "size": 2,
+             "active_type": "softmax", "inputs": ["h"]},
+            {"name": "cost", "type": "multi-class-cross-entropy",
+             "inputs": ["prob", "label"]},
+        ],
+        "output_layer_names": ["cost"],
+    }
+    parsed = parse_model_config(json.dumps(cfg))
+    main, startup, outs, feed_order, _ = parsed.to_program()
+    assert set(feed_order) == {"x", "label"}
+
+    bad = {"layers": [{"name": "x", "type": "data", "size": 4},
+                      {"name": "r", "type": "rotated_conv", "size": 4,
+                       "inputs": ["x"]}]}
+    with _pytest.raises(ValueError, match="v2 boundary"):
+        parse_model_config(bad)
+
+    missing = {"layers": [{"name": "h", "type": "fc", "size": 4,
+                           "inputs": ["nope"]}]}
+    with _pytest.raises(ValueError, match="not declared"):
+        parse_model_config(missing)

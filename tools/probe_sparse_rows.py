@@ -1,0 +1,62 @@
+"""A/B: dense vs SelectedRows (is_sparse) embedding update on one chip.
+
+Where does the device-side sparse optimizer pay? The dense path streams the
+WHOLE table (scatter-add + optimizer pass ~7 passes over [V, E]); the sparse
+path sorts/merges the batch's ids and gathers/scatters only touched rows.
+Crossover is therefore set by table size vs batch rows.
+Usage: python tools/probe_sparse_rows.py [V] [E] [batch] [slots]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+from bench import _slope_time  # noqa: E402
+
+
+def run(V, E, B, S, is_sparse):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.param_attr import ParamAttr
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[S], dtype="int64")
+            y = fluid.layers.data("y", shape=[E], dtype="float32")
+            emb = fluid.layers.embedding(
+                ids, size=[V, E], is_sparse=is_sparse,
+                param_attr=ParamAttr("tab"))
+            pooled = fluid.layers.reduce_sum(emb, dim=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pooled, y))
+            fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=1)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    feed = {
+        "ids": jax.device_put(rng.randint(0, V, (B, S)).astype("int32"), dev),
+        "y": jax.device_put(rng.randn(B, E).astype("float32"), dev),
+    }
+    step, spread = _slope_time(
+        lambda: exe.run(main, feed=feed, fetch_list=[], scope=scope),
+        lambda: exe.run(main, feed=feed, fetch_list=[loss], scope=scope),
+        warmup=3, iters=40)
+    print(json.dumps({
+        "V": V, "E": E, "batch_rows": B * S, "is_sparse": is_sparse,
+        "step_ms": round(step * 1e3, 3),
+        "spread_ms": round(spread * 1e3, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    V = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    E = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    B = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    S = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+    for is_sparse in (False, True):
+        run(V, E, B, S, is_sparse)

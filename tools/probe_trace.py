@@ -7,7 +7,7 @@ bench.build_* function, so a config change in bench.py cannot
 desynchronize the audit from the benchmark.
 
 Usage: python tools/probe_trace.py {tlm,s2s,resnet,longcontext} [steps]
-       [dir] [batch]
+       [dir] [batch]   (batch override: tlm only)
 """
 import glob
 import os
@@ -32,6 +32,10 @@ def main():
 
     kw = {}
     if len(sys.argv) > 4:
+        if workload != "tlm":
+            raise SystemExit(
+                f"batch override is only supported for tlm (the other "
+                f"builders take no batch kwarg); got workload={workload}")
         kw["batch"] = int(sys.argv[4])
     run_step, fetch = BUILDERS[workload](**kw)
     for _ in range(3):
@@ -43,6 +47,9 @@ def main():
     fetch()
     jax.profiler.stop_trace()
     pbs = glob.glob(os.path.join(out, "**", "*.xplane.pb"), recursive=True)
+    if not pbs:
+        raise SystemExit(f"no *.xplane.pb produced under {out} — did the "
+                         f"profiler run on this backend?")
     pb = max(pbs, key=os.path.getmtime)
     print(f"trace: {pb}\n")
     import hlo_audit
