@@ -1047,10 +1047,11 @@ bool Exec::run_op(const JValue* op) {
       for (int64_t i = 0; i < N; i++) len[i] = l->data[i];
     }
     Tensor out;
+    // rank-2 input pools to [N] exactly like the Python op (reduce over
+    // axis 1); higher ranks keep the trailing feature dims
     out.shape = {N};
     for (size_t i = 2; i < x->shape.size(); i++)
       out.shape.push_back(x->shape[i]);
-    if (out.shape.size() == 1) out.shape.push_back(D);
     out.data.assign(N * D, 0.f);
     for (int64_t n = 0; n < N; n++) {
       int64_t L = (int64_t)std::llround(len[n]);

@@ -470,6 +470,14 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
 
     for table in (host_tables or []):
         tdir = _host_table_dir(cur, table.name, jax.process_index())
+        if not os.path.exists(os.path.join(tdir, "meta.json")):
+            # legacy layout fallback: early-r5 single-process checkpoints
+            # wrote the table dir without the @pN suffix
+            legacy = os.path.join(cur, "host_tables",
+                                  urllib.parse.quote(table.name, safe=""))
+            if (jax.process_index() == 0
+                    and os.path.exists(os.path.join(legacy, "meta.json"))):
+                tdir = legacy
         try:
             table.load(tdir)
         except FileNotFoundError as e:

@@ -296,11 +296,24 @@ def array_length(ctx, ins, attrs):
 # and the kernel dispatch — policies cannot drift between the two.
 # None/'nothing' = save nothing, full replay; 'dots' = selective
 # checkpointing keeping matmul/conv outputs (near-zero extra FLOPs,
-# memory between full remat and none)
+# memory between full remat and none); 'flash' = save ONLY the flash
+# attention kernel's named outputs (out + lse, ops/pallas_attention.py
+# _fa_fwd) so the backward replays elementwise/matmul glue but never
+# re-runs the Pallas forward — full remat minus the one segment member a
+# policy could not previously split (it rematerialized "as a UNIT")
 RECOMPUTE_POLICIES = {
     None: None,
     "nothing": None,
     "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "flash": jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse"),
+    # dots_flash: keep matmul outputs AND the flash kernel outputs — the
+    # backward replays only elementwise glue (near-zero extra FLOPs); the
+    # memory cost over 'flash' is the saved projection/FFN activations
+    "dots_flash": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")),
 }
 
 

@@ -286,6 +286,12 @@ def _ln_grad_maker(op, no_grad_set):
         "Mean": list(op.outputs.get("Mean", [])),
         "Variance": list(op.outputs.get("Variance", [])),
         "Y@GRAD": [grad_var_name(n) for n in op.outputs["Y"]],
+        # rare but public: a consumer of the stats outputs contributes
+        # gradient through them too (autodiff nulls these when unused)
+        "Mean@GRAD": [grad_var_name(n)
+                      for n in op.outputs.get("Mean", [])],
+        "Variance@GRAD": [grad_var_name(n)
+                          for n in op.outputs.get("Variance", [])],
     }
     outputs = {}
     for slot in ("X", "Scale", "Bias"):
@@ -331,7 +337,8 @@ def layer_norm(ctx, ins, attrs):
 
 @register_op(
     "layer_norm_grad",
-    inputs=("X", "Scale", "Bias", "Mean", "Variance", "Y@GRAD"),
+    inputs=("X", "Scale", "Bias", "Mean", "Variance", "Y@GRAD",
+            "Mean@GRAD", "Variance@GRAD"),
     outputs=("X@GRAD", "Scale@GRAD", "Bias@GRAD"),
     no_grad=True,
 )
@@ -340,7 +347,9 @@ def layer_norm_grad(ctx, ins, attrs):
     xhat = (x - mean) * rsqrt(var + eps)
     dScale = sum_rows(g * xhat); dBias = sum_rows(g)
     dX = inv * (dxhat - mean_f(dxhat) - xhat * mean_f(dxhat * xhat))
-    with dxhat = g * scale, means over the normalized axes per row."""
+    with dxhat = g * scale, means over the normalized axes per row.
+    Cotangents through the Mean/Variance OUTPUTS (rare, but they are
+    public op outputs) add dmean/n and dvar * 2(x - mean)/n."""
     x = ins["X"][0]
     g = ins["Y@GRAD"][0]
     eps = attrs.get("epsilon", 1e-5)
@@ -379,6 +388,16 @@ def layer_norm_grad(ctx, ins, attrs):
             b.shape).astype(b.dtype)]
     dx = inv * (dxhat - jnp.mean(dxhat, **kd)
                 - xhat * jnp.mean(dxhat * xhat, **kd))
+    n_feat = 1
+    for a in axes:
+        n_feat *= x.shape[a]
+    for slot, jac in (("Mean@GRAD", lambda dm: dm / n_feat),
+                      ("Variance@GRAD",
+                       lambda dv: dv * 2.0 * (x.astype(jnp.float32) - mean)
+                       / n_feat)):
+        if ins.get(slot) and ins[slot][0] is not None:
+            dstat = ins[slot][0].reshape(stat_shape).astype(jnp.float32)
+            dx = dx + jac(dstat)
     out["X@GRAD"] = [dx.astype(x.dtype)]
     return out
 

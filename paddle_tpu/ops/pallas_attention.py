@@ -517,6 +517,17 @@ def _fa_fwd(q, k, v, causal, scale, q_block, k_block, heads_per_block):
                                    q_block=q_block, k_block=k_block,
                                    return_lse=True,
                                    heads_per_block=heads_per_block)
+    # Name the kernel outputs for selective remat: under
+    # layers.recompute(policy="flash") (save_only_these_names) the segment
+    # replay keeps these two residuals and NEVER re-runs the Pallas
+    # forward in the backward — the r4 longcontext profile's biggest
+    # unexplored delta ("rematerializes as a UNIT that no policy can
+    # split", docs/perf.md). Outside a named policy checkpoint_name is
+    # identity.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -1,3 +1,5 @@
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
 from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401
+from .passes import (FunctionPass, Pass, PassManager, find_chains,  # noqa: F401
+                     splice_out)

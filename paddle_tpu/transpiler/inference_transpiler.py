@@ -2,7 +2,10 @@
 
 <- python/paddle/fluid/transpiler/inference_transpiler.py: its headline pass
 folds batch_norm into the preceding conv (fuse_batch_norm), mutating both the
-program and the parameter values in scope. Same pass here on our IR/scope.
+program and the parameter values in scope. Built on the reusable pass
+framework (transpiler/passes.py — the analysis::PassManager/subgraph
+splitter equivalent), so the fusion's matching logic is the shared
+``find_chains`` instead of an ad-hoc op-list walk.
 """
 from __future__ import annotations
 
@@ -10,28 +13,30 @@ import numpy as np
 
 from ..core.executor import Scope
 from ..core.ir import Program
+from .passes import Pass, PassManager, find_chains, splice_out
 
 
-class InferenceTranspiler:
-    def transpile(self, program: Program, place=None, scope: Scope = None):
-        """Fold conv2d + batch_norm(is_test) into conv2d with adjusted
-        weights/bias. Mutates ``program`` and ``scope`` in place."""
-        assert scope is not None, "InferenceTranspiler needs the scope holding weights"
+class FuseBatchNormPass(Pass):
+    """Fold conv2d + batch_norm(is_test) into conv2d with adjusted
+    weights/bias (<- inference_transpiler.py fuse_batch_norm). Mutates
+    program ops AND scope weights; the matcher's exclusivity rule
+    guarantees the conv output has no other consumer, so removing the
+    bn op cannot change an observable value."""
+
+    name = "fuse_batch_norm"
+
+    def apply(self, program: Program, scope=None) -> Program:
+        assert scope is not None, \
+            "fuse_batch_norm needs the scope holding weights"
         block = program.global_block()
-        ops = block.ops
-        i = 0
-        while i < len(ops) - 1:
-            op = ops[i]
-            nxt = ops[i + 1]
-            if (op.type == "conv2d" and nxt.type == "batch_norm"
-                    and op.output("Output") and nxt.input("X")
-                    and op.output("Output")[0] == nxt.input("X")[0]):
-                self._fold(block, op, nxt, scope)
-                # batch_norm's Y replaces conv output var
-                op.outputs["Output"] = [nxt.output("Y")[0]]
-                del ops[i + 1]
-                program._bump_version()
-            i += 1
+        chains = find_chains(block, ["conv2d", "batch_norm"],
+                             [("Output", "X")])
+        self.changed = bool(chains)  # no match -> keep jit caches warm
+        for conv_op, bn_op in chains:
+            self._fold(block, conv_op, bn_op, scope)
+            # batch_norm's Y replaces conv output var
+            conv_op.outputs["Output"] = [bn_op.output("Y")[0]]
+            splice_out(block, bn_op)
         return program
 
     def _fold(self, block, conv_op, bn_op, scope: Scope):
@@ -55,3 +60,10 @@ class InferenceTranspiler:
                              shape=new_bias.shape, persistable=True)
             scope.set(b_name, new_bias)
             conv_op.inputs["Bias"] = [b_name]
+
+
+class InferenceTranspiler:
+    """Public API kept from the reference; runs the pass pipeline."""
+
+    def transpile(self, program: Program, place=None, scope: Scope = None):
+        return PassManager([FuseBatchNormPass()]).run(program, scope=scope)

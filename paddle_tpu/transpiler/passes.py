@@ -1,0 +1,150 @@
+"""Program-pass framework: reusable pass manager + subgraph matcher.
+
+<- paddle/fluid/inference/analysis/pass_manager.h:46 (ordered DataFlowGraph
+passes with a uniform Initialize/Run/Finalize contract) and
+subgraph_splitter.h:34 (marking and fusing matched subgraphs). The
+reference grew these under its inference rewrites; here the same
+abstraction serves EVERY program-to-program transform — inference fusions
+(BN fold), quantization rewrites, memory transforms — instead of each
+transpiler hand-rolling its own op-list walk.
+
+Design (TPU-native, IR-level): a Pass rewrites ``Program`` (+ optionally
+the weight ``Scope``); a PassManager runs an ordered list with per-pass
+version bumps and an audit trail; ``find_chains`` is the subgraph-splitter
+equivalent for the dominant fusion shape — a producer/consumer chain of op
+types linked by var use — returning concrete op references a pass mutates.
+
+Example (the BN-fold pass, transpiler/inference_transpiler.py)::
+
+    class FuseBatchNormPass(Pass):
+        name = "fuse_batch_norm"
+        def apply(self, program, scope=None):
+            block = program.global_block()
+            for conv, bn in find_chains(block, ["conv2d", "batch_norm"],
+                                        [("Output", "X")]):
+                ...fold weights, splice ops...
+            return program
+
+    PassManager([FuseBatchNormPass()]).run(program, scope)
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..core.ir import Block, Operator, Program
+
+
+class Pass:
+    """One program-to-program rewrite (<- analysis::Pass). Subclasses set
+    ``name`` and implement ``apply``; mutating in place and returning the
+    same Program is fine. A pass that can tell whether it changed
+    anything should set ``self.changed`` accordingly — the manager then
+    skips the version bump for no-op passes (a bump invalidates every
+    executor jit cache entry for the program). The default (True) is the
+    safe side."""
+
+    name: str = "pass"
+    changed: bool = True
+
+    def apply(self, program: Program, scope=None) -> Program:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<Pass {self.name}>"
+
+
+class FunctionPass(Pass):
+    """Wrap a plain ``fn(program, scope) -> Program`` as a Pass."""
+
+    def __init__(self, name: str, fn: Callable[[Program, Optional[object]],
+                                               Program]):
+        self.name = name
+        self._fn = fn
+
+    def apply(self, program: Program, scope=None) -> Program:
+        return self._fn(program, scope)
+
+
+class PassManager:
+    """Ordered pass pipeline (<- PassManager::RunAll). ``run`` applies
+    each pass, records (pass name, ops before, ops after) in ``history``,
+    and bumps the program version once per applied pass."""
+
+    def __init__(self, passes: Sequence[Pass] = ()):
+        self.passes: List[Pass] = list(passes)
+        self.history: List[Tuple[str, int, int]] = []
+
+    def add(self, p: Pass) -> "PassManager":
+        self.passes.append(p)
+        return self
+
+    def run(self, program: Program, scope=None) -> Program:
+        for p in self.passes:
+            before = sum(len(b.ops) for b in program.blocks)
+            p.changed = True  # passes that know better overwrite in apply
+            program = p.apply(program, scope=scope)
+            after = sum(len(b.ops) for b in program.blocks)
+            if p.changed or after != before:
+                program._bump_version()
+            self.history.append((p.name, before, after))
+        return program
+
+
+def _produced(op: Operator, name: str) -> bool:
+    return any(name in names for names in op.outputs.values())
+
+
+def find_chains(block: Block, op_types: Sequence[str],
+                links: Sequence[Tuple[str, str]],
+                exclusive: bool = True) -> List[List[Operator]]:
+    """All producer/consumer chains matching ``op_types`` in ``block``.
+
+    ``links[i] = (out_slot, in_slot)``: op i's ``out_slot`` output var must
+    be op i+1's ``in_slot`` input var. With ``exclusive`` (the subgraph
+    splitter's safe-to-fuse rule) an interior link var may have NO other
+    consumer in the block, so fusing away the intermediate cannot change
+    a value any op observes. Caveat (the reference's subgraph splitter
+    shares it): fetch targets are chosen at RUN time, not recorded in the
+    IR — a caller who fetches an interior var of a fused chain fetches a
+    var no op produces anymore; run fusion passes before choosing fetch
+    targets (the save_inference_model flow does).
+    Returns op-object chains ordered as in the block; chains never share
+    an op (greedy, first match wins) so a pass may rewrite all of them in
+    one sweep."""
+    assert len(links) == len(op_types) - 1
+    chains: List[List[Operator]] = []
+    used: set = set()
+    ops = block.ops
+    for i, op in enumerate(ops):
+        if op.type != op_types[0] or id(op) in used:
+            continue
+        chain = [op]
+        for (out_slot, in_slot), want in zip(links, op_types[1:]):
+            cur = chain[-1]
+            outs = cur.outputs.get(out_slot) or []
+            if not outs:
+                chain = None
+                break
+            link_var = outs[0]
+            consumers = [o for o in ops
+                         if any(link_var in (o.inputs.get(s) or [])
+                                for s in o.inputs)]
+            nxt = next((o for o in consumers
+                        if o.type == want and id(o) not in used
+                        and link_var in (o.inputs.get(in_slot) or [])), None)
+            if nxt is None:
+                chain = None
+                break
+            if exclusive and len(consumers) > 1:
+                chain = None
+                break
+            chain.append(nxt)
+        if chain and len(chain) == len(op_types):
+            chains.append(chain)
+            used.update(id(o) for o in chain)
+    return chains
+
+
+def splice_out(block: Block, op: Operator) -> None:
+    """Remove one op from its block (the fuse step after a match)."""
+    block.ops.remove(op)

@@ -130,10 +130,17 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
         k, _, v = pair.partition("=")
         config_args[k.strip()] = v.strip()
     state: Dict[str, Any] = {"outputs": [], "settings": {}}
-    source = config
+    source = str(config)
     filename = "<v2-config>"
-    if "\n" not in str(config):
-        filename = str(config)
+    # path-vs-source: an existing file or a .py-suffixed name is a path
+    # (a missing .py path raises the natural FileNotFoundError); anything
+    # else — including single-line source like "outputs(...)" — executes
+    # as config source text
+    import os
+
+    if "\n" not in source and (os.path.exists(source)
+                               or source.endswith(".py")):
+        filename = source
         with open(filename) as f:
             source = f.read()
     ns = _helper_namespace(state, config_args)

@@ -338,7 +338,11 @@ def test_multiclass_nms_basic():
     assert np.all(kept[:, 0] == 1)
 
 
+@pytest.mark.slow
 def test_detection_map_perfect():
+    # slow tier (fast-tier budget, README <5 min): 13 s of DetectionMAP
+    # accumulation dominates; fast-tier coverage of the metric remains in
+    # test_training.py::test_detection_map_metric
     """Detections exactly matching gt -> mAP 1.0."""
     det = np.array([[[1, 0.9, 0, 0, 1, 1], [2, 0.8, 2, 2, 3, 3],
                      [-1, 0, 0, 0, 0, 0]]], np.float32)

@@ -463,3 +463,42 @@ def test_activation(name, fn):
     if name not in ("square",):  # square grad fine too but keep list small
         t2 = T()
         t2.check_grad(["X"], "Out", max_relative_error=2e-2)
+
+
+def test_layer_norm_grad_through_stats_outputs():
+    """The explicit layer_norm grad honors cotangents arriving through the
+    Mean/Variance OUTPUTS (they are public op outputs; the generic vjp
+    covered this and the r5 explicit grad must too). Oracle: jax.grad of
+    the forward kernel's combined outputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def, ExecContext
+
+    fwd = get_op_def("layer_norm").impl
+    bwd = get_op_def("layer_norm_grad").impl
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(3, 6).astype("float32"))
+    scale = jnp.asarray(rng.rand(6).astype("float32"))
+    bias = jnp.asarray(rng.rand(6).astype("float32"))
+    attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+    wy, wm, wv = 0.7, 1.3, -0.9  # mixed cotangent weights
+
+    def combined(x):
+        out = fwd(ExecContext(), {"X": [x], "Scale": [scale],
+                                  "Bias": [bias]}, attrs)
+        return (wy * jnp.sum(out["Y"][0]) + wm * jnp.sum(out["Mean"][0])
+                + wv * jnp.sum(out["Variance"][0]))
+
+    want = jax.grad(combined)(x)
+    out = fwd(ExecContext(), {"X": [x], "Scale": [scale], "Bias": [bias]},
+              attrs)
+    got = bwd(ExecContext(), {
+        "X": [x], "Scale": [scale], "Bias": [bias],
+        "Mean": out["Mean"], "Variance": out["Variance"],
+        "Y@GRAD": [jnp.full_like(out["Y"][0], wy)],
+        "Mean@GRAD": [jnp.full_like(out["Mean"][0], wm)],
+        "Variance@GRAD": [jnp.full_like(out["Variance"][0], wv)],
+    }, attrs)["X@GRAD"][0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)

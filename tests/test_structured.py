@@ -204,3 +204,45 @@ def test_chunk_eval_counts():
     assert niv == 2 and nlv == 2 and ncv == 1
     assert pv == pytest.approx(0.5) and rv == pytest.approx(0.5)
     assert fv == pytest.approx(0.5)
+
+
+def test_pass_manager_and_chain_matcher():
+    """The reusable program-pass framework (<- inference/analysis
+    pass_manager.h + subgraph_splitter.h): ordered passes with an audit
+    trail; find_chains honors the exclusivity (safe-to-fuse) rule."""
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler import (FunctionPass, PassManager,
+                                       find_chains)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1, 8, 8], dtype="float32")
+        # bias-free convs: with a bias the conv feeds an elementwise_add
+        # first and the 2-op pattern rightly does not match
+        c1 = fluid.layers.conv2d(x, 2, 3, bias_attr=False)  # -> bn, fusable
+        b1 = fluid.layers.batch_norm(c1, is_test=True)
+        c2 = fluid.layers.conv2d(b1, 2, 3, bias_attr=False)  # TWO consumers
+        b2 = fluid.layers.batch_norm(c2, is_test=True)
+        extra = fluid.layers.relu(c2)              # second consumer of c2
+        out = fluid.layers.elementwise_add(b2, extra)
+
+    block = main.global_block()
+    chains = find_chains(block, ["conv2d", "batch_norm"], [("Output", "X")])
+    assert len(chains) == 1  # c2 -> b2 excluded: c2 feeds relu too
+    assert chains[0][0].output("Output")[0] == c1.name
+    # non-exclusive matching sees both
+    loose = find_chains(block, ["conv2d", "batch_norm"], [("Output", "X")],
+                        exclusive=False)
+    assert len(loose) == 2
+
+    seen = []
+    pm = PassManager([
+        FunctionPass("count", lambda p, s: (seen.append(
+            sum(len(b.ops) for b in p.blocks)) or p)),
+        FunctionPass("noop", lambda p, s: p),
+    ])
+    v0 = main.version
+    pm.run(main)
+    assert [h[0] for h in pm.history] == ["count", "noop"]
+    assert main.version > v0  # jit caches can't serve the pre-pass program
+    assert seen and seen[0] == len(block.ops)

@@ -198,3 +198,85 @@ def test_recompute_dropout_consistent_grads():
     numeric = (float(lp) - float(lm)) / (2 * eps)
     analytic = float((gx * d).sum())
     np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-4)
+
+
+def test_recompute_policy_flash_saves_kernel_outputs():
+    """policy='flash' (VERDICT r4 item 2): the flash kernel's named
+    outputs (flash_out/flash_lse) are kept as remat residuals — the
+    backward replays projections/FFN glue but never re-runs the attention
+    forward. Structural check via jax.ad_checkpoint.saved_residuals;
+    model-level numerics vs full remat and vs no remat."""
+    import jax
+    import jax.numpy as jnp
+    from jax._src.ad_checkpoint import saved_residuals  # not re-exported
+
+    from paddle_tpu.ops.control_flow import RECOMPUTE_POLICIES
+    from paddle_tpu.ops.pallas_attention import flash_attention
+
+    # --- structural: only the named kernel outputs are saved ------------
+    def seg(q, k, v, w):
+        o = flash_attention(q, k, v, True)
+        return jnp.tanh(o.reshape(2, 16, 8) @ w).sum()
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 16, 2, 4).astype("float32"))
+    w = jnp.asarray(rng.randn(8, 8).astype("float32"))
+    ckpt = jax.checkpoint(seg, policy=RECOMPUTE_POLICIES["flash"])
+    saved = saved_residuals(ckpt, q, q, q, w)
+    names = [str(note) for _, note in saved]
+    # lse is saved under its checkpoint_name; the out tensor is saved too
+    # (jax labels it via the reduce_precision wrapper its name primitive
+    # inserts) — together the FA-2 backward's residuals (q,k,v args +
+    # out + lse) are all available, so the kernel forward never replays
+    assert any("flash_lse" in n for n in names), names
+    assert any(getattr(v, "shape", None) == q.shape and "argument" not in n
+               for (v, _), n in zip(saved, names)), names
+    # full remat saves only the arguments — the kernel outputs are NOT
+    # residuals, so its backward must re-run the flash forward
+    full = jax.checkpoint(seg)
+    fnames = [str(note) for _, note in saved_residuals(full, q, q, q, w)]
+    assert all("argument" in n for n in fnames), fnames
+
+    # grads identical across policies
+    g_flash = jax.grad(ckpt)(q, q, q, w)
+    g_full = jax.grad(full)(q, q, q, w)
+    g_none = jax.grad(seg)(q, q, q, w)
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_none),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_full), np.asarray(g_none),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_transformer_recompute_policy_flash_matches():
+    """Model-level: transformer_lm under policy='flash' trains identically
+    to full remat (same seed, same feeds). slow tier: two jit builds of a
+    2-layer model dominate (~27 s); the fast tier keeps the structural
+    saved-residuals test above."""
+    from paddle_tpu.models.transformer import transformer_lm
+
+    V, T = 40, 16
+
+    def run(policy):
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+                labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+                _, loss = transformer_lm(
+                    ids, labels, vocab_size=V, max_len=T, d_model=16,
+                    n_heads=2, n_layers=2, d_ff=32, use_recompute=True,
+                    recompute_policy=policy)
+                fluid.optimizer.Adam(0.01).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=7)
+        X = np.random.RandomState(1).randint(0, V, (4, T)).astype("int64")
+        out = []
+        for _ in range(3):
+            lv, = exe.run(main, feed={"ids": X, "labels": X},
+                          fetch_list=[loss], scope=scope)
+            out.append(float(lv))
+        return out
+
+    np.testing.assert_allclose(run("flash"), run(None), rtol=1e-5)

@@ -1,0 +1,87 @@
+"""Long-context LM A/B: remat policy x fused-head chunk variants.
+
+Usage: python tools/probe_lc.py "policy[,chunk=N][,noremat][,densehead]" ...
+policy in {nothing, flash, dots_flash, dots}
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+from bench import (LC_BATCH, LC_D, LC_LAYERS, LC_T, LC_VOCAB,  # noqa: E402
+                   PEAK_TFLOPS, _slope_time)
+
+
+def run(policy, chunk=4096, use_recompute=True, fused=True):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu import layers as L
+
+    orig = L.fused_linear_cross_entropy
+    if chunk != 4096:
+        def patched(x, size, label, param_attr=None, bias_attr=None,
+                    chunk_=chunk, name=None, **kw):
+            return orig(x, size, label, param_attr=param_attr,
+                        bias_attr=bias_attr, chunk=chunk_, name=name)
+        L.fused_linear_cross_entropy = patched
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                ids = fluid.layers.data("ids", shape=[LC_T], dtype="int64")
+                labels = fluid.layers.data("labels", shape=[LC_T],
+                                           dtype="int64")
+                _, loss = transformer_lm(
+                    ids, labels, vocab_size=LC_VOCAB, max_len=LC_T,
+                    d_model=LC_D, n_heads=8, n_layers=LC_LAYERS,
+                    d_ff=4 * LC_D, use_recompute=use_recompute,
+                    fused_head=fused, use_bias=False,
+                    recompute_policy=(None if policy in (None, "nothing")
+                                      else policy))
+                fluid.optimizer.Adam(1e-4).minimize(loss, startup)
+    finally:
+        L.fused_linear_cross_entropy = orig
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=17)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    X = jax.device_put(
+        rng.randint(0, LC_VOCAB, (LC_BATCH, LC_T)).astype("int32"), dev)
+    feed = {"ids": X, "labels": X}
+    step, spread = _slope_time(
+        lambda: exe.run(main, feed=feed, fetch_list=[], scope=scope),
+        lambda: exe.run(main, feed=feed, fetch_list=[loss], scope=scope),
+        warmup=2, iters=30)
+    tok_s = LC_BATCH * LC_T / step
+    n_params = (LC_LAYERS * (4 * LC_D * LC_D + 2 * LC_D * 4 * LC_D)
+                + LC_VOCAB * LC_D)
+    fpt = 6 * n_params + 6 * LC_LAYERS * LC_D * LC_T
+    print(json.dumps({
+        "policy": policy, "chunk": chunk, "remat": use_recompute,
+        "fused_head": fused,
+        "tok_s": round(tok_s, 1), "mfu": round(tok_s * fpt / 1e12
+                                               / PEAK_TFLOPS, 4),
+        "step_ms": round(step * 1e3, 2),
+        "spread_ms": round(spread * 1e3, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    for spec in sys.argv[1:]:
+        parts = spec.split(",")
+        policy = parts[0]
+        chunk = 4096
+        use_recompute = True
+        fused = True
+        for p in parts[1:]:
+            if p.startswith("chunk="):
+                chunk = int(p[6:])
+            elif p == "noremat":
+                use_recompute = False
+            elif p == "densehead":
+                fused = False
+        run(policy, chunk, use_recompute, fused)
