@@ -386,12 +386,12 @@ def build_longcontext_lm():
 
 
 def bench_longcontext_lm():
-    """Long-context / huge-vocab LM: T=4096, V=100k. The dense LM head's
-    logits alone are [B*T, V] f32 = 1.6 GB with same-size grads; the
-    streamed fused_linear_cross_entropy head (chunked vocab under an online
-    logsumexp, per-chunk recompute) is the config where that feature PAYS
-    (docs/perf.md 'Streamed LM head') — this line makes it driver-visible.
-    Uses recompute through the layer stack for the T=4096 activations."""
+    """Long-context / huge-vocab LM: T=4096, V=100k, B=1 — dense head, no
+    remat (the fastest CORRECT config at this size; the r5 ladder in
+    docs/perf.md "Long-context LM round 5" measured the streamed-head and
+    remat variants slower because B=1's logits and activations fit HBM).
+    fused_linear_cross_entropy and recompute_policy="flash" remain the
+    knobs for configs where they don't (B>=4 or T>=16k)."""
     run_step, fetch = build_longcontext_lm()
     step_time, spread = _slope_time(run_step, fetch, warmup=2, iters=30)
     tok_s = LC_BATCH * LC_T / step_time

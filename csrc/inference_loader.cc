@@ -8,10 +8,14 @@
 // is JAX/XLA from Python; this file is the DEPLOYMENT story: a
 // dependency-free C++ interpreter over the same serialized IR, covering
 // the inference op surface of the book models (fc = mul+add+act, conv2d,
-// pool2d, batch_norm(is_test), softmax, ...), CPU f32, exact op-for-op
-// program order — so a C++ server can load `save_inference_model` output
-// and serve it with zero Python. Exposed through a C API (ctypes tests +
-// the `demo_loader` main below).
+// pool2d, batch_norm(is_test), softmax, sequence ops incl. the lstm
+// scan, ...), CPU f32, exact op-for-op program order — so a C++ server
+// can load `save_inference_model` output and serve it with zero Python.
+// It also TRAINS: a saved TRAINING program (io.save_training_model —
+// forward + grad + sgd ops in the same IR) runs step after step via
+// ptinf_exec_train with parameter updates persisting across calls, the
+// reference's pure-C++ train/demo/demo_trainer.cc capability. Exposed
+// through a C API (ctypes tests + the `demo_loader` main below).
 //
 // Self-contained: minimal JSON parser + .npy (v1/v2) reader, no deps.
 #include <algorithm>
@@ -870,6 +874,157 @@ bool Exec::run_op(const JValue* op) {
     env[out_name(op, "Out")] = *x;  // f32-only runtime
     return true;
   }
+  // --- training op surface (<- train/demo/demo_trainer.cc: the reference
+  // trains a saved fit_a_line program from pure C++; same capability here:
+  // the exported TRAINING program carries grad + optimizer ops as ordinary
+  // IR ops, so the interpreter only needs their kernels) ------------------
+  if (type == "fill_constant") {
+    Tensor out;
+    for (int64_t d : jints(op, "shape", {})) out.shape.push_back(d);
+    out.data.assign(out.numel(), (float)jnum(op, "value", 0.0));
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "mean") {
+    Tensor* x;
+    if (!need(op, "X", &x)) return false;
+    double s = 0;
+    for (float v : x->data) s += v;
+    Tensor out;
+    out.shape = {};
+    out.data.assign(1, (float)(s / (double)x->numel()));
+    env[out_name(op, "Out")] = std::move(out);
+    return true;
+  }
+  if (type == "mean_grad") {
+    Tensor *x, *g;
+    if (!need(op, "X", &x) || !need(op, "Out@GRAD", &g)) return false;
+    Tensor out;
+    out.shape = x->shape;
+    out.data.assign(x->numel(), g->data[0] / (float)x->numel());
+    env[out_name(op, "X@GRAD")] = std::move(out);
+    return true;
+  }
+  if (type == "square_error_cost" || type == "square_error_cost_grad") {
+    Tensor *x, *y;
+    if (!need(op, "X", &x) || !need(op, "Y", &y)) return false;
+    if (x->shape != y->shape)
+      return fail(type + ": shape mismatch");
+    if (type == "square_error_cost") {
+      Tensor out = *x;
+      for (int64_t i = 0; i < out.numel(); i++) {
+        float d = x->data[i] - y->data[i];
+        out.data[i] = d * d;
+      }
+      env[out_name(op, "Out")] = std::move(out);
+      return true;
+    }
+    Tensor* g;
+    if (!need(op, "Out@GRAD", &g)) return false;
+    std::string gx = out_name(op, "X@GRAD"), gy = out_name(op, "Y@GRAD");
+    Tensor dx = *x;
+    for (int64_t i = 0; i < dx.numel(); i++)
+      dx.data[i] = 2.f * (x->data[i] - y->data[i]) * g->data[i];
+    if (!gy.empty()) {
+      Tensor dy = dx;
+      for (auto& v : dy.data) v = -v;
+      env[gy] = std::move(dy);
+    }
+    if (!gx.empty()) env[gx] = std::move(dx);
+    return true;
+  }
+  if (type == "elementwise_add_grad") {
+    Tensor *x, *y, *g;
+    if (!need(op, "X", &x) || !need(op, "Y", &y) ||
+        !need(op, "Out@GRAD", &g))
+      return false;
+    std::string gx = out_name(op, "X@GRAD"), gy = out_name(op, "Y@GRAD");
+    if (!gx.empty()) env[gx] = *g;  // same shape as Out
+    if (!gy.empty()) {
+      // reduce Out@GRAD over the axes Y broadcast across (the reference's
+      // alignment rule, mirrored from ew_binary)
+      int xr = (int)x->shape.size(), yr = (int)y->shape.size();
+      int axis = (int)jnum(op, "axis", -1);
+      if (axis < 0) axis = xr - yr;
+      std::vector<int64_t> ys(xr, 1);
+      for (int i = 0; i < yr; i++) ys[axis + i] = y->shape[i];
+      Tensor dy;
+      dy.shape = y->shape;
+      dy.data.assign(y->numel(), 0.f);
+      std::vector<int64_t> xstr(xr, 1);
+      for (int i = xr - 2; i >= 0; i--)
+        xstr[i] = xstr[i + 1] * x->shape[i + 1];
+      std::vector<int64_t> ycum(xr, 0);
+      int64_t s = 1;
+      for (int i = xr - 1; i >= 0; i--) {
+        ycum[i] = (ys[i] == 1) ? 0 : s;
+        s *= ys[i];
+      }
+      for (int64_t f = 0; f < g->numel(); f++) {
+        int64_t yoff = 0, rem = f;
+        for (int i = 0; i < xr; i++) {
+          int64_t c = rem / xstr[i];
+          rem -= c * xstr[i];
+          if (ycum[i]) yoff += c * ycum[i];
+        }
+        dy.data[yoff] += g->data[f];
+      }
+      env[gy] = std::move(dy);
+    }
+    return true;
+  }
+  if (type == "mul_grad") {
+    Tensor *x, *y, *g;
+    if (!need(op, "X", &x) || !need(op, "Y", &y) ||
+        !need(op, "Out@GRAD", &g))
+      return false;
+    int xnc = (int)jnum(op, "x_num_col_dims", 1);
+    int ync = (int)jnum(op, "y_num_col_dims", 1);
+    int64_t M = 1, K = 1, N = 1;
+    for (int i = 0; i < xnc; i++) M *= x->shape[i];
+    for (size_t i = xnc; i < x->shape.size(); i++) K *= x->shape[i];
+    for (size_t i = ync; i < y->shape.size(); i++) N *= y->shape[i];
+    std::string gx = out_name(op, "X@GRAD"), gy = out_name(op, "Y@GRAD");
+    if (!gx.empty()) {  // dX = g @ Y^T : [M, K]
+      Tensor dx;
+      dx.shape = x->shape;
+      dx.data.assign(M * K, 0.f);
+      for (int64_t i = 0; i < M; i++)
+        for (int64_t j = 0; j < N; j++) {
+          float gv = g->data[i * N + j];
+          for (int64_t k = 0; k < K; k++)
+            dx.data[i * K + k] += gv * y->data[k * N + j];
+        }
+      env[gx] = std::move(dx);
+    }
+    if (!gy.empty()) {  // dY = X^T @ g : [K, N]
+      Tensor dy;
+      dy.shape = y->shape;
+      dy.data.assign(K * N, 0.f);
+      for (int64_t i = 0; i < M; i++)
+        for (int64_t k = 0; k < K; k++) {
+          float xv = x->data[i * K + k];
+          for (int64_t j = 0; j < N; j++)
+            dy.data[k * N + j] += xv * g->data[i * N + j];
+        }
+      env[gy] = std::move(dy);
+    }
+    return true;
+  }
+  if (type == "sgd") {
+    Tensor *p, *g, *lr;
+    if (!need(op, "Param", &p) || !need(op, "Grad", &g) ||
+        !need(op, "LearningRate", &lr))
+      return false;
+    if (!in_name(op, "GradIds").empty())
+      return fail("sgd: SelectedRows grads unsupported in native runtime");
+    Tensor out = *p;
+    float l = lr->data[0];
+    for (int64_t i = 0; i < out.numel(); i++)
+      out.data[i] -= l * g->data[i];
+    env[out_name(op, "ParamOut")] = std::move(out);
+    return true;
+  }
   if (type == "sum") {
     // elementwise sum over the X list (<- sum_op.cc; ops/basic.py sum)
     const JValue* ins_j = op->get("inputs");
@@ -1193,13 +1348,26 @@ const char* ptinf_param_name(void* h, uint64_t i) {
   return i < m->params.size() ? m->params[i].name.c_str() : "";
 }
 
+// After ptinf_exec_train, the LIVE weights are the f32 param_cache (the
+// trained values); the param accessors serve those so a trainer can
+// extract what it learned. Before any exec the cache is empty and the
+// accessors serve the as-loaded .npy bytes.
+static Tensor* cached_param(Model* m, uint64_t i) {
+  if (i >= m->params.size()) return nullptr;
+  auto it = m->param_cache.find(m->params[i].name);
+  return it == m->param_cache.end() ? nullptr : &it->second;
+}
+
 const char* ptinf_param_dtype(void* h, uint64_t i) {
   auto* m = static_cast<Model*>(h);
+  if (cached_param(m, i)) return "<f4";  // the cache is f32
   return i < m->params.size() ? m->params[i].tensor.dtype.c_str() : "";
 }
 
 int ptinf_param_ndim(void* h, uint64_t i) {
   auto* m = static_cast<Model*>(h);
+  Tensor* c = cached_param(m, i);
+  if (c) return static_cast<int>(c->shape.size());
   return i < m->params.size() ? static_cast<int>(m->params[i].tensor.shape.size())
                               : -1;
 }
@@ -1207,7 +1375,8 @@ int ptinf_param_ndim(void* h, uint64_t i) {
 int64_t ptinf_param_dim(void* h, uint64_t i, int d) {
   auto* m = static_cast<Model*>(h);
   if (i >= m->params.size()) return -1;
-  auto& s = m->params[i].tensor.shape;
+  Tensor* c = cached_param(m, i);
+  auto& s = c ? c->shape : m->params[i].tensor.shape;
   return d < static_cast<int>(s.size()) ? s[d] : -1;
 }
 
@@ -1216,6 +1385,11 @@ const uint8_t* ptinf_param_data(void* h, uint64_t i, uint64_t* nbytes) {
   if (i >= m->params.size()) {
     *nbytes = 0;
     return nullptr;
+  }
+  Tensor* c = cached_param(m, i);
+  if (c) {
+    *nbytes = c->data.size() * sizeof(float);
+    return reinterpret_cast<const uint8_t*>(c->data.data());
   }
   *nbytes = m->params[i].tensor.data.size();
   return m->params[i].tensor.data.data();
@@ -1226,10 +1400,9 @@ void ptinf_close(void* h) { delete static_cast<Model*>(h); }
 // --- execution C API -------------------------------------------------------
 // ptinf_exec: run block 0 of the loaded program over the given f32 feeds;
 // fetch results via ptinf_fetch_*. Returns 1 on success (0: ptinf_error).
-int ptinf_exec(void* h, const char** feed_names, const float** feed_data,
-               const int64_t** feed_shapes, const int* feed_ndims,
-               int n_feeds) {
-  auto* m = static_cast<Model*>(h);
+static int exec_impl(Model* m, const char** feed_names,
+                     const float** feed_data, const int64_t** feed_shapes,
+                     const int* feed_ndims, int n_feeds, int train) {
   if (!m->param_cache_ready) {
     // convert weights to f32 ONCE; every exec reads them in place
     for (auto& p : m->params) {
@@ -1256,6 +1429,18 @@ int ptinf_exec(void* h, const char** feed_names, const float** feed_data,
     return 0;
   }
   m->error.clear();
+  if (train) {
+    // training step: optimizer ops wrote ParamOut under the param names
+    // into env — persist them so the next step reads updated weights
+    // (<- demo_trainer.cc's Executor mutating its scope across batches).
+    // COPY (not move), and BEFORE the fetch extraction: a fetch target may
+    // alias a param name, and a moved-from weight would corrupt either
+    // the cache or the fetch.
+    for (auto& p : m->params) {
+      auto it = ex.env.find(p.name);
+      if (it != ex.env.end()) m->param_cache[p.name] = it->second;
+    }
+  }
   m->fetch_results.clear();
   for (auto& f : m->fetches) {
     auto it = ex.env.find(f);
@@ -1266,6 +1451,24 @@ int ptinf_exec(void* h, const char** feed_names, const float** feed_data,
     }
   }
   return 1;
+}
+
+int ptinf_exec(void* h, const char** feed_names, const float** feed_data,
+               const int64_t** feed_shapes, const int* feed_ndims,
+               int n_feeds) {
+  return exec_impl(static_cast<Model*>(h), feed_names, feed_data,
+                   feed_shapes, feed_ndims, n_feeds, 0);
+}
+
+// ptinf_exec_train: run one TRAINING step of a saved training program
+// (io.save_training_model output) — identical to ptinf_exec except
+// parameter updates survive into the next call. Pure-C++ training,
+// the train/demo/demo_trainer.cc capability.
+int ptinf_exec_train(void* h, const char** feed_names,
+                     const float** feed_data, const int64_t** feed_shapes,
+                     const int* feed_ndims, int n_feeds) {
+  return exec_impl(static_cast<Model*>(h), feed_names, feed_data,
+                   feed_shapes, feed_ndims, n_feeds, 1);
 }
 
 static Tensor* fetch_tensor(Model* m, uint64_t i) {

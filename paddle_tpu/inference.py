@@ -64,6 +64,8 @@ def _lib():
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
                 ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+            lib.ptinf_exec_train.restype = ctypes.c_int
+            lib.ptinf_exec_train.argtypes = lib.ptinf_exec.argtypes
             lib.ptinf_fetch_data.restype = ctypes.POINTER(ctypes.c_float)
             lib.ptinf_fetch_data.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                              ctypes.POINTER(ctypes.c_uint64)]
@@ -131,6 +133,18 @@ class NativeModelLoader:
         """EXECUTE the loaded program in the C++ runtime (f32 interpreter
         over block 0 — the reference's C++ Executor::Run role,
         inference/io.h:30). Returns one array per fetch target."""
+        return self._exec(feed, train=False)
+
+    def train_step(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """One TRAINING step of a saved training program
+        (io.save_training_model): same execution, but parameter updates
+        written by the program's optimizer ops persist into the next call
+        — pure-C++ training, the reference's train/demo/demo_trainer.cc
+        capability."""
+        return self._exec(feed, train=True)
+
+    def _exec(self, feed: Dict[str, np.ndarray],
+              train: bool) -> List[np.ndarray]:
         names = list(feed)
         arrs = [np.ascontiguousarray(np.asarray(feed[n], dtype=np.float32))
                 for n in names]
@@ -144,8 +158,8 @@ class NativeModelLoader:
             *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
               for s in shapes])
         c_ndims = (ctypes.c_int * len(names))(*[a.ndim for a in arrs])
-        ok = self._lib.ptinf_exec(self._h, c_names, c_data, c_shapes,
-                                  c_ndims, len(names))
+        fn = self._lib.ptinf_exec_train if train else self._lib.ptinf_exec
+        ok = fn(self._h, c_names, c_data, c_shapes, c_ndims, len(names))
         if not ok:
             raise RuntimeError(
                 "native execution failed: "

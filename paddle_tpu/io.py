@@ -395,6 +395,32 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return fetch_names
 
 
+def save_training_model(dirname, feeded_var_names, fetch_targets, executor,
+                        main_program=None, scope=None):
+    """Export the FULL training program (forward + grad + optimizer ops)
+    plus every persistable it touches — the saved-program-that-trains the
+    reference's pure-C++ demo consumes (train/demo/demo_trainer.cc loads a
+    ProgramDesc and runs Executor over it batch after batch). Unlike
+    ``save_inference_model`` nothing is pruned: grad and optimizer ops ARE
+    the point. Serve with NativeModelLoader.train_step."""
+    program = main_program or default_main_program()
+    fetch_names = [t if isinstance(t, str) else t.name for t in fetch_targets]
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": program.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
+        json.dump(meta, f)
+    referenced = {n for op in program.global_block().ops
+                  for n in list(op.input_names) + list(op.output_names)}
+    vars = [v for v in program.list_vars()
+            if v.persistable and v.name in referenced]
+    save_vars(executor, dirname, program, vars=vars, scope=scope)
+    return fetch_names
+
+
 def load_inference_model(dirname, executor, scope=None):
     """Returns (program, feed_names, fetch_names); params loaded into scope."""
     with open(os.path.join(dirname, MODEL_FILENAME)) as f:

@@ -1,7 +1,7 @@
 """Round-5 transformer A/B probe: batch / bias / attention-packing variants.
 
 Model-level slope timing (the authoritative instrument, docs/perf.md).
-Usage: python tools/probe_tlm_r5.py "B[,nobias][,hb=N]" ...
+Usage: python tools/probe_tlm_r5.py "B[,nobias][,hb=N][,fusedqkv]" ...
 e.g. python tools/probe_tlm_r5.py 8 8,nobias 8,nobias,hb=2
 """
 import json
@@ -14,7 +14,7 @@ from bench import (PEAK_TFLOPS, TLM_D, TLM_FF, TLM_LAYERS, TLM_T,  # noqa: E402
                    TLM_VOCAB, _slope_time)
 
 
-def run(batch, use_bias=True, hb=None):
+def run(batch, use_bias=True, hb=None, fused_qkv=False):
     import jax
 
     import paddle_tpu as fluid
@@ -36,7 +36,7 @@ def run(batch, use_bias=True, hb=None):
             _, loss = tmod.transformer_lm(
                 ids, labels, vocab_size=TLM_VOCAB, max_len=TLM_T,
                 d_model=TLM_D, n_heads=8, n_layers=TLM_LAYERS,
-                d_ff=TLM_FF, use_bias=use_bias)
+                d_ff=TLM_FF, use_bias=use_bias, fused_qkv=fused_qkv)
             fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
     finally:
         layers.flash_attention = orig
@@ -59,7 +59,7 @@ def run(batch, use_bias=True, hb=None):
     flops_per_token = 6 * n_params + 6 * TLM_LAYERS * TLM_D * TLM_T
     mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
     print(json.dumps({
-        "batch": batch, "bias": use_bias, "hb": hb,
+        "batch": batch, "bias": use_bias, "hb": hb, "fused_qkv": fused_qkv,
         "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "spread_ms": round(spread * 1e3, 2)}), flush=True)
@@ -71,7 +71,8 @@ if __name__ == "__main__":
         batch = int(parts[0])
         use_bias = "nobias" not in parts[1:]
         hb = None
+        fused_qkv = "fusedqkv" in parts[1:]
         for p in parts[1:]:
             if p.startswith("hb="):
                 hb = int(p[3:])
-        run(batch, use_bias, hb)
+        run(batch, use_bias, hb, fused_qkv)
