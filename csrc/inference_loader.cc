@@ -317,6 +317,7 @@ struct Model {
   // across runs); only the fetch tensors of the last run are retained
   std::map<std::string, struct Tensor> param_cache;
   bool param_cache_ready = false;
+  bool trained = false;  // a ptinf_exec_train step ran: cache = live weights
   std::map<std::string, struct Tensor> fetch_results;
   Model();
   ~Model();
@@ -1350,10 +1351,14 @@ const char* ptinf_param_name(void* h, uint64_t i) {
 
 // After ptinf_exec_train, the LIVE weights are the f32 param_cache (the
 // trained values); the param accessors serve those so a trainer can
-// extract what it learned. Before any exec the cache is empty and the
-// accessors serve the as-loaded .npy bytes.
+// extract what it learned. Until a TRAINING step runs they serve the
+// as-loaded .npy bytes.
 static Tensor* cached_param(Model* m, uint64_t i) {
-  if (i >= m->params.size()) return nullptr;
+  // only a TRAINING step makes the cache the live weights; a plain
+  // inference exec also fills param_cache (the f32 conversion), and
+  // serving that would silently change the accessors' dtype/bytes for
+  // e.g. f64-saved params after any warm-up call
+  if (!m->trained || i >= m->params.size()) return nullptr;
   auto it = m->param_cache.find(m->params[i].name);
   return it == m->param_cache.end() ? nullptr : &it->second;
 }
@@ -1440,6 +1445,7 @@ static int exec_impl(Model* m, const char** feed_names,
       auto it = ex.env.find(p.name);
       if (it != ex.env.end()) m->param_cache[p.name] = it->second;
     }
+    m->trained = true;
   }
   m->fetch_results.clear();
   for (auto& f : m->fetches) {

@@ -45,8 +45,8 @@ class MovieInfo:
                 [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
 
     def __repr__(self):
-        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
-            self.index, self.title, self.categories)
+        return (f"MovieInfo(index={self.index}, title={self.title!r}, "
+                f"categories={self.categories!r})")
 
 
 class UserInfo:
@@ -62,9 +62,9 @@ class UserInfo:
         return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
 
     def __repr__(self):
-        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
-            self.index, "M" if self.is_male else "F",
-            age_table[self.age], self.job_id)
+        gender = "M" if self.is_male else "F"
+        return (f"UserInfo(index={self.index}, gender={gender}, "
+                f"age={age_table[self.age]}, job_id={self.job_id})")
 
 
 MOVIE_INFO = None

@@ -413,7 +413,9 @@ def save_training_model(dirname, feeded_var_names, fetch_targets, executor,
     }
     with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
         json.dump(meta, f)
-    referenced = {n for op in program.global_block().ops
+    # scan EVERY block: control-flow bodies (While/StaticRNN/DynamicRNN)
+    # live in sub-blocks and reference their recurrent weights only there
+    referenced = {n for blk in program.blocks for op in blk.ops
                   for n in list(op.input_names) + list(op.output_names)}
     vars = [v for v in program.list_vars()
             if v.persistable and v.name in referenced]

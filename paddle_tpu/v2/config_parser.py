@@ -12,7 +12,7 @@ a script importing our DSL) has an entry point::
     cfg = parse_config("sentiment_config.py", "dict_dim=10000")
     main, startup, outs, feed_order, _ = layer.to_program(cfg.outputs)
 
-Covered layer kinds = exactly the v2 DSL's (~17, see v2/layer.py); anything
+Covered layer kinds = exactly the v2 DSL's (~20, see v2/layer.py); anything
 else raises with the layer name. Known deviations (README "v2 boundary"):
 
 * whether a data layer is a sequence comes from the config (``type=`` /
@@ -90,6 +90,9 @@ def _helper_namespace(state: dict, config_args: Dict[str, str]):
         "concat_layer": L.concat,
         "dropout_layer": L.dropout,
         "maxid_layer": L.max_id,
+        "img_conv_layer": L.img_conv,
+        "img_pool_layer": L.img_pool,
+        "batch_norm_layer": L.batch_norm,
         "classification_cost": L.classification_cost,
         "cross_entropy_cost": L.cross_entropy_cost,
         "regression_cost": L.square_error_cost,
@@ -247,6 +250,25 @@ def parse_model_config(cfg) -> ParsedConfig:
                                                            0.5), name=name)
         elif kind == "maxid":
             node = L.max_id(ins[0], name=name)
+        elif kind in ("conv", "exconv"):
+            node = L.img_conv(ins[0], filter_size=spec.get("filter_size", 3),
+                              num_filters=size,
+                              num_channels=spec.get("num_channels"),
+                              stride=spec.get("stride", 1),
+                              padding=spec.get("padding", 0), act=act,
+                              name=name)
+        elif kind == "pool2d":
+            ptype = _POOLS.get(spec.get("pooling_type", "max"))
+            if ptype is None:
+                raise ValueError(f"layer {name!r}: unknown pooling_type "
+                                 f"{spec.get('pooling_type')!r}")
+            node = L.img_pool(ins[0], pool_size=spec.get("pool_size", 2),
+                              pool_type=ptype, stride=spec.get("stride"),
+                              padding=spec.get("padding", 0),
+                              num_channels=spec.get("num_channels"),
+                              name=name)
+        elif kind == "batch_norm":
+            node = L.batch_norm(ins[0], act=act, name=name)
         elif kind in ("multi-class-cross-entropy", "classification_cost"):
             node = L.classification_cost(ins[0], ins[1], name=name)
         elif kind in ("square_error", "mse"):

@@ -24,7 +24,8 @@ from .data_type import InputType
 __all__ = ["data", "fc", "embedding", "pooling", "lstmemory", "gru",
            "concat", "cross_entropy_cost", "classification_cost",
            "square_error_cost", "mse_cost", "max_id", "dropout",
-           "nce_cost", "hsigmoid_cost", "parse_network"]
+           "nce_cost", "hsigmoid_cost", "img_conv", "img_pool",
+           "batch_norm", "parse_network"]
 
 _DEFAULT_SEQ_LEN = 128
 
@@ -229,6 +230,73 @@ def dropout(input, dropout_rate: float = 0.5, name=None, **kw) -> Layer:
         return F.dropout(parents[0], dropout_prob=dropout_rate)
 
     return Layer("dropout", [input], build, name=name)
+
+
+def _as_nchw(v, num_channels):
+    """v2 image layers ride flat dense inputs (<- config_parser: data
+    layers declare size=C*H*W and the parser infers square H=W from
+    size/channels). Rank-2 [N, C*H*W] reshapes to [N, C, H, W]; rank-4
+    passes through."""
+    shape = v.shape
+    if shape is not None and len(shape) == 4:
+        return v
+    if num_channels is None:
+        raise ValueError(
+            "v2 img layer on a flat input needs num_channels= (the "
+            "reference's config_parser required it on the first conv)")
+    dim = int(shape[-1])
+    hw = dim // int(num_channels)
+    side = int(round(hw ** 0.5))
+    if side * side != hw:
+        raise ValueError(
+            f"v2 img layer: size {dim} / channels {num_channels} is not a "
+            f"square image (the reference assumed square)")
+    return F.reshape(v, [0, int(num_channels), side, side])
+
+
+def img_conv(input, filter_size: int, num_filters: int, num_channels=None,
+             stride: int = 1, padding: int = 0, act=None, name=None,
+             **kw) -> Layer:
+    """<- trainer_config_helpers img_conv_layer (gserver ConvLayer)."""
+
+    def build(ctx, parents):
+        x = _as_nchw(parents[0], num_channels)
+        return F.conv2d(x, num_filters=num_filters, filter_size=filter_size,
+                        stride=stride, padding=padding, act=_act_name(act))
+
+    return Layer("img_conv", [input], build, name=name)
+
+
+def img_pool(input, pool_size: int, pool_type=pooling_mod.Max,
+             stride=None, padding: int = 0, num_channels=None, name=None,
+             **kw) -> Layer:
+    """<- trainer_config_helpers img_pool_layer (gserver PoolLayer).
+    Spatial pooling supports max/avg (pool2d's kinds); Sum is a SEQUENCE
+    pooling type and raises here rather than silently becoming avg."""
+    kinds = {"MAX": "max", "AVERAGE": "avg"}
+    pname = getattr(pool_type, "name", str(pool_type))
+    if pname not in kinds:
+        raise ValueError(
+            f"img_pool supports Max/Avg pooling, got {pname!r}")
+    ptype = kinds[pname]
+
+    def build(ctx, parents):
+        x = _as_nchw(parents[0], num_channels)
+        return F.pool2d(x, pool_size=pool_size, pool_type=ptype,
+                        pool_stride=stride or pool_size,
+                        pool_padding=padding)
+
+    return Layer("img_pool", [input], build, name=name)
+
+
+def batch_norm(input, act=None, name=None, **kw) -> Layer:
+    """<- trainer_config_helpers batch_norm_layer (gserver BatchNormLayer);
+    training-mode statistics, folded for inference by the BN-fold pass."""
+
+    def build(ctx, parents):
+        return F.batch_norm(parents[0], act=_act_name(act))
+
+    return Layer("batch_norm", [input], build, name=name)
 
 
 def max_id(input, name=None, **kw) -> Layer:

@@ -359,3 +359,42 @@ def test_v2_config_declarative_dict():
                            "inputs": ["nope"]}]}
     with _pytest.raises(ValueError, match="not declared"):
         parse_model_config(missing)
+
+
+IMG_CONFIG = """
+# reference-style image-classification config (<- demo/image_classification)
+settings(batch_size=32, learning_rate=0.05)
+img = data_layer("img", size=3 * 16 * 16)
+c1 = img_conv_layer(img, filter_size=3, num_filters=8, num_channels=3,
+                    padding=1, act=ReluActivation())
+b1 = batch_norm_layer(c1, act=ReluActivation())
+p1 = img_pool_layer(b1, pool_size=2, pool_type=MaxPooling)
+prob = fc_layer(p1, size=4, act=SoftmaxActivation())
+label = data_layer("label", size=4, type=integer_value(4))
+outputs(classification_cost(input=prob, label=label))
+"""
+
+
+def test_v2_config_image_classification_trains(tmp_path):
+    """The image-layer kinds (img_conv/img_pool/batch_norm) reached from a
+    reference-style config file — the demo/image_classification shape."""
+    import paddle_tpu as fluid
+    from paddle_tpu.v2 import parse_config
+
+    cfg = parse_config(IMG_CONFIG)
+    main, startup, outs, feed_order, _ = cfg.to_program()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(0.02).minimize(outs[0], startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=3)
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 3 * 16 * 16).astype("float32")
+    ybits = (x.reshape(32, -1).mean(1) > 0.5).astype("int64")
+    y = (ybits * 2)[:, None]  # classes {0, 2}: learnable from the mean
+    losses = []
+    for _ in range(12):
+        lv, = exe.run(main, feed={"img": x, "label": y},
+                      fetch_list=[outs[0]], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7, losses[::3]
