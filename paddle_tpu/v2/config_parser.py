@@ -263,7 +263,7 @@ def parse_model_config(cfg) -> ParsedConfig:
                 raise ValueError(f"layer {name!r}: unknown pooling_type "
                                  f"{spec.get('pooling_type')!r}")
             node = L.img_pool(ins[0], pool_size=spec.get("pool_size", 2),
-                              pool_type=ptype, stride=spec.get("stride"),
+                              pool_type=ptype, stride=spec.get("stride", 1),
                               padding=spec.get("padding", 0),
                               num_channels=spec.get("num_channels"),
                               name=name)

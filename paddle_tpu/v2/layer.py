@@ -268,11 +268,13 @@ def img_conv(input, filter_size: int, num_filters: int, num_channels=None,
 
 
 def img_pool(input, pool_size: int, pool_type=pooling_mod.Max,
-             stride=None, padding: int = 0, num_channels=None, name=None,
-             **kw) -> Layer:
+             stride: int = 1, padding: int = 0, num_channels=None,
+             name=None, **kw) -> Layer:
     """<- trainer_config_helpers img_pool_layer (gserver PoolLayer).
     Spatial pooling supports max/avg (pool2d's kinds); Sum is a SEQUENCE
-    pooling type and raises here rather than silently becoming avg."""
+    pooling type and raises here rather than silently becoming avg.
+    ``stride`` defaults to 1 — the REFERENCE's img_pool_layer default
+    (overlapping pooling when omitted), not pool_size."""
     kinds = {"MAX": "max", "AVERAGE": "avg"}
     pname = getattr(pool_type, "name", str(pool_type))
     if pname not in kinds:
@@ -283,8 +285,7 @@ def img_pool(input, pool_size: int, pool_type=pooling_mod.Max,
     def build(ctx, parents):
         x = _as_nchw(parents[0], num_channels)
         return F.pool2d(x, pool_size=pool_size, pool_type=ptype,
-                        pool_stride=stride or pool_size,
-                        pool_padding=padding)
+                        pool_stride=stride, pool_padding=padding)
 
     return Layer("img_pool", [input], build, name=name)
 

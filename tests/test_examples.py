@@ -48,3 +48,8 @@ def test_long_context_ring_example():
     err = float(out.split("max err:")[1].split()[0])
     assert err < 1e-3, out
     assert "grad through the ring OK" in out
+
+
+def test_deploy_native_example():
+    out = _run("deploy_native.py", "--steps", "10", timeout=300)
+    assert "OK" in out

@@ -368,7 +368,7 @@ img = data_layer("img", size=3 * 16 * 16)
 c1 = img_conv_layer(img, filter_size=3, num_filters=8, num_channels=3,
                     padding=1, act=ReluActivation())
 b1 = batch_norm_layer(c1, act=ReluActivation())
-p1 = img_pool_layer(b1, pool_size=2, pool_type=MaxPooling)
+p1 = img_pool_layer(b1, pool_size=2, stride=2, pool_type=MaxPooling)
 prob = fc_layer(p1, size=4, act=SoftmaxActivation())
 label = data_layer("label", size=4, type=integer_value(4))
 outputs(classification_cost(input=prob, label=label))
