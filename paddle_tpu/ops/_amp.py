@@ -43,6 +43,21 @@ def recurrent_cast(amp: bool, weights=(), carries=()):
     return weights, carries
 
 
+def emit_cast(amp: bool, *vals):
+    """AMP dtype for a scan's STACKED per-step emits: bf16 when amp (the
+    consumers cast them into their matmuls anyway; only the carry is an
+    accumulator and stays f32 — see recurrent_cast), unchanged otherwise.
+    One helper so every recurrence (lstm, gru, attention decoder) applies
+    the same recipe; measured -1.3 ms/step on the seq2seq bench
+    (docs/perf.md "Seq2seq round 5")."""
+    import jax.numpy as jnp
+
+    if not amp:
+        return vals if len(vals) != 1 else vals[0]
+    out = tuple(v.astype(jnp.bfloat16) for v in vals)
+    return out if len(out) != 1 else out[0]
+
+
 def f32_compute(ctx, x):
     """Upcast a low-precision tensor to f32 for precision-sensitive math.
 

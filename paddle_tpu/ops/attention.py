@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ._amp import emit_cast as _emit_cast
 from ._amp import recurrent_cast as _recurrent_cast
 
 
@@ -79,9 +80,9 @@ def attention_lstm_decoder(ctx_, ins, attrs):
          else jnp.zeros((wx.shape[1],), emb.dtype))
     n, td, _ = emb.shape
     ts = enc.shape[1]
+    amp = getattr(ctx_, "amp", False)
     (wa, wx, wh, enc, emb), (h0, c0) = _recurrent_cast(
-        getattr(ctx_, "amp", False),
-        weights=(wa, wx, wh, enc, emb), carries=(h0, c0))
+        amp, weights=(wa, wx, wh, enc, emb), carries=(h0, c0))
     enc_mask = jnp.arange(ts)[None, :] < enc_len.reshape(-1, 1)
     trg_len = (ins["TrgLength"][0] if ins.get("TrgLength") and ins["TrgLength"][0] is not None
                else jnp.full((n,), td, jnp.int32))
@@ -105,7 +106,10 @@ def attention_lstm_decoder(ctx_, ins, attrs):
         m = m[:, None]
         h_out = m * h_new + (1 - m) * h_prev
         c_out = m * c_new + (1 - m) * c_prev
-        return (h_out, c_out), (h_out * m, ctx_t * m)
+        # bf16 stacked emits under AMP; f32 carry (see ops/rnn.py)
+        emit = ((h_out * m).astype(jnp.bfloat16),
+                (ctx_t * m).astype(jnp.bfloat16)) if amp else             (h_out * m, ctx_t * m)
+        return (h_out, c_out), emit
 
     (_, _), (hs, ctxs) = lax.scan(step, (h0, c0), (jnp.moveaxis(pre, 1, 0), step_mask))
     return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "Context": [jnp.moveaxis(ctxs, 0, 1)]}
