@@ -25,13 +25,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from args import parse_args  # noqa: E402  (benchmark-local args.py)
 
 _args = parse_args() if __name__ == "__main__" else None
-if _args is not None and _args.device == "CPU" and _args.num_devices > 1:
-    # must happen before jax initializes: virtual CPU devices for the mesh
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={_args.num_devices}"
-        ).strip()
+if _args is not None and _args.device == "CPU":
+    # must happen before jax initializes: pin the platform — the axon TPU
+    # plugin otherwise makes itself the default backend and hangs probing
+    # for devices on a TPU-less host
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if _args.num_devices > 1:
+        # virtual CPU devices for the mesh
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={_args.num_devices}"
+            ).strip()
 
 import paddle_tpu as fluid  # noqa: E402
 from models import get_model_module  # noqa: E402

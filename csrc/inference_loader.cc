@@ -1424,6 +1424,15 @@ static int exec_impl(Model* m, const char** feed_names,
   Exec ex;
   ex.m = m;
   for (int i = 0; i < n_feeds; i++) {
+    if (train && m->param_cache.count(feed_names[i])) {
+      // a feed named like a parameter would land in env and be persisted by
+      // the train copy-back below, silently overwriting the trained weight
+      // for every subsequent step — reject instead
+      m->error = std::string("feed '") + feed_names[i] +
+                 "' collides with a parameter name; feeding parameters is "
+                 "not allowed in a training step";
+      return 0;
+    }
     Tensor t;
     t.shape.assign(feed_shapes[i], feed_shapes[i] + feed_ndims[i]);
     t.data.assign(feed_data[i], feed_data[i] + t.numel());

@@ -20,6 +20,7 @@ from . import ops  # registers the op library
 from . import clip, initializer, layers, optimizer, regularizer, unique_name  # noqa: F401
 from . import dataset, io, metrics, profiler, reader  # noqa: F401
 from . import concurrency, debugger, flags, host_table, inference, master  # noqa: F401
+from . import serving  # noqa: F401
 from .flags import get_flag, init_gflags, set_flag, set_flags  # noqa: F401
 from .concurrency import (  # noqa: F401
     Go,

@@ -107,9 +107,7 @@ def attention_lstm_decoder(ctx_, ins, attrs):
         h_out = m * h_new + (1 - m) * h_prev
         c_out = m * c_new + (1 - m) * c_prev
         # bf16 stacked emits under AMP; f32 carry (see ops/rnn.py)
-        emit = ((h_out * m).astype(jnp.bfloat16),
-                (ctx_t * m).astype(jnp.bfloat16)) if amp else             (h_out * m, ctx_t * m)
-        return (h_out, c_out), emit
+        return (h_out, c_out), _emit_cast(amp, h_out * m, ctx_t * m)
 
     (_, _), (hs, ctxs) = lax.scan(step, (h0, c0), (jnp.moveaxis(pre, 1, 0), step_mask))
     return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "Context": [jnp.moveaxis(ctxs, 0, 1)]}

@@ -73,9 +73,7 @@ def _lstm_scan(x, h0, c0, w, bias, peephole, length, gate_act, cell_act, cand_ac
         # accumulator across T steps keeps full precision, only the
         # exported sequence rounds. Halves the scan-output stacking
         # traffic the seq2seq profile charges ~1.8 ms/step for.
-        emit = ((h_out * m).astype(jnp.bfloat16),
-                (c_out * m).astype(jnp.bfloat16)) if amp else             (h_out * m, c_out * m)
-        return (h_out, c_out), emit
+        return (h_out, c_out), _emit_cast(amp, h_out * m, c_out * m)
 
     (hT, cT), (hs, cs) = lax.scan(step, (h0, c0), (xs, step_mask))
     hidden = jnp.moveaxis(hs, 0, 1)

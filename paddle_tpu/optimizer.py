@@ -111,11 +111,15 @@ class Optimizer:
         # its int ids) through autodiff's rename+sum dedup — elementwise
         # sums of rows belonging to DIFFERENT id sets, silently updating
         # wrong rows. Refuse: SelectedRows grads cannot be summed.
+        # walk EVERY block: autodiff's rename+sum dedup can land inside a
+        # control-flow sub-block (While/StaticRNN body), and a sparse lookup
+        # there must not bypass the guard
         summed = set()
-        for op in block.ops:
-            if op.type == "sum":
-                for names in op.outputs.values():
-                    summed.update(names)
+        for blk in block.program.blocks:
+            for op in blk.ops:
+                if op.type == "sum":
+                    for names in op.outputs.values():
+                        summed.update(names)
         for p, g in params_grads:
             if self._grad_ids(block, g) is None:
                 continue

@@ -1,0 +1,31 @@
+"""jax version-compat shims for the parallel package.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a top-level
+``jax.shard_map`` export, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` (after graduation, so there is a version
+window with the top-level export and the OLD kwarg). One import site so
+every user of manual sharding in this package resolves the same callable
+on any of the three generations; call sites use the new ``check_vma``
+spelling and the shim downgrades it when the resolved function predates it.
+"""
+import inspect
+
+try:  # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+except (ValueError, TypeError):  # unintrospectable: assume current spelling
+    _HAS_CHECK_VMA = True
+
+if _HAS_CHECK_VMA:
+    shard_map = _shard_map
+else:
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+__all__ = ["shard_map"]

@@ -178,7 +178,7 @@ class ParallelExecutor:
     def _build_local_sgd_step(self, step, feed_sig_names):
         """Wrap the traced step in shard_map: per-worker params (leading dp
         dim), per-worker batch shard, NO collectives inside — local SGD."""
-        from jax import shard_map
+        from ._compat import shard_map
         from jax import lax
 
         mesh = self.mesh

@@ -1,0 +1,38 @@
+"""paddle_tpu.serving — dynamic-batching inference serving.
+
+The deployment half of the roadmap: the training side exports a frozen
+program (``io.save_inference_model``) and the synchronous ``Predictor``
+runs it one request at a time; this package turns that artifact into a
+traffic-serving engine. Four pieces, composable or used together via
+``ServingServer``:
+
+* ``ServingEngine`` (engine.py) — frozen program + device-resident params,
+  bucket-ladder padding, LRU compile cache with hit/miss accounting,
+  ``warmup()`` to pre-compile the ladder.
+* ``MicroBatcher`` (batcher.py) — bounded-queue request coalescing into one
+  padded device call per batch window; rejects (never blocks) when full.
+* ``ServingServer`` / ``ServingClient`` (server.py) — dependency-free
+  threaded TCP line-JSON front: ``predict`` / ``healthz`` / ``stats``.
+* ``ServingStats`` (stats.py) — QPS, latency percentiles, batch fill,
+  queue depth, compile hits/misses, rejects.
+
+Quickstart::
+
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import ServingServer, ServingClient
+
+    with ServingServer("exported_model_dir", max_batch_size=16,
+                       batch_timeout_ms=2.0, warmup=True) as srv:
+        with ServingClient(srv.endpoint) as c:
+            outs = c.predict({"x": x_batch})   # list of np arrays
+            print(c.stats()["latency_ms"])
+"""
+from .batcher import MicroBatcher, QueueFullError  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .server import ServingClient, ServingRejected, ServingServer  # noqa: F401
+from .stats import ServingStats  # noqa: F401
+
+__all__ = [
+    "MicroBatcher", "QueueFullError", "ServingEngine", "ServingClient",
+    "ServingRejected", "ServingServer", "ServingStats",
+]

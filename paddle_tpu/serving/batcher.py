@@ -1,0 +1,240 @@
+"""Micro-batcher: coalesce concurrent requests into one padded device call.
+
+A TPU (or any XLA device) wants few LARGE dispatches, not many small ones;
+individual user requests arrive as batch-1..k tensors. The batcher sits
+between them:
+
+* ``submit()`` validates and enqueues a request onto a **bounded** queue and
+  returns a future. A full queue REJECTS immediately (``QueueFullError``)
+  instead of blocking — load shedding at the edge keeps tail latency bounded
+  and lets the caller retry against a replica (the reference's pserver-side
+  send buffers blocked, which is exactly the failure mode this avoids).
+* a background thread pulls requests, coalescing until ``max_batch_size``
+  rows are gathered or ``batch_timeout_ms`` has elapsed since the first
+  request — whichever comes first — then dispatches ONE
+  ``engine.run_batch`` call and scatters per-row results back to each
+  request's future.
+* requests only coalesce when their trailing-shape signature matches (same
+  compiled bucket); a mismatched request is carried over to start the next
+  batch rather than reordered behind later traffic.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .engine import ServingEngine
+from .stats import ServingStats
+
+
+class QueueFullError(RuntimeError):
+    """Structured backpressure rejection: the request was NOT enqueued."""
+
+    def __init__(self, queue_depth: int, capacity: int):
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+        super().__init__(
+            f"serving queue full ({queue_depth}/{capacity}); request rejected")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "queue_full",
+                "queue_depth": self.queue_depth, "capacity": self.capacity}
+
+
+class _Request:
+    __slots__ = ("feeds", "sig", "rows", "future", "t_submit")
+
+    def __init__(self, feeds, sig, rows):
+        self.feeds = feeds
+        self.sig = sig
+        self.rows = rows
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+class MicroBatcher:
+    """Background request coalescer over a ``ServingEngine``.
+
+    ``start=False`` builds the batcher without its worker thread — requests
+    then pile up in the queue until ``start()`` (deterministic coalescing
+    in tests, pre-fill before opening traffic).
+    """
+
+    def __init__(self, engine: ServingEngine,
+                 max_batch_size: Optional[int] = None,
+                 batch_timeout_ms: float = 5.0,
+                 queue_capacity: int = 64,
+                 stats: Optional[ServingStats] = None,
+                 start: bool = True):
+        self.engine = engine
+        self.max_batch_size = int(max_batch_size or engine.max_batch_size)
+        if self.max_batch_size > engine.max_batch_size:
+            raise ValueError("batcher max_batch_size exceeds the engine's")
+        self.batch_timeout_s = batch_timeout_ms / 1e3
+        self.queue_capacity = int(queue_capacity)
+        self.stats = stats
+        self._queue: "queue.Queue[_Request]" = queue.Queue(self.queue_capacity)
+        self._carry: Optional[_Request] = None  # held-over (mismatch/overflow)
+        self._stop = threading.Event()
+        self._closed = False
+        self._close_lock = threading.Lock()  # orders submit's put vs close
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- producer side --
+    def submit(self, feeds: Dict[str, Any]) -> Future:
+        """Enqueue one request (leading dim = rows). Never blocks: raises
+        ``QueueFullError`` when the bounded queue is full."""
+        if self._closed:
+            # a drained queue would accept the put but no worker will ever
+            # serve it — fail now, not at the caller's result() timeout
+            raise RuntimeError("batcher closed")
+        padded, sig, rows = self.engine.prepare_request(feeds)
+        if rows > self.max_batch_size:
+            raise ValueError(
+                f"request of {rows} rows exceeds max_batch_size "
+                f"{self.max_batch_size}; split it client-side")
+        req = _Request(padded, sig, rows)
+        with self._close_lock:
+            # re-check under the lock: a close() racing this submit either
+            # sees our put (and drains/fails it) or we see its _closed
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                if self.stats:
+                    self.stats.record_reject()
+                raise QueueFullError(self.queue_depth,
+                                     self.queue_capacity) from None
+        if self.stats:
+            self.stats.record_submit()
+        return req.future
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize() + (1 if self._carry is not None else 0)
+
+    # -- worker side --
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._closed = False
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="paddle-tpu-microbatcher")
+            self._thread.start()
+
+    def _next(self, timeout: float) -> Optional[_Request]:
+        if self._carry is not None:
+            r, self._carry = self._carry, None
+            return r
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _loop(self) -> None:
+        while True:
+            first = self._next(0.05)
+            if first is None:
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [first]
+            rows = first.rows
+            deadline = time.monotonic() + self.batch_timeout_s
+            while rows < self.max_batch_size:
+                nxt = self._next(max(0.0, deadline - time.monotonic()))
+                if nxt is None:  # timed out — ship what we have
+                    break
+                if nxt.sig != first.sig or rows + nxt.rows > self.max_batch_size:
+                    self._carry = nxt  # starts the next batch, keeps order
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            self._dispatch(batch, rows)
+
+    @staticmethod
+    def _complete(req: _Request, result=None, exc=None) -> bool:
+        """Resolve a future exactly once (cancelled/raced ones are done)."""
+        if req.future.done():
+            return False
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(result)
+            return True
+        except Exception:  # lost a set race — the other side owns it
+            return False
+
+    def _fail_batch(self, batch: List[_Request], e: Exception) -> None:
+        if self.stats:
+            self.stats.record_failure(len(batch))
+        for r in batch:
+            self._complete(r, exc=e)
+
+    def _dispatch(self, batch: List[_Request], rows: int) -> None:
+        if len(batch) > 1 and not all(self.engine.fetch_per_row.values()):
+            # a fetch without a per-row batch dim (a batch reduction) would
+            # mix the coalesced clients' rows — refuse to scatter it
+            self._fail_batch(batch, ValueError(
+                "a fetch does not lead with the batch dim; it cannot be "
+                "scattered across coalesced requests — serve such models "
+                "with max_batch_size=1 or per-row fetch targets"))
+            return
+        feeds = {n: np.concatenate([r.feeds[n] for r in batch], axis=0)
+                 for n in self.engine.feed_names}
+        try:
+            # requests were prepared (validated/coerced/padded) at submit;
+            # don't re-run that work per dispatched batch
+            outs = self.engine.run_prepared(feeds, rows)
+        except Exception as e:
+            self._fail_batch(batch, e)
+            return
+        if self.stats:
+            self.stats.record_batch(rows, self.engine.bucket_batch(rows))
+        now = time.monotonic()
+        off = 0
+        for r in batch:
+            res = [o[off:off + r.rows] if self.engine.fetch_per_row[n] else o
+                   for n, o in zip(self.engine.fetch_names, outs)]
+            off += r.rows
+            if self._complete(r, result=res) and self.stats:
+                self.stats.record_done(now - r.t_submit)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the worker after draining queued requests."""
+        with self._close_lock:  # no submit can land a put after this
+            self._closed = True
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            if t.is_alive():
+                # worker still mid-dispatch (a long compile): it owns the
+                # queue and will drain it on its way out — draining here
+                # too would race it into double-completing requests
+                return
+        # worker gone (or never started): fail anything still pending
+        leftover, self._carry = ([self._carry] if self._carry else []), None
+        while True:
+            try:
+                leftover.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for r in leftover:
+            self._complete(r, exc=RuntimeError("batcher closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

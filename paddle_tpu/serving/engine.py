@@ -1,0 +1,310 @@
+"""ServingEngine: a frozen, bucketed, compile-cached inference runner.
+
+Wraps an exported inference dir (the ``io.save_inference_model`` format the
+``Predictor`` consumes) for high-throughput serving. XLA compiles one
+executable per input-shape signature, so serving arbitrary batch sizes
+naively means a compile storm; the TPU-native answer (the shape-bucketing
+view of PAPERS' hierarchical-placement work) is a **bucket ladder**:
+
+* the batch dim of every request batch is padded UP to the smallest ladder
+  entry that fits (default: powers of two up to ``max_batch_size``), so at
+  most ``log2(max_batch)`` executables exist per trailing-shape signature;
+* optionally, per-feed trailing axes (sequence length, image side) are
+  padded up their own ladders via ``pad_axes`` — only for axes the model
+  treats as padding-safe (masked/length-carrying models);
+* compiled executables live in an LRU keyed by the full padded signature,
+  with hit/miss counters surfaced to ``stats`` — a steady-state server
+  should run at ~100% hits after ``warmup()``.
+
+The program is frozen once at load: parameters are device-resident arrays,
+the block is traced into one step function (``core.executor.build_step_fn``,
+the same lowering the Executor uses), and each bucket signature gets its own
+``jax.jit`` wrapper so evicting a cache entry actually frees its executable.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _pow2_ladder(limit: int) -> Tuple[int, ...]:
+    """1, 2, 4, ... capped at ``limit`` (limit always included)."""
+    ladder = []
+    b = 1
+    while b < limit:
+        ladder.append(b)
+        b *= 2
+    ladder.append(limit)
+    return tuple(ladder)
+
+
+def _round_up(size: int, ladder: Optional[Sequence[int]]) -> int:
+    """Smallest ladder entry >= size; pow2 rounding when no ladder given."""
+    if ladder is None:
+        b = 1
+        while b < size:
+            b *= 2
+        return b
+    for b in ladder:
+        if b >= size:
+            return b
+    raise ValueError(f"size {size} exceeds bucket ladder {tuple(ladder)}")
+
+
+class ServingEngine:
+    """Load an exported inference dir; serve padded, bucketed batches.
+
+    Thread-safe: ``run_batch`` may be called from any thread (the micro
+    batcher uses one), cache and counters are lock-guarded.
+    """
+
+    def __init__(self, dirname: str, place=None, max_batch_size: int = 32,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 pad_axes: Optional[Dict[str, Dict[int, Optional[Sequence[int]]]]] = None,
+                 cache_capacity: int = 16):
+        import jax
+
+        from .. import io as model_io
+        from ..core.executor import Scope, build_step_fn
+        from ..core.types import default_place
+
+        self.dirname = dirname
+        self.batch_buckets = tuple(sorted(batch_buckets)) if batch_buckets \
+            else _pow2_ladder(int(max_batch_size))
+        # the ladder IS the contract: a custom ladder caps (or raises) the
+        # largest servable batch, so the batcher can never coalesce a batch
+        # bucket_batch() would reject
+        self.max_batch_size = self.batch_buckets[-1]
+        # {feed_name: {axis: ladder-or-None}} — trailing axes safe to pad;
+        # ladders sorted so _round_up's first-fit really is the smallest
+        self.pad_axes = {
+            k: {a: (tuple(sorted(l)) if l is not None else None)
+                for a, l in v.items()}
+            for k, v in (pad_axes or {}).items()}
+        self.cache_capacity = int(cache_capacity)
+
+        self._place = place or default_place()
+        self._device = self._place.jax_device()
+        self.scope = Scope()
+        self.program, self.feed_names, self.fetch_names = (
+            model_io.load_inference_model(dirname, None, scope=self.scope))
+        self._feed_vars = {
+            n: self.program.global_block().find_var_recursive(n)
+            for n in self.feed_names}
+        # decide per-row-ness from the DECLARED fetch shapes (the symbolic
+        # -1 batch dim survives export), not from runtime shape coincidence:
+        # a batch-aggregated fetch whose leading dim happens to equal the
+        # bucket must never be sliced and scattered as if it were per-row
+        self.fetch_per_row: Dict[str, bool] = {}
+        for n in self.fetch_names:
+            var = self.program.global_block().find_var_recursive(n)
+            self.fetch_per_row[n] = (
+                var is not None and var.shape is not None
+                and len(var.shape) >= 1 and var.shape[0] in (-1, None))
+
+        # freeze: one traced step for the whole block, params on device once
+        (self._step, self._readonly_names, self._donated_names,
+         self._state_out_names) = build_step_fn(
+            self.program, 0, list(self.feed_names), self.fetch_names)
+        if self._state_out_names:
+            # a program that writes persistable state per run (retained BN
+            # updaters, counters) would fold padding rows — and, coalesced,
+            # other clients' rows — into that state: silently wrong. Serving
+            # requires a pure inference export (clone(for_test) prunes these).
+            raise ValueError(
+                f"exported program writes persistable state per run "
+                f"({self._state_out_names}); padding/coalescing would corrupt "
+                f"it — export with save_inference_model from a "
+                f"clone(for_test) program")
+        self._params: Dict[str, Any] = {}
+        with jax.default_device(self._device):
+            for n in list(self._readonly_names) + list(self._donated_names):
+                v = self.scope.get(n)
+                if v is None:
+                    raise RuntimeError(
+                        f"exported model {dirname!r}: state var {n!r} has no "
+                        f"saved value — export with the scope that holds it")
+                self._params[n] = jax.device_put(np.asarray(v), self._device)
+            self._key = jax.random.PRNGKey(0)
+
+        self._lock = threading.RLock()
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- bucketing --
+    def bucket_batch(self, rows: int) -> int:
+        """Smallest batch-ladder entry that fits ``rows``."""
+        if rows <= 0:
+            raise ValueError("empty batch")
+        for b in self.batch_buckets:
+            if b >= rows:
+                return b
+        raise ValueError(
+            f"batch of {rows} rows exceeds max_batch_size "
+            f"{self.batch_buckets[-1]}")
+
+    def _pad_trailing(self, name: str, arr: np.ndarray) -> np.ndarray:
+        policy = self.pad_axes.get(name)
+        if not policy:
+            return arr
+        pads = [(0, 0)] * arr.ndim
+        changed = False
+        for axis, ladder in policy.items():
+            if axis == 0:
+                raise ValueError("axis 0 is the batch dim; it is bucketed "
+                                 "by the batch ladder, not pad_axes")
+            want = _round_up(arr.shape[axis], ladder)
+            if want != arr.shape[axis]:
+                pads[axis] = (0, want - arr.shape[axis])
+                changed = True
+        return np.pad(arr, pads) if changed else arr
+
+    def prepare_request(self, feeds: Dict[str, Any]):
+        """Validate + coerce one request's feeds; pad trailing axes.
+
+        Returns ``(feeds, trailing_sig, rows)``. ``trailing_sig`` is the
+        per-feed (shape[1:], dtype) tuple two requests must share to be
+        coalesced into one device call (their padded trailing shapes land
+        in the same compiled bucket).
+        """
+        from ..core.executor import coerce_int64_feed
+
+        missing = set(self.feed_names) - set(feeds)
+        if missing:
+            raise ValueError(f"missing feeds: {sorted(missing)}")
+        extra = set(feeds) - set(self.feed_names)
+        if extra:
+            raise ValueError(f"unknown feeds: {sorted(extra)}")
+        out: Dict[str, np.ndarray] = {}
+        rows = None
+        for n in self.feed_names:
+            arr = np.asarray(feeds[n])
+            var = self._feed_vars.get(n)
+            if var is not None and var.dtype is not None:
+                arr = arr.astype(var.dtype.np_dtype, copy=False)
+            arr = coerce_int64_feed(arr, n)
+            if arr.ndim == 0:
+                raise ValueError(f"feed {n!r} must have a leading batch dim")
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise ValueError(
+                    f"feed {n!r} has {arr.shape[0]} rows, others have {rows}")
+            out[n] = self._pad_trailing(n, arr)
+        sig = tuple((n, out[n].shape[1:], str(out[n].dtype))
+                    for n in self.feed_names)
+        return out, sig, rows
+
+    # -- compile cache --
+    def _get_fn(self, sig: Tuple):
+        import jax
+
+        with self._lock:
+            fn = self._cache.get(sig)
+            if fn is not None:
+                self.cache_hits += 1
+                self._cache.move_to_end(sig)
+                return fn
+            self.cache_misses += 1
+            # one jit wrapper per signature: eviction drops the executable
+            fn = jax.jit(self._step)
+            self._cache[sig] = fn
+            while len(self._cache) > self.cache_capacity:
+                self._cache.popitem(last=False)
+            return fn
+
+    def cache_info(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.cache_hits, "misses": self.cache_misses,
+                    "size": len(self._cache), "capacity": self.cache_capacity}
+
+    # -- execution --
+    def run_batch(self, feeds: Dict[str, Any]) -> List[np.ndarray]:
+        """Run one coalesced batch: pad rows up to the bucket, dispatch one
+        device call, slice per-row results back to the true row count."""
+        feeds, _, rows = self.prepare_request(feeds)
+        return self.run_prepared(feeds, rows)
+
+    def run_prepared(self, feeds: Dict[str, np.ndarray],
+                     rows: int) -> List[np.ndarray]:
+        """``run_batch`` minus validation/coercion/trailing padding — for
+        feeds assembled from ``prepare_request`` outputs (the batcher preps
+        each request once at submit and only concatenates here)."""
+        import jax
+
+        bucket = self.bucket_batch(rows)
+        if bucket != rows:
+            feeds = {n: np.concatenate(
+                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
+                for n, a in feeds.items()}
+        sig = tuple((n, feeds[n].shape, str(feeds[n].dtype))
+                    for n in self.feed_names)
+        fn = self._get_fn(sig)
+        # no lock around the dispatch: _params/_key are frozen after
+        # __init__ and jitted calls are thread-safe — a cold-bucket compile
+        # must not stall cache_info() (the stats RPC) or other runners
+        with jax.default_device(self._device):
+            feed_vals = {n: jax.device_put(a, self._device)
+                         for n, a in feeds.items()}
+            readonly = {n: self._params[n] for n in self._readonly_names}
+            donated = {n: self._params[n] for n in self._donated_names}
+            fetches, _ = fn(feed_vals, readonly, donated, self._key)
+        outs = []
+        for name, f in zip(self.fetch_names, fetches):
+            a = np.asarray(f)
+            if self.fetch_per_row[name]:
+                if a.ndim < 1 or a.shape[0] != bucket:
+                    raise RuntimeError(
+                        f"fetch {name!r} declared per-row but produced "
+                        f"shape {a.shape} for bucket {bucket}")
+                outs.append(a[:rows])
+            elif bucket != rows:
+                # a batch-coupled fetch (a reduction over rows) under
+                # padding: the padding rows fed zeros into it — reject
+                # loudly, never serve it wrong
+                raise ValueError(
+                    f"fetch {name!r} (shape {a.shape}) does not lead with "
+                    f"the batch dim; padding {rows}->{bucket} rows would "
+                    f"fold zero rows into it — serve it at exact bucket "
+                    f"sizes or export per-row fetch targets")
+            else:
+                outs.append(a)
+        return outs
+
+    def warmup(self, trailing: Optional[Dict[str, Sequence[int]]] = None,
+               batch_sizes: Optional[Sequence[int]] = None) -> int:
+        """Pre-compile the bucket ladder with zero feeds.
+
+        ``trailing`` overrides per-feed trailing shapes when the exported
+        program declares unknown (-1) trailing dims. Returns the number of
+        fresh compiles performed.
+        """
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        for n in self.feed_names:
+            if trailing and n in trailing:
+                shapes[n] = tuple(trailing[n])
+                continue
+            var = self._feed_vars.get(n)
+            if var is None or var.shape is None:
+                raise ValueError(
+                    f"feed {n!r}: no declared shape — pass trailing={{...}}")
+            dims = tuple(var.shape)[1:]
+            if any(d is None or d < 0 for d in dims):
+                raise ValueError(
+                    f"feed {n!r} has unknown trailing dims {dims} — pass "
+                    f"trailing={{...}}")
+            shapes[n] = dims
+        misses_before = self.cache_misses
+        for b in (batch_sizes or self.batch_buckets):
+            feeds = {}
+            for n in self.feed_names:
+                var = self._feed_vars.get(n)
+                dt = (var.dtype.np_dtype if var is not None
+                      and var.dtype is not None else np.float32)
+                feeds[n] = np.zeros((b,) + shapes[n], dtype=dt)
+            self.run_batch(feeds)
+        return self.cache_misses - misses_before

@@ -1,0 +1,250 @@
+"""Threaded TCP/JSON serving front (line-JSON, ``master/rpc.py`` idiom).
+
+One request per line: ``{"method": ..., "params": {...}}`` ->
+``{"result": ...}`` | ``{"error": ...}``. Deliberately dependency-free
+(socketserver), mirroring how the master's RPC spawns a real server in
+tests and drives a client against it. Three methods:
+
+* ``predict`` — params ``{"feeds": {name: {"data": nested-list,
+  "dtype": "float32"} | nested-list}}``; arrays include the leading batch
+  dim. The handler submits to the micro-batcher and blocks THAT connection
+  thread on the future (socketserver gives one thread per connection), so
+  slow requests never stall the accept loop. A full queue answers
+  ``{"error": {"code": "rejected", "reason": "queue_full", ...}}`` —
+  structured backpressure the client can distinguish from a failure.
+* ``healthz`` — liveness + model identity.
+* ``stats`` — ``ServingStats.snapshot()`` merged with compile-cache and
+  queue gauges.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .batcher import MicroBatcher, QueueFullError
+from .engine import ServingEngine
+from .stats import ServingStats
+
+
+class ServingRejected(RuntimeError):
+    """Client-side view of a structured backpressure rejection."""
+
+    def __init__(self, info: Dict[str, Any]):
+        self.info = info
+        super().__init__(f"request rejected: {info.get('reason', info)}")
+
+
+def _decode_feed(name: str, spec) -> np.ndarray:
+    if isinstance(spec, dict):
+        return np.asarray(spec["data"], dtype=spec.get("dtype"))
+    return np.asarray(spec)
+
+
+def _encode_fetch(arr: np.ndarray) -> Dict[str, Any]:
+    arr = np.asarray(arr)
+    return {"data": arr.tolist(), "shape": list(arr.shape),
+            "dtype": str(arr.dtype)}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            srv: "ServingServer" = self.server  # type: ignore[assignment]
+            try:
+                req = json.loads(line.decode())
+                method = req["method"]
+                params = req.get("params") or {}
+                if method == "predict":
+                    resp = self._predict(srv, params)
+                elif method == "healthz":
+                    resp = {"result": srv.healthz()}
+                elif method == "stats":
+                    resp = {"result": srv.stats_snapshot()}
+                else:
+                    raise ValueError(f"unknown method {method!r}")
+            except Exception as e:  # report, keep serving
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+    @staticmethod
+    def _predict(srv: "ServingServer", params: Dict) -> Dict:
+        feeds = {n: _decode_feed(n, spec)
+                 for n, spec in params.get("feeds", {}).items()}
+        try:
+            fut = srv.batcher.submit(feeds)
+        except QueueFullError as e:
+            return {"error": e.info()}
+        outs = fut.result(timeout=srv.request_timeout)
+        return {"result": {"fetches": [_encode_fetch(o) for o in outs]}}
+
+
+class ServingServer(socketserver.ThreadingTCPServer):
+    """Dynamic-batching model server. ``with ServingServer(model_dir) as s:
+    s.endpoint`` — serves on background threads until ``close()``."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, model: Any, host: str = "127.0.0.1", port: int = 0,
+                 max_batch_size: Optional[int] = None,
+                 batch_timeout_ms: float = 5.0,
+                 queue_capacity: int = 64, request_timeout: float = 60.0,
+                 warmup: bool = False, stats: Optional[ServingStats] = None,
+                 start_batcher: bool = True, **engine_kwargs):
+        super().__init__((host, port), _Handler)
+        self.batcher = None
+        try:
+            if isinstance(model, ServingEngine):
+                if engine_kwargs:
+                    raise ValueError(
+                        f"engine kwargs {sorted(engine_kwargs)} have no "
+                        f"effect on a prebuilt ServingEngine — pass them to "
+                        f"its constructor")
+                self.engine = model
+                # follow the engine's ladder unless explicitly capped lower
+                batcher_max = (self.engine.max_batch_size
+                               if max_batch_size is None else
+                               min(max_batch_size,
+                                   self.engine.max_batch_size))
+            else:
+                self.engine = ServingEngine(
+                    model, max_batch_size=max_batch_size or 32,
+                    **engine_kwargs)
+                batcher_max = self.engine.max_batch_size
+            self.stats = stats or ServingStats()
+            # start_batcher=False accepts (and queues) traffic without
+            # serving it — pre-fill before opening, deterministic
+            # backpressure tests
+            self.batcher = MicroBatcher(
+                self.engine, max_batch_size=batcher_max,
+                batch_timeout_ms=batch_timeout_ms,
+                queue_capacity=queue_capacity,
+                stats=self.stats, start=start_batcher)
+            self.request_timeout = request_timeout
+            self._t0 = time.monotonic()
+            if warmup:
+                self.engine.warmup()
+        except Exception:
+            # the port bound before setup failed: release it (and any live
+            # batcher worker) instead of leaking until GC
+            if self.batcher is not None:
+                self.batcher.close()
+            self.server_close()
+            raise
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server_address[:2]
+        return f"{host}:{port}"
+
+    def healthz(self) -> Dict[str, Any]:
+        return {"ok": True, "uptime_s": time.monotonic() - self._t0,
+                "model_dir": self.engine.dirname,
+                "feeds": list(self.engine.feed_names),
+                "fetches": list(self.engine.fetch_names)}
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        return self.stats.snapshot(extra={
+            "queue_depth": self.batcher.queue_depth,
+            "queue_capacity": self.batcher.queue_capacity,
+            "compile_cache": self.engine.cache_info(),
+        })
+
+    def close(self):
+        self.shutdown()
+        self.server_close()
+        self.batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ServingClient:
+    """Blocking line-JSON client (``master/rpc.py`` MasterRPCClient shape).
+
+    ``predict`` returns one np.ndarray per fetch target; a structured
+    backpressure answer raises ``ServingRejected`` (retryable), transport
+    and server faults raise ``ConnectionError``/``RuntimeError``.
+    """
+
+    def __init__(self, endpoint: str, timeout: float = 60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr: Tuple[str, int] = (host, int(port))
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        self._sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self._file = self._sock.makefile("rwb")
+
+    def call(self, method: str, params: Optional[Dict] = None) -> Any:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._file.write(
+                    (json.dumps({"method": method, "params": params or {}})
+                     + "\n").encode())
+                self._file.flush()
+                line = self._file.readline()
+            except OSError:
+                self.close()
+                raise
+            if not line:
+                self.close()
+                raise ConnectionError("serving server closed connection")
+            resp = json.loads(line.decode())
+            if "error" in resp:
+                err = resp["error"]
+                if isinstance(err, dict) and err.get("code") == "rejected":
+                    raise ServingRejected(err)
+                raise RuntimeError(f"serving error: {err}")
+            return resp["result"]
+
+    def predict(self, feeds: Dict[str, Any]) -> List[np.ndarray]:
+        enc = {}
+        for n, v in feeds.items():
+            arr = np.asarray(v)
+            enc[n] = {"data": arr.tolist(), "dtype": str(arr.dtype)}
+        result = self.call("predict", {"feeds": enc})
+        return [np.asarray(f["data"], dtype=f["dtype"]).reshape(f["shape"])
+                for f in result["fetches"]]
+
+    def healthz(self) -> Dict[str, Any]:
+        return self.call("healthz")
+
+    def stats(self) -> Dict[str, Any]:
+        return self.call("stats")
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,119 @@
+"""Rolling serving metrics: QPS, latency percentiles, batch fill, rejects.
+
+The reference framework shipped no serving telemetry at all — deployments
+wrapped the C++ predictor and measured outside. Here the metrics are part
+of the serving engine itself because every knob the operator can turn
+(`max_batch_size`, `batch_timeout_ms`, bucket ladder, queue capacity) is
+only tunable against these four signals:
+
+* **QPS / latency percentiles** — completed requests per second over a
+  sliding window, p50/p95/p99 of submit->result latency.
+* **batch-fill ratio** — rows dispatched / bucket capacity per device call;
+  low fill means padding waste (compile amortization bought with FLOPs).
+* **queue depth + rejects** — backpressure state; rejects are the load-shed
+  counter, not an error counter.
+* **compile cache hits/misses** — a miss is an XLA compile on the serving
+  path (hundreds of ms); steady-state traffic should be ~100% hits.
+
+Everything is monotonic-clock based and lock-guarded; `snapshot()` is what
+the server's ``stats`` RPC returns.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class ServingStats:
+    """Thread-safe rolling counters shared by engine, batcher, and server."""
+
+    def __init__(self, latency_window: int = 2048, qps_window_s: float = 10.0):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.qps_window_s = qps_window_s
+        # cumulative counters
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.batches = 0
+        self.rows = 0
+        self._fill_sum = 0.0  # sum over batches of rows/bucket
+        # latency ring (last N latencies, seconds) bounds the percentile
+        # cost; QPS counts in separate per-second buckets so high
+        # throughput can't push completions out before their window expires
+        self._lat: deque = deque(maxlen=latency_window)
+        self._qps_buckets: deque = deque()  # (whole_second, count)
+
+    # -- recording (called from submit/dispatch paths) --
+    def record_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_failure(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    def record_batch(self, rows: int, bucket: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows += rows
+            self._fill_sum += rows / max(bucket, 1)
+
+    def record_done(self, latency_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            now = time.monotonic()
+            self._lat.append(latency_s)
+            sec = int(now)
+            if self._qps_buckets and self._qps_buckets[-1][0] == sec:
+                self._qps_buckets[-1] = (sec, self._qps_buckets[-1][1] + 1)
+            else:
+                self._qps_buckets.append((sec, 1))
+            horizon = int(now - self.qps_window_s) - 1
+            while self._qps_buckets and self._qps_buckets[0][0] < horizon:
+                self._qps_buckets.popleft()
+
+    # -- reading --
+    def snapshot(self, extra: Optional[Dict] = None) -> Dict:
+        with self._lock:
+            now = time.monotonic()
+            lats = sorted(self._lat)
+            recent = sum(c for sec, c in self._qps_buckets
+                         if now - sec <= self.qps_window_s)
+            horizon = min(self.qps_window_s, max(now - self._t0, 1e-9))
+            snap = {
+                "uptime_s": now - self._t0,
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "failed": self.failed,
+                "batches": self.batches,
+                "rows": self.rows,
+                "qps": recent / horizon,
+                "latency_ms": {
+                    "p50": _percentile(lats, 0.50) * 1e3,
+                    "p95": _percentile(lats, 0.95) * 1e3,
+                    "p99": _percentile(lats, 0.99) * 1e3,
+                },
+                "avg_batch_rows": self.rows / self.batches if self.batches else 0.0,
+                "batch_fill_ratio": (self._fill_sum / self.batches
+                                     if self.batches else 0.0),
+            }
+        if extra:
+            snap.update(extra)
+        return snap

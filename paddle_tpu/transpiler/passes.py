@@ -94,6 +94,36 @@ def _produced(op: Operator, name: str) -> bool:
     return any(name in names for names in op.outputs.values())
 
 
+# attr keys whose int value references a sub-block (control flow bodies,
+# recompute segments) — the same set the debugger walks
+_SUB_BLOCK_ATTRS = ("sub_block", "sub_true", "sub_false")
+
+
+def _ops_with_sub_blocks(block: Block) -> List[Operator]:
+    """``block.ops`` plus the ops of every sub-block reachable from them.
+
+    The exclusivity scan must see consumers inside While/StaticRNN bodies:
+    a sub-block reads outer vars by name (its closure), so a fusion pass
+    splicing out an interior var the sub-block still reads would change an
+    observed value. Chain MEMBERS still come from ``block.ops`` only —
+    fusing across a block boundary is never valid."""
+    ops: List[Operator] = []
+    stack = [block]
+    seen = set()
+    while stack:
+        blk = stack.pop()
+        if blk.idx in seen:
+            continue
+        seen.add(blk.idx)
+        ops.extend(blk.ops)
+        for op in blk.ops:
+            for key in _SUB_BLOCK_ATTRS:
+                idx = op.attrs.get(key)
+                if isinstance(idx, int) and 0 <= idx < len(blk.program.blocks):
+                    stack.append(blk.program.blocks[idx])
+    return ops
+
+
 def find_chains(block: Block, op_types: Sequence[str],
                 links: Sequence[Tuple[str, str]],
                 exclusive: bool = True) -> List[List[Operator]]:
@@ -102,8 +132,9 @@ def find_chains(block: Block, op_types: Sequence[str],
     ``links[i] = (out_slot, in_slot)``: op i's ``out_slot`` output var must
     be op i+1's ``in_slot`` input var. With ``exclusive`` (the subgraph
     splitter's safe-to-fuse rule) an interior link var may have NO other
-    consumer in the block, so fusing away the intermediate cannot change
-    a value any op observes. Caveat (the reference's subgraph splitter
+    consumer in the block or any sub-block reachable from it (While/
+    StaticRNN bodies read outer vars by closure), so fusing away the
+    intermediate cannot change a value any op observes. Caveat (the reference's subgraph splitter
     shares it): fetch targets are chosen at RUN time, not recorded in the
     IR — a caller who fetches an interior var of a fused chain fetches a
     var no op produces anymore; run fusion passes before choosing fetch
@@ -115,6 +146,9 @@ def find_chains(block: Block, op_types: Sequence[str],
     chains: List[List[Operator]] = []
     used: set = set()
     ops = block.ops
+    block_op_ids = {id(o) for o in ops}
+    # consumer visibility includes sub-block bodies (closure reads)
+    all_ops = _ops_with_sub_blocks(block)
     for i, op in enumerate(ops):
         if op.type != op_types[0] or id(op) in used:
             continue
@@ -126,11 +160,12 @@ def find_chains(block: Block, op_types: Sequence[str],
                 chain = None
                 break
             link_var = outs[0]
-            consumers = [o for o in ops
+            consumers = [o for o in all_ops
                          if any(link_var in (o.inputs.get(s) or [])
                                 for s in o.inputs)]
             nxt = next((o for o in consumers
-                        if o.type == want and id(o) not in used
+                        if o.type == want and id(o) in block_op_ids
+                        and id(o) not in used
                         and link_var in (o.inputs.get(in_slot) or [])), None)
             if nxt is None:
                 chain = None

@@ -525,3 +525,32 @@ def test_cpp_trained_params_are_extractable(tmp_path):
     assert not np.allclose(params["w"], w0)
     np.testing.assert_allclose(params["w"], np.asarray(fetched_w),
                                rtol=1e-6)
+
+
+def test_cpp_train_step_rejects_param_name_feed(tmp_path):
+    """A feed named like a parameter would be persisted by the train
+    copy-back, silently overwriting the trained weight for every later
+    step (ADVICE r5) — the loader rejects it loudly instead."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr("w"),
+                               bias_attr=fluid.ParamAttr("b"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=2)
+    d = str(tmp_path / "collide")
+    fluid.io.save_training_model(d, ["x", "y"], [loss], exe,
+                                 main_program=main, scope=scope)
+    xb = np.zeros((2, 4), "float32")
+    yb = np.zeros((2, 1), "float32")
+    m = NativeModelLoader(d)
+    with pytest.raises(RuntimeError, match="collides with a parameter"):
+        m.train_step({"x": xb, "y": yb, "w": np.zeros((4, 1), "float32")})
+    # a legitimate step on the same handle still works afterwards
+    out, = m.train_step({"x": xb, "y": yb})
+    assert np.isfinite(np.asarray(out)).all()
+    m.close()

@@ -1,0 +1,262 @@
+"""paddle_tpu.serving: bucketed engine, micro-batcher, TCP server (fast tier).
+
+Acceptance contract (ISSUE 1): batched-and-padded results equal per-request
+``Predictor.run``; a warmed bucket serves again with ZERO new compiles
+(cache-hit counter); a full queue returns a structured rejection instead of
+blocking; end-to-end server/client predict on a small exported model.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.inference import Predictor
+from paddle_tpu.serving import (MicroBatcher, QueueFullError, ServingClient,
+                                ServingEngine, ServingRejected, ServingServer,
+                                ServingStats)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """Export a tiny fc-softmax model once for the whole module."""
+    np.random.seed(7)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        d = str(tmp_path_factory.mktemp("serving") / "model")
+        io.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+    return d
+
+
+@pytest.fixture(scope="module")
+def predictor(model_dir):
+    return Predictor(model_dir, place=fluid.CPUPlace())
+
+
+def test_engine_padding_matches_predictor(model_dir, predictor):
+    """Rows served through a padded bucket == per-request Predictor.run."""
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    X = np.random.randn(5, 4).astype("float32")
+    out = eng.run_batch({"x": X})
+    assert len(out) == 1 and out[0].shape == (5, 3)  # sliced back to 5 rows
+    for i in range(5):
+        ref = predictor.run({"x": X[i:i + 1]})[0]
+        np.testing.assert_allclose(out[0][i:i + 1], ref, rtol=0, atol=1e-6)
+
+
+def test_engine_bucket_ladder_and_warm_cache(model_dir):
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    assert eng.batch_buckets == (1, 2, 4, 8)
+    assert [eng.bucket_batch(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError, match="exceeds max_batch_size"):
+        eng.bucket_batch(9)
+
+    compiles = eng.warmup()
+    assert compiles == 4  # one executable per ladder entry
+    info = eng.cache_info()
+    assert info["misses"] == 4 and info["size"] == 4
+
+    # a warmed bucket serves again with ZERO new compiles
+    X = np.random.randn(3, 4).astype("float32")  # -> bucket 4
+    eng.run_batch({"x": X})
+    info2 = eng.cache_info()
+    assert info2["misses"] == 4  # unchanged
+    assert info2["hits"] == info["hits"] + 1
+
+
+def test_engine_cache_lru_eviction(model_dir):
+    eng = ServingEngine(model_dir, max_batch_size=8, cache_capacity=2)
+    for rows in (1, 2, 4):  # three distinct signatures, capacity two
+        eng.run_batch({"x": np.zeros((rows, 4), "float32")})
+    info = eng.cache_info()
+    assert info["size"] == 2 and info["misses"] == 3
+    eng.run_batch({"x": np.zeros((1, 4), "float32")})  # evicted -> recompile
+    assert eng.cache_info()["misses"] == 4
+
+
+def test_engine_pad_axes_trailing_bucket(model_dir, predictor):
+    """A pad-safe trailing axis rounds up its own ladder; numerics match
+    feeding the explicitly zero-padded array."""
+    eng = ServingEngine(model_dir, max_batch_size=4,
+                        pad_axes={"x": {1: (4,)}})
+    X3 = np.random.randn(2, 3).astype("float32")  # trailing dim 3 -> 4
+    out = eng.run_batch({"x": X3})[0]
+    ref = predictor.run({"x": np.pad(X3, ((0, 0), (0, 1)))})[0]
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-6)
+    with pytest.raises(ValueError, match="exceeds bucket ladder"):
+        eng.run_batch({"x": np.zeros((1, 5), "float32")})
+
+
+def test_batcher_coalesces_queued_requests(model_dir, predictor):
+    """Requests queued before the worker starts coalesce into ONE padded
+    device call (deterministic: start=False holds the worker)."""
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    stats = ServingStats()
+    b = MicroBatcher(eng, batch_timeout_ms=50.0, queue_capacity=16,
+                     stats=stats, start=False)
+    X = np.random.randn(6, 4).astype("float32")
+    futs = [b.submit({"x": X[i:i + 1]}) for i in range(6)]
+    b.start()
+    outs = [f.result(timeout=60) for f in futs]
+    b.close()
+    for i, o in enumerate(outs):
+        ref = predictor.run({"x": X[i:i + 1]})[0]
+        np.testing.assert_allclose(o[0], ref, rtol=0, atol=1e-6)
+    snap = stats.snapshot()
+    assert snap["submitted"] == 6 and snap["completed"] == 6
+    assert snap["batches"] == 1  # 6 rows <= max_batch_size: one dispatch
+    assert snap["rows"] == 6
+    assert snap["batch_fill_ratio"] == pytest.approx(6 / 8)  # bucket 8
+
+
+def test_batcher_concurrent_clients(model_dir, predictor):
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    with MicroBatcher(eng, batch_timeout_ms=5.0, queue_capacity=64) as b:
+        X = np.random.randn(12, 4).astype("float32")
+        results = {}
+
+        def worker(i):
+            results[i] = b.submit({"x": X[i:i + 1]}).result(timeout=60)[0]
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert len(results) == 12
+        for i in range(12):
+            ref = predictor.run({"x": X[i:i + 1]})[0]
+            np.testing.assert_allclose(results[i], ref, rtol=0, atol=1e-6)
+
+
+def test_batcher_queue_full_rejects_not_blocks(model_dir):
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    stats = ServingStats()
+    b = MicroBatcher(eng, queue_capacity=2, stats=stats, start=False)
+    X = np.zeros((1, 4), "float32")
+    f1, f2 = b.submit({"x": X}), b.submit({"x": X})
+    with pytest.raises(QueueFullError) as ei:
+        b.submit({"x": X})
+    assert ei.value.info() == {"code": "rejected", "reason": "queue_full",
+                               "queue_depth": 2, "capacity": 2}
+    assert stats.snapshot()["rejected"] == 1
+    b.start()  # the two accepted requests still complete
+    assert f1.result(timeout=60) and f2.result(timeout=60)
+    b.close()
+
+
+def test_server_client_end_to_end(model_dir, predictor):
+    with ServingServer(model_dir, max_batch_size=8, batch_timeout_ms=2.0,
+                       warmup=True) as srv:
+        with ServingClient(srv.endpoint) as c:
+            h = c.healthz()
+            assert h["ok"] and h["feeds"] == ["x"] and len(h["fetches"]) == 1
+
+            X = np.random.randn(3, 4).astype("float32")
+            outs = c.predict({"x": X})
+            ref = predictor.run({"x": X})[0]
+            np.testing.assert_allclose(outs[0], ref, rtol=0, atol=1e-5)
+
+            # concurrent clients through the live batcher
+            results = {}
+
+            def worker(i):
+                with ServingClient(srv.endpoint) as cc:
+                    results[i] = cc.predict({"x": X[i:i + 1]})[0]
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            for i in range(3):
+                np.testing.assert_allclose(results[i], ref[i:i + 1],
+                                           rtol=0, atol=1e-5)
+
+            snap = c.stats()
+            assert snap["completed"] >= 4
+            assert {"p50", "p95", "p99"} <= set(snap["latency_ms"])
+            assert snap["compile_cache"]["misses"] >= 4  # warmup ladder
+            assert snap["queue_capacity"] == 64
+            # warmed ladder: live traffic added no compiles
+            assert snap["compile_cache"]["hits"] >= 2
+
+
+def test_server_structured_rejection(model_dir):
+    """A full queue answers predict with a structured rejection — the
+    connection is NOT blocked and other methods keep working."""
+    with ServingServer(model_dir, queue_capacity=2,
+                       start_batcher=False) as srv:
+        X = np.zeros((1, 4), "float32")
+        srv.batcher.submit({"x": X})  # fill the bounded queue
+        srv.batcher.submit({"x": X})
+        with ServingClient(srv.endpoint) as c:
+            with pytest.raises(ServingRejected) as ei:
+                c.predict({"x": X})
+            assert ei.value.info["reason"] == "queue_full"
+            assert ei.value.info["capacity"] == 2
+            assert c.healthz()["ok"]  # same connection still serves
+            assert c.stats()["rejected"] == 1
+
+
+def test_server_reports_bad_feed_as_error(model_dir):
+    with ServingServer(model_dir) as srv:
+        with ServingClient(srv.endpoint) as c:
+            with pytest.raises(RuntimeError, match="missing feeds"):
+                c.predict({})
+            with pytest.raises(RuntimeError, match="unknown feeds"):
+                c.predict({"x": np.zeros((1, 4), "float32"),
+                           "bogus": np.zeros((1, 1), "float32")})
+
+
+def test_engine_custom_ladder_caps_max_batch(model_dir):
+    """A custom bucket ladder IS the batch contract: max_batch_size follows
+    its top, so the batcher can never coalesce a batch the ladder rejects."""
+    eng = ServingEngine(model_dir, max_batch_size=32, batch_buckets=[1, 2, 4])
+    assert eng.max_batch_size == 4
+    b = MicroBatcher(eng, start=False)
+    assert b.max_batch_size == 4
+    with pytest.raises(ValueError, match="split it client-side"):
+        b.submit({"x": np.zeros((5, 4), "float32")})
+
+
+def test_engine_rejects_batch_coupled_fetch_under_padding(tmp_path, model_dir):
+    """A fetch that reduces over the batch dim would fold padding rows (and
+    coalesced neighbors) into its value — rejected loudly, never wrong."""
+    np.random.seed(11)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            m = fluid.layers.mean(fluid.layers.fc(x, size=3))
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        d = str(tmp_path / "reduce_model")
+        io.save_inference_model(d, ["x"], [m], exe, main, scope=scope)
+    eng = ServingEngine(d, max_batch_size=4)
+    # exact bucket fit: no padding, the scalar fetch is served
+    out = eng.run_batch({"x": np.random.randn(2, 4).astype("float32")})
+    assert out[0].shape == ()
+    # padded (3 -> 4): refuse instead of averaging in a zeros row
+    with pytest.raises(ValueError, match="does not lead with the batch dim"):
+        eng.run_batch({"x": np.random.randn(3, 4).astype("float32")})
+    # coalescing two clients' rows into one scalar is refused too
+    b = MicroBatcher(eng, batch_timeout_ms=50.0, start=False)
+    f1 = b.submit({"x": np.random.randn(1, 4).astype("float32")})
+    f2 = b.submit({"x": np.random.randn(1, 4).astype("float32")})
+    b.start()
+    with pytest.raises(ValueError, match="cannot be scattered|does not lead"):
+        f1.result(timeout=60)
+    with pytest.raises(ValueError, match="cannot be scattered|does not lead"):
+        f2.result(timeout=60)
+    b.close()

@@ -141,3 +141,23 @@ def test_sparse_guards_raise_clearly():
             with pytest.raises(NotImplementedError,
                                match="exactly once|cannot be summed"):
                 fluid.optimizer.SGD(0.1).minimize(loss, startup)
+
+
+def test_sparse_double_use_guard_sees_sub_block_sums():
+    """The double-use guard must collect sum-op outputs from EVERY block:
+    autodiff's rename+sum dedup can land inside a control-flow sub-block
+    and must not bypass the SelectedRows refusal (ADVICE r5)."""
+    main = fluid.Program()
+    blk = main.global_block()
+    w = blk.create_var("W_tbl", shape=(20, 4), dtype="float32",
+                       persistable=True)
+    g = blk.create_var("W_tbl@GRAD", shape=(3, 4), dtype="float32")
+    blk.create_var("W_tbl@GRAD@IDS", shape=(3,), dtype="int32")
+    sub = main.create_block()
+    main.rollback()
+    sub.append_op("sum", {"X": ["W_tbl@GRAD_r0", "W_tbl@GRAD_r1"]},
+                  {"Out": ["W_tbl@GRAD"]}, {})
+    blk.append_op("while", {}, {}, {"sub_block": sub.idx})
+    with pytest.raises(NotImplementedError,
+                       match="exactly once|cannot be summed"):
+        fluid.optimizer.SGD(0.1)._check_sparse_supported(blk, [(w, g)])

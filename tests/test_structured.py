@@ -246,3 +246,31 @@ def test_pass_manager_and_chain_matcher():
     assert [h[0] for h in pm.history] == ["count", "noop"]
     assert main.version > v0  # jit caches can't serve the pre-pass program
     assert seen and seen[0] == len(block.ops)
+
+
+def test_find_chains_sees_sub_block_consumers():
+    """Exclusivity must count consumers inside While/StaticRNN bodies: a
+    sub-block reads outer vars by closure, so splicing out an interior var
+    it still reads would change an observed value (ADVICE r5)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler import find_chains
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1, 8, 8], dtype="float32")
+        c1 = fluid.layers.conv2d(x, 2, 3, bias_attr=False)
+        b1 = fluid.layers.batch_norm(c1, is_test=True)  # fusable pair
+    block = main.global_block()
+    assert len(find_chains(block, ["conv2d", "batch_norm"],
+                           [("Output", "X")])) == 1
+    # a sub-block op reads the interior var WITHOUT surfacing it as an
+    # input of the parent control-flow op -> no longer safe to fuse
+    sub = main.create_block()
+    main.rollback()
+    sub.append_op("relu", {"X": [c1.name]}, {"Out": ["sub_read"]}, {})
+    block.append_op("while", {}, {}, {"sub_block": sub.idx})
+    assert find_chains(block, ["conv2d", "batch_norm"],
+                       [("Output", "X")]) == []
+    # non-exclusive matching is unaffected
+    assert len(find_chains(block, ["conv2d", "batch_norm"],
+                           [("Output", "X")], exclusive=False)) == 1

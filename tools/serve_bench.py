@@ -1,0 +1,147 @@
+"""Closed-loop load generator for the serving engine (serving-bench entry).
+
+Drives a ``paddle_tpu.serving.ServingServer`` with N concurrent closed-loop
+clients (each sends the next request the moment the previous one returns)
+for a fixed duration and reports offered QPS, latency percentiles, rejects,
+and the server's own ``stats`` snapshot (batch-fill ratio, compile cache).
+
+Two modes:
+
+* ``--model-dir DIR`` — spawn an in-process server over the exported dir
+  (same format ``io.save_inference_model`` writes), bench it, shut down.
+* ``--endpoint HOST:PORT`` — bench an already-running server; feed shapes
+  then come from ``--shape name=d1,d2`` (repeatable).
+
+Examples::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
+        --clients 8 --duration 10 --rows 1 --max-batch-size 16
+    python tools/serve_bench.py --endpoint 127.0.0.1:9000 --shape x=4
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_tpu.serving import ServingClient, ServingRejected, ServingServer  # noqa: E402
+from paddle_tpu.serving.stats import _percentile  # noqa: E402
+
+
+def _client_loop(endpoint, feeds, stop, out):
+    lat, done, rejected, errors = [], 0, 0, 0
+    with ServingClient(endpoint) as c:
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                c.predict(feeds)
+                lat.append(time.monotonic() - t0)
+                done += 1
+            except ServingRejected:
+                rejected += 1
+                time.sleep(0.001)  # back off a tick before retrying
+            except Exception:
+                errors += 1
+                break
+    out.append((lat, done, rejected, errors))
+
+
+def bench(endpoint, feeds, clients, duration):
+    stop = threading.Event()
+    out = []
+    threads = [threading.Thread(target=_client_loop,
+                                args=(endpoint, feeds, stop, out), daemon=True)
+               for _ in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    elapsed = time.monotonic() - t0
+    lats = sorted(l for ls, *_ in out for l in ls)
+    done = sum(d for _, d, _, _ in out)
+    rejected = sum(r for _, _, r, _ in out)
+    errors = sum(e for _, _, _, e in out)
+    return {"elapsed_s": elapsed, "requests": done, "rejected": rejected,
+            "errors": errors, "qps": done / elapsed if elapsed else 0.0,
+            "p50_ms": _percentile(lats, 0.50) * 1e3,
+            "p95_ms": _percentile(lats, 0.95) * 1e3,
+            "p99_ms": _percentile(lats, 0.99) * 1e3}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model-dir", help="spawn an in-process server over DIR")
+    ap.add_argument("--endpoint", help="bench an already-running HOST:PORT")
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="name=d1,d2",
+                    help="per-request trailing shape of a feed (repeatable; "
+                         "required with --endpoint, optional override with "
+                         "--model-dir)")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request (client-side batch)")
+    ap.add_argument("--max-batch-size", type=int, default=16)
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    ap.add_argument("--queue-capacity", type=int, default=256)
+    args = ap.parse_args(argv)
+    if not args.model_dir and not args.endpoint:
+        ap.error("one of --model-dir / --endpoint is required")
+
+    shapes = {}
+    for spec in args.shape:
+        name, _, dims = spec.partition("=")
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+
+    server = None
+    try:
+        if args.model_dir:
+            server = ServingServer(
+                args.model_dir, max_batch_size=args.max_batch_size,
+                batch_timeout_ms=args.batch_timeout_ms,
+                queue_capacity=args.queue_capacity, warmup=True)
+            endpoint = server.endpoint
+            for n in server.engine.feed_names:
+                if n not in shapes:
+                    var = server.engine._feed_vars[n]
+                    shapes[n] = tuple(var.shape)[1:]
+            print(f"spawned server on {endpoint} (warmed "
+                  f"{server.engine.cache_info()['misses']} buckets)")
+        else:
+            endpoint = args.endpoint
+            if not shapes:
+                ap.error("--endpoint needs at least one --shape name=dims")
+
+        rng = np.random.RandomState(0)
+        feeds = {n: rng.rand(args.rows, *dims).astype("float32")
+                 for n, dims in shapes.items()}
+        print(f"benching {endpoint}: {args.clients} closed-loop clients, "
+              f"{args.duration:.0f}s, {args.rows} row(s)/request")
+        r = bench(endpoint, feeds, args.clients, args.duration)
+        print(f"requests={r['requests']} rejected={r['rejected']} "
+              f"errors={r['errors']}")
+        print(f"qps={r['qps']:.1f}  p50={r['p50_ms']:.2f}ms  "
+              f"p95={r['p95_ms']:.2f}ms  p99={r['p99_ms']:.2f}ms")
+        with ServingClient(endpoint) as c:
+            s = c.stats()
+            print(f"server: batches={s['batches']} "
+                  f"avg_rows={s['avg_batch_rows']:.2f} "
+                  f"fill={s['batch_fill_ratio']:.2f} "
+                  f"cache={s['compile_cache']}")
+        return 0 if r["errors"] == 0 else 1
+    finally:
+        if server is not None:
+            server.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
