@@ -76,6 +76,8 @@ class ElasticSupervisor:
     def __init__(self, worker_argv: Sequence[str], n_workers: int,
                  heartbeat_ttl: float = 15.0, startup_grace: float = 120.0,
                  max_restarts: int = 3, poll_interval: float = 0.5,
+                 restart_backoff: float = 1.0,
+                 restart_backoff_max: float = 30.0,
                  env: Optional[Dict[str, str]] = None, cwd: Optional[str] = None,
                  on_event: Optional[Callable[[str], None]] = None):
         self.worker_argv = list(worker_argv)
@@ -84,12 +86,30 @@ class ElasticSupervisor:
         self.startup_grace = startup_grace
         self.max_restarts = max_restarts
         self.poll_interval = poll_interval
+        # exponential backoff between incarnations: an immediate respawn
+        # of a persistently-failing job (bad image, poisoned checkpoint,
+        # flapping host) hammers the machine and floods the logs; doubling
+        # the pause per restart gives transient faults time to clear.
+        # restart_backoff=0 disables (tests that count restarts quickly).
+        self.restart_backoff = restart_backoff
+        self.restart_backoff_max = restart_backoff_max
         self.env = dict(env or {})
         self.cwd = cwd
         self.on_event = on_event or (lambda msg: None)
         self.restarts = 0
         self.outputs: List[List[str]] = []  # per incarnation, per rank
         self._logs: List = []  # open per-rank log files, current incarnation
+
+    def restart_delay(self, restarts: Optional[int] = None) -> float:
+        """Backoff before incarnation ``restarts + 1``: base * 2^restarts,
+        capped at ``restart_backoff_max``."""
+        n = self.restarts if restarts is None else restarts
+        if self.restart_backoff <= 0:
+            return 0.0
+        # cap the exponent before the pow: 2.0**1024 overflows float, and
+        # any sane cap is hit long before 2**63 anyway
+        return min(self.restart_backoff_max,
+                   self.restart_backoff * (2.0 ** min(n, 63)))
 
     def _spawn(self, server: MasterServer) -> List[subprocess.Popen]:
         gen = server.service.new_generation()
@@ -186,10 +206,17 @@ class ElasticSupervisor:
                             failed = (f"heartbeat lost for workers {missing} "
                                       f"(steps {hb['steps']})")
                             break
-                self.on_event(f"incarnation failed: {failed}")
                 self._kill_all(procs)
                 if _attempt == self.max_restarts:
+                    self.on_event(f"incarnation failed: {failed}")
                     break
+                delay = self.restart_delay()
+                self.on_event(
+                    f"incarnation failed: {failed}; restarting in "
+                    f"{delay:.1f}s (restart {self.restarts + 1}/"
+                    f"{self.max_restarts})")
+                if delay > 0:
+                    time.sleep(delay)
                 self.restarts += 1
             raise RuntimeError(
                 f"elastic job failed: {failed}; gave up after "

@@ -21,10 +21,12 @@ host as a compatibility fallback.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import urllib.parse
+import warnings
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -34,6 +36,7 @@ from .core.ir import Program, Variable, default_main_program
 
 MODEL_FILENAME = "__model__"
 SUCCESS_MARKER = "_SUCCESS"
+MANIFEST_FILENAME = "_MANIFEST.json"
 CHECKPOINT_PREFIX = "checkpoint"
 SHARD_META_SUFFIX = ".shards.json"
 
@@ -292,6 +295,11 @@ def reshard_sharded_var(dirname: str, name: str, new_rows: Optional[int] = None,
         for mpath in _shard_descriptors(dirname, name):
             if os.path.abspath(mpath) != os.path.abspath(meta_path):
                 os.remove(mpath)
+    if os.path.exists(os.path.join(out_dirname, MANIFEST_FILENAME)):
+        # resharding inside a committed checkpoint dir rewrote files the
+        # digest manifest covers — refresh it or the (valid) checkpoint
+        # would read as corrupt at the next load
+        write_checkpoint_manifest(out_dirname)
     return new_meta
 
 
@@ -443,6 +451,84 @@ def load_inference_model(dirname, executor, scope=None):
 # ---------------------------------------------------------------------------
 # Checkpoint / resume (<- io.py:802 save_checkpoint, :882 load_checkpoint)
 # ---------------------------------------------------------------------------
+#
+# Integrity: every numbered checkpoint carries a per-file digest manifest
+# (_MANIFEST.json, written before the _SUCCESS marker — <- the reference's
+# Go pserver checkpoints carrying a CRC32 its LoadCheckpoint verified,
+# go/pserver/service.go:346). A _SUCCESS marker only proves the save
+# FINISHED; the manifest proves the bytes on disk are still the bytes that
+# were saved — torn writes, truncation, and bit rot all surface as a
+# verification failure, and load_checkpoint falls back to the newest older
+# complete serial instead of loading garbage into a training run.
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_checkpoint_manifest(dirname: str) -> dict:
+    """Digest every file under ``dirname`` (recursively — host-table and
+    shard files included) into ``_MANIFEST.json``. Call after all writers
+    have finished and before the _SUCCESS marker commits the checkpoint."""
+    files = {}
+    for root, _dirs, names in os.walk(dirname):
+        for fn in sorted(names):
+            if fn in (SUCCESS_MARKER, MANIFEST_FILENAME):
+                continue
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, dirname)
+            files[rel] = {"sha256": _file_digest(p),
+                          "bytes": os.path.getsize(p)}
+    manifest = {"algo": "sha256", "files": files}
+    _atomic_write(os.path.join(dirname, MANIFEST_FILENAME),
+                  lambda f: f.write(json.dumps(manifest).encode()))
+    return manifest
+
+
+def verify_checkpoint(dirname: str) -> Optional[str]:
+    """Check ``dirname`` against its manifest. Returns ``None`` when clean
+    (or when no manifest exists — pre-manifest checkpoints stay loadable),
+    else a human-readable description of the first corruption found."""
+    mpath = os.path.join(dirname, MANIFEST_FILENAME)
+    if not os.path.exists(mpath):
+        return None  # legacy checkpoint: nothing to verify against
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable manifest: {e}"
+    for rel, ent in manifest.get("files", {}).items():
+        p = os.path.join(dirname, rel)
+        if not os.path.exists(p):
+            return f"missing file {rel!r}"
+        size = os.path.getsize(p)
+        if size != ent["bytes"]:
+            return (f"size mismatch for {rel!r}: {size} bytes on disk, "
+                    f"{ent['bytes']} in manifest")
+        if _file_digest(p) != ent["sha256"]:
+            return f"digest mismatch for {rel!r}"
+    return None
+
+
+def _pick_verified_serial(checkpoint_dir: str) -> int:
+    """Newest complete serial that passes manifest verification; ``-1``
+    when every complete checkpoint is corrupt, ``-2`` when none exists."""
+    serials = _checkpoint_serials(checkpoint_dir)
+    if not serials:
+        return -2
+    for s in reversed(serials):
+        err = verify_checkpoint(
+            os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{s}"))
+        if err is None:
+            return s
+        warnings.warn(
+            f"checkpoint_{s} under {checkpoint_dir} is corrupt ({err}); "
+            f"falling back to an older checkpoint")
+    return -1
 
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
@@ -472,6 +558,9 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
 
         multihost_utils.sync_global_devices(f"checkpoint_{serial}_written")
         if jax.process_index() == 0:
+            # the barrier above guarantees every host's shard files are on
+            # disk, so the chief's manifest covers the whole checkpoint
+            write_checkpoint_manifest(cur)
             with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
                 f.write(str(trainer_id))
             _scroll_delete(checkpoint_dir, max_num_checkpoints)
@@ -480,6 +569,7 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
         # (overwriting these shards) and desynchronize the barrier keys
         multihost_utils.sync_global_devices(f"checkpoint_{serial}_marked")
         return serial
+    write_checkpoint_manifest(cur)
     with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
         f.write(str(trainer_id))
     _scroll_delete(checkpoint_dir, max_num_checkpoints)
@@ -488,14 +578,67 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
 
 def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
                     serial=None, host_tables=None):
+    """Load the newest VERIFIED complete checkpoint (or ``serial``).
+
+    Verification happens BEFORE anything touches the scope: a checkpoint
+    whose bytes no longer match its digest manifest (truncated array file,
+    bit rot) is skipped with a warning and the newest older complete
+    serial is used instead — a corrupt latest checkpoint must never load
+    garbage when an intact predecessor exists. All-corrupt (or an
+    explicitly requested corrupt ``serial``) raises ``IOError`` — resuming
+    fresh over silently-lost state is the one thing this must never do."""
+    import jax
+
     if serial is None:
-        serial = _latest_checkpoint_serial(checkpoint_dir)
+        if jax.process_count() > 1:
+            # exactly one host decides: per-host verification can diverge
+            # (one host's stale shared-fs attribute cache reads a file as
+            # short) and a split decision would silently resume the job
+            # from DIFFERENT serials on different hosts. The chief
+            # verifies; everyone loads the broadcast winner.
+            from jax.experimental import multihost_utils
+
+            chosen = (_pick_verified_serial(checkpoint_dir)
+                      if jax.process_index() == 0 else 0)
+            chosen = int(multihost_utils.broadcast_one_to_all(
+                np.int64(chosen)))
+        else:
+            chosen = _pick_verified_serial(checkpoint_dir)
+        if chosen == -2:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {checkpoint_dir}")
+        if chosen == -1:
+            raise IOError(
+                f"every complete checkpoint under {checkpoint_dir} failed "
+                f"manifest verification; refusing to load corrupt state")
+        serial = chosen
+    else:
+        # same chief-verify + broadcast discipline as the serial=None
+        # branch: a per-host verdict split (raise on one host, proceed on
+        # the rest) would wedge the survivors inside the load collectives
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            err = (verify_checkpoint(os.path.join(
+                checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}"))
+                if jax.process_index() == 0 else None)
+            corrupt = int(multihost_utils.broadcast_one_to_all(
+                np.int64(0 if err is None else 1)))
+            if corrupt:
+                raise IOError(
+                    f"checkpoint_{serial} under {checkpoint_dir} is corrupt"
+                    + (f": {err}" if err else " (chief-verified)"))
+        else:
+            err = verify_checkpoint(
+                os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}"))
+            if err is not None:
+                raise IOError(
+                    f"checkpoint_{serial} under {checkpoint_dir} is corrupt: "
+                    f"{err}")
     if serial < 0:
         raise FileNotFoundError(f"no complete checkpoint under {checkpoint_dir}")
     cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
     load_persistables(executor, cur, main_program, scope=scope)
-    import jax
-
     for table in (host_tables or []):
         tdir = _host_table_dir(cur, table.name, jax.process_index())
         if not os.path.exists(os.path.join(tdir, "meta.json")):

@@ -1,20 +1,33 @@
-"""paddle_tpu.serving — dynamic-batching inference serving.
+"""paddle_tpu.serving — dynamic-batching inference serving, fault-tolerant.
 
 The deployment half of the roadmap: the training side exports a frozen
 program (``io.save_inference_model``) and the synchronous ``Predictor``
 runs it one request at a time; this package turns that artifact into a
-traffic-serving engine. Four pieces, composable or used together via
-``ServingServer``:
+traffic-serving engine with a full resilience layer (docs/design.md §12 —
+the serving-side re-expression of the reference's Go fault-tolerance
+plane). Pieces, composable or used together via ``ServingServer``:
 
 * ``ServingEngine`` (engine.py) — frozen program + device-resident params,
   bucket-ladder padding, LRU compile cache with hit/miss accounting,
-  ``warmup()`` to pre-compile the ladder.
+  ``warmup()`` to pre-compile the ladder, ``reload_params()`` zero-downtime
+  atomic hot weight reload.
 * ``MicroBatcher`` (batcher.py) — bounded-queue request coalescing into one
-  padded device call per batch window; rejects (never blocks) when full.
+  padded device call per batch window; rejects (never blocks) when full;
+  sheds deadline-expired requests at coalesce time; drains on close (a
+  submitted future always resolves, with a result or a typed error).
 * ``ServingServer`` / ``ServingClient`` (server.py) — dependency-free
-  threaded TCP line-JSON front: ``predict`` / ``healthz`` / ``stats``.
+  threaded TCP line-JSON front: ``predict`` / ``healthz`` / ``stats`` /
+  ``reload``; health state machine (healthy/degraded/draining) with
+  probabilistic load shedding; graceful SIGTERM drain. The client retries
+  retryable errors with exponential backoff + jitter under a budget and
+  reconnects automatically.
 * ``ServingStats`` (stats.py) — QPS, latency percentiles, batch fill,
-  queue depth, compile hits/misses, rejects.
+  queue depth, compile hits/misses, rejects/sheds/deadline misses,
+  weights version — cumulative and sliding-window.
+* ``ChaosInjector`` (chaos.py) — seeded fault injection (slow device
+  calls, step faults, connection drops, queue stalls) proving all of the
+  above recovers; wired into ``tools/serve_bench.py --chaos``.
+* ``errors`` (errors.py) — the typed error hierarchy + wire codes.
 
 Quickstart::
 
@@ -23,16 +36,23 @@ Quickstart::
 
     with ServingServer("exported_model_dir", max_batch_size=16,
                        batch_timeout_ms=2.0, warmup=True) as srv:
-        with ServingClient(srv.endpoint) as c:
-            outs = c.predict({"x": x_batch})   # list of np arrays
-            print(c.stats()["latency_ms"])
+        with ServingClient(srv.endpoint, retries=4) as c:
+            outs = c.predict({"x": x_batch}, timeout_ms=200)
+            c.reload("exported_model_dir_v2")   # hot weight swap
+            print(c.stats()["latency_ms"], c.healthz()["state"])
 """
 from .batcher import MicroBatcher, QueueFullError  # noqa: F401
+from .chaos import ChaosInjector  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
-from .server import ServingClient, ServingRejected, ServingServer  # noqa: F401
+from .errors import (DeadlineExceeded, InjectedFault, LoadShedError,  # noqa: F401
+                     RetryBudgetExceeded, ServingError, ServingRejected,
+                     ServingUnavailable, ShuttingDown)
+from .server import ServingClient, ServingServer  # noqa: F401
 from .stats import ServingStats  # noqa: F401
 
 __all__ = [
-    "MicroBatcher", "QueueFullError", "ServingEngine", "ServingClient",
-    "ServingRejected", "ServingServer", "ServingStats",
+    "ChaosInjector", "DeadlineExceeded", "InjectedFault", "LoadShedError",
+    "MicroBatcher", "QueueFullError", "RetryBudgetExceeded", "ServingClient",
+    "ServingEngine", "ServingError", "ServingRejected", "ServingServer",
+    "ServingStats", "ServingUnavailable", "ShuttingDown",
 ]

@@ -9,6 +9,10 @@ between them:
   instead of blocking — load shedding at the edge keeps tail latency bounded
   and lets the caller retry against a replica (the reference's pserver-side
   send buffers blocked, which is exactly the failure mode this avoids).
+* a request may carry an absolute **deadline** (monotonic seconds): one
+  whose deadline has already passed when the worker would coalesce it is
+  shed with a typed ``DeadlineExceeded`` instead of wasting space in a
+  device dispatch the client has stopped waiting for.
 * a background thread pulls requests, coalescing until ``max_batch_size``
   rows are gathered or ``batch_timeout_ms`` has elapsed since the first
   request — whichever comes first — then dispatches ONE
@@ -17,6 +21,9 @@ between them:
 * requests only coalesce when their trailing-shape signature matches (same
   compiled bucket); a mismatched request is carried over to start the next
   batch rather than reordered behind later traffic.
+* ``close()`` drains: the worker keeps serving until the queue is empty,
+  then exits; anything it cannot serve resolves with a typed
+  ``ShuttingDown`` — a submitted future ALWAYS resolves, it never hangs.
 """
 from __future__ import annotations
 
@@ -29,30 +36,18 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .engine import ServingEngine
+from .errors import DeadlineExceeded, QueueFullError, ShuttingDown  # noqa: F401 (QueueFullError re-exported: PR-1 import site)
 from .stats import ServingStats
 
 
-class QueueFullError(RuntimeError):
-    """Structured backpressure rejection: the request was NOT enqueued."""
-
-    def __init__(self, queue_depth: int, capacity: int):
-        self.queue_depth = queue_depth
-        self.capacity = capacity
-        super().__init__(
-            f"serving queue full ({queue_depth}/{capacity}); request rejected")
-
-    def info(self) -> Dict[str, Any]:
-        return {"code": "rejected", "reason": "queue_full",
-                "queue_depth": self.queue_depth, "capacity": self.capacity}
-
-
 class _Request:
-    __slots__ = ("feeds", "sig", "rows", "future", "t_submit")
+    __slots__ = ("feeds", "sig", "rows", "future", "t_submit", "deadline")
 
-    def __init__(self, feeds, sig, rows):
+    def __init__(self, feeds, sig, rows, deadline=None):
         self.feeds = feeds
         self.sig = sig
         self.rows = rows
+        self.deadline = deadline  # absolute monotonic seconds, or None
         self.future: Future = Future()
         self.t_submit = time.monotonic()
 
@@ -78,8 +73,11 @@ class MicroBatcher:
         self.batch_timeout_s = batch_timeout_ms / 1e3
         self.queue_capacity = int(queue_capacity)
         self.stats = stats
+        self.chaos = None  # optional ChaosInjector (queue-stall hook)
         self._queue: "queue.Queue[_Request]" = queue.Queue(self.queue_capacity)
         self._carry: Optional[_Request] = None  # held-over (mismatch/overflow)
+        self._pending = 0  # accepted futures not yet resolved (drain gauge)
+        self._pending_lock = threading.Lock()
         self._stop = threading.Event()
         self._closed = False
         self._close_lock = threading.Lock()  # orders submit's put vs close
@@ -88,27 +86,41 @@ class MicroBatcher:
             self.start()
 
     # -- producer side --
-    def submit(self, feeds: Dict[str, Any]) -> Future:
+    def submit(self, feeds: Dict[str, Any],
+               deadline: Optional[float] = None) -> Future:
         """Enqueue one request (leading dim = rows). Never blocks: raises
-        ``QueueFullError`` when the bounded queue is full."""
+        ``QueueFullError`` when the bounded queue is full, ``ShuttingDown``
+        after ``close()``. ``deadline`` is absolute ``time.monotonic()``
+        seconds; an already-expired request is refused up front."""
         if self._closed:
             # a drained queue would accept the put but no worker will ever
             # serve it — fail now, not at the caller's result() timeout
-            raise RuntimeError("batcher closed")
+            raise ShuttingDown("batcher closed")
+        if deadline is not None and time.monotonic() >= deadline:
+            if self.stats:
+                self.stats.record_deadline()
+            raise DeadlineExceeded(time.monotonic() - deadline, "submit")
         padded, sig, rows = self.engine.prepare_request(feeds)
         if rows > self.max_batch_size:
             raise ValueError(
                 f"request of {rows} rows exceeds max_batch_size "
                 f"{self.max_batch_size}; split it client-side")
-        req = _Request(padded, sig, rows)
+        req = _Request(padded, sig, rows, deadline=deadline)
         with self._close_lock:
             # re-check under the lock: a close() racing this submit either
             # sees our put (and drains/fails it) or we see its _closed
             if self._closed:
-                raise RuntimeError("batcher closed")
+                raise ShuttingDown("batcher closed")
+            # count BEFORE the put: the worker may resolve the request the
+            # instant it lands, and `pending` must never transiently read
+            # 0 while an accepted request is unresolved (drain correctness)
+            with self._pending_lock:
+                self._pending += 1
             try:
                 self._queue.put_nowait(req)
             except queue.Full:
+                with self._pending_lock:
+                    self._pending -= 1
                 if self.stats:
                     self.stats.record_reject()
                 raise QueueFullError(self.queue_depth,
@@ -120,6 +132,13 @@ class MicroBatcher:
     @property
     def queue_depth(self) -> int:
         return self._queue.qsize() + (1 if self._carry is not None else 0)
+
+    @property
+    def pending(self) -> int:
+        """Accepted requests whose future has not resolved yet (queued OR
+        mid-dispatch) — the server's drain loop waits on this."""
+        with self._pending_lock:
+            return self._pending
 
     # -- worker side --
     def start(self) -> None:
@@ -139,12 +158,35 @@ class MicroBatcher:
         except queue.Empty:
             return None
 
+    def _shed_expired(self, req: _Request) -> bool:
+        """Coalesce-time deadline check: a request whose deadline has
+        passed is resolved with ``DeadlineExceeded`` and never occupies a
+        slot in a device dispatch. Returns True when shed."""
+        if req.deadline is None:
+            return False
+        now = time.monotonic()
+        if now < req.deadline:
+            return False
+        if self._complete(req, exc=DeadlineExceeded(now - req.deadline,
+                                                    "coalesce")):
+            if self.stats:
+                self.stats.record_deadline()
+        return True
+
     def _loop(self) -> None:
         while True:
             first = self._next(0.05)
             if first is None:
                 if self._stop.is_set():
                     return
+                continue
+            if self.chaos is not None:
+                # injected queue stall, per batch (an idle poll must not
+                # roll the dice — it would drain the fault budget with no
+                # traffic to observe the fault); stalling with `first` in
+                # hand lets the queue build behind it, and may expire it
+                self.chaos.on_coalesce()
+            if self._shed_expired(first):
                 continue
             batch = [first]
             rows = first.rows
@@ -153,6 +195,8 @@ class MicroBatcher:
                 nxt = self._next(max(0.0, deadline - time.monotonic()))
                 if nxt is None:  # timed out — ship what we have
                     break
+                if self._shed_expired(nxt):
+                    continue
                 if nxt.sig != first.sig or rows + nxt.rows > self.max_batch_size:
                     self._carry = nxt  # starts the next batch, keeps order
                     break
@@ -160,8 +204,7 @@ class MicroBatcher:
                 rows += nxt.rows
             self._dispatch(batch, rows)
 
-    @staticmethod
-    def _complete(req: _Request, result=None, exc=None) -> bool:
+    def _complete(self, req: _Request, result=None, exc=None) -> bool:
         """Resolve a future exactly once (cancelled/raced ones are done)."""
         if req.future.done():
             return False
@@ -170,9 +213,11 @@ class MicroBatcher:
                 req.future.set_exception(exc)
             else:
                 req.future.set_result(result)
-            return True
         except Exception:  # lost a set race — the other side owns it
             return False
+        with self._pending_lock:
+            self._pending -= 1
+        return True
 
     def _fail_batch(self, batch: List[_Request], e: Exception) -> None:
         if self.stats:
@@ -210,7 +255,9 @@ class MicroBatcher:
                 self.stats.record_done(now - r.t_submit)
 
     def close(self, timeout: float = 10.0) -> None:
-        """Stop the worker after draining queued requests."""
+        """Graceful drain: no new submits land, the worker serves what is
+        already queued, then exits; whatever cannot be served resolves with
+        a typed ``ShuttingDown`` (a submitted future never hangs)."""
         with self._close_lock:  # no submit can land a put after this
             self._closed = True
         self._stop.set()
@@ -230,7 +277,7 @@ class MicroBatcher:
             except queue.Empty:
                 break
         for r in leftover:
-            self._complete(r, exc=RuntimeError("batcher closed"))
+            self._complete(r, exc=ShuttingDown("batcher closed"))
 
     def __enter__(self):
         return self

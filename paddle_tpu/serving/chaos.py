@@ -1,0 +1,127 @@
+"""Fault-injection harness for the serving stack (the chaos plane).
+
+The reference's fault-tolerance plane was only trusted because its Go test
+suite killed pservers mid-run and watched the master re-queue work; this is
+the serving-side equivalent: a seeded, hook-based injector the engine,
+batcher, and server consult at their natural fault points. Nothing in the
+serving code path changes shape when chaos is off (the hooks are a single
+``is None`` check), and every injection is drawn from one seeded RNG, so a
+failing chaos run replays exactly.
+
+Fault classes (each an independent probability per event):
+
+* **slow device call** (``slow_call_prob``/``slow_call_ms``) — the engine
+  sleeps before dispatch: models a busy device / long compile. Exercises
+  queue growth, deadline sheds, degraded health.
+* **step-fn exception** (``error_prob``) — the engine raises
+  ``InjectedFault`` (wire code ``unavailable``) instead of dispatching:
+  models an XLA runtime fault. Exercises batch-failure fan-out + client
+  retry.
+* **connection drop** (``drop_conn_prob``) — the server closes the socket
+  before answering: models a crashed frontend / LB reset. Exercises client
+  reconnect + retry.
+* **queue stall** (``stall_prob``/``stall_ms``) — the batcher worker sleeps
+  before coalescing: models a wedged consumer. Exercises backpressure
+  (queue_full) and deadline sheds.
+
+The injector is **armed for a bounded window** (``fault_window_s``; None =
+forever) and/or a bounded count (``max_faults``), after which every hook
+becomes a no-op — tests assert the server returns to ``healthy`` after the
+window, which is the whole point of the resilience layer. Counters are
+surfaced via ``snapshot()`` and printed by ``tools/serve_bench.py
+--chaos``.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .errors import InjectedFault
+
+
+class ChaosInjector:
+    """Seeded fault injector; attach via ``ServingServer(chaos=...)`` or
+    set ``engine.chaos`` / ``batcher.chaos`` directly."""
+
+    def __init__(self, seed: int = 0, slow_call_prob: float = 0.0,
+                 slow_call_ms: float = 50.0, error_prob: float = 0.0,
+                 drop_conn_prob: float = 0.0, stall_prob: float = 0.0,
+                 stall_ms: float = 50.0,
+                 fault_window_s: Optional[float] = None,
+                 max_faults: Optional[int] = None):
+        self.seed = seed
+        self.slow_call_prob = slow_call_prob
+        self.slow_call_ms = slow_call_ms
+        self.error_prob = error_prob
+        self.drop_conn_prob = drop_conn_prob
+        self.stall_prob = stall_prob
+        self.stall_ms = stall_ms
+        self.fault_window_s = fault_window_s
+        self.max_faults = max_faults
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.injected = {"slow_calls": 0, "errors": 0, "dropped_conns": 0,
+                         "stalls": 0}
+
+    def arm(self) -> None:
+        """(Re)start the fault window from now."""
+        with self._lock:
+            self._t0 = time.monotonic()
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._active_locked()
+
+    def _active_locked(self) -> bool:
+        if (self.max_faults is not None
+                and sum(self.injected.values()) >= self.max_faults):
+            return False
+        return (self.fault_window_s is None
+                or time.monotonic() - self._t0 <= self.fault_window_s)
+
+    def _roll(self, prob: float, counter: str) -> bool:
+        """One seeded coin flip; counts the injection when it fires."""
+        if prob <= 0.0:
+            return False
+        with self._lock:
+            if not self._active_locked():
+                return False
+            if self._rng.random() >= prob:
+                return False
+            self.injected[counter] += 1
+            return True
+
+    # -- hooks (each called from exactly one layer) --
+    def on_dispatch(self) -> None:
+        """Engine hook, before the device call: slow call or step fault."""
+        if self._roll(self.slow_call_prob, "slow_calls"):
+            time.sleep(self.slow_call_ms / 1e3)
+        if self._roll(self.error_prob, "errors"):
+            raise InjectedFault("chaos: injected step-fn fault")
+
+    def on_coalesce(self) -> None:
+        """Batcher hook, before pulling a batch: queue stall."""
+        if self._roll(self.stall_prob, "stalls"):
+            time.sleep(self.stall_ms / 1e3)
+
+    def drop_connection(self) -> bool:
+        """Server hook, per request: True = hang up without answering."""
+        return self._roll(self.drop_conn_prob, "dropped_conns")
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"seed": self.seed, "active": self._active_locked(),
+                    "injected": dict(self.injected)}
+
+
+def default_profile(seed: int = 0,
+                    fault_window_s: Optional[float] = None) -> ChaosInjector:
+    """The serve_bench ``--chaos`` profile: a little of everything."""
+    return ChaosInjector(seed=seed, slow_call_prob=0.10, slow_call_ms=30.0,
+                         error_prob=0.05, drop_conn_prob=0.05,
+                         stall_prob=0.05, stall_ms=30.0,
+                         fault_window_s=fault_window_s)

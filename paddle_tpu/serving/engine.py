@@ -20,6 +20,14 @@ The program is frozen once at load: parameters are device-resident arrays,
 the block is traced into one step function (``core.executor.build_step_fn``,
 the same lowering the Executor uses), and each bucket signature gets its own
 ``jax.jit`` wrapper so evicting a cache entry actually frees its executable.
+
+The param *values* are not frozen forever: ``reload_params`` hot-swaps them
+from a re-exported inference dir with zero downtime. The whole param set is
+one dict swapped by a single attribute assignment, and every dispatch
+snapshots that reference once before running — so each response is computed
+entirely with the old weights or entirely with the new, never a mix
+(docs/design.md §12). Shapes/dtypes are validated against the frozen
+program BEFORE the swap; a bad export leaves the serving set untouched.
 """
 from __future__ import annotations
 
@@ -134,6 +142,8 @@ class ServingEngine:
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self.cache_hits = 0
         self.cache_misses = 0
+        self.params_version = 1  # bumped by every successful reload_params
+        self.chaos = None  # optional ChaosInjector (dispatch hooks)
 
     # -- bucketing --
     def bucket_batch(self, rows: int) -> int:
@@ -222,6 +232,62 @@ class ServingEngine:
             return {"hits": self.cache_hits, "misses": self.cache_misses,
                     "size": len(self._cache), "capacity": self.cache_capacity}
 
+    # -- hot weight reload --
+    def reload_params(self, dirname: str) -> int:
+        """Atomically swap the serving parameters from a re-exported
+        inference dir; returns the new ``params_version``.
+
+        The new export must be shape-compatible with the FROZEN program:
+        same feed/fetch names and, for every state var, the same shape and
+        dtype (the traced step fn and its compiled bucket executables are
+        kept — only the weight values change, so no recompile and no
+        downtime). Validation happens entirely before the swap: a bad
+        export raises ``ValueError`` and the live params are untouched.
+        In-flight batches that already snapshotted the old dict finish on
+        the old weights; every later dispatch sees only the new ones —
+        no response ever mixes versions.
+        """
+        import jax
+
+        from .. import io as model_io
+        from ..core.executor import Scope
+
+        scope = Scope()
+        _program, feed_names, fetch_names = model_io.load_inference_model(
+            dirname, None, scope=scope)
+        if list(feed_names) != list(self.feed_names) \
+                or list(fetch_names) != list(self.fetch_names):
+            raise ValueError(
+                f"reload {dirname!r}: feed/fetch names "
+                f"({feed_names}/{fetch_names}) do not match the frozen "
+                f"program ({list(self.feed_names)}/{list(self.fetch_names)})")
+        staged: Dict[str, np.ndarray] = {}
+        for n in list(self._readonly_names) + list(self._donated_names):
+            v = scope.get(n)
+            if v is None:
+                raise ValueError(
+                    f"reload {dirname!r}: state var {n!r} has no saved value")
+            arr = np.asarray(v)
+            old = self._params[n]
+            if tuple(arr.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"reload {dirname!r}: {n!r} shape {arr.shape} != frozen "
+                    f"{tuple(old.shape)}")
+            if np.dtype(arr.dtype) != np.dtype(old.dtype):
+                raise ValueError(
+                    f"reload {dirname!r}: {n!r} dtype {arr.dtype} != frozen "
+                    f"{np.dtype(old.dtype)}")
+            staged[n] = arr
+        # validated: device_put the full set, then swap the dict reference
+        # (one attribute store — dispatches snapshot it exactly once)
+        with jax.default_device(self._device):
+            new_params = {n: jax.device_put(a, self._device)
+                          for n, a in staged.items()}
+        with self._lock:
+            self._params = new_params
+            self.params_version += 1
+            return self.params_version
+
     # -- execution --
     def run_batch(self, feeds: Dict[str, Any]) -> List[np.ndarray]:
         """Run one coalesced batch: pad rows up to the bucket, dispatch one
@@ -244,14 +310,19 @@ class ServingEngine:
         sig = tuple((n, feeds[n].shape, str(feeds[n].dtype))
                     for n in self.feed_names)
         fn = self._get_fn(sig)
-        # no lock around the dispatch: _params/_key are frozen after
-        # __init__ and jitted calls are thread-safe — a cold-bucket compile
-        # must not stall cache_info() (the stats RPC) or other runners
+        if self.chaos is not None:
+            self.chaos.on_dispatch()  # injected slow call / step fault
+        # no lock around the dispatch: jitted calls are thread-safe and the
+        # param set is read through ONE snapshot of the dict reference —
+        # reload_params swaps the whole dict atomically, so this batch runs
+        # entirely on one weights version. A cold-bucket compile must not
+        # stall cache_info() (the stats RPC) or other runners.
+        params = self._params
         with jax.default_device(self._device):
             feed_vals = {n: jax.device_put(a, self._device)
                          for n, a in feeds.items()}
-            readonly = {n: self._params[n] for n in self._readonly_names}
-            donated = {n: self._params[n] for n in self._donated_names}
+            readonly = {n: params[n] for n in self._readonly_names}
+            donated = {n: params[n] for n in self._donated_names}
             fetches, _ = fn(feed_vals, readonly, donated, self._key)
         outs = []
         for name, f in zip(self.fetch_names, fetches):

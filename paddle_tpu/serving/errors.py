@@ -1,0 +1,178 @@
+"""Typed serving errors + their wire encoding.
+
+The fault-tolerance contract of the serving plane (the TPU re-expression
+of the reference's Go master/pserver recovery loops) is that every failure
+a client can observe is TYPED and carries a machine-readable ``info()``
+dict the server returns verbatim as the RPC ``error`` payload. Wire codes:
+
+* ``rejected`` — the request was NOT executed and MAY be retried
+  (``reason``: ``queue_full`` backpressure, ``shedding`` probabilistic
+  load shed while degraded, ``draining`` graceful shutdown in progress).
+* ``unavailable`` — the request failed mid-flight for a transient server
+  reason (injected fault, step-fn error); retryable, the work was not
+  partially applied (inference is stateless).
+* ``deadline_exceeded`` — the caller's deadline passed before dispatch;
+  terminal (retrying what the client already gave up on wastes a device
+  call — the exact failure the deadline exists to prevent).
+
+``ServingClient`` maps the codes back to these classes, retries the
+retryable ones with exponential backoff + jitter, and wraps an exhausted
+budget in the terminal ``RetryBudgetExceeded`` (``last_error`` preserved).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class ServingError(RuntimeError):
+    """Base of all typed serving errors. ``code`` is the wire error code;
+    ``retryable`` is the client-side retry eligibility."""
+
+    code = "error"
+    retryable = False
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": self.code, "message": str(self)}
+
+
+class QueueFullError(ServingError):
+    """Structured backpressure rejection: the request was NOT enqueued."""
+
+    code = "rejected"
+    retryable = True
+
+    def __init__(self, queue_depth: int, capacity: int):
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+        super().__init__(
+            f"serving queue full ({queue_depth}/{capacity}); request rejected")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "queue_full",
+                "queue_depth": self.queue_depth, "capacity": self.capacity}
+
+
+class ShuttingDown(ServingError):
+    """The server (or batcher) is draining/closed: not enqueued, retryable
+    against a replica — this instance will not take new work."""
+
+    code = "rejected"
+    retryable = True
+
+    def __init__(self, message: str = "serving shutting down"):
+        super().__init__(message)
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "draining",
+                "message": str(self)}
+
+
+class LoadShedError(ServingError):
+    """Probabilistic shed while the server is degraded: not enqueued."""
+
+    code = "rejected"
+    retryable = True
+
+    def __init__(self, state: str, queue_depth: int, capacity: int):
+        self.state = state
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+        super().__init__(f"load shed while {state} "
+                         f"(queue {queue_depth}/{capacity})")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "shedding", "state": self.state,
+                "queue_depth": self.queue_depth, "capacity": self.capacity}
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before it was dispatched (shed at
+    coalesce time) or before the client's retry loop could re-send it.
+    Terminal: the caller has already given up on the answer."""
+
+    code = "deadline_exceeded"
+    retryable = False
+
+    def __init__(self, overshoot_s: float = 0.0, where: str = "coalesce"):
+        self.overshoot_ms = overshoot_s * 1e3
+        self.where = where
+        super().__init__(
+            f"deadline exceeded {self.overshoot_ms:.1f}ms before {where}")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "deadline_exceeded", "where": self.where,
+                "overshoot_ms": self.overshoot_ms}
+
+
+class ServingUnavailable(ServingError):
+    """Transient mid-flight server fault (step-fn error, injected fault).
+    Retryable: inference is stateless, nothing was partially applied."""
+
+    code = "unavailable"
+    retryable = True
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "unavailable", "message": str(self)}
+
+
+class InjectedFault(ServingUnavailable):
+    """A chaos-harness fault (serving/chaos.py) — distinguishable in logs
+    from organic faults, identical on the wire (code ``unavailable``)."""
+
+
+class ServingRejected(ServingError):
+    """Client-side view of a structured ``rejected`` answer (queue_full /
+    shedding / draining). Retryable — ideally against a replica.
+
+    NOTE: the one deliberate asymmetry in the hierarchy — ``info`` here is
+    a DICT property (the wire payload as received), not the ``info()``
+    method the server-side classes use to build that payload; the original
+    serving API shipped ``err.info["reason"]`` and that shape is kept.
+    Generic code should use :func:`error_info` to read either kind."""
+
+    code = "rejected"
+    retryable = True
+
+    def __init__(self, info: Dict[str, Any]):
+        self._info = dict(info)
+        super().__init__(f"request rejected: {info.get('reason', info)}")
+
+    @property
+    def info(self) -> Dict[str, Any]:
+        return self._info
+
+
+class RetryBudgetExceeded(ServingError):
+    """Terminal client error: the retry budget ran out. ``last_error`` is
+    the final retryable error observed; nothing was silently swallowed."""
+
+    code = "retry_budget_exceeded"
+    retryable = False
+
+    def __init__(self, attempts: int, last_error: Optional[BaseException]):
+        self.attempts = attempts
+        self.last_error = last_error
+        super().__init__(
+            f"retry budget exhausted after {attempts} attempt(s); "
+            f"last error: {type(last_error).__name__}: {last_error}")
+
+
+def error_info(e: ServingError) -> Dict[str, Any]:
+    """The wire payload of any ServingError, whether its ``info`` is the
+    server-side method or ``ServingRejected``'s received-payload dict."""
+    info = e.info
+    return info() if callable(info) else info
+
+
+def error_from_wire(err: Dict[str, Any]) -> ServingError:
+    """Map a structured RPC ``error`` dict back to its typed class."""
+    code = err.get("code")
+    if code == "rejected":
+        return ServingRejected(err)
+    if code == "deadline_exceeded":
+        e = DeadlineExceeded(where=err.get("where", "server"))
+        e.overshoot_ms = err.get("overshoot_ms", 0.0)
+        return e
+    if code == "unavailable":
+        return ServingUnavailable(err.get("message", "serving unavailable"))
+    return ServingError(f"serving error: {err}")

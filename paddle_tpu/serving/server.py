@@ -3,41 +3,53 @@
 One request per line: ``{"method": ..., "params": {...}}`` ->
 ``{"result": ...}`` | ``{"error": ...}``. Deliberately dependency-free
 (socketserver), mirroring how the master's RPC spawns a real server in
-tests and drives a client against it. Three methods:
+tests and drives a client against it. Methods:
 
 * ``predict`` — params ``{"feeds": {name: {"data": nested-list,
-  "dtype": "float32"} | nested-list}}``; arrays include the leading batch
-  dim. The handler submits to the micro-batcher and blocks THAT connection
-  thread on the future (socketserver gives one thread per connection), so
-  slow requests never stall the accept loop. A full queue answers
-  ``{"error": {"code": "rejected", "reason": "queue_full", ...}}`` —
-  structured backpressure the client can distinguish from a failure.
-* ``healthz`` — liveness + model identity.
-* ``stats`` — ``ServingStats.snapshot()`` merged with compile-cache and
-  queue gauges.
+  "dtype": "float32"} | nested-list}, "deadline_ms": remaining-budget}``;
+  arrays include the leading batch dim. The handler submits to the
+  micro-batcher and blocks THAT connection thread on the future
+  (socketserver gives one thread per connection), so slow requests never
+  stall the accept loop. Every failure answers with a TYPED structured
+  error (errors.py wire codes): ``rejected`` (queue_full / shedding /
+  draining — retryable), ``unavailable`` (transient fault — retryable),
+  ``deadline_exceeded`` (terminal). ``deadline_ms`` is a RELATIVE budget
+  (client and server clocks are never compared); the server pins it to its
+  own monotonic clock on receipt and the batcher sheds the request at
+  coalesce time if it expires before dispatch.
+* ``healthz`` — liveness + model identity + the health state machine:
+  ``healthy`` / ``degraded`` (queue or recent-error pressure; degraded
+  servers shed probabilistically) / ``draining`` (graceful shutdown).
+* ``stats`` — ``ServingStats.snapshot()`` merged with compile-cache,
+  queue, health, and weights-version gauges.
+* ``reload`` — hot weight reload from a re-exported inference dir
+  (``ServingEngine.reload_params``): zero-downtime atomic swap.
+
+``close()`` is a graceful drain by default: stop taking new predicts
+(answer ``draining``), serve everything already queued, resolve in-flight
+futures, then tear the listener down. ``install_signal_handlers()`` wires
+SIGTERM/SIGINT to that same path.
 """
 from __future__ import annotations
 
 import json
+import random
+import signal
 import socket
 import socketserver
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .batcher import MicroBatcher, QueueFullError
+from .batcher import MicroBatcher
 from .engine import ServingEngine
+from .errors import (DeadlineExceeded, LoadShedError, RetryBudgetExceeded,
+                     ServingError, ServingRejected, ServingUnavailable,
+                     ShuttingDown, error_from_wire, error_info)
 from .stats import ServingStats
-
-
-class ServingRejected(RuntimeError):
-    """Client-side view of a structured backpressure rejection."""
-
-    def __init__(self, info: Dict[str, Any]):
-        self.info = info
-        super().__init__(f"request rejected: {info.get('reason', info)}")
 
 
 def _decode_feed(name: str, spec) -> np.ndarray:
@@ -64,11 +76,15 @@ class _Handler(socketserver.StreamRequestHandler):
                 method = req["method"]
                 params = req.get("params") or {}
                 if method == "predict":
+                    if srv.chaos is not None and srv.chaos.drop_connection():
+                        return  # injected fault: hang up without answering
                     resp = self._predict(srv, params)
                 elif method == "healthz":
                     resp = {"result": srv.healthz()}
                 elif method == "stats":
                     resp = {"result": srv.stats_snapshot()}
+                elif method == "reload":
+                    resp = {"result": srv.reload(params["dirname"])}
                 else:
                     raise ValueError(f"unknown method {method!r}")
             except Exception as e:  # report, keep serving
@@ -78,13 +94,49 @@ class _Handler(socketserver.StreamRequestHandler):
 
     @staticmethod
     def _predict(srv: "ServingServer", params: Dict) -> Dict:
+        # shed BEFORE decode/validate work: a draining or overloaded server
+        # answers in O(1), it does not burn CPU on requests it won't serve
+        state = srv.health_state()
+        if state == "draining":
+            return {"error": ShuttingDown("server draining").info()}
+        if state == "degraded" and srv.should_shed():
+            srv.stats.record_shed()
+            return {"error": LoadShedError(
+                state, srv.batcher.queue_depth,
+                srv.batcher.queue_capacity).info()}
         feeds = {n: _decode_feed(n, spec)
                  for n, spec in params.get("feeds", {}).items()}
+        deadline = None
+        wait = srv.request_timeout
+        deadline_ms = params.get("deadline_ms")
+        if deadline_ms is not None:
+            # relative budget -> THIS host's monotonic clock; never compare
+            # client and server wall clocks
+            deadline = time.monotonic() + float(deadline_ms) / 1e3
+            # the future resolves with DeadlineExceeded at coalesce time;
+            # the +1s slack means a typed answer beats the handler timeout
+            wait = min(wait, float(deadline_ms) / 1e3 + 1.0)
         try:
-            fut = srv.batcher.submit(feeds)
-        except QueueFullError as e:
+            fut = srv.batcher.submit(feeds, deadline=deadline)
+            outs = fut.result(timeout=wait)
+        except ServingError as e:
+            # error_info, not e.info(): a re-raised ServingRejected (dict
+            # property, see errors.py) must not TypeError the handler
+            return {"error": error_info(e)}
+        except FuturesTimeout:
+            # the handler gave up waiting before the batcher resolved the
+            # future (e.g. a multi-second compile ahead of it) — still a
+            # TYPED answer: terminal deadline_exceeded ONLY when the
+            # client's deadline really passed (wait may have been capped
+            # by request_timeout instead), else a retryable unavailable
+            # (inference is stateless, a duplicate dispatch is safe)
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                e = DeadlineExceeded(now - deadline, "server wait")
+            else:
+                e = ServingUnavailable(
+                    f"request timed out after {wait:.1f}s server-side")
             return {"error": e.info()}
-        outs = fut.result(timeout=srv.request_timeout)
         return {"result": {"fetches": [_encode_fetch(o) for o in outs]}}
 
 
@@ -100,7 +152,13 @@ class ServingServer(socketserver.ThreadingTCPServer):
                  batch_timeout_ms: float = 5.0,
                  queue_capacity: int = 64, request_timeout: float = 60.0,
                  warmup: bool = False, stats: Optional[ServingStats] = None,
-                 start_batcher: bool = True, **engine_kwargs):
+                 start_batcher: bool = True,
+                 degraded_queue_ratio: float = 0.75,
+                 degraded_error_ratio: float = 0.5,
+                 health_window_s: float = 5.0,
+                 shed_prob: Optional[float] = None, shed_seed: int = 0,
+                 drain_timeout: float = 30.0, chaos=None,
+                 handle_signals: bool = False, **engine_kwargs):
         super().__init__((host, port), _Handler)
         self.batcher = None
         try:
@@ -121,7 +179,7 @@ class ServingServer(socketserver.ThreadingTCPServer):
                     model, max_batch_size=max_batch_size or 32,
                     **engine_kwargs)
                 batcher_max = self.engine.max_batch_size
-            self.stats = stats or ServingStats()
+            self.stats = stats or ServingStats(qps_window_s=health_window_s)
             # start_batcher=False accepts (and queues) traffic without
             # serving it — pre-fill before opening, deterministic
             # backpressure tests
@@ -131,9 +189,28 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 queue_capacity=queue_capacity,
                 stats=self.stats, start=start_batcher)
             self.request_timeout = request_timeout
+            # health state machine + probabilistic load shedding
+            self.degraded_queue_ratio = degraded_queue_ratio
+            self.degraded_error_ratio = degraded_error_ratio
+            # a caller-supplied stats object may retain less history than
+            # the requested health window; judge over what actually exists
+            self.health_window_s = min(health_window_s,
+                                       self.stats.qps_window_s)
+            self.shed_prob = shed_prob  # None = proportional to overload
+            self._shed_rng = random.Random(shed_seed)
+            self.drain_timeout = drain_timeout
+            self._draining = False
+            self._closed = False
+            self._close_lock = threading.Lock()
             self._t0 = time.monotonic()
             if warmup:
                 self.engine.warmup()
+            # chaos hooks attach AFTER warmup: the ladder pre-compile is
+            # deployment plumbing, not traffic the harness should fault
+            self.chaos = chaos
+            if chaos is not None:
+                self.engine.chaos = chaos
+                self.batcher.chaos = chaos
         except Exception:
             # the port bound before setup failed: release it (and any live
             # batcher worker) instead of leaking until GC
@@ -141,6 +218,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 self.batcher.close()
             self.server_close()
             raise
+        if handle_signals:
+            self.install_signal_handlers()
         self._thread = threading.Thread(target=self.serve_forever, daemon=True)
         self._thread.start()
 
@@ -149,23 +228,118 @@ class ServingServer(socketserver.ThreadingTCPServer):
         host, port = self.server_address[:2]
         return f"{host}:{port}"
 
+    # -- health state machine --
+    def health_state(self) -> str:
+        """``draining`` (shutdown in progress) > ``degraded`` (queue above
+        the high-water mark, or the recent window is mostly rejects /
+        failures / deadline misses) > ``healthy``. Window counters decay,
+        so a server left alone after a fault burst RETURNS to healthy."""
+        if self._draining:
+            return "draining"
+        cap = self.batcher.queue_capacity
+        if cap and self.batcher.queue_depth / cap >= self.degraded_queue_ratio:
+            return "degraded"
+        w = self.health_window_s
+        bad = (self.stats.recent("rejected", w)
+               + self.stats.recent("failed", w)
+               + self.stats.recent("deadline_exceeded", w))
+        good = self.stats.recent("completed", w)
+        if bad and bad >= self.degraded_error_ratio * (bad + good):
+            return "degraded"
+        return "healthy"
+
+    def shed_probability(self) -> float:
+        """How aggressively a degraded server sheds: proportional to how
+        far the queue is past the high-water mark, floor 0.25 when degraded
+        by error rate alone. A FULL queue does not shed here — the submit
+        path's ``QueueFullError`` is deterministic and carries the depth /
+        capacity the client's operator wants. ``shed_prob`` overrides with
+        a fixed value (deterministic tests)."""
+        if self.shed_prob is not None:
+            return self.shed_prob
+        cap = self.batcher.queue_capacity
+        ratio = self.batcher.queue_depth / cap if cap else 0.0
+        thr = self.degraded_queue_ratio
+        if ratio >= 1.0:
+            return 0.0  # let QueueFullError speak
+        if ratio >= thr and thr < 1.0:
+            return min(0.9, max(0.25, (ratio - thr) / (1.0 - thr)))
+        return 0.25
+
+    def should_shed(self) -> bool:
+        return self._shed_rng.random() < self.shed_probability()
+
     def healthz(self) -> Dict[str, Any]:
-        return {"ok": True, "uptime_s": time.monotonic() - self._t0,
+        state = self.health_state()
+        return {"ok": state != "draining", "state": state,
+                "uptime_s": time.monotonic() - self._t0,
                 "model_dir": self.engine.dirname,
                 "feeds": list(self.engine.feed_names),
-                "fetches": list(self.engine.fetch_names)}
+                "fetches": list(self.engine.fetch_names),
+                "queue_depth": self.batcher.queue_depth,
+                "queue_capacity": self.batcher.queue_capacity,
+                "weights_version": self.engine.params_version}
 
     def stats_snapshot(self) -> Dict[str, Any]:
-        return self.stats.snapshot(extra={
+        extra = {
+            "state": self.health_state(),
             "queue_depth": self.batcher.queue_depth,
             "queue_capacity": self.batcher.queue_capacity,
             "compile_cache": self.engine.cache_info(),
-        })
+            "weights_version": self.engine.params_version,
+        }
+        if self.chaos is not None:
+            extra["chaos"] = self.chaos.snapshot()
+        return self.stats.snapshot(extra=extra)
 
-    def close(self):
+    # -- hot weight reload --
+    def reload(self, dirname: str) -> Dict[str, Any]:
+        """Swap serving weights from a re-exported dir; zero downtime (no
+        request is rejected because of the reload — traffic keeps flowing
+        on the old weights until the atomic swap)."""
+        version = self.engine.reload_params(dirname)
+        self.stats.record_reload()
+        return {"weights_version": version}
+
+    # -- graceful shutdown --
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting new predicts (they answer ``draining``) and wait
+        until every accepted request has been answered. True = fully
+        drained within the timeout."""
+        self._draining = True
+        deadline = time.monotonic() + (
+            self.drain_timeout if timeout is None else timeout)
+        while time.monotonic() < deadline:
+            if self.batcher.queue_depth == 0 and self.batcher.pending == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Graceful by default: reject new work, drain the queue, answer
+        in-flight requests, then stop the listener. ``drain=False`` skips
+        the wait (queued requests resolve with ``ShuttingDown``)."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._draining = True
+        if drain:
+            self.drain(timeout)
+        self.batcher.close()  # serves anything still queued, then stops
         self.shutdown()
         self.server_close()
-        self.batcher.close()
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        """SIGTERM/SIGINT -> graceful drain + close. Main thread only (a
+        CPython constraint on signal.signal)."""
+        for s in signals:
+            signal.signal(s, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        # never block inside a signal handler: drain on a worker thread
+        threading.Thread(target=self.close, daemon=True,
+                         name="paddle-tpu-serving-drain").start()
 
     def __enter__(self):
         return self
@@ -176,17 +350,39 @@ class ServingServer(socketserver.ThreadingTCPServer):
 
 
 class ServingClient:
-    """Blocking line-JSON client (``master/rpc.py`` MasterRPCClient shape).
+    """Blocking line-JSON client (``master/rpc.py`` MasterRPCClient shape)
+    with typed errors, deadlines, and budget-capped retry.
 
-    ``predict`` returns one np.ndarray per fetch target; a structured
-    backpressure answer raises ``ServingRejected`` (retryable), transport
-    and server faults raise ``ConnectionError``/``RuntimeError``.
+    ``predict`` returns one np.ndarray per fetch target. Failures are
+    TYPED: a structured backpressure answer raises ``ServingRejected``
+    (retryable), a transient server fault ``ServingUnavailable``
+    (retryable), a missed deadline ``DeadlineExceeded`` (terminal), server
+    bugs ``RuntimeError`` (terminal), transport faults
+    ``ConnectionError``/``OSError`` (retryable; the next attempt
+    reconnects automatically).
+
+    With ``retries > 0``, retryable errors are retried with exponential
+    backoff + full jitter (seeded via ``retry_seed`` for determinism) up
+    to the budget; exhaustion raises the terminal ``RetryBudgetExceeded``
+    carrying the last underlying error — nothing is ever swallowed.
+    ``predict(..., timeout_ms=...)`` attaches a deadline that rides the
+    wire (the server sheds the request if it expires before dispatch) and
+    also caps the retry loop client-side.
     """
 
-    def __init__(self, endpoint: str, timeout: float = 60.0):
+    def __init__(self, endpoint: str, timeout: float = 60.0,
+                 retries: int = 0, backoff_base_ms: float = 20.0,
+                 backoff_max_ms: float = 2000.0,
+                 retry_seed: Optional[int] = None):
         host, port = endpoint.rsplit(":", 1)
         self.addr: Tuple[str, int] = (host, int(port))
         self.timeout = timeout
+        self.retries = int(retries)
+        self.backoff_base_s = backoff_base_ms / 1e3
+        self.backoff_max_s = backoff_max_ms / 1e3
+        self._rng = random.Random(retry_seed)
+        self.retries_total = 0  # lifetime retry count (serve_bench reports)
+        self.close_errors = 0  # OSErrors discarded while closing the socket
         self._sock: Optional[socket.socket] = None
         self._file = None
         self._lock = threading.Lock()
@@ -196,6 +392,7 @@ class ServingClient:
         self._file = self._sock.makefile("rwb")
 
     def call(self, method: str, params: Optional[Dict] = None) -> Any:
+        """One attempt, no retry: the raw RPC with typed error mapping."""
         with self._lock:
             try:
                 if self._sock is None:
@@ -214,17 +411,52 @@ class ServingClient:
             resp = json.loads(line.decode())
             if "error" in resp:
                 err = resp["error"]
-                if isinstance(err, dict) and err.get("code") == "rejected":
-                    raise ServingRejected(err)
+                if isinstance(err, dict):
+                    raise error_from_wire(err)
                 raise RuntimeError(f"serving error: {err}")
             return resp["result"]
 
-    def predict(self, feeds: Dict[str, Any]) -> List[np.ndarray]:
+    def call_with_retries(self, method: str, params: Optional[Dict] = None,
+                          deadline: Optional[float] = None) -> Any:
+        """``call`` under the retry budget. ``deadline`` (absolute
+        monotonic seconds) rides each attempt as a fresh remaining-budget
+        ``deadline_ms`` and bounds the backoff sleeps."""
+        attempts = 0
+        delay = self.backoff_base_s
+        while True:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceeded(-remaining, "client send")
+                params = dict(params or {}, deadline_ms=remaining * 1e3)
+            try:
+                return self.call(method, params)
+            except (ServingError, OSError) as e:
+                retryable = getattr(e, "retryable", True)  # OSError: yes
+                if not retryable:
+                    raise
+                if attempts >= self.retries:
+                    if self.retries == 0:
+                        raise  # no retry layer engaged: the raw typed error
+                    raise RetryBudgetExceeded(attempts + 1, e) from e
+                attempts += 1
+                self.retries_total += 1
+                sleep = self._rng.uniform(0, delay)  # full jitter
+                if deadline is not None:
+                    sleep = min(sleep, max(0.0, deadline - time.monotonic()))
+                time.sleep(sleep)
+                delay = min(delay * 2, self.backoff_max_s)
+
+    def predict(self, feeds: Dict[str, Any],
+                timeout_ms: Optional[float] = None) -> List[np.ndarray]:
         enc = {}
         for n, v in feeds.items():
             arr = np.asarray(v)
             enc[n] = {"data": arr.tolist(), "dtype": str(arr.dtype)}
-        result = self.call("predict", {"feeds": enc})
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        result = self.call_with_retries("predict", {"feeds": enc},
+                                        deadline=deadline)
         return [np.asarray(f["data"], dtype=f["dtype"]).reshape(f["shape"])
                 for f in result["fetches"]]
 
@@ -234,13 +466,23 @@ class ServingClient:
     def stats(self) -> Dict[str, Any]:
         return self.call("stats")
 
+    def reload(self, dirname: str) -> Dict[str, Any]:
+        """Hot-swap the server's weights from a re-exported inference dir."""
+        return self.call("reload", {"dirname": dirname})
+
     def close(self):
-        if self._sock is not None:
+        f, s = self._file, self._sock
+        self._file = None
+        self._sock = None
+        for obj in (f, s):
+            if obj is None:
+                continue
             try:
-                self._sock.close()
-            finally:
-                self._sock = None
-                self._file = None
+                obj.close()
+            except OSError:
+                # the transport is already dead; a close failure carries no
+                # further signal — counted, never silently swallowed
+                self.close_errors += 1
 
     def __enter__(self):
         return self

@@ -1,19 +1,28 @@
-"""Rolling serving metrics: QPS, latency percentiles, batch fill, rejects.
+"""Rolling serving metrics: QPS, latency percentiles, batch fill, rejects,
+sheds, deadline misses, reload version.
 
 The reference framework shipped no serving telemetry at all — deployments
 wrapped the C++ predictor and measured outside. Here the metrics are part
 of the serving engine itself because every knob the operator can turn
-(`max_batch_size`, `batch_timeout_ms`, bucket ladder, queue capacity) is
-only tunable against these four signals:
+(`max_batch_size`, `batch_timeout_ms`, bucket ladder, queue capacity,
+shed thresholds) is only tunable against these signals:
 
 * **QPS / latency percentiles** — completed requests per second over a
   sliding window, p50/p95/p99 of submit->result latency.
 * **batch-fill ratio** — rows dispatched / bucket capacity per device call;
   low fill means padding waste (compile amortization bought with FLOPs).
-* **queue depth + rejects** — backpressure state; rejects are the load-shed
-  counter, not an error counter.
+* **queue depth + rejects/sheds** — backpressure state; rejects and sheds
+  are load-shed counters, not error counters.
+* **deadline_exceeded** — requests dropped at coalesce time because their
+  client deadline had already passed (a saved device dispatch each).
 * **compile cache hits/misses** — a miss is an XLA compile on the serving
   path (hundreds of ms); steady-state traffic should be ~100% hits.
+* **weights_version / reloads** — hot-reload progress (§12 failure model).
+
+Besides the cumulative counters, every event lands in a per-second bucket
+ring so ``recent(name)`` yields a sliding-window rate — the health state
+machine (server.py) is driven off these, so a burst of rejects reads as
+``degraded`` while it is happening and decays back to ``healthy`` after.
 
 Everything is monotonic-clock based and lock-guarded; `snapshot()` is what
 the server's ``stats`` RPC returns.
@@ -37,6 +46,11 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 class ServingStats:
     """Thread-safe rolling counters shared by engine, batcher, and server."""
 
+    #: event names that get a sliding-window bucket ring in addition to
+    #: their cumulative counter
+    WINDOWED = ("submitted", "completed", "rejected", "failed",
+                "deadline_exceeded", "shed")
+
     def __init__(self, latency_window: int = 2048, qps_window_s: float = 10.0):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
@@ -46,27 +60,65 @@ class ServingStats:
         self.completed = 0
         self.rejected = 0
         self.failed = 0
+        self.deadline_exceeded = 0
+        self.shed = 0
+        self.reloads = 0
         self.batches = 0
         self.rows = 0
         self._fill_sum = 0.0  # sum over batches of rows/bucket
         # latency ring (last N latencies, seconds) bounds the percentile
-        # cost; QPS counts in separate per-second buckets so high
-        # throughput can't push completions out before their window expires
+        # cost; rates count in separate per-second buckets so high
+        # throughput can't push events out before their window expires
         self._lat: deque = deque(maxlen=latency_window)
-        self._qps_buckets: deque = deque()  # (whole_second, count)
+        self._buckets: Dict[str, deque] = {
+            n: deque() for n in self.WINDOWED}  # name -> (whole_second, count)
+
+    def _bump(self, name: str, now: Optional[float] = None) -> None:
+        """Record one event into its per-second window ring (lock held)."""
+        now = time.monotonic() if now is None else now
+        ring = self._buckets[name]
+        sec = int(now)
+        if ring and ring[-1][0] == sec:
+            ring[-1] = (sec, ring[-1][1] + 1)
+        else:
+            ring.append((sec, 1))
+        horizon = int(now - self.qps_window_s) - 1
+        while ring and ring[0][0] < horizon:
+            ring.popleft()
 
     # -- recording (called from submit/dispatch paths) --
     def record_submit(self) -> None:
         with self._lock:
             self.submitted += 1
+            self._bump("submitted")
 
     def record_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+            self._bump("rejected")
 
     def record_failure(self, n: int = 1) -> None:
         with self._lock:
             self.failed += n
+            for _ in range(n):
+                self._bump("failed")
+
+    def record_deadline(self, n: int = 1) -> None:
+        """A request shed at coalesce time: its deadline had passed."""
+        with self._lock:
+            self.deadline_exceeded += n
+            for _ in range(n):
+                self._bump("deadline_exceeded")
+
+    def record_shed(self) -> None:
+        """A request probabilistically shed while the server was degraded."""
+        with self._lock:
+            self.shed += 1
+            self._bump("shed")
+
+    def record_reload(self) -> None:
+        with self._lock:
+            self.reloads += 1
 
     def record_batch(self, rows: int, bucket: int) -> None:
         with self._lock:
@@ -77,24 +129,29 @@ class ServingStats:
     def record_done(self, latency_s: float) -> None:
         with self._lock:
             self.completed += 1
-            now = time.monotonic()
             self._lat.append(latency_s)
-            sec = int(now)
-            if self._qps_buckets and self._qps_buckets[-1][0] == sec:
-                self._qps_buckets[-1] = (sec, self._qps_buckets[-1][1] + 1)
-            else:
-                self._qps_buckets.append((sec, 1))
-            horizon = int(now - self.qps_window_s) - 1
-            while self._qps_buckets and self._qps_buckets[0][0] < horizon:
-                self._qps_buckets.popleft()
+            self._bump("completed")
 
     # -- reading --
+    def recent(self, name: str, window_s: Optional[float] = None) -> int:
+        """Events of ``name`` within the last ``window_s`` (default: the
+        stats window). The health state machine reads these. Clamped to
+        ``qps_window_s`` — the rings only retain that much history, so a
+        larger request would silently undercount."""
+        window_s = (self.qps_window_s if window_s is None
+                    else min(window_s, self.qps_window_s))
+        with self._lock:
+            now = time.monotonic()
+            return sum(c for sec, c in self._buckets[name]
+                       if now - sec <= window_s)
+
     def snapshot(self, extra: Optional[Dict] = None) -> Dict:
         with self._lock:
             now = time.monotonic()
             lats = sorted(self._lat)
-            recent = sum(c for sec, c in self._qps_buckets
-                         if now - sec <= self.qps_window_s)
+            recent = {n: sum(c for sec, c in ring
+                             if now - sec <= self.qps_window_s)
+                      for n, ring in self._buckets.items()}
             horizon = min(self.qps_window_s, max(now - self._t0, 1e-9))
             snap = {
                 "uptime_s": now - self._t0,
@@ -102,9 +159,13 @@ class ServingStats:
                 "completed": self.completed,
                 "rejected": self.rejected,
                 "failed": self.failed,
+                "deadline_exceeded": self.deadline_exceeded,
+                "shed": self.shed,
+                "reloads": self.reloads,
                 "batches": self.batches,
                 "rows": self.rows,
-                "qps": recent / horizon,
+                "qps": recent["completed"] / horizon,
+                "recent": recent,
                 "latency_ms": {
                     "p50": _percentile(lats, 0.50) * 1e3,
                     "p95": _percentile(lats, 0.95) * 1e3,

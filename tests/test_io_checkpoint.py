@@ -2,6 +2,7 @@
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import io
@@ -75,6 +76,61 @@ def test_checkpoint_rotation_and_resume(tmp_path):
     assert len(dirs) == 3  # rotation keeps last 3
     serial = io.load_checkpoint(exe, ckpt, main, scope=fluid.Scope())
     assert serial == 4
+
+
+def test_checkpoint_corruption_falls_back_to_older(tmp_path):
+    """A truncated array file fails the digest manifest and load_checkpoint
+    resumes from the newest OLDER complete serial instead of loading
+    garbage; an all-corrupt history refuses to load at all."""
+    import glob
+
+    main, startup, pred, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    ckpt = str(tmp_path / "ckpts")
+    X = np.random.randn(8, 4).astype("float32")
+    Y = np.random.randint(0, 3, (8, 1)).astype("int64")
+    per_serial = {}
+    for step in range(3):
+        exe.run(main, feed={"x": X, "label": Y}, fetch_list=[], scope=scope)
+        serial = io.save_checkpoint(exe, ckpt, main_program=main, scope=scope)
+        per_serial[serial] = {
+            v.name: np.asarray(scope.get(v.name)).copy()
+            for v in main.list_vars() if v.persistable}
+    latest = max(per_serial)
+    # every checkpoint carries its digest manifest
+    for s in per_serial:
+        assert os.path.exists(os.path.join(
+            ckpt, f"checkpoint_{s}", io.MANIFEST_FILENAME))
+
+    # truncate one array file in the newest checkpoint (torn write)
+    victim = sorted(glob.glob(os.path.join(
+        ckpt, f"checkpoint_{latest}", "*.npy")))[0]
+    data = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(data[:len(data) // 2])
+
+    scope2 = fluid.Scope()
+    with pytest.warns(UserWarning, match="corrupt"):
+        serial = io.load_checkpoint(exe, ckpt, main, scope=scope2)
+    assert serial == latest - 1  # fell back, did not load garbage
+    for name, want in per_serial[latest - 1].items():
+        np.testing.assert_array_equal(np.asarray(scope2.get(name)), want,
+                                      err_msg=name)
+
+    # explicitly requesting the corrupt serial is a loud error
+    with pytest.raises(IOError, match="corrupt"):
+        io.load_checkpoint(exe, ckpt, main, scope=fluid.Scope(),
+                           serial=latest)
+
+    # corrupt every remaining serial: refuse rather than resume over junk
+    for s in per_serial:
+        for f in glob.glob(os.path.join(ckpt, f"checkpoint_{s}", "*.npy")):
+            with open(f, "wb") as fh:
+                fh.write(b"junk")
+    with pytest.warns(UserWarning), pytest.raises(IOError, match="refusing"):
+        io.load_checkpoint(exe, ckpt, main, scope=fluid.Scope())
 
 
 def test_sharded_checkpoint_roundtrip_no_gather(tmp_path):

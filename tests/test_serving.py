@@ -6,6 +6,7 @@ Acceptance contract (ISSUE 1): batched-and-padded results equal per-request
 blocking; end-to-end server/client predict on a small exported model.
 """
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -15,7 +16,7 @@ from paddle_tpu import io
 from paddle_tpu.inference import Predictor
 from paddle_tpu.serving import (MicroBatcher, QueueFullError, ServingClient,
                                 ServingEngine, ServingRejected, ServingServer,
-                                ServingStats)
+                                ServingStats, ShuttingDown)
 
 
 @pytest.fixture(scope="module")
@@ -227,6 +228,64 @@ def test_engine_custom_ladder_caps_max_batch(model_dir):
     assert b.max_batch_size == 4
     with pytest.raises(ValueError, match="split it client-side"):
         b.submit({"x": np.zeros((5, 4), "float32")})
+
+
+def test_batcher_close_racing_submit_never_hangs(model_dir):
+    """close() racing concurrent submit(): every ACCEPTED future resolves
+    (result or typed error) and every refused submit raises a typed error
+    — no request can hang and no future leaks unresolved."""
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    X = np.zeros((1, 4), "float32")
+    for _trial in range(3):
+        b = MicroBatcher(eng, batch_timeout_ms=1.0, queue_capacity=128)
+        futs, refused = [], [0]
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    futs.append(b.submit({"x": X}))
+                except (ShuttingDown, QueueFullError):
+                    refused[0] += 1  # typed refusal: the contract
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        b.close()  # races the in-flight submits
+        stop.set()
+        for t in threads:
+            t.join(30)
+        resolved = 0
+        for f in futs:
+            try:
+                assert f.result(timeout=30)  # served before/while draining
+                resolved += 1
+            except ShuttingDown:
+                resolved += 1  # typed shutdown: also fine
+        assert resolved == len(futs)
+        assert b.pending == 0  # the drain gauge agrees: nothing dangling
+        with pytest.raises(ShuttingDown):
+            b.submit({"x": X})  # post-close submits are typed too
+
+
+def test_stats_reject_shed_deadline_reload_counters():
+    """The load-shedding counters: cumulative + sliding window."""
+    st = ServingStats(qps_window_s=5.0)
+    st.record_submit()
+    st.record_reject()
+    st.record_shed()
+    st.record_deadline()
+    st.record_failure(2)
+    st.record_reload()
+    snap = st.snapshot()
+    assert snap["submitted"] == 1 and snap["rejected"] == 1
+    assert snap["shed"] == 1 and snap["deadline_exceeded"] == 1
+    assert snap["failed"] == 2 and snap["reloads"] == 1
+    # the same events are visible through the recent window (health input)
+    assert snap["recent"]["rejected"] == 1 and snap["recent"]["failed"] == 2
+    assert st.recent("deadline_exceeded") == 1
+    assert st.recent("rejected", 0.0) in (0, 1)  # tiny window: may decay
 
 
 def test_engine_rejects_batch_coupled_fetch_under_padding(tmp_path, model_dir):

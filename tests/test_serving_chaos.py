@@ -1,0 +1,396 @@
+"""Serving resilience layer: deadlines, retry, health, drain, reload, chaos.
+
+Acceptance contract (ISSUE 2): a deadline-expired request is shed at
+coalesce time with a typed error and NO device dispatch; a backoff-retrying
+client survives injected connection drops, step faults, slow calls, and
+queue stalls with only successes or typed errors (no hangs, no silent data
+loss); the server drains cleanly on shutdown and ``healthz`` returns to
+``healthy`` after the fault window; hot weight reload swaps predictions
+atomically mid-traffic with zero rejected-due-to-reload requests.
+
+Everything runs on JAX_PLATFORMS=cpu (conftest) with sub-second fault
+windows — fast tier.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.inference import Predictor
+from paddle_tpu.serving import (ChaosInjector, DeadlineExceeded,
+                                MicroBatcher, RetryBudgetExceeded,
+                                ServingClient, ServingEngine, ServingRejected,
+                                ServingServer, ServingStats,
+                                ServingUnavailable, ShuttingDown)
+
+
+def _export(dirname, seed, size=3, feature=4):
+    np.random.seed(seed)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[feature], dtype="float32")
+            pred = fluid.layers.fc(x, size=size, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)  # distinct weights per seed
+        io.save_inference_model(dirname, ["x"], [pred], exe, main, scope=scope)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    """Two same-architecture exports with different weights (A for serving,
+    B for hot reload) plus a shape-incompatible one (C, reload must refuse)."""
+    root = tmp_path_factory.mktemp("chaos")
+    a = _export(str(root / "model_a"), seed=21)
+    b = _export(str(root / "model_b"), seed=42)
+    c = _export(str(root / "model_c"), seed=7, size=5)
+    return a, b, c
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_shed_at_coalesce_no_dispatch(model_dirs):
+    """Expired requests resolve with typed DeadlineExceeded at coalesce
+    time and never reach the device: zero batches dispatched."""
+    eng = ServingEngine(model_dirs[0], max_batch_size=8)
+    stats = ServingStats()
+    b = MicroBatcher(eng, stats=stats, start=False)
+    X = np.zeros((1, 4), "float32")
+    futs = [b.submit({"x": X}, deadline=time.monotonic() + 0.02)
+            for _ in range(3)]
+    time.sleep(0.06)  # all three expire while the worker is held
+    b.start()
+    for f in futs:
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+    b.close()
+    snap = stats.snapshot()
+    assert snap["deadline_exceeded"] == 3
+    assert snap["batches"] == 0  # the device dispatch was saved
+    assert snap["recent"]["deadline_exceeded"] == 3
+
+    # a live request with headroom still serves
+    b2 = MicroBatcher(eng, stats=stats)
+    out = b2.submit({"x": X}, deadline=time.monotonic() + 30).result(timeout=30)
+    assert out[0].shape == (1, 3)
+    b2.close()
+
+
+def test_deadline_expired_at_submit_is_refused(model_dirs):
+    eng = ServingEngine(model_dirs[0], max_batch_size=4)
+    stats = ServingStats()
+    b = MicroBatcher(eng, stats=stats, start=False)
+    with pytest.raises(DeadlineExceeded):
+        b.submit({"x": np.zeros((1, 4), "float32")},
+                 deadline=time.monotonic() - 0.01)
+    assert stats.snapshot()["deadline_exceeded"] == 1
+    assert b.pending == 0  # nothing was enqueued
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# client retry / typed errors
+# ---------------------------------------------------------------------------
+
+
+def test_client_retry_exhaustion_is_typed(model_dirs):
+    """A persistently-full queue exhausts the retry budget into the
+    terminal RetryBudgetExceeded with the last rejection attached."""
+    with ServingServer(model_dirs[0], queue_capacity=2,
+                       start_batcher=False) as srv:
+        X = np.zeros((1, 4), "float32")
+        srv.batcher.submit({"x": X})
+        srv.batcher.submit({"x": X})
+        with ServingClient(srv.endpoint, retries=3, backoff_base_ms=1,
+                           retry_seed=0) as c:
+            with pytest.raises(RetryBudgetExceeded) as ei:
+                c.predict({"x": X})
+            assert ei.value.attempts == 4
+            assert isinstance(ei.value.last_error, ServingRejected)
+            assert c.retries_total == 3
+        # the queue draining turns the same retry loop into a success
+        srv.batcher.start()
+        with ServingClient(srv.endpoint, retries=5, backoff_base_ms=1,
+                           retry_seed=0) as c:
+            out = c.predict({"x": X})
+            assert out[0].shape == (1, 3)
+
+
+def test_client_survives_connection_drops(model_dirs):
+    """Injected connection drops surface as transport errors the client
+    absorbs by reconnecting + retrying — never a silent OSError."""
+    chaos = ChaosInjector(seed=5, drop_conn_prob=1.0, max_faults=2)
+    with ServingServer(model_dirs[0], chaos=chaos) as srv:
+        with ServingClient(srv.endpoint, retries=5, backoff_base_ms=1,
+                           retry_seed=0) as c:
+            out = c.predict({"x": np.zeros((1, 4), "float32")})
+            assert out[0].shape == (1, 3)
+            assert c.retries_total == 2  # exactly the two injected drops
+    assert chaos.snapshot()["injected"]["dropped_conns"] == 2
+
+
+def test_client_survives_injected_step_faults(model_dirs):
+    """A step-fn fault fails the whole batch with a typed retryable
+    ``unavailable`` error; the retrying client recovers."""
+    chaos = ChaosInjector(seed=5, error_prob=1.0, max_faults=2)
+    with ServingServer(model_dirs[0], chaos=chaos) as srv:
+        X = np.zeros((1, 4), "float32")
+        # retries=0 first: the typed error itself reaches the caller
+        with ServingClient(srv.endpoint) as c:
+            with pytest.raises(ServingUnavailable):
+                c.predict({"x": X})
+        with ServingClient(srv.endpoint, retries=5, backoff_base_ms=1,
+                           retry_seed=0) as c:
+            assert c.predict({"x": X})[0].shape == (1, 3)
+        assert srv.stats.snapshot()["failed"] == 2
+
+
+def test_client_close_errors_counted_not_raised(model_dirs):
+    """close() on a dead transport is explicitly discarded + counted."""
+    with ServingServer(model_dirs[0]) as srv:
+        c = ServingClient(srv.endpoint)
+        assert c.healthz()["ok"]
+        c._sock.close()  # kill the transport under the client
+        c.close()  # must not raise even though the fd is already gone
+        assert c._sock is None and c.close_errors >= 0  # counter exists
+        c.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# health state machine + load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_health_degrades_sheds_and_recovers(model_dirs):
+    with ServingServer(model_dirs[0], queue_capacity=8, start_batcher=False,
+                       degraded_queue_ratio=0.5, shed_prob=1.0,
+                       health_window_s=1.0) as srv:
+        assert srv.health_state() == "healthy"
+        X = np.zeros((1, 4), "float32")
+        futs = [srv.batcher.submit({"x": X}) for _ in range(5)]  # 5/8 > 0.5
+        assert srv.health_state() == "degraded"
+        with ServingClient(srv.endpoint) as c:
+            assert c.healthz()["state"] == "degraded"
+            with pytest.raises(ServingRejected) as ei:  # shed_prob=1.0
+                c.predict({"x": X})
+            assert ei.value.info["reason"] == "shedding"
+            assert c.stats()["shed"] == 1
+            # non-predict methods never shed
+            assert c.healthz()["ok"]
+        srv.batcher.start()
+        for f in futs:
+            assert f.result(timeout=30)
+        deadline = time.monotonic() + 5
+        while srv.health_state() != "healthy" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv.health_state() == "healthy"
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_drain_answers_inflight_then_rejects_new(model_dirs):
+    with ServingServer(model_dirs[0], start_batcher=False,
+                       queue_capacity=16) as srv:
+        X = np.random.randn(6, 4).astype("float32")
+        futs = [srv.batcher.submit({"x": X[i:i + 1]}) for i in range(6)]
+        srv.batcher.start()
+        assert srv.drain(timeout=30)  # everything accepted gets answered
+        for f in futs:
+            assert f.result(timeout=1)[0].shape == (1, 3)
+        assert srv.batcher.pending == 0
+        with ServingClient(srv.endpoint) as c:
+            h = c.healthz()
+            assert h["state"] == "draining" and not h["ok"]
+            with pytest.raises(ServingRejected) as ei:
+                c.predict({"x": X[:1]})
+            assert ei.value.info["reason"] == "draining"
+    # __exit__ -> close(): idempotent after the manual drain
+
+
+def test_close_without_drain_resolves_queued_typed(model_dirs):
+    srv = ServingServer(model_dirs[0], start_batcher=False, queue_capacity=8)
+    X = np.zeros((1, 4), "float32")
+    futs = [srv.batcher.submit({"x": X}) for _ in range(4)]
+    srv.close(drain=False)  # worker never started: queued work CANNOT run
+    for f in futs:
+        with pytest.raises(ShuttingDown):
+            f.result(timeout=10)
+
+
+def test_sigterm_path_drains_and_closes(model_dirs):
+    srv = ServingServer(model_dirs[0])
+    with ServingClient(srv.endpoint) as c:
+        assert c.predict({"x": np.zeros((1, 4), "float32")})[0].shape == (1, 3)
+    srv._on_signal(None, None)  # what install_signal_handlers wires up
+    deadline = time.monotonic() + 10
+    while not srv._closed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv._closed
+    # the listener actually went away
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            ServingClient(srv.endpoint, timeout=0.2).healthz()
+            time.sleep(0.02)
+        except (ConnectionError, OSError):
+            break
+    else:
+        pytest.fail("server still accepting after SIGTERM close")
+
+
+# ---------------------------------------------------------------------------
+# hot weight reload
+# ---------------------------------------------------------------------------
+
+
+def test_hot_reload_swaps_predictions_atomically(model_dirs):
+    dir_a, dir_b, _ = model_dirs
+    X = np.random.RandomState(3).randn(2, 4).astype("float32")
+    ref_a = Predictor(dir_a, place=fluid.CPUPlace()).run({"x": X})[0]
+    ref_b = Predictor(dir_b, place=fluid.CPUPlace()).run({"x": X})[0]
+    assert not np.allclose(ref_a, ref_b)  # the swap is observable
+
+    with ServingServer(dir_a, max_batch_size=4, batch_timeout_ms=1.0,
+                       warmup=True) as srv:
+        results, errors = [], []
+        stop = threading.Event()
+
+        def traffic():
+            with ServingClient(srv.endpoint) as c:
+                while not stop.is_set():
+                    try:
+                        results.append(c.predict({"x": X})[0])
+                    except Exception as e:  # pragma: no cover - must not happen
+                        errors.append(e)
+                        return
+
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # traffic flowing on A
+        with ServingClient(srv.endpoint) as c:
+            assert c.reload(dir_b) == {"weights_version": 2}
+        time.sleep(0.1)  # traffic flowing on B
+        stop.set()
+        for t in threads:
+            t.join(30)
+        snap = srv.stats_snapshot()
+        assert not errors  # ZERO rejected/failed because of the reload
+        assert snap["failed"] == 0 and snap["weights_version"] == 2
+        assert snap["reloads"] == 1
+        saw_a = saw_b = 0
+        for out in results:
+            is_a = np.allclose(out, ref_a, atol=1e-5)
+            is_b = np.allclose(out, ref_b, atol=1e-5)
+            # atomic: every response is ENTIRELY old or ENTIRELY new weights
+            assert is_a != is_b, "response mixed weight versions"
+            saw_a += is_a
+            saw_b += is_b
+        assert saw_a and saw_b  # the swap happened mid-traffic
+        # steady state after the reload: only B answers
+        with ServingClient(srv.endpoint) as c2:
+            np.testing.assert_allclose(c2.predict({"x": X})[0], ref_b,
+                                       rtol=0, atol=1e-5)
+
+
+def test_reload_rejects_incompatible_export(model_dirs):
+    dir_a, _, dir_c = model_dirs
+    X = np.random.RandomState(3).randn(1, 4).astype("float32")
+    ref_a = Predictor(dir_a, place=fluid.CPUPlace()).run({"x": X})[0]
+    with ServingServer(dir_a) as srv:
+        with ServingClient(srv.endpoint) as c:
+            before = c.predict({"x": X})[0]
+            with pytest.raises(RuntimeError, match="shape|dtype|match"):
+                c.reload(dir_c)  # size-5 fc against the frozen size-3 program
+            # the failed reload left the live weights untouched
+            np.testing.assert_allclose(c.predict({"x": X})[0], before,
+                                       rtol=0, atol=1e-6)
+            np.testing.assert_allclose(before, ref_a, rtol=0, atol=1e-5)
+            assert c.healthz()["weights_version"] == 1
+    eng = ServingEngine(dir_a, max_batch_size=2)
+    with pytest.raises(ValueError, match="shape"):
+        eng.reload_params(dir_c)
+    assert eng.params_version == 1
+
+
+# ---------------------------------------------------------------------------
+# the full storm (ISSUE acceptance test)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_storm_typed_errors_only_then_healthy(model_dirs):
+    """Connection drops + slow steps + step faults + queue stalls for a
+    bounded window against backoff-retrying clients: every request ends in
+    a numerically-correct success or a TYPED error (no hangs, no silent
+    loss), the server drains cleanly, and healthz returns to healthy."""
+    dir_a = model_dirs[0]
+    pred = Predictor(dir_a, place=fluid.CPUPlace())
+    chaos = ChaosInjector(seed=11, slow_call_prob=0.15, slow_call_ms=20.0,
+                          error_prob=0.10, drop_conn_prob=0.10,
+                          stall_prob=0.10, stall_ms=20.0, fault_window_s=0.8)
+    srv = ServingServer(dir_a, max_batch_size=8, batch_timeout_ms=1.0,
+                        queue_capacity=32, health_window_s=1.0,
+                        warmup=True, chaos=chaos)
+    chaos.arm()  # window starts with the traffic, not the warmup
+    n_threads, n_reqs = 4, 12
+    rng = np.random.RandomState(9)
+    inputs = rng.randn(n_threads, n_reqs, 1, 4).astype("float32")
+    outcomes = [[] for _ in range(n_threads)]
+
+    def client_loop(tid):
+        with ServingClient(srv.endpoint, retries=10, backoff_base_ms=2,
+                           retry_seed=tid) as c:
+            for i in range(n_reqs):
+                x = inputs[tid, i]
+                try:
+                    out = c.predict({"x": x}, timeout_ms=5000)[0]
+                    outcomes[tid].append(("ok", x, out))
+                except (DeadlineExceeded, RetryBudgetExceeded,
+                        ServingRejected, ServingUnavailable) as e:
+                    outcomes[tid].append(("typed", x, e))
+                except Exception as e:  # untyped = contract violation
+                    outcomes[tid].append(("UNTYPED", x, e))
+
+    threads = [threading.Thread(target=client_loop, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not any(t.is_alive() for t in threads), "client hung"
+
+    flat = [o for sub in outcomes for o in sub]
+    assert len(flat) == n_threads * n_reqs  # nothing lost
+    untyped = [o for o in flat if o[0] == "UNTYPED"]
+    assert not untyped, f"untyped failures leaked: {untyped[:3]}"
+    oks = [o for o in flat if o[0] == "ok"]
+    # generous retry budget: the storm is absorbed, not just survived
+    assert len(oks) >= 0.9 * len(flat), (len(oks), len(flat))
+    for _, x, out in oks:  # no silent data corruption under chaos
+        np.testing.assert_allclose(out, pred.run({"x": x})[0],
+                                   rtol=0, atol=1e-5)
+    assert sum(chaos.snapshot()["injected"].values()) > 0  # storm was real
+
+    # let the fault window lapse, then the state machine must return to
+    # healthy (recent-window pressure decays with no new faults)
+    deadline = time.monotonic() + 6
+    while chaos.active and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not chaos.active
+    while srv.health_state() != "healthy" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert srv.health_state() == "healthy"
+    # and shutdown drains cleanly
+    srv.close()
+    assert srv.batcher.pending == 0

@@ -3,7 +3,8 @@
 Drives a ``paddle_tpu.serving.ServingServer`` with N concurrent closed-loop
 clients (each sends the next request the moment the previous one returns)
 for a fixed duration and reports offered QPS, latency percentiles, rejects,
-and the server's own ``stats`` snapshot (batch-fill ratio, compile cache).
+and the server's own ``stats`` snapshot (batch-fill ratio, compile cache,
+shed/deadline/reload counters).
 
 Two modes:
 
@@ -12,11 +13,20 @@ Two modes:
 * ``--endpoint HOST:PORT`` — bench an already-running server; feed shapes
   then come from ``--shape name=d1,d2`` (repeatable).
 
+``--chaos`` arms a seeded fault profile (slow device calls, injected step
+faults, connection drops, queue stalls — serving/chaos.py) inside the
+in-process server for the first ``--chaos-window`` seconds of the run;
+clients retry with exponential backoff (``--retries``), so the report
+shows the resilience layer absorbing the faults: retry counts, sheds,
+deadline misses, and the server's health state returning to ``healthy``.
+
 Examples::
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
         --clients 8 --duration 10 --rows 1 --max-batch-size 16
     python tools/serve_bench.py --endpoint 127.0.0.1:9000 --shape x=4
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
+        --chaos --chaos-seed 7 --duration 6 --deadline-ms 500
 """
 from __future__ import annotations
 
@@ -30,34 +40,46 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from paddle_tpu.serving import ServingClient, ServingRejected, ServingServer  # noqa: E402
+from paddle_tpu.serving import (DeadlineExceeded, RetryBudgetExceeded,  # noqa: E402
+                                ServingClient, ServingRejected, ServingServer)
+from paddle_tpu.serving.chaos import default_profile  # noqa: E402
 from paddle_tpu.serving.stats import _percentile  # noqa: E402
 
 
-def _client_loop(endpoint, feeds, stop, out):
-    lat, done, rejected, errors = [], 0, 0, 0
-    with ServingClient(endpoint) as c:
+def _client_loop(endpoint, feeds, stop, out, retries, deadline_ms, seed):
+    lat, done, rejected, deadline_missed, exhausted, errors = [], 0, 0, 0, 0, 0
+    with ServingClient(endpoint, retries=retries, backoff_base_ms=5.0,
+                       retry_seed=seed) as c:
         while not stop.is_set():
             t0 = time.monotonic()
             try:
-                c.predict(feeds)
+                c.predict(feeds, timeout_ms=deadline_ms)
                 lat.append(time.monotonic() - t0)
                 done += 1
             except ServingRejected:
-                rejected += 1
+                rejected += 1  # retries=0 path: raw structured rejection
                 time.sleep(0.001)  # back off a tick before retrying
+            except DeadlineExceeded:
+                deadline_missed += 1  # typed terminal: the budget ran out
+            except RetryBudgetExceeded:
+                exhausted += 1  # typed terminal: kept rejecting/failing
             except Exception:
                 errors += 1
                 break
-    out.append((lat, done, rejected, errors))
+        retries_used = c.retries_total
+    out.append((lat, done, rejected, deadline_missed, exhausted, errors,
+                retries_used))
 
 
-def bench(endpoint, feeds, clients, duration):
+def bench(endpoint, feeds, clients, duration, retries=0, deadline_ms=None):
     stop = threading.Event()
     out = []
+    # distinct per-client seeds: identical streams would back off in
+    # lock-step — a synchronized herd is exactly what the jitter prevents
     threads = [threading.Thread(target=_client_loop,
-                                args=(endpoint, feeds, stop, out), daemon=True)
-               for _ in range(clients)]
+                                args=(endpoint, feeds, stop, out, retries,
+                                      deadline_ms, i), daemon=True)
+               for i in range(clients)]
     t0 = time.monotonic()
     for t in threads:
         t.start()
@@ -67,11 +89,14 @@ def bench(endpoint, feeds, clients, duration):
         t.join(30)
     elapsed = time.monotonic() - t0
     lats = sorted(l for ls, *_ in out for l in ls)
-    done = sum(d for _, d, _, _ in out)
-    rejected = sum(r for _, _, r, _ in out)
-    errors = sum(e for _, _, _, e in out)
-    return {"elapsed_s": elapsed, "requests": done, "rejected": rejected,
-            "errors": errors, "qps": done / elapsed if elapsed else 0.0,
+    done = sum(r[1] for r in out)
+    return {"elapsed_s": elapsed, "requests": done,
+            "rejected": sum(r[2] for r in out),
+            "deadline_missed": sum(r[3] for r in out),
+            "retry_exhausted": sum(r[4] for r in out),
+            "errors": sum(r[5] for r in out),
+            "client_retries": sum(r[6] for r in out),
+            "qps": done / elapsed if elapsed else 0.0,
             "p50_ms": _percentile(lats, 0.50) * 1e3,
             "p95_ms": _percentile(lats, 0.95) * 1e3,
             "p99_ms": _percentile(lats, 0.99) * 1e3}
@@ -93,9 +118,26 @@ def main(argv=None):
     ap.add_argument("--max-batch-size", type=int, default=16)
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
     ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--retries", type=int, default=None,
+                    help="client retry budget (default: 0, or 8 with --chaos)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline budget; expired requests are "
+                         "shed server-side before dispatch")
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm the seeded fault profile in the in-process "
+                         "server (requires --model-dir)")
+    ap.add_argument("--chaos-seed", type=int, default=0)
+    ap.add_argument("--chaos-window", type=float, default=None,
+                    help="stop injecting after this many seconds (default: "
+                         "half the bench duration)")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.endpoint:
         ap.error("one of --model-dir / --endpoint is required")
+    if args.chaos and not args.model_dir:
+        ap.error("--chaos injects inside the in-process server; it needs "
+                 "--model-dir")
+    retries = args.retries if args.retries is not None else \
+        (8 if args.chaos else 0)
 
     shapes = {}
     for spec in args.shape:
@@ -103,12 +145,18 @@ def main(argv=None):
         shapes[name] = tuple(int(d) for d in dims.split(",") if d)
 
     server = None
+    chaos = None
     try:
         if args.model_dir:
+            if args.chaos:
+                window = (args.chaos_window if args.chaos_window is not None
+                          else args.duration / 2)
+                chaos = default_profile(seed=args.chaos_seed,
+                                        fault_window_s=window)
             server = ServingServer(
                 args.model_dir, max_batch_size=args.max_batch_size,
                 batch_timeout_ms=args.batch_timeout_ms,
-                queue_capacity=args.queue_capacity, warmup=True)
+                queue_capacity=args.queue_capacity, warmup=True, chaos=chaos)
             endpoint = server.endpoint
             for n in server.engine.feed_names:
                 if n not in shapes:
@@ -116,6 +164,11 @@ def main(argv=None):
                     shapes[n] = tuple(var.shape)[1:]
             print(f"spawned server on {endpoint} (warmed "
                   f"{server.engine.cache_info()['misses']} buckets)")
+            if chaos is not None:
+                chaos.arm()  # fault window starts with the traffic, not
+                # with server construction (warmup compiles are not chaos)
+                print(f"chaos armed: seed={args.chaos_seed} "
+                      f"window={chaos.fault_window_s:.1f}s retries={retries}")
         else:
             endpoint = args.endpoint
             if not shapes:
@@ -126,17 +179,26 @@ def main(argv=None):
                  for n, dims in shapes.items()}
         print(f"benching {endpoint}: {args.clients} closed-loop clients, "
               f"{args.duration:.0f}s, {args.rows} row(s)/request")
-        r = bench(endpoint, feeds, args.clients, args.duration)
+        r = bench(endpoint, feeds, args.clients, args.duration,
+                  retries=retries, deadline_ms=args.deadline_ms)
         print(f"requests={r['requests']} rejected={r['rejected']} "
-              f"errors={r['errors']}")
+              f"deadline_missed={r['deadline_missed']} "
+              f"retry_exhausted={r['retry_exhausted']} errors={r['errors']} "
+              f"client_retries={r['client_retries']}")
         print(f"qps={r['qps']:.1f}  p50={r['p50_ms']:.2f}ms  "
               f"p95={r['p95_ms']:.2f}ms  p99={r['p99_ms']:.2f}ms")
         with ServingClient(endpoint) as c:
             s = c.stats()
-            print(f"server: batches={s['batches']} "
+            print(f"server: state={s.get('state')} batches={s['batches']} "
                   f"avg_rows={s['avg_batch_rows']:.2f} "
                   f"fill={s['batch_fill_ratio']:.2f} "
                   f"cache={s['compile_cache']}")
+            print(f"server: rejected={s['rejected']} shed={s['shed']} "
+                  f"deadline_exceeded={s['deadline_exceeded']} "
+                  f"failed={s['failed']} reloads={s['reloads']} "
+                  f"weights_version={s.get('weights_version')}")
+            if "chaos" in s:
+                print(f"chaos: {s['chaos']}")
         return 0 if r["errors"] == 0 else 1
     finally:
         if server is not None:
