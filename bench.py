@@ -23,6 +23,7 @@ import json
 import os
 import re
 import sys
+import time
 
 import numpy as np
 
@@ -48,6 +49,12 @@ TLM_LAYERS = 8
 TLM_FF = 4096
 TLM_T = 1024
 TLM_BATCH = 8
+
+# fused steps per device call (Executor.run_steps scan window): the host
+# touches the program once per window instead of once per step, so the XLA
+# dispatch queue never drains between steps (docs/design.md §13). Builders
+# default to k=1 so probe_trace/audit tools keep per-step semantics.
+PIPE_K = 8
 
 
 def _prev_results():
@@ -113,7 +120,8 @@ def _emit(rec):
     print(json.dumps(rec))
 
 
-def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS, reps=3):
+def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS, reps=3,
+                steps_per_call=1):
     """Per-step device time via the shared slope method (the axon tunnel's
     block_until_ready returns before device completion and a per-step fetch
     pays ~80 ms RPC latency, so the slope isolates true step time).
@@ -125,16 +133,25 @@ def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS, reps=3):
     for exactly this reason. A measurement whose spread exceeds 15% of
     its own median failed its quality gate (a sustained tunnel slow
     phase, not the workload) and is retried ONCE; the cleaner of the two
-    is reported. Returns (median_seconds, spread_seconds)."""
+    is reported.
+
+    ``steps_per_call``: with run_steps-fused closures each run_step() call
+    executes that many training steps; ``warmup``/``iters`` stay in STEP
+    units (converted to call counts here) and the returned times are
+    per step. Returns (median_seconds, spread_seconds)."""
     from paddle_tpu.profiler import slope_time
+
+    spc = max(1, int(steps_per_call))
+    warmup_calls = max(2, -(-warmup // spc)) if warmup else 0
+    iter_calls = max(6, iters // spc)
 
     def measure(first):
         # warmup + a discarded prime window run on the first rep of the
         # first measurement only; later reps (and the retry) are warm
         times = sorted(
             slope_time(run_step, fetch,
-                       warmup=(warmup if first and r == 0 else 0),
-                       iters=iters, prime=(first and r == 0))
+                       warmup=(warmup_calls if first and r == 0 else 0),
+                       iters=iter_calls, prime=(first and r == 0))
             for r in range(reps))
         return times[reps // 2], times[-1] - times[0]
 
@@ -142,14 +159,46 @@ def _slope_time(run_step, fetch, warmup=WARMUP, iters=ITERS, reps=3):
     if spread > 0.15 * med:
         med2, spread2 = measure(first=False)
         if spread2 / med2 < spread / med:
-            return med2, spread2
-    return med, spread
+            med, spread = med2, spread2
+    return med / spc, spread / spc
 
 
-def build_resnet():
+def _host_dispatch_ms(run_step, fetch, steps_per_call=1):
+    """Per-step HOST cost of one dispatch window: time for run_step() to
+    RETURN (enqueue-only — XLA dispatch is async; device completion is the
+    slope's job). The min of a few samples avoids counting a dispatch that
+    blocked on device backpressure. host_ms vs device_ms attributes a
+    bench move to host-overlap wins vs kernel wins."""
+    fetch()  # sync: start with an empty dispatch queue
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_step()
+        samples.append(time.perf_counter() - t0)
+    fetch()  # flush what we queued
+    return min(samples) / max(1, steps_per_call) * 1e3
+
+
+def _step_closures(exe, prog, feed, scope, loss_var, k):
+    """(run_step, fetch) over the per-step run path (k<=1: one dispatch per
+    step — what probe_trace audits) or the fused run_steps window (k>1:
+    ONE lax.scan device program per k steps; the pipeline the bench
+    metrics now report)."""
+    if k <= 1:
+        return (lambda: exe.run(prog, feed=feed, fetch_list=[], scope=scope),
+                lambda: exe.run(prog, feed=feed, fetch_list=[loss_var],
+                                scope=scope))
+    return (lambda: exe.run_steps(prog, feed=feed, k=k, fetch_list=[],
+                                  scope=scope),
+            lambda: exe.run_steps(prog, feed=feed, k=k,
+                                  fetch_list=[loss_var], scope=scope))
+
+
+def build_resnet(k=1):
     """(run_step, fetch) closures for the ResNet-50 bench workload — the
     ONE place its program/feed are assembled (probe_trace.py traces the
-    same builders bench.py times, so audits measure the benched program)."""
+    same builders bench.py times, so audits measure the benched program).
+    ``k>1`` fuses k steps per call via Executor.run_steps."""
     import jax
 
     import paddle_tpu as fluid
@@ -178,14 +227,13 @@ def build_resnet():
         "label": jax.device_put(
             rng.randint(0, CLASSES, (BATCH, 1)).astype("int32"), dev),
     }
-    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-            lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                            scope=scope))
+    return _step_closures(exe, main_prog, feed, scope, avg_cost, k)
 
 
 def bench_resnet():
-    run_step, fetch = build_resnet()
-    step_time, spread = _slope_time(run_step, fetch)
+    run_step, fetch = build_resnet(k=PIPE_K)
+    step_time, spread = _slope_time(run_step, fetch, steps_per_call=PIPE_K)
+    host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
     img_s = BATCH / step_time
     mfu = img_s * RESNET_GFLOP_PER_IMG / 1e3 / PEAK_TFLOPS
     _emit({
@@ -199,10 +247,13 @@ def bench_resnet():
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
+        "window_k": PIPE_K,
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(step_time * 1e3, 2),
     })
 
 
-def build_seq2seq():
+def build_seq2seq(k=1):
     """(run_step, fetch) for the seq2seq NMT bench workload."""
     import jax
 
@@ -245,18 +296,18 @@ def build_seq2seq():
         "trg_next": jax.device_put(
             rng.randint(0, S2S_VOCAB, (S2S_BATCH, S2S_LEN)).astype("int32"), dev),
     }
-    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-            lambda: exe.run(main_prog, feed=feed, fetch_list=[avg_loss],
-                            scope=scope))
+    return _step_closures(exe, main_prog, feed, scope, avg_loss, k)
 
 
 def bench_seq2seq():
-    run_step, fetch = build_seq2seq()
+    run_step, fetch = build_seq2seq(k=PIPE_K)
     # the ~10 ms step is small relative to tunnel jitter: long windows
     # (150 steps) + 5 reps keep the slope spread under 10% of the step
     # where 30-step windows swung 74% (VERDICT r3 item 2)
     step_time, spread = _slope_time(run_step, fetch,
-                                    warmup=3, iters=250, reps=5)
+                                    warmup=3, iters=250, reps=5,
+                                    steps_per_call=PIPE_K)
+    host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
     tok_s = S2S_BATCH * S2S_LEN / step_time
     # analytic matmul FLOPs (fwd x3 for bwd): encoder LSTM + attention
     # decoder + vocab head, per trg token (embedding gathers excluded —
@@ -278,10 +329,13 @@ def bench_seq2seq():
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
+        "window_k": PIPE_K,
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(step_time * 1e3, 2),
     })
 
 
-def build_transformer_lm(batch=None):
+def build_transformer_lm(batch=None, k=1):
     """(run_step, fetch) for the transformer-LM bench workload."""
     import jax
 
@@ -308,9 +362,7 @@ def build_transformer_lm(batch=None):
     X = jax.device_put(
         rng.randint(0, TLM_VOCAB, (batch, TLM_T)).astype("int32"), dev)
     feed = {"ids": X, "labels": X}
-    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-            lambda: exe.run(main_prog, feed=feed, fetch_list=[loss],
-                            scope=scope))
+    return _step_closures(exe, main_prog, feed, scope, loss, k)
 
 
 def bench_transformer_lm():
@@ -318,8 +370,10 @@ def bench_transformer_lm():
     net-new beyond the reference's benchmark suite (SURVEY.md §5.7).
     Bias-free FFN/head (the GPT-2/PaLM convention) as of r5: the head
     bias grad alone was a 0.63 ms full pass over the [N*T, V] dlogits."""
-    run_step, fetch = build_transformer_lm()
-    step_time, spread = _slope_time(run_step, fetch, warmup=3, iters=20)
+    run_step, fetch = build_transformer_lm(k=PIPE_K)
+    step_time, spread = _slope_time(run_step, fetch, warmup=3, iters=20,
+                                    steps_per_call=PIPE_K)
+    host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
     tokens = TLM_BATCH * TLM_T
     tok_s = tokens / step_time
     # analytic FLOPs/token: 6*N (fwd+bwd matmuls) + causal attention term
@@ -335,6 +389,9 @@ def bench_transformer_lm():
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
+        "window_k": PIPE_K,
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(step_time * 1e3, 2),
     })
 
 
@@ -345,7 +402,7 @@ LC_D = 1024
 LC_LAYERS = 4
 
 
-def build_longcontext_lm():
+def build_longcontext_lm(k=1):
     """(run_step, fetch) for the long-context LM bench workload."""
     import jax
 
@@ -380,9 +437,7 @@ def build_longcontext_lm():
     X = jax.device_put(
         rng.randint(0, LC_VOCAB, (LC_BATCH, LC_T)).astype("int32"), dev)
     feed = {"ids": X, "labels": X}
-    return (lambda: exe.run(main_prog, feed=feed, fetch_list=[], scope=scope),
-            lambda: exe.run(main_prog, feed=feed, fetch_list=[loss],
-                            scope=scope))
+    return _step_closures(exe, main_prog, feed, scope, loss, k)
 
 
 def bench_longcontext_lm():
@@ -392,8 +447,10 @@ def bench_longcontext_lm():
     remat variants slower because B=1's logits and activations fit HBM).
     fused_linear_cross_entropy and recompute_policy="flash" remain the
     knobs for configs where they don't (B>=4 or T>=16k)."""
-    run_step, fetch = build_longcontext_lm()
-    step_time, spread = _slope_time(run_step, fetch, warmup=2, iters=30)
+    run_step, fetch = build_longcontext_lm(k=PIPE_K)
+    step_time, spread = _slope_time(run_step, fetch, warmup=2, iters=30,
+                                    steps_per_call=PIPE_K)
+    host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
     tok_s = LC_BATCH * LC_T / step_time
     n_params = (LC_LAYERS * (4 * LC_D * LC_D + 2 * LC_D * 4 * LC_D)
                 + LC_VOCAB * LC_D)
@@ -407,6 +464,9 @@ def bench_longcontext_lm():
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
+        "window_k": PIPE_K,
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(step_time * 1e3, 2),
         "config": f"T={LC_T} V={LC_VOCAB} dense-head no-remat (B=1 fits)",
     })
 

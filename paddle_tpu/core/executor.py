@@ -238,27 +238,19 @@ class Executor:
         feed_vals = {k: _to_device_array(v, program, k, self._device)
                      for k, v in feed.items()}
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
-        cache_key = (id(program), program.version, block_idx, sig,
+        # program.uid, NOT id(program): a GC'd program's id can be reused by
+        # a fresh one with a matching version/signature, silently serving the
+        # dead program's executable (regression: test_executor_cache_uid_*)
+        cache_key = (program.uid, program.version, block_idx, sig,
                      tuple(fetch_names), self.amp)
 
         from ..flags import get_flag
         from ..profiler import RecordEvent  # lazy: profiler imports jax
 
-        entry = self._cache.get(cache_key)
-        if entry is None:
-            t_c = time.perf_counter()
-            with RecordEvent("executor_compile"):
-                entry = self._compile(program, block_idx, feed_names, fetch_names, sig)
-            if get_flag("log_compile"):
-                print(f"[compile] block{block_idx} sig={sig} "
-                      f"{time.perf_counter() - t_c:.3f}s", flush=True)
-            self._cache[cache_key] = entry
-            # bounded LRU: mutating a program between runs (append_backward in
-            # a loop, etc.) would otherwise accumulate stale executables
-            while len(self._cache) > self._cache_capacity:
-                self._cache.pop(next(iter(self._cache)))
-        else:  # refresh LRU order
-            self._cache[cache_key] = self._cache.pop(cache_key)
+        entry = self._cache_get_or_compile(
+            cache_key, f"block{block_idx} sig={sig}", "executor_compile",
+            lambda: self._compile(program, block_idx, feed_names,
+                                  fetch_names, sig))
         fn, readonly_names, donated_names, state_out_names = entry
 
         readonly, donated = {}, {}
@@ -320,7 +312,175 @@ class Executor:
                     f"(first bad index {np.argwhere(~np.isfinite(arr))[0].tolist()})"
                 )
 
+    # -- multi-step (pipelined) API --
+    def run_steps(
+        self,
+        program: Optional[Program] = None,
+        feed=None,
+        k: Optional[int] = None,
+        fetch_list: Optional[Sequence[Union[str, Any]]] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        block_idx: int = 0,
+        seed: Optional[int] = None,
+    ):
+        """Run ``k`` training steps as ONE fused device program.
+
+        The per-step ``run`` path pays host work every step: cache-key
+        construction, feed placement, scope reads, one dispatch. ``run_steps``
+        rolls ``k`` steps into a single ``lax.scan`` over device-resident
+        batches (the same traced step fn ``run`` compiles, with the same
+        donated-state plumbing), so the host touches the program once per
+        window and the XLA dispatch queue never drains between steps.
+
+        ``feed`` is either
+        * ONE dict (requires ``k``) — the same batch every step (synthetic
+          benches, device-resident data), carried into the scan as an
+          invariant input (no per-step copies); or
+        * a sequence of ``k`` dicts — per-step batches, each feed name
+          stacked on a new leading axis with ONE ``device_put`` per name for
+          the whole window (the H2D transfer amortizes over ``k`` steps).
+
+        Every fetch comes back with a leading ``k`` axis (step-stacked);
+        with ``return_numpy=False`` the fetches stay device arrays and the
+        call does not force a host sync — scalars land on the host only at
+        window boundaries, and only if the caller converts them.
+
+        Scan fusion is legal because the block is already a pure traced
+        function; the one extra requirement over ``run`` is that the
+        program's state is shape-stable across steps (optimizer updates
+        are — the carry must re-enter the scan with the same
+        shapes/dtypes).
+        """
+        program = program or default_main_program()
+        fetch_names = [f if isinstance(f, str) else f.name for f in (fetch_list or [])]
+        scope = scope or global_scope()
+        if isinstance(feed, dict):
+            if k is None or int(k) < 1:
+                raise ValueError("run_steps with a single feed dict needs k >= 1")
+            k = int(k)
+            invariant = True
+            feeds: Any = feed
+        else:
+            feeds = list(feed or [])
+            if not feeds:
+                raise ValueError("run_steps needs a feed dict or a non-empty "
+                                 "sequence of feed dicts")
+            if k is not None and int(k) != len(feeds):
+                raise ValueError(f"k={k} but {len(feeds)} feed dicts given")
+            k = len(feeds)
+            invariant = False
+        with jax.default_device(self._device):
+            return self._run_steps_on_device(
+                program, feeds, invariant, k, fetch_names, scope,
+                return_numpy, block_idx, seed)
+
+    def _run_steps_on_device(self, program, feeds, invariant, k, fetch_names,
+                             scope, return_numpy, block_idx, seed):
+        feed_names = tuple(sorted(feeds if invariant else feeds[0]))
+        if invariant:
+            feed_vals = {n: _to_device_array(feeds[n], program, n, self._device)
+                         for n in feed_names}
+            step_sig = tuple((n, feed_vals[n].shape, str(feed_vals[n].dtype))
+                             for n in feed_names)
+        else:
+            for fd in feeds:
+                if tuple(sorted(fd)) != feed_names:
+                    raise ValueError(
+                        f"every step feed must bind the same names; got "
+                        f"{sorted(fd)} vs {list(feed_names)}")
+            feed_vals = {}
+            for n in feed_names:
+                vals = [fd[n] for fd in feeds]
+                if any(isinstance(v, jax.Array) for v in vals):
+                    feed_vals[n] = jnp.stack(
+                        [_to_device_array(v, program, n, self._device)
+                         for v in vals])
+                else:
+                    # ONE H2D transfer per name for the whole window
+                    stacked = np.stack(
+                        [_coerce_host(v, program, n) for v in vals])
+                    feed_vals[n] = jax.device_put(stacked, self._device)
+            step_sig = tuple((n, feed_vals[n].shape[1:], str(feed_vals[n].dtype))
+                             for n in feed_names)
+
+        from ..flags import get_flag
+        from ..profiler import RecordEvent  # lazy: profiler imports jax
+
+        cache_key = (program.uid, program.version, block_idx, step_sig,
+                     tuple(fetch_names), self.amp, "steps", invariant, k)
+        entry = self._cache_get_or_compile(
+            cache_key, f"block{block_idx} steps k={k} sig={step_sig}",
+            "executor_compile_steps",
+            lambda: self._compile_steps(program, block_idx, feed_names,
+                                        fetch_names, invariant))
+        fn, readonly_names, donated_names, state_out_names = entry
+
+        readonly = {}
+        for n in readonly_names:
+            v = scope.get(n, _MISSING)
+            if v is _MISSING:
+                raise RuntimeError(
+                    f"variable {n!r} is read by the program but missing from "
+                    f"the scope; run the startup program first")
+            readonly[n] = v
+        state = {}
+        for n in state_out_names:
+            v = scope.get(n, _MISSING)
+            if v is _MISSING:
+                raise RuntimeError(
+                    f"state variable {n!r} has no initial value in the scope "
+                    f"(run_steps carries the full state; run the startup "
+                    f"program first)")
+            state[n] = v
+
+        # per-step PRNG keys: step i of the window draws the same key the
+        # i-th sequential run() call would, so pipelined and unpipelined
+        # training are bit-comparable under dropout
+        if seed is None:
+            seeds = [self._step_seed + 1 + i for i in range(k)]
+            self._step_seed += k
+        else:
+            seeds = [seed] * k  # matches k sequential run(seed=seed) calls
+        rs = program.random_seed or 0
+        keys = jnp.stack([jax.random.PRNGKey(np.uint32(s ^ rs))
+                          for s in seeds])
+
+        with RecordEvent(f"executor_run_steps/block{block_idx}"):
+            fetches, new_state = fn(feed_vals, readonly, state, keys)
+            for n in state_out_names:
+                scope.set(n, new_state[n])
+            if return_numpy:
+                fetches = [np.asarray(v) for v in fetches]
+        if get_flag("check_nan_inf"):
+            self._check_nan_inf(fetch_names, fetches, state_out_names,
+                                new_state)
+        return fetches
+
     # -- compilation --
+    def _cache_get_or_compile(self, cache_key, log_label, event, compile_fn):
+        """LRU probe shared by run and run_steps: compile on miss (timed,
+        optionally logged), refresh recency on hit, evict past capacity —
+        mutating a program between runs (append_backward in a loop, etc.)
+        would otherwise accumulate stale executables."""
+        from ..flags import get_flag
+        from ..profiler import RecordEvent  # lazy: profiler imports jax
+
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            t_c = time.perf_counter()
+            with RecordEvent(event):
+                entry = compile_fn()
+            if get_flag("log_compile"):
+                print(f"[compile] {log_label} "
+                      f"{time.perf_counter() - t_c:.3f}s", flush=True)
+            self._cache[cache_key] = entry
+            while len(self._cache) > self._cache_capacity:
+                self._cache.pop(next(iter(self._cache)))
+        else:  # refresh LRU order
+            self._cache[cache_key] = self._cache.pop(cache_key)
+        return entry
+
     def _compile(self, program: Program, block_idx: int, feed_names, fetch_names, sig):
         step, readonly_names, donated_names, state_out_names = build_step_fn(
             program, block_idx, feed_names, fetch_names, amp=self.amp
@@ -329,6 +489,41 @@ class Executor:
         # their old values die with the update, so XLA can update in place in
         # HBM. Read-only state must not be donated — the scope keeps it live.
         jitted = jax.jit(step, donate_argnums=(2,))
+        return jitted, readonly_names, donated_names, state_out_names
+
+    def _compile_steps(self, program: Program, block_idx: int, feed_names,
+                       fetch_names, invariant: bool):
+        """Roll the traced step into a ``lax.scan`` over the window.
+
+        The carry is the FULL state-out dict (donated, so params update in
+        place across the whole window); per-step fetches stack as scan ys.
+        The body compiles once regardless of k — window length only changes
+        the leading axis of the stacked inputs.
+        """
+        step, readonly_names, donated_names, state_out_names = build_step_fn(
+            program, block_idx, feed_names, fetch_names, amp=self.amp
+        )
+
+        def one_step(state, feed_k, readonly, key):
+            donated = {n: state[n] for n in donated_names}
+            fetches, new_state = step(feed_k, readonly, donated, key)
+            return {**state, **new_state}, fetches
+
+        if invariant:
+            def multi(feed_vals, readonly, state, keys):
+                def body(state, key):
+                    return one_step(state, feed_vals, readonly, key)
+                state, ys = jax.lax.scan(body, state, keys)
+                return ys, state
+        else:
+            def multi(feed_stack, readonly, state, keys):
+                def body(state, xs):
+                    feed_k, key = xs
+                    return one_step(state, feed_k, readonly, key)
+                state, ys = jax.lax.scan(body, state, (feed_stack, keys))
+                return ys, state
+
+        jitted = jax.jit(multi, donate_argnums=(2,))
         return jitted, readonly_names, donated_names, state_out_names
 
     def close(self):
@@ -351,13 +546,22 @@ def coerce_int64_feed(arr: np.ndarray, name: str) -> np.ndarray:
     return arr
 
 
-def _to_device_array(v, program: Program, name: str, device=None):
-    """numpy / python value -> jax array, respecting the declared var dtype."""
-    if isinstance(v, jax.Array):
-        return v
+def _coerce_host(v, program: Program, name: str) -> np.ndarray:
+    """numpy / python value -> host array with the declared var dtype applied
+    and the int64 policy enforced — the host half of ``_to_device_array``,
+    shared with the reader-side ``DevicePrefetcher`` so prefetched feeds are
+    byte-identical to synchronously placed ones."""
     arr = np.asarray(v)
     var = program.global_block().find_var_recursive(name)
     if var is not None and var.dtype is not None:
         arr = arr.astype(var.dtype.np_dtype, copy=False)
-    arr = coerce_int64_feed(arr, name)
-    return jax.device_put(arr, device)
+    return coerce_int64_feed(arr, name)
+
+
+def _to_device_array(v, program: Program, name: str, device=None):
+    """numpy / python value -> jax array, respecting the declared var dtype.
+    Already-placed ``jax.Array`` feeds (a ``DevicePrefetcher``'s output, a
+    previous fetch) pass through untouched — no re-``device_put``."""
+    if isinstance(v, jax.Array):
+        return v
+    return jax.device_put(_coerce_host(v, program, name), device)

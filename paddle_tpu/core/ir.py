@@ -13,6 +13,7 @@ Grad variables use the reference's naming convention ``X@GRAD``
 from __future__ import annotations
 
 import copy
+import itertools
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -308,18 +309,26 @@ def _normalize_slots(slots) -> Dict[str, List[str]]:
     return out
 
 
+_program_uid_counter = itertools.count(1)
+
+
 class Program:
     """A whole computation: list of blocks, block 0 is global.
 
     <- ProgramDesc (program_desc.h) / python Program (framework.py:1227).
     ``_version`` increments on any mutation; the executor keys its jit cache on
-    it so edited programs recompile (<- executor.py:204 program cache).
+    (``uid``, ``version``) so edited programs recompile (<- executor.py:204
+    program cache). ``uid`` is a process-monotonic id assigned at
+    construction: unlike ``id()``, it is never reused after a program is
+    garbage-collected, so a fresh program can never alias a dead one's
+    cached executables.
     """
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0, -1)]
         self._current_block_idx = 0
         self._version = 0
+        self._uid = next(_program_uid_counter)
         self.random_seed = 0
 
     # -- structure --
@@ -346,6 +355,11 @@ class Program:
     @property
     def version(self) -> int:
         return self._version
+
+    @property
+    def uid(self) -> int:
+        """Process-monotonic identity, never reused across GC (cache keys)."""
+        return self._uid
 
     # -- transforms --
     def clone(self, for_test: bool = False) -> "Program":

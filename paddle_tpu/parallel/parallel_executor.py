@@ -355,7 +355,7 @@ class ParallelExecutor:
             feed_vals[k] = jax.device_put(arr, sh)
 
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
-        key_cache = (id(self.program), self.program.version, sig,
+        key_cache = (self.program.uid, self.program.version, sig,
                      tuple(fetch_names), self.amp)
         entry = self._cache.get(key_cache)
         if entry is None:

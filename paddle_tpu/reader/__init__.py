@@ -9,4 +9,5 @@ from .decorator import (  # noqa: F401
     shuffle,
     xmap_readers,
 )
+from .prefetch import DevicePrefetcher  # noqa: F401
 from .seq import pad_batch_reader  # noqa: F401

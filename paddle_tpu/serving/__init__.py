@@ -14,7 +14,10 @@ plane). Pieces, composable or used together via ``ServingServer``:
 * ``MicroBatcher`` (batcher.py) — bounded-queue request coalescing into one
   padded device call per batch window; rejects (never blocks) when full;
   sheds deadline-expired requests at coalesce time; drains on close (a
-  submitted future always resolves, with a result or a typed error).
+  submitted future always resolves, with a result or a typed error);
+  depth-2 dispatch pipeline (host-prepare of batch N+1 overlaps the
+  in-flight device call, docs/design.md §13) with ``flush()`` as the
+  reload barrier.
 * ``ServingServer`` / ``ServingClient`` (server.py) — dependency-free
   threaded TCP line-JSON front: ``predict`` / ``healthz`` / ``stats`` /
   ``reload``; health state machine (healthy/degraded/draining) with

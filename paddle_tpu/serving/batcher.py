@@ -21,6 +21,15 @@ between them:
 * requests only coalesce when their trailing-shape signature matches (same
   compiled bucket); a mismatched request is carried over to start the next
   batch rather than reordered behind later traffic.
+* dispatch is a bounded two-stage **pipeline** (``pipeline_depth``, default
+  2): the worker host-prepares and *asynchronously* dispatches a batch,
+  then hands the in-flight handle to a completion thread that blocks on
+  the device and scatters results — so padding/coalescing of batch N+1
+  overlaps the device executing batch N. A slot semaphore (returned only
+  once a batch fully completes) hard-caps dispatched-not-completed
+  batches at ``pipeline_depth``. ``flush()`` is the pipeline barrier the
+  hot-reload path uses; ``pipeline_depth=1`` restores the fully
+  synchronous dispatch.
 * ``close()`` drains: the worker keeps serving until the queue is empty,
   then exits; anything it cannot serve resolves with a typed
   ``ShuttingDown`` — a submitted future ALWAYS resolves, it never hangs.
@@ -65,6 +74,7 @@ class MicroBatcher:
                  batch_timeout_ms: float = 5.0,
                  queue_capacity: int = 64,
                  stats: Optional[ServingStats] = None,
+                 pipeline_depth: int = 2,
                  start: bool = True):
         self.engine = engine
         self.max_batch_size = int(max_batch_size or engine.max_batch_size)
@@ -74,6 +84,28 @@ class MicroBatcher:
         self.queue_capacity = int(queue_capacity)
         self.stats = stats
         self.chaos = None  # optional ChaosInjector (queue-stall hook)
+        # depth-2 dispatch pipeline (docs/design.md §13): the worker splits
+        # each batch into host-prepare + async device dispatch, then hands
+        # the in-flight handle to a completion thread for the host sync and
+        # per-row scatter. While the completion thread blocks on batch N,
+        # the worker pads/coalesces and dispatches batch N+1 — the slot
+        # semaphore (released only when a batch fully completes) caps how
+        # far the host runs ahead at pipeline_depth outstanding batches.
+        # pipeline_depth=1 restores the fully synchronous dispatch.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight_q: Optional["queue.Queue"] = None
+        self._in_flight = 0  # dispatched-not-completed batches (gauge)
+        self._in_flight_lock = threading.Lock()
+        # the HARD cap on dispatched-not-completed batches: the worker takes
+        # a slot before launching, the completion stage returns it only
+        # AFTER the batch fully finishes — the device queue can never hold
+        # more than pipeline_depth outstanding batches
+        self._slots = threading.Semaphore(self.pipeline_depth)
+        self._pause = threading.Event()  # flush() barrier gate
+        self._flush_lock = threading.Lock()  # one barrier at a time
+        self._completion_thread: Optional[threading.Thread] = None
+        if stats is not None:
+            stats.set_pipeline_depth(self.pipeline_depth)
         self._queue: "queue.Queue[_Request]" = queue.Queue(self.queue_capacity)
         self._carry: Optional[_Request] = None  # held-over (mismatch/overflow)
         self._pending = 0  # accepted futures not yet resolved (drain gauge)
@@ -135,16 +167,65 @@ class MicroBatcher:
 
     @property
     def pending(self) -> int:
-        """Accepted requests whose future has not resolved yet (queued OR
-        mid-dispatch) — the server's drain loop waits on this."""
+        """Accepted requests whose future has not resolved yet (queued,
+        mid-dispatch OR in the completion pipeline) — the server's drain
+        loop waits on this."""
         with self._pending_lock:
             return self._pending
+
+    @property
+    def in_flight(self) -> int:
+        """Batches dispatched to the device but not yet completed — the
+        device-queue occupancy gauge (0..pipeline_depth)."""
+        with self._in_flight_lock:
+            return self._in_flight
+
+    def flush(self, timeout: float = 30.0, then=None) -> bool:
+        """Pipeline barrier: pause new dispatches, wait until no batch is
+        mid-dispatch or awaiting completion, run ``then()`` (if given) at
+        the quiesced point, then resume. The hot-reload path passes the
+        weight swap as ``then`` so it happens at a clean pipeline boundary
+        — every batch dispatched before the barrier has fully completed on
+        the old weights, every batch after it snapshots the new. Queued
+        requests are unaffected (they dispatch after, on the new weights).
+        Returns False (and does NOT run ``then``) if the pipeline failed
+        to quiesce within ``timeout`` — under sustained traffic the pause
+        gate guarantees it normally drains within ~one batch time.
+        Concurrent flushes serialize (the gate must stay closed for the
+        whole quiesce+then of each caller); a close() racing a barrier
+        that is still WAITING aborts it with False (shutdown wins), while
+        one already at its quiesced point completes its ``then`` with the
+        gate still closed — either way no dispatch overlaps ``then``."""
+        with self._flush_lock:
+            self._pause.set()
+            try:
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if self._stop.is_set():
+                        return False  # shutting down: the gate is void
+                    if self.in_flight == 0:
+                        if then is not None:
+                            then()
+                        return True
+                    time.sleep(0.001)
+                return False
+            finally:
+                self._pause.clear()
 
     # -- worker side --
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._closed = False
+            if self.pipeline_depth > 1:
+                # unbounded hand-off: the slot semaphore is the backpressure
+                # (a bounded queue would free its slot at get(), letting the
+                # host run depth+1 batches ahead while one is mid-finish)
+                self._inflight_q = queue.Queue()
+                self._completion_thread = threading.Thread(
+                    target=self._completion_loop, daemon=True,
+                    name="paddle-tpu-microbatcher-complete")
+                self._completion_thread.start()
             self._thread = threading.Thread(target=self._loop, daemon=True,
                                             name="paddle-tpu-microbatcher")
             self._thread.start()
@@ -174,35 +255,42 @@ class MicroBatcher:
         return True
 
     def _loop(self) -> None:
-        while True:
-            first = self._next(0.05)
-            if first is None:
-                if self._stop.is_set():
-                    return
-                continue
-            if self.chaos is not None:
-                # injected queue stall, per batch (an idle poll must not
-                # roll the dice — it would drain the fault budget with no
-                # traffic to observe the fault); stalling with `first` in
-                # hand lets the queue build behind it, and may expire it
-                self.chaos.on_coalesce()
-            if self._shed_expired(first):
-                continue
-            batch = [first]
-            rows = first.rows
-            deadline = time.monotonic() + self.batch_timeout_s
-            while rows < self.max_batch_size:
-                nxt = self._next(max(0.0, deadline - time.monotonic()))
-                if nxt is None:  # timed out — ship what we have
-                    break
-                if self._shed_expired(nxt):
+        try:
+            while True:
+                first = self._next(0.05)
+                if first is None:
+                    if self._stop.is_set():
+                        return
                     continue
-                if nxt.sig != first.sig or rows + nxt.rows > self.max_batch_size:
-                    self._carry = nxt  # starts the next batch, keeps order
-                    break
-                batch.append(nxt)
-                rows += nxt.rows
-            self._dispatch(batch, rows)
+                if self.chaos is not None:
+                    # injected queue stall, per batch (an idle poll must not
+                    # roll the dice — it would drain the fault budget with no
+                    # traffic to observe the fault); stalling with `first` in
+                    # hand lets the queue build behind it, and may expire it
+                    self.chaos.on_coalesce()
+                if self._shed_expired(first):
+                    continue
+                batch = [first]
+                rows = first.rows
+                deadline = time.monotonic() + self.batch_timeout_s
+                while rows < self.max_batch_size:
+                    nxt = self._next(max(0.0, deadline - time.monotonic()))
+                    if nxt is None:  # timed out — ship what we have
+                        break
+                    if self._shed_expired(nxt):
+                        continue
+                    if nxt.sig != first.sig or rows + nxt.rows > self.max_batch_size:
+                        self._carry = nxt  # starts the next batch, keeps order
+                        break
+                    batch.append(nxt)
+                    rows += nxt.rows
+                self._dispatch(batch, rows)
+        finally:
+            # the completion thread exits only on this sentinel, AFTER
+            # finishing every in-flight batch the worker handed it — so a
+            # drain still resolves everything dispatched
+            if self._inflight_q is not None:
+                self._inflight_q.put(None)
 
     def _complete(self, req: _Request, result=None, exc=None) -> bool:
         """Resolve a future exactly once (cancelled/raced ones are done)."""
@@ -226,6 +314,9 @@ class MicroBatcher:
             self._complete(r, exc=e)
 
     def _dispatch(self, batch: List[_Request], rows: int) -> None:
+        """Host-prepare + async device dispatch. With the pipeline enabled
+        the host sync happens on the completion thread (``_finish``); this
+        thread immediately returns to coalescing the next batch."""
         if len(batch) > 1 and not all(self.engine.fetch_per_row.values()):
             # a fetch without a per-row batch dim (a batch reduction) would
             # mix the coalesced clients' rows — refuse to scatter it
@@ -234,17 +325,65 @@ class MicroBatcher:
                 "scattered across coalesced requests — serve such models "
                 "with max_batch_size=1 or per-row fetch targets"))
             return
-        feeds = {n: np.concatenate([r.feeds[n] for r in batch], axis=0)
-                 for n in self.engine.feed_names}
+        if len(batch) == 1:
+            # fast path: a single-request batch reuses the buffer
+            # prepare_request already padded at submit — no per-name
+            # re-stack (counted as single_request_batches in stats)
+            feeds = batch[0].feeds
+        else:
+            feeds = {n: np.concatenate([r.feeds[n] for r in batch], axis=0)
+                     for n in self.engine.feed_names}
+        # take a pipeline slot (hard cap: pipeline_depth dispatched-not-
+        # completed), then clear the flush() barrier gate; the pause check
+        # shares the in_flight lock so flush can never observe a quiesced
+        # pipeline while a dispatch is slipping past the gate
+        # the gate honors the pause unconditionally — no shutdown escape, so
+        # a barrier that reached its quiesced point runs then() with NO
+        # dispatch slipping in (even a racing close()); the wait is bounded
+        # because flush() always clears the pause in its finally
+        self._slots.acquire()
+        while True:
+            with self._in_flight_lock:
+                if not self._pause.is_set():
+                    self._in_flight += 1
+                    occ = self._in_flight
+                    break
+            time.sleep(0.0005)
+        if self.stats:
+            self.stats.record_pipeline(occ)
         try:
             # requests were prepared (validated/coerced/padded) at submit;
             # don't re-run that work per dispatched batch
-            outs = self.engine.run_prepared(feeds, rows)
+            inflight = self.engine.dispatch_prepared(feeds, rows)
+        except Exception as e:
+            with self._in_flight_lock:
+                self._in_flight -= 1
+            self._slots.release()
+            self._fail_batch(batch, e)
+            return
+        if self._inflight_q is not None:
+            self._inflight_q.put((batch, inflight))
+        else:
+            self._finish(batch, inflight)
+
+    def _finish(self, batch: List[_Request], inflight) -> None:
+        """Device-complete stage: host sync, per-row scatter, resolve.
+        The pipeline slot is returned only HERE, after the batch fully
+        finished — the worker cannot run further ahead in the meantime."""
+        try:
+            outs = self.engine.complete(inflight)
         except Exception as e:
             self._fail_batch(batch, e)
             return
+        finally:
+            with self._in_flight_lock:
+                self._in_flight -= 1
+            self._slots.release()
+        # counted only once the device call actually completed (failure
+        # paths land in record_failure, matching the pre-pipeline stats)
         if self.stats:
-            self.stats.record_batch(rows, self.engine.bucket_batch(rows))
+            self.stats.record_batch(inflight.rows, inflight.bucket,
+                                    requests=len(batch))
         now = time.monotonic()
         off = 0
         for r in batch:
@@ -253,6 +392,14 @@ class MicroBatcher:
             off += r.rows
             if self._complete(r, result=res) and self.stats:
                 self.stats.record_done(now - r.t_submit)
+
+    def _completion_loop(self) -> None:
+        q = self._inflight_q
+        while True:
+            item = q.get()
+            if item is None:  # worker exited; pipeline fully drained
+                return
+            self._finish(*item)
 
     def close(self, timeout: float = 10.0) -> None:
         """Graceful drain: no new submits land, the worker serves what is
@@ -269,6 +416,11 @@ class MicroBatcher:
                 # queue and will drain it on its way out — draining here
                 # too would race it into double-completing requests
                 return
+        # the worker's exit pushed the pipeline sentinel; the completion
+        # thread finishes every dispatched batch, then exits
+        ct = self._completion_thread
+        if ct is not None and ct.is_alive():
+            ct.join(timeout)
         # worker gone (or never started): fail anything still pending
         leftover, self._carry = ([self._carry] if self._carry else []), None
         while True:

@@ -62,6 +62,21 @@ def _round_up(size: int, ladder: Optional[Sequence[int]]) -> int:
     raise ValueError(f"size {size} exceeds bucket ladder {tuple(ladder)}")
 
 
+class InFlightBatch:
+    """A dispatched-but-not-synced device call: the handle between the
+    engine's host-prepare (``dispatch_prepared``) and device-complete
+    (``complete``) stages. ``weights_version`` records the param snapshot
+    the batch runs on — wholly one version, never a mix."""
+
+    __slots__ = ("fetches", "rows", "bucket", "weights_version")
+
+    def __init__(self, fetches, rows: int, bucket: int, weights_version: int):
+        self.fetches = fetches
+        self.rows = rows
+        self.bucket = bucket
+        self.weights_version = weights_version
+
+
 class ServingEngine:
     """Load an exported inference dir; serve padded, bucketed batches.
 
@@ -237,6 +252,12 @@ class ServingEngine:
         """Atomically swap the serving parameters from a re-exported
         inference dir; returns the new ``params_version``.
 
+        ``stage_params`` (slow: disk read, validation, device_put) +
+        ``commit_params`` (one attribute store). Callers that need the
+        swap at a precise point — the batcher's pipeline barrier — stage
+        first and pass only the commit into the barrier, so traffic keeps
+        flowing on the old weights for the whole load.
+
         The new export must be shape-compatible with the FROZEN program:
         same feed/fetch names and, for every state var, the same shape and
         dtype (the traced step fn and its compiled bucket executables are
@@ -247,6 +268,13 @@ class ServingEngine:
         the old weights; every later dispatch sees only the new ones —
         no response ever mixes versions.
         """
+        return self.commit_params(self.stage_params(dirname))
+
+    def stage_params(self, dirname: str) -> Dict[str, Any]:
+        """Load, validate, and device_put a re-exported param set WITHOUT
+        touching the live one — the slow half of a reload, safe to run
+        while traffic flows. Returns the staged device-resident dict for
+        ``commit_params``."""
         import jax
 
         from .. import io as model_io
@@ -278,11 +306,15 @@ class ServingEngine:
                     f"reload {dirname!r}: {n!r} dtype {arr.dtype} != frozen "
                     f"{np.dtype(old.dtype)}")
             staged[n] = arr
-        # validated: device_put the full set, then swap the dict reference
-        # (one attribute store — dispatches snapshot it exactly once)
+        # validated: device_put the full set (still off to the side)
         with jax.default_device(self._device):
-            new_params = {n: jax.device_put(a, self._device)
-                          for n, a in staged.items()}
+            return {n: jax.device_put(a, self._device)
+                    for n, a in staged.items()}
+
+    def commit_params(self, new_params: Dict[str, Any]) -> int:
+        """Swap the live param set to a ``stage_params`` result: ONE dict
+        reference store (dispatches snapshot it exactly once) — cheap
+        enough to run inside a pipeline barrier."""
         with self._lock:
             self._params = new_params
             self.params_version += 1
@@ -300,6 +332,17 @@ class ServingEngine:
         """``run_batch`` minus validation/coercion/trailing padding — for
         feeds assembled from ``prepare_request`` outputs (the batcher preps
         each request once at submit and only concatenates here)."""
+        return self.complete(self.dispatch_prepared(feeds, rows))
+
+    def dispatch_prepared(self, feeds: Dict[str, np.ndarray],
+                          rows: int) -> "InFlightBatch":
+        """Host-prepare + enqueue stage of the split dispatch (docs/design.md
+        §13): pad rows up to the bucket, ``device_put`` the feeds, snapshot
+        the param set ONCE, and launch the device call WITHOUT waiting for
+        it. XLA dispatch is async — the returned ``InFlightBatch`` holds
+        device arrays still being computed; ``complete()`` is the host sync.
+        The batcher's depth-2 pipeline preps the next batch while this one
+        runs."""
         import jax
 
         bucket = self.bucket_batch(rows)
@@ -317,15 +360,23 @@ class ServingEngine:
         # reload_params swaps the whole dict atomically, so this batch runs
         # entirely on one weights version. A cold-bucket compile must not
         # stall cache_info() (the stats RPC) or other runners.
-        params = self._params
+        with self._lock:  # one consistent (params, version) snapshot
+            params = self._params
+            version = self.params_version
         with jax.default_device(self._device):
             feed_vals = {n: jax.device_put(a, self._device)
                          for n, a in feeds.items()}
             readonly = {n: params[n] for n in self._readonly_names}
             donated = {n: params[n] for n in self._donated_names}
             fetches, _ = fn(feed_vals, readonly, donated, self._key)
+        return InFlightBatch(fetches, rows, bucket, version)
+
+    def complete(self, inflight: "InFlightBatch") -> List[np.ndarray]:
+        """Device-complete stage: block until the in-flight batch finishes,
+        convert to numpy, slice per-row results back to the true row count."""
+        rows, bucket = inflight.rows, inflight.bucket
         outs = []
-        for name, f in zip(self.fetch_names, fetches):
+        for name, f in zip(self.fetch_names, inflight.fetches):
             a = np.asarray(f)
             if self.fetch_per_row[name]:
                 if a.ndim < 1 or a.shape[0] != bucket:

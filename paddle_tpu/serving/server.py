@@ -152,7 +152,7 @@ class ServingServer(socketserver.ThreadingTCPServer):
                  batch_timeout_ms: float = 5.0,
                  queue_capacity: int = 64, request_timeout: float = 60.0,
                  warmup: bool = False, stats: Optional[ServingStats] = None,
-                 start_batcher: bool = True,
+                 start_batcher: bool = True, pipeline_depth: int = 2,
                  degraded_queue_ratio: float = 0.75,
                  degraded_error_ratio: float = 0.5,
                  health_window_s: float = 5.0,
@@ -187,7 +187,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 self.engine, max_batch_size=batcher_max,
                 batch_timeout_ms=batch_timeout_ms,
                 queue_capacity=queue_capacity,
-                stats=self.stats, start=start_batcher)
+                stats=self.stats, pipeline_depth=pipeline_depth,
+                start=start_batcher)
             self.request_timeout = request_timeout
             # health state machine + probabilistic load shedding
             self.degraded_queue_ratio = degraded_queue_ratio
@@ -287,6 +288,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
             "queue_capacity": self.batcher.queue_capacity,
             "compile_cache": self.engine.cache_info(),
             "weights_version": self.engine.params_version,
+            "pipeline_depth": self.batcher.pipeline_depth,
+            "in_flight": self.batcher.in_flight,
         }
         if self.chaos is not None:
             extra["chaos"] = self.chaos.snapshot()
@@ -296,10 +299,30 @@ class ServingServer(socketserver.ThreadingTCPServer):
     def reload(self, dirname: str) -> Dict[str, Any]:
         """Swap serving weights from a re-exported dir; zero downtime (no
         request is rejected because of the reload — traffic keeps flowing
-        on the old weights until the atomic swap)."""
-        version = self.engine.reload_params(dirname)
+        on the old weights until the atomic swap). The swap happens at a
+        clean pipeline boundary: ``flush()`` waits out any in-flight
+        dispatches first, so every batch dispatched before the reload has
+        fully completed on the old weights and every later one snapshots
+        the new — per-dispatch atomicity (one params snapshot per batch)
+        holds regardless; the barrier additionally pins the ORDER of
+        weights versions across the pipeline. The SLOW half of the reload
+        (disk read, validation, device_put) runs BEFORE the barrier with
+        traffic flowing on the old weights; only the one-attribute-store
+        commit runs inside it (microseconds of pause). If the pipeline
+        fails to quiesce the reload is REFUSED with a retryable
+        ``unavailable`` rather than swapping mid-flight."""
+        staged = self.engine.stage_params(dirname)  # slow; traffic flows
+        swapped: Dict[str, int] = {}
+
+        def _swap():
+            swapped["version"] = self.engine.commit_params(staged)
+
+        if not self.batcher.flush(then=_swap):
+            raise ServingUnavailable(
+                "reload: dispatch pipeline did not quiesce within the "
+                "barrier timeout — retry")
         self.stats.record_reload()
-        return {"weights_version": version}
+        return {"weights_version": swapped["version"]}
 
     # -- graceful shutdown --
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -65,7 +65,14 @@ class ServingStats:
         self.reloads = 0
         self.batches = 0
         self.rows = 0
+        self.single_request_batches = 0  # fast path: no re-stack (batcher)
         self._fill_sum = 0.0  # sum over batches of rows/bucket
+        # dispatch-pipeline gauges (docs/design.md §13): configured depth +
+        # how many batches were dispatched-but-not-completed when the last
+        # dispatch launched (occupancy ~depth = the device queue stays full)
+        self.pipeline_depth = 1
+        self.device_queue_occupancy = 0
+        self.device_queue_occupancy_max = 0
         # latency ring (last N latencies, seconds) bounds the percentile
         # cost; rates count in separate per-second buckets so high
         # throughput can't push events out before their window expires
@@ -120,11 +127,24 @@ class ServingStats:
         with self._lock:
             self.reloads += 1
 
-    def record_batch(self, rows: int, bucket: int) -> None:
+    def record_batch(self, rows: int, bucket: int, requests: int = 1) -> None:
         with self._lock:
             self.batches += 1
             self.rows += rows
             self._fill_sum += rows / max(bucket, 1)
+            if requests == 1:
+                self.single_request_batches += 1
+
+    def set_pipeline_depth(self, depth: int) -> None:
+        with self._lock:
+            self.pipeline_depth = int(depth)
+
+    def record_pipeline(self, occupancy: int) -> None:
+        """Device-queue occupancy sampled at each dispatch launch."""
+        with self._lock:
+            self.device_queue_occupancy = int(occupancy)
+            self.device_queue_occupancy_max = max(
+                self.device_queue_occupancy_max, int(occupancy))
 
     def record_done(self, latency_s: float) -> None:
         with self._lock:
@@ -174,6 +194,13 @@ class ServingStats:
                 "avg_batch_rows": self.rows / self.batches if self.batches else 0.0,
                 "batch_fill_ratio": (self._fill_sum / self.batches
                                      if self.batches else 0.0),
+                "single_request_batches": self.single_request_batches,
+                "pipeline": {
+                    "depth": self.pipeline_depth,
+                    "device_queue_occupancy": self.device_queue_occupancy,
+                    "device_queue_occupancy_max":
+                        self.device_queue_occupancy_max,
+                },
             }
         if extra:
             snap.update(extra)

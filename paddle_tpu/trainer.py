@@ -120,25 +120,58 @@ class Trainer:
 
     def train(self, num_epochs: int, event_handler: Optional[Callable] = None,
               reader: Optional[Callable] = None,
-              feed_order: Optional[Sequence[str]] = None):
-        """Epoch/step loop with events (<- trainer.py train/_train_by_executor)."""
+              feed_order: Optional[Sequence[str]] = None,
+              log_every: int = 1, prefetch_depth: int = 0):
+        """Epoch/step loop with events (<- trainer.py train/_train_by_executor).
+
+        Pipelining knobs (docs/design.md §13):
+
+        * ``prefetch_depth > 0`` wraps the reader in a ``DevicePrefetcher``:
+          batch N+1 is converted and ``device_put`` on a background thread
+          while step N runs, so the step path feeds device-resident arrays.
+        * ``log_every = m`` fetches and converts metrics only every m-th
+          step (async fetch mode): the other steps dispatch with an empty
+          fetch list and never force a host sync, keeping the XLA dispatch
+          queue full. ``BeginStepEvent.fetch_metrics`` defaults accordingly
+          and the user can still flip it per step; non-fetch steps see
+          ``EndStepEvent.metrics == []``.
+
+        Defaults (``log_every=1, prefetch_depth=0``) preserve the original
+        synchronous behavior exactly.
+        """
         event_handler = event_handler or (lambda e: None)
         feeder = self._feeder(feed_order) if feed_order else None
         fetch = [self.loss.name] + [m.name for m in self.metric_vars]
+        log_every = max(1, int(log_every))
+
+        def feed_stream():
+            if prefetch_depth > 0:
+                from .reader.prefetch import DevicePrefetcher
+                pf = DevicePrefetcher(reader, depth=prefetch_depth,
+                                      place=self.exe.place,
+                                      program=self.train_program,
+                                      transform=feeder.feed if feeder else None)
+                yield from pf()
+            else:
+                for batch in reader():
+                    yield feeder.feed(batch) if feeder else batch
+
         step_count = 0
         for epoch in range(num_epochs):
             event_handler(BeginEpochEvent(epoch))
-            for step, batch in enumerate(reader()):
+            for step, feed in enumerate(feed_stream()):
                 if self.stop_requested:
                     return
                 begin = BeginStepEvent(epoch, step)
+                begin.fetch_metrics = (step % log_every == 0)
                 event_handler(begin)
-                feed = feeder.feed(batch) if feeder else batch
                 metrics = self.exe.run(
                     self.train_program, feed=feed,
                     fetch_list=fetch if begin.fetch_metrics else [],
-                    scope=self.scope)
-                event_handler(EndStepEvent(epoch, step, list(metrics or [])))
+                    scope=self.scope, return_numpy=False)
+                # host conversion (the sync point) only on fetch steps
+                metrics = [np.asarray(m) for m in (metrics or [])]
+                event_handler(EndStepEvent(epoch, step, metrics))
                 step_count += 1
                 if (self.checkpoint_cfg
                         and step_count % self.checkpoint_cfg.step_interval == 0):

@@ -1,0 +1,146 @@
+"""Step-pipeline soak tests (slow tier): long pipelined runs must stay
+numerically faithful and the serving pipeline must survive sustained
+traffic with mid-stream reloads and a clean drain.
+
+Marked ``slow`` so tier-1 stays fast (pytest.ini addopts excludes them);
+run with ``pytest tests/test_pipeline_soak.py -m slow``.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io, layers
+from paddle_tpu.inference import Predictor
+from paddle_tpu.serving import ServingClient, ServingError, ServingServer
+
+pytestmark = pytest.mark.slow
+
+STEPS = 120
+
+
+def _build_model(seed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[10], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=16, act="relu")
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=seed)
+    return exe, main, scope, loss
+
+
+def test_soak_fused_prefetched_training_matches_sequential():
+    """STEPS steps through run_steps(k=4) windows fed by a depth-2
+    DevicePrefetcher == STEPS sequential exe.run calls: identical losses
+    at every window boundary and identical final params."""
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.randn(8, 10).astype("float32"),
+              "y": rng.randn(8, 1).astype("float32")} for _ in range(STEPS)]
+
+    exe1, p1, s1, l1 = _build_model(seed=7)
+    seq = [float(np.asarray(
+        exe1.run(p1, feed=f, fetch_list=[l1], scope=s1)[0]))
+        for f in feeds]
+
+    exe2, p2, s2, l2 = _build_model(seed=7)
+    k = 4
+    from paddle_tpu.reader import DevicePrefetcher
+
+    def window_reader():
+        for i in range(0, STEPS, k):
+            yield feeds[i:i + k]
+
+    pf = DevicePrefetcher(lambda: iter(window_reader()), depth=2,
+                          transform=lambda w: {
+                              "x": np.stack([f["x"] for f in w]),
+                              "y": np.stack([f["y"] for f in w])})
+    fused = []
+    for placed in pf():
+        window = [{n: placed[n][i] for n in placed} for i in range(k)]
+        out = exe2.run_steps(p2, feed=window, fetch_list=[l2], scope=s2)
+        fused.extend(np.asarray(out[0]).ravel().tolist())
+    np.testing.assert_allclose(seq, fused, rtol=1e-4, atol=1e-5)
+    for n in s1.var_names():
+        np.testing.assert_allclose(np.asarray(s1.get(n)),
+                                   np.asarray(s2.get(n)),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def _export_fc(dirname, seed):
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)
+        io.save_inference_model(dirname, ["x"], [pred], exe, main,
+                                scope=scope)
+    return dirname
+
+
+def test_soak_serving_pipeline_under_traffic_with_reloads(tmp_path):
+    """Sustained closed-loop traffic through the depth-2 server pipeline
+    with two mid-stream hot reloads: 100% success-or-typed-error, every
+    response wholly one weights version, pipeline gauges sane, clean
+    drain."""
+    d1 = _export_fc(str(tmp_path / "v1"), seed=21)
+    d2 = _export_fc(str(tmp_path / "v2"), seed=42)
+    X = np.random.RandomState(5).randn(2, 4).astype("float32")
+    refs = [Predictor(d, place=fluid.CPUPlace()).run({"x": X})[0]
+            for d in (d1, d2)]
+
+    srv = ServingServer(d1, max_batch_size=8, batch_timeout_ms=1.0,
+                        pipeline_depth=2, warmup=True)
+    stop = threading.Event()
+    outcomes = {"ok": 0, "typed": 0, "other": 0}
+    lock = threading.Lock()
+
+    def client_loop(seed):
+        with ServingClient(srv.endpoint, retries=4, backoff_base_ms=2.0,
+                           retry_seed=seed) as c:
+            while not stop.is_set():
+                try:
+                    out = c.predict({"x": X})[0]
+                    match = any(np.allclose(out, r, atol=1e-4) for r in refs)
+                    with lock:
+                        outcomes["ok" if match else "other"] += 1
+                except ServingError:
+                    with lock:
+                        outcomes["typed"] += 1
+                except Exception:
+                    with lock:
+                        outcomes["other"] += 1
+
+    threads = [threading.Thread(target=client_loop, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(1.0)
+        with ServingClient(srv.endpoint) as admin:
+            assert admin.reload(d2)["weights_version"] == 2
+            time.sleep(1.0)
+            assert admin.reload(d1)["weights_version"] == 3
+            time.sleep(1.0)
+            snap = admin.stats()
+            assert snap["pipeline_depth"] == 2
+            assert snap["pipeline"]["device_queue_occupancy_max"] <= 2
+            assert snap["reloads"] == 2
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+        srv.close()  # graceful drain
+    assert outcomes["other"] == 0, outcomes  # success or typed, nothing else
+    assert outcomes["ok"] > 100, outcomes
+    assert srv.batcher.pending == 0 and srv.batcher.in_flight == 0

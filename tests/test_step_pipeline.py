@@ -1,0 +1,232 @@
+"""Async step pipeline, training side (ISSUE 3): ``Executor.run_steps``
+scan fusion, ``DevicePrefetcher``, trainer ``log_every`` async fetch.
+
+Acceptance contract: the pipelined paths (fused windows, prefetched device
+feeds, sparse metric fetches) produce results allclose to the unpipelined
+per-step path — same seeds, same update order — and the compile cache is
+keyed on program ``uid`` (never the recyclable ``id()``).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.reader import DevicePrefetcher
+
+
+def _build_model(seed, dropout=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[6], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=8, act="relu")
+            if dropout:
+                h = layers.dropout(h, dropout_prob=0.3)
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=seed)
+    return exe, main, scope, loss
+
+
+def _feeds(n, batch=4):
+    rng = np.random.RandomState(7)
+    return [{"x": rng.randn(batch, 6).astype("float32"),
+             "y": rng.randn(batch, 1).astype("float32")} for _ in range(n)]
+
+
+def _assert_scopes_match(s1, s2):
+    names = set(s1.var_names())
+    assert names == set(s2.var_names())
+    for n in names:
+        np.testing.assert_allclose(np.asarray(s1.get(n)),
+                                   np.asarray(s2.get(n)),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_run_steps_matches_sequential(k):
+    """k fused steps == k sequential exe.run calls: same per-step losses,
+    same final params (the numerics-under-pipelining acceptance gate)."""
+    feeds = _feeds(8)
+    exe1, p1, s1, l1 = _build_model(seed=3)
+    seq = [float(np.asarray(
+        exe1.run(p1, feed=f, fetch_list=[l1], scope=s1)[0]))
+        for f in feeds]
+    exe2, p2, s2, l2 = _build_model(seed=3)
+    fused = []
+    for i in range(0, len(feeds), k):
+        out = exe2.run_steps(p2, feed=feeds[i:i + k], fetch_list=[l2],
+                             scope=s2)
+        assert np.asarray(out[0]).shape[0] == k  # step-stacked fetches
+        fused.extend(np.asarray(out[0]).ravel().tolist())
+    np.testing.assert_allclose(seq, fused, rtol=1e-5, atol=1e-6)
+    _assert_scopes_match(s1, s2)
+
+
+def test_run_steps_invariant_feed_matches_sequential():
+    """Single-dict (scan-invariant) feed mode == feeding the same batch k
+    times through the per-step path."""
+    feed = _feeds(1)[0]
+    exe1, p1, s1, l1 = _build_model(seed=5)
+    seq = [float(np.asarray(
+        exe1.run(p1, feed=feed, fetch_list=[l1], scope=s1)[0]))
+        for _ in range(4)]
+    exe2, p2, s2, l2 = _build_model(seed=5)
+    out = exe2.run_steps(p2, feed=feed, k=4, fetch_list=[l2], scope=s2)
+    np.testing.assert_allclose(seq, np.asarray(out[0]).ravel(),
+                               rtol=1e-5, atol=1e-6)
+    _assert_scopes_match(s1, s2)
+
+
+def test_run_steps_seed_parity_under_dropout():
+    """Step i of a fused window draws the SAME PRNG key the i-th sequential
+    run() would — dropout masks agree, so losses agree bitwise-close."""
+    feeds = _feeds(4)
+    exe1, p1, s1, l1 = _build_model(seed=11, dropout=True)
+    seq = [float(np.asarray(
+        exe1.run(p1, feed=f, fetch_list=[l1], scope=s1)[0]))
+        for f in feeds]
+    exe2, p2, s2, l2 = _build_model(seed=11, dropout=True)
+    out = exe2.run_steps(p2, feed=feeds, fetch_list=[l2], scope=s2)
+    np.testing.assert_allclose(seq, np.asarray(out[0]).ravel(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_async_fetch_returns_device_arrays():
+    """return_numpy=False: fetches stay device arrays (no forced host
+    sync); converting later yields the same values."""
+    feeds = _feeds(2)
+    exe, prog, scope, loss = _build_model(seed=3)
+    out = exe.run_steps(prog, feed=feeds, fetch_list=[loss], scope=scope,
+                        return_numpy=False)
+    assert isinstance(out[0], jax.Array)
+    assert np.asarray(out[0]).shape == (2,)
+
+
+def test_run_steps_feed_validation():
+    exe, prog, scope, loss = _build_model(seed=3)
+    with pytest.raises(ValueError, match="needs k >= 1"):
+        exe.run_steps(prog, feed=_feeds(1)[0], scope=scope)
+    with pytest.raises(ValueError, match="non-empty"):
+        exe.run_steps(prog, feed=[], scope=scope)
+    bad = _feeds(2)
+    del bad[1]["y"]
+    with pytest.raises(ValueError, match="same names"):
+        exe.run_steps(prog, feed=bad, fetch_list=[loss], scope=scope)
+
+
+def test_program_uid_monotonic_never_reused():
+    """Regression (compile-cache aliasing): id() of a GC'd program can be
+    recycled; Program.uid must never repeat."""
+    p1 = fluid.Program()
+    uid1 = p1.uid
+    del p1
+    seen = {uid1}
+    for _ in range(32):
+        p = fluid.Program()
+        assert p.uid not in seen
+        seen.add(p.uid)
+        del p
+
+
+def test_executor_cache_keyed_on_uid_not_id():
+    """The jit cache key leads with program.uid — a fresh program whose
+    id() happens to match a dead one's can never hit its executable."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        blk = prog.global_block()
+        blk.create_var("x", dtype="float32", shape=(2,), is_data=True)
+        blk.create_var("y")
+        blk.append_op("scale", {"X": ["x"]}, {"Out": ["y"]}, {"scale": 2.0})
+    exe.run(prog, feed={"x": np.ones(2, "float32")}, fetch_list=["y"])
+    keys = list(exe._cache)
+    assert keys and keys[0][0] == prog.uid
+    assert all(key[0] != id(prog) for key in keys)  # id() plays no part
+
+
+def test_device_prefetcher_order_values_and_placement():
+    """Prefetched feeds come back in order, as device arrays, with values
+    identical to the source reader's."""
+    rng = np.random.RandomState(0)
+    batches = [{"x": rng.randn(3, 6).astype("float32")} for _ in range(7)]
+
+    def reader():
+        yield from batches
+
+    pf = DevicePrefetcher(lambda: reader(), depth=2)
+    got = list(pf())
+    assert len(got) == 7 and pf.batches == 7
+    for src, dst in zip(batches, got):
+        assert isinstance(dst["x"], jax.Array)
+        np.testing.assert_array_equal(src["x"], np.asarray(dst["x"]))
+
+
+def test_device_prefetcher_depth_validation_and_transform():
+    with pytest.raises(ValueError, match="depth"):
+        DevicePrefetcher(lambda: iter(()), depth=0)
+
+    def reader():
+        yield from range(3)
+
+    pf = DevicePrefetcher(lambda: reader(), depth=1,
+                          transform=lambda i: {"x": np.full((1,), i, "f4")})
+    vals = [float(np.asarray(f["x"])[0]) for f in pf()]
+    assert vals == [0.0, 1.0, 2.0]
+
+
+def test_device_prefetcher_propagates_reader_error():
+    def reader():
+        yield {"x": np.zeros((1,), "float32")}
+        raise RuntimeError("boom mid-stream")
+
+    pf = DevicePrefetcher(lambda: reader(), depth=2)
+    it = pf()
+    next(it)
+    with pytest.raises(RuntimeError, match="boom mid-stream"):
+        next(it)
+
+
+def test_trainer_log_every_and_prefetch_still_learns():
+    """log_every>1 fetches metrics only on log steps (others dispatch with
+    an empty fetch list); prefetch_depth feeds device arrays — learning
+    matches the synchronous path's trajectory."""
+    W = np.random.RandomState(0).randn(6, 1).astype("float32")
+
+    def make_reader():
+        rng = np.random.RandomState(2)
+
+        def rd():
+            for _ in range(24):
+                x = rng.randn(6).astype("float32")
+                yield x, (x @ W).astype("float32")
+
+        return rd
+
+    def train_func():
+        x = layers.data("x", shape=[6], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    events = []
+    tr = fluid.Trainer(train_func,
+                       lambda: fluid.optimizer.SGD(learning_rate=0.05),
+                       place=fluid.CPUPlace(), seed=3)
+    tr.train(num_epochs=10, reader=fluid.reader.batch(make_reader(), 8),
+             feed_order=["x", "y"], event_handler=events.append,
+             log_every=3, prefetch_depth=2)
+    steps = [e for e in events if isinstance(e, fluid.EndStepEvent)]
+    logged = [e for e in steps if e.metrics]
+    assert len(steps) == 30  # 10 epochs x 3 steps
+    assert len(logged) == 10  # only step 0 of each epoch (0 % 3 == 0)
+    assert all(e.step % 3 == 0 for e in logged)
+    first = float(np.asarray(logged[0].metrics[0]))
+    last = float(np.asarray(logged[-1].metrics[0]))
+    assert last < first * 0.5, (first, last)

@@ -1,5 +1,5 @@
 """Perf lab: hand-written pure-JAX ResNet-50 train step as a throughput
-ceiling reference for bench.py.
+ceiling reference for bench.py, plus a step-pipeline sweep.
 
 The framework's bench (bench.py) runs ResNet-50 through the Program->XLA
 executor. This script runs the *same math* written directly in jax, so the
@@ -7,11 +7,18 @@ difference isolates framework-introduced overhead (op-boundary casts, BN
 materialization, grad recomputation that XLA failed to CSE, ...) from
 chip/XLA limits. Variants:
 
-  python tools/perf_lab.py nchw    # framework's layout
-  python tools/perf_lab.py nhwc    # TPU-preferred logical layout
+  python tools/perf_lab.py nchw      # framework's layout
+  python tools/perf_lab.py nhwc      # TPU-preferred logical layout
+  python tools/perf_lab.py pipeline  # sweep run_steps window k in {1,2,4}
+                                     # and DevicePrefetcher depth in {1,2,4}
+                                     # on a small framework workload and
+                                     # report step_ms per config — one
+                                     # command to spot a pipelining
+                                     # regression (docs/design.md §13)
 
 Prints images/sec and analytic MFU (12.3 GFLOP/img fwd+bwd on a
-~197 TFLOP/s bf16 v5e chip).
+~197 TFLOP/s bf16 v5e chip) for the resnet modes; step_ms per knob for
+``pipeline``.
 """
 from __future__ import annotations
 
@@ -123,8 +130,94 @@ def forward(params, blocks, img, label, layout):
     return -jnp.mean(jnp.take_along_axis(logp, label, axis=1))
 
 
+def pipeline_mode(steps: int = 64):
+    """Sweep the step-pipeline knobs on a small framework MLP workload.
+
+    Three rows per knob value k in {1, 2, 4}:
+
+    * ``run_steps k=N``    — fused scan window over device-resident feeds
+      (the bench.py hot path; k=1 is the unfused per-step dispatch)
+    * ``prefetch depth=N`` — host-fed reader behind a DevicePrefetcher
+      (H2D overlap; depth=1 still overlaps conversion, just single-buffered)
+
+    step_ms should be monotonically non-increasing in k on a host-bound
+    workload; a regression here means the pipeline stopped overlapping.
+    """
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import paddle_tpu as fluid
+
+    def build():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main_prog, startup):
+                x = fluid.layers.data("x", shape=[256], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                h = fluid.layers.fc(x, size=512, act="relu")
+                h = fluid.layers.fc(h, size=512, act="relu")
+                pred = fluid.layers.fc(h, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(
+                    loss, startup)
+        exe = fluid.Executor(fluid.default_place())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=5)
+        return exe, main_prog, scope, loss
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(steps, 128, 256).astype("float32")
+    Y = rng.randn(steps, 128, 1).astype("float32")
+
+    def timed(label, fn, nsteps):
+        fn()  # warm (compile)
+        t0 = time.perf_counter()
+        fn()
+        dt = (time.perf_counter() - t0) / nsteps
+        print(f"{label:<24} step {dt * 1e3:8.3f} ms")
+        return dt
+
+    print(f"pipeline sweep: {steps} steps/config, MLP 256->512->512->1 "
+          f"batch 128")
+    for k in (1, 2, 4):
+        exe, prog, scope, loss = build()
+        feeds = [{"x": X[i], "y": Y[i]} for i in range(steps)]
+
+        def run_fused(k=k, exe=exe, prog=prog, scope=scope):
+            for i in range(0, steps, k):
+                if k == 1:
+                    exe.run(prog, feed=feeds[i], fetch_list=[], scope=scope)
+                else:
+                    exe.run_steps(prog, feed=feeds[i:i + k], fetch_list=[],
+                                  scope=scope)
+            jax.block_until_ready(scope.get(next(
+                n for n in scope.var_names())))
+
+        timed(f"run_steps k={k}", run_fused, steps)
+    for depth in (1, 2, 4):
+        exe, prog, scope, loss = build()
+
+        def reader():
+            for i in range(steps):
+                yield {"x": X[i], "y": Y[i]}
+
+        from paddle_tpu.reader import DevicePrefetcher
+        pf = DevicePrefetcher(lambda: reader(), depth=depth, program=prog)
+
+        def run_prefetched(pf=pf, exe=exe, prog=prog, scope=scope):
+            for feed in pf():
+                exe.run(prog, feed=feed, fetch_list=[], scope=scope)
+            jax.block_until_ready(scope.get(next(
+                n for n in scope.var_names())))
+
+        timed(f"prefetch depth={depth}", run_prefetched, steps)
+
+
 def main():
     layout = sys.argv[1] if len(sys.argv) > 1 else "nchw"
+    if layout == "pipeline":
+        pipeline_mode()
+        return
     rng = np.random.RandomState(0)
     params, blocks = init_params(rng, layout)
     dev = jax.devices()[0]

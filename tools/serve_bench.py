@@ -118,6 +118,10 @@ def main(argv=None):
     ap.add_argument("--max-batch-size", type=int, default=16)
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
     ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="dispatch pipeline depth (1 = synchronous dispatch, "
+                         "2 = overlap host prep with the in-flight device "
+                         "call)")
     ap.add_argument("--retries", type=int, default=None,
                     help="client retry budget (default: 0, or 8 with --chaos)")
     ap.add_argument("--deadline-ms", type=float, default=None,
@@ -156,7 +160,8 @@ def main(argv=None):
             server = ServingServer(
                 args.model_dir, max_batch_size=args.max_batch_size,
                 batch_timeout_ms=args.batch_timeout_ms,
-                queue_capacity=args.queue_capacity, warmup=True, chaos=chaos)
+                queue_capacity=args.queue_capacity,
+                pipeline_depth=args.pipeline_depth, warmup=True, chaos=chaos)
             endpoint = server.endpoint
             for n in server.engine.feed_names:
                 if n not in shapes:
@@ -197,6 +202,11 @@ def main(argv=None):
                   f"deadline_exceeded={s['deadline_exceeded']} "
                   f"failed={s['failed']} reloads={s['reloads']} "
                   f"weights_version={s.get('weights_version')}")
+            p = s.get("pipeline", {})
+            print(f"pipeline: depth={s.get('pipeline_depth')} "
+                  f"occupancy={p.get('device_queue_occupancy')} "
+                  f"occupancy_max={p.get('device_queue_occupancy_max')} "
+                  f"single_request_batches={s.get('single_request_batches')}")
             if "chaos" in s:
                 print(f"chaos: {s['chaos']}")
         return 0 if r["errors"] == 0 else 1
