@@ -1,16 +1,24 @@
 """Benchmark: flagship training throughput on one TPU chip (AMP bf16).
 
-Prints one JSON line per workload — transformer LM, then seq2seq NMT, then
-the ResNet-50 flagship LAST so tail-parsers that take the final JSON line
-get the BASELINE.json headline metric:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+Prints one JSON line per workload — transformer LM, seq2seq NMT,
+long-context LM (plain + remat-required config), sparse CTR, then the
+ResNet-50 flagship LAST so tail-parsers that take the final JSON line get
+the BASELINE.json headline metric:
+  {"metric": "...", "value": N, "unit": "...", "bar": {...},
+   "meets_bar": true, "vs_baseline": N, "vs_prev": N, ...}
 
 Workloads mirror benchmark/fluid/fluid_benchmark.py --model resnet /
-machine_translation (synthetic data, examples-per-sec metric,
-fluid_benchmark.py:295 print_train_time). vs_baseline compares against the
-reference's published numbers (BASELINE.md: ResNet-50 81.69 img/s on
-2xXeon 6148 MKL-DNN — the only published reference numbers; it has no
-TPU/GPU figures).
+machine_translation plus the BASELINE.json sparse-CTR class (synthetic
+data, examples-per-sec metric, fluid_benchmark.py:295 print_train_time).
+
+bench.py judges its own bars (VERDICT r5 item 7): every tracked metric
+carries its per-workload-class bar from BASELINE.md, ``meets_bar``, and
+``vs_baseline`` = measured / bar (the reference published no TPU numbers,
+so the in-repo roofline-derived bar IS the baseline — five rounds of
+``vs_baseline: null`` end here). The process exits NONZERO when any
+tracked metric misses its bar (beyond a 2% instrument-noise tolerance) or
+regresses >3% vs the previous round, so a drift cannot ship as a green
+round.
 
 MFU = analytic model FLOPs / step-time / chip peak (197 TFLOP/s bf16,
 TPU v5 lite). The chip's measured big-matmul rate is ~191 TFLOP/s
@@ -49,6 +57,23 @@ TLM_LAYERS = 8
 TLM_FF = 4096
 TLM_T = 1024
 TLM_BATCH = 8
+
+# sparse CTR (Wide&Deep over the SelectedRows path) at the scale where
+# sparsity pays: V>=1e6 rows with lane-aligned E>=128 (docs/perf.md
+# "Device-side SelectedRows": 4.14 vs 7.05 ms dense at V=1M/E=128 with
+# 16k gathered rows/step — exactly CTR_BATCH * CTR_SLOTS here)
+CTR_VOCAB = 1_000_000
+CTR_EMBED = 128
+CTR_SLOTS = 16
+CTR_DENSE = 13   # Criteo-style dense-feature width
+CTR_BATCH = 1024
+
+# remat-REQUIRED long-context config (second longcontext metric): at B=4 x
+# T=4096 the [N*T, V] f32 logits alone are 6.4 GB — the streamed head +
+# policy="flash" remat (which keeps the Pallas kernel outputs and replays
+# only projections/FFN glue) are not knobs here but requirements, so the
+# r5 checkpoint_name-split machinery carries a benched number
+LCR_BATCH = 4
 
 # fused steps per device call (Executor.run_steps scan window): the host
 # touches the program once per window instead of once per step, so the XLA
@@ -95,14 +120,52 @@ def _prev_results():
 _PREV = None
 REGRESSION_PCT = 0.03  # >3% drop vs the previous round is flagged loudly
 
+# Per-workload-class bars, taken from BASELINE.md ("Roofline-adjusted
+# ResNet-50 target", "Transformer-LM bar", "Per-class bars" table). bench.py
+# judges its own output against them (VERDICT r5 item 7). ``field`` names
+# the record entry the bar constrains (MFU for the roofline-derived
+# classes; raw examples/sec for CTR, whose cost is gather/scatter+host
+# tables, not MXU FLOPs — an MFU there would be noise dressed as a metric).
+BARS = {
+    "transformer_lm_train_tokens_per_sec_per_chip": {
+        "field": "mfu", "min": 0.60,
+        "source": "BASELINE.md transformer bar (~62-63% audited ceiling)"},
+    "seq2seq_nmt_train_tokens_per_sec_per_chip": {
+        "field": "mfu", "min": 0.33,
+        "source": "BASELINE.md seq2seq per-class bar (measured 33.6% r5)"},
+    "longcontext_lm_train_tokens_per_sec_per_chip": {
+        "field": "mfu", "min": 0.45,
+        "source": "BASELINE.md long-context bar (measured 49.6% r5)"},
+    "longcontext_remat_lm_train_tokens_per_sec_per_chip": {
+        "field": "mfu", "min": 0.30, "provisional": True,
+        "source": "BASELINE.md remat-required long-context bar (r6, "
+                  "provisional until a measured round tightens it)"},
+    "ctr_wide_deep_train_examples_per_sec_per_chip": {
+        "field": "value", "min": 60000.0, "provisional": True,
+        "source": "BASELINE.md sparse-CTR bar (r6, provisional)"},
+    "resnet50_train_images_per_sec_per_chip": {
+        "field": "mfu", "min": 0.17,
+        "source": "BASELINE.md ResNet-50 bandwidth-bound target (~20-21% "
+                  "ceiling)"},
+}
+# a bar miss inside the slope instrument's own noise band is tunnel
+# weather, not a defensible regression: 2% relative tolerance (the spread
+# quality gate in _slope_time retries at 15% of the median; r5 spreads ran
+# 0.1-4.8% of their steps)
+BAR_TOL = 0.02
+_FAILURES = []
+
 
 def _emit(rec):
-    """Print one metric line, self-compared against the previous round.
+    """Print one metric line, self-judged and self-compared.
 
-    ``vs_prev`` = value / previous round's value (the in-repo baseline the
-    judge asked bench.py to carry, VERDICT r4 item 6); a >3% drop sets
-    ``regression: true`` on the record AND warns on stderr so a drift like
-    r4's silent ResNet -2.2% can never ship unnoticed again."""
+    ``vs_prev`` = value / previous round's value (VERDICT r4 item 6); a
+    >3% drop sets ``regression: true``, warns on stderr, AND lands in
+    _FAILURES so main() exits nonzero. ``bar``/``meets_bar``/``vs_baseline``
+    come from BARS: vs_baseline is the measured value relative to its
+    BASELINE.md bar (the only baseline that exists for TPU — the
+    reference's 2017 CPU/GPU numbers stay as clearly-labelled history), and
+    a bar miss beyond BAR_TOL is a failure too."""
     global _PREV
     if _PREV is None:
         _PREV = _prev_results()
@@ -114,9 +177,23 @@ def _emit(rec):
         rec["prev_round"] = tag
         if ratio < 1.0 - REGRESSION_PCT:
             rec["regression"] = True
-            print(f"WARNING bench regression: {rec['metric']} "
-                  f"{rec['value']:.2f} vs {pv:.2f} ({tag}) = {ratio:.3f}x",
-                  file=sys.stderr)
+            msg = (f"bench regression: {rec['metric']} "
+                   f"{rec['value']:.2f} vs {pv:.2f} ({tag}) = {ratio:.3f}x")
+            _FAILURES.append(msg)
+            print("WARNING " + msg, file=sys.stderr)
+    bar = BARS.get(rec.get("metric"))
+    if bar is not None:
+        measured = rec.get(bar["field"])
+        rec["bar"] = dict(bar)
+        ok = bool(measured) and measured >= bar["min"] * (1.0 - BAR_TOL)
+        rec["meets_bar"] = ok
+        rec["vs_baseline"] = round(measured / bar["min"], 4) if measured \
+            else 0.0
+        if not ok:
+            msg = (f"bar miss: {rec['metric']} {bar['field']}="
+                   f"{measured} below bar {bar['min']} ({bar['source']})")
+            _FAILURES.append(msg)
+            print("WARNING " + msg, file=sys.stderr)
     print(json.dumps(rec))
 
 
@@ -177,6 +254,17 @@ def _host_dispatch_ms(run_step, fetch, steps_per_call=1):
         samples.append(time.perf_counter() - t0)
     fetch()  # flush what we queued
     return min(samples) / max(1, steps_per_call) * 1e3
+
+
+def lm_flops_per_token(d_model, n_layers, d_ff, t, vocab):
+    """Analytic transformer-LM FLOPs/token: 6*N (fwd+bwd matmul params) +
+    the causal-attention term. ONE definition shared by every LM metric
+    (transformer, longcontext, longcontext-remat) and the dW probe — the
+    MFU bars gate a nonzero bench exit, so the workloads must be judged
+    against the same FLOP model."""
+    n_params = n_layers * (4 * d_model * d_model + 2 * d_model * d_ff) \
+        + vocab * d_model
+    return 6 * n_params + 6 * n_layers * d_model * t
 
 
 def _step_closures(exe, prog, feed, scope, loss_var, k):
@@ -240,9 +328,8 @@ def bench_resnet():
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec",
-        # MFU is the number that matters; the 2017 dual-Xeon figure is kept
-        # only as a clearly-labelled historical reference, not a baseline
-        "vs_baseline": None,
+        # MFU carries the bar; the 2017 dual-Xeon figure is kept only as a
+        # clearly-labelled historical reference, not a baseline
         "vs_ref_cpu_2017": round(img_s / RESNET_BASELINE_IMG_S, 2),
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
@@ -325,7 +412,6 @@ def bench_seq2seq():
         "metric": "seq2seq_nmt_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 2),
         "unit": "tokens/sec",
-        "vs_baseline": None,  # the reference published no seq2seq throughput
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
@@ -335,13 +421,36 @@ def bench_seq2seq():
     })
 
 
+def _maybe_tune_dw(shapes):
+    """Adopt the Pallas dW-orientation matmul (ops/pallas_matmul.py) only
+    where a slope-timed on-chip A/B proves it faster than XLA's lowering —
+    the r5 audit's 114-160 TF/s dW shapes vs 176-180+ for the same shapes
+    in the fwd/dx orientation. The decision is a per-shape MEASUREMENT made
+    on the bench hardware every process (cached), never a belief: on a
+    non-TPU backend nothing routes and the stock path is byte-identical,
+    and an EXPLICIT flag choice — set_flag('pallas_dw_matmul', ...),
+    --pallas_dw_matmul=, or PT_FLAG_PALLAS_DW_MATMUL — always wins over
+    the tuner (only the untouched DEFAULT flips to 'auto'; an explicitly
+    chosen 'auto' still tunes)."""
+    from paddle_tpu import flags as ptflags
+    from paddle_tpu.ops import pallas_matmul
+
+    if (ptflags.get_flag("pallas_dw_matmul") == "off"
+            and not ptflags.is_set("pallas_dw_matmul")):
+        ptflags.set_flag("pallas_dw_matmul", "auto")
+    if ptflags.get_flag("pallas_dw_matmul") == "auto":
+        pallas_matmul.autotune(shapes)
+
+
 def build_transformer_lm(batch=None, k=1):
     """(run_step, fetch) for the transformer-LM bench workload."""
     import jax
 
     import paddle_tpu as fluid
     from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.ops.pallas_matmul import BENCH_DW_SHAPES
 
+    _maybe_tune_dw(BENCH_DW_SHAPES)
     batch = TLM_BATCH if batch is None else batch
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -376,16 +485,13 @@ def bench_transformer_lm():
     host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
     tokens = TLM_BATCH * TLM_T
     tok_s = tokens / step_time
-    # analytic FLOPs/token: 6*N (fwd+bwd matmuls) + causal attention term
-    n_params = (TLM_LAYERS * (4 * TLM_D * TLM_D + 2 * TLM_D * TLM_FF)
-                + TLM_VOCAB * TLM_D)
-    flops_per_token = 6 * n_params + 6 * TLM_LAYERS * TLM_D * TLM_T
+    flops_per_token = lm_flops_per_token(TLM_D, TLM_LAYERS, TLM_FF, TLM_T,
+                                         TLM_VOCAB)
     mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
     _emit({
         "metric": "transformer_lm_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 2),
         "unit": "tokens/sec",
-        "vs_baseline": None,  # net-new workload; no reference number exists
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
@@ -408,7 +514,9 @@ def build_longcontext_lm(k=1):
 
     import paddle_tpu as fluid
     from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.ops.pallas_matmul import LC_DW_SHAPES
 
+    _maybe_tune_dw(LC_DW_SHAPES)
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         ids = fluid.layers.data("ids", shape=[LC_T], dtype="int64")
@@ -452,15 +560,13 @@ def bench_longcontext_lm():
                                     steps_per_call=PIPE_K)
     host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
     tok_s = LC_BATCH * LC_T / step_time
-    n_params = (LC_LAYERS * (4 * LC_D * LC_D + 2 * LC_D * 4 * LC_D)
-                + LC_VOCAB * LC_D)
-    flops_per_token = 6 * n_params + 6 * LC_LAYERS * LC_D * LC_T
+    flops_per_token = lm_flops_per_token(LC_D, LC_LAYERS, 4 * LC_D, LC_T,
+                                         LC_VOCAB)
     mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
     _emit({
         "metric": "longcontext_lm_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 2),
         "unit": "tokens/sec",
-        "vs_baseline": None,
         "mfu": round(mfu, 4),
         "step_ms": round(step_time * 1e3, 2),
         "step_ms_spread": round(spread * 1e3, 2),
@@ -471,32 +577,222 @@ def bench_longcontext_lm():
     })
 
 
+def build_longcontext_remat_lm(k=1):
+    """(run_step, fetch) for the remat-REQUIRED long-context config: B=4 x
+    T=4096 x V=100k with the streamed head (fused_linear_cross_entropy) and
+    recompute_policy="flash" — the config class where the r5
+    checkpoint_name-split remat machinery is a requirement, not a knob (the
+    dense [N*T, V] f32 logits alone would be 6.4 GB)."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.ops.pallas_matmul import LCR_DW_SHAPES
+
+    # K = LCR_BATCH * LC_T = 16384 contracted rows here — NOT the B=1
+    # workload's 4096 — so this config tunes its own shape set
+    _maybe_tune_dw(LCR_DW_SHAPES)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        ids = fluid.layers.data("ids", shape=[LC_T], dtype="int64")
+        labels = fluid.layers.data("labels", shape=[LC_T], dtype="int64")
+        _, loss = transformer_lm(ids, labels, vocab_size=LC_VOCAB,
+                                 max_len=LC_T, d_model=LC_D, n_heads=8,
+                                 n_layers=LC_LAYERS, d_ff=4 * LC_D,
+                                 use_bias=False, fused_head=True,
+                                 use_recompute=True,
+                                 recompute_policy="flash")
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss, startup)
+
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=19)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    X = jax.device_put(
+        rng.randint(0, LC_VOCAB, (LCR_BATCH, LC_T)).astype("int32"), dev)
+    feed = {"ids": X, "labels": X}
+    return _step_closures(exe, main_prog, feed, scope, loss, k)
+
+
+def bench_longcontext_remat_lm():
+    """Second long-context metric (VERDICT r5 item 3): the remat-required
+    regime, so the flash-under-remat path carries a benched number instead
+    of only a probe ladder. The exact config is pinned in the JSON."""
+    run_step, fetch = build_longcontext_remat_lm(k=PIPE_K)
+    step_time, spread = _slope_time(run_step, fetch, warmup=2, iters=16,
+                                    steps_per_call=PIPE_K)
+    host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
+    tok_s = LCR_BATCH * LC_T / step_time
+    flops_per_token = lm_flops_per_token(LC_D, LC_LAYERS, 4 * LC_D, LC_T,
+                                         LC_VOCAB)
+    mfu = tok_s * flops_per_token / 1e12 / PEAK_TFLOPS
+    _emit({
+        "metric": "longcontext_remat_lm_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec",
+        "mfu": round(mfu, 4),
+        "step_ms": round(step_time * 1e3, 2),
+        "step_ms_spread": round(spread * 1e3, 2),
+        "window_k": PIPE_K,
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(step_time * 1e3, 2),
+        "config": {"B": LCR_BATCH, "T": LC_T, "V": LC_VOCAB,
+                   "n_layers": LC_LAYERS, "d_model": LC_D,
+                   "head": "fused_linear_cross_entropy",
+                   "recompute_policy": "flash"},
+    })
+
+
+def build_ctr(k=1):
+    """(run_step, fetch) for the sparse-CTR bench workload (Wide&Deep over
+    the SelectedRows path, models/ctr.py) — the fifth BASELINE workload
+    class. In-HBM table, unsharded, ``sparse_update=True``: the optimizer
+    touches only the step's 16k gathered rows of the [1M, 128] table."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.ctr import wide_deep_ctr
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        ids = fluid.layers.data("ids", shape=[CTR_SLOTS], dtype="int64")
+        dense = fluid.layers.data("dense", shape=[CTR_DENSE],
+                                  dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="float32")
+        avg_loss, _ = wide_deep_ctr(
+            ids, dense, label, sparse_vocab=CTR_VOCAB, embed_dim=CTR_EMBED,
+            hidden_sizes=(512, 256), shard_embeddings=False,
+            sparse_update=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_loss, startup)
+
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=29)
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    feed = {
+        "ids": jax.device_put(
+            rng.randint(0, CTR_VOCAB, (CTR_BATCH, CTR_SLOTS)).astype("int32"),
+            dev),
+        "dense": jax.device_put(
+            rng.randn(CTR_BATCH, CTR_DENSE).astype("float32"), dev),
+        "label": jax.device_put(
+            (rng.rand(CTR_BATCH, 1) > 0.5).astype("float32"), dev),
+    }
+    return _step_closures(exe, main_prog, feed, scope, avg_loss, k)
+
+
+def _exercise_host_table_ctr():
+    """Functionally exercise the beyond-HBM variant of the CTR tower: the
+    same slots/embed-dim through paddle_tpu.host_table (host-resident
+    table, HostTableSession gather -> device step -> sparse host update).
+    Three steps, returns the final loss (must be finite). Not slope-timed —
+    tools/probe_host_io.py owns the host-table numbers (672 -> 525 ms/step
+    prefetched at V=2M, docs/perf.md)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.host_table import (HostEmbeddingTable, HostTableSession,
+                                       host_embedding)
+
+    V, B = 200_000, 256
+    table = HostEmbeddingTable("bench_ctr_host", rows=V, dim=CTR_EMBED,
+                               lr=0.1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = fluid.layers.data("dense", shape=[CTR_DENSE],
+                                  dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="float32")
+        emb = host_embedding(table, batch_slots=CTR_SLOTS, program=main)
+        flat = fluid.layers.reshape(emb, [0, CTR_SLOTS * CTR_EMBED])
+        x = fluid.layers.concat([flat, dense], axis=1)
+        x = fluid.layers.fc(x, size=256, act="relu")
+        logit = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    place = fluid.default_place()
+    exe = fluid.Executor(place, amp=True)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=31)
+    sess = HostTableSession(exe, main, [table], scope=scope)
+    rng = np.random.RandomState(5)
+    last = None
+    for _ in range(3):
+        ids = rng.randint(0, V, (B, CTR_SLOTS)).astype("int64")
+        feed = {"dense": rng.randn(B, CTR_DENSE).astype("float32"),
+                "label": (rng.rand(B, 1) > 0.5).astype("float32")}
+        last = sess.run(feed=feed, ids={"bench_ctr_host": ids},
+                        fetch_list=[loss])
+    v = float(np.asarray(last[0]))
+    if not np.isfinite(v):
+        raise ValueError(f"host-table CTR loss not finite: {v}")
+    return v
+
+
+def bench_ctr():
+    """Sparse-CTR workload class (VERDICT r5 "Next round" item 2): in-HBM
+    SelectedRows variant slope-timed; the host-table variant run
+    functionally and reported on the same record."""
+    run_step, fetch = build_ctr(k=PIPE_K)
+    # small step (~5-8 ms expected) under tunnel jitter: long windows +
+    # extra reps, the seq2seq recipe
+    step_time, spread = _slope_time(run_step, fetch, warmup=3, iters=250,
+                                    reps=5, steps_per_call=PIPE_K)
+    host_ms = _host_dispatch_ms(run_step, fetch, steps_per_call=PIPE_K)
+    ex_s = CTR_BATCH / step_time
+    rec = {
+        "metric": "ctr_wide_deep_train_examples_per_sec_per_chip",
+        "value": round(ex_s, 2),
+        "unit": "examples/sec",
+        "step_ms": round(step_time * 1e3, 2),
+        "step_ms_spread": round(spread * 1e3, 2),
+        "window_k": PIPE_K,
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(step_time * 1e3, 2),
+        "config": {"B": CTR_BATCH, "slots": CTR_SLOTS, "V": CTR_VOCAB,
+                   "E": CTR_EMBED, "sparse_update": True,
+                   "rows_per_step": CTR_BATCH * CTR_SLOTS},
+    }
+    try:
+        rec["host_table_loss"] = round(_exercise_host_table_ctr(), 4)
+        rec["host_table"] = "ok"
+    except Exception as e:  # the in-HBM number must survive a host failure
+        rec["host_table"] = f"error: {str(e)[:120]}"
+        _FAILURES.append(f"ctr host-table variant failed: {str(e)[:120]}")
+    _emit(rec)
+
+
 def main():
+    for bench_fn, metric, unit in (
+            (bench_transformer_lm,
+             "transformer_lm_train_tokens_per_sec_per_chip", "tokens/sec"),
+            (bench_seq2seq,
+             "seq2seq_nmt_train_tokens_per_sec_per_chip", "tokens/sec"),
+            (bench_longcontext_lm,
+             "longcontext_lm_train_tokens_per_sec_per_chip", "tokens/sec"),
+            (bench_longcontext_remat_lm,
+             "longcontext_remat_lm_train_tokens_per_sec_per_chip",
+             "tokens/sec"),
+            (bench_ctr,
+             "ctr_wide_deep_train_examples_per_sec_per_chip",
+             "examples/sec"),
+    ):
+        try:
+            bench_fn()
+        except Exception as e:  # the flagship line must survive any failure
+            _emit({"metric": metric, "value": 0.0, "unit": unit,
+                   "error": str(e)[:200]})
     try:
-        bench_transformer_lm()
+        bench_resnet()
     except Exception as e:
-        _emit({
-            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/sec", "vs_baseline": None,
-            "error": str(e)[:200],
-        })
-    try:
-        bench_seq2seq()
-    except Exception as e:  # the flagship line must survive a seq2seq failure
-        _emit({
-            "metric": "seq2seq_nmt_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/sec", "vs_baseline": None,
-            "error": str(e)[:200],
-        })
-    try:
-        bench_longcontext_lm()
-    except Exception as e:
-        _emit({
-            "metric": "longcontext_lm_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/sec", "vs_baseline": None,
-            "error": str(e)[:200],
-        })
-    bench_resnet()
+        _emit({"metric": "resnet50_train_images_per_sec_per_chip",
+               "value": 0.0, "unit": "images/sec", "error": str(e)[:200]})
+    if _FAILURES:
+        print("BENCH FAILED its own bars:\n  " + "\n  ".join(_FAILURES),
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,19 @@ _DEFAULTS: Dict[str, Any] = {
     # print a one-line summary (block, feed signature, compile seconds) every
     # time a program (re)compiles — retrace-storm debugging
     "log_compile": False,
+    # route eligible fc/matmul weight grads through the Pallas dW-orientation
+    # kernel (ops/pallas_matmul.py). 'off' = stock XLA everywhere;
+    # 'auto' = only shapes a measured on-chip A/B (pallas_matmul.autotune)
+    # proved faster (routes nothing on non-TPU backends); 'direct' /
+    # 'transpose' = force that kernel strategy on every eligible shape.
+    # Set BEFORE the program first traces — routing is a trace-time choice.
+    "pallas_dw_matmul": "off",
+    # eligibility floor for the forced modes: contracted rows (K = batch*T)
+    # and min(d_in, d_out). Below these the dW matmul is too small for the
+    # orientation gap to matter (perf.md r5: the gap lives at K>=4096 with
+    # >=1024-wide outputs); tests lower them to route small shapes.
+    "pallas_dw_min_k": 4096,
+    "pallas_dw_min_mn": 512,
 }
 
 _flags: Dict[str, Any] = {}
@@ -54,6 +67,15 @@ def get_flag(name: str) -> Any:
     if name not in _DEFAULTS:
         raise KeyError(f"unknown flag {name!r}; known: {sorted(_DEFAULTS)}")
     return _flags.get(name, _DEFAULTS[name])
+
+
+def is_set(name: str) -> bool:
+    """True when ``name`` was set explicitly (set_flag / init_gflags / env
+    var) rather than riding its default — auto-configuration (e.g. bench's
+    dW autotune opt-in) uses this to never override a deliberate choice."""
+    if name not in _DEFAULTS:
+        raise KeyError(f"unknown flag {name!r}; known: {sorted(_DEFAULTS)}")
+    return name in _flags
 
 
 def set_flag(name: str, value: Any) -> None:

@@ -17,6 +17,7 @@ from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
 from . import pallas_attention  # noqa: F401
+from . import pallas_matmul  # noqa: F401
 from . import pipelined_stack  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import structured  # noqa: F401

@@ -304,7 +304,18 @@ def array_length(ctx, ins, attrs):
 RECOMPUTE_POLICIES = {
     None: None,
     "nothing": None,
-    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # 'dots' composes with the named dW-routed dot output: a dot routed
+    # through the pallas_dw custom_vjp (ops/pallas_matmul.py) is opaque to
+    # dots_with_no_batch_dims_saveable (the dot hides inside the custom_vjp
+    # call), so the name keeps the policy's meaning when the flag is on —
+    # without it, enabling the kernel would silently change what 'dots'
+    # saves and the backward would replay those matmuls.
+    "dots": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names("dw_mm_out")),
+    # 'flash' stays minimal on purpose: it saves ONLY the flash kernel
+    # outputs; projection/FFN dot outputs (dw_mm_out included) are exactly
+    # the activations the policy exists to drop.
     "flash": jax.checkpoint_policies.save_only_these_names(
         "flash_out", "flash_lse"),
     # dots_flash: keep matmul outputs AND the flash kernel outputs — the
@@ -313,7 +324,7 @@ RECOMPUTE_POLICIES = {
     "dots_flash": jax.checkpoint_policies.save_from_both_policies(
         jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         jax.checkpoint_policies.save_only_these_names(
-            "flash_out", "flash_lse")),
+            "flash_out", "flash_lse", "dw_mm_out")),
 }
 
 

@@ -44,6 +44,19 @@ def _dot_dtypes(ctx, *dtypes):
     return acc, acc
 
 
+def _routed_or_plain_dot(x2, y2, pref, store):
+    """2D dot, optionally through the Pallas-dW custom_vjp (the fc/matmul
+    weight-grad path, ops/pallas_matmul.py). Off-flag and non-float dots are
+    the stock XLA lowering, byte-identical to pre-flag behavior."""
+    if pref is not None:  # float dot: the dW routing may apply
+        from .pallas_matmul import routed_dot
+
+        out = routed_dot(x2, y2, store)
+        if out is not None:
+            return out
+    return jnp.dot(x2, y2, preferred_element_type=pref).astype(store)
+
+
 @register_op("mul", inputs=("X", "Y"), outputs=("Out",))
 def mul(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
@@ -51,9 +64,9 @@ def mul(ctx, ins, attrs):
     ync = attrs.get("y_num_col_dims", 1)
     pref, store = _dot_dtypes(ctx, x.dtype, y.dtype)
     x2, y2 = _amp_cast(ctx, _flatten2(x, xnc), _flatten2(y, ync))
-    out = jnp.dot(x2, y2, preferred_element_type=pref)
+    out = _routed_or_plain_dot(x2, y2, pref, store)
     out_shape = x.shape[:xnc] + y.shape[ync:]
-    return {"Out": [out.reshape(out_shape).astype(store)]}
+    return {"Out": [out.reshape(out_shape)]}
 
 
 @register_op("matmul", inputs=("X", "Y"), outputs=("Out",))
@@ -65,7 +78,10 @@ def matmul(ctx, ins, attrs):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     pref, store = _dot_dtypes(ctx, x.dtype, y.dtype)
     xc, yc = _amp_cast(ctx, x, y)
-    out = jnp.matmul(xc, yc, preferred_element_type=pref).astype(store)
+    if xc.ndim == 2 and yc.ndim == 2:
+        out = _routed_or_plain_dot(xc, yc, pref, store)
+    else:
+        out = jnp.matmul(xc, yc, preferred_element_type=pref).astype(store)
     alpha = attrs.get("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

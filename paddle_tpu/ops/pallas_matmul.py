@@ -1,0 +1,426 @@
+"""Pallas TPU dW-orientation matmul (the backward-pass weight-grad kernel).
+
+Why this exists (docs/perf.md "Transformer LM round 5"): the transformer
+bench's forward and dx matmuls run at 176-180+ TF/s, but the SAME shapes in
+the dW orientation — ``dW = X^T @ dOut``, contracting over the batch*time
+rows — measure 114-129 TF/s (LM head, [1024, 32000] out with K=8192 rows)
+and 146-160 (FFN). That 2-4 ms/step gap is XLA's lowering of the
+rows-contracted dot, and the r5/r6 BASELINE bar treated it as "outside what
+a framework above XLA controls". This module is the in-scope experiment the
+round-5 verdict asked for: a hand-scheduled Pallas kernel that accumulates
+``A^T @ B`` directly in MXU-friendly tiles, in the spirit of hand-tuned
+kernels beating vendor lowerings (CUDA-L2, arxiv 2512.02551) and high-level
+tiling abstractions recovering HPC rates (arxiv 2304.12576).
+
+Two strategies ship, because the mechanism hypothesis has two sides:
+
+* ``direct``  — each grid cell issues ``dot_general`` with BOTH operands
+  contracting on dim 0 (the dW orientation) over [bk, bm] x [bk, bn] VMEM
+  tiles; Mosaic feeds the MXU from the sublane dim. If XLA's slowness is
+  scheduling (tile choice / HBM streaming), this wins.
+* ``transpose`` — the "fast-orientation sibling with a cheap fixup": each
+  A tile is relayouted [bk, bm] -> [bm, bk] IN VMEM and the cell runs the
+  standard [bm, bk] @ [bk, bn] orientation. If Mosaic's dim-0-contraction
+  lowering is itself the tax (r4 measured in-kernel ``swapaxes`` at 2.7x
+  the HBM fold it replaced — in the attention kernels), this bounds it:
+  the relayout touches only a [bk, bm] VMEM tile, never HBM.
+
+Block shapes come from ``plan_blocks``: an exhaustive search over aligned
+divisors minimizing HBM traffic (A is re-read once per N-tile, B once per
+M-tile) under a VMEM budget — the planner is what makes the head-dW shape
+([8192, 1024]^T @ [8192, 32000]) compute-bound (~1.0 GB moved vs the naive
+512-tile plan's ~3 GB, against a 2.8 ms MXU floor at 190 TF/s).
+
+Routing is opt-in via ``flags.pallas_dw_matmul`` and goes through a
+``jax.custom_vjp`` whose FORWARD is the stock XLA dot (that orientation
+already runs at peak) and whose backward computes dX via XLA and dW via the
+Pallas kernel. The forward output carries ``checkpoint_name`` so selective
+remat policies can keep it (remat-safe, like ops/pallas_attention.py).
+Because this session's hot-path adoption is decided by measurement, the
+``auto`` mode runs a slope-timed on-chip A/B per shape ONCE per process
+(``autotune``) and routes only the shapes where a Pallas strategy beats XLA
+by the margin — on a CPU/interpret backend it routes nothing, so the stock
+path is byte-identical there.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .. import flags
+from .pallas_attention import _interpret_default
+
+try:  # pltpu is TPU-plugin-scoped; interpret mode never touches it
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - exotic jax builds
+    pltpu = None
+
+# the audited bench dW shapes (m = d_in, n = d_out, k = contracted rows):
+# LM head dW, FFN up/down dW, attention projection dW at T=1024 bs8, and
+# their longcontext (T=4096, B=1, V=100352) siblings
+BENCH_DW_SHAPES = (
+    (1024, 32000, 8192),   # head dW: 114-129 TF/s under XLA (perf.md r5)
+    (1024, 4096, 8192),    # FFN up dW: 146-160
+    (4096, 1024, 8192),    # FFN down dW
+    (1024, 1024, 8192),    # q/k/v/out projection dW
+)
+LC_DW_SHAPES = (
+    (1024, 100352, 4096),
+    (1024, 4096, 4096),
+    (4096, 1024, 4096),
+    (1024, 1024, 4096),
+)
+# the remat-required longcontext bench (B=4 x T=4096 -> K=16384 rows); its
+# head runs through the streamed-CE op, so only projection/FFN dWs route
+LCR_DW_SHAPES = (
+    (1024, 4096, 16384),
+    (4096, 1024, 16384),
+    (1024, 1024, 16384),
+)
+
+# VMEM working-set budget for the planner: block inputs are double-buffered
+# by the Pallas pipeline, and the f32 accumulator + output tile are resident.
+# ~12 MB of the ~16 MB/core leaves room for Mosaic's own staging.
+_VMEM_BUDGET = 12 * 1024 * 1024
+_SMALL_SINGLE_BLOCK = 1 << 20  # total elements below which one block is fine
+
+
+def _aligned_divisors(n, align, cap):
+    """Divisors of ``n`` that are multiples of ``align``, capped, descending."""
+    out = []
+    for b in range(min(n, cap), 0, -align):
+        if b % align == 0 and n % b == 0:
+            out.append(b)
+    return out
+
+
+def plan_blocks(m, n, k, in_bytes=2, out_bytes=2):
+    """(bm, bn, bk) minimizing HBM traffic under the VMEM budget, or None.
+
+    Traffic model: the A operand ([k, m]) is streamed once per N-tile and B
+    ([k, n]) once per M-tile, so  bytes = k*m*(n/bn) + k*n*(m/bm) + m*n
+    (times element sizes). VMEM holds double-buffered [bk, bm] + [bk, bn]
+    input tiles, the f32 [bm, bn] accumulator, and the output tile. All
+    dims must split into lane-aligned (x128) divisors — a shape with no
+    aligned split (truly ragged) returns None and the caller keeps the XLA
+    path, mirroring the ``_fit_block`` contract in pallas_attention."""
+    if min(m, n, k) <= 0:
+        return None
+    if m * k + k * n + m * n <= _SMALL_SINGLE_BLOCK:
+        # small operands: one cell, whole arrays (Mosaic pads internally) —
+        # the correctness/test regime; eligibility gates keep it off hot paths
+        return (m, n, k)
+    bms = _aligned_divisors(m, 128, 4096)
+    bns = _aligned_divisors(n, 128, 4096)
+    bks = _aligned_divisors(k, 128, 2048)
+    if not (bms and bns and bks):
+        return None
+    best, best_cost = None, None
+    for bm in bms:
+        for bn in bns:
+            acc_bytes = 4 * bm * bn + out_bytes * bm * bn
+            for bk in bks:
+                vmem = 2 * in_bytes * bk * (bm + bn) + acc_bytes
+                if vmem > _VMEM_BUDGET:
+                    continue
+                traffic = in_bytes * (k * m * (n // bn) + k * n * (m // bm))
+                # tie-break toward bigger k blocks (fewer grid cells)
+                cost = (traffic, (m // bm) * (n // bn) * (k // bk))
+                if best_cost is None or cost < best_cost:
+                    best, best_cost = (bm, bn, bk), cost
+    return best
+
+
+def _dw_kernel(a_ref, b_ref, o_ref, acc_ref, *, nk, transpose):
+    """One (i, j, k) grid cell: acc[i,j] += A[k,i]^T @ B[k,j].
+
+    The grid's last dim (k) iterates fastest and the output block index
+    does not depend on it, so the f32 accumulator lives in VMEM across the
+    whole K loop and the bf16 output tile is written exactly once."""
+    ki = pl.program_id(2)
+    a = a_ref[...]  # [bk, bm] native dtype (bf16 under AMP)
+    b = b_ref[...]  # [bk, bn]
+    if transpose:
+        # fast-orientation sibling: relayout the A tile in VMEM, then the
+        # standard (1,),(0,) contraction the MXU pipeline is tuned for
+        prod = lax.dot_general(
+            jnp.swapaxes(a, 0, 1), b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        # dW orientation on the MXU: contract dim 0 of both operands
+        prod = lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[...] = prod
+
+    @pl.when(ki > 0)
+    def _():
+        acc_ref[...] += prod
+
+    @pl.when(ki == nk - 1)
+    def _():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def dw_matmul(a, b, *, strategy="direct", out_dtype=None, blocks=None,
+              interpret=None):
+    """``A^T @ B`` with f32 accumulation: a [K, M], b [K, N] -> [M, N].
+
+    This is the dW-orientation contraction itself — no input transposes in
+    HBM. ``strategy``: 'direct' (dim-0 contraction in-cell) or 'transpose'
+    (in-VMEM tile relayout + fast orientation). Falls back to the XLA
+    lowering when the planner finds no aligned tiling."""
+    if a.ndim != 2 or b.ndim != 2 or a.shape[0] != b.shape[0]:
+        raise ValueError(f"dw_matmul wants [K,M]x[K,N], got {a.shape} {b.shape}")
+    if strategy not in ("direct", "transpose"):
+        raise ValueError(f"unknown dw_matmul strategy {strategy!r}")
+    k, m = a.shape
+    n = b.shape[1]
+    out_dtype = jnp.dtype(out_dtype or a.dtype)
+    if interpret is None:
+        interpret = _interpret_default()
+    in_bytes = jnp.dtype(a.dtype).itemsize
+    plan = blocks or plan_blocks(m, n, k, in_bytes, out_dtype.itemsize)
+    if plan is None:
+        return lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32
+                               ).astype(out_dtype)
+    bm, bn, bk = plan
+    if m % bm or n % bn or k % bk:
+        # an explicit blocks= tuple must tile exactly — a truncated grid
+        # would silently drop the tail rows' contribution to the grad
+        raise ValueError(f"blocks {plan} do not divide operands "
+                         f"[{k},{m}]x[{k},{n}]")
+    nk = k // bk
+    if pltpu is None:  # pragma: no cover - pltpu ships with jax
+        return lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32
+                               ).astype(out_dtype)
+    kernel = functools.partial(_dw_kernel, nk=nk, transpose=(strategy ==
+                                                             "transpose"))
+    scratch = [pltpu.VMEM((bm, bn), jnp.float32)]
+    return pl.pallas_call(
+        kernel,
+        grid=(m // bm, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bk, bm), lambda i, j, ki: (ki, i)),
+            pl.BlockSpec((bk, bn), lambda i, j, ki: (ki, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, ki: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=in_bytes * (k * m * (n // bn) + k * n * (m // bm))
+            + out_dtype.itemsize * m * n,
+            transcendentals=0),
+        interpret=interpret,
+    )(a, b)
+
+
+# ---------------------------------------------------------------------------
+# differentiable entry point: stock-XLA forward, Pallas dW backward
+# ---------------------------------------------------------------------------
+
+# counts dot_dw routings in the current process — the opt-out test's witness
+# that the flag cleanly restores the stock path (and the probe's sanity line)
+route_count = 0
+
+
+def _fwd_dot(x, y, store):
+    pref = jnp.float32 if jnp.issubdtype(jnp.dtype(store), jnp.floating) \
+        else None
+    return jnp.dot(x, y, preferred_element_type=pref).astype(store)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def dot_dw(x, y, store, strategy):
+    """x [R, M] @ y [M, N] whose vjp computes dY with the Pallas dW kernel.
+
+    ``store``: output dtype name (bf16 under AMP — matches the stock path's
+    fused store). ``strategy``: dw_matmul strategy for the backward. The
+    forward IS the stock XLA dot: that orientation already runs at peak;
+    only the rows-contracted weight grad is re-scheduled."""
+    return _fwd_dot(x, y, store)
+
+
+def _dot_dw_fwd(x, y, store, strategy):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = _fwd_dot(x, y, store)
+    # named for selective remat (save_only_these_names): policies composed
+    # in ops/control_flow.RECOMPUTE_POLICIES can keep the dot output so the
+    # segment replay never re-runs it — same recipe as flash_out/flash_lse
+    out = checkpoint_name(out, "dw_mm_out")
+    return out, (x, y)
+
+
+def _dot_dw_bwd(store, strategy, res, g):
+    x, y = res
+    global route_count
+    route_count += 1
+    # dX: fast orientation ([R, N] x [M, N]^T contracting n) — XLA's own
+    # lowering measures 162-180 TF/s on the bench shapes; leave it alone
+    dx = lax.dot_general(g, y, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+    # dW: the rows-contracted orientation XLA runs at 114-160 TF/s
+    dy = dw_matmul(x, g, strategy=strategy, out_dtype=y.dtype)
+    return dx, dy
+
+
+dot_dw.defvjp(_dot_dw_fwd, _dot_dw_bwd)
+
+
+# ---------------------------------------------------------------------------
+# routing: consulted by the mul/matmul registry kernels
+# ---------------------------------------------------------------------------
+
+# shape -> winning strategy, filled by autotune() (mode 'auto') — (m, n, k)
+# keys in dW terms: m = x columns (d_in), n = y columns (d_out), k = rows
+_PLAN = {}
+_AUTOTUNED = set()
+
+
+def routed_dot(x2, y2, store):
+    """The flag-gated dot for the fc/matmul kernels: returns the dot with a
+    Pallas-dW backward when routing applies, else None (caller keeps the
+    stock path). x2 [R, M] @ y2 [M, N]."""
+    mode = flags.get_flag("pallas_dw_matmul")
+    if mode == "off":
+        return None
+    if x2.ndim != 2 or y2.ndim != 2:
+        return None
+    if not (jnp.issubdtype(x2.dtype, jnp.floating)
+            and jnp.issubdtype(y2.dtype, jnp.floating)):
+        return None
+    if (jnp.dtype(x2.dtype).itemsize > 4 or jnp.dtype(y2.dtype).itemsize > 4):
+        # f64 programs (x64 mode) keep the stock path: the MXU has no f64
+        # and this pipeline accumulates f32 — routing would silently
+        # downgrade an f64 dot's accumulation precision
+        return None
+    r, m = x2.shape
+    n = y2.shape[1]
+    if mode == "auto":
+        strategy = _PLAN.get((m, n, r))
+        if strategy is None:
+            return None
+    elif mode in ("direct", "transpose"):
+        if (r < flags.get_flag("pallas_dw_min_k")
+                or min(m, n) < flags.get_flag("pallas_dw_min_mn")):
+            return None
+        if plan_blocks(m, n, r, jnp.dtype(x2.dtype).itemsize) is None:
+            return None
+        strategy = mode
+    else:
+        raise ValueError(
+            f"pallas_dw_matmul flag must be off/auto/direct/transpose, "
+            f"got {mode!r}")
+    return dot_dw(x2, y2, str(jnp.dtype(store)), strategy)
+
+
+# ---------------------------------------------------------------------------
+# on-chip autotune: the adoption decision is a measurement, not a belief
+# ---------------------------------------------------------------------------
+
+
+def measure_dw(m, n, k, dtype=jnp.bfloat16, iters=12, reps=3):
+    """Slope-timed ms/call for {xla, direct, transpose} on one dW shape,
+    via the shared chained-window instrument (profiler.chained_slope_ms).
+
+    Serialization: each iteration scales A by (1 + out[0,0]*1e-30) —
+    numerically identity in bf16 but a real data dependency, so XLA can
+    neither DCE a call nor hoist the loop-invariant dot (the failure mode
+    behind the r4 425%-"MFU" microbench artifact)."""
+    import numpy as np
+
+    from ..profiler import chained_slope_ms
+
+    rng = np.random.RandomState(0)
+    a0 = jnp.asarray(rng.randn(k, m), dtype)
+    b0 = jnp.asarray(rng.randn(k, n), dtype)
+
+    def window_for(fn):
+        def window(n_calls):
+            @jax.jit
+            def run(a, b):
+                def body(_, carry):
+                    a, s = carry
+                    o = fn(a, b)
+                    s = o[0, 0].astype(jnp.float32)
+                    a = (a * (1.0 + s * 1e-30).astype(a.dtype))
+                    return a, s
+                _, s = lax.fori_loop(0, n_calls, body, (a, jnp.float32(0.0)))
+                return s
+            return run
+        return window
+
+    fns = {
+        "xla": lambda a, b: lax.dot_general(
+            a, b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dtype),
+        "direct": lambda a, b: dw_matmul(a, b, strategy="direct",
+                                         out_dtype=dtype),
+        "transpose": lambda a, b: dw_matmul(a, b, strategy="transpose",
+                                            out_dtype=dtype),
+    }
+    return {name: chained_slope_ms(window_for(fn), iters=iters, reps=reps,
+                                   args=(a0, b0))
+            for name, fn in fns.items()}
+
+
+def autotune(shapes=BENCH_DW_SHAPES, dtype=jnp.bfloat16, margin=0.95,
+             verbose=True):
+    """Measure XLA vs Pallas per dW shape ON THE CURRENT BACKEND and route
+    only the shapes where a Pallas strategy wins by ``margin``. Fills the
+    plan consulted by flag mode 'auto'; once per process per shape. On a
+    non-TPU backend (interpret mode) nothing is measured or routed — the
+    stock path stays byte-identical, so tests and CPU runs are unaffected.
+
+    Kernel-level microbenches were unstable under tunnel weather in r4, so
+    the margin is deliberately wide (a 5% win on a 2.8-4.4 ms call is far
+    outside the slope's noise) and the model-level probe
+    (tools/probe_dw_matmul.py model) stays the authoritative instrument."""
+    todo = [s for s in shapes if s not in _AUTOTUNED]
+    if not todo:
+        return dict(_PLAN)
+    if _interpret_default():
+        _AUTOTUNED.update(todo)
+        if verbose:
+            print("DW_AUTOTUNE no TPU backend: stock XLA path keeps all "
+                  "dW matmuls", file=sys.stderr)
+        return dict(_PLAN)
+    for (m, n, k) in todo:
+        _AUTOTUNED.add((m, n, k))
+        try:
+            res = measure_dw(m, n, k, dtype)
+        except Exception as e:  # never let the tuner kill a bench round
+            if verbose:
+                print(f"DW_AUTOTUNE ({m},{n},{k}) failed: {e}",
+                      file=sys.stderr)
+            continue
+        best = min(("direct", "transpose"), key=lambda s: res[s])
+        tfs = 2 * m * n * k / 1e9  # GFLOP -> TF/s when divided by ms
+        if res[best] < margin * res["xla"]:
+            _PLAN[(m, n, k)] = best
+        if verbose:
+            print(f"DW_AUTOTUNE ({m},{n},{k}): "
+                  + " ".join(f"{s}={res[s]:.3f}ms/{tfs / res[s]:.0f}TFs"
+                             for s in ("xla", "direct", "transpose"))
+                  + f" -> {_PLAN.get((m, n, k), 'xla')}", file=sys.stderr)
+    return dict(_PLAN)
+
+
+def reset(plan=None):
+    """Test/probe hook: drop the plan + autotune memo (optionally install
+    an explicit {shape: strategy} plan for flag mode 'auto')."""
+    _PLAN.clear()
+    _AUTOTUNED.clear()
+    if plan:
+        _PLAN.update(plan)

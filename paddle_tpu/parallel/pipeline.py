@@ -40,6 +40,7 @@ Restrictions (deliberate, minimal-but-real):
 """
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable
 
 import jax
@@ -159,6 +160,24 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
     mb = batch // dp_total // microbatches
     M = microbatches
     S = n_stages
+    if S > 1 and M <= 2 * S:
+        # Selection rule (measured, docs/perf.md "1F1B head gating"): 1F1B
+        # pays a per-tick vjp forward replay that only amortizes when
+        # M >> S. At S=4 the measured points bracket the crossover: M=8
+        # (= 2S) was 1.16x SLOWER than GPipe-remat and M=32 (= 8S) was
+        # 0.80x (20% faster) — so M == 2S is still on the losing side and
+        # warns too; the crossover lies somewhere in (2S, 8S). Below it,
+        # GPipe-remat wins on time and 1F1B's O(S) residency buys little
+        # (GPipe's O(M) stash is small when M is).
+        warnings.warn(
+            f"one_f_one_b with M={M} microbatches over S={S} stages: "
+            f"M <= 2S is a regime where GPipe-remat measured FASTER "
+            f"(1F1B 1.16x slower at M=8/S=4; first measured-faster point "
+            f"M=32/S=4 at 0.80x; docs/perf.md '1F1B head gating'). Prefer "
+            f"gpipe(remat=True) here unless the O(S) activation residency "
+            f"is the point, or raise microbatches toward >= {8 * S} (the "
+            f"measured-faster regime, M >> S).",
+            RuntimeWarning, stacklevel=2)
     stash_len = 2 * S  # >= max in-flight 2(S-1)+1
 
     def local(params, head_p, x, labels):

@@ -150,3 +150,42 @@ def slope_time(run_step, fetch, warmup: int = 5, iters: int = 50,
     if step <= 0:
         step = t2 / n2
     return step
+
+
+def chained_slope_ms(window, iters: int = 12, reps: int = 3, args=()):
+    """Per-call milliseconds of a chained-kernel microbench via the slope
+    of a 1x vs 4x window — the kernel-level sibling of ``slope_time``.
+
+    ``window(n)`` must return a jitted callable running ``n`` serialized
+    calls and returning a SCALAR that depends on every call (the caller
+    builds the data-dependency chain — e.g. scaling an input by
+    ``1 + out[0, 0] * 1e-30``, numerically identity but un-hoistable — so
+    XLA can neither DCE a call nor lift it out of the loop: the r4 lesson
+    where an unused output produced a 425%-"MFU" artifact). The scalar is
+    fetched with ``float()`` to close the async dispatch chain (tunneled
+    backends return from block_until_ready early). The slope
+    ((t_4x - t_1x) / 3n) cancels per-window fixed costs; median of
+    ``reps``. Shared by pallas_matmul.measure_dw / autotune and
+    tools/probe_fa_gap.py so every kernel A/B uses one methodology."""
+    r1, r4 = window(iters), window(4 * iters)
+    float(r1(*args))  # compile + warm both windows
+    float(r4(*args))
+    slopes, big_means = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(r1(*args))
+        t1 = time.perf_counter()
+        float(r4(*args))
+        t2 = time.perf_counter()
+        slopes.append(((t2 - t1) - (t1 - t0)) / (3 * iters))
+        big_means.append((t2 - t1) / (4 * iters))
+    slopes.sort()
+    med = slopes[len(slopes) // 2]
+    if med <= 0:
+        # a jitter burst under the 1x window can make the 4x window time
+        # "faster"; a non-positive slope is meaningless and — fed raw into
+        # autotune — would trivially pass any adoption margin. Same guard
+        # as slope_time: fall back to the large-window mean.
+        big_means.sort()
+        med = big_means[len(big_means) // 2]
+    return med * 1e3

@@ -332,8 +332,12 @@ def test_recompute_policy_dots_matches_inline():
         return str(jax.make_jaxpr(step)(
             {"x": X, "y": Y}, params, state, jax.random.PRNGKey(0)))
 
-    assert "dots_with_no_batch_dims_saveable" in jaxpr_text("dots")
-    assert "dots_with_no_batch_dims_saveable" not in jaxpr_text("nothing")
+    # 'dots' is save_from_both_policies(dots_saveable, names('dw_mm_out'))
+    # since the dW-routing work (ops/pallas_matmul.py): the structural
+    # witness is the composed policy on the checkpoint eqn — 'nothing'
+    # carries no policy at all
+    assert "save_from_both_policies" in jaxpr_text("dots")
+    assert "policy=None" in jaxpr_text("nothing")
 
     with pytest.raises(ValueError, match="unknown recompute policy"):
         fluid.layers.recompute(policy="bogus")

@@ -1160,6 +1160,37 @@ def test_one_f_one_b_head_runs_under_stage_local_cond():
     assert "cond" in str(jaxpr), "head must be gated under lax.cond"
 
 
+def test_one_f_one_b_warns_below_crossover():
+    """VERDICT r5 item 9: the 1F1B/GPipe selection rule is enforced at
+    runtime — M <= 2S (a measured GPipe-remat-faster point: 1F1B 1.16x
+    slower at M=8/S=4; the first measured-faster point is M=32 at 0.80x,
+    docs/perf.md '1F1B head gating') emits a RuntimeWarning citing the
+    crossover; M well above it (8S) stays silent."""
+    import warnings as _warnings
+
+    import jax
+
+    from paddle_tpu.parallel.pipeline import one_f_one_b
+
+    S = 2
+    stage_params, head, x, lbl = _mk_1f1b_case(S=S, B=16)
+    mesh = make_mesh({"pp": S}, devices=jax.devices("cpu")[:S])
+
+    def loss_grad_fn(hp, y_mb, lbl_mb):
+        loss, (dhp, dy) = jax.value_and_grad(
+            _mlp_head, argnums=(0, 1))(hp, y_mb, lbl_mb)
+        return loss, dy, dhp
+
+    with pytest.warns(RuntimeWarning, match="GPipe-remat measured FASTER"):
+        one_f_one_b(_mlp_stage, loss_grad_fn, stage_params, head, x, lbl,
+                    mesh, microbatches=2 * S)  # M=4 == 2S: still losing side
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", RuntimeWarning)
+        one_f_one_b(_mlp_stage, loss_grad_fn, stage_params, head, x, lbl,
+                    mesh, microbatches=8 * S)  # M=16/S=2: M >> S, silent
+
+
 @pytest.mark.slow
 def test_one_f_one_b_dp_composition():
     """dp x pp: per-shard batches, grads match the single-mesh oracle."""

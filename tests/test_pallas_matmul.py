@@ -1,0 +1,343 @@
+"""Tests for ops/pallas_matmul.py — the dW-orientation Pallas matmul.
+
+Covers the ISSUE-6 contract: numeric parity vs the XLA dW path (f32 exact,
+bf16-policy tolerance), gradient check through the tests/op_test.py harness
+(the op runs inside the real Executor + append_backward), a remat-split
+structure test mirroring test_flash_ring_under_remat /
+test_recompute_policy_flash_saves_kernel_outputs, and an opt-out test
+proving the flag cleanly restores the stock path. All kernels run in
+interpret mode off-TPU, so numerics here bind the on-chip behavior.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags
+from paddle_tpu.ops import pallas_matmul
+from paddle_tpu.ops.pallas_matmul import (dot_dw, dw_matmul, plan_blocks,
+                                          routed_dot)
+
+
+@pytest.fixture
+def dw_flags():
+    """Force-route every eligible dot through the Pallas dW kernel for the
+    duration of a test, restoring the stock defaults afterwards."""
+    saved = {k: flags.get_flag(k) for k in
+             ("pallas_dw_matmul", "pallas_dw_min_k", "pallas_dw_min_mn")}
+    flags.set_flag("pallas_dw_min_k", 4)
+    flags.set_flag("pallas_dw_min_mn", 2)
+    try:
+        yield flags
+    finally:
+        flags.set_flags(saved)
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+def test_plan_blocks_bench_shapes_align_and_fit():
+    for (m, n, k) in pallas_matmul.BENCH_DW_SHAPES + pallas_matmul.LC_DW_SHAPES:
+        plan = plan_blocks(m, n, k)
+        assert plan is not None, (m, n, k)
+        bm, bn, bk = plan
+        assert m % bm == 0 and n % bn == 0 and k % bk == 0
+        assert bm % 128 == 0 and bn % 128 == 0 and bk % 128 == 0
+        # the VMEM working set the kernel declares must fit the budget
+        assert (2 * 2 * bk * (bm + bn) + 6 * bm * bn
+                <= pallas_matmul._VMEM_BUDGET)
+
+
+def test_plan_blocks_small_is_single_block_and_ragged_large_is_none():
+    assert plan_blocks(32, 16, 24) == (32, 16, 24)  # small: one padded cell
+    # large with a prime K: no aligned divisor anywhere -> None (caller
+    # keeps the XLA path — the _fit_block contract)
+    assert plan_blocks(1024, 1024, 1021 * 7) is None
+
+
+# ---------------------------------------------------------------------------
+# kernel numerics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("strategy", ["direct", "transpose"])
+def test_dw_matmul_parity_f32(strategy):
+    rng = np.random.RandomState(0)
+    a = rng.randn(24, 32).astype("float32")
+    b = rng.randn(24, 16).astype("float32")
+    got = np.asarray(dw_matmul(a, b, strategy=strategy,
+                               out_dtype=np.float32))
+    np.testing.assert_allclose(got, a.T @ b, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("strategy", ["direct", "transpose"])
+def test_dw_matmul_parity_blocked_bf16(strategy):
+    """Multi-block accumulation over the K grid, bf16 operands with f32
+    accumulation (the AMP policy): must match the f32 reference to bf16
+    input-rounding tolerance, and the two strategies must agree exactly
+    (same products, same accumulation order over K blocks)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.randn(512, 256), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(512, 384), jnp.bfloat16)
+    ref = np.asarray(a, np.float32).T @ np.asarray(b, np.float32)
+    got = np.asarray(dw_matmul(a, b, strategy=strategy,
+                               out_dtype=jnp.float32,
+                               blocks=(128, 128, 128)))
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 2e-6
+    other = "transpose" if strategy == "direct" else "direct"
+    got2 = np.asarray(dw_matmul(a, b, strategy=other, out_dtype=jnp.float32,
+                                blocks=(128, 128, 128)))
+    np.testing.assert_array_equal(got, got2)
+
+
+def test_dw_matmul_matches_xla_dw_orientation():
+    """Parity against the exact XLA computation the kernel replaces: the
+    dim-0-contracted dot_general with f32 accumulate, bf16 store."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.RandomState(2)
+    a = jnp.asarray(rng.randn(256, 128), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(256, 128), jnp.bfloat16)
+    xla = np.asarray(lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16),
+        dtype=np.float32)
+    pal = np.asarray(dw_matmul(a, b, strategy="direct",
+                               out_dtype=jnp.bfloat16,
+                               blocks=(128, 128, 128)), dtype=np.float32)
+    # identical f32 accumulation, one bf16 rounding each side
+    np.testing.assert_allclose(pal, xla, rtol=1e-2, atol=1e-2)
+
+
+def test_dw_matmul_rejects_bad_shapes():
+    a = np.zeros((8, 4), "float32")
+    with pytest.raises(ValueError):
+        dw_matmul(a, np.zeros((9, 4), "float32"))
+    with pytest.raises(ValueError):
+        dw_matmul(a, np.zeros((8, 4), "float32"), strategy="sideways")
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp: grads equal the stock path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("strategy", ["direct", "transpose"])
+def test_dot_dw_grads_match_plain_dot(strategy):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(40, 32), jnp.float32)
+    y = jnp.asarray(rng.randn(32, 48), jnp.float32)
+    c = jnp.asarray(rng.randn(40, 48), jnp.float32)
+    gx1, gy1 = jax.grad(
+        lambda x, y: jnp.sum(dot_dw(x, y, "float32", strategy) * c),
+        argnums=(0, 1))(x, y)
+    gx2, gy2 = jax.grad(lambda x, y: jnp.sum((x @ y) * c),
+                        argnums=(0, 1))(x, y)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gy1), np.asarray(gy2), rtol=1e-5)
+
+
+def test_mul_grad_through_op_test_harness(dw_flags):
+    """The IR-level gradient contract: a 'mul' op with the dW routing
+    forced passes the central-difference vs analytic check through the
+    real Executor (op_test.py harness — the same append_backward +
+    generic-vjp path the transformer's fc layers take)."""
+    from tests.op_test import OpTest
+
+    class MulDW(OpTest):
+        op_type = "mul"
+
+        def setup(self):
+            rng = np.random.RandomState(7)
+            x = rng.uniform(-1, 1, (16, 8)).astype("float64")
+            y = rng.uniform(-1, 1, (8, 12)).astype("float64")
+            self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+            self.outputs = {"Out": [("out", x @ y)]}
+            self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+
+    dw_flags.set_flag("pallas_dw_matmul", "direct")
+    t = MulDW()
+    t.check_output()
+    t.check_grad(["x", "y"], "out", max_relative_error=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# remat behavior (mirrors test_flash_ring_under_remat +
+# test_recompute_policy_flash_saves_kernel_outputs)
+# ---------------------------------------------------------------------------
+
+
+def test_dot_dw_under_remat_matches_dense_oracle():
+    """The custom_vjp must compose with jax.checkpoint — fwd AND grads
+    match the plain-dot oracle with the remat wrapper in place."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(32, 16), jnp.float32)
+    w = jnp.asarray(rng.randn(16, 24), jnp.float32)
+
+    def remat_dw(x, w):
+        body = jax.checkpoint(
+            lambda x, w: jnp.tanh(dot_dw(x, w, "float32", "direct")))
+        return jnp.sum(body(x, w) ** 2)
+
+    def remat_plain(x, w):
+        body = jax.checkpoint(lambda x, w: jnp.tanh(x @ w))
+        return jnp.sum(body(x, w) ** 2)
+
+    np.testing.assert_allclose(float(jax.jit(remat_dw)(x, w)),
+                               float(jax.jit(remat_plain)(x, w)), rtol=1e-5)
+    g1 = jax.jit(jax.grad(remat_dw, argnums=(0, 1)))(x, w)
+    g2 = jax.jit(jax.grad(remat_plain, argnums=(0, 1)))(x, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_remat_policies_save_named_dot_output():
+    """Structure: the forward output is checkpoint_name'd 'dw_mm_out' and
+    the composed 'dots' / 'dots_flash' policies keep it as a residual —
+    routing a dot through the custom_vjp must not silently change what
+    those policies save (the dot itself is opaque to
+    dots_with_no_batch_dims_saveable inside a custom_vjp call)."""
+    import jax
+    import jax.numpy as jnp
+    from jax._src.ad_checkpoint import saved_residuals  # not re-exported
+
+    from paddle_tpu.ops.control_flow import RECOMPUTE_POLICIES
+
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(16, 8), jnp.float32)
+    w = jnp.asarray(rng.randn(8, 8), jnp.float32)
+
+    def seg(x, w):
+        return jnp.tanh(dot_dw(x, w, "float32", "direct")).sum()
+
+    for policy_name in ("dots", "dots_flash"):
+        ckpt = jax.checkpoint(seg, policy=RECOMPUTE_POLICIES[policy_name])
+        saved = saved_residuals(ckpt, x, w)
+        names = [str(note) for _, note in saved]
+        assert any("dw_mm_out" in n or
+                   (getattr(v, "shape", None) == (16, 8) and
+                    "argument" not in n)
+                   for (v, _), n in zip(saved, names)), (policy_name, names)
+        # grads unchanged by the policy
+        g = jax.grad(ckpt, argnums=(0, 1))(x, w)
+        gref = jax.grad(seg, argnums=(0, 1))(x, w)
+        for a, b in zip(g, gref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# routing + opt-out through the real Executor
+# ---------------------------------------------------------------------------
+
+
+def _fc_losses(n_steps=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[32], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(p, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=3)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(64, 32).astype("float32"),
+            "y": rng.randn(64, 1).astype("float32")}
+    return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                          scope=scope)[0]) for _ in range(n_steps)]
+
+
+def test_flag_opt_out_restores_stock_path(dw_flags):
+    """Flag off: not a single dot routes (route_count is the witness) and
+    training is bitwise the stock path; flag on: the SAME program routes
+    and produces identical losses (the forward is the stock dot; only the
+    weight-grad schedule changes, f32-accumulated either way)."""
+    dw_flags.set_flag("pallas_dw_matmul", "off")
+    c0 = pallas_matmul.route_count
+    off = _fc_losses()
+    assert pallas_matmul.route_count == c0, "flag off must route nothing"
+
+    dw_flags.set_flag("pallas_dw_matmul", "direct")
+    on = _fc_losses()
+    assert pallas_matmul.route_count > c0, "flag on must route the fc dW"
+    np.testing.assert_allclose(off, on, rtol=1e-6)
+
+    # ...and switching back off cleanly restores the stock path again
+    dw_flags.set_flag("pallas_dw_matmul", "off")
+    c1 = pallas_matmul.route_count
+    off2 = _fc_losses()
+    assert pallas_matmul.route_count == c1
+    np.testing.assert_allclose(off2, off, rtol=0, atol=0)
+
+
+def test_routed_dot_eligibility_gates(dw_flags):
+    """min_k / min_mn floors and the mode switch: ineligible shapes and
+    'off'/'auto'-without-plan return None (stock path)."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((64, 32), jnp.float32)
+    y = jnp.zeros((32, 16), jnp.float32)
+    dw_flags.set_flag("pallas_dw_matmul", "off")
+    assert routed_dot(x, y, jnp.float32) is None
+    dw_flags.set_flag("pallas_dw_matmul", "auto")
+    pallas_matmul.reset()
+    assert routed_dot(x, y, jnp.float32) is None  # no measured plan -> stock
+    pallas_matmul.reset({(32, 16, 64): "direct"})
+    assert routed_dot(x, y, jnp.float32) is not None
+    pallas_matmul.reset()
+    dw_flags.set_flag("pallas_dw_matmul", "direct")
+    assert routed_dot(x, y, jnp.float32) is not None
+    dw_flags.set_flag("pallas_dw_min_k", 65)  # rows floor excludes K=64
+    assert routed_dot(x, y, jnp.float32) is None
+    dw_flags.set_flag("pallas_dw_min_k", 4)
+    dw_flags.set_flag("pallas_dw_min_mn", 17)  # min(m, n) floor
+    assert routed_dot(x, y, jnp.float32) is None
+    # int dots never route
+    dw_flags.set_flag("pallas_dw_min_mn", 2)
+    assert routed_dot(jnp.zeros((64, 32), jnp.int32),
+                      jnp.zeros((32, 16), jnp.int32), jnp.int32) is None
+
+
+def test_amp_fc_matches_stock_under_routing(dw_flags):
+    """Under AMP (bf16 operands, f32 master grads via vjp-of-cast) the
+    routed weight grad must track the stock path within bf16 tolerance —
+    both accumulate f32 and store the cotangent bf16."""
+    def amp_losses():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[64], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, size=64, act="relu")
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(p, y)))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace(), amp=True)
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=5)
+        rng = np.random.RandomState(1)
+        feed = {"x": rng.randn(128, 64).astype("float32"),
+                "y": rng.randn(128, 1).astype("float32")}
+        return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                              scope=scope)[0]) for _ in range(4)]
+
+    dw_flags.set_flag("pallas_dw_matmul", "off")
+    off = amp_losses()
+    dw_flags.set_flag("pallas_dw_matmul", "direct")
+    on = amp_losses()
+    np.testing.assert_allclose(off, on, rtol=2e-2, atol=1e-3)

@@ -141,3 +141,47 @@ def test_bench_self_comparison(tmp_path, capsys):
     bench._emit({"metric": "m", "value": 110.0, "unit": "u"})
     rec = json.loads(capsys.readouterr().out.strip())
     assert "regression" not in rec and rec["vs_prev"] > 1.0
+
+
+def test_bench_judges_its_own_bars(tmp_path, capsys):
+    """Round 6 (VERDICT r5 item 7): every tracked metric emits its
+    BASELINE.md bar, meets_bar, and a NON-NULL vs_baseline (= measured /
+    bar); misses and regressions land in _FAILURES, which main() turns
+    into a nonzero exit."""
+    import importlib.util
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._PREV = {}
+    # all six tracked metrics carry a bar
+    assert len(bench.BARS) == 6
+    # pass: above bar
+    bench._emit({"metric": "transformer_lm_train_tokens_per_sec_per_chip",
+                 "value": 150000.0, "unit": "tokens/sec", "mfu": 0.648})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["meets_bar"] is True
+    assert rec["vs_baseline"] == round(0.648 / 0.60, 4)
+    assert rec["bar"]["min"] == 0.60
+    assert not bench._FAILURES
+    # miss: below bar beyond the 2% tolerance -> recorded failure
+    bench._emit({"metric": "resnet50_train_images_per_sec_per_chip",
+                 "value": 2000.0, "unit": "images/sec", "mfu": 0.125})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["meets_bar"] is False and rec["vs_baseline"] < 1.0
+    assert any("bar miss" in f for f in bench._FAILURES)
+    # within tolerance: 0.17 bar, 0.1675 measured -> still green
+    bench._FAILURES.clear()
+    bench._emit({"metric": "resnet50_train_images_per_sec_per_chip",
+                 "value": 2690.0, "unit": "images/sec", "mfu": 0.1675})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["meets_bar"] is True and not bench._FAILURES
+    # errored workload (value 0): meets_bar False, vs_baseline 0.0
+    bench._emit({"metric": "ctr_wide_deep_train_examples_per_sec_per_chip",
+                 "value": 0.0, "unit": "examples/sec", "error": "boom"})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["meets_bar"] is False and rec["vs_baseline"] == 0.0
+    assert bench._FAILURES

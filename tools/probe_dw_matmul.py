@@ -1,0 +1,90 @@
+"""Round-6 dW-orientation matmul A/B probe (the slope instrument for
+ops/pallas_matmul.py).
+
+Two levels, same discipline as tools/probe_tlm*.py:
+
+* ``kernel`` — slope-timed ms/call + effective TF/s for XLA vs the two
+  Pallas strategies on each audited dW shape (head dW [8192,1024]^T @
+  [8192,32000], FFN up/down dW, projection dW, and the longcontext
+  siblings). Chained windows with a scalar fetch close the dispatch chain
+  — the r4 lesson that an unfetched output lets XLA DCE the kernel (the
+  425%-"MFU" artifact) and that block_until_ready returns early through
+  the tunnel.
+* ``model`` — the AUTHORITATIVE instrument (docs/perf.md measurement
+  note): the full bench transformer step, slope-timed, with the dW flag
+  forced off / direct / transpose / auto. A kernel-level win that does
+  not reproduce here is a de-fusion loss (the r3 conv lesson) and must
+  not ship.
+
+Usage:
+  python tools/probe_dw_matmul.py kernel            # bench shapes
+  python tools/probe_dw_matmul.py kernel 1024,32000,8192 ...
+  python tools/probe_dw_matmul.py model [off direct transpose auto]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+
+def probe_kernel(shapes):
+    from paddle_tpu.ops.pallas_matmul import measure_dw, plan_blocks
+
+    for (m, n, k) in shapes:
+        res = measure_dw(m, n, k)
+        gflop = 2.0 * m * n * k / 1e9
+        rec = {"shape": [m, n, k], "plan": plan_blocks(m, n, k)}
+        for name, ms in res.items():
+            rec[f"{name}_ms"] = round(ms, 3)
+            rec[f"{name}_tfs"] = round(gflop / ms, 1)
+        best = min(("direct", "transpose"), key=lambda s: res[s])
+        rec["verdict"] = best if res[best] < res["xla"] else "xla"
+        print(json.dumps(rec), flush=True)
+
+
+def probe_model(modes):
+    """Model-level step A/B: bench.build_transformer_lm under each dW flag
+    mode. Fresh program per mode (routing is a trace-time choice)."""
+    import bench
+    from paddle_tpu import flags
+    from paddle_tpu.ops import pallas_matmul
+
+    # an explicit set_flag is always honored by bench's _maybe_tune_dw
+    # (flags.is_set); 'auto' additionally drops any prior plan so the
+    # builder's tuner measures afresh
+    for mode in modes:
+        flags.set_flag("pallas_dw_matmul", mode)
+        if mode == "auto":
+            pallas_matmul.reset()
+        routes0 = pallas_matmul.route_count
+        run_step, fetch = bench.build_transformer_lm(k=bench.PIPE_K)
+        step, spread = bench._slope_time(run_step, fetch, warmup=3, iters=20,
+                                         steps_per_call=bench.PIPE_K)
+        tok_s = bench.TLM_BATCH * bench.TLM_T / step
+        fpt = bench.lm_flops_per_token(bench.TLM_D, bench.TLM_LAYERS,
+                                       bench.TLM_FF, bench.TLM_T,
+                                       bench.TLM_VOCAB)
+        print(json.dumps({
+            "mode": mode,
+            "routed_dots": pallas_matmul.route_count - routes0,
+            "step_ms": round(step * 1e3, 2),
+            "spread_ms": round(spread * 1e3, 2),
+            "tok_s": round(tok_s, 1),
+            "mfu": round(tok_s * fpt / 1e12 / bench.PEAK_TFLOPS, 4),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "kernel"
+    rest = sys.argv[2:]
+    if which == "kernel":
+        from paddle_tpu.ops.pallas_matmul import BENCH_DW_SHAPES, LC_DW_SHAPES
+
+        shapes = ([tuple(int(x) for x in s.split(",")) for s in rest]
+                  if rest else list(BENCH_DW_SHAPES) + list(LC_DW_SHAPES))
+        probe_kernel(shapes)
+    elif which == "model":
+        probe_model(rest or ["off", "direct", "transpose", "auto"])
+    else:
+        raise SystemExit(f"unknown probe mode {which!r} (kernel|model)")

@@ -1,0 +1,86 @@
+"""Flash-attention schedule-gap probe (VERDICT r5 item 4).
+
+The r5 audit measured the bench-config flash kernels (T=1024, 512-token
+blocks -> a 2-cell-per-(batch*head) grid) at 8.2 ms/step against a
+~2.2-2.9 ms causal-analytic floor — a ~3x "small-grid tax" attributed to
+per-cell VPU softmax + DMA that the tiny sequential grid cannot amortize.
+This probe bounds that claim cheaply: it slope-times ONE layer's flash
+fwd+bwd at the bench shape (B8 H8 T1024 D128) and at the longcontext
+shape (B1 H8 T4096 D128, an 8x longer K loop per cell) and prints each
+against its own analytic floor. If the tax ratio falls materially at
+T=4096, the gap is T=1024-specific (amortization), not a kernel-schedule
+defect — and the perf.md sentence "only a materially different schedule
+could attack it" gets scoped to short sequences.
+
+Floor model: 8 MXU passes/layer (2 fwd + 6 bwd, the FA-2 recipe — the
+QK^T replay runs in BOTH backward kernels), each 2*B*H*(T^2/2)*D FLOPs
+causal, at the chip's measured 190 TF/s big-matmul rate.
+
+Usage: python tools/probe_fa_gap.py [B,H,T,D ...]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+MEASURED_PEAK_TFS = 190.0  # tools/perf_lab.py big-matmul rate
+CONFIGS = ((8, 8, 1024, 128),   # bench transformer layer (r5: 8.2ms/8 layers)
+           (1, 8, 4096, 128))   # longcontext layer
+
+
+def floor_ms(b, h, t, d):
+    flops = 8 * 2 * b * h * (t * t / 2) * d
+    return flops / (MEASURED_PEAK_TFS * 1e12) * 1e3
+
+
+def measure(b, h, t, d, iters=8, reps=3):
+    """One layer's flash fwd+bwd ms via the shared chained-window slope
+    (profiler.chained_slope_ms — the same instrument pallas_matmul's
+    autotune uses; the q-scaling chain keeps XLA from hoisting or DCE'ing
+    the loop-invariant kernel calls)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu.ops.pallas_attention import flash_attention
+    from paddle_tpu.profiler import chained_slope_ms
+
+    rng = np.random.RandomState(0)
+    q0 = jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
+
+    def step(q):
+        out, vjp = jax.vjp(
+            lambda q: flash_attention(q, q, q, True, None, 512, 512), q)
+        (dq,) = vjp(out)
+        return dq
+
+    def window(n):
+        @jax.jit
+        def run(q):
+            def body(_, carry):
+                q, s = carry
+                dq = step(q)
+                s = dq[0, 0, 0, 0].astype(jnp.float32)
+                q = q * (1.0 + s * 1e-30).astype(q.dtype)
+                return q, s
+            _, s = lax.fori_loop(0, n, body, (q, jnp.float32(0.0)))
+            return s
+        return run
+
+    return chained_slope_ms(window, iters=iters, reps=reps, args=(q0,))
+
+
+if __name__ == "__main__":
+    configs = ([tuple(int(x) for x in s.split(",")) for s in sys.argv[1:]]
+               or CONFIGS)
+    for (b, h, t, d) in configs:
+        ms = measure(b, h, t, d)
+        fl = floor_ms(b, h, t, d)
+        print(json.dumps({
+            "config": {"B": b, "H": h, "T": t, "D": d},
+            "fwd_bwd_ms": round(ms, 3),
+            "analytic_floor_ms": round(fl, 3),
+            "tax_ratio": round(ms / fl, 2),
+            "grid_cells_per_bh": t // 512 if t >= 512 else 1,
+        }), flush=True)
