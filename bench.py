@@ -120,6 +120,32 @@ def _prev_results():
 _PREV = None
 REGRESSION_PCT = 0.03  # >3% drop vs the previous round is flagged loudly
 
+# obs tracing (docs/design.md §15): the whole round runs under the span
+# tracer; each record carries the breakdown of ITS workload's spans and
+# the round dumps one Chrome trace for chrome://tracing / paddle_cli trace
+TRACE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_trace.json")
+_WORKLOAD_T0 = [0.0]
+
+
+def _workload_spans():
+    """Aggregate the tracer's spans since the current workload started:
+    {span_name: {count, total_ms}} — the per-record stage breakdown."""
+    from paddle_tpu.obs import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled:
+        return None
+    agg = {}
+    for s in tr.spans():
+        if s.t0 < _WORKLOAD_T0[0]:
+            continue
+        d = agg.setdefault(s.name, {"count": 0, "total_ms": 0.0})
+        d["count"] += 1
+        d["total_ms"] += s.dur * 1e3
+    return {n: {"count": d["count"], "total_ms": round(d["total_ms"], 3)}
+            for n, d in sorted(agg.items())} or None
+
 # Per-workload-class bars, taken from BASELINE.md ("Roofline-adjusted
 # ResNet-50 target", "Transformer-LM bar", "Per-class bars" table). bench.py
 # judges its own output against them (VERDICT r5 item 7). ``field`` names
@@ -194,6 +220,12 @@ def _emit(rec):
                    f"{measured} below bar {bar['min']} ({bar['source']})")
             _FAILURES.append(msg)
             print("WARNING " + msg, file=sys.stderr)
+    try:
+        spans = _workload_spans()
+        if spans:
+            rec["obs"] = {"spans": spans, "trace_file": TRACE_FILE}
+    except Exception:
+        pass  # telemetry must never break the bench record
     print(json.dumps(rec))
 
 
@@ -765,6 +797,10 @@ def bench_ctr():
 
 
 def main():
+    from paddle_tpu import obs
+
+    obs.enable()
+    obs.get_tracer().clear()
     for bench_fn, metric, unit in (
             (bench_transformer_lm,
              "transformer_lm_train_tokens_per_sec_per_chip", "tokens/sec"),
@@ -780,15 +816,22 @@ def main():
              "examples/sec"),
     ):
         try:
+            _WORKLOAD_T0[0] = time.monotonic()
             bench_fn()
         except Exception as e:  # the flagship line must survive any failure
             _emit({"metric": metric, "value": 0.0, "unit": unit,
                    "error": str(e)[:200]})
     try:
+        _WORKLOAD_T0[0] = time.monotonic()
         bench_resnet()
     except Exception as e:
         _emit({"metric": "resnet50_train_images_per_sec_per_chip",
                "value": 0.0, "unit": "images/sec", "error": str(e)[:200]})
+    try:
+        n = obs.get_tracer().dump(TRACE_FILE)
+        print(f"chrome trace: {TRACE_FILE} ({n} spans)", file=sys.stderr)
+    except Exception as e:
+        print(f"trace dump failed: {e}", file=sys.stderr)
     if _FAILURES:
         print("BENCH FAILED its own bars:\n  " + "\n  ".join(_FAILURES),
               file=sys.stderr)

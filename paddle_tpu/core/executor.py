@@ -19,6 +19,7 @@ to them functionally), with buffer donation so updates happen in place in HBM.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -67,6 +68,58 @@ class Scope:
 _MISSING = object()
 
 _global_scope = Scope()
+
+# -- training-plane obs instruments (process default registry) ------------
+_train_obs = None
+_train_obs_lock = threading.Lock()
+
+
+def _train_metrics():
+    """Lazy get-or-create of the training-side instruments: step/flops
+    counters into ``obs.get_registry()`` plus the windowed FLOP/s + MFU
+    gauges (docs/design.md §15). One set per process — every Executor
+    publishes here, a ``MetricsServer`` exposes it."""
+    global _train_obs
+    if _train_obs is not None:
+        return _train_obs
+    with _train_obs_lock:
+        if _train_obs is not None:
+            return _train_obs
+        from ..obs import RateWindow, get_registry
+
+        r = get_registry()
+        window = RateWindow(10.0)
+        _train_obs = {
+            "steps": r.counter("pt_train_steps_total",
+                               "Training steps dispatched"),
+            "flops": r.counter("pt_train_step_flops_total",
+                               "XLA cost-analysis FLOPs of dispatched steps"),
+            "compiles": r.counter("pt_train_compiles_total",
+                                  "Executor compile-cache misses"),
+            "window": window,
+        }
+        r.gauge("pt_train_flops_per_second",
+                "Windowed rate of cost-analysis FLOPs dispatched",
+                callback=window.rate)
+
+        def _mfu():
+            from ..obs.cost import peak_flops
+
+            peak = peak_flops()
+            return window.rate() / peak if peak > 0 else 0.0
+
+        r.gauge("pt_train_mfu",
+                "pt_train_flops_per_second / (obs_peak_tflops * 1e12)",
+                callback=_mfu)
+    return _train_obs
+
+
+def _record_step_flops(flops, steps: int = 1) -> None:
+    m = _train_metrics()
+    m["steps"].inc(steps)
+    if flops:
+        m["flops"].inc(flops)
+        m["window"].add(flops)
 
 
 def global_scope() -> Scope:
@@ -204,10 +257,16 @@ class Executor:
         self.amp = amp
         self._device = self.place.jax_device()
         from ..flags import get_flag
+        from ..obs import init_from_flags
+
+        init_from_flags()  # PT_FLAG_OBS_TRACE alone turns the spans on
 
         self._cache: Dict[Any, Any] = {}
         self._cache_capacity = int(get_flag("executor_cache_capacity"))
         self._step_seed = 0
+        # cache_key -> XLA cost-analysis FLOPs (annotated lazily on the
+        # first run of each entry — obs/cost.py, feeds the MFU gauges)
+        self._flops: Dict[Any, Any] = {}
 
     # -- public API --
     def run(
@@ -234,9 +293,12 @@ class Executor:
 
     def _run_on_device(self, program, feed, fetch_names, scope, return_numpy,
                        block_idx, seed):
+        from ..obs import get_tracer as _get_tracer
+
         feed_names = tuple(sorted(feed))
-        feed_vals = {k: _to_device_array(v, program, k, self._device)
-                     for k, v in feed.items()}
+        with _get_tracer().span("train/host_prep", cat="train"):
+            feed_vals = {k: _to_device_array(v, program, k, self._device)
+                         for k, v in feed.items()}
         sig = tuple((k, feed_vals[k].shape, str(feed_vals[k].dtype)) for k in feed_names)
         # program.uid, NOT id(program): a GC'd program's id can be reused by
         # a fresh one with a matching version/signature, silently serving the
@@ -270,18 +332,27 @@ class Executor:
             seed = self._step_seed
         key = jax.random.PRNGKey(np.uint32(seed ^ (program.random_seed or 0)))
 
+        flops = self._annotate_flops(cache_key, fn, feed_vals, readonly,
+                                     donated, key)
         # the profiler event is the whole compiled-block run — the analogue of
         # the reference's per-op RecordEvent in the interpreter hot loop
         # (operator.cc RunImpl); ops fused into one XLA program leave only
         # block-granularity host events, finer grain lives in device traces
         benchmark = get_flag("benchmark")
         t0 = time.perf_counter() if benchmark else 0.0
+        from ..obs import get_tracer
+
+        tr = get_tracer()
         with RecordEvent(f"executor_run/block{block_idx}"):
-            fetches, new_state = fn(feed_vals, readonly, donated, key)
-            for n in state_out_names:
-                scope.set(n, new_state[n])
+            with tr.span("train/device_dispatch", cat="train"):
+                fetches, new_state = fn(feed_vals, readonly, donated, key)
+                for n in state_out_names:
+                    scope.set(n, new_state[n])
             if return_numpy:
-                fetches = [np.asarray(v) for v in fetches]
+                # the host sync point: np conversion blocks on the device
+                with tr.span("train/fetch_sync", cat="train"):
+                    fetches = [np.asarray(v) for v in fetches]
+        _record_step_flops(flops)
         if get_flag("check_nan_inf"):
             # <- FLAGS_check_nan_inf (operator.cc RunImpl tail): scan every
             # produced tensor; here that is the fetches + updated state of
@@ -295,6 +366,39 @@ class Executor:
                   f"feed={len(feed_vals)} fetch={len(fetches)} "
                   f"state_out={len(state_out_names)}", flush=True)
         return fetches
+
+    def _annotate_flops(self, cache_key, fn, *call_args):
+        """XLA cost-analysis FLOPs for one compile-cache entry, computed
+        once per key from the REAL call arguments' avals (obs/cost.py) and
+        memoized — the live-MFU numerator. Returns None (and caches the
+        None) when disabled or unavailable; never raises."""
+        if cache_key in self._flops:
+            return self._flops[cache_key]
+        from ..flags import get_flag, is_set
+        from ..obs import get_tracer
+
+        # the annotation lowers (re-traces) the whole step — milliseconds
+        # to seconds per cache entry. On the TRAINING side that is paid
+        # only when the obs plane is actually live (tracer on, e.g. a
+        # bench round / PT_FLAG_OBS_TRACE job) or the operator opted in by
+        # setting obs_cost_analysis explicitly; a plain test/CI run with
+        # hundreds of throwaway programs skips it. The serving engine
+        # annotates unconditionally (few buckets, small programs, and the
+        # /metrics MFU gauge must work without opt-in).
+        flops = None
+        if get_flag("obs_cost_analysis") and (
+                get_tracer().enabled or is_set("obs_cost_analysis")):
+            try:
+                from ..obs import abstractify, analyze_jit
+
+                avals = tuple(abstractify(a) for a in call_args)
+                flops = analyze_jit(fn, *avals)["flops"]
+            except Exception:
+                flops = None
+        self._flops[cache_key] = flops
+        while len(self._flops) > self._cache_capacity * 2:
+            self._flops.pop(next(iter(self._flops)))
+        return flops
 
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, state_out_names, new_state):
@@ -377,32 +481,38 @@ class Executor:
 
     def _run_steps_on_device(self, program, feeds, invariant, k, fetch_names,
                              scope, return_numpy, block_idx, seed):
+        from ..obs import get_tracer as _get_tracer
+
         feed_names = tuple(sorted(feeds if invariant else feeds[0]))
-        if invariant:
-            feed_vals = {n: _to_device_array(feeds[n], program, n, self._device)
-                         for n in feed_names}
-            step_sig = tuple((n, feed_vals[n].shape, str(feed_vals[n].dtype))
-                             for n in feed_names)
-        else:
-            for fd in feeds:
-                if tuple(sorted(fd)) != feed_names:
-                    raise ValueError(
-                        f"every step feed must bind the same names; got "
-                        f"{sorted(fd)} vs {list(feed_names)}")
-            feed_vals = {}
-            for n in feed_names:
-                vals = [fd[n] for fd in feeds]
-                if any(isinstance(v, jax.Array) for v in vals):
-                    feed_vals[n] = jnp.stack(
-                        [_to_device_array(v, program, n, self._device)
-                         for v in vals])
-                else:
-                    # ONE H2D transfer per name for the whole window
-                    stacked = np.stack(
-                        [_coerce_host(v, program, n) for v in vals])
-                    feed_vals[n] = jax.device_put(stacked, self._device)
-            step_sig = tuple((n, feed_vals[n].shape[1:], str(feed_vals[n].dtype))
-                             for n in feed_names)
+        with _get_tracer().span("train/host_prep", cat="train", k=k):
+            if invariant:
+                feed_vals = {n: _to_device_array(feeds[n], program, n,
+                                                 self._device)
+                             for n in feed_names}
+                step_sig = tuple(
+                    (n, feed_vals[n].shape, str(feed_vals[n].dtype))
+                    for n in feed_names)
+            else:
+                for fd in feeds:
+                    if tuple(sorted(fd)) != feed_names:
+                        raise ValueError(
+                            f"every step feed must bind the same names; got "
+                            f"{sorted(fd)} vs {list(feed_names)}")
+                feed_vals = {}
+                for n in feed_names:
+                    vals = [fd[n] for fd in feeds]
+                    if any(isinstance(v, jax.Array) for v in vals):
+                        feed_vals[n] = jnp.stack(
+                            [_to_device_array(v, program, n, self._device)
+                             for v in vals])
+                    else:
+                        # ONE H2D transfer per name for the whole window
+                        stacked = np.stack(
+                            [_coerce_host(v, program, n) for v in vals])
+                        feed_vals[n] = jax.device_put(stacked, self._device)
+                step_sig = tuple(
+                    (n, feed_vals[n].shape[1:], str(feed_vals[n].dtype))
+                    for n in feed_names)
 
         from ..flags import get_flag
         from ..profiler import RecordEvent  # lazy: profiler imports jax
@@ -446,12 +556,21 @@ class Executor:
         keys = jnp.stack([jax.random.PRNGKey(np.uint32(s ^ rs))
                           for s in seeds])
 
+        flops = self._annotate_flops(cache_key, fn, feed_vals, readonly,
+                                     state, keys)
+        from ..obs import get_tracer
+
+        tr = get_tracer()
         with RecordEvent(f"executor_run_steps/block{block_idx}"):
-            fetches, new_state = fn(feed_vals, readonly, state, keys)
-            for n in state_out_names:
-                scope.set(n, new_state[n])
+            with tr.span("train/device_window", cat="train", k=k):
+                fetches, new_state = fn(feed_vals, readonly, state, keys)
+                for n in state_out_names:
+                    scope.set(n, new_state[n])
             if return_numpy:
-                fetches = [np.asarray(v) for v in fetches]
+                with tr.span("train/fetch_sync", cat="train"):
+                    fetches = [np.asarray(v) for v in fetches]
+        # the annotated FLOPs cover the WHOLE k-step window program
+        _record_step_flops(flops, steps=k)
         if get_flag("check_nan_inf"):
             self._check_nan_inf(fetch_names, fetches, state_out_names,
                                 new_state)
@@ -468,9 +587,13 @@ class Executor:
 
         entry = self._cache.get(cache_key)
         if entry is None:
+            from ..obs import get_tracer
+
+            _train_metrics()["compiles"].inc()
             t_c = time.perf_counter()
             with RecordEvent(event):
-                entry = compile_fn()
+                with get_tracer().span(f"train/{event}", cat="compile"):
+                    entry = compile_fn()
             if get_flag("log_compile"):
                 print(f"[compile] {log_label} "
                       f"{time.perf_counter() - t_c:.3f}s", flush=True)

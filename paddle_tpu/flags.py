@@ -39,6 +39,21 @@ _DEFAULTS: Dict[str, Any] = {
     # >=1024-wide outputs); tests lower them to route small shapes.
     "pallas_dw_min_k": 4096,
     "pallas_dw_min_mn": 512,
+    # observability plane (paddle_tpu/obs, docs/design.md §15): obs_trace
+    # turns the span tracer on (zero-cost disabled — instrumentation sites
+    # hand back a shared no-op); capacity bounds the finished-span ring.
+    "obs_trace": False,
+    "obs_trace_capacity": 65536,
+    # complete span lists retained for the slowest requests/steps (p99
+    # exemplar sampling — the tail's trace outlives the ring)
+    "obs_exemplars": 8,
+    # annotate executor/serving compile-cache entries with XLA cost-analysis
+    # FLOPs (one pre-optimization HLO walk per cache entry) — feeds the
+    # live MFU gauges; off disables the extra lowering entirely
+    "obs_cost_analysis": True,
+    # chip peak for the MFU gauges, TFLOP/s (bench.py's TPU v5 lite bf16
+    # nominal); the gauge is flops_per_sec / (obs_peak_tflops * 1e12)
+    "obs_peak_tflops": 197.0,
 }
 
 _flags: Dict[str, Any] = {}

@@ -1,0 +1,38 @@
+"""paddle_tpu.obs — unified tracing + metrics (docs/design.md §15).
+
+The observability plane for the hot paths built in PRs 1-4: when a p99
+regresses or occupancy drops, the spans and metrics here say WHICH stage
+(queue wait / pad / H2D / device / sync / scatter; host prep / H2D /
+device window / fetch sync) ate the time — you cannot tune what you
+cannot attribute.
+
+* ``trace``   — ``Tracer``: thread-safe nested spans on a monotonic clock
+  in a bounded ring, zero-cost when disabled, Chrome trace-event export,
+  p99 exemplar retention (``ExemplarStore``). Request/step correlation
+  via ``new_trace_id()`` riding the serving wire protocol.
+* ``metrics`` — ``MetricsRegistry``: counters/gauges/histograms with
+  Prometheus text exposition. ``ServingStats`` publishes through one of
+  these (one source of truth); training instruments use the process
+  default (``get_registry()``).
+* ``cost``    — XLA cost-analysis FLOPs annotation at compile time (the
+  executor and serving compile caches), powering the live MFU gauges.
+* ``http``    — ``MetricsServer``: a standalone ``GET /metrics`` endpoint
+  for training jobs (ServingServer answers /metrics on its own port).
+
+Turn tracing on with ``flags.set_flag("obs_trace", True)`` (or
+``PT_FLAG_OBS_TRACE=1``), or programmatically ``obs.enable()``.
+"""
+from .trace import (ExemplarStore, Span, Tracer, disable, enable,  # noqa: F401
+                    get_tracer, init_from_flags, new_trace_id)
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      RateWindow, get_registry)
+from .cost import abstractify, analyze_jit, flops_of_lowered, peak_flops  # noqa: F401
+from .http import MetricsServer  # noqa: F401
+
+__all__ = [
+    "Counter", "ExemplarStore", "Gauge", "Histogram", "MetricsRegistry",
+    "MetricsServer", "RateWindow", "Span", "Tracer", "abstractify",
+    "analyze_jit",
+    "disable", "enable", "flops_of_lowered", "get_registry", "get_tracer",
+    "init_from_flags", "new_trace_id", "peak_flops",
+]

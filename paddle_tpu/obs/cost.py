@@ -1,0 +1,87 @@
+"""XLA cost-analysis FLOPs annotation — the MFU attribution source.
+
+bench.py computes MFU from *analytic* model FLOPs; that only works when a
+human sat down with the architecture. Live attribution needs the number
+for WHATEVER program is currently compiled, so the executor and serving
+compile caches annotate each cache entry with the FLOPs XLA's own cost
+analysis assigns to the lowered computation
+(``jax.stages.Lowered.cost_analysis()`` — no XLA compile needed; the
+pre-optimization HLO walk is milliseconds and runs ONCE per cache entry,
+i.e. per unique program signature).
+
+MFU then falls out per dispatch: ``flops_per_call x calls_per_sec /
+(peak_tflops x 1e12)``, with the peak from ``flags.obs_peak_tflops``
+(default: bench.py's chip nominal). Pre-optimization FLOPs slightly
+overcount what a fused executable really retires (CSE/DCE land later) —
+good enough for attribution, and the bias is stable across rounds, so
+trends are trustworthy.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+def _cost_dict(lowered) -> Optional[dict]:
+    """The cost-analysis dict of a ``jax.stages.Lowered``, or None (never
+    raises — telemetry must not take down the hot path it measures)."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):  # per-device list on some backends
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def _positive(v) -> Optional[float]:
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    # some backends report -1/0 for "unknown"
+    return v if v > 0 else None
+
+
+def flops_of_lowered(lowered) -> Optional[float]:
+    """FLOPs from a ``jax.stages.Lowered``; None when unavailable."""
+    ca = _cost_dict(lowered)
+    return _positive(ca.get("flops")) if ca else None
+
+
+def analyze_jit(fn, *abstract_args, static=None) -> Dict[str, Any]:
+    """Lower ``fn`` (a plain function or jax.jit wrapper) against
+    ``jax.ShapeDtypeStruct`` args and return {'flops': float|None,
+    'bytes': float|None}. Shared by the serving engine and the executor so
+    both caches annotate the same way."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    try:
+        lowered = jitted.lower(*abstract_args)
+    except Exception:
+        return {"flops": None, "bytes": None}
+    # ONE cost-analysis walk (it re-traverses the whole HLO) for both stats
+    ca = _cost_dict(lowered)
+    if not ca:
+        return {"flops": None, "bytes": None}
+    return {"flops": _positive(ca.get("flops")),
+            "bytes": _positive(ca.get("bytes accessed"))}
+
+
+def abstractify(v) -> "Any":
+    """Value -> ShapeDtypeStruct (arrays pass structurally, pytrees map)."""
+    import jax
+
+    def one(x):
+        import numpy as np
+        a = x if hasattr(x, "shape") and hasattr(x, "dtype") else np.asarray(x)
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+    return jax.tree_util.tree_map(one, v)
+
+
+def peak_flops() -> float:
+    """Chip peak in FLOP/s from ``flags.obs_peak_tflops``."""
+    from ..flags import get_flag
+
+    return float(get_flag("obs_peak_tflops")) * 1e12

@@ -1,0 +1,84 @@
+"""MetricsServer: a standalone Prometheus scrape endpoint for training jobs.
+
+``ServingServer`` answers ``GET /metrics`` on its own port (server.py); a
+training job has no listener at all, so this one-file HTTP server gives it
+one::
+
+    from paddle_tpu.obs import MetricsServer, get_registry
+    ms = MetricsServer(port=9184)          # port=0 picks a free one
+    ...train...                            # instruments publish to the
+    ms.close()                             # default registry
+
+Dependency-free (stdlib ``http.server``), threaded, exposes:
+
+* ``GET /metrics``  — Prometheus text exposition of the registry
+* ``GET /healthz``  — liveness (``ok``)
+
+Scrape-pull only; nothing here ever blocks a training step.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        srv: "MetricsServer" = self.server  # type: ignore[assignment]
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = srv.registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+        elif path == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes are not stdout events
+        pass
+
+
+class MetricsServer(ThreadingHTTPServer):
+    """Threaded scrape endpoint over a ``MetricsRegistry`` (default: the
+    process registry). ``with MetricsServer(port=0) as ms: ms.endpoint``."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[MetricsRegistry] = None):
+        super().__init__((host, port), _Handler)
+        self.registry = registry or get_registry()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="paddle-tpu-metrics")
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server_address[:2]
+        return f"{host}:{port}"
+
+    def close(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

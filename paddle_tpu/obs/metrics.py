@@ -1,0 +1,354 @@
+"""Metrics registry: counters / gauges / histograms + Prometheus text.
+
+One registry is ONE scrape surface: ``ServingServer`` exposes its stats
+object's registry on ``GET /metrics``; training jobs register into the
+process default registry and serve it via ``MetricsServer``. Instruments
+are get-or-create by (name, labelnames) so independent subsystems can
+share a metric family without coordination; registering the same name
+with a DIFFERENT type or label set raises (silent divergence is how two
+sources of truth come back).
+
+Naming scheme (docs/design.md §15): ``pt_<plane>_<what>_<unit>`` —
+``pt_serving_requests_total{event="submitted"}``,
+``pt_serving_stage_seconds{stage="queue_wait"}``,
+``pt_train_step_flops_total``, ``pt_serving_mfu``. Counters end in
+``_total``; durations are seconds; gauges are instantaneous.
+
+Exposition follows the Prometheus text format 0.0.4: ``# HELP`` /
+``# TYPE`` headers, one sample per line, histograms as cumulative
+``_bucket{le=...}`` series plus ``_sum``/``_count``. ``Gauge`` accepts a
+zero-arg callback so queue depths / occupancy are read at scrape time
+rather than pushed on every change.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# serving latencies (ms to s scale) through training steps (seconds)
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if v != v:  # NaN
+        return "NaN"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _labelstr(labelnames: Sequence[str], labelvalues: Sequence[str]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(f'{n}="{_escape(v)}"'
+                     for n, v in zip(labelnames, labelvalues))
+    return "{" + inner + "}"
+
+
+class _Instrument:
+    """One metric family; label children share the family's lock."""
+
+    typ = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], "_Instrument"] = {}
+        if not self.labelnames:
+            self._init_value()
+
+    def _init_value(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def labels(self, *labelvalues, **labelkv) -> "_Instrument":
+        if labelkv:
+            if labelvalues:
+                raise ValueError("pass labels positionally OR by name")
+            labelvalues = tuple(labelkv[n] for n in self.labelnames)
+        key = tuple(str(v) for v in labelvalues)
+        if len(key) != len(self.labelnames):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.labelnames}, got {key}")
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self).__new__(type(self))
+                child.name = self.name
+                child.help = self.help
+                child.labelnames = ()
+                child._lock = self._lock
+                child._children = {}
+                child._init_value()
+                self._children[key] = child
+            return child
+
+    def _samples(self) -> List[Tuple[str, str, float]]:
+        """[(suffix, labelstr, value)] — flat family expansion."""
+        out: List[Tuple[str, str, float]] = []
+        if self.labelnames:
+            with self._lock:
+                items = sorted(self._children.items())
+            for key, child in items:
+                ls = _labelstr(self.labelnames, key)
+                out.extend((suf, _merge_labels(ls, extra), v)
+                           for suf, extra, v in child._sample_values())
+        else:
+            out.extend((suf, _merge_labels("", extra), v)
+                       for suf, extra, v in self._sample_values())
+        return out
+
+    def _sample_values(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {_escape(self.help)}",
+                 f"# TYPE {self.name} {self.typ}"]
+        for suffix, labelstr, value in self._samples():
+            lines.append(f"{self.name}{suffix}{labelstr} {_fmt(value)}")
+        return "\n".join(lines)
+
+
+def _merge_labels(base: str, extra: str) -> str:
+    """Merge two ``{...}`` label strings (either may be empty)."""
+    if not extra:
+        return base
+    if not base:
+        return extra
+    return base[:-1] + "," + extra[1:]
+
+
+class Counter(_Instrument):
+    """Monotonic float counter (``_total`` naming is the caller's job)."""
+
+    typ = "counter"
+
+    def _init_value(self):
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _sample_values(self):
+        return [("", "", self.value)]
+
+
+class Gauge(_Instrument):
+    """Set/inc/dec, or a zero-arg ``callback`` read at scrape time."""
+
+    typ = "gauge"
+
+    def __init__(self, name, help, labelnames=(),
+                 callback: Optional[Callable[[], float]] = None):
+        self._callback = callback
+        super().__init__(name, help, labelnames)
+
+    def _init_value(self):
+        self._value = 0.0
+        if not hasattr(self, "_callback"):
+            self._callback = None  # label children have no callback
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_callback(self, fn: Callable[[], float]) -> None:
+        self._callback = fn
+
+    @property
+    def value(self) -> float:
+        cb = getattr(self, "_callback", None)
+        if cb is not None:
+            try:
+                return float(cb())
+            except Exception:
+                return float("nan")  # a broken callback must not kill scrape
+        with self._lock:
+            return self._value
+
+    def _sample_values(self):
+        return [("", "", self.value)]
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket cumulative histogram (Prometheus semantics)."""
+
+    typ = "histogram"
+
+    def __init__(self, name, help, labelnames=(), buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        super().__init__(name, help, labelnames)
+
+    def _init_value(self):
+        if not hasattr(self, "buckets"):
+            self.buckets = DEFAULT_BUCKETS
+        self._counts = [0] * (len(self.buckets) + 1)  # + +Inf
+        self._sum = 0.0
+        self._n = 0
+
+    def labels(self, *labelvalues, **labelkv):
+        child = super().labels(*labelvalues, **labelkv)
+        child.buckets = self.buckets
+        if len(child._counts) != len(self.buckets) + 1:
+            child._counts = [0] * (len(self.buckets) + 1)
+        return child
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _sample_values(self):
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._n, self._sum
+        out = []
+        cum = 0
+        for b, c in zip(self.buckets, counts[:-1]):
+            cum += c
+            out.append(("_bucket", f'{{le="{_fmt(b)}"}}', cum))
+        out.append(("_bucket", '{le="+Inf"}', total))
+        out.append(("_sum", "", s))
+        out.append(("_count", "", total))
+        return out
+
+
+class RateWindow:
+    """Per-second ring summing amounts over a sliding window — the
+    denominator-free half of a rate gauge (``rate()`` divides by the
+    window actually covered). Thread-safe; used for the live FLOP/s and
+    MFU gauges on both the serving and training planes."""
+
+    def __init__(self, window_s: float = 10.0):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._ring: List[List[float]] = []  # [whole_second, amount]
+        self._t0 = time.monotonic()
+
+    def add(self, amount: float) -> None:
+        now = time.monotonic()
+        sec = int(now)
+        with self._lock:
+            if self._ring and self._ring[-1][0] == sec:
+                self._ring[-1][1] += amount
+            else:
+                self._ring.append([sec, amount])
+            horizon = int(now - self.window_s) - 1
+            while self._ring and self._ring[0][0] < horizon:
+                self._ring.pop(0)
+
+    def rate(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            total = sum(a for sec, a in self._ring
+                        if now - sec <= self.window_s)
+        horizon = min(self.window_s, max(now - self._t0, 1e-9))
+        return total / horizon
+
+
+class MetricsRegistry:
+    """Get-or-create instrument store + one-call text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: "Dict[str, _Instrument]" = {}
+        self._t0 = time.monotonic()
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if type(inst) is not cls or \
+                        inst.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(inst).__name__}{inst.labelnames}; cannot "
+                        f"re-register as {cls.__name__}{tuple(labelnames)}")
+                return inst
+            inst = cls(name, help, labelnames, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = (),
+              callback: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self._get_or_create(Gauge, name, help, labelnames,
+                                callback=callback)
+        if callback is not None and g._callback is None:
+            g._callback = callback
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._instruments.pop(name, None)
+
+    def expose(self) -> str:
+        """The Prometheus text page (0.0.4): every family, HELP/TYPE +
+        samples, newline-terminated."""
+        with self._lock:
+            insts = [self._instruments[k] for k in sorted(self._instruments)]
+        return "\n".join(i.expose() for i in insts) + "\n" if insts else "\n"
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process default registry (training-side instruments land here;
+    each ``ServingStats`` scopes its own)."""
+    return _default_registry

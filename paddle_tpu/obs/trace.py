@@ -1,0 +1,332 @@
+"""Span tracer: low-overhead, thread-safe, bounded — the attribution layer.
+
+The hot paths built in PRs 1-4 (the fused ``run_steps`` window, the depth-2
+serving dispatch pipeline, the Pallas dW route) are visible only as
+aggregate counters; when a p99 regresses nothing says WHICH stage ate the
+time. The tracer records *per-stage spans* — named intervals on a
+monotonic clock, nested per thread, tagged with a request trace-id or a
+training step-id — into a bounded ring buffer, and exports them as Chrome
+trace-event JSON (the same format ``tools/timeline.py`` emits, so host
+profiler events and obs spans merge into one timeline).
+
+Design constraints (docs/design.md §15):
+
+* **zero-cost when disabled** — ``span()`` returns a shared no-op context
+  manager (no allocation, one attribute read); every instrumentation site
+  is guarded by the same check. Enabling is a runtime switch
+  (``enable()`` / the ``obs_trace`` flag), not a rebuild.
+* **bounded** — finished spans land in a ``deque(maxlen=capacity)``; a
+  week-long serving process cannot leak memory through its own telemetry.
+* **thread-safe** — one lock around the ring; the per-thread span stack
+  (for nesting/depth) lives in ``threading.local`` and needs none.
+* **monotonic** — span timestamps are ``time.monotonic()``; wall-clock
+  jumps (NTP) cannot produce negative durations.
+
+Exemplar sampling (``ExemplarStore``): percentiles say *that* the tail is
+slow, exemplars say *why* — the store retains the complete span list of
+the K slowest requests/steps, evicting faster ones, so the p99's trace is
+still inspectable hours later even though the ring has long rotated.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+def new_trace_id() -> str:
+    """16-hex-char request/step correlation id (rides the wire protocol)."""
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One finished interval. ``t0`` is monotonic seconds; ``dur`` seconds.
+    ``parent`` is the enclosing span's ``sid`` on the same thread (0 = root)
+    — the CLI's self-time report subtracts children via this link."""
+
+    __slots__ = ("sid", "name", "cat", "t0", "dur", "tid", "trace_id",
+                 "parent", "args")
+
+    def __init__(self, sid, name, cat, t0, dur, tid, trace_id, parent, args):
+        self.sid = sid
+        self.name = name
+        self.cat = cat
+        self.t0 = t0
+        self.dur = dur
+        self.tid = tid
+        self.trace_id = trace_id
+        self.parent = parent
+        self.args = args
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"sid": self.sid, "name": self.name, "cat": self.cat,
+             "t0": self.t0, "dur": self.dur, "tid": self.tid,
+             "parent": self.parent}
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        if self.args:
+            d["args"] = self.args
+        return d
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disabled-tracer fast path
+    allocates NOTHING per call (tests assert identity)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    """An open span; closing records it into the tracer's ring. The span's
+    id is assigned at OPEN so children started while it is live can link
+    their ``parent`` to it (the per-thread stack carries open sids)."""
+
+    __slots__ = ("_tracer", "name", "cat", "trace_id", "args", "_t0",
+                 "_parent", "sid")
+
+    def __init__(self, tracer, name, cat, trace_id, args):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.trace_id = trace_id
+        self.args = args
+
+    def __enter__(self):
+        tl = self._tracer._tls
+        stack = getattr(tl, "stack", None)
+        if stack is None:
+            stack = tl.stack = []
+        self._parent = stack[-1] if stack else 0
+        self.sid = next(self._tracer._sid)
+        # push BEFORE reading the clock so nesting bookkeeping isn't counted
+        stack.append(self.sid)
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.monotonic() - self._t0
+        tl = self._tracer._tls
+        if tl.stack and tl.stack[-1] == self.sid:
+            tl.stack.pop()
+        self._tracer._record(self.name, self.cat, self._t0, dur,
+                             self.trace_id, self._parent, self.args,
+                             sid=self.sid)
+        return False
+
+
+class ExemplarStore:
+    """Keep the complete span lists of the K slowest keys (min-heap by
+    duration: a new trace evicts the fastest retained one)."""
+
+    def __init__(self, k: int = 8):
+        self.k = int(k)
+        self._lock = threading.Lock()
+        self._heap: List[Any] = []  # (duration, seq, key, spans)
+        self._seq = itertools.count()
+
+    def would_retain(self, duration: float) -> bool:
+        """Cheap pre-check so callers skip assembling the span list for
+        traces that would be rejected anyway (the common case)."""
+        if self.k <= 0:
+            return False
+        with self._lock:
+            return len(self._heap) < self.k or duration > self._heap[0][0]
+
+    def offer(self, key: str, duration: float,
+              spans: List[Dict[str, Any]]) -> bool:
+        """Returns True when the trace was retained."""
+        if self.k <= 0:
+            return False
+        with self._lock:
+            item = (duration, next(self._seq), key, spans)
+            if len(self._heap) < self.k:
+                heapq.heappush(self._heap, item)
+                return True
+            if duration > self._heap[0][0]:
+                heapq.heapreplace(self._heap, item)
+                return True
+            return False
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Slowest-first list of {key, duration_s, spans}."""
+        with self._lock:
+            items = sorted(self._heap, reverse=True)
+        return [{"key": k, "duration_s": d, "spans": s}
+                for d, _, k, s in items]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._heap.clear()
+
+
+class Tracer:
+    """Bounded ring of finished spans + per-thread nesting state."""
+
+    def __init__(self, capacity: int = 65536, exemplars: int = 8):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._enabled = False
+        # >= 1: _record indexes the ring, a 0-capacity ring would crash the
+        # instrumented hot path telemetry must never take down
+        self.capacity = max(1, int(capacity))
+        self._ring: List[Span] = []
+        self._next = 0  # ring write cursor
+        self._sid = itertools.count(1)
+        self.dropped = 0  # spans overwritten since enable()
+        self.exemplars = ExemplarStore(exemplars)
+        self._t_epoch = time.monotonic()  # export time base
+
+    # -- switches --
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None and max(1, int(capacity)) != self.capacity:
+                self.capacity = max(1, int(capacity))
+                self._ring = []
+                self._next = 0
+            self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._next = 0
+            self.dropped = 0
+        self.exemplars.clear()
+
+    # -- recording --
+    def span(self, name: str, cat: str = "host",
+             trace_id: Optional[str] = None, **args):
+        """Context manager measuring one interval. Disabled: returns the
+        shared no-op singleton — no allocation on the hot path."""
+        if not self._enabled:
+            return _NOOP
+        return _LiveSpan(self, name, cat, trace_id, args or None)
+
+    def add_span(self, name: str, t0: float, dur: float, cat: str = "host",
+                 trace_id: Optional[str] = None, tid: Optional[int] = None,
+                 parent: int = 0, args: Optional[Dict] = None) -> int:
+        """Record an externally-measured interval (``t0`` monotonic
+        seconds). Used by code that already took its own timestamps — the
+        batcher's stage timings, profiler.RecordEvent re-emission."""
+        if not self._enabled:
+            return 0
+        return self._record(name, cat, t0, dur, trace_id, parent, args,
+                            tid=tid)
+
+    def _record(self, name, cat, t0, dur, trace_id, parent, args,
+                tid=None, sid=None) -> int:
+        if sid is None:
+            sid = next(self._sid)
+        sp = Span(sid, name, cat, t0, dur,
+                  threading.get_ident() & 0xFFFFFF if tid is None else tid,
+                  trace_id, parent, args)
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                self._ring.append(sp)
+            else:
+                self._ring[self._next] = sp
+                self.dropped += 1
+            self._next = (self._next + 1) % max(self.capacity, 1)
+        return sid
+
+    # -- reading --
+    def spans(self, trace_id: Optional[str] = None) -> List[Span]:
+        """Finished spans in recording order (oldest first); optionally
+        only those tagged with ``trace_id``."""
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                out = list(self._ring)
+            else:
+                out = self._ring[self._next:] + self._ring[:self._next]
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- export --
+    def to_chrome_trace(self, extra_events: Optional[List[Dict]] = None) -> Dict:
+        """Chrome trace-event JSON dict (``{"traceEvents": [...]}``) —
+        loadable in chrome://tracing / ui.perfetto.dev and mergeable with
+        ``tools/timeline.py`` output (same schema, 'X' complete events).
+        ``extra_events`` (pre-formatted event dicts, e.g. the profiler's
+        host events converted by timeline.py) are appended verbatim."""
+        spans = self.spans()
+        t0 = min((s.t0 for s in spans), default=self._t_epoch)
+        events: List[Dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "paddle_tpu obs"}}]
+        trace: Dict[str, Any] = {"traceEvents": events,
+                                 # absolute monotonic base of ts=0: lets
+                                 # timeline.py re-align this dump against
+                                 # profiler events rebased to a different
+                                 # zero (chrome ignores unknown keys)
+                                 "t0_monotonic": t0}
+        for s in spans:
+            args = dict(s.args or {})
+            if s.trace_id:
+                args["trace_id"] = s.trace_id
+            events.append({
+                "ph": "X", "cat": s.cat, "name": s.name, "pid": 0,
+                "tid": s.tid, "ts": (s.t0 - t0) * 1e6, "dur": s.dur * 1e6,
+                "args": args})
+        if extra_events:
+            events.extend(extra_events)
+        return trace
+
+    def dump(self, path: str) -> int:
+        """Write the Chrome trace JSON; returns the span count written."""
+        trace = self.to_chrome_trace()
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+
+
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide default tracer every instrumentation site uses."""
+    return _default
+
+
+def enable(capacity: Optional[int] = None) -> Tracer:
+    _default.enable(capacity)
+    return _default
+
+
+def disable() -> None:
+    _default.disable()
+
+
+def init_from_flags() -> Tracer:
+    """Honor ``flags.obs_trace`` / ``obs_trace_capacity`` /
+    ``obs_exemplars`` (called lazily by the instrumented entry points so
+    an env var alone turns tracing on)."""
+    from ..flags import get_flag
+
+    if get_flag("obs_trace") and not _default.enabled:
+        _default.exemplars.k = int(get_flag("obs_exemplars"))
+        _default.enable(int(get_flag("obs_trace_capacity")))
+    return _default

@@ -94,7 +94,12 @@ def _flash_ring_local(*, axis, n_shards, causal, sc, interpret):
     """
     from ..ops.pallas_attention import flash_attention_bwd, flash_attention_fwd
 
-    neg_inf = jnp.float32(-jnp.inf)
+    # a plain python float, NOT jnp.float32(-inf): a jax scalar created here
+    # is born under whatever trace is active at closure-build time (e.g. the
+    # jax.checkpoint trace of the FIRST call) and, captured by blk_skip,
+    # leaks into later re-traces as an UnexpectedTracerError (the
+    # test_flash_ring_under_remat failure carried since PR 2)
+    neg_inf = float("-inf")
     perm = [(i, (i - 1) % n_shards) for i in range(n_shards)]
 
     def blk_diag(args):
@@ -111,12 +116,12 @@ def _flash_ring_local(*, axis, n_shards, causal, sc, interpret):
 
     def blk_skip(args):
         q, _, _ = args
-        return jnp.zeros_like(q), jnp.full(q.shape[:3], neg_inf)
+        return jnp.zeros_like(q), jnp.full(q.shape[:3], neg_inf, jnp.float32)
 
     def ring_fwd(q, k, v):
         idx = lax.axis_index(axis)
         o0 = jnp.zeros(q.shape, jnp.float32)
-        l0 = jnp.full(q.shape[:3], neg_inf)
+        l0 = jnp.full(q.shape[:3], neg_inf, jnp.float32)
 
         def body(i, carry):
             (o, l), (k_i, v_i) = carry

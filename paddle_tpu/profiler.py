@@ -42,12 +42,31 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         self._scope.__exit__(*exc)
-        if _enabled:
+        tracer = _obs_tracer()
+        if _enabled or tracer is not None:
             dur = time.perf_counter() - self._t0
-            _events[self.name].append(dur)
-            _records.append((self.name, self._t0, dur,
-                             threading.get_ident() & 0xFFFF))
+            if _enabled:
+                _events[self.name].append(dur)
+                _records.append((self.name, self._t0, dur,
+                                 threading.get_ident() & 0xFFFF))
+            if tracer is not None:
+                # re-emit into the obs span tracer so profiler regions and
+                # obs spans land in ONE merged Chrome trace (note: profiler
+                # events ride perf_counter, obs spans time.monotonic — on
+                # Linux both are CLOCK_MONOTONIC, so the lanes line up)
+                tracer.add_span(self.name, self._t0, dur, cat="profiler")
         return False
+
+
+def _obs_tracer():
+    """The obs tracer iff live (import kept lazy + failure-proof: the
+    profiler must work even if obs is mid-import)."""
+    try:
+        from .obs import get_tracer
+    except Exception:
+        return None
+    t = get_tracer()
+    return t if t.enabled else None
 
 
 def start_profiler(state: str = "All", trace_dir: Optional[str] = None):

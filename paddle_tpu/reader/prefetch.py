@@ -77,11 +77,18 @@ class DevicePrefetcher:
         stop = threading.Event()
         end = object()
 
+        from ..obs import get_tracer
+
         def fill():
+            tr = get_tracer()
             try:
                 for batch in self.reader():
-                    feed = self.transform(batch) if self.transform else batch
-                    placed = self._place(feed)
+                    with tr.span("prefetch/transform", cat="train"):
+                        feed = (self.transform(batch) if self.transform
+                                else batch)
+                    # the H2D transfer the pipeline hides behind compute
+                    with tr.span("prefetch/place", cat="train"):
+                        placed = self._place(feed)
                     while not stop.is_set():
                         try:
                             q.put(placed, timeout=0.1)

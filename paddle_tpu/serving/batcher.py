@@ -50,15 +50,25 @@ from .stats import ServingStats
 
 
 class _Request:
-    __slots__ = ("feeds", "sig", "rows", "future", "t_submit", "deadline")
+    __slots__ = ("feeds", "sig", "rows", "future", "t_submit", "deadline",
+                 "trace_id", "t_enqueue", "t_dequeue", "t_dispatched",
+                 "timings")
 
-    def __init__(self, feeds, sig, rows, deadline=None):
+    def __init__(self, feeds, sig, rows, deadline=None, trace_id=None,
+                 t_submit=None):
         self.feeds = feeds
         self.sig = sig
         self.rows = rows
         self.deadline = deadline  # absolute monotonic seconds, or None
+        self.trace_id = trace_id  # wire-propagated correlation id, or None
         self.future: Future = Future()
-        self.t_submit = time.monotonic()
+        # t_submit is the START of submit() (so the pad stage is inside the
+        # measured latency and the per-stage spans sum to it)
+        self.t_submit = time.monotonic() if t_submit is None else t_submit
+        self.t_enqueue = self.t_submit  # set after the queue put
+        self.t_dequeue = None  # first worker pull (queue_wait ends here)
+        self.t_dispatched = None  # dispatch_prepared returned
+        self.timings: Dict[str, float] = {}  # stage -> seconds
 
 
 class MicroBatcher:
@@ -119,25 +129,35 @@ class MicroBatcher:
 
     # -- producer side --
     def submit(self, feeds: Dict[str, Any],
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one request (leading dim = rows). Never blocks: raises
         ``QueueFullError`` when the bounded queue is full, ``ShuttingDown``
         after ``close()``. ``deadline`` is absolute ``time.monotonic()``
-        seconds; an already-expired request is refused up front."""
+        seconds; an already-expired request is refused up front.
+        ``trace_id`` tags the request's spans/timings (wire-propagated by
+        the server); the returned future carries the request as
+        ``fut.request`` so the caller can read ``request.timings`` after
+        the result resolves."""
+        t0 = time.monotonic()
         if self._closed:
             # a drained queue would accept the put but no worker will ever
             # serve it — fail now, not at the caller's result() timeout
             raise ShuttingDown("batcher closed")
-        if deadline is not None and time.monotonic() >= deadline:
+        if deadline is not None and t0 >= deadline:
             if self.stats:
                 self.stats.record_deadline()
-            raise DeadlineExceeded(time.monotonic() - deadline, "submit")
+            raise DeadlineExceeded(t0 - deadline, "submit")
         padded, sig, rows = self.engine.prepare_request(feeds)
         if rows > self.max_batch_size:
             raise ValueError(
                 f"request of {rows} rows exceeds max_batch_size "
                 f"{self.max_batch_size}; split it client-side")
-        req = _Request(padded, sig, rows, deadline=deadline)
+        req = _Request(padded, sig, rows, deadline=deadline,
+                       trace_id=trace_id, t_submit=t0)
+        req.timings["pad"] = time.monotonic() - t0
+        if self.stats:
+            self.stats.record_stage("pad", req.timings["pad"])
         with self._close_lock:
             # re-check under the lock: a close() racing this submit either
             # sees our put (and drains/fails it) or we see its _closed
@@ -157,8 +177,10 @@ class MicroBatcher:
                     self.stats.record_reject()
                 raise QueueFullError(self.queue_depth,
                                      self.queue_capacity) from None
+        req.t_enqueue = time.monotonic()
         if self.stats:
             self.stats.record_submit()
+        req.future.request = req  # timings/trace ride back with the future
         return req.future
 
     @property
@@ -233,11 +255,16 @@ class MicroBatcher:
     def _next(self, timeout: float) -> Optional[_Request]:
         if self._carry is not None:
             r, self._carry = self._carry, None
-            return r
+            return r  # t_dequeue kept from its FIRST pull (carry != queue)
         try:
-            return self._queue.get(timeout=timeout)
+            r = self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        r.t_dequeue = time.monotonic()
+        r.timings["queue_wait"] = r.t_dequeue - r.t_enqueue
+        if self.stats:
+            self.stats.record_stage("queue_wait", r.timings["queue_wait"])
+        return r
 
     def _shed_expired(self, req: _Request) -> bool:
         """Coalesce-time deadline check: a request whose deadline has
@@ -317,6 +344,12 @@ class MicroBatcher:
         """Host-prepare + async device dispatch. With the pipeline enabled
         the host sync happens on the completion thread (``_finish``); this
         thread immediately returns to coalescing the next batch."""
+        t_d = time.monotonic()
+        for r in batch:
+            # coalesce = first dequeue -> dispatch start (the batch window)
+            r.timings["coalesce"] = t_d - (r.t_dequeue or t_d)
+            if self.stats:
+                self.stats.record_stage("coalesce", r.timings["coalesce"])
         if len(batch) > 1 and not all(self.engine.fetch_per_row.values()):
             # a fetch without a per-row batch dim (a batch reduction) would
             # mix the coalesced clients' rows — refuse to scatter it
@@ -361,6 +394,22 @@ class MicroBatcher:
             self._slots.release()
             self._fail_batch(batch, e)
             return
+        t_done = time.monotonic()
+        dispatch_s = t_done - t_d  # concat + slot wait + H2D + launch
+        for r in batch:
+            r.timings["dispatch"] = dispatch_s
+            r.t_dispatched = t_done
+            if self.stats:
+                # per REQUEST, not per batch: the stage histograms then
+                # decompose request latency (their means sum to ~it)
+                self.stats.record_stage("dispatch", dispatch_s)
+        from ..obs import get_tracer
+
+        tr = get_tracer()
+        if tr.enabled:
+            tr.add_span("serve/dispatch", t_d, dispatch_s, cat="serving",
+                        args={"rows": rows, "bucket": inflight.bucket,
+                              "requests": len(batch), "occupancy": occ})
         if self._inflight_q is not None:
             self._inflight_q.put((batch, inflight))
         else:
@@ -370,6 +419,7 @@ class MicroBatcher:
         """Device-complete stage: host sync, per-row scatter, resolve.
         The pipeline slot is returned only HERE, after the batch fully
         finished — the worker cannot run further ahead in the meantime."""
+        t_f = time.monotonic()
         try:
             outs = self.engine.complete(inflight)
         except Exception as e:
@@ -379,19 +429,79 @@ class MicroBatcher:
             with self._in_flight_lock:
                 self._in_flight -= 1
             self._slots.release()
+        t_synced = time.monotonic()
+        sync_s = t_synced - t_f
         # counted only once the device call actually completed (failure
         # paths land in record_failure, matching the pre-pipeline stats)
         if self.stats:
             self.stats.record_batch(inflight.rows, inflight.bucket,
-                                    requests=len(batch))
-        now = time.monotonic()
+                                    requests=len(batch),
+                                    flops=inflight.flops)
         off = 0
+        results = []
         for r in batch:
             res = [o[off:off + r.rows] if self.engine.fetch_per_row[n] else o
                    for n, o in zip(self.engine.fetch_names, outs)]
             off += r.rows
+            results.append(res)
+        now = time.monotonic()
+        scatter_s = now - t_synced
+        for r, res in zip(batch, results):
+            # ALL timings land BEFORE the future resolves: set_result wakes
+            # the server handler, which reads r.timings — a write after it
+            # would race the handler's dict iteration (and "total" must not
+            # depend on a stats object being attached: tracing uses it too)
+            # pipeline_wait: launched -> completion thread picked it up
+            # (the depth-2 hand-off queue + the device call ahead of it)
+            r.timings["pipeline_wait"] = t_f - (r.t_dispatched or t_f)
+            r.timings["device_sync"] = sync_s
+            r.timings["scatter"] = scatter_s
+            r.timings["total"] = now - r.t_submit
+            if self.stats:
+                self.stats.record_stage("pipeline_wait",
+                                        r.timings["pipeline_wait"])
+                self.stats.record_stage("device_sync", sync_s)
+                self.stats.record_stage("scatter", scatter_s)
             if self._complete(r, result=res) and self.stats:
-                self.stats.record_done(now - r.t_submit)
+                self.stats.record_done(r.timings["total"])
+        self._trace_batch(batch, inflight, t_f, sync_s, scatter_s, now)
+
+    def _trace_batch(self, batch, inflight, t_f, sync_s, scatter_s,
+                     now) -> None:
+        """Emit per-batch + per-request spans and offer p99 exemplars —
+        only when the tracer is live (zero work otherwise)."""
+        from ..obs import get_tracer
+
+        tr = get_tracer()
+        if not tr.enabled:
+            return
+        tr.add_span("serve/complete", t_f, (now - t_f), cat="serving",
+                    args={"rows": inflight.rows, "bucket": inflight.bucket,
+                          "device_sync_ms": sync_s * 1e3,
+                          "scatter_ms": scatter_s * 1e3})
+        for r in batch:
+            if not r.timings.get("total"):
+                continue
+            sid = tr.add_span("serve/request", r.t_submit,
+                              r.timings["total"], cat="serving",
+                              trace_id=r.trace_id,
+                              args={"rows": r.rows})
+            # reconstruct stage child spans from the recorded timestamps
+            # (they were measured on three different threads; the request
+            # row in the trace shows them as one contiguous lane)
+            t = r.t_submit
+            for stage in ("pad", "queue_wait", "coalesce", "dispatch",
+                          "pipeline_wait", "device_sync", "scatter"):
+                dur = r.timings.get(stage)
+                if dur is None:
+                    continue
+                tr.add_span(f"serve/{stage}", t, dur, cat="serving",
+                            trace_id=r.trace_id, parent=sid)
+                t += dur
+            tr.exemplars.offer(
+                r.trace_id or f"req-{sid}", r.timings["total"],
+                [{"name": s, "dur_ms": d * 1e3}
+                 for s, d in r.timings.items()])
 
     def _completion_loop(self) -> None:
         q = self._inflight_q

@@ -32,6 +32,7 @@ program BEFORE the swap; a bad export leaves the serving set untouched.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -66,15 +67,37 @@ class InFlightBatch:
     """A dispatched-but-not-synced device call: the handle between the
     engine's host-prepare (``dispatch_prepared``) and device-complete
     (``complete``) stages. ``weights_version`` records the param snapshot
-    the batch runs on — wholly one version, never a mix."""
+    the batch runs on — wholly one version, never a mix. ``flops`` is the
+    XLA cost-analysis annotation of the compiled bucket this batch ran
+    (obs/cost.py; None when unannotated) — the batcher feeds it into the
+    stats FLOPs/MFU window on completion."""
 
-    __slots__ = ("fetches", "rows", "bucket", "weights_version")
+    __slots__ = ("fetches", "rows", "bucket", "weights_version", "flops")
 
-    def __init__(self, fetches, rows: int, bucket: int, weights_version: int):
+    def __init__(self, fetches, rows: int, bucket: int, weights_version: int,
+                 flops: Optional[float] = None):
         self.fetches = fetches
         self.rows = rows
         self.bucket = bucket
         self.weights_version = weights_version
+        self.flops = flops
+
+
+class _CacheEntry:
+    """One compiled bucket: the jit wrapper, its cost-analysis FLOPs, and
+    cold-state bookkeeping (the first dispatch through a fresh jit wrapper
+    runs the XLA compile synchronously — the batcher's dispatch span for
+    that call IS the compile latency, recorded as ``compile_s``)."""
+
+    __slots__ = ("fn", "flops", "bytes", "cold", "compile_s", "lower_s")
+
+    def __init__(self, fn, flops=None, bytes=None, lower_s=0.0):
+        self.fn = fn
+        self.flops = flops
+        self.bytes = bytes
+        self.cold = True
+        self.compile_s = None
+        self.lower_s = lower_s
 
 
 class ServingEngine:
@@ -225,27 +248,73 @@ class ServingEngine:
         return out, sig, rows
 
     # -- compile cache --
-    def _get_fn(self, sig: Tuple):
+    def _annotate_cost(self, fn, sig: Tuple) -> Tuple[Optional[float],
+                                                      Optional[float]]:
+        """XLA cost-analysis FLOPs/bytes for one bucket signature — a
+        pre-optimization lowering walk, once per cache entry (obs/cost.py).
+        Never raises: the serving path must survive any analysis gap."""
+        from ..flags import get_flag
+
+        if not get_flag("obs_cost_analysis"):
+            return None, None
+        try:
+            import jax
+
+            from ..obs import cost as obs_cost
+
+            feed_avals = {n: jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+                          for n, shape, dtype in sig}
+            with self._lock:
+                params = self._params
+            ro = {n: obs_cost.abstractify(params[n])
+                  for n in self._readonly_names}
+            don = {n: obs_cost.abstractify(params[n])
+                   for n in self._donated_names}
+            key = obs_cost.abstractify(self._key)
+            res = obs_cost.analyze_jit(fn, feed_avals, ro, don, key)
+            return res["flops"], res["bytes"]
+        except Exception:
+            return None, None
+
+    def _get_fn(self, sig: Tuple) -> "_CacheEntry":
         import jax
 
+        from ..obs import get_tracer
+
         with self._lock:
-            fn = self._cache.get(sig)
-            if fn is not None:
+            entry = self._cache.get(sig)
+            if entry is not None:
                 self.cache_hits += 1
                 self._cache.move_to_end(sig)
-                return fn
+                return entry
             self.cache_misses += 1
-            # one jit wrapper per signature: eviction drops the executable
-            fn = jax.jit(self._step)
-            self._cache[sig] = fn
+        # build + annotate OUTSIDE the lock: the cost lowering traces the
+        # whole step; a cold bucket must not stall cache_info() (stats RPC)
+        t0 = time.monotonic()
+        # one jit wrapper per signature: eviction drops the executable
+        fn = jax.jit(self._step)
+        flops, nbytes = self._annotate_cost(fn, sig)
+        lower_s = time.monotonic() - t0
+        tr = get_tracer()
+        if tr.enabled:
+            tr.add_span("serving/compile_lower", t0, lower_s, cat="compile",
+                        args={"bucket_rows": sig[0][1][0] if sig else 0,
+                              "flops": flops})
+        entry = _CacheEntry(fn, flops=flops, bytes=nbytes, lower_s=lower_s)
+        with self._lock:
+            # a racing builder may have landed the same sig; keep the first
+            entry = self._cache.setdefault(sig, entry)
             while len(self._cache) > self.cache_capacity:
                 self._cache.popitem(last=False)
-            return fn
+        return entry
 
     def cache_info(self) -> Dict[str, int]:
         with self._lock:
+            annotated = sum(1 for e in self._cache.values()
+                            if e.flops is not None)
             return {"hits": self.cache_hits, "misses": self.cache_misses,
-                    "size": len(self._cache), "capacity": self.cache_capacity}
+                    "size": len(self._cache), "capacity": self.cache_capacity,
+                    "flops_annotated": annotated}
 
     # -- hot weight reload --
     def reload_params(self, dirname: str) -> int:
@@ -352,7 +421,7 @@ class ServingEngine:
                 for n, a in feeds.items()}
         sig = tuple((n, feeds[n].shape, str(feeds[n].dtype))
                     for n in self.feed_names)
-        fn = self._get_fn(sig)
+        entry = self._get_fn(sig)
         if self.chaos is not None:
             self.chaos.on_dispatch()  # injected slow call / step fault
         # no lock around the dispatch: jitted calls are thread-safe and the
@@ -363,13 +432,29 @@ class ServingEngine:
         with self._lock:  # one consistent (params, version) snapshot
             params = self._params
             version = self.params_version
+        cold = entry.cold
+        t_call = time.monotonic() if cold else 0.0
         with jax.default_device(self._device):
             feed_vals = {n: jax.device_put(a, self._device)
                          for n, a in feeds.items()}
             readonly = {n: params[n] for n in self._readonly_names}
             donated = {n: params[n] for n in self._donated_names}
-            fetches, _ = fn(feed_vals, readonly, donated, self._key)
-        return InFlightBatch(fetches, rows, bucket, version)
+            fetches, _ = entry.fn(feed_vals, readonly, donated, self._key)
+        if cold:
+            # the first call through a fresh jit wrapper runs the XLA
+            # compile synchronously — this duration IS the cache-miss
+            # compile latency the trace must surface
+            entry.compile_s = time.monotonic() - t_call
+            entry.cold = False
+            from ..obs import get_tracer
+
+            tr = get_tracer()
+            if tr.enabled:
+                tr.add_span("serving/compile", t_call, entry.compile_s,
+                            cat="compile",
+                            args={"bucket": bucket, "flops": entry.flops})
+        return InFlightBatch(fetches, rows, bucket, version,
+                             flops=entry.flops)
 
     def complete(self, inflight: "InFlightBatch") -> List[np.ndarray]:
         """Device-complete stage: block until the in-flight batch finishes,

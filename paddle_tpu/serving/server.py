@@ -71,6 +71,11 @@ class _Handler(socketserver.StreamRequestHandler):
             if not line:
                 return
             srv: "ServingServer" = self.server  # type: ignore[assignment]
+            if line[:4] in (b"GET ", b"HEAD"):
+                # a Prometheus scraper (or curl) talking plain HTTP on the
+                # line-JSON port: answer GET /metrics | /healthz and close
+                self._http(srv, line)
+                return
             try:
                 req = json.loads(line.decode())
                 method = req["method"]
@@ -83,6 +88,8 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"result": srv.healthz()}
                 elif method == "stats":
                     resp = {"result": srv.stats_snapshot()}
+                elif method == "metrics":
+                    resp = {"result": {"text": srv.metrics_text()}}
                 elif method == "reload":
                     resp = {"result": srv.reload(params["dirname"])}
                 else:
@@ -91,6 +98,32 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = {"error": f"{type(e).__name__}: {e}"}
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
+
+    def _http(self, srv: "ServingServer", request_line: bytes) -> None:
+        """Minimal HTTP/1.0 responder so /metrics is scrape-able without a
+        second listener. Drains the request headers, answers, hangs up."""
+        try:
+            path = request_line.split()[1].decode(errors="replace")
+        except IndexError:
+            path = "/"
+        while True:  # consume headers up to the blank line
+            h = self.rfile.readline()
+            if not h or h in (b"\r\n", b"\n"):
+                break
+        if path.split("?", 1)[0] == "/metrics":
+            status, ctype = "200 OK", "text/plain; version=0.0.4; charset=utf-8"
+            body = srv.metrics_text().encode()
+        elif path.split("?", 1)[0] == "/healthz":
+            status, ctype = "200 OK", "application/json"
+            body = (json.dumps(srv.healthz()) + "\n").encode()
+        else:
+            status, ctype = "404 Not Found", "text/plain"
+            body = b"not found\n"
+        self.wfile.write(
+            (f"HTTP/1.0 {status}\r\nContent-Type: {ctype}\r\n"
+             f"Content-Length: {len(body)}\r\n"
+             f"Connection: close\r\n\r\n").encode() + body)
+        self.wfile.flush()
 
     @staticmethod
     def _predict(srv: "ServingServer", params: Dict) -> Dict:
@@ -116,8 +149,18 @@ class _Handler(socketserver.StreamRequestHandler):
             # the future resolves with DeadlineExceeded at coalesce time;
             # the +1s slack means a typed answer beats the handler timeout
             wait = min(wait, float(deadline_ms) / 1e3 + 1.0)
+        # trace-id propagation (docs/design.md §15): "trace": true asks the
+        # server to mint an id; a string is the CLIENT's id and rides every
+        # span + the response, so client and server timelines correlate
+        trace = params.get("trace")
+        trace_id = None
+        if trace:
+            from ..obs import new_trace_id
+
+            trace_id = trace if isinstance(trace, str) else new_trace_id()
         try:
-            fut = srv.batcher.submit(feeds, deadline=deadline)
+            fut = srv.batcher.submit(feeds, deadline=deadline,
+                                     trace_id=trace_id)
             outs = fut.result(timeout=wait)
         except ServingError as e:
             # error_info, not e.info(): a re-raised ServingRejected (dict
@@ -137,7 +180,16 @@ class _Handler(socketserver.StreamRequestHandler):
                 e = ServingUnavailable(
                     f"request timed out after {wait:.1f}s server-side")
             return {"error": e.info()}
-        return {"result": {"fetches": [_encode_fetch(o) for o in outs]}}
+        result: Dict[str, Any] = {
+            "fetches": [_encode_fetch(o) for o in outs]}
+        if trace_id is not None:
+            req = getattr(fut, "request", None)
+            # copy defensively: the completion thread owns this dict
+            timings = dict(getattr(req, "timings", None) or {})
+            result["trace"] = {
+                "trace_id": trace_id,
+                "stages_ms": {k: v * 1e3 for k, v in timings.items()}}
+        return {"result": result}
 
 
 class ServingServer(socketserver.ThreadingTCPServer):
@@ -190,6 +242,37 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 stats=self.stats, pipeline_depth=pipeline_depth,
                 start=start_batcher)
             self.request_timeout = request_timeout
+            # observability plumbing: honor PT_FLAG_OBS_TRACE, and register
+            # pull-gauges into the stats registry so GET /metrics carries
+            # queue/pipeline/compile/weights state without push traffic
+            from ..obs import init_from_flags
+
+            init_from_flags()
+            r = self.stats.registry
+            r.gauge("pt_serving_queue_depth",
+                    "Requests queued (incl. carry)",
+                    callback=lambda: self.batcher.queue_depth)
+            r.gauge("pt_serving_queue_capacity", "Bounded queue capacity",
+                    callback=lambda: self.batcher.queue_capacity)
+            r.gauge("pt_serving_in_flight",
+                    "Batches dispatched but not completed",
+                    callback=lambda: self.batcher.in_flight)
+            r.gauge("pt_serving_pending",
+                    "Accepted requests not yet resolved",
+                    callback=lambda: self.batcher.pending)
+            r.gauge("pt_serving_weights_version",
+                    "Params version (bumped by hot reload)",
+                    callback=lambda: self.engine.params_version)
+            r.gauge("pt_serving_compile_cache_hits",
+                    "Serving compile-cache hits",
+                    callback=lambda: self.engine.cache_hits)
+            r.gauge("pt_serving_compile_cache_misses",
+                    "Serving compile-cache misses (an XLA compile each)",
+                    callback=lambda: self.engine.cache_misses)
+            r.gauge("pt_serving_healthy",
+                    "1 healthy / 0.5 degraded / 0 draining",
+                    callback=lambda: {"healthy": 1.0, "degraded": 0.5,
+                                      "draining": 0.0}[self.health_state()])
             # health state machine + probabilistic load shedding
             self.degraded_queue_ratio = degraded_queue_ratio
             self.degraded_error_ratio = degraded_error_ratio
@@ -280,6 +363,12 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 "queue_depth": self.batcher.queue_depth,
                 "queue_capacity": self.batcher.queue_capacity,
                 "weights_version": self.engine.params_version}
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (the ``GET /metrics`` body): the
+        stats registry — counters, histograms, and the pull-gauges
+        registered at construction."""
+        return self.stats.expose()
 
     def stats_snapshot(self) -> Dict[str, Any]:
         extra = {
@@ -406,6 +495,7 @@ class ServingClient:
         self._rng = random.Random(retry_seed)
         self.retries_total = 0  # lifetime retry count (serve_bench reports)
         self.close_errors = 0  # OSErrors discarded while closing the socket
+        self.last_trace: Optional[Dict[str, Any]] = None  # predict(trace=)
         self._sock: Optional[socket.socket] = None
         self._file = None
         self._lock = threading.Lock()
@@ -471,15 +561,27 @@ class ServingClient:
                 delay = min(delay * 2, self.backoff_max_s)
 
     def predict(self, feeds: Dict[str, Any],
-                timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+                timeout_ms: Optional[float] = None,
+                trace=False) -> List[np.ndarray]:
+        """``trace=True`` mints a trace id client-side (a string passes
+        YOUR id); the id rides the wire, tags every server-side span, and
+        the per-stage timings come back on ``self.last_trace``
+        (``{"trace_id": ..., "stages_ms": {stage: ms}}``) — the return
+        value stays one np.ndarray per fetch either way."""
+        from ..obs import new_trace_id
+
         enc = {}
         for n, v in feeds.items():
             arr = np.asarray(v)
             enc[n] = {"data": arr.tolist(), "dtype": str(arr.dtype)}
+        params: Dict[str, Any] = {"feeds": enc}
+        if trace:
+            params["trace"] = trace if isinstance(trace, str) \
+                else new_trace_id()
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
-        result = self.call_with_retries("predict", {"feeds": enc},
-                                        deadline=deadline)
+        result = self.call_with_retries("predict", params, deadline=deadline)
+        self.last_trace = result.get("trace") if trace else None
         return [np.asarray(f["data"], dtype=f["dtype"]).reshape(f["shape"])
                 for f in result["fetches"]]
 
@@ -488,6 +590,11 @@ class ServingClient:
 
     def stats(self) -> Dict[str, Any]:
         return self.call("stats")
+
+    def metrics(self) -> str:
+        """Prometheus text exposition over the line-JSON protocol (the
+        HTTP-speaking sibling is ``GET /metrics`` on the same port)."""
+        return self.call("metrics")["text"]
 
     def reload(self, dirname: str) -> Dict[str, Any]:
         """Hot-swap the server's weights from a re-exported inference dir."""

@@ -1,5 +1,6 @@
 """Rolling serving metrics: QPS, latency percentiles, batch fill, rejects,
-sheds, deadline misses, reload version.
+sheds, deadline misses, reload version — published through ONE
+``obs.MetricsRegistry``.
 
 The reference framework shipped no serving telemetry at all — deployments
 wrapped the C++ predictor and measured outside. Here the metrics are part
@@ -9,6 +10,9 @@ shed thresholds) is only tunable against these signals:
 
 * **QPS / latency percentiles** — completed requests per second over a
   sliding window, p50/p95/p99 of submit->result latency.
+* **per-stage latency** — where each request's time went: pad, queue
+  wait, coalesce, dispatch (H2D + launch), pipeline wait, device sync,
+  scatter (docs/design.md §15 span taxonomy).
 * **batch-fill ratio** — rows dispatched / bucket capacity per device call;
   low fill means padding waste (compile amortization bought with FLOPs).
 * **queue depth + rejects/sheds** — backpressure state; rejects and sheds
@@ -18,11 +22,18 @@ shed thresholds) is only tunable against these signals:
 * **compile cache hits/misses** — a miss is an XLA compile on the serving
   path (hundreds of ms); steady-state traffic should be ~100% hits.
 * **weights_version / reloads** — hot-reload progress (§12 failure model).
+* **FLOPs / MFU** — each dispatched batch carries the XLA cost-analysis
+  FLOPs its compile-cache entry was annotated with (obs/cost.py); the
+  windowed rate over peak (``flags.obs_peak_tflops``) is the live MFU.
 
-Besides the cumulative counters, every event lands in a per-second bucket
-ring so ``recent(name)`` yields a sliding-window rate — the health state
-machine (server.py) is driven off these, so a burst of rejects reads as
-``degraded`` while it is happening and decays back to ``healthy`` after.
+Since PR 5 the cumulative counters/gauges ARE ``obs.metrics`` instruments
+in ``self.registry`` — ``GET /metrics`` on the server exposes that
+registry, and ``snapshot()`` reads the same instruments, so there is ONE
+source of truth (the pre-refactor ints and this registry can never
+disagree; ``snapshot()`` keys are unchanged). The sliding-window
+per-second rings and exact-percentile deques stay internal: Prometheus
+derives rates from counters on its own timeline, while ``recent()`` and
+the health state machine (server.py) need an in-process window.
 
 Everything is monotonic-clock based and lock-guarded; `snapshot()` is what
 the server's ``stats`` RPC returns.
@@ -34,6 +45,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..obs.metrics import MetricsRegistry, RateWindow
+
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank percentile over an already-sorted list."""
@@ -43,112 +56,224 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+#: request pipeline stages, in hot-path order (docs/design.md §15)
+STAGES = ("pad", "queue_wait", "coalesce", "dispatch", "pipeline_wait",
+          "device_sync", "scatter")
+
+
 class ServingStats:
-    """Thread-safe rolling counters shared by engine, batcher, and server."""
+    """Thread-safe rolling counters shared by engine, batcher, and server,
+    backed by an ``obs.MetricsRegistry`` (``self.registry``)."""
 
     #: event names that get a sliding-window bucket ring in addition to
     #: their cumulative counter
     WINDOWED = ("submitted", "completed", "rejected", "failed",
                 "deadline_exceeded", "shed")
 
-    def __init__(self, latency_window: int = 2048, qps_window_s: float = 10.0):
+    def __init__(self, latency_window: int = 2048, qps_window_s: float = 10.0,
+                 registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self.qps_window_s = qps_window_s
-        # cumulative counters
-        self.submitted = 0
-        self.completed = 0
-        self.rejected = 0
-        self.failed = 0
-        self.deadline_exceeded = 0
-        self.shed = 0
-        self.reloads = 0
-        self.batches = 0
-        self.rows = 0
-        self.single_request_batches = 0  # fast path: no re-stack (batcher)
-        self._fill_sum = 0.0  # sum over batches of rows/bucket
-        # dispatch-pipeline gauges (docs/design.md §13): configured depth +
-        # how many batches were dispatched-but-not-completed when the last
-        # dispatch launched (occupancy ~depth = the device queue stays full)
-        self.pipeline_depth = 1
-        self.device_queue_occupancy = 0
-        self.device_queue_occupancy_max = 0
+        # one registry per stats object: several servers in one process
+        # (tests, shadow deployments) must not share counters
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self._requests = r.counter(
+            "pt_serving_requests_total",
+            "Requests by lifecycle event", labelnames=("event",))
+        # materialize the children so /metrics shows zeros before traffic
+        self._c = {n: self._requests.labels(event=n)
+                   for n in ("submitted", "completed", "rejected", "failed",
+                             "deadline_exceeded", "shed")}
+        self._reloads = r.counter("pt_serving_reloads_total",
+                                  "Successful hot weight reloads")
+        self._batches = r.counter("pt_serving_batches_total",
+                                  "Device batches dispatched and completed")
+        self._rows = r.counter("pt_serving_rows_total",
+                               "True (unpadded) rows served")
+        self._single = r.counter(
+            "pt_serving_single_request_batches_total",
+            "Batches that reused the submit-padded buffer (fast path)")
+        self._fill = r.counter(
+            "pt_serving_batch_fill_sum",
+            "Sum over batches of rows/bucket (fill ratio numerator)")
+        self._flops = r.counter(
+            "pt_serving_batch_flops_total",
+            "XLA cost-analysis FLOPs of completed batches")
+        self._pipe_depth = r.gauge("pt_serving_pipeline_depth",
+                                   "Configured dispatch pipeline depth")
+        self._pipe_depth.set(1)
+        self._occ = r.gauge(
+            "pt_serving_device_queue_occupancy",
+            "Dispatched-not-completed batches at the last launch")
+        self._occ_max = r.gauge(
+            "pt_serving_device_queue_occupancy_max",
+            "High-water mark of device queue occupancy")
+        self._lat_hist = r.histogram(
+            "pt_serving_request_latency_seconds",
+            "Submit-to-result latency")
+        self._stage_hist = r.histogram(
+            "pt_serving_stage_seconds",
+            "Per-request time in each pipeline stage",
+            labelnames=("stage",))
+        self._stage_children = {s: self._stage_hist.labels(stage=s)
+                                for s in STAGES}
+        r.gauge("pt_serving_flops_per_second",
+                "Windowed rate of cost-analysis FLOPs served",
+                callback=self.flops_rate)
+        r.gauge("pt_serving_mfu",
+                "flops_per_second / (obs_peak_tflops * 1e12)",
+                callback=self.mfu)
         # latency ring (last N latencies, seconds) bounds the percentile
         # cost; rates count in separate per-second buckets so high
         # throughput can't push events out before their window expires
         self._lat: deque = deque(maxlen=latency_window)
+        self._stage_lat: Dict[str, deque] = {
+            s: deque(maxlen=latency_window) for s in STAGES}
         self._buckets: Dict[str, deque] = {
-            n: deque() for n in self.WINDOWED}  # name -> (whole_second, count)
+            n: deque() for n in self.WINDOWED}  # name -> (whole_second, amt)
+        # windowed FLOP/s (the MFU numerator) — the shared obs RateWindow,
+        # same mechanism the executor's pt_train_flops_per_second rides
+        self._flops_window = RateWindow(qps_window_s)
 
-    def _bump(self, name: str, now: Optional[float] = None) -> None:
-        """Record one event into its per-second window ring (lock held)."""
+    # -- legacy attribute surface (everything reads the registry) --
+    @property
+    def submitted(self) -> int:
+        return int(self._c["submitted"].value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._c["completed"].value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._c["rejected"].value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._c["failed"].value)
+
+    @property
+    def deadline_exceeded(self) -> int:
+        return int(self._c["deadline_exceeded"].value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._c["shed"].value)
+
+    @property
+    def reloads(self) -> int:
+        return int(self._reloads.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def rows(self) -> int:
+        return int(self._rows.value)
+
+    @property
+    def single_request_batches(self) -> int:
+        return int(self._single.value)
+
+    @property
+    def pipeline_depth(self) -> int:
+        return int(self._pipe_depth.value)
+
+    @property
+    def device_queue_occupancy(self) -> int:
+        return int(self._occ.value)
+
+    @property
+    def device_queue_occupancy_max(self) -> int:
+        return int(self._occ_max.value)
+
+    def _bump(self, name: str, amount: float = 1.0,
+              now: Optional[float] = None) -> None:
+        """Record ``amount`` into a per-second window ring (lock held)."""
         now = time.monotonic() if now is None else now
         ring = self._buckets[name]
         sec = int(now)
         if ring and ring[-1][0] == sec:
-            ring[-1] = (sec, ring[-1][1] + 1)
+            ring[-1] = (sec, ring[-1][1] + amount)
         else:
-            ring.append((sec, 1))
+            ring.append((sec, amount))
         horizon = int(now - self.qps_window_s) - 1
         while ring and ring[0][0] < horizon:
             ring.popleft()
 
     # -- recording (called from submit/dispatch paths) --
     def record_submit(self) -> None:
+        self._c["submitted"].inc()
         with self._lock:
-            self.submitted += 1
             self._bump("submitted")
 
     def record_reject(self) -> None:
+        self._c["rejected"].inc()
         with self._lock:
-            self.rejected += 1
             self._bump("rejected")
 
     def record_failure(self, n: int = 1) -> None:
+        self._c["failed"].inc(n)
         with self._lock:
-            self.failed += n
-            for _ in range(n):
-                self._bump("failed")
+            self._bump("failed", n)
 
     def record_deadline(self, n: int = 1) -> None:
         """A request shed at coalesce time: its deadline had passed."""
+        self._c["deadline_exceeded"].inc(n)
         with self._lock:
-            self.deadline_exceeded += n
-            for _ in range(n):
-                self._bump("deadline_exceeded")
+            self._bump("deadline_exceeded", n)
 
     def record_shed(self) -> None:
         """A request probabilistically shed while the server was degraded."""
+        self._c["shed"].inc()
         with self._lock:
-            self.shed += 1
             self._bump("shed")
 
     def record_reload(self) -> None:
-        with self._lock:
-            self.reloads += 1
+        self._reloads.inc()
 
-    def record_batch(self, rows: int, bucket: int, requests: int = 1) -> None:
+    def record_batch(self, rows: int, bucket: int, requests: int = 1,
+                     flops: Optional[float] = None) -> None:
+        self._batches.inc()
+        self._rows.inc(rows)
+        self._fill.inc(rows / max(bucket, 1))
+        if requests == 1:
+            self._single.inc()
+        if flops:
+            self._flops.inc(flops)
+            self._flops_window.add(flops)
+
+    def record_stage(self, stage: str, seconds: float) -> None:
+        """One request spent ``seconds`` in ``stage`` (STAGES member)."""
+        child = self._stage_children.get(stage)
+        if child is None:  # unknown stage: register rather than drop
+            child = self._stage_hist.labels(stage=stage)
+            self._stage_children[stage] = child
+            with self._lock:
+                self._stage_lat.setdefault(
+                    stage, deque(maxlen=self._lat.maxlen))
+        child.observe(seconds)
         with self._lock:
-            self.batches += 1
-            self.rows += rows
-            self._fill_sum += rows / max(bucket, 1)
-            if requests == 1:
-                self.single_request_batches += 1
+            self._stage_lat[stage].append(seconds)
 
     def set_pipeline_depth(self, depth: int) -> None:
-        with self._lock:
-            self.pipeline_depth = int(depth)
+        self._pipe_depth.set(int(depth))
 
     def record_pipeline(self, occupancy: int) -> None:
         """Device-queue occupancy sampled at each dispatch launch."""
+        occ = int(occupancy)
+        self._occ.set(occ)
         with self._lock:
-            self.device_queue_occupancy = int(occupancy)
-            self.device_queue_occupancy_max = max(
-                self.device_queue_occupancy_max, int(occupancy))
+            if occ > self._occ_max.value:
+                self._occ_max.set(occ)
 
     def record_done(self, latency_s: float) -> None:
+        self._c["completed"].inc()
+        self._lat_hist.observe(latency_s)
         with self._lock:
-            self.completed += 1
             self._lat.append(latency_s)
             self._bump("completed")
 
@@ -165,6 +290,36 @@ class ServingStats:
             return sum(c for sec, c in self._buckets[name]
                        if now - sec <= window_s)
 
+    def flops_rate(self) -> float:
+        """Windowed FLOP/s actually served (the MFU numerator)."""
+        return self._flops_window.rate()
+
+    def mfu(self) -> float:
+        from ..obs.cost import peak_flops
+
+        peak = peak_flops()
+        return self.flops_rate() / peak if peak > 0 else 0.0
+
+    def stage_summary(self) -> Dict[str, Dict[str, float]]:
+        """{stage: {count, mean_ms, p50_ms, p95_ms, p99_ms}} over the
+        retained window — what serve_bench prints as the breakdown."""
+        with self._lock:
+            snap = {s: sorted(d) for s, d in self._stage_lat.items() if d}
+        out = {}
+        for s, vals in snap.items():
+            out[s] = {
+                "count": len(vals),
+                "mean_ms": sum(vals) / len(vals) * 1e3,
+                "p50_ms": _percentile(vals, 0.50) * 1e3,
+                "p95_ms": _percentile(vals, 0.95) * 1e3,
+                "p99_ms": _percentile(vals, 0.99) * 1e3,
+            }
+        return out
+
+    def expose(self) -> str:
+        """Prometheus text exposition of this stats object's registry."""
+        return self.registry.expose()
+
     def snapshot(self, extra: Optional[Dict] = None) -> Dict:
         with self._lock:
             now = time.monotonic()
@@ -173,35 +328,40 @@ class ServingStats:
                              if now - sec <= self.qps_window_s)
                       for n, ring in self._buckets.items()}
             horizon = min(self.qps_window_s, max(now - self._t0, 1e-9))
-            snap = {
-                "uptime_s": now - self._t0,
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "rejected": self.rejected,
-                "failed": self.failed,
-                "deadline_exceeded": self.deadline_exceeded,
-                "shed": self.shed,
-                "reloads": self.reloads,
-                "batches": self.batches,
-                "rows": self.rows,
-                "qps": recent["completed"] / horizon,
-                "recent": recent,
-                "latency_ms": {
-                    "p50": _percentile(lats, 0.50) * 1e3,
-                    "p95": _percentile(lats, 0.95) * 1e3,
-                    "p99": _percentile(lats, 0.99) * 1e3,
-                },
-                "avg_batch_rows": self.rows / self.batches if self.batches else 0.0,
-                "batch_fill_ratio": (self._fill_sum / self.batches
-                                     if self.batches else 0.0),
-                "single_request_batches": self.single_request_batches,
-                "pipeline": {
-                    "depth": self.pipeline_depth,
-                    "device_queue_occupancy": self.device_queue_occupancy,
-                    "device_queue_occupancy_max":
-                        self.device_queue_occupancy_max,
-                },
-            }
+        batches = self.batches
+        snap = {
+            "uptime_s": now - self._t0,
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "failed": self.failed,
+            "deadline_exceeded": self.deadline_exceeded,
+            "shed": self.shed,
+            "reloads": self.reloads,
+            "batches": batches,
+            "rows": self.rows,
+            "qps": recent["completed"] / horizon,
+            "recent": recent,
+            "latency_ms": {
+                "mean": (sum(lats) / len(lats) * 1e3) if lats else 0.0,
+                "p50": _percentile(lats, 0.50) * 1e3,
+                "p95": _percentile(lats, 0.95) * 1e3,
+                "p99": _percentile(lats, 0.99) * 1e3,
+            },
+            "avg_batch_rows": self.rows / batches if batches else 0.0,
+            "batch_fill_ratio": (self._fill.value / batches
+                                 if batches else 0.0),
+            "single_request_batches": self.single_request_batches,
+            "pipeline": {
+                "depth": self.pipeline_depth,
+                "device_queue_occupancy": self.device_queue_occupancy,
+                "device_queue_occupancy_max":
+                    self.device_queue_occupancy_max,
+            },
+            "stages_ms": self.stage_summary(),
+            "flops_per_s": self.flops_rate(),
+            "mfu": self.mfu(),
+        }
         if extra:
             snap.update(extra)
         return snap

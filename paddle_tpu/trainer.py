@@ -13,6 +13,7 @@ orchestration — it never fragments the compiled computation.
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -156,6 +157,9 @@ class Trainer:
                 for batch in reader():
                     yield feeder.feed(batch) if feeder else batch
 
+        from .obs import get_tracer, init_from_flags
+        tracer = init_from_flags()  # PT_FLAG_OBS_TRACE turns spans on here
+
         step_count = 0
         for epoch in range(num_epochs):
             event_handler(BeginEpochEvent(epoch))
@@ -165,12 +169,23 @@ class Trainer:
                 begin = BeginStepEvent(epoch, step)
                 begin.fetch_metrics = (step % log_every == 0)
                 event_handler(begin)
-                metrics = self.exe.run(
-                    self.train_program, feed=feed,
-                    fetch_list=fetch if begin.fetch_metrics else [],
-                    scope=self.scope, return_numpy=False)
-                # host conversion (the sync point) only on fetch steps
-                metrics = [np.asarray(m) for m in (metrics or [])]
+                t_step = time.monotonic()
+                with tracer.span("train/step", cat="train", epoch=epoch,
+                                 step=step, fetch=begin.fetch_metrics):
+                    metrics = self.exe.run(
+                        self.train_program, feed=feed,
+                        fetch_list=fetch if begin.fetch_metrics else [],
+                        scope=self.scope, return_numpy=False)
+                    # host conversion (the sync point) only on fetch steps
+                    metrics = [np.asarray(m) for m in (metrics or [])]
+                if tracer.enabled:
+                    dur = time.monotonic() - t_step
+                    if tracer.exemplars.would_retain(dur):
+                        # p99 exemplar: keep the slow step's full span list
+                        tracer.exemplars.offer(
+                            f"step-e{epoch}-s{step}", dur,
+                            [s.to_dict() for s in tracer.spans()
+                             if s.t0 >= t_step - 1e-6])
                 event_handler(EndStepEvent(epoch, step, metrics))
                 step_count += 1
                 if (self.checkpoint_cfg

@@ -1,0 +1,505 @@
+"""paddle_tpu.obs: span tracer, metrics registry, Prometheus exposition,
+trace-ID propagation, exemplars — the ISSUE 5 acceptance surface.
+
+Contract highlights:
+* tracer disabled = ZERO allocation on the hot path (shared no-op);
+* the ring is bounded (a serving process cannot leak through telemetry);
+* /metrics output is scrape-parseable Prometheus text with monotone
+  counters;
+* a trace id sent by ``ServingClient.predict`` comes back verbatim with
+  per-stage timings that sum to ~the request latency;
+* ``ServingStats.snapshot()`` keeps its pre-refactor keys while the same
+  numbers ride the registry (one source of truth).
+"""
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io, obs
+from paddle_tpu.obs import (ExemplarStore, MetricsRegistry, MetricsServer,
+                            Tracer)
+from paddle_tpu.serving import ServingClient, ServingServer, ServingStats
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    np.random.seed(11)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        d = str(tmp_path_factory.mktemp("obs") / "model")
+        io.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+    return d
+
+
+# -- tracer core ----------------------------------------------------------
+
+def test_disabled_tracer_is_allocation_free():
+    t = Tracer()
+    assert not t.enabled
+    a = t.span("anything", cat="x", foo=1)
+    b = t.span("else")
+    assert a is b, "disabled span() must return the shared no-op singleton"
+    with a:
+        pass
+    assert len(t) == 0
+    # add_span is an early-return no-op too
+    assert t.add_span("x", 0.0, 1.0) == 0
+    assert len(t) == 0
+
+
+def test_span_nesting_links_parents():
+    t = Tracer()
+    t.enable()
+    with t.span("outer"):
+        with t.span("mid"):
+            with t.span("leaf"):
+                pass
+        with t.span("mid2"):
+            pass
+    by_name = {s.name: s for s in t.spans()}
+    assert by_name["leaf"].parent == by_name["mid"].sid
+    assert by_name["mid"].parent == by_name["outer"].sid
+    assert by_name["mid2"].parent == by_name["outer"].sid
+    assert by_name["outer"].parent == 0
+    # durations nest: outer covers its children
+    assert by_name["outer"].dur >= by_name["mid"].dur + by_name["mid2"].dur
+
+
+def test_ring_buffer_is_bounded():
+    t = Tracer(capacity=16)
+    t.enable()
+    for i in range(100):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t) == 16
+    assert t.dropped == 84
+    names = [s.name for s in t.spans()]
+    assert names == [f"s{i}" for i in range(84, 100)], "oldest-first order"
+
+
+def test_tracer_thread_safety():
+    t = Tracer(capacity=100000)
+    t.enable()
+    errs = []
+
+    def worker(w):
+        try:
+            for i in range(200):
+                with t.span("outer", w=w):
+                    with t.span("inner"):
+                        pass
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    spans = t.spans()
+    assert len(spans) == 8 * 200 * 2
+    # every inner's parent is an outer recorded on the SAME thread
+    outers = {s.sid: s for s in spans if s.name == "outer"}
+    for s in spans:
+        if s.name == "inner":
+            assert s.parent in outers
+            assert outers[s.parent].tid == s.tid
+
+
+def test_chrome_trace_export_valid():
+    t = Tracer()
+    t.enable()
+    with t.span("a", cat="serving", trace_id="t1", rows=3):
+        pass
+    trace = t.to_chrome_trace()
+    payload = json.loads(json.dumps(trace))  # round-trippable
+    xs = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 1
+    e = xs[0]
+    assert e["name"] == "a" and e["cat"] == "serving"
+    assert e["ts"] >= 0 and e["dur"] >= 0
+    assert e["args"]["trace_id"] == "t1" and e["args"]["rows"] == 3
+
+
+def test_exemplar_store_retains_slowest():
+    es = ExemplarStore(3)
+    for i, d in enumerate([0.5, 0.1, 0.9, 0.2, 0.7, 0.05]):
+        es.offer(f"k{i}", d, [{"name": "x", "dur_ms": d * 1e3}])
+    snap = es.snapshot()
+    assert [e["key"] for e in snap] == ["k2", "k4", "k0"]  # 0.9, 0.7, 0.5
+    assert es.would_retain(0.6) and not es.would_retain(0.4)
+
+
+# -- metrics registry -----------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? ([0-9eE.+-]+|NaN|\+Inf)$")
+
+
+def _assert_scrape_parseable(text):
+    """Every non-comment line must match the Prometheus text format and
+    every samples block must be preceded by HELP/TYPE for its family."""
+    assert text.endswith("\n")
+    seen_type = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) >= 3, line
+            if parts[1] == "TYPE":
+                seen_type[parts[2]] = parts[3]
+            continue
+        assert _PROM_LINE.match(line), f"unparseable sample line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in seen_type or family in seen_type, \
+            f"sample {name} has no TYPE header"
+
+
+def test_prometheus_exposition_format_and_monotonicity():
+    r = MetricsRegistry()
+    c = r.counter("pt_x_total", "events", labelnames=("event",))
+    c.labels(event="a").inc()
+    g = r.gauge("pt_depth", "queue depth")
+    g.set(3)
+    h = r.histogram("pt_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(2.0)
+    text1 = r.expose()
+    _assert_scrape_parseable(text1)
+    assert 'pt_x_total{event="a"} 1' in text1
+    assert 'pt_lat_seconds_bucket{le="+Inf"} 2' in text1
+    assert "pt_lat_seconds_count 2" in text1
+    # counters are monotone: more events -> value never decreases
+    c.labels(event="a").inc(5)
+    text2 = r.expose()
+    v1 = float(re.search(r'pt_x_total\{event="a"\} (\S+)', text1).group(1))
+    v2 = float(re.search(r'pt_x_total\{event="a"\} (\S+)', text2).group(1))
+    assert v2 >= v1
+    with pytest.raises(ValueError):
+        c.labels(event="a").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        r.gauge("pt_x_total", "re-register as another type")
+
+
+def test_histogram_buckets_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("pt_h_seconds", "h", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.05, 5.0):
+        h.observe(v)
+    text = r.expose()
+    assert 'pt_h_seconds_bucket{le="0.001"} 1' in text
+    assert 'pt_h_seconds_bucket{le="0.01"} 2' in text
+    assert 'pt_h_seconds_bucket{le="0.1"} 3' in text
+    assert 'pt_h_seconds_bucket{le="+Inf"} 4' in text
+
+
+def test_metrics_server_scrape():
+    r = MetricsRegistry()
+    r.counter("pt_scrape_total", "scrapes").inc(2)
+    with MetricsServer(registry=r) as ms:
+        body = urllib.request.urlopen(
+            f"http://{ms.endpoint}/metrics", timeout=10).read().decode()
+        _assert_scrape_parseable(body)
+        assert "pt_scrape_total 2" in body
+        ok = urllib.request.urlopen(
+            f"http://{ms.endpoint}/healthz", timeout=10).read()
+        assert ok == b"ok\n"
+
+
+# -- ServingStats registry refactor --------------------------------------
+
+def test_serving_stats_snapshot_parity():
+    """The pre-refactor snapshot keys and counter semantics survive the
+    registry refactor, and the registry carries the SAME numbers."""
+    s = ServingStats()
+    s.record_submit()
+    s.record_submit()
+    s.record_reject()
+    s.record_deadline()
+    s.record_shed()
+    s.record_failure(2)
+    s.record_batch(rows=6, bucket=8, requests=2, flops=1000.0)
+    s.record_batch(rows=1, bucket=1, requests=1)
+    s.record_done(0.010)
+    s.record_done(0.030)
+    s.set_pipeline_depth(2)
+    s.record_pipeline(2)
+    s.record_pipeline(1)
+    s.record_reload()
+    snap = s.snapshot(extra={"state": "healthy"})
+    # pre-refactor key set (PR 1-4 contract), verbatim
+    for key in ("uptime_s", "submitted", "completed", "rejected", "failed",
+                "deadline_exceeded", "shed", "reloads", "batches", "rows",
+                "qps", "recent", "latency_ms", "avg_batch_rows",
+                "batch_fill_ratio", "single_request_batches", "pipeline"):
+        assert key in snap, f"snapshot lost pre-refactor key {key!r}"
+    assert snap["submitted"] == 2 and snap["completed"] == 2
+    assert snap["rejected"] == 1 and snap["failed"] == 2
+    assert snap["deadline_exceeded"] == 1 and snap["shed"] == 1
+    assert snap["reloads"] == 1
+    assert snap["batches"] == 2 and snap["rows"] == 7
+    assert snap["single_request_batches"] == 1
+    assert snap["avg_batch_rows"] == pytest.approx(3.5)
+    assert snap["batch_fill_ratio"] == pytest.approx((6 / 8 + 1) / 2)
+    assert snap["pipeline"]["depth"] == 2
+    assert snap["pipeline"]["device_queue_occupancy"] == 1
+    assert snap["pipeline"]["device_queue_occupancy_max"] == 2
+    assert snap["latency_ms"]["p50"] == pytest.approx(10.0, rel=0.2)
+    assert snap["recent"]["submitted"] == 2
+    assert snap["state"] == "healthy"  # extra merge kept
+    # attribute surface kept too (server.py health machine reads these)
+    assert s.submitted == 2 and s.deadline_exceeded == 1
+    assert s.recent("completed") == 2
+    # ONE source of truth: the registry text carries the same numbers
+    text = s.expose()
+    _assert_scrape_parseable(text)
+    assert 'pt_serving_requests_total{event="submitted"} 2' in text
+    assert "pt_serving_batches_total 2" in text
+    assert "pt_serving_rows_total 7" in text
+    assert "pt_serving_batch_flops_total 1000" in text
+    assert "pt_serving_request_latency_seconds_count 2" in text
+
+
+def test_serving_stats_stage_summary():
+    s = ServingStats()
+    for ms in (1, 2, 3, 4, 5):
+        s.record_stage("queue_wait", ms / 1e3)
+    out = s.stage_summary()
+    assert out["queue_wait"]["count"] == 5
+    assert out["queue_wait"]["mean_ms"] == pytest.approx(3.0, rel=0.01)
+    text = s.expose()
+    assert 'pt_serving_stage_seconds_count{stage="queue_wait"} 5' in text
+
+
+# -- end-to-end serving round trip ----------------------------------------
+
+def test_trace_id_round_trip_and_stage_timings(model_dir):
+    tracer = obs.get_tracer()
+    tracer.enable()
+    tracer.clear()
+    try:
+        with ServingServer(model_dir, max_batch_size=8,
+                           batch_timeout_ms=1.0) as srv:
+            with ServingClient(srv.endpoint) as c:
+                x = np.random.randn(2, 4).astype("float32")
+                my_id = "feedcafe00112233"
+                out = c.predict({"x": x}, trace=my_id)
+                assert out[0].shape == (2, 3)
+                tr = c.last_trace
+                assert tr is not None
+                assert tr["trace_id"] == my_id, "trace id must round-trip"
+                stages = tr["stages_ms"]
+                for st in ("pad", "queue_wait", "coalesce", "dispatch",
+                           "pipeline_wait", "device_sync", "scatter",
+                           "total"):
+                    assert st in stages, f"missing stage {st}"
+                parts = sum(v for k, v in stages.items() if k != "total")
+                # the per-stage decomposition accounts for the latency
+                assert parts == pytest.approx(stages["total"], rel=0.10)
+                # trace=True mints an id; trace omitted -> no trace block
+                c.predict({"x": x}, trace=True)
+                assert c.last_trace["trace_id"]
+                c.predict({"x": x})
+                assert c.last_trace is None
+        # the server-side spans carry the propagated id
+        tagged = tracer.spans(trace_id=my_id)
+        assert any(s.name == "serve/request" for s in tagged)
+        stage_names = {s.name for s in tagged}
+        assert {"serve/queue_wait", "serve/dispatch",
+                "serve/device_sync"} <= stage_names
+        # exemplars retained the request's full stage list
+        keys = [e["key"] for e in tracer.exemplars.snapshot()]
+        assert my_id in keys
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
+def test_serving_server_metrics_endpoint(model_dir):
+    with ServingServer(model_dir, max_batch_size=8,
+                       batch_timeout_ms=1.0) as srv:
+        with ServingClient(srv.endpoint) as c:
+            x = np.random.randn(1, 4).astype("float32")
+            for _ in range(3):
+                c.predict({"x": x})
+            # line-JSON verb
+            text = c.metrics()
+            _assert_scrape_parseable(text)
+            assert 'pt_serving_requests_total{event="completed"} 3' in text
+            assert "pt_serving_pipeline_depth 2" in text
+            assert "pt_serving_device_queue_occupancy" in text
+            assert "pt_serving_mfu" in text
+            assert "pt_serving_queue_depth" in text
+            assert "pt_serving_healthy 1" in text
+        # plain HTTP GET on the same port (the Prometheus scrape path)
+        body = urllib.request.urlopen(
+            f"http://{srv.endpoint}/metrics", timeout=10).read().decode()
+        _assert_scrape_parseable(body)
+        assert 'pt_serving_requests_total{event="completed"} 3' in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://{srv.endpoint}/healthz", timeout=10).read().decode())
+        assert hz["ok"] is True
+
+
+def test_engine_compile_cache_flops_annotation(model_dir):
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model_dir, max_batch_size=4)
+    eng.run_batch({"x": np.random.randn(2, 4).astype("float32")})
+    info = eng.cache_info()
+    assert info["misses"] == 1 and info["flops_annotated"] == 1
+    entry = next(iter(eng._cache.values()))
+    assert entry.flops and entry.flops > 0
+    assert entry.compile_s and entry.compile_s > 0  # cold-dispatch latency
+
+
+# -- training-plane instruments -------------------------------------------
+
+def test_executor_flops_and_train_metrics():
+    """Training-side FLOPs annotation is paid only when the obs plane is
+    live (tracer on / flag explicitly set) — here: tracer on."""
+    from paddle_tpu.obs import get_registry
+
+    tracer = obs.get_tracer()
+    tracer.enable()
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.fc(x, size=4)
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.SGD(0.1).minimize(loss, startup)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        before = get_registry().counter("pt_train_steps_total").value
+        exe.run(main, feed={"x": np.zeros((2, 8), "float32")},
+                fetch_list=[loss.name], scope=scope)
+        exe.run(main, feed={"x": np.zeros((2, 8), "float32")},
+                fetch_list=[loss.name], scope=scope)
+        r = get_registry()
+        assert r.counter("pt_train_steps_total").value == before + 2
+        assert r.counter("pt_train_step_flops_total").value > 0
+        assert r.get("pt_train_mfu") is not None
+        text = r.expose()
+        assert "pt_train_flops_per_second" in text
+        # per-key flops memoized: one annotation for two runs of one sig
+        assert len(exe._flops) == 2  # startup program + main program
+    tracer.disable()
+    tracer.clear()
+
+
+def test_tracer_spans_on_training_hot_path():
+    tracer = obs.get_tracer()
+    tracer.enable()
+    tracer.clear()
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[8], dtype="float32")
+                loss = fluid.layers.mean(fluid.layers.fc(x, size=4))
+                fluid.optimizer.SGD(0.1).minimize(loss, startup)
+            scope = fluid.Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope)
+            exe.run(main, feed={"x": np.zeros((2, 8), "float32")},
+                    fetch_list=[loss.name], scope=scope)
+            feeds = [{"x": np.zeros((2, 8), "float32")} for _ in range(3)]
+            exe.run_steps(main, feeds, fetch_list=[loss.name], scope=scope)
+        names = {s.name for s in tracer.spans()}
+        assert "train/host_prep" in names
+        assert "train/device_dispatch" in names
+        assert "train/fetch_sync" in names
+        assert "train/device_window" in names  # run_steps window
+        assert any(n.startswith("train/executor_compile") for n in names)
+        # profiler.RecordEvent re-emission into the tracer
+        assert any(n.startswith("executor_run") for n in names)
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
+def test_disabled_tracer_no_overhead_on_serving(model_dir):
+    """With the tracer off the batcher/server must not allocate spans or
+    tag requests (the zero-cost contract)."""
+    tracer = obs.get_tracer()
+    assert not tracer.enabled
+    tracer.clear()
+    with ServingServer(model_dir, max_batch_size=8,
+                       batch_timeout_ms=1.0) as srv:
+        with ServingClient(srv.endpoint) as c:
+            x = np.random.randn(1, 4).astype("float32")
+            c.predict({"x": x})
+    assert len(tracer) == 0
+    assert not tracer.exemplars.snapshot()
+
+
+# -- trace tooling --------------------------------------------------------
+
+def test_paddle_cli_trace_report(tmp_path):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "paddle_cli", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "paddle_cli.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    t = Tracer()
+    t.enable()
+    with t.span("serve/request", trace_id="aa11"):
+        with t.span("serve/dispatch"):
+            time.sleep(0.002)
+    path = tmp_path / "trace.json"
+    t.dump(str(path))
+    events = cli.load_trace(str(path))
+    assert len(events) == 2
+    st = cli.self_times(events)
+    assert st["serve/request"][0] == 1
+    # parent total >= child total; self-time subtracts the child
+    assert st["serve/request"][1] >= st["serve/dispatch"][1]
+    assert st["serve/request"][2] <= st["serve/request"][1]
+    report = cli.trace_report(events)
+    assert "serve/request" in report and "stage histogram" in report
+    assert "aa11" in report  # slowest traced requests section
+
+
+def test_timeline_merges_obs_trace(tmp_path):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "timeline", os.path.join(os.path.dirname(__file__), "..",
+                                 "tools", "timeline.py"))
+    tl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tl)
+
+    t = Tracer()
+    t.enable()
+    with t.span("obs_span"):
+        pass
+    profile = {"events": [{"name": "host_ev", "start": 0.0, "dur": 0.001,
+                           "tid": 1}]}
+    merged = json.loads(tl.to_chrome_trace(
+        profile, obs_trace=t.to_chrome_trace()))
+    names = {e["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"host_ev", "obs_span"} <= names
+    pids = {e.get("pid") for e in merged["traceEvents"]
+            if e.get("ph") == "X"}
+    assert pids == {0, 1}

@@ -5,12 +5,18 @@ Subcommands:
   train    — launch a local training run of a benchmark model
              (the paddle_trainer role; flags forward to the benchmark driver)
   version  — print framework/runtime versions
+  trace    — summarize a Chrome-trace JSON (obs tracer / timeline.py
+             output) without a browser: top spans by SELF time (child
+             spans subtracted), per-stage duration histogram, slowest
+             trace_ids. ``--convert OUT`` re-emits a normalized trace.
 """
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -38,10 +44,115 @@ def cmd_train(argv):
     os.execv(sys.executable, [sys.executable, driver] + argv)
 
 
+# -- trace inspection ------------------------------------------------------
+_HIST_BUCKETS_MS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                    1000, float("inf"))
+
+
+def load_trace(path):
+    """Chrome-trace JSON -> list of complete ('X') event dicts."""
+    with open(path) as f:
+        obj = json.load(f)
+    events = obj.get("traceEvents", obj) if isinstance(obj, dict) else obj
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def self_times(events):
+    """name -> (count, total_us, self_us). Children are detected by strict
+    time containment on the same (pid, tid) lane — works on any Chrome
+    trace, not just ones carrying explicit parent links."""
+    by_lane = defaultdict(list)
+    for e in events:
+        by_lane[(e.get("pid", 0), e.get("tid", 0))].append(e)
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # count, total, self
+    for lane in by_lane.values():
+        lane.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack = []  # (end_ts, event, child_total)
+        def pop_until(ts):
+            while stack and stack[-1][0] <= ts + 1e-9:
+                end, ev, child = stack.pop()
+                rec = agg[ev["name"]]
+                rec[0] += 1
+                rec[1] += ev.get("dur", 0.0)
+                rec[2] += max(ev.get("dur", 0.0) - child, 0.0)
+                if stack:
+                    stack[-1][2] += ev.get("dur", 0.0)
+        for e in lane:
+            pop_until(e["ts"])
+            stack.append([e["ts"] + e.get("dur", 0.0), e, 0.0])
+        pop_until(float("inf"))
+    return {n: tuple(v) for n, v in agg.items()}
+
+
+def stage_histogram(events):
+    """name -> per-_HIST_BUCKETS_MS counts of span durations."""
+    hist = defaultdict(lambda: [0] * len(_HIST_BUCKETS_MS))
+    for e in events:
+        ms = e.get("dur", 0.0) / 1e3
+        for i, b in enumerate(_HIST_BUCKETS_MS):
+            if ms <= b:
+                hist[e["name"]][i] += 1
+                break
+    return dict(hist)
+
+
+def trace_report(events, top=15):
+    """Human-readable summary (also what tests assert against)."""
+    lines = []
+    st = sorted(self_times(events).items(), key=lambda kv: -kv[1][2])
+    lines.append(f"{'span':<38}{'calls':>7}{'total_ms':>12}{'self_ms':>12}")
+    for name, (count, total, self_us) in st[:top]:
+        lines.append(f"{name:<38}{count:>7}{total / 1e3:>12.3f}"
+                     f"{self_us / 1e3:>12.3f}")
+    hist = stage_histogram(events)
+    lines.append("")
+    lines.append("stage histogram (span count per duration bucket, ms):")
+    labels = [("<=" + (f"{b:g}" if b != float("inf") else "inf"))
+              for b in _HIST_BUCKETS_MS]
+    for name in sorted(hist):
+        nz = [(l, c) for l, c in zip(labels, hist[name]) if c]
+        lines.append(f"  {name}: " + " ".join(f"{l}:{c}" for l, c in nz))
+    slow = sorted((e for e in events
+                   if e.get("args", {}).get("trace_id")),
+                  key=lambda e: -e.get("dur", 0.0))
+    if slow:
+        lines.append("")
+        lines.append("slowest traced requests:")
+        for e in slow[:5]:
+            lines.append(f"  {e['args']['trace_id']}  {e['name']}  "
+                         f"{e.get('dur', 0.0) / 1e3:.3f}ms")
+    return "\n".join(lines)
+
+
+def cmd_trace(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py trace",
+        description="summarize/convert a Chrome-trace JSON")
+    ap.add_argument("path", help="trace file (obs dump / timeline.py out)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="rows in the self-time table")
+    ap.add_argument("--convert", metavar="OUT",
+                    help="also write a normalized pretty-printed trace")
+    args = ap.parse_args(argv)
+    events = load_trace(args.path)
+    if not events:
+        print(f"{args.path}: no complete ('X') trace events")
+        return 1
+    print(f"{args.path}: {len(events)} spans")
+    print(trace_report(events, top=args.top))
+    if args.convert:
+        with open(args.convert, "w") as f:
+            json.dump({"traceEvents": events}, f, indent=2)
+        print(f"normalized trace written to {args.convert}")
+    return 0
+
+
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle_cli.py {train|version} [args...]")
+        print("usage: paddle_cli.py {train|version|trace} [args...]")
         return 0
     sub = sys.argv[1]
     if sub == "version":
@@ -50,7 +161,9 @@ def main():
     if sub == "train":
         cmd_train(sys.argv[2:])
         return 0  # unreachable (execv)
-    print(f"unknown subcommand {sub!r}; use train|version")
+    if sub == "trace":
+        return cmd_trace(sys.argv[2:])
+    print(f"unknown subcommand {sub!r}; use train|version|trace")
     return 2
 
 

@@ -134,6 +134,10 @@ def main(argv=None):
     ap.add_argument("--chaos-window", type=float, default=None,
                     help="stop injecting after this many seconds (default: "
                          "half the bench duration)")
+    ap.add_argument("--trace-out", metavar="FILE",
+                    help="enable the obs span tracer and write a Chrome "
+                         "trace (chrome://tracing / ui.perfetto.dev) of "
+                         "the run; inspect with tools/paddle_cli.py trace")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.endpoint:
         ap.error("one of --model-dir / --endpoint is required")
@@ -147,6 +151,13 @@ def main(argv=None):
     for spec in args.shape:
         name, _, dims = spec.partition("=")
         shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+
+    tracer = None
+    if args.trace_out:
+        from paddle_tpu import obs
+
+        tracer = obs.enable()
+        tracer.clear()
 
     server = None
     chaos = None
@@ -207,8 +218,34 @@ def main(argv=None):
                   f"occupancy={p.get('device_queue_occupancy')} "
                   f"occupancy_max={p.get('device_queue_occupancy_max')} "
                   f"single_request_batches={s.get('single_request_batches')}")
+            stages = s.get("stages_ms") or {}
+            if stages:
+                # the per-stage breakdown the spans buy us: where a
+                # request's latency actually went (docs/design.md §15)
+                print("stage breakdown (per-request ms, "
+                      "mean/p95 over the retained window):")
+                order = ("pad", "queue_wait", "coalesce", "dispatch",
+                         "pipeline_wait", "device_sync", "scatter")
+                total_mean = 0.0
+                for st in order:
+                    d = stages.get(st)
+                    if not d:
+                        continue
+                    total_mean += d["mean_ms"]
+                    print(f"  {st:<14} mean={d['mean_ms']:8.3f}  "
+                          f"p95={d['p95_ms']:8.3f}  n={d['count']}")
+                srv_mean = s.get("latency_ms", {}).get("mean", 0.0)
+                print(f"  {'sum(means)':<14} {total_mean:13.3f}  "
+                      f"(vs server mean latency {srv_mean:.3f}ms)")
+            if s.get("flops_per_s"):
+                print(f"mfu: {s.get('mfu', 0.0):.3e} "
+                      f"(cost-analysis {s['flops_per_s'] / 1e9:.4f} GFLOP/s)")
             if "chaos" in s:
                 print(f"chaos: {s['chaos']}")
+        if tracer is not None:
+            n = tracer.dump(args.trace_out)
+            print(f"chrome trace: {args.trace_out} ({n} spans; "
+                  f"summarize with tools/paddle_cli.py trace)")
         return 0 if r["errors"] == 0 else 1
     finally:
         if server is not None:

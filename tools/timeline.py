@@ -43,11 +43,29 @@ class _ChromeTraceFormatter:
                           separators=None if pretty else (",", ":"))
 
 
-def to_chrome_trace(profile: dict, pretty=False) -> str:
+def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None) -> str:
+    """``obs_trace`` (an ``obs.Tracer.to_chrome_trace()`` dict or a loaded
+    dump file) merges into the same timeline: profiler host events land on
+    pid 0, obs spans on pid 1. When the obs dump carries its absolute
+    monotonic base (``t0_monotonic``, written by ``Tracer.to_chrome_trace``)
+    the obs lane is re-based onto the profiler's zero so the two planes are
+    genuinely time-aligned (both clocks are CLOCK_MONOTONIC on Linux — see
+    profiler.RecordEvent re-emission); without it the obs lane keeps its
+    own zero (distinguishable, alignment best-effort)."""
     f = _ChromeTraceFormatter()
     f.emit_pid("host", 0)
     events = profile.get("events", [])
     t0 = min((e["start"] for e in events), default=0.0)
+    obs_events = []
+    obs_shift_us = 0.0
+    if obs_trace:
+        obs_events = [e for e in obs_trace.get("traceEvents", [])
+                      if e.get("ph") == "X"]
+        if obs_events:
+            f.emit_pid("obs spans", 1)
+            obs_t0 = obs_trace.get("t0_monotonic")
+            if obs_t0 is not None and events:
+                obs_shift_us = (float(obs_t0) - t0) * 1e6
     for e in events:
         f.emit_region(
             timestamp_us=(e["start"] - t0) * 1e6,
@@ -57,6 +75,11 @@ def to_chrome_trace(profile: dict, pretty=False) -> str:
             category="host",
             name=e["name"],
         )
+    for e in obs_events:
+        f.emit_region(
+            timestamp_us=e["ts"] + obs_shift_us, duration_us=e["dur"],
+            pid=1, tid=e.get("tid", 0), category=e.get("cat", "obs"),
+            name=e["name"], args=e.get("args"))
     return f.format_to_string(pretty)
 
 
@@ -66,11 +89,18 @@ def main():
                         help="profile JSON from paddle_tpu.profiler.dump_profile")
     parser.add_argument("--timeline_path", type=str, required=True,
                         help="output Chrome-trace JSON")
+    parser.add_argument("--obs_path", type=str, default=None,
+                        help="optional obs tracer Chrome-trace dump "
+                             "(obs.get_tracer().dump(...)) to merge in")
     args = parser.parse_args()
     with open(args.profile_path) as f:
         profile = json.load(f)
+    obs_trace = None
+    if args.obs_path:
+        with open(args.obs_path) as f:
+            obs_trace = json.load(f)
     with open(args.timeline_path, "w") as f:
-        f.write(to_chrome_trace(profile, pretty=True))
+        f.write(to_chrome_trace(profile, pretty=True, obs_trace=obs_trace))
     print("timeline written to", args.timeline_path)
 
 
