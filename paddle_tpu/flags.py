@@ -39,6 +39,14 @@ _DEFAULTS: Dict[str, Any] = {
     # >=1024-wide outputs); tests lower them to route small shapes.
     "pallas_dw_min_k": 4096,
     "pallas_dw_min_mn": 512,
+    # decode serving (serving/decode.py, docs/design.md §16): default KV
+    # slot-pool size for DecodeEngine (one slot = one in-flight generation;
+    # the pool is [layers, slots+1, max_len, heads, d_head] device-resident
+    # K and V) and the chunked-prefill size (0 = prefill the whole prompt
+    # as one power-of-two bucket; N > 0 = N-token chunks so long prompts
+    # never stall in-flight decode lanes for their whole length)
+    "decode_max_slots": 8,
+    "decode_prefill_chunk": 0,
     # observability plane (paddle_tpu/obs, docs/design.md §15): obs_trace
     # turns the span tracer on (zero-cost disabled — instrumentation sites
     # hand back a shared no-op); capacity bounds the finished-span ring.

@@ -213,6 +213,355 @@ def transformer_lm(ids, labels, vocab_size: int, max_len: int,
     return logits, avg_loss
 
 
+# ---------------------------------------------------------------------------
+# Incremental-decode export (serving/decode.py consumes this)
+# ---------------------------------------------------------------------------
+#
+# The IR program is a whole-sequence forward: logits over every position of a
+# fixed [N, T] window. Served as a generator that shape is ruinous — every new
+# token would recompute the entire prefix. The decode export re-expresses the
+# SAME parameters as two pure-jax entry points over a slot-pooled KV cache:
+#
+#   * prefill — prompt chunk in, K/V written into the pool, next-token out;
+#   * step    — one token per in-flight generation, batched over slots.
+#
+# Rather than asking the caller to re-describe the architecture, the export
+# RECOVERS it from the exported inference program itself: fc/attention weight
+# names are the canonical ParamAttr names, while auto-named parameters
+# (layer norms, fc biases) are found by walking the program's ops in dataflow
+# order. That keeps one source of truth — whatever transformer_lm traced is
+# what decodes — and makes the export validate loudly when pointed at a
+# program that is not a causal transformer LM.
+
+
+def _producer_consumer_maps(block):
+    producer, consumers = {}, {}
+    for op in block.ops:
+        for outs in op.outputs.values():
+            for n in outs:
+                producer[n] = op
+        for ins in op.inputs.values():
+            for n in ins:
+                consumers.setdefault(n, []).append(op)
+    return producer, consumers
+
+
+def decode_roles(program):
+    """Map an exported ``transformer_lm`` inference program's parameters to
+    decode roles by walking its ops.
+
+    Returns ``(roles, cfg)`` where ``roles`` mirrors the decode params
+    pytree with parameter NAMES at the leaves::
+
+        {"emb": str, "pos": str, "lnf_s": str, "lnf_b": str,
+         "out_w": str, ["out_b": str],
+         "layers": [{"ln1_s", "ln1_b", "wq"|"wqkv", "wk", "wv", "wo",
+                     "ln2_s", "ln2_b", "wup", ["bup"], "wdown",
+                     ["bdown"]}, ...]}
+
+    and ``cfg`` carries the recovered architecture
+    (n_layers/n_heads/d_model/d_ff/vocab/max_len/eps). Raises ``ValueError``
+    on anything that is not the causal-LM shape ``transformer_lm`` traces.
+    """
+    blk = program.global_block()
+    producer, consumers = _producer_consumer_maps(blk)
+
+    def persistable(n):
+        v = blk.find_var_recursive(n)
+        return v is not None and v.persistable
+
+    def var_shape(n):
+        v = blk.find_var_recursive(n)
+        return tuple(v.shape) if v is not None and v.shape else None
+
+    lookups = [op for op in blk.ops if op.type == "lookup_table"]
+    if len(lookups) != 1:
+        raise ValueError(
+            f"decode export expects exactly one embedding lookup, found "
+            f"{len(lookups)} — not a transformer_lm export")
+    emb_name = lookups[0].input("W")[0]
+    emb_out = lookups[0].output("Out")[0]
+
+    # pos rides the first residual add after the lookup, possibly behind a
+    # slice (t < max_len exports)
+    pos_name = None
+    for op in consumers.get(emb_out, []):
+        if op.type == "elementwise_add":
+            other = [n for n in op.input("X") + op.input("Y")
+                     if n != emb_out][0]
+            src = other
+            if not persistable(src):
+                p = producer.get(src)
+                if p is not None and p.type == "slice":
+                    src = p.input("Input")[0]
+            if persistable(src):
+                pos_name = src
+                break
+    if pos_name is None:
+        raise ValueError("decode export: no positional-encoding parameter "
+                         "behind the embedding add")
+
+    def ln_params(op):
+        if not op.input("Scale") or not op.input("Bias"):
+            raise ValueError("decode export: layer_norm without scale/bias")
+        return op.input("Scale")[0], op.input("Bias")[0], \
+            float(op.attr("epsilon", 1e-5))
+
+    def fc_of(mul_op):
+        """(weight, bias-or-None, activation) of the fc around a mul op."""
+        w = mul_op.input("Y")[0]
+        out = mul_op.output("Out")[0]
+        bias = None
+        for nxt in consumers.get(out, []):
+            if nxt.type == "elementwise_add":
+                cand = [n for n in nxt.input("X") + nxt.input("Y")
+                        if n != out]
+                if cand and persistable(cand[0]):
+                    bias = cand[0]
+                    out = nxt.output("Out")[0]
+                    break
+        act = None
+        for nxt in consumers.get(out, []):
+            if nxt.type in ("relu", "gelu", "tanh", "sigmoid"):
+                act = nxt.type
+                out = nxt.output("Out")[0]
+                break
+        return w, bias, act, out
+
+    fa_ops = [op for op in blk.ops if op.type == "flash_attention"]
+    if not fa_ops:
+        raise ValueError("decode export: no flash_attention ops — not the "
+                         "transformer_lm attention layout")
+    n_heads = None
+    layers = []
+    eps = 1e-5
+    for fa in fa_ops:
+        if not fa.attr("causal", False):
+            raise ValueError("decode export requires causal attention "
+                             "(incremental KV decode is a causal identity)")
+        lp = {}
+
+        def trace_head(name):
+            """flash input <- reshape [0,t,H,Dh] <- (slice <-)? mul."""
+            nonlocal n_heads
+            rs = producer.get(name)
+            if rs is None or rs.type != "reshape":
+                raise ValueError("decode export: attention input is not the "
+                                 "reshape(fc(...)) transformer_lm emits")
+            shape = rs.attr("shape")
+            if n_heads is None:
+                n_heads = int(shape[2])
+            m = producer.get(rs.input("X")[0])
+            if m is not None and m.type == "slice":  # fused_qkv export
+                m = producer.get(m.input("Input")[0])
+            if m is None or m.type != "mul":
+                raise ValueError("decode export: attention projection is "
+                                 "not an fc")
+            return m
+
+        mq = trace_head(fa.input("Q")[0])
+        mk = trace_head(fa.input("K")[0])
+        mv = trace_head(fa.input("V")[0])
+        if mq is mk is mv:  # one [D, 3D] fused projection, sliced
+            lp["wqkv"] = mq.input("Y")[0]
+        else:
+            lp["wq"] = mq.input("Y")[0]
+            lp["wk"] = mk.input("Y")[0]
+            lp["wv"] = mv.input("Y")[0]
+        ln1 = producer.get(mq.input("X")[0])
+        if ln1 is None or ln1.type != "layer_norm":
+            raise ValueError("decode export: expected pre-LN attention")
+        lp["ln1_s"], lp["ln1_b"], eps = ln_params(ln1)
+
+        # output projection: the mul fed (through a reshape) by the
+        # attention output
+        out = fa.output("Out")[0]
+        nxt = consumers.get(out, [None])[0]
+        if nxt is not None and nxt.type == "reshape":
+            out = nxt.output("Out")[0]
+            nxt = consumers.get(out, [None])[0]
+        if nxt is None or nxt.type != "mul":
+            raise ValueError("decode export: no attention output projection")
+        lp["wo"], _, _, proj_out = fc_of(nxt)
+
+        # residual add -> FFN pre-LN -> up fc (relu) -> down fc
+        res = consumers.get(proj_out, [None])[0]
+        if res is None or res.type != "elementwise_add":
+            raise ValueError("decode export: missing attention residual add")
+        x2 = res.output("Out")[0]
+        ln2 = next((o for o in consumers.get(x2, [])
+                    if o.type == "layer_norm"), None)
+        if ln2 is None:
+            raise ValueError("decode export: missing FFN pre-LN")
+        lp["ln2_s"], lp["ln2_b"], _ = ln_params(ln2)
+        up = next((o for o in consumers.get(ln2.output("Y")[0], [])
+                   if o.type == "mul"), None)
+        if up is None:
+            raise ValueError("decode export: missing FFN up projection")
+        wup, bup, act, up_out = fc_of(up)
+        if act != "relu":
+            raise ValueError(f"decode export: FFN activation {act!r} != relu")
+        lp["wup"] = wup
+        if bup:
+            lp["bup"] = bup
+        down = next((o for o in consumers.get(up_out, [])
+                     if o.type == "mul"), None)
+        if down is None:
+            raise ValueError("decode export: missing FFN down projection")
+        wdown, bdown, _, _ = fc_of(down)
+        lp["wdown"] = wdown
+        if bdown:
+            lp["bdown"] = bdown
+        layers.append(lp)
+
+    # final LN is the last layer_norm in program order; head fc consumes it
+    final_ln = [op for op in blk.ops if op.type == "layer_norm"][-1]
+    roles = {"emb": emb_name, "pos": pos_name, "layers": layers}
+    roles["lnf_s"], roles["lnf_b"], _ = ln_params(final_ln)
+    head = next((o for o in consumers.get(final_ln.output("Y")[0], [])
+                 if o.type == "mul"), None)
+    if head is None:
+        raise ValueError("decode export: no LM head after the final LN")
+    out_w, out_b, _, _ = fc_of(head)
+    roles["out_w"] = out_w
+    if out_b:
+        roles["out_b"] = out_b
+
+    emb_shape = var_shape(emb_name)
+    pos_shape = var_shape(pos_name)
+    wup_shape = var_shape(layers[0]["wup"])
+    cfg = {
+        "n_layers": len(layers),
+        "n_heads": int(n_heads),
+        "d_model": int(emb_shape[1]),
+        "d_ff": int(wup_shape[1]),
+        "vocab": int(emb_shape[0]),
+        "max_len": int(pos_shape[1]),
+        "eps": eps,
+    }
+    return roles, cfg
+
+
+def decode_params_from_scope(roles, scope):
+    """Materialize the decode params pytree (numpy leaves) from a scope the
+    inference export was loaded into. Missing parameters raise KeyError."""
+
+    def leaf(name):
+        v = scope.get(name)
+        if v is None:
+            raise KeyError(f"decode export: parameter {name!r} has no saved "
+                           f"value in the scope")
+        return np.asarray(v)
+
+    params = {k: leaf(v) for k, v in roles.items() if k != "layers"}
+    params["layers"] = [{k: leaf(v) for k, v in lp.items()}
+                        for lp in roles["layers"]]
+    return params
+
+
+def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
+                         slots, *, cfg, window):
+    """One decode/prefill chunk over the slot-pooled KV cache. Pure jax —
+    the decode engine jits this per (batch, chunk, window) signature with
+    the pools donated, so steady-state decode is one fixed executable.
+
+    Shapes (B = lanes in this dispatch, C = chunk length, W = ``window``,
+    the power-of-two attention window bucket; pools are
+    [L, n_slots, max_len, H, Dh]):
+
+    * ``tokens``    [B, C] int32 — next tokens per lane (prefill: the
+      prompt chunk; decode: C=1, the last generated token)
+    * ``positions`` [B] int32 — each lane's current sequence length (the
+      pool position this chunk starts writing at)
+    * ``valids``    [B] int32 — valid tokens in the chunk (prefill tail
+      chunks are padded up to C; inactive decode lanes carry 0)
+    * ``slots``     [B] int32 — pool row per lane (inactive lanes point at
+      the trash slot, so their writes land nowhere meaningful)
+
+    Returns ``(next_tokens [B], logits [B, V], new_positions [B], pool_k,
+    pool_v)`` — ``next_tokens`` is the greedy argmax at each lane's LAST
+    VALID chunk position; ``new_positions = positions + valids``.
+
+    The math matches the IR program's op kernels (ops/nn.py layer_norm's
+    E[x²] statistics, ops/pallas_attention.py's f32 masked softmax) so the
+    incremental path agrees with the whole-sequence export to float
+    tolerance, and greedy token streams agree exactly.
+
+    Write-then-attend ordering makes padding sound: each chunk writes its
+    K/V first, then attends with the mask ``key_pos <= query_pos``, so a
+    position only ever reads pool entries that were really produced
+    (stale bytes past a lane's length are masked out, and the slot's next
+    real write overwrites them before they ever become visible).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, C = tokens.shape
+    H = cfg["n_heads"]
+    D = cfg["d_model"]
+    Dh = D // H
+    eps = cfg["eps"]
+    scale = 1.0 / (Dh ** 0.5)
+    max_len = pool_k.shape[2]
+
+    # pool positions this chunk occupies, clamped so padded tails of the
+    # last prefill chunk cannot write past the pool (they are masked and
+    # overwritten before any real query can see them)
+    posm = jnp.minimum(positions[:, None] + jnp.arange(C, dtype=jnp.int32),
+                       max_len - 1)  # [B, C]
+
+    def ln(x, s, b):
+        # ops/nn.py layer_norm: single-pass E[x²] stats, clamped variance
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.maximum(
+            jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * s + b
+
+    x = params["emb"][tokens] + params["pos"][0][posm]
+    key_idx = jnp.arange(window, dtype=jnp.int32)
+    mask = key_idx[None, None, None, :] <= posm[:, None, :, None]  # [B,1,C,W]
+    for li, lp in enumerate(params["layers"]):
+        a = ln(x, lp["ln1_s"], lp["ln1_b"])
+        if "wqkv" in lp:
+            q, k, v = jnp.split(a @ lp["wqkv"], 3, axis=-1)
+        else:
+            q, k, v = a @ lp["wq"], a @ lp["wk"], a @ lp["wv"]
+        q = q.reshape(B, C, H, Dh)
+        k = k.reshape(B, C, H, Dh)
+        v = v.reshape(B, C, H, Dh)
+        # slot as a scatter dim: one compiled step serves every in-flight
+        # generation, wherever its pool row lives
+        pool_k = pool_k.at[li, slots[:, None], posm].set(k)
+        pool_v = pool_v.at[li, slots[:, None], posm].set(v)
+        # static window slice FIRST, then the slot gather — XLA moves
+        # W*H*Dh rows per lane instead of max_len*H*Dh
+        kw = pool_k[li, :, :window][slots]  # [B, W, H, Dh]
+        vw = pool_v[li, :, :window][slots]
+        logits = jnp.einsum("bchd,bkhd->bhck", q, kw) * scale
+        logits = jnp.where(mask, logits, -1e30)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        p = jnp.exp(logits - lse[..., None])
+        ctx = jnp.einsum("bhck,bkhd->bchd", p, vw).reshape(B, C, D)
+        x = x + ctx @ lp["wo"]
+        f = ln(x, lp["ln2_s"], lp["ln2_b"])
+        h = f @ lp["wup"]
+        if "bup" in lp:
+            h = h + lp["bup"]
+        h = jnp.maximum(h, 0.0)
+        f2 = h @ lp["wdown"]
+        if "bdown" in lp:
+            f2 = f2 + lp["bdown"]
+        x = x + f2
+    xn = ln(x, params["lnf_s"], params["lnf_b"])
+    last = jnp.maximum(valids - 1, 0)
+    xl = xn[jnp.arange(B), last]  # [B, D] — each lane's last valid position
+    head_logits = xl @ params["out_w"]
+    if "out_b" in params:
+        head_logits = head_logits + params["out_b"]
+    next_tok = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
+    return next_tok, head_logits, positions + valids, pool_k, pool_v
+
+
 def transformer_encoder(x, n_layers: int, d_model: int, n_heads: int,
                         d_ff: int, name: str = "enc", tp_shard: bool = False,
                         use_recompute: bool = False):

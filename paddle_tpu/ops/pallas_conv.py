@@ -39,6 +39,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_attention import _interpret_default
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams across releases;
+# accept whichever this jax ships (carried tier-1 failure since PR 4: the
+# two fused-kernel tests died on the old name under the new jax, not on
+# numerics)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 
 def bn_affine(mean, var, gamma, beta, eps=1e-5):
     """Fold BN stats+params into the per-channel affine (a, b) the kernel
@@ -435,7 +442,7 @@ def fused_bwd_conv3x3_bn(p, yout, yin, w, coefs=None, xaffine=None,
         scratch_shapes=[pltpu.VMEM((h + 2, wdt + 2, k), jnp.bfloat16),
                         pltpu.VMEM((h + 2, wdt + 2, c), jnp.bfloat16),
                         pltpu.VMEM((h, wdt, 9 * c), jnp.bfloat16)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(p.astype(jnp.bfloat16), yout.astype(jnp.bfloat16),
@@ -493,7 +500,7 @@ def fused_conv3x3_bn(x, w, affine=None, relu=True, stats=True,
         ],
         scratch_shapes=[pltpu.VMEM((h + 2, wdt + 2, k), jnp.bfloat16),
                         pltpu.VMEM((h, wdt, 9 * k), jnp.bfloat16)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(x.astype(jnp.bfloat16), wmat, a, b)

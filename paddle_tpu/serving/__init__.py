@@ -46,6 +46,8 @@ Quickstart::
 """
 from .batcher import MicroBatcher, QueueFullError  # noqa: F401
 from .chaos import ChaosInjector  # noqa: F401
+from .decode import (DecodeEngine, GenerationBatcher,  # noqa: F401
+                     GenerationResult, SlotScheduler)
 from .engine import ServingEngine  # noqa: F401
 from .errors import (DeadlineExceeded, InjectedFault, LoadShedError,  # noqa: F401
                      RetryBudgetExceeded, ServingError, ServingRejected,
@@ -54,8 +56,9 @@ from .server import ServingClient, ServingServer  # noqa: F401
 from .stats import ServingStats  # noqa: F401
 
 __all__ = [
-    "ChaosInjector", "DeadlineExceeded", "InjectedFault", "LoadShedError",
+    "ChaosInjector", "DeadlineExceeded", "DecodeEngine", "GenerationBatcher",
+    "GenerationResult", "InjectedFault", "LoadShedError",
     "MicroBatcher", "QueueFullError", "RetryBudgetExceeded", "ServingClient",
     "ServingEngine", "ServingError", "ServingRejected", "ServingServer",
-    "ServingStats", "ServingUnavailable", "ShuttingDown",
+    "ServingStats", "ServingUnavailable", "ShuttingDown", "SlotScheduler",
 ]

@@ -1,0 +1,1197 @@
+"""Autoregressive decode serving: device-resident KV pool + continuous
+batching.
+
+``ServingEngine`` serves one-shot programs — a request enters a batch, the
+batch dispatches once, everyone leaves together. A *generator* breaks that
+shape: requests produce 1..max_new_tokens device calls, and coalescing at
+dispatch boundaries would hold every batch slot hostage to the longest
+generation. This module serves generation the way the hardware wants:
+
+* **KV pool** (``DecodeEngine``): one device-resident K and V array per
+  model — ``[n_layers, max_slots+1, max_len, n_heads, d_head]`` — where
+  the *slot* dimension is a gather/scatter index. A generation owns a slot
+  for its lifetime; one compiled step serves every in-flight generation
+  regardless of which slots they landed in (the trailing +1 row is the
+  trash slot inactive lanes write into).
+* **Fixed compiled shapes**: the decode step always runs the full
+  ``max_slots`` lanes at chunk length 1; the attention window is a static
+  power-of-two bucket (the serving tier's one ladder — engine.pow2_ladder)
+  sliced from the pool. Prompts prefill at their own power-of-two length
+  bucket. Signature count is therefore O(log2 max_len), precompiled by
+  ``warmup()``, and steady-state decode causes ZERO recompiles — asserted
+  through the same hit/miss counters the one-shot engine exposes.
+* **Continuous batching** (``GenerationBatcher``): requests join and leave
+  the in-flight batch at *token boundaries*. Each boundary the loop
+  retires finished lanes (EOS / max tokens / expired deadline), asks the
+  cost-model ``SlotScheduler`` how many queued prompts to prefill into
+  free slots, then dispatches the next step for everyone still running.
+* **PR-2/3/5 semantics preserved**: deadlines shed queued *and*
+  mid-generation requests at token boundaries; ``close()`` drains —
+  everything already accepted (in-flight AND queued) finishes, new
+  submits raise a typed ``ShuttingDown`` (``drain=False`` aborts the
+  accepted work typed instead); hot weight reload stages off to the
+  side and commits only at a token boundary with no generation in
+  flight, so every
+  generation runs wholly on the version pinned at its admission; the step
+  loop keeps a depth-2 dispatch pipeline (the next step is enqueued on
+  device-resident carries before the previous step's tokens are synced to
+  the host); prefill/decode stage spans and ``pt_serving_decode_*``
+  instruments ride the shared obs registry.
+
+The slot scheduler follows the repo's "exhaustive search under a cost
+model" discipline (ops/pallas_matmul.plan_blocks, PAPERS.md arXiv
+2110.10548): it enumerates every admissible prefill count against measured
+step/prefill costs and picks the one maximizing projected aggregate
+tokens/s, subject to an inter-token latency stall budget.
+"""
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .engine import pow2_ladder, round_up
+from .errors import DeadlineExceeded, QueueFullError, ServingUnavailable, \
+    ShuttingDown
+from .stats import ServingStats
+
+
+def _flat_items(tree, prefix="params"):
+    """Deterministic (path, leaf) walk of the decode params pytree —
+    version-proof stand-in for tree_leaves_with_path."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _flat_items(tree[k], f"{prefix}.{k}")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _flat_items(v, f"{prefix}[{i}]")
+    else:
+        yield prefix, tree
+
+
+class _ChunkEntry:
+    """One compiled (lanes, chunk, window) signature of the decode step."""
+
+    __slots__ = ("fn", "cold", "compile_s")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.cold = True
+        self.compile_s = None
+
+
+class DecodeEngine:
+    """Incremental-decode runtime over an exported ``transformer_lm``
+    inference dir: slot-pooled KV cache, bucketed prefill, fixed-shape
+    batched decode step, compile-cache counters, and atomic hot weight
+    reload (stage/commit split, like ``ServingEngine``).
+
+    Not thread-safe by design: exactly one thread (the
+    ``GenerationBatcher`` loop, or a test driving it directly) owns the
+    pool carry. ``stage_params`` is safe from any thread; ``commit_params``
+    must run at a token boundary (the batcher's reload barrier does).
+    """
+
+    def __init__(self, dirname: str, place=None,
+                 max_slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 kv_buckets: Optional[Sequence[int]] = None,
+                 prefill_chunk: Optional[int] = None,
+                 cache_capacity: int = 32):
+        import jax
+
+        from .. import io as model_io
+        from ..core.executor import Scope
+        from ..core.types import default_place
+        from ..flags import get_flag
+        from ..models.transformer import decode_params_from_scope, \
+            decode_roles
+
+        self.dirname = dirname
+        self._place = place or default_place()
+        self._device = self._place.jax_device()
+        self.scope = Scope()
+        self.program, self.feed_names, self.fetch_names = (
+            model_io.load_inference_model(dirname, None, scope=self.scope))
+        self.roles, self.cfg = decode_roles(self.program)
+        host_params = decode_params_from_scope(self.roles, self.scope)
+
+        self.max_slots = int(get_flag("decode_max_slots")
+                             if max_slots is None else max_slots)
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_len = int(max_len or self.cfg["max_len"])
+        if self.max_len > self.cfg["max_len"]:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the exported position "
+                f"table ({self.cfg['max_len']})")
+        self.prefill_chunk = int(
+            get_flag("decode_prefill_chunk") if prefill_chunk is None
+            else prefill_chunk)
+        # window/prompt ladder: power-of-two buckets up to max_len, floored
+        # at 16 so tiny prompts don't mint near-duplicate signatures
+        if kv_buckets:
+            self.kv_buckets = tuple(sorted(int(b) for b in kv_buckets))
+            if self.kv_buckets[-1] < self.max_len:
+                raise ValueError(
+                    f"kv_buckets {self.kv_buckets} do not cover max_len "
+                    f"{self.max_len}")
+            if self.kv_buckets[-1] > self.max_len:
+                # an oversized window would slice past the pool rows and
+                # die as a shape mismatch at first dispatch — refuse here
+                raise ValueError(
+                    f"kv_buckets {self.kv_buckets} exceed max_len "
+                    f"{self.max_len} (windows slice the KV pool; the top "
+                    f"bucket must equal max_len)")
+        else:
+            self.kv_buckets = tuple(
+                b for b in pow2_ladder(self.max_len)
+                if b >= min(16, self.max_len))
+        self.cache_capacity = int(cache_capacity)
+
+        self._lock = threading.RLock()  # params snapshot + cache counters
+        with jax.default_device(self._device):
+            self._params = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._device), host_params)
+        self.params_version = 1
+        self.chaos = None  # optional ChaosInjector (on_dispatch hook)
+
+        L, H = self.cfg["n_layers"], self.cfg["n_heads"]
+        Dh = self.cfg["d_model"] // H
+        self._pool_shape = (L, self.max_slots + 1, self.max_len, H, Dh)
+        self.trash_slot = self.max_slots
+        with jax.default_device(self._device):
+            self.pool_k = jax.numpy.zeros(self._pool_shape, jax.numpy.float32)
+            self.pool_v = jax.numpy.zeros(self._pool_shape, jax.numpy.float32)
+        self._free: List[int] = list(range(self.max_slots))
+        self._cache: "OrderedDict[Tuple[int, int, int], _ChunkEntry]" = \
+            OrderedDict()
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- slots --
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.max_slots - len(self._free)
+
+    def alloc_slot(self) -> int:
+        if not self._free:
+            raise RuntimeError("no free KV slots")
+        return self._free.pop()
+
+    def free_slot(self, slot: int) -> None:
+        if not 0 <= slot < self.max_slots or slot in self._free:
+            raise ValueError(f"bad slot free: {slot}")
+        self._free.append(slot)
+
+    # -- buckets --
+    def window_bucket(self, length: int) -> int:
+        """Smallest ladder window covering ``length`` pool positions."""
+        return round_up(max(1, min(length, self.max_len)), self.kv_buckets)
+
+    def prompt_bucket(self, length: int) -> int:
+        if length > self.max_len - 1:
+            raise ValueError(
+                f"prompt of {length} tokens leaves no room to generate "
+                f"(max_len {self.max_len})")
+        return round_up(length, self.kv_buckets)
+
+    # -- compile cache --
+    def _get_fn(self, lanes: int, chunk: int, window: int) -> _ChunkEntry:
+        import jax
+
+        from ..models.transformer import decode_forward_chunk
+
+        key = (lanes, chunk, window)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self.cache_hits += 1
+                self._cache.move_to_end(key)
+                return entry
+            self.cache_misses += 1
+        fn = jax.jit(functools.partial(decode_forward_chunk, cfg=self.cfg,
+                                       window=window),
+                     donate_argnums=(1, 2))
+        entry = _ChunkEntry(fn)
+        with self._lock:
+            entry = self._cache.setdefault(key, entry)
+            while len(self._cache) > self.cache_capacity:
+                self._cache.popitem(last=False)
+        return entry
+
+    def cache_info(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.cache_hits, "misses": self.cache_misses,
+                    "size": len(self._cache), "capacity": self.cache_capacity}
+
+    # -- dispatch --
+    def dispatch_chunk(self, tokens, positions, valids, slots,
+                       window: int):
+        """One async device call of the chunk function over the CURRENT
+        pool carry. Inputs may be numpy (a structural boundary rebuilt the
+        lanes) or device arrays (the steady-state carry). Returns
+        ``(next_tokens, logits, new_positions, version)`` — device arrays,
+        NOT synced; the pools are replaced in place (donated).
+        """
+        import jax
+
+        tokens = jax.numpy.asarray(tokens, jax.numpy.int32)
+        lanes, chunk = tokens.shape
+        entry = self._get_fn(lanes, chunk, window)
+        if self.chaos is not None:
+            self.chaos.on_dispatch()
+        with self._lock:
+            params = self._params
+            version = self.params_version
+        cold = entry.cold
+        t0 = time.monotonic() if cold else 0.0
+        with jax.default_device(self._device):
+            next_tok, logits, new_pos, self.pool_k, self.pool_v = entry.fn(
+                params, self.pool_k, self.pool_v, tokens,
+                jax.numpy.asarray(positions, jax.numpy.int32),
+                jax.numpy.asarray(valids, jax.numpy.int32),
+                jax.numpy.asarray(slots, jax.numpy.int32))
+        if cold:
+            entry.compile_s = time.monotonic() - t0
+            entry.cold = False
+            from ..obs import get_tracer
+
+            tr = get_tracer()
+            if tr.enabled:
+                tr.add_span("serving/decode_compile", t0, entry.compile_s,
+                            cat="compile", args={"lanes": lanes,
+                                                 "chunk": chunk,
+                                                 "window": window})
+        return next_tok, logits, new_pos, version
+
+    def prefill(self, slot: int, prompt: np.ndarray) -> Tuple[Any, Any, int]:
+        """Write a prompt's K/V into ``slot`` and return its first
+        generated token: ``(next_token [1] device, logits [1, V] device,
+        version)``. The prompt runs as one bucketed chunk, or — when
+        ``prefill_chunk`` > 0 — as a train of fixed-size chunks so a long
+        prompt never stalls in-flight decode lanes for its whole length.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        self.prompt_bucket(n)  # length guard
+        chunk = self.prefill_chunk if self.prefill_chunk > 0 else 0
+        out = None
+        start = 0
+        while start < n:
+            if chunk:
+                c = chunk
+                valid = min(c, n - start)
+            else:
+                c = self.prompt_bucket(n)
+                valid = n
+            buf = np.zeros((1, c), np.int32)
+            buf[0, :valid] = prompt[start:start + valid]
+            window = self.window_bucket(start + valid)
+            out = self.dispatch_chunk(
+                buf, np.array([start], np.int32),
+                np.array([valid], np.int32),
+                np.array([slot], np.int32), window)
+            start += valid
+        next_tok, logits, _new_pos, version = out
+        return next_tok, logits, version
+
+    def warmup(self) -> int:
+        """Precompile the steady-state signatures: the decode step at every
+        window bucket, and whole-prompt prefill at every prompt bucket
+        (plus the chunked-prefill train when ``prefill_chunk`` is set).
+        Returns the number of fresh compiles."""
+        misses0 = self.cache_misses
+        slot = self.alloc_slot()
+        try:
+            for b in self.kv_buckets:
+                self.prefill(slot, np.zeros(min(b, self.max_len - 1),
+                                            np.int32))
+            for w in self.kv_buckets:
+                lanes = self.max_slots
+                toks = np.zeros((lanes, 1), np.int32)
+                self.dispatch_chunk(
+                    toks, np.zeros(lanes, np.int32),
+                    np.zeros(lanes, np.int32),
+                    np.full(lanes, self.trash_slot, np.int32), w)
+        finally:
+            self.free_slot(slot)
+            self.reset_pool()
+        return self.cache_misses - misses0
+
+    def reset_pool(self) -> None:
+        """Zero the KV pool (tests / warmup hygiene; slot ownership is the
+        real isolation — stale bytes are never attended)."""
+        import jax
+
+        with jax.default_device(self._device):
+            self.pool_k = jax.numpy.zeros(self._pool_shape,
+                                          jax.numpy.float32)
+            self.pool_v = jax.numpy.zeros(self._pool_shape,
+                                          jax.numpy.float32)
+
+    # -- hot weight reload --
+    def stage_params(self, dirname: str) -> Dict[str, Any]:
+        """Load + validate a re-exported dir against the frozen decode
+        roles WITHOUT touching the live params (the slow half of a reload;
+        safe while generations run). Returns the staged device pytree."""
+        import jax
+
+        from .. import io as model_io
+        from ..core.executor import Scope
+        from ..models.transformer import decode_params_from_scope, \
+            decode_roles
+
+        scope = Scope()
+        program, _f, _t = model_io.load_inference_model(dirname, None,
+                                                        scope=scope)
+        roles, cfg = decode_roles(program)
+        for k in ("n_layers", "n_heads", "d_model", "d_ff", "vocab",
+                  "max_len"):
+            if cfg[k] != self.cfg[k]:
+                raise ValueError(
+                    f"reload {dirname!r}: architecture mismatch — {k} "
+                    f"{cfg[k]} != frozen {self.cfg[k]}")
+        staged = decode_params_from_scope(roles, scope)
+        old_flat = dict(_flat_items(self._params))
+        new_flat = dict(_flat_items(staged))
+        if set(old_flat) != set(new_flat):
+            raise ValueError(
+                f"reload {dirname!r}: parameter set mismatch "
+                f"(+{sorted(set(new_flat) - set(old_flat))} "
+                f"-{sorted(set(old_flat) - set(new_flat))})")
+        for path, old in old_flat.items():
+            new = new_flat[path]
+            if tuple(old.shape) != tuple(new.shape) \
+                    or np.dtype(old.dtype) != np.dtype(new.dtype):
+                raise ValueError(
+                    f"reload {dirname!r}: param {path} shape/dtype mismatch "
+                    f"({tuple(new.shape)}/{np.dtype(new.dtype)} vs frozen "
+                    f"{tuple(old.shape)}/{np.dtype(old.dtype)})")
+        with jax.default_device(self._device):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._device), staged)
+
+    def commit_params(self, staged: Dict[str, Any]) -> int:
+        """One reference store; every later dispatch snapshots the new
+        set. The batcher runs this inside its token-boundary barrier."""
+        with self._lock:
+            self._params = staged
+            self.params_version += 1
+            return self.params_version
+
+
+class SlotScheduler:
+    """Cost-model prefill admission (the placement-synthesis discipline:
+    enumerate every candidate against a measured cost model, pick the
+    best — ops/pallas_matmul.plan_blocks is the in-repo exemplar).
+
+    Each token boundary the batcher asks: with ``free`` slots and this
+    queue, how many prompts should prefill NOW? Admitting raises steady-
+    state occupancy (aggregate tokens/s scales with it) but stalls every
+    in-flight lane for the prefill's duration (an inter-token latency
+    spike). The scheduler scores every k in 0..free against measured EMA
+    costs::
+
+        rate(k) = (active + k) * H / (H * step_cost + prefill_cost(k))
+
+    over a horizon of H decode steps, and takes the best k whose total
+    prefill stall fits ``itl_budget_ms`` (always admitting when nothing is
+    in flight — stalling an empty batch costs nobody anything, and a
+    head-of-queue request older than ``starve_ms`` overrides the budget so
+    admission can never starve under a hot decode batch).
+    """
+
+    def __init__(self, itl_budget_ms: float = 50.0,
+                 starve_ms: float = 500.0, horizon_steps: int = 32):
+        self.itl_budget_s = itl_budget_ms / 1e3
+        self.starve_s = starve_ms / 1e3
+        self.horizon_steps = int(horizon_steps)
+        # measured EMAs keyed by bucket (prefill) / window (step)
+        self._prefill_ema: Dict[int, float] = {}
+        self._step_ema: Dict[int, float] = {}
+
+    def observe_prefill(self, bucket: int, seconds: float) -> None:
+        old = self._prefill_ema.get(bucket)
+        self._prefill_ema[bucket] = seconds if old is None \
+            else 0.8 * old + 0.2 * seconds
+
+    def observe_step(self, window: int, seconds: float) -> None:
+        old = self._step_ema.get(window)
+        self._step_ema[window] = seconds if old is None \
+            else 0.8 * old + 0.2 * seconds
+
+    def prefill_cost(self, bucket: int) -> float:
+        if self._prefill_ema:
+            if bucket in self._prefill_ema:
+                return self._prefill_ema[bucket]
+            # nearest measured bucket, scaled linearly in length
+            near = min(self._prefill_ema, key=lambda b: abs(b - bucket))
+            return self._prefill_ema[near] * bucket / max(near, 1)
+        return 1e-3 * bucket  # unmeasured: optimistic linear guess
+
+    def step_cost(self, window: int) -> float:
+        if self._step_ema:
+            if window in self._step_ema:
+                return self._step_ema[window]
+            near = min(self._step_ema, key=lambda w: abs(w - window))
+            return self._step_ema[near]
+        return 1e-3
+
+    def plan(self, free: int, queued_buckets: Sequence[int], active: int,
+             window: int, oldest_wait_s: float = 0.0) -> int:
+        """Number of queue-head prompts to prefill at this boundary."""
+        k_max = min(free, len(queued_buckets))
+        if k_max == 0:
+            return 0
+        if active == 0:
+            return k_max  # nothing to stall: fill the batch
+        step_s = self.step_cost(window)
+        H = self.horizon_steps
+        best_k, best_rate = 0, active * H / max(H * step_s, 1e-9)
+        stall = 0.0
+        for k in range(1, k_max + 1):
+            stall += self.prefill_cost(queued_buckets[k - 1])
+            if stall > self.itl_budget_s and oldest_wait_s < self.starve_s:
+                break
+            rate = (active + k) * H / (H * step_s + stall)
+            if rate > best_rate:
+                best_k, best_rate = k, rate
+        if best_k == 0 and oldest_wait_s >= self.starve_s:
+            return 1  # starvation override: the head has waited long enough
+        return best_k
+
+
+class _Generation:
+    """One queued/in-flight generation request."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "trace_id",
+                 "future", "t_submit", "t_first_token", "t_last_token",
+                 "tokens", "slot", "version", "timings", "done")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline, trace_id):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.trace_id = trace_id
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+        self.t_first_token = None
+        self.t_last_token = None
+        self.tokens: List[int] = []
+        self.slot = None
+        self.version = None  # params version pinned at admission
+        self.timings: Dict[str, float] = {}
+        self.done = False
+
+
+class GenerationResult:
+    """What a generation future resolves with."""
+
+    __slots__ = ("tokens", "ttft_s", "weights_version", "finish_reason")
+
+    def __init__(self, tokens, ttft_s, weights_version, finish_reason):
+        self.tokens = tokens
+        self.ttft_s = ttft_s
+        self.weights_version = weights_version
+        self.finish_reason = finish_reason  # "eos" | "length"
+
+
+class GenerationBatcher:
+    """Continuous batcher over a ``DecodeEngine``: requests join and leave
+    the in-flight batch at token boundaries.
+
+    The loop's steady state is ONE fixed-shape device dispatch per token
+    boundary, pipelined depth-2: step k+1 is enqueued on step k's
+    device-resident carries (tokens/positions never round-trip the host),
+    and only THEN does the host sync step k's tokens to run retirement,
+    admission, deadline shedding, and the reload barrier. A structural
+    change (a lane joined or left) applies one boundary later — the lame
+    step a dying lane runs is one wasted lane-row, not a wasted batch.
+
+    ``submit`` never blocks (bounded queue -> ``QueueFullError``); every
+    accepted future resolves with a ``GenerationResult`` or a typed error.
+    """
+
+    def __init__(self, engine: DecodeEngine,
+                 queue_capacity: int = 64,
+                 stats: Optional[ServingStats] = None,
+                 scheduler: Optional[SlotScheduler] = None,
+                 pipeline_depth: int = 2,
+                 default_max_new_tokens: int = 64,
+                 start: bool = True):
+        self.engine = engine
+        self.queue_capacity = int(queue_capacity)
+        self.stats = stats
+        self.scheduler = scheduler or SlotScheduler()
+        # depth 2 = enqueue step k+1 on step k's device carries before
+        # syncing step k; deeper would let the host's window estimate lag
+        # behind the true positions (see _max_pos), so the knob is 1 or 2
+        self.pipeline_depth = min(2, max(1, int(pipeline_depth)))
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.chaos = None  # batcher-level hook (queue stall), like MicroBatcher
+        self._queue: "queue.Queue[_Generation]" = \
+            queue.Queue(self.queue_capacity)
+        self._deferred: deque = deque()  # popped but not yet admitted (FIFO)
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._drain = True
+        # lanes: parallel host-side arrays, one row per batch lane
+        self._lanes: List[Optional[_Generation]] = \
+            [None] * engine.max_slots
+        self._inflight: deque = deque()  # (next_tok_dev, version, lanes_snapshot, t_dispatch, window)
+        self._carry = None  # (tokens_dev, positions_dev) steady-state carry
+        # reload barrier hand-off
+        self._reload_lock = threading.Lock()  # one reload at a time
+        self._staged_params = None
+        self._reload_done = threading.Event()
+        self._reload_version = None
+        self._thread: Optional[threading.Thread] = None
+        if stats is not None:
+            stats.set_decode_slots(0, engine.max_slots)
+        if start:
+            self.start()
+
+    # -- producer side --
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_id: Optional[int] = None,
+               deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
+        t0 = time.monotonic()
+        if self._closed:
+            raise ShuttingDown("generation batcher closed")
+        if deadline is not None and t0 >= deadline:
+            if self.stats:
+                self.stats.record_deadline()
+            raise DeadlineExceeded(t0 - deadline, "submit")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("empty prompt")  # terminal, not retryable
+        self.engine.prompt_bucket(prompt.shape[0])  # length guard, raises
+        mnt = int(self.default_max_new_tokens if max_new_tokens is None
+                  else max_new_tokens)
+        if mnt < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        gen = _Generation(prompt, mnt, eos_id, deadline, trace_id)
+        with self._close_lock:
+            if self._closed:
+                raise ShuttingDown("generation batcher closed")
+            with self._pending_lock:
+                self._pending += 1
+            try:
+                self._queue.put_nowait(gen)
+            except queue.Full:
+                with self._pending_lock:
+                    self._pending -= 1
+                if self.stats:
+                    self.stats.record_reject()
+                raise QueueFullError(self._queue.qsize(),
+                                     self.queue_capacity) from None
+        if self.stats:
+            self.stats.record_submit()
+        gen.future.request = gen
+        return gen.future
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize() + len(self._deferred)
+
+    @property
+    def pending(self) -> int:
+        with self._pending_lock:
+            return self._pending
+
+    @property
+    def active(self) -> int:
+        return sum(1 for g in self._lanes if g is not None)
+
+    # -- hot reload (token-boundary barrier) --
+    def reload(self, dirname: str, timeout: float = 30.0,
+               record: bool = True) -> int:
+        """Stage a re-exported param set (slow, off the hot path), then
+        commit it at the first token boundary with NO generation in
+        flight. While the commit is pending the loop stops admitting new
+        prefills — in-flight generations run to completion on their pinned
+        version, so every generation is wholly-old-or-wholly-new. Raises
+        ``ServingUnavailable`` if the barrier does not clear in time (the
+        staged set is dropped; live traffic is untouched). ``record=False``
+        skips the stats reload counter — for a caller (the server's reload
+        RPC) that already counted this reload as one operation."""
+        staged = self.engine.stage_params(dirname)
+        with self._reload_lock:
+            self._reload_done.clear()
+            with self._close_lock:
+                self._staged_params = staged
+                if self._thread is None or not self._thread.is_alive():
+                    # no loop running (tests drive boundaries by hand):
+                    # commit immediately — nothing can be in flight
+                    self._commit_staged()
+            if not self._reload_done.wait(timeout):
+                with self._close_lock:
+                    if not self._reload_done.is_set():  # loop didn't win
+                        self._staged_params = None
+                        raise ServingUnavailable(
+                            "decode reload: token-boundary barrier did not "
+                            "clear in time — retry")
+            if self.stats and record:
+                self.stats.record_reload()
+            return self._reload_version
+
+    def _commit_staged(self) -> None:
+        """Caller holds ``_close_lock``."""
+        staged, self._staged_params = self._staged_params, None
+        if staged is None:
+            return
+        self._reload_version = self.engine.commit_params(staged)
+        self._reload_done.set()
+
+    # -- worker --
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._closed = False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="paddle-tpu-generation-batcher")
+            self._thread.start()
+
+    def _resolve(self, gen: _Generation, result=None, exc=None) -> bool:
+        if gen.future.done():
+            return False
+        try:
+            if exc is not None:
+                gen.future.set_exception(exc)
+            else:
+                gen.future.set_result(result)
+        except Exception:
+            return False
+        with self._pending_lock:
+            self._pending -= 1
+        return True
+
+    def _finish(self, gen: _Generation, reason: str) -> None:
+        gen.done = True
+        now = time.monotonic()
+        total = now - gen.t_submit
+        gen.timings["total"] = total
+        ttft = (gen.t_first_token - gen.t_submit
+                if gen.t_first_token else total)
+        if self._resolve(gen, result=GenerationResult(
+                list(gen.tokens), ttft, gen.version, reason)):
+            if self.stats:
+                self.stats.record_done(total)
+        self._trace_generation(gen, now, reason)
+
+    def _trace_generation(self, gen: _Generation, now: float,
+                          reason: str) -> None:
+        from ..obs import get_tracer
+
+        tr = get_tracer()
+        if not tr.enabled:
+            return
+        sid = tr.add_span("serve/generation", gen.t_submit,
+                          now - gen.t_submit, cat="serving",
+                          trace_id=gen.trace_id,
+                          args={"prompt": int(gen.prompt.shape[0]),
+                                "tokens": len(gen.tokens),
+                                "reason": reason,
+                                "weights_version": gen.version})
+        if gen.t_first_token is not None:
+            tr.add_span("serve/prefill_ttft", gen.t_submit,
+                        gen.t_first_token - gen.t_submit, cat="serving",
+                        trace_id=gen.trace_id, parent=sid)
+
+    def _admit(self, gen: _Generation) -> bool:
+        """Prefill one queued generation into a free slot. Returns False
+        (resolving the future with the typed error) on prefill failure."""
+        t0 = time.monotonic()
+        slot = self.engine.alloc_slot()
+        try:
+            tok_dev, _logits, version = self.engine.prefill(slot, gen.prompt)
+            first = int(np.asarray(tok_dev)[0])  # host sync: TTFT token
+        except Exception as e:
+            self.engine.free_slot(slot)
+            if self.stats:
+                self.stats.record_failure()
+            self._resolve(gen, exc=e if isinstance(e, ServingUnavailable)
+                          else ServingUnavailable(f"prefill failed: {e}"))
+            return False
+        dt = time.monotonic() - t0
+        gen.slot = slot
+        gen.version = version
+        gen.tokens.append(first)
+        gen.t_first_token = gen.t_last_token = time.monotonic()
+        gen.timings["prefill"] = dt
+        bucket = self.engine.prompt_bucket(gen.prompt.shape[0])
+        self.scheduler.observe_prefill(bucket, dt)
+        if self.stats:
+            self.stats.record_stage("prefill", dt)
+            self.stats.record_ttft(gen.t_first_token - gen.t_submit)
+            self.stats.record_decode_tokens(1)
+        # the prefill's own token can already satisfy the generation
+        # (eos first token, max_new_tokens=1, prompt at the pool edge):
+        # finish NOW instead of occupying a lane for one wasted step
+        if gen.eos_id is not None and first == gen.eos_id:
+            self.engine.free_slot(slot)
+            self._finish(gen, "eos")
+            return True
+        if len(gen.tokens) >= gen.max_new_tokens or \
+                gen.prompt.shape[0] + len(gen.tokens) >= self.engine.max_len:
+            self.engine.free_slot(slot)
+            self._finish(gen, "length")
+            return True
+        lane = self._lanes.index(None)
+        self._lanes[lane] = gen
+        return True
+
+    def _lane_arrays(self):
+        """Host-rebuilt lane arrays after a structural change."""
+        B = self.engine.max_slots
+        toks = np.zeros((B, 1), np.int32)
+        pos = np.zeros(B, np.int32)
+        val = np.zeros(B, np.int32)
+        slots = np.full(B, self.engine.trash_slot, np.int32)
+        for i, g in enumerate(self._lanes):
+            if g is None:
+                continue
+            toks[i, 0] = g.tokens[-1]
+            pos[i] = g.prompt.shape[0] + len(g.tokens) - 1
+            val[i] = 1
+            slots[i] = g.slot
+        return toks, pos, val, slots
+
+    def _max_pos(self) -> int:
+        m = 1
+        for g in self._lanes:
+            if g is not None:
+                m = max(m, g.prompt.shape[0] + len(g.tokens) + 1)
+        return m
+
+    def _retire_or_continue(self, gen: _Generation, tok: int) -> bool:
+        """Append a synced token; True when the generation just finished."""
+        gen.tokens.append(tok)
+        now = time.monotonic()
+        if self.stats:
+            self.stats.record_decode_tokens(1)
+            if gen.t_last_token is not None:
+                self.stats.record_itl(now - gen.t_last_token)
+        gen.t_last_token = now
+        if gen.eos_id is not None and tok == gen.eos_id:
+            self._finish(gen, "eos")
+            return True
+        if len(gen.tokens) >= gen.max_new_tokens or \
+                gen.prompt.shape[0] + len(gen.tokens) >= self.engine.max_len:
+            # budget spent, or the next token's pool position would fall
+            # off the end of the KV rows
+            self._finish(gen, "length")
+            return True
+        return False
+
+    def _shed_expired_lanes(self) -> bool:
+        """Deadline shed at the token boundary — mid-generation, as PR 2
+        sheds at coalesce time. Returns True on structural change."""
+        changed = False
+        now = time.monotonic()
+        for i, g in enumerate(self._lanes):
+            if g is None or g.deadline is None or now < g.deadline:
+                continue
+            g.done = True
+            if self._resolve(g, exc=DeadlineExceeded(now - g.deadline,
+                                                     "mid-generation")):
+                if self.stats:
+                    self.stats.record_deadline()
+            self.engine.free_slot(g.slot)
+            self._lanes[i] = None
+            changed = True
+        return changed
+
+    def _sync_boundary(self, item) -> bool:
+        """Host-sync one in-flight step and retire its finishers. The lanes
+        snapshot taken at dispatch names who each row belonged to (a lane
+        may have been shed since). Returns True on structural change."""
+        tok_dev, version, lanes_snap, t_disp, window = item
+        try:
+            toks = np.asarray(tok_dev)
+        except Exception as e:
+            # the device call itself failed: every lane in it fails typed
+            err = e if isinstance(e, ServingUnavailable) else \
+                ServingUnavailable(f"decode step failed: {e}")
+            changed = False
+            for i, g in enumerate(lanes_snap):
+                if g is None or g.done:
+                    continue
+                if self._resolve(g, exc=err):
+                    if self.stats:
+                        self.stats.record_failure()
+                self.engine.free_slot(g.slot)
+                if self._lanes[i] is g:
+                    self._lanes[i] = None
+                g.done = True
+                changed = True
+            self._carry = None
+            return changed
+        dt = time.monotonic() - t_disp
+        self.scheduler.observe_step(window, dt)
+        if self.stats:
+            self.stats.record_stage("decode_step", dt)
+        changed = False
+        for i, g in enumerate(lanes_snap):
+            if g is None or g.done or self._lanes[i] is not g:
+                continue
+            if self._retire_or_continue(g, int(toks[i])):
+                self.engine.free_slot(g.slot)
+                self._lanes[i] = None
+                changed = True
+        return changed
+
+    def _drain_inflight(self) -> bool:
+        changed = False
+        while self._inflight:
+            changed |= self._sync_boundary(self._inflight.popleft())
+        return changed
+
+    def _reap_finished_lanes(self) -> bool:
+        """Drop lanes whose future resolved out-of-band (abort close, a
+        racing cancel): free their slots so the loop can exit/admit."""
+        changed = False
+        for i, g in enumerate(self._lanes):
+            if g is None or not g.done:
+                continue
+            self.engine.free_slot(g.slot)
+            self._lanes[i] = None
+            changed = True
+        return changed
+
+    def _pull_queued(self, cap: int) -> List[_Generation]:
+        """FIFO view of up to ``cap`` waiting generations (deferred first),
+        shedding any whose deadline already passed."""
+        out: List[_Generation] = []
+        while len(out) < cap:
+            if self._deferred:
+                g = self._deferred.popleft()
+            else:
+                try:
+                    g = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            now = time.monotonic()
+            if g.deadline is not None and now >= g.deadline:
+                if self._resolve(g, exc=DeadlineExceeded(now - g.deadline,
+                                                         "queue")):
+                    if self.stats:
+                        self.stats.record_deadline()
+                continue
+            out.append(g)
+        return out
+
+    def _boundary(self) -> bool:
+        """Token-boundary housekeeping: shed, reload barrier, admission.
+        Returns True when the lane set changed (carry must rebuild)."""
+        changed = self._reap_finished_lanes()
+        changed |= self._shed_expired_lanes()
+        # reload barrier: stop admitting; commit once nothing is in flight
+        if self._staged_params is not None:
+            if self.active == 0 and not self._inflight:
+                with self._close_lock:
+                    self._commit_staged()
+            return changed  # no admission while a commit is pending
+        if self._stop.is_set() and not self._drain:
+            return changed  # aborting: whatever is queued resolves typed
+        free = self.engine.free_slots
+        if free == 0:
+            return changed
+        queued = self._pull_queued(free)
+        if not queued:
+            return changed
+        buckets = [self.engine.prompt_bucket(g.prompt.shape[0])
+                   for g in queued]
+        oldest = time.monotonic() - queued[0].t_submit
+        k = self.scheduler.plan(free, buckets, self.active,
+                                self.engine.window_bucket(self._max_pos()),
+                                oldest_wait_s=oldest)
+        for g in queued[:k]:
+            if self._admit(g):
+                changed = True
+        # not admitted this boundary: keep FIFO order ahead of the queue
+        self._deferred.extendleft(reversed(queued[k:]))
+        if self.stats:
+            self.stats.set_decode_slots(self.active, self.engine.max_slots)
+        return changed
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                if self.chaos is not None and (self.active
+                                               or self.queue_depth):
+                    self.chaos.on_coalesce()
+                changed = False
+                # depth-2 pipeline: keep at most pipeline_depth-1 steps
+                # un-synced — with depth 2, step k+1 is already enqueued on
+                # step k's device carries before this sync blocks on k
+                while len(self._inflight) > self.pipeline_depth - 1 \
+                        or (self._inflight and self.active == 0):
+                    changed |= self._sync_boundary(self._inflight.popleft())
+                if changed and self.stats:
+                    self.stats.set_decode_slots(self.active,
+                                                self.engine.max_slots)
+                if self._stop.is_set() and self.active == 0 \
+                        and not self._inflight \
+                        and (not self._drain or self.queue_depth == 0):
+                    return
+                # admission/shedding/reload decisions need settled lanes:
+                # flush the pipeline first — but ONLY when one of them can
+                # actually happen (a queued request with no free slot must
+                # not serialize the steady-state pipeline)
+                if (self._staged_params is not None
+                        or (self.queue_depth > 0
+                            and self.engine.free_slots > 0)
+                        or self._deadline_pending()
+                        or self._stop.is_set()):
+                    changed |= self._drain_inflight()
+                changed |= self._boundary()
+                if self.active == 0:
+                    if self._stop.is_set():
+                        continue  # drain/abort check at loop top
+                    if self.queue_depth == 0:
+                        # idle: block on the queue instead of spinning
+                        try:
+                            self._deferred.append(self._queue.get(
+                                timeout=0.05))
+                        except queue.Empty:
+                            pass
+                    continue
+                if changed or self._carry is None:
+                    if self._drain_inflight():
+                        # a late retirement landed during the flush; let
+                        # the next iteration re-run the boundary
+                        self._carry = None
+                        continue
+                    toks, pos, val, slots = self._lane_arrays()
+                    self._slots_arr = slots
+                    self._valids_arr = val
+                else:
+                    toks, pos = self._carry
+                    slots, val = self._slots_arr, self._valids_arr
+                window = self.engine.window_bucket(self._max_pos())
+                t_disp = time.monotonic()
+                lanes_snap = list(self._lanes)
+                try:
+                    tok_dev, _lg, pos_dev, version = \
+                        self.engine.dispatch_chunk(toks, pos, val, slots,
+                                                   window)
+                except Exception as e:
+                    err = e if isinstance(e, ServingUnavailable) else \
+                        ServingUnavailable(f"decode dispatch failed: {e}")
+                    for i, g in enumerate(self._lanes):
+                        if g is None:
+                            continue
+                        g.done = True
+                        if self._resolve(g, exc=err):
+                            if self.stats:
+                                self.stats.record_failure()
+                        self.engine.free_slot(g.slot)
+                        self._lanes[i] = None
+                    self._carry = None
+                    continue
+                self._carry = (tok_dev.reshape(-1, 1), pos_dev)
+                self._inflight.append(
+                    (tok_dev, version, lanes_snap, t_disp, window))
+                if self.stats:
+                    self.stats.set_decode_slots(self.active,
+                                                self.engine.max_slots)
+        finally:
+            # resolve whatever is left so no accepted future ever hangs
+            try:
+                self._drain_inflight()
+            except Exception:
+                pass
+            for i, g in enumerate(self._lanes):
+                if g is None:
+                    continue
+                self._resolve(g, exc=ShuttingDown("generation batcher "
+                                                  "closed"))
+                self.engine.free_slot(g.slot)
+                self._lanes[i] = None
+            self._resolve_leftovers()
+            if self.stats:
+                self.stats.set_decode_slots(0, self.engine.max_slots)
+
+    def _resolve_leftovers(self) -> None:
+        """Resolve every still-waiting generation (deferred + queued)
+        with a typed ``ShuttingDown``."""
+        leftovers = list(self._deferred)
+        self._deferred.clear()
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for g in leftovers:
+            self._resolve(g, exc=ShuttingDown("generation batcher closed"))
+
+    def _deadline_pending(self) -> bool:
+        now = time.monotonic()
+        return any(g is not None and g.deadline is not None
+                   and now >= g.deadline for g in self._lanes)
+
+    def close(self, timeout: float = 30.0, drain: bool = True) -> None:
+        """Graceful drain by default: every ACCEPTED generation — in
+        flight or still queued — runs to completion (the MicroBatcher
+        close contract), and new submits raise ``ShuttingDown``; budget
+        the timeout for a full queue of generations. ``drain=False``
+        resolves in-flight and queued generations with ``ShuttingDown``
+        instead (lanes are reaped at the loop's next boundary)."""
+        with self._close_lock:
+            self._closed = True
+        if not drain:
+            self._drain = False
+            # fail fast: resolve actives now; the loop reaps their lanes
+            for g in list(self._lanes):
+                if g is not None:
+                    g.done = True
+                    self._resolve(g, exc=ShuttingDown("generation batcher "
+                                                      "closed"))
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        if t is None or not t.is_alive():
+            # loop gone (or never started): clean up directly
+            self._resolve_leftovers()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference decoders (tests + the bench A/B baseline)
+# ---------------------------------------------------------------------------
+
+
+def _per_prompt(max_new_tokens, n: int) -> List[int]:
+    if isinstance(max_new_tokens, (list, tuple, np.ndarray)):
+        if len(max_new_tokens) != n:
+            raise ValueError("one max_new_tokens per prompt")
+        return [int(m) for m in max_new_tokens]
+    return [int(max_new_tokens)] * n
+
+
+def generate_sequential(engine: DecodeEngine, prompts, max_new_tokens,
+                        eos_id: Optional[int] = None) -> List[List[int]]:
+    """One request at a time through the SAME compiled signatures the
+    continuous batcher uses — the greedy reference continuous batching
+    must bit-match (same executables, lane-independent math).
+    ``max_new_tokens`` may be one int or one per prompt."""
+    outs = []
+    B = engine.max_slots
+    limits = _per_prompt(max_new_tokens, len(prompts))
+    for prompt, limit in zip(prompts, limits):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        slot = engine.alloc_slot()
+        try:
+            tok_dev, _l, _v = engine.prefill(slot, prompt)
+            toks = [int(np.asarray(tok_dev)[0])]
+            pos = int(prompt.shape[0])
+            while len(toks) < limit and pos < engine.max_len - 1 and \
+                    not (eos_id is not None and toks[-1] == eos_id):
+                lane_toks = np.zeros((B, 1), np.int32)
+                lane_toks[0, 0] = toks[-1]
+                positions = np.zeros(B, np.int32)
+                positions[0] = pos
+                valids = np.zeros(B, np.int32)
+                valids[0] = 1
+                slots = np.full(B, engine.trash_slot, np.int32)
+                slots[0] = slot
+                window = engine.window_bucket(pos + 1)
+                tok_dev, _lg, _p, _ver = engine.dispatch_chunk(
+                    lane_toks, positions, valids, slots, window)
+                toks.append(int(np.asarray(tok_dev)[0]))
+                pos += 1
+        finally:
+            engine.free_slot(slot)
+        outs.append(toks)
+    return outs
+
+
+def generate_static_batched(engine: DecodeEngine, prompts, max_new_tokens,
+                            eos_id: Optional[int] = None
+                            ) -> Tuple[List[List[int]], int]:
+    """The coalesce-then-dispatch baseline the tentpole replaces: admit up
+    to ``max_slots`` prompts as one wave, decode until EVERY member
+    finishes, then start the next wave. Mixed generation lengths waste
+    each finished lane for the remainder of the wave — exactly the cost
+    continuous batching removes. ``max_new_tokens`` may be one int or one
+    per prompt. Returns ``(token_lists, device_steps)``.
+    """
+    outs: List[List[int]] = []
+    steps = 0
+    B = engine.max_slots
+    i = 0
+    prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+    all_limits = _per_prompt(max_new_tokens, len(prompts))
+    while i < len(prompts):
+        wave = prompts[i:i + B]
+        limits = all_limits[i:i + B]
+        i += len(wave)
+        slots = [engine.alloc_slot() for _ in wave]
+        toks: List[List[int]] = []
+        finished = [False] * len(wave)
+        try:
+            for s, p in zip(slots, wave):
+                tok_dev, _l, _v = engine.prefill(s, p)
+                toks.append([int(np.asarray(tok_dev)[0])])
+            for f, t in enumerate(toks):
+                if (eos_id is not None and t[-1] == eos_id) \
+                        or len(t) >= limits[f] \
+                        or wave[f].shape[0] + len(t) >= engine.max_len:
+                    finished[f] = True
+            while not all(finished):
+                lane_toks = np.zeros((B, 1), np.int32)
+                positions = np.zeros(B, np.int32)
+                valids = np.zeros(B, np.int32)
+                lane_slots = np.full(B, engine.trash_slot, np.int32)
+                maxpos = 1
+                for j, (s, p, t) in enumerate(zip(slots, wave, toks)):
+                    lane_toks[j, 0] = t[-1]
+                    positions[j] = p.shape[0] + len(t) - 1
+                    valids[j] = 1
+                    lane_slots[j] = s
+                    maxpos = max(maxpos, int(positions[j]) + 2)
+                window = engine.window_bucket(maxpos)
+                tok_dev, _lg, _p, _ver = engine.dispatch_chunk(
+                    lane_toks, positions, valids, lane_slots, window)
+                steps += 1
+                out = np.asarray(tok_dev)
+                for j in range(len(wave)):
+                    if finished[j]:
+                        continue  # the wasted lane: stepped, discarded
+                    toks[j].append(int(out[j]))
+                    if (eos_id is not None and toks[j][-1] == eos_id) or \
+                            len(toks[j]) >= limits[j] or \
+                            wave[j].shape[0] + len(toks[j]) >= engine.max_len:
+                        finished[j] = True
+        finally:
+            for s in slots:
+                engine.free_slot(s)
+        outs.extend(toks)
+    return outs, steps

@@ -39,8 +39,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
-def _pow2_ladder(limit: int) -> Tuple[int, ...]:
-    """1, 2, 4, ... capped at ``limit`` (limit always included)."""
+def pow2_ladder(limit: int) -> Tuple[int, ...]:
+    """1, 2, 4, ... capped at ``limit`` (limit always included). The ONE
+    bucket ladder of the serving tier: batch buckets here, prompt/window
+    buckets in serving/decode.py — both bound compiled-signature count at
+    log2 of the covered range."""
     ladder = []
     b = 1
     while b < limit:
@@ -50,7 +53,7 @@ def _pow2_ladder(limit: int) -> Tuple[int, ...]:
     return tuple(ladder)
 
 
-def _round_up(size: int, ladder: Optional[Sequence[int]]) -> int:
+def round_up(size: int, ladder: Optional[Sequence[int]]) -> int:
     """Smallest ladder entry >= size; pow2 rounding when no ladder given."""
     if ladder is None:
         b = 1
@@ -61,6 +64,11 @@ def _round_up(size: int, ladder: Optional[Sequence[int]]) -> int:
         if b >= size:
             return b
     raise ValueError(f"size {size} exceeds bucket ladder {tuple(ladder)}")
+
+
+# decode.py grew out of this module; the old private names stay importable
+_pow2_ladder = pow2_ladder
+_round_up = round_up
 
 
 class InFlightBatch:

@@ -84,6 +84,10 @@ class _Handler(socketserver.StreamRequestHandler):
                     if srv.chaos is not None and srv.chaos.drop_connection():
                         return  # injected fault: hang up without answering
                     resp = self._predict(srv, params)
+                elif method == "generate":
+                    if srv.chaos is not None and srv.chaos.drop_connection():
+                        return
+                    resp = self._generate(srv, params)
                 elif method == "healthz":
                     resp = {"result": srv.healthz()}
                 elif method == "stats":
@@ -191,6 +195,65 @@ class _Handler(socketserver.StreamRequestHandler):
                 "stages_ms": {k: v * 1e3 for k, v in timings.items()}}
         return {"result": result}
 
+    @staticmethod
+    def _generate(srv: "ServingServer", params: Dict) -> Dict:
+        """Autoregressive generation over the decode engine (continuous
+        batching: the request joins the in-flight batch at the next token
+        boundary). Same edge behavior as predict: O(1) shed while
+        draining/degraded, relative deadline pinned to this host's clock,
+        typed structured errors."""
+        if srv.gen_batcher is None:
+            return {"error": f"ValueError: this server was built without "
+                             f"decode serving (pass decode=... to "
+                             f"ServingServer)"}
+        state = srv.health_state()
+        if state == "draining":
+            return {"error": ShuttingDown("server draining").info()}
+        if state == "degraded" and srv.should_shed():
+            srv.stats.record_shed()
+            return {"error": LoadShedError(
+                state, srv.gen_batcher.queue_depth,
+                srv.gen_batcher.queue_capacity).info()}
+        tokens = np.asarray(params.get("tokens", []), np.int64)
+        deadline = None
+        wait = srv.request_timeout
+        deadline_ms = params.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline = time.monotonic() + float(deadline_ms) / 1e3
+            wait = min(wait, float(deadline_ms) / 1e3 + 1.0)
+        trace = params.get("trace")
+        trace_id = None
+        if trace:
+            from ..obs import new_trace_id
+
+            trace_id = trace if isinstance(trace, str) else new_trace_id()
+        try:
+            fut = srv.gen_batcher.submit(
+                tokens,
+                max_new_tokens=params.get("max_new_tokens"),
+                eos_id=params.get("eos_id"),
+                deadline=deadline, trace_id=trace_id)
+            res = fut.result(timeout=wait)
+        except ServingError as e:
+            return {"error": error_info(e)}
+        except FuturesTimeout:
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                e = DeadlineExceeded(now - deadline, "server wait")
+            else:
+                e = ServingUnavailable(
+                    f"generation timed out after {wait:.1f}s server-side")
+            return {"error": e.info()}
+        result: Dict[str, Any] = {
+            "tokens": [int(t) for t in res.tokens],
+            "ttft_ms": res.ttft_s * 1e3,
+            "finish_reason": res.finish_reason,
+            "weights_version": res.weights_version,
+        }
+        if trace_id is not None:
+            result["trace"] = {"trace_id": trace_id}
+        return {"result": result}
+
 
 class ServingServer(socketserver.ThreadingTCPServer):
     """Dynamic-batching model server. ``with ServingServer(model_dir) as s:
@@ -210,9 +273,12 @@ class ServingServer(socketserver.ThreadingTCPServer):
                  health_window_s: float = 5.0,
                  shed_prob: Optional[float] = None, shed_seed: int = 0,
                  drain_timeout: float = 30.0, chaos=None,
-                 handle_signals: bool = False, **engine_kwargs):
+                 handle_signals: bool = False, decode=None,
+                 **engine_kwargs):
         super().__init__((host, port), _Handler)
         self.batcher = None
+        self.decode_engine = None
+        self.gen_batcher = None
         try:
             if isinstance(model, ServingEngine):
                 if engine_kwargs:
@@ -241,6 +307,47 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 queue_capacity=queue_capacity,
                 stats=self.stats, pipeline_depth=pipeline_depth,
                 start=start_batcher)
+            # decode serving (docs/design.md §16): ``decode`` arms the
+            # generation path next to one-shot predict. True = defaults;
+            # a dict carries DecodeEngine/GenerationBatcher knobs
+            # (max_slots, kv_buckets, prefill_chunk, gen_queue_capacity,
+            # default_max_new_tokens, pipeline_depth, scheduler); a
+            # prebuilt DecodeEngine is taken as-is.
+            self.decode_engine = None
+            self.gen_batcher = None
+            # truthiness would read decode={} ("all defaults") as OFF and
+            # surface only at the first generate() call — arm on anything
+            # but the explicit not-armed spellings
+            if decode is not None and decode is not False:
+                from .decode import DecodeEngine, GenerationBatcher
+
+                dcfg = dict(decode) if isinstance(decode, dict) else {}
+                if isinstance(decode, DecodeEngine):
+                    self.decode_engine = decode
+                else:
+                    if not isinstance(model, str):
+                        raise ValueError(
+                            "decode serving needs the exported dir (pass "
+                            "the model dirname, or decode=DecodeEngine)")
+                    self.decode_engine = DecodeEngine(
+                        model,
+                        max_slots=dcfg.pop("max_slots", None),
+                        max_len=dcfg.pop("max_len", None),
+                        kv_buckets=dcfg.pop("kv_buckets", None),
+                        prefill_chunk=dcfg.pop("prefill_chunk", None))
+                self.gen_batcher = GenerationBatcher(
+                    self.decode_engine,
+                    queue_capacity=dcfg.pop("gen_queue_capacity",
+                                            queue_capacity),
+                    stats=self.stats,
+                    scheduler=dcfg.pop("scheduler", None),
+                    pipeline_depth=dcfg.pop("pipeline_depth",
+                                            pipeline_depth),
+                    default_max_new_tokens=dcfg.pop(
+                        "default_max_new_tokens", 64),
+                    start=start_batcher)
+                if dcfg:
+                    raise ValueError(f"unknown decode knobs {sorted(dcfg)}")
             self.request_timeout = request_timeout
             # observability plumbing: honor PT_FLAG_OBS_TRACE, and register
             # pull-gauges into the stats registry so GET /metrics carries
@@ -273,6 +380,13 @@ class ServingServer(socketserver.ThreadingTCPServer):
                     "1 healthy / 0.5 degraded / 0 draining",
                     callback=lambda: {"healthy": 1.0, "degraded": 0.5,
                                       "draining": 0.0}[self.health_state()])
+            if self.gen_batcher is not None:
+                r.gauge("pt_serving_decode_queue_depth",
+                        "Generations queued for a KV slot",
+                        callback=lambda: self.gen_batcher.queue_depth)
+                r.gauge("pt_serving_decode_pending",
+                        "Accepted generations not yet resolved",
+                        callback=lambda: self.gen_batcher.pending)
             # health state machine + probabilistic load shedding
             self.degraded_queue_ratio = degraded_queue_ratio
             self.degraded_error_ratio = degraded_error_ratio
@@ -289,15 +403,22 @@ class ServingServer(socketserver.ThreadingTCPServer):
             self._t0 = time.monotonic()
             if warmup:
                 self.engine.warmup()
+                if self.decode_engine is not None:
+                    self.decode_engine.warmup()
             # chaos hooks attach AFTER warmup: the ladder pre-compile is
             # deployment plumbing, not traffic the harness should fault
             self.chaos = chaos
             if chaos is not None:
                 self.engine.chaos = chaos
                 self.batcher.chaos = chaos
+                if self.decode_engine is not None:
+                    self.decode_engine.chaos = chaos
+                    self.gen_batcher.chaos = chaos
         except Exception:
             # the port bound before setup failed: release it (and any live
             # batcher worker) instead of leaking until GC
+            if getattr(self, "gen_batcher", None) is not None:
+                self.gen_batcher.close(drain=False)
             if self.batcher is not None:
                 self.batcher.close()
             self.server_close()
@@ -355,14 +476,21 @@ class ServingServer(socketserver.ThreadingTCPServer):
 
     def healthz(self) -> Dict[str, Any]:
         state = self.health_state()
-        return {"ok": state != "draining", "state": state,
-                "uptime_s": time.monotonic() - self._t0,
-                "model_dir": self.engine.dirname,
-                "feeds": list(self.engine.feed_names),
-                "fetches": list(self.engine.fetch_names),
-                "queue_depth": self.batcher.queue_depth,
-                "queue_capacity": self.batcher.queue_capacity,
-                "weights_version": self.engine.params_version}
+        h = {"ok": state != "draining", "state": state,
+             "uptime_s": time.monotonic() - self._t0,
+             "model_dir": self.engine.dirname,
+             "feeds": list(self.engine.feed_names),
+             "fetches": list(self.engine.fetch_names),
+             "queue_depth": self.batcher.queue_depth,
+             "queue_capacity": self.batcher.queue_capacity,
+             "weights_version": self.engine.params_version}
+        if self.gen_batcher is not None:
+            h["decode"] = {
+                "max_slots": self.decode_engine.max_slots,
+                "active_slots": self.decode_engine.active_slots,
+                "queue_depth": self.gen_batcher.queue_depth,
+                "weights_version": self.decode_engine.params_version}
+        return h
 
     def metrics_text(self) -> str:
         """Prometheus text exposition (the ``GET /metrics`` body): the
@@ -380,6 +508,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
             "pipeline_depth": self.batcher.pipeline_depth,
             "in_flight": self.batcher.in_flight,
         }
+        if self.gen_batcher is not None:
+            extra["decode_compile_cache"] = self.decode_engine.cache_info()
+            extra["decode_queue_depth"] = self.gen_batcher.queue_depth
         if self.chaos is not None:
             extra["chaos"] = self.chaos.snapshot()
         return self.stats.snapshot(extra=extra)
@@ -411,7 +542,16 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 "reload: dispatch pipeline did not quiesce within the "
                 "barrier timeout — retry")
         self.stats.record_reload()
-        return {"weights_version": swapped["version"]}
+        out = {"weights_version": swapped["version"]}
+        if self.gen_batcher is not None:
+            # decode reloads at its own barrier — a token boundary with no
+            # generation in flight, so every generation stays wholly on
+            # the version pinned at its admission (ServingUnavailable if
+            # the barrier cannot clear; the one-shot swap above stands —
+            # the two engines version independently)
+            out["decode_weights_version"] = self.gen_batcher.reload(
+                dirname, record=False)  # one RPC = one counted reload
+        return out
 
     # -- graceful shutdown --
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -422,7 +562,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
         deadline = time.monotonic() + (
             self.drain_timeout if timeout is None else timeout)
         while time.monotonic() < deadline:
-            if self.batcher.queue_depth == 0 and self.batcher.pending == 0:
+            if self.batcher.queue_depth == 0 and self.batcher.pending == 0 \
+                    and (self.gen_batcher is None
+                         or self.gen_batcher.pending == 0):
                 return True
             time.sleep(0.005)
         return False
@@ -438,6 +580,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
         self._draining = True
         if drain:
             self.drain(timeout)
+        if self.gen_batcher is not None:
+            # in-flight generations finish (drain=True) or resolve typed
+            self.gen_batcher.close(drain=drain)
         self.batcher.close()  # serves anything still queued, then stops
         self.shutdown()
         self.server_close()
@@ -584,6 +729,33 @@ class ServingClient:
         self.last_trace = result.get("trace") if trace else None
         return [np.asarray(f["data"], dtype=f["dtype"]).reshape(f["shape"])
                 for f in result["fetches"]]
+
+    def generate(self, tokens, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 trace=False) -> Dict[str, Any]:
+        """Autoregressive generation on a decode-enabled server. Returns
+        ``{"tokens": [...], "ttft_ms": float, "finish_reason":
+        "eos"|"length", "weights_version": int}``. Same deadline/retry
+        semantics as ``predict`` (a failed generation is retryable: no
+        state outlives the request's KV slot)."""
+        params: Dict[str, Any] = {
+            "tokens": [int(t) for t in np.asarray(tokens).reshape(-1)]}
+        if max_new_tokens is not None:
+            params["max_new_tokens"] = int(max_new_tokens)
+        if eos_id is not None:
+            params["eos_id"] = int(eos_id)
+        if trace:
+            from ..obs import new_trace_id
+
+            params["trace"] = trace if isinstance(trace, str) \
+                else new_trace_id()
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        result = self.call_with_retries("generate", params,
+                                        deadline=deadline)
+        self.last_trace = result.get("trace") if trace else None
+        return result
 
     def healthz(self) -> Dict[str, Any]:
         return self.call("healthz")

@@ -56,9 +56,10 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
-#: request pipeline stages, in hot-path order (docs/design.md §15)
+#: request pipeline stages, in hot-path order (docs/design.md §15); the
+#: trailing pair belongs to the decode serving path (docs/design.md §16)
 STAGES = ("pad", "queue_wait", "coalesce", "dispatch", "pipeline_wait",
-          "device_sync", "scatter")
+          "device_sync", "scatter", "prefill", "decode_step")
 
 
 class ServingStats:
@@ -125,6 +126,29 @@ class ServingStats:
         r.gauge("pt_serving_mfu",
                 "flops_per_second / (obs_peak_tflops * 1e12)",
                 callback=self.mfu)
+        # decode-serving instruments (serving/decode.py): generated-token
+        # throughput, slot occupancy, time-to-first-token and inter-token
+        # latency. Prefill/decode-step stage timings ride the shared
+        # pt_serving_stage_seconds histogram ("prefill" / "decode_step"
+        # labels) and stage_summary like every other pipeline stage.
+        self._decode_tokens = r.counter(
+            "pt_serving_decode_tokens_total",
+            "Tokens generated by the decode serving path")
+        self._decode_active = r.gauge(
+            "pt_serving_decode_active_slots",
+            "In-flight generations holding a KV slot")
+        self._decode_capacity = r.gauge(
+            "pt_serving_decode_max_slots",
+            "KV slot pool capacity")
+        self._ttft_hist = r.histogram(
+            "pt_serving_decode_ttft_seconds",
+            "Submit to first generated token")
+        self._itl_hist = r.histogram(
+            "pt_serving_decode_itl_seconds",
+            "Inter-token latency of in-flight generations")
+        r.gauge("pt_serving_decode_tokens_per_second",
+                "Windowed generated-token rate",
+                callback=self.decode_tokens_rate)
         # latency ring (last N latencies, seconds) bounds the percentile
         # cost; rates count in separate per-second buckets so high
         # throughput can't push events out before their window expires
@@ -136,6 +160,9 @@ class ServingStats:
         # windowed FLOP/s (the MFU numerator) — the shared obs RateWindow,
         # same mechanism the executor's pt_train_flops_per_second rides
         self._flops_window = RateWindow(qps_window_s)
+        self._decode_tokens_window = RateWindow(qps_window_s)
+        self._ttft: deque = deque(maxlen=latency_window)
+        self._itl: deque = deque(maxlen=latency_window)
 
     # -- legacy attribute surface (everything reads the registry) --
     @property
@@ -259,6 +286,14 @@ class ServingStats:
         with self._lock:
             self._stage_lat[stage].append(seconds)
 
+    def stage_count(self, stage: str) -> int:
+        """CUMULATIVE number of observations of ``stage`` (the Prometheus
+        histogram count) — unlike ``stage_summary()['count']``, which is
+        capped at the retained percentile window and must not be used as
+        an event counter."""
+        child = self._stage_children.get(stage)
+        return int(child.count) if child is not None else 0
+
     def set_pipeline_depth(self, depth: int) -> None:
         self._pipe_depth.set(int(depth))
 
@@ -269,6 +304,32 @@ class ServingStats:
         with self._lock:
             if occ > self._occ_max.value:
                 self._occ_max.set(occ)
+
+    def record_decode_tokens(self, n: int = 1) -> None:
+        self._decode_tokens.inc(n)
+        self._decode_tokens_window.add(n)
+
+    def record_ttft(self, seconds: float) -> None:
+        self._ttft_hist.observe(seconds)
+        with self._lock:
+            self._ttft.append(seconds)
+
+    def record_itl(self, seconds: float) -> None:
+        self._itl_hist.observe(seconds)
+        with self._lock:
+            self._itl.append(seconds)
+
+    def set_decode_slots(self, active: int, capacity: int) -> None:
+        self._decode_active.set(int(active))
+        self._decode_capacity.set(int(capacity))
+
+    def decode_tokens_rate(self) -> float:
+        """Windowed generated tokens/s (the decode throughput gauge)."""
+        return self._decode_tokens_window.rate()
+
+    @property
+    def decode_tokens(self) -> int:
+        return int(self._decode_tokens.value)
 
     def record_done(self, latency_s: float) -> None:
         self._c["completed"].inc()
@@ -316,6 +377,30 @@ class ServingStats:
             }
         return out
 
+    def decode_summary(self) -> Dict[str, float]:
+        """Generation-serving rollup: token throughput, slot occupancy,
+        TTFT / inter-token latency percentiles (serve_bench --generate
+        prints this; the stats RPC carries it as ``decode``)."""
+        with self._lock:
+            ttft = sorted(self._ttft)
+            itl = sorted(self._itl)
+        return {
+            "tokens": self.decode_tokens,
+            "tokens_per_s": self.decode_tokens_rate(),
+            "active_slots": int(self._decode_active.value),
+            "max_slots": int(self._decode_capacity.value),
+            "ttft_ms": {
+                "mean": (sum(ttft) / len(ttft) * 1e3) if ttft else 0.0,
+                "p50": _percentile(ttft, 0.50) * 1e3,
+                "p95": _percentile(ttft, 0.95) * 1e3,
+            },
+            "itl_ms": {
+                "mean": (sum(itl) / len(itl) * 1e3) if itl else 0.0,
+                "p50": _percentile(itl, 0.50) * 1e3,
+                "p95": _percentile(itl, 0.95) * 1e3,
+            },
+        }
+
     def expose(self) -> str:
         """Prometheus text exposition of this stats object's registry."""
         return self.registry.expose()
@@ -361,6 +446,7 @@ class ServingStats:
             "stages_ms": self.stage_summary(),
             "flops_per_s": self.flops_rate(),
             "mfu": self.mfu(),
+            "decode": self.decode_summary(),
         }
         if extra:
             snap.update(extra)

@@ -157,8 +157,8 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all six tracked metrics carry a bar
-    assert len(bench.BARS) == 6
+    # all seven tracked metrics carry a bar (r6 added decode serving)
+    assert len(bench.BARS) == 7
     # pass: above bar
     bench._emit({"metric": "transformer_lm_train_tokens_per_sec_per_chip",
                  "value": 150000.0, "unit": "tokens/sec", "mfu": 0.648})

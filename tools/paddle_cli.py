@@ -21,6 +21,25 @@ from collections import defaultdict
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _probe_backends(timeout_s=45):
+    """Platform list via a killable child: `version` is a host-side
+    informational command, and an accelerator plugin probing absent
+    hardware can hang jax backend init for minutes (the PR-1 benchmark
+    driver hang) — that must bound-fail the backends line, not the CLI."""
+    code = ("import jax; "
+            "print(','.join(sorted({d.platform for d in jax.devices()})))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, cwd=REPO,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return ["unavailable (backend probe timed out)"]
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return [f"unavailable ({tail[-1] if tail else r.returncode})"]
+    return r.stdout.strip().split(",")
+
+
 def cmd_version():
     sys.path.insert(0, REPO)
     import jax
@@ -29,11 +48,7 @@ def cmd_version():
 
     print("paddle_tpu (TPU-native Paddle-capability framework)")
     print("  jax:", jax.__version__)
-    try:
-        platforms = sorted({d.platform for d in jax.devices()})
-    except RuntimeError as e:  # no device/backend in this environment
-        platforms = [f"unavailable ({e})"]
-    print("  backends:", ", ".join(platforms))
+    print("  backends:", ", ".join(_probe_backends()))
     from paddle_tpu.core.registry import registered_ops
 
     print("  ops registered:", len(registered_ops()))

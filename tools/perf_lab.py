@@ -15,6 +15,13 @@ chip/XLA limits. Variants:
                                      # report step_ms per config — one
                                      # command to spot a pipelining
                                      # regression (docs/design.md §13)
+  python tools/perf_lab.py decode    # sweep the decode-serving knobs
+                                     # (max_slots x KV bucket ladder x
+                                     # prefill chunk) over a mixed-length
+                                     # generation workload; prints tokens/s
+                                     # per config and emits the CHOSEN
+                                     # config as the final JSON line
+                                     # (docs/design.md §16)
 
 Prints images/sec and analytic MFU (12.3 GFLOP/img fwd+bwd on a
 ~197 TFLOP/s bf16 v5e chip) for the resnet modes; step_ms per knob for
@@ -213,10 +220,111 @@ def pipeline_mode(steps: int = 64):
         timed(f"prefetch depth={depth}", run_prefetched, steps)
 
 
+def decode_mode(n_requests: int = 32, seed: int = 7):
+    """Sweep the decode-serving knobs (docs/design.md §16) over one fixed
+    mixed-length generation workload and emit the winner as JSON.
+
+    Grid: ``max_slots`` (batch width of the fixed-shape step — occupancy
+    vs per-step cost), KV bucket ladder (``fine`` = every power of two:
+    tight attention windows, more compiled signatures; ``coarse`` = every
+    other rung: half the signatures, wider windows), ``prefill_chunk``
+    (0 = whole-prompt buckets; N = fixed N-token chunks, bounding the
+    stall a long prompt inflicts on in-flight lanes). Each config is run
+    once to warm its executables (this backend's first ~30 calls per
+    signature run slow) and once measured.
+    """
+    import json
+    import os
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import paddle_tpu as fluid
+    from paddle_tpu import io
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.serving.decode import DecodeEngine, GenerationBatcher
+    from paddle_tpu.serving.engine import pow2_ladder
+
+    V, T, D, H, L, FF = 512, 128, 64, 4, 2, 128
+    d = os.path.join(tempfile.mkdtemp(prefix="perf_lab_decode_"), "lm")
+    with fluid.unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+            logits, _loss = transformer_lm(
+                ids, labels, vocab_size=V, max_len=T, d_model=D, n_heads=H,
+                n_layers=L, d_ff=FF)
+        exe = fluid.Executor(fluid.default_place())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)
+        io.save_inference_model(d, ["ids"], [logits], exe, main_prog,
+                                scope=scope)
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, V, size=(int(rng.randint(4, 48)),))
+               for _ in range(n_requests)]
+    # bimodal budgets: the chat-shaped mix where continuous batching's
+    # retire-and-admit discipline matters most
+    budgets = [int(b) for b in np.where(rng.rand(n_requests) < 0.7,
+                                        rng.randint(4, 16, n_requests),
+                                        rng.randint(48, 72, n_requests))]
+    total_budget = sum(budgets)
+    print(f"decode sweep: {n_requests} generations, prompts 4-47 tokens, "
+          f"budgets {min(budgets)}-{max(budgets)} "
+          f"(sum {total_budget}), LM V={V} T={T} D={D} L={L}")
+
+    full = tuple(b for b in pow2_ladder(T) if b >= 16)
+    ladders = {"fine": full, "coarse": full[1::2] + (
+        () if full[-1] in full[1::2] else (full[-1],))}
+    rows = []
+    for slots in (4, 8, 16):
+        for lname, ladder in ladders.items():
+            for chunk in (0, 16):
+                eng = DecodeEngine(d, max_slots=slots, kv_buckets=ladder,
+                                   prefill_chunk=chunk)
+                eng.warmup()
+
+                def run_once(eng=eng, slots=slots):
+                    gb = GenerationBatcher(eng, queue_capacity=n_requests,
+                                           default_max_new_tokens=64)
+                    try:
+                        t0 = time.monotonic()
+                        futs = [gb.submit(p, max_new_tokens=b)
+                                for p, b in zip(prompts, budgets)]
+                        toks = sum(len(f.result(timeout=600).tokens)
+                                   for f in futs)
+                        return toks, time.monotonic() - t0
+                    finally:
+                        gb.close()
+
+                run_once()  # warm the executables
+                toks, dt = run_once()
+                rate = toks / dt
+                rows.append({"max_slots": slots, "kv_buckets": lname,
+                             "ladder": list(ladder), "prefill_chunk": chunk,
+                             "tokens": toks, "seconds": round(dt, 3),
+                             "tokens_per_s": round(rate, 1),
+                             "signatures": eng.cache_info()["size"]})
+                print(f"slots={slots:<3} buckets={lname:<7} "
+                      f"chunk={chunk:<3} {rate:8.1f} tok/s  "
+                      f"({toks} tokens in {dt:.2f}s, "
+                      f"{rows[-1]['signatures']} signatures)")
+    best = max(rows, key=lambda r: r["tokens_per_s"])
+    print("chosen config:")
+    print(json.dumps({"chosen": {k: best[k] for k in
+                                 ("max_slots", "kv_buckets", "ladder",
+                                  "prefill_chunk")},
+                      "tokens_per_s": best["tokens_per_s"],
+                      "rows": rows}))
+
+
 def main():
     layout = sys.argv[1] if len(sys.argv) > 1 else "nchw"
     if layout == "pipeline":
         pipeline_mode()
+        return
+    if layout == "decode":
+        decode_mode()
         return
     rng = np.random.RandomState(0)
     params, blocks = init_params(rng, layout)

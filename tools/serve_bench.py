@@ -20,6 +20,14 @@ clients retry with exponential backoff (``--retries``), so the report
 shows the resilience layer absorbing the faults: retry counts, sheds,
 deadline misses, and the server's health state returning to ``healthy``.
 
+``--generate`` switches the clients to closed-loop autoregressive
+generation against a decode-enabled server (``serving/decode.py``
+continuous batching): each client submits a random prompt with a random
+token budget, waits for the full stream, and repeats. The report adds the
+decode plane: aggregate generated tokens/s, time-to-first-token and
+inter-token latency p50/p95, mean/max KV-slot occupancy (sampled), and
+the decode compile cache (steady state must show zero recompiles).
+
 Examples::
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
@@ -27,6 +35,9 @@ Examples::
     python tools/serve_bench.py --endpoint 127.0.0.1:9000 --shape x=4
     JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
         --chaos --chaos-seed 7 --duration 6 --deadline-ms 500
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/lm \
+        --generate --clients 16 --duration 15 --max-slots 8 \
+        --gen-tokens 8:64 --prompt-tokens 2:16
 """
 from __future__ import annotations
 
@@ -69,6 +80,110 @@ def _client_loop(endpoint, feeds, stop, out, retries, deadline_ms, seed):
         retries_used = c.retries_total
     out.append((lat, done, rejected, deadline_missed, exhausted, errors,
                 retries_used))
+
+
+def _parse_range(spec, name):
+    lo, _, hi = spec.partition(":")
+    lo, hi = int(lo), int(hi or lo)
+    if not 1 <= lo <= hi:
+        raise SystemExit(f"--{name} wants LO:HI with 1 <= LO <= HI, "
+                         f"got {spec!r}")
+    return lo, hi
+
+
+def _gen_client_loop(endpoint, vocab, prompt_rng_seed, prompt_range,
+                     token_range, stop, out, retries, deadline_ms):
+    """One closed-loop generation client: random prompt + budget, wait for
+    the whole stream, repeat."""
+    rng = np.random.RandomState(prompt_rng_seed)
+    lat, ttfts, tokens, done = [], [], 0, 0
+    rejected = deadline_missed = exhausted = errors = 0
+    with ServingClient(endpoint, retries=retries, backoff_base_ms=5.0,
+                       retry_seed=prompt_rng_seed) as c:
+        while not stop.is_set():
+            prompt = rng.randint(0, vocab, size=(
+                int(rng.randint(prompt_range[0], prompt_range[1] + 1)),))
+            budget = int(rng.randint(token_range[0], token_range[1] + 1))
+            t0 = time.monotonic()
+            try:
+                r = c.generate(prompt, max_new_tokens=budget,
+                               timeout_ms=deadline_ms)
+                lat.append(time.monotonic() - t0)
+                ttfts.append(r["ttft_ms"] / 1e3)
+                tokens += len(r["tokens"])
+                done += 1
+            except ServingRejected:
+                rejected += 1
+                time.sleep(0.001)
+            except DeadlineExceeded:
+                deadline_missed += 1
+            except RetryBudgetExceeded:
+                exhausted += 1
+            except Exception:
+                errors += 1
+                break
+        retries_used = c.retries_total
+    out.append({"lat": lat, "ttft": ttfts, "tokens": tokens, "done": done,
+                "rejected": rejected, "deadline_missed": deadline_missed,
+                "exhausted": exhausted, "errors": errors,
+                "retries": retries_used})
+
+
+def bench_generate(endpoint, vocab, clients, duration, prompt_range,
+                   token_range, retries=0, deadline_ms=None,
+                   occupancy_poll_s=0.05):
+    """Closed-loop generation bench + an occupancy sampler riding healthz
+    (the decode gauge is instantaneous; the mean NEEDS sampling)."""
+    stop = threading.Event()
+    out = []
+    threads = [threading.Thread(target=_gen_client_loop,
+                                args=(endpoint, vocab, i, prompt_range,
+                                      token_range, stop, out, retries,
+                                      deadline_ms), daemon=True)
+               for i in range(clients)]
+    occ_samples = []
+
+    def sampler():
+        with ServingClient(endpoint) as c:
+            while not stop.is_set():
+                try:
+                    d = c.healthz().get("decode")
+                    if d:
+                        occ_samples.append(
+                            d["active_slots"] / max(d["max_slots"], 1))
+                except Exception:
+                    pass
+                time.sleep(occupancy_poll_s)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    sampler_t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(60)
+    sampler_t.join(10)
+    elapsed = time.monotonic() - t0
+    lats = sorted(l for r in out for l in r["lat"])
+    ttfts = sorted(t for r in out for t in r["ttft"])
+    tokens = sum(r["tokens"] for r in out)
+    done = sum(r["done"] for r in out)
+    return {"elapsed_s": elapsed, "generations": done, "tokens": tokens,
+            "tokens_per_s": tokens / elapsed if elapsed else 0.0,
+            "rejected": sum(r["rejected"] for r in out),
+            "deadline_missed": sum(r["deadline_missed"] for r in out),
+            "retry_exhausted": sum(r["exhausted"] for r in out),
+            "errors": sum(r["errors"] for r in out),
+            "client_retries": sum(r["retries"] for r in out),
+            "gen_p50_ms": _percentile(lats, 0.50) * 1e3,
+            "gen_p95_ms": _percentile(lats, 0.95) * 1e3,
+            "ttft_p50_ms": _percentile(ttfts, 0.50) * 1e3,
+            "ttft_p95_ms": _percentile(ttfts, 0.95) * 1e3,
+            "occupancy_mean": (sum(occ_samples) / len(occ_samples))
+            if occ_samples else 0.0,
+            "occupancy_max": max(occ_samples) if occ_samples else 0.0}
 
 
 def bench(endpoint, feeds, clients, duration, retries=0, deadline_ms=None):
@@ -134,6 +249,23 @@ def main(argv=None):
     ap.add_argument("--chaos-window", type=float, default=None,
                     help="stop injecting after this many seconds (default: "
                          "half the bench duration)")
+    ap.add_argument("--generate", action="store_true",
+                    help="closed-loop autoregressive generation against a "
+                         "decode-enabled server (continuous batching) "
+                         "instead of one-shot predict")
+    ap.add_argument("--gen-tokens", default="8:64", metavar="LO:HI",
+                    help="per-generation max_new_tokens range (--generate)")
+    ap.add_argument("--prompt-tokens", default="2:16", metavar="LO:HI",
+                    help="per-generation prompt length range (--generate)")
+    ap.add_argument("--max-slots", type=int, default=None,
+                    help="KV slot pool size of the in-process decode "
+                         "engine (--generate + --model-dir; default: the "
+                         "decode_max_slots flag)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill size (0 = whole-prompt buckets)")
+    ap.add_argument("--vocab", type=int, default=None,
+                    help="prompt token id range (--generate + --endpoint; "
+                         "--model-dir reads it from the export)")
     ap.add_argument("--trace-out", metavar="FILE",
                     help="enable the obs span tracer and write a Chrome "
                          "trace (chrome://tracing / ui.perfetto.dev) of "
@@ -168,11 +300,20 @@ def main(argv=None):
                           else args.duration / 2)
                 chaos = default_profile(seed=args.chaos_seed,
                                         fault_window_s=window)
+            decode = None
+            if args.generate:
+                decode = {}
+                if args.max_slots is not None:
+                    decode["max_slots"] = args.max_slots
+                if args.prefill_chunk is not None:
+                    decode["prefill_chunk"] = args.prefill_chunk
+                decode["gen_queue_capacity"] = args.queue_capacity
             server = ServingServer(
                 args.model_dir, max_batch_size=args.max_batch_size,
                 batch_timeout_ms=args.batch_timeout_ms,
                 queue_capacity=args.queue_capacity,
-                pipeline_depth=args.pipeline_depth, warmup=True, chaos=chaos)
+                pipeline_depth=args.pipeline_depth, warmup=True, chaos=chaos,
+                decode=decode)
             endpoint = server.endpoint
             for n in server.engine.feed_names:
                 if n not in shapes:
@@ -180,6 +321,12 @@ def main(argv=None):
                     shapes[n] = tuple(var.shape)[1:]
             print(f"spawned server on {endpoint} (warmed "
                   f"{server.engine.cache_info()['misses']} buckets)")
+            if args.generate:
+                args.vocab = server.decode_engine.cfg["vocab"]
+                print(f"decode engine: slots={server.decode_engine.max_slots} "
+                      f"kv_buckets={server.decode_engine.kv_buckets} "
+                      f"warmed={server.decode_engine.cache_info()['misses']} "
+                      f"signatures")
             if chaos is not None:
                 chaos.arm()  # fault window starts with the traffic, not
                 # with server construction (warmup compiles are not chaos)
@@ -187,8 +334,54 @@ def main(argv=None):
                       f"window={chaos.fault_window_s:.1f}s retries={retries}")
         else:
             endpoint = args.endpoint
-            if not shapes:
+            if args.generate:
+                if args.vocab is None:
+                    ap.error("--generate --endpoint needs --vocab")
+            elif not shapes:
                 ap.error("--endpoint needs at least one --shape name=dims")
+
+        if args.generate:
+            pr = _parse_range(args.prompt_tokens, "prompt-tokens")
+            tr = _parse_range(args.gen_tokens, "gen-tokens")
+            print(f"benching {endpoint}: {args.clients} closed-loop "
+                  f"GENERATION clients, {args.duration:.0f}s, prompts "
+                  f"{pr[0]}-{pr[1]} tokens, budgets {tr[0]}-{tr[1]} tokens")
+            r = bench_generate(endpoint, args.vocab, args.clients,
+                               args.duration, pr, tr, retries=retries,
+                               deadline_ms=args.deadline_ms)
+            print(f"generations={r['generations']} tokens={r['tokens']} "
+                  f"rejected={r['rejected']} "
+                  f"deadline_missed={r['deadline_missed']} "
+                  f"retry_exhausted={r['retry_exhausted']} "
+                  f"errors={r['errors']} "
+                  f"client_retries={r['client_retries']}")
+            print(f"tokens/s={r['tokens_per_s']:.1f}  "
+                  f"gen p50={r['gen_p50_ms']:.1f}ms "
+                  f"p95={r['gen_p95_ms']:.1f}ms  "
+                  f"ttft p50={r['ttft_p50_ms']:.1f}ms "
+                  f"p95={r['ttft_p95_ms']:.1f}ms")
+            print(f"slot occupancy: mean={r['occupancy_mean']:.2f} "
+                  f"max={r['occupancy_max']:.2f} (sampled)")
+            with ServingClient(endpoint) as c:
+                s = c.stats()
+                d = s.get("decode") or {}
+                itl = d.get("itl_ms") or {}
+                print(f"server decode: tokens={d.get('tokens')} "
+                      f"itl p50={itl.get('p50', 0.0):.3f}ms "
+                      f"p95={itl.get('p95', 0.0):.3f}ms  "
+                      f"cache={s.get('decode_compile_cache')}")
+                stages = s.get("stages_ms") or {}
+                for st in ("prefill", "decode_step"):
+                    if st in stages:
+                        print(f"  {st:<12} mean={stages[st]['mean_ms']:8.3f} "
+                              f"p95={stages[st]['p95_ms']:8.3f} "
+                              f"n={stages[st]['count']}")
+                if "chaos" in s:
+                    print(f"chaos: {s['chaos']}")
+            if tracer is not None:
+                n = tracer.dump(args.trace_out)
+                print(f"chrome trace: {args.trace_out} ({n} spans)")
+            return 0 if r["errors"] == 0 else 1
 
         rng = np.random.RandomState(0)
         feeds = {n: rng.rand(args.rows, *dims).astype("float32")
