@@ -94,6 +94,35 @@ class _Instrument:
                 self._children[key] = child
             return child
 
+    def children(self) -> Dict[Tuple[str, ...], "_Instrument"]:
+        """Snapshot of the label children, keyed by label-value tuple —
+        lets callers derive per-label views from the one true counter."""
+        with self._lock:
+            return dict(self._children)
+
+    def remove(self, *labelvalues, **labelkv) -> None:
+        """Drop one label child (e.g. a decommissioned replica's series)."""
+        if labelkv:
+            if labelvalues:
+                raise ValueError("pass labels positionally OR by name")
+            labelvalues = tuple(labelkv[n] for n in self.labelnames)
+        key = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def prune(self, keep) -> None:
+        """Drop every label child whose key is not in ``keep`` (an
+        iterable of label-value tuples, or bare values for one-label
+        families)."""
+        keys = set()
+        for k in keep:
+            if not isinstance(k, tuple):
+                k = (k,)
+            keys.add(tuple(str(v) for v in k))
+        with self._lock:
+            for key in [k for k in self._children if k not in keys]:
+                del self._children[key]
+
     def _samples(self) -> List[Tuple[str, str, float]]:
         """[(suffix, labelstr, value)] — flat family expansion."""
         out: List[Tuple[str, str, float]] = []

@@ -30,6 +30,13 @@ plane). Pieces, composable or used together via ``ServingServer``:
 * ``ChaosInjector`` (chaos.py) — seeded fault injection (slow device
   calls, step faults, connection drops, queue stalls) proving all of the
   above recovers; wired into ``tools/serve_bench.py --chaos``.
+* ``FleetRouter`` / ``LocalFleet`` (fleet.py, docs/design.md §17) — the
+  fleet tier over N replicas: least-loaded routing off scraped
+  ``/metrics`` gauges, per-tenant token-bucket quotas with priority
+  shedding, hedged predicts, circuit breaking with half-open probing,
+  replica failover under one shared retry budget, rolling reload, and
+  autoscale hooks; ``FleetChaos`` (chaos.py) storms it with replica
+  kills/restarts, partitions, and slow replicas.
 * ``errors`` (errors.py) — the typed error hierarchy + wire codes.
 
 Quickstart::
@@ -45,20 +52,24 @@ Quickstart::
             print(c.stats()["latency_ms"], c.healthz()["state"])
 """
 from .batcher import MicroBatcher, QueueFullError  # noqa: F401
-from .chaos import ChaosInjector  # noqa: F401
+from .chaos import ChaosInjector, FleetChaos  # noqa: F401
 from .decode import (DecodeEngine, GenerationBatcher,  # noqa: F401
                      GenerationResult, SlotScheduler)
 from .engine import ServingEngine  # noqa: F401
-from .errors import (DeadlineExceeded, InjectedFault, LoadShedError,  # noqa: F401
+from .errors import (DeadlineExceeded, FleetOverloaded,  # noqa: F401
+                     InjectedFault, LoadShedError, NoHealthyReplicas,
                      RetryBudgetExceeded, ServingError, ServingRejected,
-                     ServingUnavailable, ShuttingDown)
+                     ServingUnavailable, ShuttingDown, TenantQuotaExceeded)
+from .fleet import FleetRouter, LocalFleet, TokenBucket  # noqa: F401
 from .server import ServingClient, ServingServer  # noqa: F401
-from .stats import ServingStats  # noqa: F401
+from .stats import FleetStats, ServingStats  # noqa: F401
 
 __all__ = [
-    "ChaosInjector", "DeadlineExceeded", "DecodeEngine", "GenerationBatcher",
-    "GenerationResult", "InjectedFault", "LoadShedError",
-    "MicroBatcher", "QueueFullError", "RetryBudgetExceeded", "ServingClient",
-    "ServingEngine", "ServingError", "ServingRejected", "ServingServer",
-    "ServingStats", "ServingUnavailable", "ShuttingDown", "SlotScheduler",
+    "ChaosInjector", "DeadlineExceeded", "DecodeEngine", "FleetChaos",
+    "FleetOverloaded", "FleetRouter", "FleetStats", "GenerationBatcher",
+    "GenerationResult", "InjectedFault", "LoadShedError", "LocalFleet",
+    "MicroBatcher", "NoHealthyReplicas", "QueueFullError",
+    "RetryBudgetExceeded", "ServingClient", "ServingEngine", "ServingError",
+    "ServingRejected", "ServingServer", "ServingStats", "ServingUnavailable",
+    "ShuttingDown", "SlotScheduler", "TenantQuotaExceeded", "TokenBucket",
 ]

@@ -63,6 +63,10 @@ class ChaosInjector:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
+        # fleet-tier fault (FleetChaos / LocalFleet.set_partition): while
+        # True the server hangs up on EVERY request — data and scrape —
+        # without answering, modelling a network partition
+        self.partitioned = False
         self.injected = {"slow_calls": 0, "errors": 0, "dropped_conns": 0,
                          "stalls": 0}
 
@@ -115,6 +119,182 @@ class ChaosInjector:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {"seed": self.seed, "active": self._active_locked(),
+                    "partitioned": self.partitioned,
+                    "injected": dict(self.injected)}
+
+
+class FleetChaos:
+    """Seeded FLEET-level fault orchestrator: the PR-2 injector lifted
+    from one process to the whole replica set. Drives any object with the
+    ``LocalFleet`` control surface (``alive_indices`` / ``kill_replica``
+    / ``restart_replica`` / ``set_partition`` / ``set_slow``) from a
+    background thread. Fault classes, each an independent seeded roll per
+    ``tick_s``:
+
+    * **replica kill + restart** (``kill_prob``/``restart_delay_s``) —
+      abrupt ``close(drain=False)``; the replica respawns on a fresh port
+      ``restart_delay_s`` later. Exercises router death discovery
+      (scrapes + circuit breaker), failover, and membership churn.
+    * **partition** (``partition_prob``/``partition_s``) — the replica
+      answers NOTHING (data or scrape) for a window: connects succeed,
+      requests hang up. Exercises circuit open -> half-open recovery.
+    * **slow replica** (``slow_prob``/``slow_s``/``slow_ms``) — every
+      dispatch on one replica stalls; exercises hedging.
+
+    Faults stop at the ``fault_window_s``/``max_faults`` bound, but
+    HEALS never do: pending restarts/un-partitions/un-slows run to
+    completion even after the window (and synchronously in ``stop()``),
+    so the fleet always ends whole — the storm tests assert it returns
+    to ``healthy``. ``min_alive`` unfaulted replicas are always spared
+    so the fleet never goes fully dark by injection alone."""
+
+    def __init__(self, fleet, seed: int = 0, tick_s: float = 0.05,
+                 kill_prob: float = 0.04, restart_delay_s: float = 0.3,
+                 partition_prob: float = 0.04, partition_s: float = 0.25,
+                 slow_prob: float = 0.04, slow_s: float = 0.25,
+                 slow_ms: float = 30.0,
+                 fault_window_s: Optional[float] = None,
+                 max_faults: Optional[int] = None, min_alive: int = 1):
+        self.fleet = fleet
+        self.seed = seed
+        self.tick_s = tick_s
+        self.kill_prob = kill_prob
+        self.restart_delay_s = restart_delay_s
+        self.partition_prob = partition_prob
+        self.partition_s = partition_s
+        self.slow_prob = slow_prob
+        self.slow_s = slow_s
+        self.slow_ms = slow_ms
+        self.fault_window_s = fault_window_s
+        self.max_faults = max_faults
+        self.min_alive = int(min_alive)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pending = []  # (due_t, heal_fn, counter_name_or_None)
+        self._partitioned: set = set()
+        self._slowed: set = set()
+        self.injected = {"kills": 0, "restarts": 0, "partitions": 0,
+                         "slow_replicas": 0}
+
+    # -- lifecycle --
+    def start(self) -> "FleetChaos":
+        self._t0 = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pt-fleet-chaos")
+        self._thread.start()
+        return self
+
+    def stop(self, heal: bool = True) -> None:
+        """Stop injecting; with ``heal`` (default) run every pending
+        restart/un-partition/un-slow NOW so the fleet ends whole."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        if heal:
+            with self._lock:
+                pending, self._pending = self._pending, []
+            for _, fn, cname in sorted(pending, key=lambda p: p[0]):
+                self._run_heal(fn, cname)
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._active_locked()
+
+    def _active_locked(self) -> bool:
+        # restarts are heals, not faults — they must not spend the budget
+        faults = sum(v for k, v in self.injected.items() if k != "restarts")
+        if self.max_faults is not None and faults >= self.max_faults:
+            return False
+        return (self.fault_window_s is None
+                or time.monotonic() - self._t0 <= self.fault_window_s)
+
+    def _run_heal(self, fn, cname) -> None:
+        try:
+            fn()
+        except Exception:
+            pass  # a failed heal must not take the harness down
+        else:
+            if cname:
+                with self._lock:
+                    self.injected[cname] += 1
+
+    # -- the storm loop --
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            now = time.monotonic()
+            with self._lock:
+                due = [p for p in self._pending if p[0] <= now]
+                self._pending = [p for p in self._pending if p[0] > now]
+            for _, fn, cname in sorted(due, key=lambda p: p[0]):
+                self._run_heal(fn, cname)  # heals run even post-window
+            with self._lock:
+                if not self._active_locked():
+                    continue
+                rolls = (self._rng.random(), self._rng.random(),
+                         self._rng.random())
+                picks = (self._rng.random(), self._rng.random(),
+                         self._rng.random())
+            alive = self.fleet.alive_indices()
+            unfaulted = [i for i in alive if i not in self._partitioned
+                         and i not in self._slowed]
+            if rolls[0] < self.kill_prob and len(unfaulted) > self.min_alive:
+                i = unfaulted[int(picks[0] * len(unfaulted))
+                              % len(unfaulted)]
+                if self.fleet.kill_replica(i):
+                    with self._lock:
+                        self.injected["kills"] += 1
+                        self._pending.append(
+                            (time.monotonic() + self.restart_delay_s,
+                             lambda i=i: self.fleet.restart_replica(i),
+                             "restarts"))
+                alive = self.fleet.alive_indices()
+                unfaulted = [i for i in alive if i not in self._partitioned
+                             and i not in self._slowed]
+            if (rolls[1] < self.partition_prob
+                    and len(unfaulted) > self.min_alive):
+                i = unfaulted[int(picks[1] * len(unfaulted))
+                              % len(unfaulted)]
+                self.fleet.set_partition(i, True)
+                with self._lock:
+                    self.injected["partitions"] += 1
+                    self._partitioned.add(i)
+
+                def _heal_part(i=i):
+                    self.fleet.set_partition(i, False)
+                    with self._lock:
+                        self._partitioned.discard(i)
+
+                with self._lock:
+                    self._pending.append(
+                        (time.monotonic() + self.partition_s,
+                         _heal_part, None))
+                unfaulted = [j for j in unfaulted if j != i]
+            if rolls[2] < self.slow_prob and unfaulted:
+                i = unfaulted[int(picks[2] * len(unfaulted))
+                              % len(unfaulted)]
+                self.fleet.set_slow(i, True, slow_ms=self.slow_ms)
+                with self._lock:
+                    self.injected["slow_replicas"] += 1
+                    self._slowed.add(i)
+
+                def _heal_slow(i=i):
+                    self.fleet.set_slow(i, False)
+                    with self._lock:
+                        self._slowed.discard(i)
+
+                with self._lock:
+                    self._pending.append(
+                        (time.monotonic() + self.slow_s, _heal_slow, None))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"seed": self.seed, "active": self._active_locked(),
+                    "pending_heals": len(self._pending),
                     "injected": dict(self.injected)}
 
 

@@ -30,6 +30,10 @@ class ServingError(RuntimeError):
 
     code = "error"
     retryable = False
+    # True when this instance was decoded from an RPC error payload: the
+    # server ANSWERED (proof of liveness for circuit breakers) as opposed
+    # to the same type raised locally before any bytes moved
+    remote = False
 
     def info(self) -> Dict[str, Any]:
         return {"code": self.code, "message": str(self)}
@@ -142,6 +146,77 @@ class ServingRejected(ServingError):
         return self._info
 
 
+class NoHealthyReplicas(ServingError):
+    """Fleet-level: the router found no routable replica (every replica is
+    dead, partitioned, circuit-open, or draining). Retryable — replicas
+    restart and circuits half-open, so the fleet may recover; the request
+    itself was never dispatched anywhere."""
+
+    code = "unavailable"
+    retryable = True
+
+    def __init__(self, replicas: int = 0,
+                 last_error: Optional[BaseException] = None):
+        self.replicas = replicas
+        self.last_error = last_error
+        tail = (f"; last replica error: {type(last_error).__name__}: "
+                f"{last_error}" if last_error is not None else "")
+        super().__init__(
+            f"no healthy replica among {replicas} registered{tail}")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "unavailable", "reason": "no_healthy_replicas",
+                "replicas": self.replicas, "message": str(self)}
+
+
+class TenantQuotaExceeded(ServingError):
+    """Fleet-level: the tenant's token bucket is empty. Retryable (the
+    bucket refills at ``rate`` tokens/s) but the polite client backs off
+    at least ``retry_after_s`` first — hammering a dry bucket is exactly
+    the traffic the quota exists to absorb."""
+
+    code = "rejected"
+    retryable = True
+
+    def __init__(self, tenant: str, rate: float, retry_after_s: float = 0.0):
+        self.tenant = tenant
+        self.rate = rate
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"tenant {tenant!r} over quota ({rate:g} req/s); "
+            f"retry after {retry_after_s:.3f}s")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "quota", "tenant": self.tenant,
+                "rate": self.rate, "retry_after_s": self.retry_after_s}
+
+
+class FleetOverloaded(ServingError):
+    """Fleet-level load shed: aggregate pressure across replicas crossed
+    this tenant's priority bar (low-priority tenants shed first as
+    pressure rises — the PR-2 health machine lifted to the fleet).
+    Retryable; not enqueued anywhere."""
+
+    code = "rejected"
+    retryable = True
+
+    def __init__(self, tenant: str, priority: int, pressure: float,
+                 bar: float):
+        self.tenant = tenant
+        self.priority = priority
+        self.pressure = pressure
+        self.bar = bar
+        super().__init__(
+            f"fleet shedding priority<={priority} tenants "
+            f"(pressure {pressure:.2f} >= bar {bar:.2f}); "
+            f"tenant {tenant!r} shed")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "shedding", "scope": "fleet",
+                "tenant": self.tenant, "priority": self.priority,
+                "pressure": self.pressure, "bar": self.bar}
+
+
 class RetryBudgetExceeded(ServingError):
     """Terminal client error: the retry budget ran out. ``last_error`` is
     the final retryable error observed; nothing was silently swallowed."""
@@ -165,14 +240,17 @@ def error_info(e: ServingError) -> Dict[str, Any]:
 
 
 def error_from_wire(err: Dict[str, Any]) -> ServingError:
-    """Map a structured RPC ``error`` dict back to its typed class."""
+    """Map a structured RPC ``error`` dict back to its typed class.
+    Decoded instances carry ``remote=True``: the server answered."""
     code = err.get("code")
     if code == "rejected":
-        return ServingRejected(err)
-    if code == "deadline_exceeded":
+        e: ServingError = ServingRejected(err)
+    elif code == "deadline_exceeded":
         e = DeadlineExceeded(where=err.get("where", "server"))
         e.overshoot_ms = err.get("overshoot_ms", 0.0)
-        return e
-    if code == "unavailable":
-        return ServingUnavailable(err.get("message", "serving unavailable"))
-    return ServingError(f"serving error: {err}")
+    elif code == "unavailable":
+        e = ServingUnavailable(err.get("message", "serving unavailable"))
+    else:
+        e = ServingError(f"serving error: {err}")
+    e.remote = True
+    return e

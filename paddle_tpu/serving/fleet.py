@@ -1,0 +1,1085 @@
+"""Fleet tier: a metrics-driven router over N ``ServingServer`` replicas.
+
+One hardened ``ServingServer`` survives what kills a process (docs §12);
+this layer survives what kills a *node* — the serving-side re-expression
+of the reference's etcd-backed master/pserver fleet plane, driven by the
+PR-5 observability surface instead of etcd. ``FleetRouter`` fronts the
+``predict`` and ``generate`` RPCs of N replicas and adds (docs §17):
+
+* **metrics-driven least-loaded routing** — a scraper thread polls each
+  replica's existing ``healthz`` + ``metrics`` endpoints and caches the
+  gauges (queue depth/capacity, ``device_queue_occupancy``, health state,
+  MFU); selection scores replicas off the cache plus the router's own
+  live in-flight count, with rendezvous-hash session affinity when the
+  caller supplies a ``session`` key.
+* **per-tenant token-bucket quotas + priority shedding** — the PR-2
+  health machine lifted to fleet level: aggregate pressure across
+  replicas sheds low-priority tenants first (``shed_base`` +
+  ``priority * shed_step`` bars), quota exhaustion answers the typed
+  ``TenantQuotaExceeded``.
+* **hedged predicts** — after ``hedge_after_ms`` with no answer, a
+  budgeted (token-bucket) second attempt races a different replica;
+  first win answers, the loser is abandoned (inference is stateless, a
+  duplicate dispatch has no side effects). Counted in ``pt_fleet_*``.
+* **circuit breaking with half-open probing** — transport faults and
+  ``unavailable`` answers trip a per-replica breaker open; after a
+  cooldown exactly one probe request may pass, success re-closes.
+* **replica failover under one shared retry budget** — a failed attempt
+  is retried on a different replica; the budget is SHARED with the inner
+  ``ServingClient`` via its ``attempt`` header (budgets compose, never
+  multiply), and deadlines re-propagate per attempt as remaining budget.
+  Generations are pinned to their replica; on replica death they are
+  retried FROM SCRATCH elsewhere under the caller's remaining deadline.
+* **autoscale hooks** — when windowed QPS-per-healthy-replica crosses
+  ``scale_up_qps`` / ``scale_down_qps``, ``on_scale_up`` /
+  ``on_scale_down`` fire (cooldown-limited); ``add_replica`` /
+  ``remove_replica`` (with graceful drain) are the actuators.
+* **fleet-wide rolling reload** — ``reload(dirname)`` swaps weights one
+  replica at a time; each replica's own flush barrier keeps every request
+  wholly-old-or-wholly-new throughout the roll.
+
+``LocalFleet`` spawns N in-process replicas behind one router — the
+substrate for ``tools/serve_bench.py --fleet N``, the fleet chaos
+harness (``chaos.FleetChaos``), and the test suite.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
+from concurrent.futures import wait as futures_wait
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs import get_tracer, new_trace_id
+from .errors import (DeadlineExceeded, FleetOverloaded, NoHealthyReplicas,
+                     RetryBudgetExceeded, ServingError, ServingRejected,
+                     ServingUnavailable, TenantQuotaExceeded)
+from .server import ServingClient, ServingServer
+from .stats import FleetStats
+
+
+def parse_prometheus_gauges(text: str) -> Dict[str, float]:
+    """First sample of every family in a Prometheus text page (the fleet
+    router and ``paddle_cli fleet`` only read unlabeled gauges)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            continue
+        name = parts[0].split("{", 1)[0]
+        if name not in out:
+            try:
+                out[name] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
+    """The healthz+``/metrics`` → router-gauge name contract: which
+    ``pt_serving_*`` families feed routing, with healthz-dict fallbacks
+    for servers predating a gauge. ONE source of truth — the router's
+    scraper and ``paddle_cli fleet`` both read through here."""
+    g = parse_prometheus_gauges(metrics_text)
+    return {
+        "queue_depth": g.get("pt_serving_queue_depth",
+                             float(hz.get("queue_depth", 0))),
+        "queue_capacity": g.get("pt_serving_queue_capacity",
+                                float(hz.get("queue_capacity", 0))),
+        "occupancy": g.get("pt_serving_device_queue_occupancy", 0.0),
+        "pipeline_depth": g.get("pt_serving_pipeline_depth", 1.0),
+        "healthy": g.get("pt_serving_healthy", 1.0),
+        "mfu": g.get("pt_serving_mfu", 0.0),
+        "weights_version": g.get("pt_serving_weights_version",
+                                 float(hz.get("weights_version", 0))),
+    }
+
+
+class TokenBucket:
+    """Classic token bucket on the monotonic clock: ``rate`` tokens/s up
+    to ``burst``. ``rate=0`` never refills (a pure burst allowance)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def retry_after(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will have refilled (inf if never)."""
+        with self._lock:
+            deficit = n - self._tokens
+            if deficit <= 0:
+                return 0.0
+            return deficit / self.rate if self.rate > 0 else float("inf")
+
+
+class _Circuit:
+    """Per-replica breaker: ``closed`` -> (``threshold`` consecutive
+    transport/unavailable faults) -> ``open`` -> (cooldown) ->
+    ``half_open`` (exactly ONE probe) -> closed on success, re-open on
+    failure. Typed rejections count as contact — they prove the replica
+    is alive — and reset the failure streak."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 2.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self._probing = False
+        self._lock = threading.Lock()
+
+    def _tick_locked(self) -> None:
+        if (self.state == self.OPEN
+                and time.monotonic() - self.opened_at >= self.cooldown_s):
+            self.state = self.HALF_OPEN
+            self._probing = False
+
+    def would_allow(self) -> bool:
+        """Routability check without claiming the half-open probe slot."""
+        with self._lock:
+            self._tick_locked()
+            return (self.state == self.CLOSED
+                    or (self.state == self.HALF_OPEN and not self._probing))
+
+    def allow(self) -> bool:
+        """Claim permission for one attempt (the half-open slot is
+        exclusive: exactly one probe request passes per cooldown)."""
+        with self._lock:
+            self._tick_locked()
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def on_success(self) -> None:
+        with self._lock:
+            self.state = self.CLOSED
+            self.failures = 0
+            self._probing = False
+
+    def on_failure(self) -> bool:
+        """Record a breaker-class fault; True when this trip OPENED it."""
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                self.state = self.OPEN
+                self.opened_at = time.monotonic()
+                self._probing = False
+                return True
+            self.failures += 1
+            if self.state == self.CLOSED and self.failures >= self.threshold:
+                self.state = self.OPEN
+                self.opened_at = time.monotonic()
+                return True
+            return False
+
+    def release_probe(self) -> None:
+        """Give back an unused half-open claim (attempt aborted locally,
+        e.g. the caller's deadline expired before any bytes moved)."""
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                self._probing = False
+
+
+class _ClientPool:
+    """Small per-replica ``ServingClient`` pool: one connection per
+    concurrent attempt (the client serializes calls on its socket), freed
+    clients are reused, broken ones discarded."""
+
+    def __init__(self, endpoint: str, timeout: float, max_conns: int = 8):
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self.max_conns = max_conns
+        self._free: List[ServingClient] = []
+        self._lock = threading.Lock()
+        self._made = 0
+
+    def acquire(self) -> ServingClient:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+            self._made += 1
+            seed = self._made
+        return ServingClient(self.endpoint, timeout=self.timeout,
+                             retries=0, backoff_base_ms=5.0,
+                             retry_seed=seed)
+
+    def release(self, c: ServingClient, broken: bool = False) -> None:
+        if broken:
+            c.close()
+            return
+        with self._lock:
+            if len(self._free) < self.max_conns:
+                self._free.append(c)
+                return
+        c.close()
+
+    def close(self) -> None:
+        with self._lock:
+            free, self._free = self._free, []
+        for c in free:
+            c.close()
+
+
+class ReplicaHandle:
+    """Router-side view of one replica: scraped gauges, circuit state,
+    live in-flight count, client pool."""
+
+    def __init__(self, endpoint: str, request_timeout: float = 60.0,
+                 max_conns: int = 8, circuit_threshold: int = 3,
+                 circuit_cooldown_s: float = 2.0):
+        self.endpoint = endpoint
+        self.pool = _ClientPool(endpoint, request_timeout, max_conns)
+        # scrapes ride a dedicated client so they never steal a data conn
+        self.control = ServingClient(endpoint,
+                                     timeout=min(request_timeout, 5.0))
+        self.circuit = _Circuit(circuit_threshold, circuit_cooldown_s)
+        self.metrics: Dict[str, float] = {}
+        self.health = "unknown"
+        self.has_decode = False
+        self.reachable = True  # optimistic until the first scrape says no
+        self.draining = False
+        self.scraped_at = 0.0
+        self._in_flight = 0
+        self._scrape_busy = False
+        self._lock = threading.Lock()
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def _inflight_inc(self) -> None:
+        with self._lock:
+            self._in_flight += 1
+
+    def _inflight_dec(self) -> None:
+        with self._lock:
+            self._in_flight -= 1
+
+    def try_begin_scrape(self) -> bool:
+        """Claim the one-in-flight-scrape slot (the control client is a
+        single socket; concurrent scrapes would interleave on it)."""
+        with self._lock:
+            if self._scrape_busy:
+                return False
+            self._scrape_busy = True
+            return True
+
+    def end_scrape(self) -> None:
+        with self._lock:
+            self._scrape_busy = False
+
+    def close(self) -> None:
+        self.pool.close()
+        self.control.close()
+
+    def info(self) -> Dict[str, Any]:
+        m = self.metrics
+        return {"endpoint": self.endpoint, "reachable": self.reachable,
+                "health": self.health, "circuit": self.circuit.state,
+                "draining": self.draining, "in_flight": self.in_flight,
+                "has_decode": self.has_decode,
+                "queue_depth": m.get("queue_depth"),
+                "queue_capacity": m.get("queue_capacity"),
+                "occupancy": m.get("occupancy"),
+                "mfu": m.get("mfu"),
+                "weights_version": m.get("weights_version")}
+
+
+class _Tenant:
+    def __init__(self, name: str, rate: Optional[float], priority: int,
+                 bucket: Optional[TokenBucket]):
+        self.name = name
+        self.rate = rate
+        self.priority = int(priority)
+        self.bucket = bucket
+
+
+class FleetRouter:
+    """Route ``predict``/``generate`` over N replicas with least-loaded
+    selection, tenant QoS, hedging, circuit breaking, failover, and
+    autoscale hooks. See the module docstring for the semantics and
+    docs/design.md §17 for the failure matrix."""
+
+    def __init__(self, endpoints: Sequence[str] = (), *,
+                 retries: int = 3, attempt_retries: int = 0,
+                 request_timeout: float = 60.0,
+                 scrape_interval_s: float = 0.25,
+                 hedge_after_ms: Optional[float] = None,
+                 hedge_budget_per_s: float = 5.0, hedge_burst: float = 5.0,
+                 hedge_workers: int = 16,
+                 circuit_threshold: int = 3, circuit_cooldown_s: float = 2.0,
+                 shed_base: float = 0.6, shed_step: float = 0.15,
+                 degraded_pressure: float = 0.6,
+                 pressure_override: Optional[float] = None,
+                 default_priority: int = 1,
+                 scale_up_qps: Optional[float] = None,
+                 scale_down_qps: Optional[float] = None,
+                 on_scale_up: Optional[Callable] = None,
+                 on_scale_down: Optional[Callable] = None,
+                 scale_cooldown_s: float = 10.0, min_replicas: int = 1,
+                 max_conns_per_replica: int = 8,
+                 stats: Optional[FleetStats] = None, seed: int = 0,
+                 start_scraper: bool = True):
+        self.retries = int(retries)
+        self.attempt_retries = int(attempt_retries)
+        self.request_timeout = request_timeout
+        self.scrape_interval_s = scrape_interval_s
+        self.hedge_after_ms = hedge_after_ms
+        self.circuit_threshold = circuit_threshold
+        self.circuit_cooldown_s = circuit_cooldown_s
+        self.shed_base = shed_base
+        self.shed_step = shed_step
+        self.degraded_pressure = degraded_pressure
+        self.pressure_override = pressure_override
+        self.default_priority = int(default_priority)
+        self.scale_up_qps = scale_up_qps
+        self.scale_down_qps = scale_down_qps
+        self.on_scale_up = on_scale_up
+        self.on_scale_down = on_scale_down
+        self.scale_cooldown_s = scale_cooldown_s
+        self.min_replicas = int(min_replicas)
+        self.max_conns_per_replica = max_conns_per_replica
+        self.stats = stats or FleetStats()
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaHandle] = {}
+        self._tenants: Dict[str, _Tenant] = {}
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._hedge_bucket = TokenBucket(hedge_budget_per_s, hedge_burst)
+        self._pool_exec = (ThreadPoolExecutor(
+            max_workers=hedge_workers, thread_name_prefix="pt-fleet-hedge")
+            if hedge_after_ms is not None else None)
+        self._last_scale_t = 0.0
+        self._last_qpr = 0.0
+        self._closed = False
+        r = self.stats.registry
+        r.gauge("pt_fleet_replicas", "Registered replicas",
+                callback=lambda: float(len(self._replicas)))
+        r.gauge("pt_fleet_healthy_replicas",
+                "Replicas currently routable (reachable, circuit allows, "
+                "not draining)",
+                callback=lambda: float(self.healthy_replica_count()))
+        r.gauge("pt_fleet_pressure",
+                "Aggregate queue pressure across replicas (0..1)",
+                callback=self.pressure)
+        r.gauge("pt_fleet_qps_per_replica",
+                "Windowed completed QPS / healthy replicas",
+                callback=lambda: self._last_qpr)
+        r.gauge("pt_fleet_state",
+                "1 healthy / 0.5 degraded / 0 unavailable",
+                callback=lambda: {"healthy": 1.0, "degraded": 0.5,
+                                  "unavailable": 0.0}[self.fleet_state()])
+        self._circuit_gauge = r.gauge(
+            "pt_fleet_circuit_state",
+            "Per-replica breaker: 0 closed / 0.5 half-open / 1 open",
+            labelnames=("replica",))
+        for ep in endpoints:
+            self.add_replica(ep)
+        self._stop = threading.Event()
+        self._scraper = None
+        self._scrape_exec = None
+        if start_scraper:
+            self._scrape_exec = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="pt-fleet-scrape")
+            self._scraper = threading.Thread(
+                target=self._scrape_loop, daemon=True,
+                name="pt-fleet-scraper")
+            self._scraper.start()
+
+    # -- replica membership ------------------------------------------------
+    def add_replica(self, endpoint: str) -> ReplicaHandle:
+        """Register (and immediately scrape) a replica. Idempotent."""
+        with self._lock:
+            h = self._replicas.get(endpoint)
+            if h is not None:
+                return h
+            h = ReplicaHandle(endpoint, self.request_timeout,
+                              self.max_conns_per_replica,
+                              self.circuit_threshold,
+                              self.circuit_cooldown_s)
+            self._replicas[endpoint] = h
+        if h.try_begin_scrape():  # the loop may already have it
+            try:
+                self._scrape(h)
+            finally:
+                h.end_scrape()
+        return h
+
+    def remove_replica(self, endpoint: str, drain: bool = True,
+                       timeout: float = 10.0) -> bool:
+        """Stop routing to ``endpoint`` and (by default) wait for the
+        router-side in-flight attempts against it to finish before
+        dropping it. Does NOT shut the remote server down — that is the
+        operator's (or the autoscaler callback's) job. True = drained."""
+        with self._lock:
+            h = self._replicas.get(endpoint)
+            if h is None:
+                return False
+            h.draining = True  # _pick skips it from now on
+        drained = True
+        if drain:
+            deadline = time.monotonic() + timeout
+            while h.in_flight > 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            drained = h.in_flight == 0
+        with self._lock:
+            self._replicas.pop(endpoint, None)
+        self._circuit_gauge.remove(replica=endpoint)
+        h.close()
+        return drained
+
+    def _replica_list(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def replicas_info(self) -> List[Dict[str, Any]]:
+        return [h.info() for h in self._replica_list()]
+
+    def circuit_states(self) -> Dict[str, str]:
+        return {h.endpoint: h.circuit.state for h in self._replica_list()}
+
+    # -- tenants -----------------------------------------------------------
+    def configure_tenant(self, name: str, rate: Optional[float] = None,
+                         burst: Optional[float] = None,
+                         priority: int = 1) -> None:
+        """Give ``name`` a token-bucket quota (``rate`` req/s, ``burst``
+        capacity; ``rate=None`` = unlimited) and a shed priority (HIGHER
+        survives longer: the shed bar is ``shed_base + priority *
+        shed_step`` of aggregate pressure). Unknown tenants route at
+        ``default_priority`` with no quota."""
+        bucket = None
+        if rate is not None:
+            bucket = TokenBucket(
+                rate, burst if burst is not None else max(rate, 1.0))
+        self._tenants[name] = _Tenant(name, rate, priority, bucket)
+
+    def _admit(self, tenant: Optional[str]) -> None:
+        name = tenant or "default"
+        cfg = self._tenants.get(name)
+        prio = cfg.priority if cfg is not None else self.default_priority
+        # shed BEFORE charging quota: a shed request was never admitted,
+        # so it must not drain the tenant's bucket for when pressure clears
+        p = self.pressure()
+        bar = self.shed_base + prio * self.shed_step
+        if p >= bar:
+            self.stats.record_shed(name)
+            raise FleetOverloaded(name, prio, p, bar)
+        if cfg is not None and cfg.bucket is not None \
+                and not cfg.bucket.take():
+            self.stats.record_quota(name)
+            raise TenantQuotaExceeded(name, cfg.rate or 0.0,
+                                      cfg.bucket.retry_after())
+
+    # -- fleet health ------------------------------------------------------
+    def pressure(self) -> float:
+        """Aggregate pressure in [0, 1]: mean over non-draining replicas
+        of queue fill (scraped depth + router in-flight over capacity);
+        an unreachable replica contributes 1.0, a degraded one at least
+        ``degraded_pressure``. ``pressure_override`` pins it (tests)."""
+        if self.pressure_override is not None:
+            return self.pressure_override
+        reps = [h for h in self._replica_list() if not h.draining]
+        if not reps:
+            return 1.0
+        vals = []
+        for h in reps:
+            if not h.reachable:
+                vals.append(1.0)
+                continue
+            m = h.metrics
+            cap = max(m.get("queue_capacity") or 0.0, 1.0)
+            p = ((m.get("queue_depth") or 0.0) + h.in_flight) / cap
+            if m.get("healthy", 1.0) < 1.0:
+                p = max(p, self.degraded_pressure)
+            vals.append(min(p, 1.0))
+        return sum(vals) / len(vals)
+
+    def healthy_replica_count(self) -> int:
+        return sum(1 for h in self._replica_list()
+                   if h.reachable and not h.draining
+                   and h.health != "draining" and h.circuit.would_allow())
+
+    def fleet_state(self) -> str:
+        """``unavailable`` (nothing routable) / ``degraded`` (pressure at
+        the degraded bar, or a majority of replicas unroutable) /
+        ``healthy`` — the PR-2 state machine at fleet scope."""
+        reps = [h for h in self._replica_list() if not h.draining]
+        routable = self.healthy_replica_count()
+        if routable == 0:
+            return "unavailable"
+        if self.pressure() >= self.degraded_pressure:
+            return "degraded"
+        if reps and routable * 2 < len(reps):
+            return "degraded"
+        return "healthy"
+
+    # -- scraping ----------------------------------------------------------
+    def _scrape(self, h: ReplicaHandle) -> bool:
+        try:
+            hz = h.control.call("healthz")
+            text = h.control.call("metrics")["text"]
+        except Exception:
+            h.control.close()  # reconnect next round
+            h.reachable = False
+            self.stats.record_scrape(False)
+            return False
+        h.health = hz.get("state", "unknown")
+        h.has_decode = "decode" in hz
+        h.metrics = scraped_gauges(hz, text)
+        h.scraped_at = time.monotonic()
+        h.reachable = True
+        self.stats.record_scrape(True)
+        return True
+
+    def scrape_now(self) -> None:
+        """One synchronous scrape sweep (tests; the loop does this on
+        ``scrape_interval_s``)."""
+        for h in self._replica_list():
+            self._scrape(h)
+
+    def _scrape_one(self, h: ReplicaHandle) -> None:
+        try:
+            self._scrape(h)
+        finally:
+            h.end_scrape()
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.scrape_interval_s):
+            reps = self._replica_list()
+            for h in reps:
+                # concurrent, one in-flight scrape per replica: a wedged
+                # node blocks only its own refresh (for the control
+                # timeout), never the whole sweep
+                if h.try_begin_scrape():
+                    self._scrape_exec.submit(self._scrape_one, h)
+                self._circuit_gauge.labels(replica=h.endpoint).set(
+                    {"closed": 0.0, "half_open": 0.5,
+                     "open": 1.0}[h.circuit.state])
+            # a sweep racing remove_replica can resurrect a dead series;
+            # prune to the registered membership each round
+            self._circuit_gauge.prune(h.endpoint for h in reps
+                                      if h.endpoint in self._replicas)
+            self._eval_autoscale()
+
+    def _eval_autoscale(self) -> None:
+        healthy = self.healthy_replica_count()
+        qpr = self.stats.qps() / max(healthy, 1)
+        self._last_qpr = qpr
+        now = time.monotonic()
+        if now - self._last_scale_t < self.scale_cooldown_s:
+            return
+        if self.scale_up_qps is not None and qpr > self.scale_up_qps:
+            self._last_scale_t = now
+            self.stats.record_scale("up")
+            if self.on_scale_up is not None:
+                try:
+                    self.on_scale_up(self, qpr)
+                except Exception:
+                    pass  # a broken autoscaler must not kill routing
+        elif (self.scale_down_qps is not None and qpr < self.scale_down_qps
+              and healthy > self.min_replicas):
+            self._last_scale_t = now
+            self.stats.record_scale("down")
+            if self.on_scale_down is not None:
+                try:
+                    self.on_scale_down(self, qpr)
+                except Exception:
+                    pass
+
+    # -- selection ---------------------------------------------------------
+    def _score(self, h: ReplicaHandle) -> float:
+        """Lower = preferred. Queue fill dominates; device-queue
+        occupancy and live MFU break near-ties (a replica mid-burst shows
+        high occupancy/MFU before its queue gauge moves); degraded
+        replicas are a last resort."""
+        m = h.metrics
+        cap = max(m.get("queue_capacity") or 0.0, 1.0)
+        depth = max(m.get("pipeline_depth") or 1.0, 1.0)
+        s = ((m.get("queue_depth") or 0.0) + h.in_flight) / cap
+        s += 0.5 * (m.get("occupancy") or 0.0) / depth
+        s += 0.1 * min(m.get("mfu") or 0.0, 1.0)
+        if m.get("healthy", 1.0) < 1.0:
+            s += 0.5
+        return s
+
+    def _pick(self, excluded: Sequence[str] = (), need_decode: bool = False,
+              session: Optional[str] = None,
+              claim: bool = True) -> Optional[ReplicaHandle]:
+        cands = []
+        for h in self._replica_list():
+            if h.endpoint in excluded or h.draining or not h.reachable:
+                continue
+            if need_decode and not h.has_decode:
+                continue
+            if h.health == "draining":
+                continue
+            if not h.circuit.would_allow():
+                continue
+            cands.append(h)
+        if not cands:
+            return None
+        if session is not None:
+            # rendezvous hashing: stable per session under replica churn
+            cands.sort(key=lambda h: hashlib.md5(
+                f"{session}|{h.endpoint}".encode()).hexdigest(),
+                reverse=True)
+        else:
+            with self._rng_lock:
+                jitter = {h.endpoint: self._rng.random() for h in cands}
+            cands.sort(key=lambda h: (self._score(h), jitter[h.endpoint]))
+        for h in cands:
+            if not claim or h.circuit.allow():
+                return h
+        return None
+
+    # -- the data path -----------------------------------------------------
+    def predict(self, feeds: Dict[str, Any], tenant: Optional[str] = None,
+                timeout_ms: Optional[float] = None, trace=False,
+                session: Optional[str] = None) -> List[np.ndarray]:
+        """Route one predict. Same return/typed-error surface as
+        ``ServingClient.predict`` plus the fleet-typed errors
+        (``TenantQuotaExceeded``/``FleetOverloaded``/
+        ``NoHealthyReplicas``)."""
+        t_id = trace if isinstance(trace, str) else (
+            new_trace_id() if trace else None)
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1e3 if timeout_ms is not None else None
+        self.stats.record_submit()
+        with get_tracer().span("fleet/route", trace_id=t_id,
+                               op="predict", tenant=tenant or "default"):
+            self._admit(tenant)
+            out = self._routed("predict", {"feeds": feeds}, deadline, t_id,
+                               session=session, hedge=True)
+        self.stats.record_done(time.monotonic() - t0)
+        return out
+
+    def generate(self, tokens, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None, tenant: Optional[str] = None,
+                 timeout_ms: Optional[float] = None, trace=False,
+                 session: Optional[str] = None) -> Dict[str, Any]:
+        """Route one generation. The generation is PINNED to its replica
+        (never hedged — a duplicate in-flight generation would hold two
+        KV slots for one answer); on replica death it is retried from
+        scratch elsewhere under the remaining deadline, or answers with
+        a typed error."""
+        t_id = trace if isinstance(trace, str) else (
+            new_trace_id() if trace else None)
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1e3 if timeout_ms is not None else None
+        self.stats.record_submit()
+        payload = {"tokens": tokens, "max_new_tokens": max_new_tokens,
+                   "eos_id": eos_id}
+        with get_tracer().span("fleet/route", trace_id=t_id,
+                               op="generate", tenant=tenant or "default"):
+            self._admit(tenant)
+            out = self._routed("generate", payload, deadline, t_id,
+                               session=session, hedge=False)
+        self.stats.record_done(time.monotonic() - t0)
+        return out
+
+    def _routed(self, op: str, payload: Dict[str, Any],
+                deadline: Optional[float], t_id: Optional[str],
+                session: Optional[str], hedge: bool):
+        """Failover loop under ONE shared retry budget: ``used`` counts
+        budget units consumed across replicas AND inside the per-replica
+        client (composed via its ``attempt`` header — see server.py)."""
+        budget = self.retries
+        used = 0
+        excluded: set = set()
+        last: Optional[BaseException] = None
+        need_decode = op == "generate"
+        first = True
+        while True:
+            rep = self._pick(excluded, need_decode=need_decode,
+                             session=session)
+            if rep is None:
+                self.stats.record_failure()
+                raise NoHealthyReplicas(len(self._replicas), last)
+            inner_budget = min(budget, used + self.attempt_retries)
+            try:
+                if first and hedge and self.hedge_after_ms is not None:
+                    return self._hedged_attempt(rep, op, payload, deadline,
+                                                t_id, used, inner_budget,
+                                                excluded)
+                return self._attempt(rep, op, payload, deadline, t_id,
+                                     used, inner_budget)
+            except DeadlineExceeded:
+                self.stats.record_deadline()
+                raise
+            except RetryBudgetExceeded as e:
+                # the inner client consumed budget through its cap; fold
+                # that into the shared counter and fail over
+                used = max(used, e.attempts - 1)
+                last = e.last_error or e
+            except (ServingError, OSError) as e:
+                if not getattr(e, "retryable", True):
+                    self.stats.record_failure()
+                    raise
+                last = e
+            first = False
+            excluded.add(rep.endpoint)
+            if budget == 0:
+                # no retry layer engaged: surface the raw typed error,
+                # exactly like ServingClient(retries=0)
+                self.stats.record_failure()
+                raise last
+            if used >= budget:
+                self.stats.record_failure()
+                raise RetryBudgetExceeded(used + 1, last)
+            used += 1  # the failover re-send costs one budget unit
+            self.stats.record_failover(op)
+
+    def _attempt(self, rep: ReplicaHandle, op: str, payload: Dict[str, Any],
+                 deadline: Optional[float], t_id: Optional[str],
+                 attempt_no: int, inner_budget: int):
+        remaining_ms = None
+        if deadline is not None:
+            remaining_ms = (deadline - time.monotonic()) * 1e3
+            if remaining_ms <= 0:
+                rep.circuit.release_probe()
+                raise DeadlineExceeded(-remaining_ms / 1e3, "fleet route")
+        c = rep.pool.acquire()
+        rep._inflight_inc()
+        # None = no breaker signal (local abort), True = replica answered
+        # (even a typed rejection proves liveness), False = broken
+        verdict: Optional[bool] = None
+        try:
+            with get_tracer().span("fleet/attempt", trace_id=t_id,
+                                   replica=rep.endpoint, op=op,
+                                   attempt=attempt_no):
+                c.retries = inner_budget  # shared-budget composition
+                if op == "predict":
+                    out = c.predict(payload["feeds"],
+                                    timeout_ms=remaining_ms,
+                                    trace=t_id or False,
+                                    attempt=attempt_no)
+                else:
+                    out = c.generate(payload["tokens"],
+                                     max_new_tokens=payload["max_new_tokens"],
+                                     eos_id=payload["eos_id"],
+                                     timeout_ms=remaining_ms,
+                                     trace=t_id or False,
+                                     attempt=attempt_no)
+            verdict = True
+            return out
+        except (ConnectionError, OSError):
+            verdict = False
+            raise
+        except ServingUnavailable:
+            verdict = False
+            raise
+        except DeadlineExceeded as e:
+            # only a server-answered deadline proves liveness; the client
+            # raises the same type locally when the budget dies before a
+            # (re-)send — that must not close a breaker it never touched
+            verdict = True if e.remote else None
+            raise
+        except RetryBudgetExceeded as e:
+            le = e.last_error
+            verdict = (isinstance(le, ServingRejected)
+                       or (isinstance(le, DeadlineExceeded) and le.remote))
+            raise
+        except ServingError:
+            verdict = True  # typed answer: the replica is alive
+            raise
+        finally:
+            rep._inflight_dec()
+            rep.pool.release(c, broken=verdict is False)
+            if verdict is True:
+                rep.circuit.on_success()
+            elif verdict is False:
+                if rep.circuit.on_failure():
+                    self.stats.record_circuit_open()
+            else:
+                rep.circuit.release_probe()
+
+    def _hedged_attempt(self, rep: ReplicaHandle, op: str,
+                        payload: Dict[str, Any], deadline: Optional[float],
+                        t_id: Optional[str], attempt_no: int,
+                        inner_budget: int, excluded: set):
+        """Primary attempt with a budgeted straggler hedge: after
+        ``hedge_after_ms`` with no answer, race a second replica;
+        first win answers (the loser is abandoned — stateless predicts
+        have no side effects to double-apply). The hedge lane gets NO
+        inner retries (its one send is paid by the hedge token, not the
+        shared retry budget — two lanes spending ``inner_budget`` each
+        would multiply the budget the caller composed)."""
+        fut1 = self._pool_exec.submit(self._attempt, rep, op, payload,
+                                      deadline, t_id, attempt_no,
+                                      inner_budget)
+        wait_s = self.hedge_after_ms / 1e3
+        if deadline is not None:
+            wait_s = min(wait_s, max(0.0, deadline - time.monotonic()))
+        try:
+            return fut1.result(timeout=wait_s)
+        except FuturesTimeout:
+            pass  # primary is straggling: consider a hedge
+        if deadline is not None and deadline - time.monotonic() <= 0:
+            # the caller's deadline is already gone: a hedge is a
+            # guaranteed-useless send that would only burn hedge budget
+            return fut1.result()
+        if not (fut1.running() or fut1.done()):
+            # the primary never STARTED — the hedge pool is saturated, not
+            # the replica slow; a hedge would queue behind it and burn
+            # budget against our own congestion
+            return fut1.result()
+        rep2 = self._pick(set(excluded) | {rep.endpoint},
+                          need_decode=(op == "generate"))
+        if rep2 is None:
+            return fut1.result()  # no hedge available: wait the primary out
+        if not self._hedge_bucket.take():
+            # _pick claimed rep2's half-open probe slot; give it back or a
+            # recovering replica stays unroutable forever
+            rep2.circuit.release_probe()
+            return fut1.result()
+        self.stats.record_hedge()
+        with get_tracer().span("fleet/hedge", trace_id=t_id,
+                               primary=rep.endpoint, hedge=rep2.endpoint):
+            # inner_budget=attempt_no -> zero inner retries for the hedge
+            fut2 = self._pool_exec.submit(self._attempt, rep2, op, payload,
+                                          deadline, t_id, attempt_no,
+                                          attempt_no)
+            pending = {fut1, fut2}
+            last_exc: Optional[BaseException] = None
+            deadline_exc: Optional[BaseException] = None
+            budget_exc: Optional[RetryBudgetExceeded] = None
+            while pending:
+                done, pending = futures_wait(
+                    pending, return_when=FIRST_COMPLETED)
+                for f in done:
+                    try:
+                        res = f.result()
+                    except Exception as e:
+                        last_exc = e
+                        if isinstance(e, DeadlineExceeded):
+                            deadline_exc = e
+                        if isinstance(e, RetryBudgetExceeded) and (
+                                budget_exc is None
+                                or e.attempts > budget_exc.attempts):
+                            budget_exc = e
+                        if f is fut2:
+                            # a failed hedge replica is out for this
+                            # request's later failovers too
+                            excluded.add(rep2.endpoint)
+                        continue
+                    if f is fut2:
+                        self.stats.record_hedge_win()
+                    for p in pending:
+                        # cancel-on-first-win: the loser finishes in the
+                        # background and is discarded
+                        p.add_done_callback(lambda fp: fp.exception())
+                    return res
+            # both lanes failed. Deadline death ends the request outright;
+            # otherwise surface the LARGEST budget consumption so _routed's
+            # fold charges everything spent, not just the later loser's
+            if deadline_exc is not None:
+                raise deadline_exc
+            if budget_exc is not None:
+                raise budget_exc
+            raise last_exc
+
+    # -- fleet-wide rolling reload ----------------------------------------
+    def reload(self, dirname: str,
+               per_replica_retries: int = 3) -> Dict[str, Optional[int]]:
+        """Rolling hot weight reload, one replica at a time. Each
+        replica's own flush barrier (docs §12) keeps every request
+        wholly-old-or-wholly-new for the whole roll; a replica whose
+        barrier will not quiesce is retried, one that is down is skipped
+        (``None`` in the result — it restarts from disk anyway). Returns
+        ``{endpoint: new_version | None}``."""
+        out: Dict[str, Optional[int]] = {}
+        for h in self._replica_list():
+            if h.draining:
+                continue
+            ver: Optional[int] = None
+            for _ in range(per_replica_retries + 1):
+                c = h.pool.acquire()
+                broken = False
+                try:
+                    ver = c.reload(dirname)["weights_version"]
+                    break
+                except ServingUnavailable:
+                    time.sleep(0.05)  # barrier busy: retry this replica
+                except (ConnectionError, OSError):
+                    broken = True
+                    break  # replica down mid-roll: skip it
+                except ServingError:
+                    break  # typed refusal (draining etc.): skip
+                finally:
+                    h.pool.release(c, broken=broken)
+            out[h.endpoint] = ver
+        self.stats.record_reload()
+        return out
+
+    # -- snapshot / shutdown ----------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return self.stats.snapshot(extra={
+            "fleet_state": self.fleet_state(),
+            "pressure": self.pressure(),
+            "qps_per_replica": self._last_qpr,
+            "replicas": self.replicas_info(),
+        })
+
+    def metrics_text(self) -> str:
+        return self.stats.expose()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._scraper is not None:
+            self._scraper.join(timeout=5)
+        if self._scrape_exec is not None:
+            self._scrape_exec.shutdown(wait=False)
+        if self._pool_exec is not None:
+            self._pool_exec.shutdown(wait=False)
+        for h in self._replica_list():
+            h.close()
+        with self._lock:
+            self._replicas.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class LocalFleet:
+    """N in-process ``ServingServer`` replicas behind one ``FleetRouter``
+    — the spawn/kill/restart/partition/slow control surface the fleet
+    chaos harness (``chaos.FleetChaos``) and ``serve_bench --fleet``
+    drive. A *kill* is abrupt (``close(drain=False)``): in-flight
+    connections die mid-request and the router must DISCOVER the death
+    through its scrapes and circuit breaker, exactly as with a crashed
+    node."""
+
+    def __init__(self, model_dir: str, n: int,
+                 server_kwargs: Optional[Dict[str, Any]] = None,
+                 router_kwargs: Optional[Dict[str, Any]] = None,
+                 warmup: bool = True):
+        self.model_dir = model_dir
+        self.server_kwargs = dict(server_kwargs or {})
+        self.warmup = warmup
+        self._lock = threading.Lock()
+        self.servers: List[Optional[ServingServer]] = []
+        for _ in range(int(n)):
+            self.servers.append(self._spawn())
+        self.router = FleetRouter([s.endpoint for s in self.servers],
+                                  **dict(router_kwargs or {}))
+
+    def _spawn(self) -> ServingServer:
+        return ServingServer(self.model_dir, warmup=self.warmup,
+                             **self.server_kwargs)
+
+    def alive_indices(self) -> List[int]:
+        with self._lock:
+            return [i for i, s in enumerate(self.servers)
+                    if s is not None and not getattr(s, "_closed", True)]
+
+    def kill_replica(self, i: int) -> bool:
+        """Abrupt shutdown of replica ``i`` (no polite deregistration —
+        the router finds out the hard way)."""
+        with self._lock:
+            s = self.servers[i]
+        if s is None or getattr(s, "_closed", True):
+            return False
+        s.close(drain=False)
+        return True
+
+    def restart_replica(self, i: int) -> str:
+        """Respawn replica ``i`` (fresh port) and swap it into the
+        router. Returns the new endpoint."""
+        with self._lock:
+            old = self.servers[i]
+        if old is not None and not getattr(old, "_closed", True):
+            old.close(drain=False)
+        new = self._spawn()
+        with self._lock:
+            self.servers[i] = new
+        if old is not None:
+            self.router.remove_replica(old.endpoint, drain=False)
+        self.router.add_replica(new.endpoint)
+        return new.endpoint
+
+    def set_partition(self, i: int, on: bool = True) -> None:
+        """Partition replica ``i`` from the router's point of view: its
+        server hangs up on every request (data AND scrape) without
+        answering, via the chaos injector's ``partitioned`` flag."""
+        from .chaos import ChaosInjector
+
+        with self._lock:
+            s = self.servers[i]
+        if s is None or getattr(s, "_closed", True):
+            return
+        if on:
+            inj = ChaosInjector()
+            inj.partitioned = True
+            s.chaos = inj
+        else:
+            s.chaos = None
+
+    def set_slow(self, i: int, on: bool = True,
+                 slow_ms: float = 50.0) -> None:
+        """Make replica ``i`` a straggler: every device dispatch — one-
+        shot predict AND decode step — sleeps ``slow_ms`` first (the
+        hedging target, and the window mid-generation faults land in)."""
+        from .chaos import ChaosInjector
+
+        with self._lock:
+            s = self.servers[i]
+        if s is None or getattr(s, "_closed", True):
+            return
+        inj = (ChaosInjector(slow_call_prob=1.0, slow_call_ms=slow_ms)
+               if on else None)
+        s.engine.chaos = inj
+        if s.decode_engine is not None:
+            s.decode_engine.chaos = inj
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return [s.endpoint for s in self.servers
+                    if s is not None and not getattr(s, "_closed", True)]
+
+    def close(self) -> None:
+        self.router.close()
+        with self._lock:
+            servers = list(self.servers)
+        for s in servers:
+            if s is not None and not getattr(s, "_closed", True):
+                s.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

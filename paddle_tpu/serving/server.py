@@ -71,6 +71,11 @@ class _Handler(socketserver.StreamRequestHandler):
             if not line:
                 return
             srv: "ServingServer" = self.server  # type: ignore[assignment]
+            if srv.chaos is not None and getattr(srv.chaos, "partitioned",
+                                                 False):
+                # fleet chaos: this replica is network-partitioned — hang
+                # up without answering ANY request (data or scrape)
+                return
             if line[:4] in (b"GET ", b"HEAD"):
                 # a Prometheus scraper (or curl) talking plain HTTP on the
                 # line-JSON port: answer GET /metrics | /healthz and close
@@ -641,6 +646,7 @@ class ServingClient:
         self.retries_total = 0  # lifetime retry count (serve_bench reports)
         self.close_errors = 0  # OSErrors discarded while closing the socket
         self.last_trace: Optional[Dict[str, Any]] = None  # predict(trace=)
+        self._deadline: Optional[float] = None  # remaining_deadline_ms()
         self._sock: Optional[socket.socket] = None
         self._file = None
         self._lock = threading.Lock()
@@ -675,18 +681,32 @@ class ServingClient:
             return resp["result"]
 
     def call_with_retries(self, method: str, params: Optional[Dict] = None,
-                          deadline: Optional[float] = None) -> Any:
+                          deadline: Optional[float] = None,
+                          attempt: int = 0) -> Any:
         """``call`` under the retry budget. ``deadline`` (absolute
         monotonic seconds) rides each attempt as a fresh remaining-budget
-        ``deadline_ms`` and bounds the backoff sleeps."""
-        attempts = 0
+        ``deadline_ms`` and bounds the backoff sleeps.
+
+        ``attempt`` is the number of retry-budget units ALREADY consumed
+        upstream (a fleet router supplies its running failover count):
+        the attempts counter starts there, so router-side and
+        client-side budgets COMPOSE into one shared budget instead of
+        multiplying — with ``retries=B``, a call entering at
+        ``attempt=k`` has ``B - k`` retries left, and the hop count
+        rides the wire as the ``attempt`` param (docs/design.md §17)."""
+        attempts = int(attempt)
         delay = self.backoff_base_s
+        base_params = params
+        self._deadline = deadline
         while True:
+            params = dict(base_params or {})
+            if attempts:
+                params["attempt"] = attempts
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise DeadlineExceeded(-remaining, "client send")
-                params = dict(params or {}, deadline_ms=remaining * 1e3)
+                params["deadline_ms"] = remaining * 1e3
             try:
                 return self.call(method, params)
             except (ServingError, OSError) as e:
@@ -705,14 +725,26 @@ class ServingClient:
                 time.sleep(sleep)
                 delay = min(delay * 2, self.backoff_max_s)
 
+    def remaining_deadline_ms(self) -> Optional[float]:
+        """Milliseconds left on the deadline of the current / most recent
+        deadline-carrying call (``None`` if it carried none). A router
+        failing a request over to another replica consults this to budget
+        the retry-from-scratch attempt with what the CALLER has left,
+        not a fresh timeout."""
+        d = self._deadline
+        if d is None:
+            return None
+        return max(0.0, (d - time.monotonic()) * 1e3)
+
     def predict(self, feeds: Dict[str, Any],
                 timeout_ms: Optional[float] = None,
-                trace=False) -> List[np.ndarray]:
+                trace=False, attempt: int = 0) -> List[np.ndarray]:
         """``trace=True`` mints a trace id client-side (a string passes
         YOUR id); the id rides the wire, tags every server-side span, and
         the per-stage timings come back on ``self.last_trace``
         (``{"trace_id": ..., "stages_ms": {stage: ms}}``) — the return
-        value stays one np.ndarray per fetch either way."""
+        value stays one np.ndarray per fetch either way. ``attempt`` is
+        the upstream-consumed retry count (see ``call_with_retries``)."""
         from ..obs import new_trace_id
 
         enc = {}
@@ -725,7 +757,8 @@ class ServingClient:
                 else new_trace_id()
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
-        result = self.call_with_retries("predict", params, deadline=deadline)
+        result = self.call_with_retries("predict", params, deadline=deadline,
+                                        attempt=attempt)
         self.last_trace = result.get("trace") if trace else None
         return [np.asarray(f["data"], dtype=f["dtype"]).reshape(f["shape"])
                 for f in result["fetches"]]
@@ -733,7 +766,7 @@ class ServingClient:
     def generate(self, tokens, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  timeout_ms: Optional[float] = None,
-                 trace=False) -> Dict[str, Any]:
+                 trace=False, attempt: int = 0) -> Dict[str, Any]:
         """Autoregressive generation on a decode-enabled server. Returns
         ``{"tokens": [...], "ttft_ms": float, "finish_reason":
         "eos"|"length", "weights_version": int}``. Same deadline/retry
@@ -753,7 +786,7 @@ class ServingClient:
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
         result = self.call_with_retries("generate", params,
-                                        deadline=deadline)
+                                        deadline=deadline, attempt=attempt)
         self.last_trace = result.get("trace") if trace else None
         return result
 

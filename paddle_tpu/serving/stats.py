@@ -451,3 +451,190 @@ class ServingStats:
         if extra:
             snap.update(extra)
         return snap
+
+
+class FleetStats:
+    """Router-plane counters for the fleet tier (serving/fleet.py), the
+    ``pt_fleet_*`` namespace next to each replica's own ``pt_serving_*``
+    registry. One instance per ``FleetRouter``; everything cumulative is
+    an ``obs.metrics`` instrument (same one-source-of-truth discipline as
+    ``ServingStats``), per-tenant sheds/quota rejections carry a
+    ``tenant`` label, and the router registers its live pull-gauges
+    (replica counts, pressure, QPS-per-replica, circuit states) into
+    ``self.registry`` at construction."""
+
+    def __init__(self, latency_window: int = 2048, qps_window_s: float = 10.0,
+                 registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.qps_window_s = qps_window_s
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self._req = r.counter("pt_fleet_requests_total",
+                              "Fleet requests by lifecycle event",
+                              labelnames=("event",))
+        self._c = {n: self._req.labels(event=n)
+                   for n in ("submitted", "completed", "failed", "shed",
+                             "quota_rejected", "deadline_exceeded")}
+        self._hedges = r.counter("pt_fleet_hedges_total",
+                                 "Hedged attempts launched")
+        self._hedge_wins = r.counter(
+            "pt_fleet_hedge_wins_total",
+            "Requests answered by the hedge before the primary")
+        self._failovers = r.counter(
+            "pt_fleet_failovers_total",
+            "Attempts retried on a different replica", labelnames=("op",))
+        self._shed_tenant = r.counter(
+            "pt_fleet_shed_by_tenant_total",
+            "Priority sheds under fleet pressure", labelnames=("tenant",))
+        self._quota_tenant = r.counter(
+            "pt_fleet_quota_rejected_total",
+            "Token-bucket quota rejections", labelnames=("tenant",))
+        self._circuit_opens = r.counter(
+            "pt_fleet_circuit_open_total",
+            "Replica circuits tripped open")
+        self._scale_events = r.counter(
+            "pt_fleet_scale_events_total",
+            "Autoscale hook firings", labelnames=("direction",))
+        for d in ("up", "down"):  # zeros visible before the first firing
+            self._scale_events.labels(direction=d)
+        self._reloads = r.counter(
+            "pt_fleet_rolling_reloads_total",
+            "Completed fleet-wide rolling weight reloads")
+        self._scrapes = r.counter(
+            "pt_fleet_scrapes_total",
+            "Replica metric scrapes", labelnames=("result",))
+        self._lat_hist = r.histogram(
+            "pt_fleet_request_latency_seconds",
+            "Router submit-to-answer latency (all hops + hedges)")
+        self._lat: deque = deque(maxlen=latency_window)
+        self._qps_window = RateWindow(qps_window_s)
+
+    # -- recording --
+    def record_submit(self) -> None:
+        self._c["submitted"].inc()
+
+    def record_done(self, latency_s: float) -> None:
+        self._c["completed"].inc()
+        self._lat_hist.observe(latency_s)
+        self._qps_window.add(1)
+        with self._lock:
+            self._lat.append(latency_s)
+
+    def record_failure(self) -> None:
+        self._c["failed"].inc()
+
+    def record_deadline(self) -> None:
+        self._c["deadline_exceeded"].inc()
+
+    def record_shed(self, tenant: str) -> None:
+        self._c["shed"].inc()
+        self._shed_tenant.labels(tenant=tenant).inc()
+
+    def record_quota(self, tenant: str) -> None:
+        self._c["quota_rejected"].inc()
+        self._quota_tenant.labels(tenant=tenant).inc()
+
+    def record_hedge(self) -> None:
+        self._hedges.inc()
+
+    def record_hedge_win(self) -> None:
+        self._hedge_wins.inc()
+
+    def record_failover(self, op: str) -> None:
+        self._failovers.labels(op=op).inc()
+
+    def record_circuit_open(self) -> None:
+        self._circuit_opens.inc()
+
+    def record_scale(self, direction: str) -> None:
+        self._scale_events.labels(direction=direction).inc()
+
+    def record_reload(self) -> None:
+        self._reloads.inc()
+
+    def record_scrape(self, ok: bool) -> None:
+        self._scrapes.labels(result="ok" if ok else "failed").inc()
+
+    # -- reading --
+    @property
+    def submitted(self) -> int:
+        return int(self._c["submitted"].value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._c["completed"].value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._c["failed"].value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._c["shed"].value)
+
+    @property
+    def quota_rejected(self) -> int:
+        return int(self._c["quota_rejected"].value)
+
+    @property
+    def hedges(self) -> int:
+        return int(self._hedges.value)
+
+    @property
+    def hedge_wins(self) -> int:
+        return int(self._hedge_wins.value)
+
+    def failovers(self, op: str) -> int:
+        return int(self._failovers.labels(op=op).value)
+
+    @property
+    def circuit_opens(self) -> int:
+        return int(self._circuit_opens.value)
+
+    def qps(self) -> float:
+        """Windowed completed-requests/s across the whole fleet."""
+        return self._qps_window.rate()
+
+    def shed_by_tenant(self) -> Dict[str, int]:
+        # derived from the labeled counter: one source of truth
+        return {k[0]: int(c.value)
+                for k, c in self._shed_tenant.children().items()}
+
+    def quota_by_tenant(self) -> Dict[str, int]:
+        return {k[0]: int(c.value)
+                for k, c in self._quota_tenant.children().items()}
+
+    def expose(self) -> str:
+        return self.registry.expose()
+
+    def snapshot(self, extra: Optional[Dict] = None) -> Dict:
+        with self._lock:
+            lats = sorted(self._lat)
+        snap = {
+            "uptime_s": time.monotonic() - self._t0,
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "shed": self.shed,
+            "quota_rejected": self.quota_rejected,
+            "deadline_exceeded": int(self._c["deadline_exceeded"].value),
+            "hedges": self.hedges,
+            "hedge_wins": self.hedge_wins,
+            "failovers": {"predict": self.failovers("predict"),
+                          "generate": self.failovers("generate")},
+            "circuit_opens": self.circuit_opens,
+            "rolling_reloads": int(self._reloads.value),
+            "qps": self.qps(),
+            "shed_by_tenant": self.shed_by_tenant(),
+            "quota_by_tenant": self.quota_by_tenant(),
+            "latency_ms": {
+                "mean": (sum(lats) / len(lats) * 1e3) if lats else 0.0,
+                "p50": _percentile(lats, 0.50) * 1e3,
+                "p95": _percentile(lats, 0.95) * 1e3,
+                "p99": _percentile(lats, 0.99) * 1e3,
+            },
+        }
+        if extra:
+            snap.update(extra)
+        return snap

@@ -1,0 +1,658 @@
+"""Fleet tier: metrics-driven routing, tenant QoS, hedging, circuit
+breaking, failover, rolling reload, autoscale hooks, fleet chaos (ISSUE 7).
+
+Acceptance contract: least-loaded routing follows the scraped live
+gauges; tenant token buckets and priority bars shed typed and in order;
+a hedged predict answers from the first replica to finish; a broken
+replica's circuit opens, half-opens after the cooldown, and re-closes on
+a good probe; a fleet-wide rolling reload keeps every response wholly on
+one weights version; a generation whose replica dies mid-stream is
+retried from scratch elsewhere (bit-identical stream) or answers typed;
+and the seeded fleet chaos storm — kills/restarts/partitions/slow
+replicas landing mid-traffic and mid-generation — completes with 100%
+success-or-typed-error, bit-correct successful payloads, and a fleet
+that returns to ``healthy`` after the fault window.
+
+Everything runs on JAX_PLATFORMS=cpu (conftest) with tiny models and
+sub-second fault windows — fast tier.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import Predictor
+from paddle_tpu.serving import (DeadlineExceeded, FleetChaos, FleetOverloaded,
+                                FleetStats, LocalFleet, NoHealthyReplicas,
+                                RetryBudgetExceeded, ServingClient,
+                                ServingRejected, ServingServer,
+                                ServingUnavailable, ShuttingDown,
+                                TenantQuotaExceeded, TokenBucket)
+from paddle_tpu.serving.decode import DecodeEngine, generate_sequential
+from test_serving_chaos import _export
+from test_serving_decode import _export_lm
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    """A (serving) and B (same arch, different weights — rolling reload)."""
+    root = tmp_path_factory.mktemp("fleet")
+    a = _export(str(root / "model_a"), seed=21)
+    b = _export(str(root / "model_b"), seed=42)
+    return a, b
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory):
+    return _export_lm(str(tmp_path_factory.mktemp("fleet_lm") / "lm"),
+                      seed=23)
+
+
+X1 = np.random.RandomState(7).randn(1, 4).astype("float32")
+
+
+def _fleet(model_dir, n=2, router=None, server=None, warmup=True):
+    rk = {"scrape_interval_s": 0.1, "retries": 3, "seed": 0}
+    rk.update(router or {})
+    sk = {"batch_timeout_ms": 1.0, "queue_capacity": 32}
+    sk.update(server or {})
+    return LocalFleet(model_dir, n, server_kwargs=sk, router_kwargs=rk,
+                      warmup=warmup)
+
+
+# ---------------------------------------------------------------------------
+# metrics-driven selection
+# ---------------------------------------------------------------------------
+
+
+def test_least_loaded_routing_follows_live_gauges(model_dirs):
+    """A replica whose scraped queue gauge is loaded receives no traffic;
+    once it drains and is re-scraped, it serves again."""
+    with _fleet(model_dirs[0], 2,
+                server={"start_batcher": False, "queue_capacity": 8},
+                router={"scrape_interval_s": 0.05}) as fl:
+        s0, s1 = fl.servers
+        s1.batcher.start()  # replica 1 serves; replica 0 queues unserved
+        futs = [s0.batcher.submit({"x": X1}) for _ in range(6)]  # 6/8 load
+        fl.router.scrape_now()
+        for _ in range(6):
+            fl.router.predict({"x": X1})
+        assert s1.stats.completed == 6
+        assert s0.stats.completed == 0  # the loaded gauge steered us away
+        # drain replica 0; the router must start using it again
+        s0.batcher.start()
+        for f in futs:
+            f.result(timeout=30)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and s0.stats.completed == 6:
+            fl.router.scrape_now()
+            fl.router.predict({"x": X1})
+        assert s0.stats.completed > 6, "drained replica never re-selected"
+
+
+def test_session_affinity_is_stable(model_dirs):
+    """Same session key -> same replica (rendezvous hash), as long as the
+    replica set is stable."""
+    with _fleet(model_dirs[0], 3) as fl:
+        for _ in range(4):
+            fl.router.predict({"x": X1}, session="tenant-a/chat-17")
+        served = [s.stats.completed for s in fl.servers]
+        assert sorted(served) == [0, 0, 4], served
+
+
+# ---------------------------------------------------------------------------
+# tenant quotas + priority shedding (the fleet-level health machine)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_token_bucket_quota_is_typed(model_dirs):
+    with _fleet(model_dirs[0], 1) as fl:
+        r = fl.router
+        r.configure_tenant("free", rate=0.0, burst=2, priority=0)
+        r.predict({"x": X1}, tenant="free")
+        r.predict({"x": X1}, tenant="free")
+        with pytest.raises(TenantQuotaExceeded) as ei:  # bucket dry
+            r.predict({"x": X1}, tenant="free")
+        assert ei.value.tenant == "free" and ei.value.retryable
+        assert ei.value.info()["reason"] == "quota"
+        # an unquota'd tenant is untouched
+        r.predict({"x": X1}, tenant="paid")
+        snap = r.snapshot()
+        assert snap["quota_rejected"] == 1
+        assert snap["quota_by_tenant"] == {"free": 1}
+        assert 'pt_fleet_quota_rejected_total{tenant="free"} 1' \
+            in r.metrics_text()
+
+
+def test_priority_shedding_order_under_pressure(model_dirs):
+    """As aggregate pressure rises, LOW priority tenants shed first:
+    bar(priority) = shed_base + priority * shed_step."""
+    with _fleet(model_dirs[0], 1,
+                router={"shed_base": 0.6, "shed_step": 0.15}) as fl:
+        r = fl.router
+        r.configure_tenant("free", priority=0)    # bar 0.60
+        r.configure_tenant("paid", priority=2)    # bar 0.90
+        r.pressure_override = 0.3  # calm: everyone serves
+        r.predict({"x": X1}, tenant="free")
+        r.predict({"x": X1}, tenant="paid")
+        r.pressure_override = 0.7  # pressure: free sheds, paid serves
+        with pytest.raises(FleetOverloaded) as ei:
+            r.predict({"x": X1}, tenant="free")
+        assert ei.value.info()["reason"] == "shedding"
+        assert ei.value.priority == 0 and ei.value.retryable
+        r.predict({"x": X1}, tenant="paid")
+        r.pressure_override = 0.95  # storm: everyone sheds
+        with pytest.raises(FleetOverloaded):
+            r.predict({"x": X1}, tenant="paid")
+        snap = r.snapshot()
+        assert snap["shed_by_tenant"] == {"free": 1, "paid": 1}
+        assert snap["shed"] == 2
+
+
+def test_token_bucket_units():
+    b = TokenBucket(rate=100.0, burst=2)
+    assert b.take() and b.take() and not b.take()
+    assert 0.0 < b.retry_after() <= 0.011  # 1 token at 100/s
+    time.sleep(0.03)
+    assert b.take()  # refilled
+    frozen = TokenBucket(rate=0.0, burst=1)
+    assert frozen.take() and not frozen.take()
+    assert frozen.retry_after() == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+
+def test_hedged_predict_cancel_on_first_win(model_dirs):
+    """Primary lands on a straggler replica (pinned there via session
+    affinity); after hedge_after_ms the router races the other replica
+    and answers with the first win — the caller never waits out the
+    straggler, and the hedge is counted."""
+    import hashlib
+
+    pred = Predictor(model_dirs[0], place=fluid.CPUPlace())
+    with _fleet(model_dirs[0], 2,
+                router={"hedge_after_ms": 40.0, "retries": 2}) as fl:
+        fl.router.predict({"x": X1})  # warm connections + caches
+        # the same rendezvous hash the router uses: find where the
+        # session key pins, and make THAT replica the straggler
+        eps = [s.endpoint for s in fl.servers]
+        primary = max(eps, key=lambda ep: hashlib.md5(
+            f"sess-1|{ep}".encode()).hexdigest())
+        fl.set_slow(eps.index(primary), True, slow_ms=500.0)
+        t0 = time.monotonic()
+        out = fl.router.predict({"x": X1}, session="sess-1",
+                                timeout_ms=30000)
+        elapsed = time.monotonic() - t0
+        np.testing.assert_allclose(out[0], pred.run({"x": X1})[0],
+                                   rtol=0, atol=1e-5)
+        snap = fl.router.snapshot()
+        assert snap["hedges"] == 1, "hedge never launched"
+        assert snap["hedge_wins"] == 1, "hedge lost to a 500ms straggler"
+        assert elapsed < 0.4, f"caller waited out the straggler ({elapsed:.2f}s)"
+        assert "pt_fleet_hedge_wins_total 1" in fl.router.metrics_text()
+
+
+def test_hedge_budget_is_bounded(model_dirs):
+    """The hedge token bucket caps hedges: with a zero budget no hedge
+    ever launches, however slow the primary."""
+    with _fleet(model_dirs[0], 2,
+                router={"hedge_after_ms": 10.0, "hedge_budget_per_s": 0.0,
+                        "hedge_burst": 0.0}) as fl:
+        fl.set_slow(0, True, slow_ms=80.0)
+        fl.set_slow(1, True, slow_ms=80.0)
+        for _ in range(3):
+            fl.router.predict({"x": X1})
+        assert fl.router.snapshot()["hedges"] == 0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaking + failover
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_break_half_open_recover(model_dirs):
+    """Transport faults trip the breaker open after ``circuit_threshold``
+    consecutive failures; after the cooldown exactly one probe passes
+    (half-open) and a success re-closes it."""
+    with _fleet(model_dirs[0], 2,
+                router={"scrape_interval_s": 30.0,  # breaker, not scraper,
+                        "retries": 3,               # must drive discovery
+                        "circuit_threshold": 2,
+                        "circuit_cooldown_s": 0.25}) as fl:
+        ep0 = fl.servers[0].endpoint
+        fl.set_partition(0, True)
+        # drive attempts until the breaker has tripped; every predict
+        # still answers via failover to replica 1
+        deadline = time.monotonic() + 10
+        while fl.router.circuit_states()[ep0] != "open":
+            fl.router.predict({"x": X1})
+            assert time.monotonic() < deadline, "circuit never opened"
+        snap = fl.router.snapshot()
+        assert snap["circuit_opens"] >= 1
+        assert snap["failovers"]["predict"] >= 1
+        # while open, traffic flows without touching replica 0
+        c0 = fl.servers[0].stats.submitted
+        for _ in range(4):
+            fl.router.predict({"x": X1})
+        assert fl.servers[0].stats.submitted == c0
+        # heal the partition; after the cooldown the half-open probe
+        # succeeds and the circuit re-closes
+        fl.set_partition(0, False)
+        time.sleep(0.3)
+        deadline = time.monotonic() + 10
+        while fl.router.circuit_states()[ep0] != "closed":
+            fl.router.predict({"x": X1})
+            assert time.monotonic() < deadline, "circuit never re-closed"
+        assert fl.router.fleet_state() == "healthy"
+
+
+def test_failover_before_scrape_discovery(model_dirs):
+    """A replica killed between scrapes: the in-flight attempt fails on
+    the dead socket and the SAME request is answered by another replica
+    under the shared retry budget."""
+    with _fleet(model_dirs[0], 2,
+                router={"scrape_interval_s": 60.0, "retries": 3}) as fl:
+        pred = Predictor(model_dirs[0], place=fluid.CPUPlace())
+        fl.router.predict({"x": X1})  # warm pools on both replicas
+        fl.kill_replica(0)
+        for _ in range(4):
+            out = fl.router.predict({"x": X1})
+            np.testing.assert_allclose(out[0], pred.run({"x": X1})[0],
+                                       rtol=0, atol=1e-5)
+        assert fl.router.snapshot()["failovers"]["predict"] >= 1
+
+
+def test_no_healthy_replicas_is_typed_and_fast(model_dirs):
+    with _fleet(model_dirs[0], 1, router={"retries": 2}) as fl:
+        fl.kill_replica(0)
+        deadline = time.monotonic() + 5
+        while fl.router.healthy_replica_count() and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)  # scraper notices the death
+        t0 = time.monotonic()
+        with pytest.raises(NoHealthyReplicas) as ei:
+            fl.router.predict({"x": X1})
+        assert time.monotonic() - t0 < 2.0
+        assert ei.value.retryable
+        assert ei.value.info()["reason"] == "no_healthy_replicas"
+        assert fl.router.fleet_state() == "unavailable"
+
+
+def test_remove_replica_graceful_drain(model_dirs):
+    """remove_replica(drain=True) stops routing new work to the replica
+    but waits for the router's in-flight attempts against it."""
+    with _fleet(model_dirs[0], 2, router={"scrape_interval_s": 0.05}) as fl:
+        ep0 = fl.servers[0].endpoint
+        fl.set_slow(0, True, slow_ms=300.0)
+        fl.set_slow(1, True, slow_ms=300.0)
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(fl.router.predict({"x": X1})))
+        t.start()
+        # wait until the slow attempt is in flight somewhere
+        deadline = time.monotonic() + 5
+        while not any(h.in_flight for h in fl.router._replica_list()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        assert fl.router.remove_replica(ep0, drain=True, timeout=10)
+        t.join(30)
+        assert done and done[0][0].shape == (1, 3)  # in-flight was answered
+        assert ep0 not in fl.router.circuit_states()
+        # new traffic has only replica 1 to land on
+        fl.set_slow(1, False)
+        fl.router.predict({"x": X1})
+        assert fl.servers[1].stats.completed >= 1
+
+
+# ---------------------------------------------------------------------------
+# shared retry budget (satellite: ServingClient attempt header)
+# ---------------------------------------------------------------------------
+
+
+def test_client_attempt_header_composes_budgets(model_dirs):
+    """A router-supplied ``attempt`` pre-consumes the client's retry
+    budget: with retries=3 and attempt=2 only ONE client-side retry
+    remains — budgets compose instead of multiplying."""
+    with ServingServer(model_dirs[0], queue_capacity=2,
+                       start_batcher=False) as srv:
+        srv.batcher.submit({"x": X1})
+        srv.batcher.submit({"x": X1})  # queue full forever
+        with ServingClient(srv.endpoint, retries=3, backoff_base_ms=1,
+                           retry_seed=0) as c:
+            with pytest.raises(RetryBudgetExceeded) as ei:
+                c.predict({"x": X1}, attempt=2)
+            # total attempts across hops: 2 upstream + 1 send + 1 retry
+            assert ei.value.attempts == 4
+            assert c.retries_total == 1  # only ONE local retry happened
+            assert isinstance(ei.value.last_error, ServingRejected)
+        # attempt=0 keeps the full local budget
+        with ServingClient(srv.endpoint, retries=3, backoff_base_ms=1,
+                           retry_seed=0) as c:
+            with pytest.raises(RetryBudgetExceeded):
+                c.predict({"x": X1})
+            assert c.retries_total == 3
+
+
+def test_client_remaining_deadline_ms(model_dirs):
+    with ServingServer(model_dirs[0]) as srv:
+        with ServingClient(srv.endpoint) as c:
+            c.predict({"x": X1})
+            assert c.remaining_deadline_ms() is None  # no deadline carried
+            c.predict({"x": X1}, timeout_ms=5000)
+            rem = c.remaining_deadline_ms()
+            assert rem is not None and 0 < rem <= 5000
+            time.sleep(0.02)
+            assert c.remaining_deadline_ms() < rem  # it keeps counting down
+
+
+# ---------------------------------------------------------------------------
+# rolling reload
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_reload_wholly_old_or_new_per_request(model_dirs):
+    dir_a, dir_b = model_dirs
+    X = np.random.RandomState(3).randn(2, 4).astype("float32")
+    ref_a = Predictor(dir_a, place=fluid.CPUPlace()).run({"x": X})[0]
+    ref_b = Predictor(dir_b, place=fluid.CPUPlace()).run({"x": X})[0]
+    assert not np.allclose(ref_a, ref_b)
+    with _fleet(dir_a, 2) as fl:
+        results, errors = [], []
+        stop = threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    results.append(fl.router.predict({"x": X})[0])
+                except Exception as e:  # pragma: no cover - must not happen
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # traffic on A
+        versions = fl.router.reload(dir_b)
+        assert sorted(versions.values()) == [2, 2]  # every replica rolled
+        time.sleep(0.1)  # traffic on B
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not errors
+        saw_a = saw_b = 0
+        for out in results:
+            is_a = np.allclose(out, ref_a, atol=1e-5)
+            is_b = np.allclose(out, ref_b, atol=1e-5)
+            assert is_a != is_b, "response mixed weight versions mid-roll"
+            saw_a += is_a
+            saw_b += is_b
+        assert saw_a and saw_b  # the roll really happened mid-traffic
+        np.testing.assert_allclose(fl.router.predict({"x": X})[0], ref_b,
+                                   rtol=0, atol=1e-5)
+        assert fl.router.snapshot()["rolling_reloads"] == 1
+
+
+# ---------------------------------------------------------------------------
+# autoscale hooks
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_hooks_fire_on_qps_bars(model_dirs):
+    ups, downs = [], []
+    with _fleet(model_dirs[0], 2,
+                router={"scrape_interval_s": 0.05,
+                        "scale_up_qps": 0.5, "scale_down_qps": None,
+                        "scale_cooldown_s": 0.0,
+                        "on_scale_up": lambda r, q: ups.append(q)}) as fl:
+        for _ in range(10):
+            fl.router.predict({"x": X1})
+        deadline = time.monotonic() + 5
+        while not ups and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ups and ups[0] > 0.5  # windowed QPS/replica crossed the bar
+        # flip to a scale-down config: idle traffic under a high bar
+        fl.router.scale_up_qps = None
+        fl.router.scale_down_qps = 1e9
+        fl.router.on_scale_down = lambda r, q: downs.append(q)
+        deadline = time.monotonic() + 5
+        while not downs and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert downs  # healthy_count(2) > min_replicas(1): hook fired
+        snap = fl.router.snapshot()
+        assert snap["completed"] == 10
+        text = fl.router.metrics_text()
+        assert 'pt_fleet_scale_events_total{direction="up"}' in text
+
+
+# ---------------------------------------------------------------------------
+# trace propagation
+# ---------------------------------------------------------------------------
+
+
+def test_router_spans_propagate_trace_id_across_hops(model_dirs):
+    from paddle_tpu import obs
+
+    tracer = obs.enable()
+    tracer.clear()
+    try:
+        with _fleet(model_dirs[0], 2) as fl:
+            fl.router.predict({"x": X1}, trace="fleet-tid-1")
+        tagged = tracer.spans(trace_id="fleet-tid-1")
+        names = {s.name for s in tagged}
+        assert "fleet/route" in names
+        assert "fleet/attempt" in names
+        # the SAME id tagged the replica-side request spans (cross-hop)
+        assert "serve/request" in names
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# generation failover
+# ---------------------------------------------------------------------------
+
+
+def test_generation_failover_or_typed_on_replica_death(lm_dir):
+    """A generation is pinned to its replica; killing that replica
+    mid-stream answers the caller from another replica with the
+    BIT-IDENTICAL stream (retried from scratch) — or a typed error."""
+    ref_eng = DecodeEngine(lm_dir, max_slots=2)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 97, size=(5,)).astype(np.int64)
+    ref = generate_sequential(ref_eng, [prompt], 16)[0]
+    with _fleet(lm_dir, 2,
+                server={"max_batch_size": 1, "queue_capacity": 32,
+                        "decode": {"max_slots": 2}},
+                router={"retries": 4}) as fl:
+        for i in range(2):
+            fl.set_slow(i, True, slow_ms=50.0)  # ~0.8s of decode steps
+        res = {}
+
+        def gen():
+            try:
+                res["r"] = fl.router.generate(prompt, max_new_tokens=16,
+                                              timeout_ms=120000)
+            except Exception as e:
+                res["e"] = e
+
+        t = threading.Thread(target=gen)
+        t.start()
+        # wait until the generation is truly MID-DECODE on its pinned
+        # replica (slot held AND at least one token synced) — a kill
+        # landing during prefill may legitimately complete instead
+        pinned = None
+        deadline = time.monotonic() + 30
+        while pinned is None and time.monotonic() < deadline:
+            for i in fl.alive_indices():
+                s = fl.servers[i]
+                if (s.decode_engine is not None
+                        and s.decode_engine.active_slots > 0
+                        and s.stats.decode_tokens > 0):
+                    pinned = i
+                    break
+            time.sleep(0.002)
+        assert pinned is not None, "generation never reached mid-decode"
+        fl.kill_replica(pinned)  # mid-generation
+        t.join(120)
+        assert res, "generation client hung"
+        assert "r" in res, f"typed-but-failed: {res.get('e')!r}"
+        assert res["r"]["tokens"] == ref  # retried FROM SCRATCH, bit-equal
+        assert fl.router.snapshot()["failovers"]["generate"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# pt_fleet_* name contract (satellite: alongside the pt_serving_* tests)
+# ---------------------------------------------------------------------------
+
+
+def test_pt_fleet_prometheus_name_contract(model_dirs):
+    with _fleet(model_dirs[0], 1) as fl:
+        fl.router.predict({"x": X1})
+        text = fl.router.metrics_text()
+    for name in ("pt_fleet_requests_total",
+                 "pt_fleet_hedges_total",
+                 "pt_fleet_hedge_wins_total",
+                 "pt_fleet_failovers_total",
+                 "pt_fleet_shed_by_tenant_total",
+                 "pt_fleet_quota_rejected_total",
+                 "pt_fleet_circuit_open_total",
+                 "pt_fleet_scale_events_total",
+                 "pt_fleet_rolling_reloads_total",
+                 "pt_fleet_scrapes_total",
+                 "pt_fleet_request_latency_seconds",
+                 "pt_fleet_replicas",
+                 "pt_fleet_healthy_replicas",
+                 "pt_fleet_pressure",
+                 "pt_fleet_qps_per_replica",
+                 "pt_fleet_state",
+                 "pt_fleet_circuit_state"):
+        assert name in text, f"{name} missing from the fleet exposition"
+    assert 'pt_fleet_requests_total{event="completed"} 1' in text
+    # a standalone FleetStats exposes the same families (shared-registry
+    # use: callers may pass their own MetricsRegistry)
+    solo = FleetStats().expose()
+    assert "pt_fleet_requests_total" in solo
+    assert "pt_fleet_hedges_total" in solo
+
+
+# ---------------------------------------------------------------------------
+# the fleet chaos storm (ISSUE acceptance test)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_chaos_storm_success_or_typed_then_healthy(lm_dir):
+    """Seeded kills/restarts/partitions/slow-replicas land mid-traffic
+    and mid-generation against predict AND generate clients: every
+    request ends in a bit-correct success or a TYPED error (no hangs, no
+    silent corruption), the fleet returns to ``healthy`` after the fault
+    window, and no generation is ever double-answered."""
+    pred = Predictor(lm_dir, place=fluid.CPUPlace())
+    ref_eng = DecodeEngine(lm_dir, max_slots=2)
+    rng = np.random.RandomState(9)
+    T = 32  # the export's fixed sequence length
+    n_pred_threads, n_pred_reqs = 2, 6
+    n_gen_threads, n_gen_reqs = 2, 4
+    pred_inputs = rng.randint(0, 97, size=(n_pred_threads, n_pred_reqs,
+                                           1, T)).astype(np.int64)
+    prompts = [[rng.randint(0, 97, size=(int(rng.randint(2, 8)),))
+                .astype(np.int64) for _ in range(n_gen_reqs)]
+               for _ in range(n_gen_threads)]
+    gen_ref = {(t, i): generate_sequential(ref_eng, [prompts[t][i]], 8)[0]
+               for t in range(n_gen_threads) for i in range(n_gen_reqs)}
+
+    fl = _fleet(lm_dir, 3,
+                server={"max_batch_size": 1, "queue_capacity": 32,
+                        "health_window_s": 1.0,
+                        "decode": {"max_slots": 2}},
+                router={"scrape_interval_s": 0.1, "retries": 8,
+                        "circuit_threshold": 2, "circuit_cooldown_s": 0.3})
+    storm = FleetChaos(fl, seed=11, tick_s=0.05,
+                       kill_prob=0.20, restart_delay_s=0.4,
+                       partition_prob=0.20, partition_s=0.3,
+                       slow_prob=0.20, slow_s=0.3, slow_ms=25.0,
+                       fault_window_s=1.5, min_alive=1)
+    typed = (DeadlineExceeded, RetryBudgetExceeded, ServingRejected,
+             ServingUnavailable, ShuttingDown, NoHealthyReplicas,
+             FleetOverloaded, TenantQuotaExceeded)
+    outcomes = [[] for _ in range(n_pred_threads + n_gen_threads)]
+
+    def predict_loop(tid):
+        for i in range(n_pred_reqs):
+            x = pred_inputs[tid, i]
+            try:
+                out = fl.router.predict({"ids": x}, timeout_ms=60000)[0]
+                outcomes[tid].append(("ok", ("p", tid, i, x), out))
+            except typed as e:
+                outcomes[tid].append(("typed", ("p", tid, i, x), e))
+            except Exception as e:  # untyped = contract violation
+                outcomes[tid].append(("UNTYPED", ("p", tid, i, x), e))
+
+    def gen_loop(tid):
+        row = n_pred_threads + tid
+        for i in range(n_gen_reqs):
+            try:
+                r = fl.router.generate(prompts[tid][i], max_new_tokens=8,
+                                       timeout_ms=120000)
+                outcomes[row].append(("ok", ("g", tid, i), r))
+            except typed as e:
+                outcomes[row].append(("typed", ("g", tid, i), e))
+            except Exception as e:
+                outcomes[row].append(("UNTYPED", ("g", tid, i), e))
+
+    storm.start()
+    threads = ([threading.Thread(target=predict_loop, args=(t,))
+                for t in range(n_pred_threads)]
+               + [threading.Thread(target=gen_loop, args=(t,))
+                  for t in range(n_gen_threads)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not any(t.is_alive() for t in threads), "fleet client hung"
+    storm.stop()  # heals: pending restarts/un-partitions run NOW
+    assert sum(storm.snapshot()["injected"].values()) > 0, "storm was a no-op"
+
+    flat = [o for sub in outcomes for o in sub]
+    assert len(flat) == (n_pred_threads * n_pred_reqs
+                         + n_gen_threads * n_gen_reqs)  # nothing lost
+    untyped = [o for o in flat if o[0] == "UNTYPED"]
+    assert not untyped, f"untyped failures leaked: {untyped[:3]}"
+    oks = [o for o in flat if o[0] == "ok"]
+    assert len(oks) >= 0.7 * len(flat), (len(oks), len(flat))
+    for kind, key, payload in oks:
+        if key[0] == "p":  # bit-correct predict payloads
+            np.testing.assert_allclose(
+                payload, pred.run({"ids": key[3]})[0], rtol=0, atol=1e-4)
+        else:  # bit-correct generation streams (exact token ids)
+            assert payload["tokens"] == gen_ref[(key[1], key[2])], key
+
+    # after the window + heals the fleet must return to healthy
+    deadline = time.monotonic() + 20
+    while fl.router.fleet_state() != "healthy" \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert fl.router.fleet_state() == "healthy"
+    # every surviving replica is itself healthy, with no stranded work
+    for i in fl.alive_indices():
+        s = fl.servers[i]
+        assert s.health_state() == "healthy"
+        assert s.batcher.pending == 0
+        if s.gen_batcher is not None:
+            assert s.gen_batcher.pending == 0
+            assert s.decode_engine.free_slots == s.decode_engine.max_slots
+    # zero double-dispatched side effects: one answer per request (the
+    # outcome ledger is complete and single-valued), and no generation
+    # left a stranded KV slot behind on any replica
+    fl.close()
+
+
+def test_fleet_router_rejects_generate_without_decode(model_dirs):
+    with _fleet(model_dirs[0], 1, router={"retries": 0}) as fl:
+        with pytest.raises(NoHealthyReplicas):
+            fl.router.generate([1, 2, 3], max_new_tokens=4)

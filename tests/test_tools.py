@@ -81,6 +81,44 @@ def test_paddle_cli_version():
     assert "paddle_tpu" in r.stdout and "ops registered:" in r.stdout
 
 
+def test_paddle_cli_fleet_status_table(tmp_path):
+    """`paddle_cli.py fleet` scrapes healthz + /metrics per endpoint into
+    a status table; an unreachable replica renders circuit=open and the
+    exit code flags the unhealthy fleet."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import io
+    from paddle_tpu.serving import ServingServer
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=3)
+        io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
+                                main, scope=scope)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import paddle_cli
+    finally:
+        sys.path.pop(0)
+    with ServingServer(str(tmp_path / "m")) as srv:
+        rows = paddle_cli.fleet_rows([srv.endpoint, "127.0.0.1:1"],
+                                     timeout=2.0)
+        report = paddle_cli.fleet_report(rows)
+    assert rows[0]["health"] == "healthy"
+    assert rows[0]["circuit"] == "closed"
+    assert rows[0]["queue"] == 0 and rows[0]["capacity"] == 64
+    assert rows[0]["weights"] == 1
+    assert rows[1]["health"] == "unreachable"
+    assert rows[1]["circuit"] == "open"
+    assert "1/2 replicas healthy" in report
+    assert srv.endpoint in report
+
+
 def test_op_parity_audit_clean():
     """Every reference op (SURVEY §2b) is matched or redesign-mapped."""
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}

@@ -9,6 +9,11 @@ Subcommands:
              output) without a browser: top spans by SELF time (child
              spans subtracted), per-stage duration histogram, slowest
              trace_ids. ``--convert OUT`` re-emits a normalized trace.
+  fleet    — status table of serving replicas (health, queue, pipeline
+             occupancy, MFU, weights version, derived circuit state)
+             scraped from each endpoint's healthz + /metrics; endpoints
+             as args or comma-separated. Unreachable replicas render as
+             circuit=open.
 """
 from __future__ import annotations
 
@@ -164,10 +169,81 @@ def cmd_trace(argv):
     return 0
 
 
+# -- fleet status ----------------------------------------------------------
+
+
+def fleet_rows(endpoints, timeout=3.0):
+    """Scrape each replica's healthz + metrics; one status dict per
+    endpoint. The circuit column is DERIVED: an endpoint that cannot be
+    scraped is what a router's breaker would hold open."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.serving import ServingClient
+    from paddle_tpu.serving.fleet import scraped_gauges
+
+    rows = []
+    for ep in endpoints:
+        row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
+               "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
+               "weights": "-", "decode": ""}
+        try:
+            with ServingClient(ep, timeout=timeout) as c:
+                hz = c.healthz()
+                m = scraped_gauges(hz, c.metrics())
+            row.update(
+                health=hz.get("state", "?"), circuit="closed",
+                queue=int(m["queue_depth"]),
+                capacity=int(m["queue_capacity"]),
+                occupancy=int(m["occupancy"]),
+                mfu=m["mfu"],
+                weights=int(m["weights_version"]))
+            d = hz.get("decode")
+            if d:
+                row["decode"] = (f"{d['active_slots']}/{d['max_slots']} "
+                                 f"slots")
+        except Exception:
+            pass
+        rows.append(row)
+    return rows
+
+
+def fleet_report(rows):
+    lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
+             f"{'occ':>5}{'mfu':>11}{'weights':>9}  decode"]
+    for r in rows:
+        q = (f"{r['queue']}/{r['capacity']}"
+             if r["queue"] != "-" else "-")
+        mfu = f"{r['mfu']:.2e}" if r["mfu"] != "-" else "-"
+        lines.append(f"{r['endpoint']:<24}{r['health']:<12}"
+                     f"{r['circuit']:<9}{q:>9}{str(r['occupancy']):>5}"
+                     f"{mfu:>11}{str(r['weights']):>9}  {r['decode']}")
+    healthy = sum(1 for r in rows if r["health"] == "healthy")
+    lines.append(f"{healthy}/{len(rows)} replicas healthy")
+    return "\n".join(lines)
+
+
+def cmd_fleet(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py fleet",
+        description="status table of serving replicas from scraped "
+                    "healthz + /metrics")
+    ap.add_argument("endpoints", nargs="+",
+                    help="replica endpoints (host:port, space- or "
+                         "comma-separated)")
+    ap.add_argument("--timeout", type=float, default=3.0,
+                    help="per-replica scrape timeout (s)")
+    args = ap.parse_args(argv)
+    eps = [e for spec in args.endpoints for e in spec.split(",") if e]
+    rows = fleet_rows(eps, timeout=args.timeout)
+    print(fleet_report(rows))
+    return 0 if all(r["health"] == "healthy" for r in rows) else 1
+
+
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle_cli.py {train|version|trace} [args...]")
+        print("usage: paddle_cli.py {train|version|trace|fleet} [args...]")
         return 0
     sub = sys.argv[1]
     if sub == "version":
@@ -178,7 +254,9 @@ def main():
         return 0  # unreachable (execv)
     if sub == "trace":
         return cmd_trace(sys.argv[2:])
-    print(f"unknown subcommand {sub!r}; use train|version|trace")
+    if sub == "fleet":
+        return cmd_fleet(sys.argv[2:])
+    print(f"unknown subcommand {sub!r}; use train|version|trace|fleet")
     return 2
 
 

@@ -51,8 +51,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from paddle_tpu.serving import (DeadlineExceeded, RetryBudgetExceeded,  # noqa: E402
-                                ServingClient, ServingRejected, ServingServer)
+from paddle_tpu.serving import (DeadlineExceeded, FleetChaos,  # noqa: E402
+                                FleetOverloaded, LocalFleet, NoHealthyReplicas,
+                                RetryBudgetExceeded, ServingClient,
+                                ServingRejected, ServingServer,
+                                TenantQuotaExceeded)
 from paddle_tpu.serving.chaos import default_profile  # noqa: E402
 from paddle_tpu.serving.stats import _percentile  # noqa: E402
 
@@ -186,6 +189,130 @@ def bench_generate(endpoint, vocab, clients, duration, prompt_range,
             "occupancy_max": max(occ_samples) if occ_samples else 0.0}
 
 
+def _fleet_client_loop(router, feeds, tenant, stop, out, deadline_ms,
+                       gen_spec=None):
+    """One closed-loop client driving the router directly (predict, or
+    generation when ``gen_spec=(vocab, prompt_range, token_range, rng)``)."""
+    lat, done, tokens = [], 0, 0
+    shed = quota = rejected = deadline_missed = exhausted = errors = 0
+    while not stop.is_set():
+        t0 = time.monotonic()
+        try:
+            if gen_spec is None:
+                router.predict(feeds, tenant=tenant, timeout_ms=deadline_ms)
+            else:
+                vocab, pr, tr, rng = gen_spec
+                prompt = rng.randint(0, vocab, size=(
+                    int(rng.randint(pr[0], pr[1] + 1)),))
+                budget = int(rng.randint(tr[0], tr[1] + 1))
+                r = router.generate(prompt, max_new_tokens=budget,
+                                    tenant=tenant, timeout_ms=deadline_ms)
+                tokens += len(r["tokens"])
+            lat.append(time.monotonic() - t0)
+            done += 1
+        except TenantQuotaExceeded as e:
+            quota += 1
+            time.sleep(min(e.retry_after_s, 0.05))
+        except FleetOverloaded:
+            shed += 1
+            time.sleep(0.002)
+        except (ServingRejected, NoHealthyReplicas):
+            rejected += 1
+            time.sleep(0.002)
+        except DeadlineExceeded:
+            deadline_missed += 1
+        except RetryBudgetExceeded:
+            exhausted += 1
+        except Exception:
+            errors += 1
+            break
+    out.append({"lat": lat, "done": done, "tokens": tokens, "shed": shed,
+                "quota": quota, "rejected": rejected,
+                "deadline_missed": deadline_missed, "exhausted": exhausted,
+                "errors": errors, "tenant": tenant})
+
+
+def bench_fleet(fleet, feeds, clients, duration, tenants=None,
+                deadline_ms=None, gen_args=None):
+    """Closed-loop clients (round-robin over ``tenants``) against a
+    ``LocalFleet`` router; returns the aggregate + per-tenant rollup."""
+    stop = threading.Event()
+    out = []
+    names = [t[0] for t in (tenants or [])] or [None]
+    threads = []
+    for i in range(clients):
+        gen_spec = None
+        if gen_args is not None:
+            vocab, pr, tr = gen_args
+            gen_spec = (vocab, pr, tr, np.random.RandomState(i))
+        threads.append(threading.Thread(
+            target=_fleet_client_loop,
+            args=(fleet.router, feeds, names[i % len(names)], stop, out,
+                  deadline_ms, gen_spec),
+            daemon=True))
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(120)
+    elapsed = time.monotonic() - t0
+    lats = sorted(l for r in out for l in r["lat"])
+    done = sum(r["done"] for r in out)
+    return {"elapsed_s": elapsed, "requests": done,
+            "tokens": sum(r["tokens"] for r in out),
+            "qps": done / elapsed if elapsed else 0.0,
+            "p50_ms": _percentile(lats, 0.50) * 1e3,
+            "p95_ms": _percentile(lats, 0.95) * 1e3,
+            "p99_ms": _percentile(lats, 0.99) * 1e3,
+            "shed": sum(r["shed"] for r in out),
+            "quota": sum(r["quota"] for r in out),
+            "rejected": sum(r["rejected"] for r in out),
+            "deadline_missed": sum(r["deadline_missed"] for r in out),
+            "retry_exhausted": sum(r["exhausted"] for r in out),
+            "errors": sum(r["errors"] for r in out)}
+
+
+def _print_fleet_report(fleet, r):
+    router = fleet.router
+    print(f"requests={r['requests']} shed={r['shed']} quota={r['quota']} "
+          f"rejected={r['rejected']} deadline_missed={r['deadline_missed']} "
+          f"retry_exhausted={r['retry_exhausted']} errors={r['errors']}")
+    if r.get("tokens"):
+        print(f"tokens={r['tokens']} "
+              f"tokens/s={r['tokens'] / r['elapsed_s']:.1f}")
+    print(f"aggregate qps={r['qps']:.1f}  p50={r['p50_ms']:.2f}ms  "
+          f"p95={r['p95_ms']:.2f}ms  p99={r['p99_ms']:.2f}ms")
+    snap = router.snapshot()
+    print(f"router: state={snap['fleet_state']} "
+          f"pressure={snap['pressure']:.2f} "
+          f"hedges={snap['hedges']} hedge_wins={snap['hedge_wins']} "
+          f"failovers={snap['failovers']} "
+          f"circuit_opens={snap['circuit_opens']}")
+    if snap["shed_by_tenant"] or snap["quota_by_tenant"]:
+        print(f"shed_by_tenant={snap['shed_by_tenant']} "
+              f"quota_by_tenant={snap['quota_by_tenant']}")
+    print(f"{'replica':<22}{'health':<10}{'circuit':<10}{'queue':>6}"
+          f"{'occ':>5}{'served':>8}{'p95_ms':>9}{'mfu':>10}")
+    for info in snap["replicas"]:
+        ep = info["endpoint"]
+        srv = next((s for s in fleet.servers
+                    if s is not None and not getattr(s, "_closed", True)
+                    and s.endpoint == ep), None)
+        served, p95 = "-", "-"
+        if srv is not None:
+            ssnap = srv.stats.snapshot()
+            served = ssnap["completed"]
+            p95 = f"{ssnap['latency_ms']['p95']:.2f}"
+        print(f"{ep:<22}{info['health'] or '?':<10}"
+              f"{info['circuit']:<10}"
+              f"{int(info['queue_depth'] or 0):>6}"
+              f"{int(info['occupancy'] or 0):>5}"
+              f"{served:>8}{p95:>9}"
+              f"{(info['mfu'] or 0.0):>10.2e}")
+
+
 def bench(endpoint, feeds, clients, duration, retries=0, deadline_ms=None):
     stop = threading.Event()
     out = []
@@ -217,6 +344,107 @@ def bench(endpoint, feeds, clients, duration, retries=0, deadline_ms=None):
             "p99_ms": _percentile(lats, 0.99) * 1e3}
 
 
+def _parse_tenants(specs):
+    """name:priority[:rate[:burst]] -> [(name, priority, rate, burst)]."""
+    out = []
+    for spec in specs:
+        parts = spec.split(":")
+        if not 2 <= len(parts) <= 4:
+            raise SystemExit(f"--tenant wants name:priority[:rate[:burst]], "
+                             f"got {spec!r}")
+        name = parts[0]
+        prio = int(parts[1])
+        rate = float(parts[2]) if len(parts) > 2 else None
+        burst = float(parts[3]) if len(parts) > 3 else None
+        out.append((name, prio, rate, burst))
+    return out
+
+
+def _main_fleet(args, shapes, tracer):
+    """The --fleet path: N local replicas behind a FleetRouter, traffic
+    driven THROUGH the router; --chaos runs the fleet-level storm.
+    ``--retries`` becomes the router's per-attempt client budget
+    (composed under the shared ``--fleet-retries`` failover budget);
+    unlike single-server mode it defaults to 0 even under --chaos —
+    the router's failover, not the inner client, owns chaos retries."""
+    tenants = _parse_tenants(args.tenant)
+    server_kwargs = {"max_batch_size": args.max_batch_size,
+                     "batch_timeout_ms": args.batch_timeout_ms,
+                     "queue_capacity": args.queue_capacity,
+                     "pipeline_depth": args.pipeline_depth}
+    if args.generate:
+        decode = {"gen_queue_capacity": args.queue_capacity}
+        if args.max_slots is not None:
+            decode["max_slots"] = args.max_slots
+        if args.prefill_chunk is not None:
+            decode["prefill_chunk"] = args.prefill_chunk
+        server_kwargs["decode"] = decode
+    router_kwargs = {"retries": args.fleet_retries,
+                     "attempt_retries": (args.retries
+                                         if args.retries is not None else 0),
+                     "scrape_interval_s": 0.1,
+                     "hedge_after_ms": args.hedge_ms}
+    fleet = LocalFleet(args.model_dir, args.fleet,
+                       server_kwargs=server_kwargs,
+                       router_kwargs=router_kwargs, warmup=True)
+    storm = None
+    try:
+        for name, prio, rate, burst in tenants:
+            fleet.router.configure_tenant(name, rate=rate, burst=burst,
+                                          priority=prio)
+        feeds = {}
+        gen_args = None
+        if args.generate:
+            vocab = fleet.servers[0].decode_engine.cfg["vocab"]
+            pr = _parse_range(args.prompt_tokens, "prompt-tokens")
+            tr = _parse_range(args.gen_tokens, "gen-tokens")
+            gen_args = (vocab, pr, tr)
+        else:
+            for n in fleet.servers[0].engine.feed_names:
+                if n not in shapes:
+                    var = fleet.servers[0].engine._feed_vars[n]
+                    shapes[n] = tuple(var.shape)[1:]
+            rng = np.random.RandomState(0)
+            feeds = {n: rng.rand(args.rows, *dims).astype("float32")
+                     for n, dims in shapes.items()}
+        print(f"fleet of {args.fleet} replicas behind the router: "
+              f"{', '.join(fleet.endpoints())}")
+        if tenants:
+            print("tenants: " + ", ".join(
+                f"{n}(prio={p}, rate={r if r is not None else 'unlimited'})"
+                for n, p, r, _ in tenants))
+        if args.chaos:
+            window = (args.chaos_window if args.chaos_window is not None
+                      else args.duration / 2)
+            storm = FleetChaos(fleet, seed=args.chaos_seed, tick_s=0.05,
+                               kill_prob=0.10, restart_delay_s=0.5,
+                               partition_prob=0.10, partition_s=0.4,
+                               slow_prob=0.10, slow_s=0.4, slow_ms=30.0,
+                               fault_window_s=window, min_alive=1)
+            storm.start()
+            print(f"fleet chaos armed: seed={args.chaos_seed} "
+                  f"window={window:.1f}s "
+                  f"(kill/restart + partition + slow-replica)")
+        mode = "GENERATION" if args.generate else "predict"
+        print(f"benching the router: {args.clients} closed-loop {mode} "
+              f"clients, {args.duration:.0f}s")
+        r = bench_fleet(fleet, feeds, args.clients, args.duration,
+                        tenants=tenants, deadline_ms=args.deadline_ms,
+                        gen_args=gen_args)
+        if storm is not None:
+            storm.stop()  # run pending heals before the report
+            print(f"chaos: {storm.snapshot()}")
+        _print_fleet_report(fleet, r)
+        if tracer is not None:
+            n = tracer.dump(args.trace_out)
+            print(f"chrome trace: {args.trace_out} ({n} spans)")
+        return 0 if r["errors"] == 0 else 1
+    finally:
+        if storm is not None:
+            storm.stop()
+        fleet.close()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model-dir", help="spawn an in-process server over DIR")
@@ -238,13 +466,34 @@ def main(argv=None):
                          "2 = overlap host prep with the in-flight device "
                          "call)")
     ap.add_argument("--retries", type=int, default=None,
-                    help="client retry budget (default: 0, or 8 with --chaos)")
+                    help="client retry budget (default: 0, or 8 with "
+                         "--chaos); with --fleet: the router's per-attempt "
+                         "client budget, default 0 (failover owns retries)")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request deadline budget; expired requests are "
                          "shed server-side before dispatch")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="spawn N local replicas behind a FleetRouter and "
+                         "bench THROUGH the router (requires --model-dir); "
+                         "composes with --chaos (fleet-level kill/restart/"
+                         "partition/slow storm) and --generate")
+    ap.add_argument("--tenant", action="append", default=[],
+                    metavar="name:priority[:rate[:burst]]",
+                    help="fleet tenant spec (repeatable); clients round-"
+                         "robin over tenants. rate = token-bucket req/s "
+                         "(omit for unlimited), priority = shed order "
+                         "(higher survives longer)")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="fleet hedging delay: race a second replica when "
+                         "the primary hasn't answered after this many ms "
+                         "(default: off)")
+    ap.add_argument("--fleet-retries", type=int, default=4,
+                    help="router-side shared failover budget (--fleet)")
     ap.add_argument("--chaos", action="store_true",
                     help="arm the seeded fault profile in the in-process "
-                         "server (requires --model-dir)")
+                         "server (requires --model-dir); with --fleet this "
+                         "is the FLEET storm: replica kills/restarts, "
+                         "partitions, slow replicas")
     ap.add_argument("--chaos-seed", type=int, default=0)
     ap.add_argument("--chaos-window", type=float, default=None,
                     help="stop injecting after this many seconds (default: "
@@ -276,6 +525,8 @@ def main(argv=None):
     if args.chaos and not args.model_dir:
         ap.error("--chaos injects inside the in-process server; it needs "
                  "--model-dir")
+    if args.fleet is not None and not args.model_dir:
+        ap.error("--fleet spawns in-process replicas; it needs --model-dir")
     retries = args.retries if args.retries is not None else \
         (8 if args.chaos else 0)
 
@@ -290,6 +541,9 @@ def main(argv=None):
 
         tracer = obs.enable()
         tracer.clear()
+
+    if args.fleet is not None:
+        return _main_fleet(args, shapes, tracer)
 
     server = None
     chaos = None
