@@ -99,6 +99,31 @@ DEC_FF = 256
 DEC_SLOTS = 8
 DEC_N = 48      # generations in the mix
 
+# sharded-serving A/B (serving/sharded.py + serving/placement.py, docs
+# §18): ONE warmed model served single-device vs over a 4-device
+# host-platform mesh (dp=2 x tp=2). The barred value is the COLLECTIVE
+# CONTRACT ratio — the compiled sharded step must contain EXACTLY the
+# column layout's static all-gather schedule (4L+2 when tp>1), measured
+# by counting all-gather instructions in its HLO: min(expected/measured,
+# measured/expected) is 1.0 only at exact agreement, deterministic across
+# reps and backends, and any regression that sneaks a psum/reduce-scatter
+# into the program (breaking bit-exactness) or drops a gather (breaking
+# the cost model) fails the bar. Output bit-equality and zero steady-state
+# recompiles are hard requirements (ValueError -> value 0), wall QPS/chip
+# rides the record as informational fields, and the searcher's predicted
+# QPS/chip-at-fixed-p95 curve for 1->8 v5e chips plus the must-shard
+# proof (params > one chip's HBM => every tp=1 plan rejected, the chosen
+# tp>1 plan executable) land in the record too. Runs in a SUBPROCESS with
+# the virtual-device XLA flag so the forced host device count never
+# perturbs the training workloads' thread pools.
+SHD_VOCAB = 128
+SHD_T = 64
+SHD_D = 64
+SHD_HEADS = 4
+SHD_LAYERS = 2
+SHD_FF = 128
+SHD_BATCH = 8
+
 
 def _prev_results():
     """metric -> (value, round_tag) from the newest prior ``BENCH_r*.json``.
@@ -196,6 +221,13 @@ BARS = {
         "source": "ISSUE 6 acceptance: continuous batching >= 2x the "
                   "coalesce-then-dispatch baseline on a mixed-length mix "
                   "(measured 2.76x r6)"},
+    "sharded_serving_qps_per_chip": {
+        "field": "value", "min": 1.0, "provisional": True,
+        "source": "ISSUE 8 acceptance: the sharded step's compiled "
+                  "collective count must equal the §18 column layout's "
+                  "static schedule exactly (ratio 1.0), with bit-equal "
+                  "outputs and zero steady-state recompiles enforced "
+                  "in-workload"},
 }
 # a bar miss inside the slope instrument's own noise band is tunnel
 # weather, not a defensible regression: 2% relative tolerance (the spread
@@ -913,6 +945,187 @@ def bench_decode_serving():
     })
 
 
+def _sharded_serving_child():
+    """The --sharded-child entry: runs the sharded A/B on the host CPU
+    mesh and prints ONE JSON record for the parent to re-emit. Separate
+    process because xla_force_host_platform_device_count must be set
+    before jax initializes AND must not leak into the other workloads."""
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import io as model_io
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.placement import (DeviceInventory, ModelProfile,
+                                              NoFeasiblePlacement,
+                                              PlacementSearcher,
+                                              TrafficProfile, profile_export)
+    from paddle_tpu.serving.sharded import ShardedServingEngine
+
+    d = os.path.join(tempfile.mkdtemp(prefix="bench_sharded_"), "lm")
+    with fluid.unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[SHD_T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[SHD_T],
+                                       dtype="int64")
+            logits, _loss = transformer_lm(
+                ids, labels, vocab_size=SHD_VOCAB, max_len=SHD_T,
+                d_model=SHD_D, n_heads=SHD_HEADS, n_layers=SHD_LAYERS,
+                d_ff=SHD_FF)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=23)
+        rng = np.random.RandomState(1023)
+        for name in scope.var_names():
+            w = np.asarray(scope.get(name))
+            if np.issubdtype(w.dtype, np.floating):
+                scope.set(name, w + 0.5 * rng.randn(*w.shape)
+                          .astype(w.dtype))
+        model_io.save_inference_model(d, ["ids"], [logits], exe, main_prog,
+                                      scope=scope)
+
+    single = ServingEngine(d, place=fluid.CPUPlace(),
+                           max_batch_size=SHD_BATCH)
+    sharded = ShardedServingEngine(d, dp=2, tp=2, place=fluid.CPUPlace(),
+                                   max_batch_size=SHD_BATCH)
+    rng = np.random.RandomState(7)
+    batches = [rng.randint(0, SHD_VOCAB, (SHD_BATCH, SHD_T))
+               .astype(np.int64) for _ in range(8)]
+    # warm BOTH engines' bucket, then the A/B compares steady states
+    for eng in (single, sharded):
+        eng.run_batch({"ids": batches[0]})
+    misses = (single.cache_info()["misses"], sharded.cache_info()["misses"])
+    outs_single = [single.run_batch({"ids": b})[0] for b in batches]
+    outs_sharded = [sharded.run_batch({"ids": b})[0] for b in batches]
+    for a, b in zip(outs_single, outs_sharded):
+        if not np.array_equal(a, b):
+            raise ValueError("sharded predict diverged from the single-"
+                             "device engine (bit-equality REQUIRED)")
+    if (single.cache_info()["misses"],
+            sharded.cache_info()["misses"]) != misses:
+        raise ValueError("steady-state sharded serving recompiled")
+
+    measured = sharded.measured_collectives(SHD_BATCH)
+    expected = sharded.expected_collectives_per_dispatch
+    contract = min(expected / measured, measured / expected) \
+        if measured else 0.0
+
+    def qps(eng, reps=6):
+        t0 = time.monotonic()
+        for _ in range(reps):
+            for b in batches:
+                eng.run_batch({"ids": b})
+        return reps * len(batches) * SHD_BATCH / (time.monotonic() - t0)
+
+    qps_1 = qps(single)
+    qps_4 = qps(sharded)
+
+    # the TPU win: predicted QPS/chip-at-fixed-p95 curve over 1->8 v5e
+    # chips for a 7B-class bf16 profile — the regime the tentpole exists
+    # for: 1 chip reports null (params + activations outgrow 16 GB), the
+    # curve starts where the search finds the first feasible split
+    big = ModelProfile.synthetic(32, 32, 4096, 11008, 32000, 4096,
+                                 dtype_bytes=2)
+    curve = PlacementSearcher(
+        big, DeviceInventory.tpu_v5e(8),
+        TrafficProfile([(1, 0.7), (8, 0.3)], seq_len=4096,
+                       p95_budget_ms=4000.0)).qps_per_chip_curve()
+    prof = profile_export(d, xla_cost=False)
+    # modeled HBM midway between the cheapest tp=1 per-device need and
+    # the cheapest sharded one: every 1-chip-class plan (tp=1 at ANY dp)
+    # must be rejected, some tp>1 plan must fit — the must-shard regime,
+    # scaled down to the bench model
+    must_traffic = TrafficProfile([(2, 1.0)], seq_len=SHD_T)
+    probe = PlacementSearcher(prof, DeviceInventory(4, hbm_gb=1e6),
+                              must_traffic)
+    needs = {(p.dp, p.tp): p.hbm_bytes_per_device
+             for p in probe.all_plans()}
+    tp1_floor = min(v for (dp_, tp_), v in needs.items() if tp_ == 1)
+    shard_floor = min(v for (dp_, tp_), v in needs.items() if tp_ > 1)
+    if shard_floor >= tp1_floor:
+        raise ValueError("must-shard setup degenerate: sharding does not "
+                         "reduce per-device bytes on this profile")
+    tiny_hbm = (tp1_floor + shard_floor) / 2 / GIB_F
+    must = PlacementSearcher(
+        prof, DeviceInventory(4, hbm_gb=tiny_hbm, link_gbps=45.0),
+        must_traffic)
+    one_chip_rejected = True
+    try:
+        must.search(max_devices=1)
+        one_chip_rejected = False
+    except NoFeasiblePlacement:
+        pass
+    if any(p.feasible and p.tp == 1 for p in must.all_plans()):
+        raise ValueError("a tp=1 plan fit the must-shard inventory")
+    must_plan = must.search()  # raises = the workload fails, loudly
+    if must_plan.tp < 2:
+        raise ValueError(f"must-shard model chose tp={must_plan.tp}")
+    # the chosen must-shard plan is executable on the real mesh
+    exec_eng = ShardedServingEngine(d, dp=must_plan.dp, tp=must_plan.tp,
+                                    place=fluid.CPUPlace(),
+                                    max_batch_size=SHD_BATCH)
+    exec_out = exec_eng.run_batch({"ids": batches[0]})[0]
+    if not np.array_equal(exec_out, outs_single[0]):
+        raise ValueError("must-shard plan execution diverged")
+
+    print(json.dumps({
+        "metric": "sharded_serving_qps_per_chip",
+        "value": round(contract, 4),
+        "unit": "x",
+        "collectives_measured": measured,
+        "collectives_expected": expected,
+        "bit_identical": True,
+        "zero_steady_state_recompiles": True,
+        "qps_1dev": round(qps_1, 1),
+        "qps_4dev": round(qps_4, 1),
+        "qps_per_chip_4dev": round(qps_4 / 4, 1),
+        "mesh": {"dp": 2, "tp": 2},
+        "predicted_qps_per_chip_curve": curve,
+        "must_shard": {
+            "param_bytes": prof.param_bytes,
+            "modeled_hbm_gb": round(tiny_hbm, 6),
+            "one_chip_rejected": one_chip_rejected,
+            "chosen": {"dp": must_plan.dp, "tp": must_plan.tp},
+            "executable_bit_identical": True},
+        "config": {"V": SHD_VOCAB, "T": SHD_T, "D": SHD_D,
+                   "layers": SHD_LAYERS, "batch": SHD_BATCH},
+    }))
+
+
+GIB_F = 1024.0 ** 3
+
+
+def bench_sharded_serving():
+    """Eighth workload class (ISSUE 8): run the sharded A/B in a child
+    process that forces an 8-virtual-device host platform, then re-emit
+    its record through the shared bar/regression judging."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded-child"],
+        capture_output=True, text=True, cwd=here, env=env, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"sharded child failed: {(r.stderr or r.stdout)[-400:]}")
+    rec = None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rec = json.loads(line)
+    if rec is None:
+        raise RuntimeError(f"sharded child emitted no record: "
+                           f"{r.stdout[-400:]}")
+    _emit(rec)
+
+
 def main():
     from paddle_tpu import obs
 
@@ -933,6 +1146,8 @@ def main():
              "examples/sec"),
             (bench_decode_serving,
              "decode_serving_continuous_batching_step_ratio", "x"),
+            (bench_sharded_serving,
+             "sharded_serving_qps_per_chip", "x"),
     ):
         try:
             _WORKLOAD_T0[0] = time.monotonic()
@@ -958,4 +1173,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--sharded-child" in sys.argv:
+        _sharded_serving_child()
+    else:
+        main()

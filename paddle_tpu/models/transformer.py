@@ -459,8 +459,99 @@ def decode_params_from_scope(roles, scope):
     return params
 
 
+def _tp_gather(tp_axis):
+    """Last-axis all-gather over a shard_map mesh axis (identity when no
+    axis) — the ONE collective of the serving tier's tensor layout. Column
+    shards are concatenated in rank order, so a gathered activation is the
+    bitwise concatenation of per-rank partials: no partial-sum reduction
+    ever happens, which is what keeps sharded execution bit-identical to
+    the single-device engine (docs/design.md §18)."""
+    import jax
+
+    if tp_axis is None:
+        return lambda z: z
+    return lambda z: jax.lax.all_gather(z, tp_axis, axis=z.ndim - 1,
+                                        tiled=True)
+
+
+def predict_forward(params, ids, *, cfg, tp: int = 1, tp_axis=None):
+    """Whole-sequence logits of a ``transformer_lm`` inference export,
+    pure jax — the sharded serving engine's step function
+    (serving/sharded.py). Returns ``[B, T, V]`` float32 logits.
+
+    The math mirrors the exported IR program's op kernels exactly —
+    ``ops/math.py mul`` (flatten-to-2D f32 dot), ``ops/nn.py layer_norm``
+    (single-pass E[x²] stats, clamped variance), and the SAME
+    ``flash_attention_fwd`` kernel the flash_attention op runs — so the
+    unsharded call is bit-identical to ``ServingEngine.run_batch`` on the
+    same export (tested in tests/test_serving_sharded.py).
+
+    With ``tp > 1`` (inside ``shard_map``), every matmul weight is a
+    COLUMN shard — each rank computes its slice of the output features
+    with the FULL contraction — and activations are all-gathered back to
+    replicated at each boundary (emb, attention context, attention out,
+    FFN hidden, FFN out, head: ``4*n_layers + 2`` gathers). Because no
+    contraction dim is ever split, per-element math is identical to the
+    single-device program and the column concatenation is exact: the
+    bit-safe Megatron variant. (Row-parallel halves would halve the FFN
+    gather at the price of a psum whose float reduction order differs
+    from the unsharded dot — rejected for serving, docs/design.md §18.)
+    Attention shards by HEAD (``q/k/v`` columns are head blocks), so the
+    flash kernel runs unchanged on each rank's head subset.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas_attention import flash_attention_fwd
+
+    B, t = ids.shape
+    H = cfg["n_heads"]
+    D = cfg["d_model"]
+    Dh = D // H
+    eps = cfg["eps"]
+    gather = _tp_gather(tp_axis if tp > 1 else None)
+
+    def fc(x, w, b=None):
+        # ops/math.py mul: flatten to 2D, f32-accumulated dot, reshape back
+        out = jnp.dot(x.reshape(-1, x.shape[-1]), w,
+                      preferred_element_type=jnp.float32)
+        out = out.astype(jnp.float32).reshape(x.shape[:-1] + (w.shape[-1],))
+        return out if b is None else out + b
+
+    def ln(x, s, b):
+        # ops/nn.py layer_norm: single-pass E[x²] stats, clamped variance
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.maximum(
+            jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        return y * s.reshape((1, 1, -1)) + b.reshape((1, 1, -1))
+
+    x = gather(jnp.take(params["emb"], ids.astype(jnp.int32), axis=0))
+    x = x + params["pos"][0][:t]
+    for lp in params["layers"]:
+        a = ln(x, lp["ln1_s"], lp["ln1_b"])
+        if "wqkv" in lp:
+            # fused export: one [D, 3D/tp] local matmul, split into the
+            # rank's q/k/v head blocks (the load path permuted the columns
+            # so each rank's slice is [q_r | k_r | v_r])
+            q, k, v = jnp.split(fc(a, lp["wqkv"]), 3, axis=-1)
+        else:
+            q, k, v = fc(a, lp["wq"]), fc(a, lp["wk"]), fc(a, lp["wv"])
+        q = q.reshape(B, t, H // tp, Dh)
+        k = k.reshape(B, t, H // tp, Dh)
+        v = v.reshape(B, t, H // tp, Dh)
+        ctx = flash_attention_fwd(q, k, v, causal=True)
+        ctx = gather(ctx.reshape(B, t, D // tp))
+        x = x + gather(fc(ctx, lp["wo"]))
+        f = ln(x, lp["ln2_s"], lp["ln2_b"])
+        h = jnp.maximum(fc(f, lp["wup"], lp.get("bup")), 0.0)
+        x = x + gather(fc(gather(h), lp["wdown"], lp.get("bdown")))
+    xn = ln(x, params["lnf_s"], params["lnf_b"])
+    return gather(fc(xn, params["out_w"], params.get("out_b")))
+
+
 def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
-                         slots, *, cfg, window):
+                         slots, *, cfg, window, tp: int = 1, tp_axis=None):
     """One decode/prefill chunk over the slot-pooled KV cache. Pure jax —
     the decode engine jits this per (batch, chunk, window) signature with
     the pools donated, so steady-state decode is one fixed executable.
@@ -492,6 +583,15 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
     position only ever reads pool entries that were really produced
     (stale bytes past a lane's length are masked out, and the slot's next
     real write overwrites them before they ever become visible).
+
+    With ``tp > 1`` (inside ``shard_map`` — serving/sharded.py): the
+    params are column shards, the POOLS hold each rank's head subset
+    (``[L, n_slots, max_len, H/tp, Dh]`` local), attention runs per local
+    head, and activations all-gather back to replicated at the same four
+    boundaries as ``predict_forward`` (+1 for the embedding, +1 for the
+    head logits so the greedy argmax sees the full vocab). Column
+    concatenation only — the sharded greedy stream is bit-identical to
+    the single-device engine's.
     """
     import jax
     import jax.numpy as jnp
@@ -503,6 +603,8 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
     eps = cfg["eps"]
     scale = 1.0 / (Dh ** 0.5)
     max_len = pool_k.shape[2]
+    H_loc = H // tp
+    gather = _tp_gather(tp_axis if tp > 1 else None)
 
     # pool positions this chunk occupies, clamped so padded tails of the
     # last prefill chunk cannot write past the pool (they are masked and
@@ -517,7 +619,7 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
             jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
         return (x - mean) * jax.lax.rsqrt(var + eps) * s + b
 
-    x = params["emb"][tokens] + params["pos"][0][posm]
+    x = gather(params["emb"][tokens]) + params["pos"][0][posm]
     key_idx = jnp.arange(window, dtype=jnp.int32)
     mask = key_idx[None, None, None, :] <= posm[:, None, :, None]  # [B,1,C,W]
     for li, lp in enumerate(params["layers"]):
@@ -526,9 +628,9 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
             q, k, v = jnp.split(a @ lp["wqkv"], 3, axis=-1)
         else:
             q, k, v = a @ lp["wq"], a @ lp["wk"], a @ lp["wv"]
-        q = q.reshape(B, C, H, Dh)
-        k = k.reshape(B, C, H, Dh)
-        v = v.reshape(B, C, H, Dh)
+        q = q.reshape(B, C, H_loc, Dh)
+        k = k.reshape(B, C, H_loc, Dh)
+        v = v.reshape(B, C, H_loc, Dh)
         # slot as a scatter dim: one compiled step serves every in-flight
         # generation, wherever its pool row lives
         pool_k = pool_k.at[li, slots[:, None], posm].set(k)
@@ -541,23 +643,25 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
         logits = jnp.where(mask, logits, -1e30)
         lse = jax.nn.logsumexp(logits, axis=-1)
         p = jnp.exp(logits - lse[..., None])
-        ctx = jnp.einsum("bhck,bkhd->bchd", p, vw).reshape(B, C, D)
-        x = x + ctx @ lp["wo"]
+        ctx = gather(jnp.einsum("bhck,bkhd->bchd", p, vw)
+                     .reshape(B, C, D // tp))
+        x = x + gather(ctx @ lp["wo"])
         f = ln(x, lp["ln2_s"], lp["ln2_b"])
         h = f @ lp["wup"]
         if "bup" in lp:
             h = h + lp["bup"]
         h = jnp.maximum(h, 0.0)
-        f2 = h @ lp["wdown"]
+        f2 = gather(h) @ lp["wdown"]
         if "bdown" in lp:
             f2 = f2 + lp["bdown"]
-        x = x + f2
+        x = x + gather(f2)
     xn = ln(x, params["lnf_s"], params["lnf_b"])
     last = jnp.maximum(valids - 1, 0)
     xl = xn[jnp.arange(B), last]  # [B, D] — each lane's last valid position
     head_logits = xl @ params["out_w"]
     if "out_b" in params:
         head_logits = head_logits + params["out_b"]
+    head_logits = gather(head_logits)
     next_tok = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
     return next_tok, head_logits, positions + valids, pool_k, pool_v
 

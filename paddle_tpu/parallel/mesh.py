@@ -47,6 +47,26 @@ def make_mesh(
     return Mesh(dev_array, tuple(axes))
 
 
+def serving_mesh(dp: int, tp: int,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 platform: Optional[str] = None) -> Mesh:
+    """The serving tier's flat ('dp', 'tp') mesh over the first dp*tp
+    addressable devices (serving/sharded.py builds its engines on this;
+    tier-1 runs it on the conftest-forced virtual CPU devices). Raises
+    with the XLA_FLAGS hint when the host exposes too few devices —
+    the one setup mistake everyone makes once."""
+    if devices is None:
+        devices = jax.devices(platform) if platform else jax.devices()
+    n = int(dp) * int(tp)
+    if n > len(devices):
+        raise ValueError(
+            f"serving mesh needs dp*tp = {n} devices, only "
+            f"{len(devices)} available (host meshes: set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N before "
+            f"jax initializes)")
+    return make_mesh({"dp": int(dp), "tp": int(tp)}, devices=devices[:n])
+
+
 def sharding_for(mesh: Mesh, *spec) -> NamedSharding:
     """NamedSharding helper: sharding_for(mesh, 'dp', None) etc."""
     return NamedSharding(mesh, PartitionSpec(*spec))

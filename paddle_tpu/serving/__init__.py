@@ -61,15 +61,24 @@ from .errors import (DeadlineExceeded, FleetOverloaded,  # noqa: F401
                      RetryBudgetExceeded, ServingError, ServingRejected,
                      ServingUnavailable, ShuttingDown, TenantQuotaExceeded)
 from .fleet import FleetRouter, LocalFleet, TokenBucket  # noqa: F401
+from .placement import (DeviceInventory, ModelProfile,  # noqa: F401
+                        NoFeasiblePlacement, PlacementPlan,
+                        PlacementSearcher, TrafficProfile, profile_export)
 from .server import ServingClient, ServingServer  # noqa: F401
+from .sharded import (ShardedDecodeEngine,  # noqa: F401
+                      ShardedServingEngine, expected_collectives)
 from .stats import FleetStats, ServingStats  # noqa: F401
 
 __all__ = [
-    "ChaosInjector", "DeadlineExceeded", "DecodeEngine", "FleetChaos",
-    "FleetOverloaded", "FleetRouter", "FleetStats", "GenerationBatcher",
-    "GenerationResult", "InjectedFault", "LoadShedError", "LocalFleet",
-    "MicroBatcher", "NoHealthyReplicas", "QueueFullError",
-    "RetryBudgetExceeded", "ServingClient", "ServingEngine", "ServingError",
-    "ServingRejected", "ServingServer", "ServingStats", "ServingUnavailable",
-    "ShuttingDown", "SlotScheduler", "TenantQuotaExceeded", "TokenBucket",
+    "ChaosInjector", "DeadlineExceeded", "DecodeEngine", "DeviceInventory",
+    "FleetChaos", "FleetOverloaded", "FleetRouter", "FleetStats",
+    "GenerationBatcher", "GenerationResult", "InjectedFault",
+    "LoadShedError", "LocalFleet", "MicroBatcher", "ModelProfile",
+    "NoFeasiblePlacement", "NoHealthyReplicas", "PlacementPlan",
+    "PlacementSearcher", "QueueFullError", "RetryBudgetExceeded",
+    "ServingClient", "ServingEngine", "ServingError", "ServingRejected",
+    "ServingServer", "ServingStats", "ServingUnavailable",
+    "ShardedDecodeEngine", "ShardedServingEngine", "ShuttingDown",
+    "SlotScheduler", "TenantQuotaExceeded", "TokenBucket",
+    "TrafficProfile", "expected_collectives", "profile_export",
 ]

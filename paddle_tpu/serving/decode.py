@@ -156,9 +156,7 @@ class DecodeEngine:
         self.cache_capacity = int(cache_capacity)
 
         self._lock = threading.RLock()  # params snapshot + cache counters
-        with jax.default_device(self._device):
-            self._params = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, self._device), host_params)
+        self._params = self._device_put_params(host_params)
         self.params_version = 1
         self.chaos = None  # optional ChaosInjector (on_dispatch hook)
 
@@ -166,14 +164,31 @@ class DecodeEngine:
         Dh = self.cfg["d_model"] // H
         self._pool_shape = (L, self.max_slots + 1, self.max_len, H, Dh)
         self.trash_slot = self.max_slots
-        with jax.default_device(self._device):
-            self.pool_k = jax.numpy.zeros(self._pool_shape, jax.numpy.float32)
-            self.pool_v = jax.numpy.zeros(self._pool_shape, jax.numpy.float32)
+        self.pool_k, self.pool_v = self._alloc_pools()
         self._free: List[int] = list(range(self.max_slots))
         self._cache: "OrderedDict[Tuple[int, int, int], _ChunkEntry]" = \
             OrderedDict()
         self.cache_hits = 0
         self.cache_misses = 0
+
+    # -- placement hooks (serving/sharded.py overrides both) --
+    def _device_put_params(self, host_params):
+        """Host pytree -> device-resident pytree. The sharded engine
+        overrides this with per-leaf NamedShardings (column layout)."""
+        import jax
+
+        with jax.default_device(self._device):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._device), host_params)
+
+    def _alloc_pools(self):
+        """Fresh zeroed (pool_k, pool_v). The sharded engine overrides
+        this to shard the pools along the heads axis."""
+        import jax
+
+        with jax.default_device(self._device):
+            return (jax.numpy.zeros(self._pool_shape, jax.numpy.float32),
+                    jax.numpy.zeros(self._pool_shape, jax.numpy.float32))
 
     # -- slots --
     @property
@@ -207,11 +222,20 @@ class DecodeEngine:
         return round_up(length, self.kv_buckets)
 
     # -- compile cache --
-    def _get_fn(self, lanes: int, chunk: int, window: int) -> _ChunkEntry:
+    def _make_chunk_fn(self, lanes: int, chunk: int, window: int):
+        """One fresh jit wrapper for a (lanes, chunk, window) signature
+        (eviction drops the executable). The sharded engine overrides
+        this with its shard_map-wrapped chunk (serving/sharded.py); the
+        LRU/counter machinery in ``_get_fn`` is shared."""
         import jax
 
         from ..models.transformer import decode_forward_chunk
 
+        return jax.jit(functools.partial(decode_forward_chunk, cfg=self.cfg,
+                                         window=window),
+                       donate_argnums=(1, 2))
+
+    def _get_fn(self, lanes: int, chunk: int, window: int) -> _ChunkEntry:
         key = (lanes, chunk, window)
         with self._lock:
             entry = self._cache.get(key)
@@ -220,10 +244,7 @@ class DecodeEngine:
                 self._cache.move_to_end(key)
                 return entry
             self.cache_misses += 1
-        fn = jax.jit(functools.partial(decode_forward_chunk, cfg=self.cfg,
-                                       window=window),
-                     donate_argnums=(1, 2))
-        entry = _ChunkEntry(fn)
+        entry = _ChunkEntry(self._make_chunk_fn(lanes, chunk, window))
         with self._lock:
             entry = self._cache.setdefault(key, entry)
             while len(self._cache) > self.cache_capacity:
@@ -334,21 +355,13 @@ class DecodeEngine:
     def reset_pool(self) -> None:
         """Zero the KV pool (tests / warmup hygiene; slot ownership is the
         real isolation — stale bytes are never attended)."""
-        import jax
-
-        with jax.default_device(self._device):
-            self.pool_k = jax.numpy.zeros(self._pool_shape,
-                                          jax.numpy.float32)
-            self.pool_v = jax.numpy.zeros(self._pool_shape,
-                                          jax.numpy.float32)
+        self.pool_k, self.pool_v = self._alloc_pools()
 
     # -- hot weight reload --
     def stage_params(self, dirname: str) -> Dict[str, Any]:
         """Load + validate a re-exported dir against the frozen decode
         roles WITHOUT touching the live params (the slow half of a reload;
         safe while generations run). Returns the staged device pytree."""
-        import jax
-
         from .. import io as model_io
         from ..core.executor import Scope
         from ..models.transformer import decode_params_from_scope, \
@@ -380,9 +393,7 @@ class DecodeEngine:
                     f"reload {dirname!r}: param {path} shape/dtype mismatch "
                     f"({tuple(new.shape)}/{np.dtype(new.dtype)} vs frozen "
                     f"{tuple(old.shape)}/{np.dtype(old.dtype)})")
-        with jax.default_device(self._device):
-            return jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, self._device), staged)
+        return self._device_put_params(staged)
 
     def commit_params(self, staged: Dict[str, Any]) -> int:
         """One reference store; every later dispatch snapshots the new

@@ -173,15 +173,8 @@ class ServingEngine:
                 f"({self._state_out_names}); padding/coalescing would corrupt "
                 f"it — export with save_inference_model from a "
                 f"clone(for_test) program")
-        self._params: Dict[str, Any] = {}
+        self._params = self._load_params()
         with jax.default_device(self._device):
-            for n in list(self._readonly_names) + list(self._donated_names):
-                v = self.scope.get(n)
-                if v is None:
-                    raise RuntimeError(
-                        f"exported model {dirname!r}: state var {n!r} has no "
-                        f"saved value — export with the scope that holds it")
-                self._params[n] = jax.device_put(np.asarray(v), self._device)
             self._key = jax.random.PRNGKey(0)
 
         self._lock = threading.RLock()
@@ -190,6 +183,25 @@ class ServingEngine:
         self.cache_misses = 0
         self.params_version = 1  # bumped by every successful reload_params
         self.chaos = None  # optional ChaosInjector (dispatch hooks)
+
+    def _load_params(self) -> Dict[str, Any]:
+        """Scope -> device-resident serving params, all on ONE device.
+        The sharded engine (serving/sharded.py) overrides this to place
+        column shards across its mesh instead — a model bigger than one
+        chip's HBM must never be staged whole on one device."""
+        import jax
+
+        params: Dict[str, Any] = {}
+        with jax.default_device(self._device):
+            for n in list(self._readonly_names) + list(self._donated_names):
+                v = self.scope.get(n)
+                if v is None:
+                    raise RuntimeError(
+                        f"exported model {self.dirname!r}: state var {n!r} "
+                        f"has no saved value — export with the scope that "
+                        f"holds it")
+                params[n] = jax.device_put(np.asarray(v), self._device)
+        return params
 
     # -- bucketing --
     def bucket_batch(self, rows: int) -> int:
@@ -284,9 +296,15 @@ class ServingEngine:
         except Exception:
             return None, None
 
-    def _get_fn(self, sig: Tuple) -> "_CacheEntry":
+    def _make_fn(self, sig: Tuple):
+        """One fresh jit wrapper for a bucket signature (eviction drops
+        the executable). The sharded engine overrides this with its
+        shard_map-wrapped step (serving/sharded.py)."""
         import jax
 
+        return jax.jit(self._step)
+
+    def _get_fn(self, sig: Tuple) -> "_CacheEntry":
         from ..obs import get_tracer
 
         with self._lock:
@@ -299,8 +317,7 @@ class ServingEngine:
         # build + annotate OUTSIDE the lock: the cost lowering traces the
         # whole step; a cold bucket must not stall cache_info() (stats RPC)
         t0 = time.monotonic()
-        # one jit wrapper per signature: eviction drops the executable
-        fn = jax.jit(self._step)
+        fn = self._make_fn(sig)
         flops, nbytes = self._annotate_cost(fn, sig)
         lower_s = time.monotonic() - t0
         tr = get_tracer()

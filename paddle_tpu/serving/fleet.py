@@ -97,6 +97,12 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
         "pipeline_depth": g.get("pt_serving_pipeline_depth", 1.0),
         "healthy": g.get("pt_serving_healthy", 1.0),
         "mfu": g.get("pt_serving_mfu", 0.0),
+        # shards: devices ONE model spans (serving/sharded.py). The mfu
+        # gauge above is already aggregated across them (ServingStats
+        # scales its denominator by shard count), so routing reads a
+        # replica's true utilization, not shard 0's; the router's
+        # capacity math can weight a sharded replica by its device count.
+        "shards": g.get("pt_serving_shard_count", 1.0),
         "weights_version": g.get("pt_serving_weights_version",
                                  float(hz.get("weights_version", 0))),
     }
@@ -308,6 +314,7 @@ class ReplicaHandle:
                 "queue_capacity": m.get("queue_capacity"),
                 "occupancy": m.get("occupancy"),
                 "mfu": m.get("mfu"),
+                "shards": int(m.get("shards") or 1),
                 "weights_version": m.get("weights_version")}
 
 

@@ -1,0 +1,549 @@
+"""Parallelism placement search for sharded serving (docs/design.md §18).
+
+``ServingEngine`` runs one frozen program on one chip; per-chip QPS stops
+scaling the moment a model saturates — or outgrows — one chip's HBM. This
+module decides HOW to spread one model over a TPU mesh the way the repo
+decides everything perf-shaped: **exhaustive search under an analytic cost
+model** (the ``plan_blocks`` / ``SlotScheduler`` discipline; PAPERS.md
+arXiv 2110.10548 "Synthesizing Optimal Parallelism Placement and Reduction
+Strategies on Hierarchical Systems" is the placement-specific argument
+that layouts should be searched, not hand-picked).
+
+Inputs:
+
+* ``ModelProfile`` — what the model costs: recovered from an exported
+  inference dir by WALKING ITS IR (``models/transformer.decode_roles`` —
+  the same walk the decode export uses), so the byte/FLOP accounting
+  describes the program that will actually serve. Per-role param bytes
+  split into *shardable* (matmul weights: column-sharded 1/tp per device)
+  and *replicated* (layer norms, the position table); analytic fwd
+  FLOPs/token; optionally the XLA cost-analysis FLOPs/bytes of the real
+  lowered step (``obs/cost.analyze_jit``) as a cross-check the cost model
+  carries in its output.
+* ``DeviceInventory`` — what a chip offers: HBM bytes, peak FLOP/s, HBM
+  bandwidth, inter-chip link bandwidth, per-collective latency. Synthetic
+  inventories drive the searcher unit tests; ``DeviceInventory.tpu_v5e``
+  is the bench default.
+* ``TrafficProfile`` — what arrives: a batch-size mix (weights over
+  request row counts — ``from_stats`` derives one from a live
+  ``ServingStats``), the serve sequence length, and the fixed p95 budget
+  the QPS/chip curve is evaluated at.
+
+The searcher enumerates every (dp, tp) split (dp a power of two — the
+batch-bucket ladder is powers of two, so any other dp only pads; tp a
+divisor of heads/d_model/d_ff/vocab — the column layout must split
+evenly), scores each against the comm/compute/latency model below, and
+returns a ``PlacementPlan`` that ``serving/sharded.ShardedServingEngine``
+executes directly. Plans are DETERMINISTIC: pure arithmetic over sorted
+candidates with a total tie-break order — the same inputs always pick the
+same plan (tested).
+
+Cost model (per dispatch of ``b`` requested rows; 4-byte f32 serving)::
+
+    b_loc      = ceil(b / dp)                      rows per dp rank
+    compute_s  = flops_fwd(b_loc) / tp / peak_flops
+    hbm_s      = (param_bytes_per_dev + act_bytes) / hbm_bw
+    device_s   = max(compute_s, hbm_s)             per-shard roofline
+    comm_s     = n_coll * alpha                    collective launch cost
+               + gather_bytes * (tp-1)/tp / link_bw   ring all-gather
+    step_s     = device_s + comm_s
+
+with the collective schedule fixed by the bit-safe column layout
+(``models/transformer.predict_forward``): ``n_coll = 4*L + 2`` all-gathers
+when tp > 1 (emb, per layer: attention context / attention out / FFN
+hidden / FFN out, head), zero when tp = 1 — data-parallel serving needs no
+collectives at all. ``gather_bytes`` is exact, not estimated: the sum of
+the gathered activation sizes. Predicted p95 = 2 * step_s of the p95
+batch bucket (one batch in service + one in the depth-2 dispatch
+pipeline); predicted QPS = weighted rows / weighted step seconds; the
+headline score is **QPS per chip at fixed p95** — a plan that doubles
+chips must better-than-double nothing, it must hold QPS/chip.
+
+Feasibility is a hard gate, not a score term: a plan whose per-device
+bytes (params/tp + activations + the decode KV pool's head shard when
+decode traffic is profiled) exceed modeled HBM is *rejected* with the
+reason recorded — for a model whose parameter bytes exceed one chip's
+HBM, every tp=1 plan is infeasible and the searcher proves the model
+must-shard (tested; the chosen plan is executable on a real mesh).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+GIB = 1024 ** 3
+
+
+class NoFeasiblePlacement(ValueError):
+    """No enumerated (dp, tp) split fits the device inventory. Carries the
+    per-candidate rejection reasons so the operator sees WHY (typically:
+    param bytes exceed HBM at every allowed tp)."""
+
+    def __init__(self, reasons: Dict[Tuple[int, int], str]):
+        self.reasons = dict(reasons)
+        detail = "; ".join(f"dp={d} tp={t}: {r}"
+                           for (d, t), r in sorted(reasons.items()))
+        super().__init__(f"no feasible placement — {detail or 'no candidates'}")
+
+
+class DeviceInventory:
+    """One chip class + how many of them (homogeneous — the mesh the
+    serving tier builds is flat)."""
+
+    __slots__ = ("n_devices", "hbm_bytes", "peak_flops", "hbm_bw",
+                 "link_bw", "alpha_s", "name")
+
+    def __init__(self, n_devices: int, hbm_gb: float = 16.0,
+                 peak_tflops: float = 197.0, hbm_gbps: float = 820.0,
+                 link_gbps: float = 45.0, alpha_us: float = 1.0,
+                 name: str = "custom"):
+        if n_devices < 1:
+            raise ValueError("inventory needs at least one device")
+        self.n_devices = int(n_devices)
+        self.hbm_bytes = float(hbm_gb) * GIB
+        self.peak_flops = float(peak_tflops) * 1e12
+        self.hbm_bw = float(hbm_gbps) * 1e9
+        self.link_bw = float(link_gbps) * 1e9
+        self.alpha_s = float(alpha_us) * 1e-6
+        self.name = name
+
+    @classmethod
+    def tpu_v5e(cls, n_devices: int) -> "DeviceInventory":
+        """bench.py's chip nominal: 197 TFLOP/s bf16, 16 GB HBM @ 820
+        GB/s, ~45 GB/s per ICI link."""
+        return cls(n_devices, hbm_gb=16.0, peak_tflops=197.0,
+                   hbm_gbps=820.0, link_gbps=45.0, name="tpu_v5e")
+
+    @classmethod
+    def host(cls, n_devices: int, peak_gflops: float = 50.0,
+             hbm_gb: float = 4.0) -> "DeviceInventory":
+        """A deliberately humble CPU-host inventory for predicted-vs-
+        measured sanity on the tier-1 mesh (tools/perf_lab.py calibrates
+        ``peak_gflops`` from a probe matmul before using it)."""
+        return cls(n_devices, hbm_gb=hbm_gb, peak_tflops=peak_gflops / 1e3,
+                   hbm_gbps=20.0, link_gbps=10.0, alpha_us=20.0,
+                   name="host")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "n_devices": self.n_devices,
+                "hbm_gb": self.hbm_bytes / GIB,
+                "peak_tflops": self.peak_flops / 1e12,
+                "hbm_gbps": self.hbm_bw / 1e9,
+                "link_gbps": self.link_bw / 1e9}
+
+
+class TrafficProfile:
+    """Batch-size mix + serve length + the fixed p95 the curve holds.
+
+    ``batch_mix`` is ``[(rows, weight)]``; weights need not sum to 1.
+    ``decode_slots > 0`` adds the decode KV pool's per-device head shard
+    to the HBM account (the pool rides the same tp split)."""
+
+    __slots__ = ("batch_mix", "seq_len", "p95_budget_ms", "decode_slots")
+
+    def __init__(self, batch_mix: Sequence[Tuple[int, float]],
+                 seq_len: Optional[int] = None,
+                 p95_budget_ms: Optional[float] = None,
+                 decode_slots: int = 0):
+        mix = [(int(b), float(w)) for b, w in batch_mix if w > 0]
+        if not mix or any(b < 1 for b, _ in mix):
+            raise ValueError(f"batch_mix needs positive rows/weights: "
+                             f"{batch_mix!r}")
+        self.batch_mix = sorted(mix)
+        self.seq_len = seq_len
+        self.p95_budget_ms = p95_budget_ms
+        self.decode_slots = int(decode_slots)
+
+    @classmethod
+    def from_stats(cls, stats, seq_len: Optional[int] = None,
+                   p95_budget_ms: Optional[float] = None) -> "TrafficProfile":
+        """Derive the mix from a live ``ServingStats``: the observed mean
+        batch fill is the one number the stats tier retains about batch
+        shape (per-dispatch row histograms would be another instrument);
+        a cold server defaults to single-row traffic."""
+        rows = getattr(stats, "rows", 0)
+        batches = getattr(stats, "batches", 0)
+        avg = max(1, int(round(rows / batches))) if batches else 1
+        return cls([(avg, 1.0)], seq_len=seq_len,
+                   p95_budget_ms=p95_budget_ms)
+
+    def p95_rows(self) -> int:
+        """The batch bucket whose step time the p95 budget constrains:
+        the smallest rows value covering >= 95% of the weight."""
+        total = sum(w for _, w in self.batch_mix)
+        acc = 0.0
+        for b, w in self.batch_mix:
+            acc += w
+            if acc >= 0.95 * total:
+                return b
+        return self.batch_mix[-1][0]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"batch_mix": list(self.batch_mix), "seq_len": self.seq_len,
+                "p95_budget_ms": self.p95_budget_ms,
+                "decode_slots": self.decode_slots}
+
+
+#: decode-style param-pytree roles whose matmul weights column-shard 1/tp
+#: per device (everything else — layer norms, the position table —
+#: replicates). Biases ride their matmul's columns.
+SHARDED_ROLES = ("emb", "out_w", "out_b", "wq", "wk", "wv", "wqkv", "wo",
+                 "wup", "bup", "wdown", "bdown")
+REPLICATED_ROLES = ("pos", "lnf_s", "lnf_b", "ln1_s", "ln1_b", "ln2_s",
+                    "ln2_b")
+
+
+class ModelProfile:
+    """Byte/FLOP account of one exported transformer LM.
+
+    ``bytes_sharded`` / ``bytes_replicated`` partition the param set by
+    SHARDED_ROLES; ``flops_fwd(rows, seq)`` is the analytic fwd FLOPs of
+    one dispatch (matmul 2*N + causal attention term — the serving
+    sibling of bench.py's ``lm_flops_per_token``). ``xla_flops`` /
+    ``xla_bytes``, when present, are the XLA cost analysis of the real
+    lowered step at the reference batch (obs/cost.py) — carried through
+    to the plan as a cross-check on the analytic numbers."""
+
+    __slots__ = ("cfg", "bytes_sharded", "bytes_replicated", "dtype_bytes",
+                 "xla_flops", "xla_bytes", "xla_rows", "source")
+
+    def __init__(self, cfg: Dict[str, Any], bytes_sharded: float,
+                 bytes_replicated: float, dtype_bytes: int = 4,
+                 xla_flops: Optional[float] = None,
+                 xla_bytes: Optional[float] = None,
+                 xla_rows: Optional[int] = None, source: str = "synthetic"):
+        self.cfg = dict(cfg)
+        self.bytes_sharded = float(bytes_sharded)
+        self.bytes_replicated = float(bytes_replicated)
+        self.dtype_bytes = int(dtype_bytes)
+        self.xla_flops = xla_flops
+        self.xla_bytes = xla_bytes
+        self.xla_rows = xla_rows
+        self.source = source
+
+    @classmethod
+    def synthetic(cls, n_layers: int, n_heads: int, d_model: int,
+                  d_ff: int, vocab: int, max_len: int,
+                  dtype_bytes: int = 4) -> "ModelProfile":
+        """Analytic profile from the architecture alone — the searcher
+        unit tests and the perf_lab sweep grid run on these."""
+        D, FF, V = d_model, d_ff, vocab
+        sharded = V * D + n_layers * (4 * D * D + 2 * D * FF + FF + D) \
+            + D * V + V
+        replicated = max_len * D + (2 * n_layers * 2 + 2) * D
+        cfg = {"n_layers": n_layers, "n_heads": n_heads, "d_model": D,
+               "d_ff": FF, "vocab": V, "max_len": max_len, "eps": 1e-5}
+        return cls(cfg, sharded * dtype_bytes, replicated * dtype_bytes,
+                   dtype_bytes=dtype_bytes)
+
+    @property
+    def param_bytes(self) -> float:
+        return self.bytes_sharded + self.bytes_replicated
+
+    def flops_fwd(self, rows: int, seq: Optional[int] = None) -> float:
+        """Analytic forward FLOPs of one dispatch of ``rows`` x ``seq``."""
+        c = self.cfg
+        t = int(seq or c["max_len"])
+        D, FF, V, L = c["d_model"], c["d_ff"], c["vocab"], c["n_layers"]
+        n_mat = L * (4 * D * D + 2 * D * FF) + D * V
+        per_token = 2 * n_mat + 2 * L * D * t  # causal attention ~t/2 * 2
+        return float(rows) * t * per_token
+
+    def max_tp(self, limit: int) -> List[int]:
+        """tp candidates: divisors of heads AND every column extent the
+        layout splits (d_model, d_ff, vocab), capped at ``limit``."""
+        c = self.cfg
+        return [t for t in range(1, min(limit, c["n_heads"]) + 1)
+                if c["n_heads"] % t == 0 and c["d_model"] % t == 0
+                and c["d_ff"] % t == 0 and c["vocab"] % t == 0]
+
+    def gather_bytes(self, rows: int, seq: Optional[int] = None) -> float:
+        """Exact bytes all-gathered per dispatch under the column layout
+        (the collective schedule of predict_forward): emb [rows,T,D] +
+        per layer ctx/attn_out [rows,T,D] x2 + FFN hidden [rows,T,FF] +
+        FFN out [rows,T,D] + head [rows,T,V]."""
+        c = self.cfg
+        t = int(seq or c["max_len"])
+        per_row = t * (c["d_model"]
+                       + c["n_layers"] * (3 * c["d_model"] + c["d_ff"])
+                       + c["vocab"])
+        return float(rows) * per_row * self.dtype_bytes
+
+    def collectives_per_dispatch(self, tp: int) -> int:
+        return 0 if tp <= 1 else 4 * self.cfg["n_layers"] + 2
+
+    def decode_pool_bytes(self, slots: int) -> float:
+        """K+V pool bytes (full, pre-split): [L, slots+1, max_len, H, Dh]
+        f32 each (serving/decode.py's pool shape)."""
+        c = self.cfg
+        return 2.0 * 4 * c["n_layers"] * (slots + 1) * c["max_len"] \
+            * c["d_model"]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"cfg": dict(self.cfg), "source": self.source,
+                "param_bytes": self.param_bytes,
+                "bytes_sharded": self.bytes_sharded,
+                "bytes_replicated": self.bytes_replicated,
+                "xla_flops": self.xla_flops, "xla_bytes": self.xla_bytes}
+
+
+def profile_export(dirname: str, xla_cost: bool = True) -> ModelProfile:
+    """Walk an exported inference dir into a ``ModelProfile``.
+
+    The architecture comes from ``decode_roles`` (the IR walk — one
+    source of truth with the decode export); byte counts are the ACTUAL
+    saved arrays' nbytes bucketed by role, so quantized or oddly-shaped
+    exports account honestly. With ``xla_cost`` the real step is lowered
+    once at batch 1 and annotated with XLA's own cost analysis
+    (obs/cost.analyze_jit — never raises; a failed analysis leaves the
+    analytic numbers)."""
+    from .. import io as model_io
+    from ..core.executor import Scope
+    from ..models.transformer import decode_params_from_scope, decode_roles
+
+    scope = Scope()
+    program, feed_names, fetch_names = model_io.load_inference_model(
+        dirname, None, scope=scope)
+    roles, cfg = decode_roles(program)
+    params = decode_params_from_scope(roles, scope)
+
+    sharded = repl = 0.0
+
+    def account(role, arr):
+        nonlocal sharded, repl
+        if role in SHARDED_ROLES:
+            sharded += arr.nbytes
+        else:
+            repl += arr.nbytes
+
+    for role, v in params.items():
+        if role == "layers":
+            for lp in v:
+                for r, arr in lp.items():
+                    account(r, arr)
+        else:
+            account(role, v)
+
+    dtype_bytes = int(params["out_w"].dtype.itemsize)
+    prof = ModelProfile(cfg, sharded, repl, dtype_bytes=dtype_bytes,
+                        source=dirname)
+    if xla_cost:
+        try:
+            import numpy as np
+
+            from ..core.executor import build_step_fn
+            from ..obs import cost as obs_cost
+
+            step, ro_names, don_names, _state = build_step_fn(
+                program, 0, list(feed_names), list(fetch_names))
+            feed_avals = {
+                n: obs_cost.abstractify(
+                    np.zeros((1, cfg["max_len"]), np.int32))
+                for n in feed_names}
+            ro = {n: obs_cost.abstractify(np.asarray(scope.get(n)))
+                  for n in ro_names}
+            don = {n: obs_cost.abstractify(np.asarray(scope.get(n)))
+                   for n in don_names}
+            key = obs_cost.abstractify(np.zeros((2,), np.uint32))
+            res = obs_cost.analyze_jit(step, feed_avals, ro, don, key)
+            prof.xla_flops = res["flops"]
+            prof.xla_bytes = res["bytes"]
+            prof.xla_rows = 1
+        except Exception:
+            pass  # analytic numbers stand alone
+    return prof
+
+
+class PlacementPlan:
+    """One scored (dp, tp) split — everything the executor and the
+    operator need: the split itself, the per-device HBM account, the
+    collective schedule, and the predicted step/latency/QPS numbers that
+    chose it."""
+
+    __slots__ = ("dp", "tp", "feasible", "reason", "param_bytes_per_device",
+                 "hbm_bytes_per_device", "hbm_fraction",
+                 "collective_bytes_per_step", "collectives_per_dispatch",
+                 "comm_s", "compute_s", "hbm_s", "step_s",
+                 "predicted_p95_ms", "predicted_qps",
+                 "predicted_qps_per_chip", "inventory", "traffic")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    @property
+    def devices(self) -> int:
+        return self.dp * self.tp
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {k: getattr(self, k) for k in self.__slots__
+             if k not in ("inventory", "traffic")}
+        d["devices"] = self.devices
+        if self.inventory is not None:
+            d["inventory"] = self.inventory.as_dict()
+        if self.traffic is not None:
+            d["traffic"] = self.traffic.as_dict()
+        return d
+
+    def __repr__(self):
+        if not self.feasible:
+            return (f"PlacementPlan(dp={self.dp}, tp={self.tp}, "
+                    f"INFEASIBLE: {self.reason})")
+        return (f"PlacementPlan(dp={self.dp}, tp={self.tp}, "
+                f"hbm/dev={self.hbm_bytes_per_device / GIB:.2f}GiB, "
+                f"qps/chip={self.predicted_qps_per_chip:.1f} "
+                f"@p95={self.predicted_p95_ms:.2f}ms)")
+
+
+class PlacementSearcher:
+    """Exhaustive (dp, tp) enumeration under the §18 cost model."""
+
+    def __init__(self, profile: ModelProfile, inventory: DeviceInventory,
+                 traffic: TrafficProfile):
+        self.profile = profile
+        self.inventory = inventory
+        self.traffic = traffic
+
+    # -- the cost model --
+    def score(self, dp: int, tp: int) -> PlacementPlan:
+        """Score one split (always returns a plan; infeasible ones carry
+        the rejection reason instead of QPS)."""
+        prof, inv, tr = self.profile, self.inventory, self.traffic
+        seq = tr.seq_len or prof.cfg["max_len"]
+        per_dev_params = prof.bytes_replicated + prof.bytes_sharded / tp
+
+        def act_bytes(b_loc: int) -> float:
+            # dominant transients of one dispatch: residual stream +
+            # per-layer working set, the FFN hidden and the head logits
+            # riding their column shards
+            c = prof.cfg
+            return 4.0 * b_loc * seq * (
+                4 * c["d_model"] + c["d_ff"] / tp + c["vocab"] / tp)
+
+        def step(b: int) -> Tuple[float, float, float, float]:
+            b_loc = math.ceil(b / dp)
+            compute_s = prof.flops_fwd(b_loc, seq) / tp / inv.peak_flops
+            hbm_s = (per_dev_params + act_bytes(b_loc)) / inv.hbm_bw
+            if tp > 1:
+                n_coll = prof.collectives_per_dispatch(tp)
+                comm_s = n_coll * inv.alpha_s + \
+                    prof.gather_bytes(b_loc, seq) * (tp - 1) / tp / inv.link_bw
+            else:
+                comm_s = 0.0
+            return (max(compute_s, hbm_s) + comm_s, compute_s, hbm_s,
+                    comm_s)
+
+        pool = prof.decode_pool_bytes(tr.decode_slots) / tp \
+            if tr.decode_slots else 0.0
+        peak_b_loc = math.ceil(max(b for b, _ in tr.batch_mix) / dp)
+        hbm_per_dev = per_dev_params + act_bytes(peak_b_loc) + pool
+        plan = PlacementPlan(
+            dp=dp, tp=tp, inventory=inv, traffic=tr,
+            param_bytes_per_device=per_dev_params,
+            hbm_bytes_per_device=hbm_per_dev,
+            hbm_fraction=hbm_per_dev / inv.hbm_bytes,
+            collectives_per_dispatch=prof.collectives_per_dispatch(tp),
+            collective_bytes_per_step=(
+                prof.gather_bytes(peak_b_loc, seq) * (tp - 1) / tp
+                if tp > 1 else 0.0),
+        )
+        if hbm_per_dev > inv.hbm_bytes:
+            plan.feasible = False
+            plan.reason = (f"per-device bytes {hbm_per_dev / GIB:.2f} GiB "
+                           f"exceed modeled HBM "
+                           f"{inv.hbm_bytes / GIB:.2f} GiB")
+            return plan
+        p95_step, comp, hbm_s, comm = step(tr.p95_rows())
+        p95_ms = 2.0 * p95_step * 1e3  # one in service + one pipelined
+        if tr.p95_budget_ms is not None and p95_ms > tr.p95_budget_ms:
+            plan.feasible = False
+            plan.reason = (f"predicted p95 {p95_ms:.2f} ms exceeds the "
+                           f"{tr.p95_budget_ms:.2f} ms budget")
+            return plan
+        w_rows = sum(b * w for b, w in tr.batch_mix)
+        w_secs = sum(step(b)[0] * w for b, w in tr.batch_mix)
+        qps = w_rows / w_secs
+        plan.feasible = True
+        plan.compute_s, plan.hbm_s, plan.comm_s = comp, hbm_s, comm
+        plan.step_s = p95_step
+        plan.predicted_p95_ms = p95_ms
+        plan.predicted_qps = qps
+        plan.predicted_qps_per_chip = qps / (dp * tp)
+        return plan
+
+    def candidates(self, max_devices: Optional[int] = None
+                   ) -> List[Tuple[int, int]]:
+        n = min(self.inventory.n_devices,
+                max_devices or self.inventory.n_devices)
+        dps = []
+        d = 1
+        while d <= n:
+            dps.append(d)
+            d *= 2
+        out = [(dp, tp) for tp in self.profile.max_tp(n) for dp in dps
+               if dp * tp <= n]
+        return sorted(out)
+
+    def all_plans(self, max_devices: Optional[int] = None
+                  ) -> List[PlacementPlan]:
+        return [self.score(dp, tp)
+                for dp, tp in self.candidates(max_devices)]
+
+    def search(self, max_devices: Optional[int] = None) -> PlacementPlan:
+        """The best feasible plan: max QPS/chip at the fixed p95; ties
+        break toward fewer devices, then higher dp (dp needs no
+        collectives), then lower tp — a total order, so the choice is
+        deterministic for fixed inputs."""
+        best, reasons = None, {}
+        for plan in self.all_plans(max_devices):
+            if not plan.feasible:
+                reasons[(plan.dp, plan.tp)] = plan.reason
+                continue
+            key = (-plan.predicted_qps_per_chip, plan.devices, -plan.dp,
+                   plan.tp)
+            if best is None or key < best[0]:
+                best = (key, plan)
+        if best is None:
+            raise NoFeasiblePlacement(reasons)
+        return best[1]
+
+    def qps_per_chip_curve(self) -> List[Dict[str, Any]]:
+        """Predicted QPS/chip at the fixed p95 for 1..N chips — the
+        scaling story the bench record carries. Infeasible chip counts
+        (the must-shard regime below the minimum tp) report null."""
+        out = []
+        for n in range(1, self.inventory.n_devices + 1):
+            try:
+                p = self.search(max_devices=n)
+                out.append({"chips": n, "dp": p.dp, "tp": p.tp,
+                            "qps_per_chip": p.predicted_qps_per_chip,
+                            "p95_ms": p.predicted_p95_ms})
+            except NoFeasiblePlacement:
+                out.append({"chips": n, "dp": None, "tp": None,
+                            "qps_per_chip": None, "p95_ms": None})
+        return out
+
+
+def plan_table(plans: Sequence[PlacementPlan]) -> str:
+    """Fixed-width table of scored plans (paddle_cli placement / perf_lab
+    placement both print through here — one format)."""
+    lines = [f"{'dp':>4}{'tp':>4}{'chips':>6}{'hbm/dev':>10}{'fit':>6}"
+             f"{'step_ms':>9}{'p95_ms':>8}{'qps':>10}{'qps/chip':>10}"
+             f"{'comm_ms':>9}  status"]
+    for p in plans:
+        if p.feasible:
+            lines.append(
+                f"{p.dp:>4}{p.tp:>4}{p.devices:>6}"
+                f"{p.hbm_bytes_per_device / GIB:>9.2f}G"
+                f"{p.hbm_fraction:>6.0%}"
+                f"{p.step_s * 1e3:>9.3f}{p.predicted_p95_ms:>8.2f}"
+                f"{p.predicted_qps:>10.1f}{p.predicted_qps_per_chip:>10.1f}"
+                f"{p.comm_s * 1e3:>9.3f}  ok")
+        else:
+            lines.append(
+                f"{p.dp:>4}{p.tp:>4}{p.devices:>6}"
+                f"{p.hbm_bytes_per_device / GIB:>9.2f}G"
+                f"{p.hbm_fraction:>6.0%}"
+                f"{'-':>9}{'-':>8}{'-':>10}{'-':>10}{'-':>9}  "
+                f"INFEASIBLE: {p.reason}")
+    return "\n".join(lines)

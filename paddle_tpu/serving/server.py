@@ -278,13 +278,47 @@ class ServingServer(socketserver.ThreadingTCPServer):
                  health_window_s: float = 5.0,
                  shed_prob: Optional[float] = None, shed_seed: int = 0,
                  drain_timeout: float = 30.0, chaos=None,
-                 handle_signals: bool = False, decode=None,
+                 handle_signals: bool = False, decode=None, mesh=None,
                  **engine_kwargs):
         super().__init__((host, port), _Handler)
         self.batcher = None
         self.decode_engine = None
         self.gen_batcher = None
         try:
+            # mesh (docs/design.md §18): span ONE model over dp*tp devices.
+            # int N = {"dp": 1, "tp": N} (the one-model-across-N-chips
+            # headline); a dict names both axes; a PlacementPlan carries a
+            # searcher choice (its dp/tp are used and the plan rides the
+            # engine for comm attribution).
+            self.mesh_spec = None
+            if mesh is not None:
+                from .placement import PlacementPlan
+                from .sharded import ShardedServingEngine
+
+                plan = None
+                if isinstance(mesh, PlacementPlan):
+                    plan, mesh = mesh, {"dp": mesh.dp, "tp": mesh.tp}
+                if isinstance(mesh, int):
+                    mesh = {"dp": 1, "tp": mesh}
+                unknown = set(mesh) - {"dp", "tp"}
+                if unknown:
+                    raise ValueError(f"unknown mesh axes {sorted(unknown)} "
+                                     f"(serving meshes are dp x tp)")
+                self.mesh_spec = {"dp": int(mesh.get("dp", 1)),
+                                  "tp": int(mesh.get("tp", 1))}
+                if not isinstance(model, str):
+                    raise ValueError(
+                        "mesh= builds a ShardedServingEngine from the "
+                        "exported dir (pass the model dirname, or pass a "
+                        "prebuilt ShardedServingEngine without mesh=)")
+                self._mesh_model_dir = model
+                model = ShardedServingEngine(
+                    model, dp=self.mesh_spec["dp"],
+                    tp=self.mesh_spec["tp"], plan=plan,
+                    max_batch_size=engine_kwargs.pop("max_batch_size",
+                                                     None)
+                    or max_batch_size or 32, **engine_kwargs)
+                engine_kwargs = {}
             if isinstance(model, ServingEngine):
                 if engine_kwargs:
                     raise ValueError(
@@ -330,16 +364,27 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 if isinstance(decode, DecodeEngine):
                     self.decode_engine = decode
                 else:
-                    if not isinstance(model, str):
+                    decode_dir = model if isinstance(model, str) else \
+                        getattr(self, "_mesh_model_dir", None)
+                    if not isinstance(decode_dir, str):
                         raise ValueError(
                             "decode serving needs the exported dir (pass "
                             "the model dirname, or decode=DecodeEngine)")
-                    self.decode_engine = DecodeEngine(
-                        model,
+                    dknobs = dict(
                         max_slots=dcfg.pop("max_slots", None),
                         max_len=dcfg.pop("max_len", None),
                         kv_buckets=dcfg.pop("kv_buckets", None),
                         prefill_chunk=dcfg.pop("prefill_chunk", None))
+                    if self.mesh_spec and self.mesh_spec["tp"] > 1:
+                        # decode rides the tp axis only: the slot pool IS
+                        # the batch; its dp story is fleet replicas (§18)
+                        from .sharded import ShardedDecodeEngine
+
+                        self.decode_engine = ShardedDecodeEngine(
+                            decode_dir, tp=self.mesh_spec["tp"], **dknobs)
+                    else:
+                        self.decode_engine = DecodeEngine(decode_dir,
+                                                          **dknobs)
                 self.gen_batcher = GenerationBatcher(
                     self.decode_engine,
                     queue_capacity=dcfg.pop("gen_queue_capacity",
@@ -360,6 +405,30 @@ class ServingServer(socketserver.ThreadingTCPServer):
             from ..obs import init_from_flags
 
             init_from_flags()
+            # sharded engine: the §18 shard plane — shard count scales the
+            # MFU denominator (gauges AGGREGATE across the mesh; a fleet
+            # router must not read shard 0 only), per-device HBM residency
+            # is published per shard, and the engine attributes its
+            # collective time into this stats object per dispatch
+            from .sharded import ShardedServingEngine as _Sharded
+
+            if isinstance(self.engine, _Sharded):
+                if self.mesh_spec is None:  # prebuilt sharded engine
+                    self.mesh_spec = {"dp": self.engine.dp,
+                                      "tp": self.engine.tp}
+                self.engine.stats = self.stats
+                if self.decode_engine is not None and \
+                        hasattr(self.decode_engine, "tp"):
+                    # the sharded decode engine attributes its own
+                    # gathers — a decode-only replica's collective
+                    # instruments must move too
+                    self.decode_engine.stats = self.stats
+                self.stats.set_shard_count(self.engine.dp * self.engine.tp)
+                plan = self.engine.plan
+                cap = plan.inventory.hbm_bytes if plan is not None and \
+                    plan.inventory is not None else None
+                self.stats.set_shard_hbm(self.engine.shard_hbm_bytes(),
+                                         capacity_bytes=cap)
             r = self.stats.registry
             r.gauge("pt_serving_queue_depth",
                     "Requests queued (incl. carry)",
@@ -489,6 +558,11 @@ class ServingServer(socketserver.ThreadingTCPServer):
              "queue_depth": self.batcher.queue_depth,
              "queue_capacity": self.batcher.queue_capacity,
              "weights_version": self.engine.params_version}
+        if self.mesh_spec is not None:
+            h["shards"] = {"dp": self.mesh_spec["dp"],
+                           "tp": self.mesh_spec["tp"],
+                           "devices": self.mesh_spec["dp"]
+                           * self.mesh_spec["tp"]}
         if self.gen_batcher is not None:
             h["decode"] = {
                 "max_slots": self.decode_engine.max_slots,
@@ -513,6 +587,12 @@ class ServingServer(socketserver.ThreadingTCPServer):
             "pipeline_depth": self.batcher.pipeline_depth,
             "in_flight": self.batcher.in_flight,
         }
+        if self.mesh_spec is not None:
+            extra["placement"] = {
+                "dp": self.mesh_spec["dp"], "tp": self.mesh_spec["tp"],
+                "collectives_per_dispatch":
+                    self.engine.expected_collectives_per_dispatch,
+                "shard_hbm_bytes": self.engine.shard_hbm_bytes()}
         if self.gen_batcher is not None:
             extra["decode_compile_cache"] = self.decode_engine.cache_info()
             extra["decode_queue_depth"] = self.gen_batcher.queue_depth

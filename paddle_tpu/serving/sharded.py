@@ -1,0 +1,506 @@
+"""Mesh-sharded serving: one model spanning N devices (docs/design.md §18).
+
+``ShardedServingEngine`` serves a ``transformer_lm`` inference export over
+a (dp, tp) device mesh; ``ShardedDecodeEngine`` shards the decode path's
+slot-pooled KV cache along heads so continuous batching survives sharding.
+Both are drop-in engines: the ``MicroBatcher`` / ``GenerationBatcher`` /
+``ServingServer`` stack above them is unchanged.
+
+Execution layout — the **bit-safe column layout**:
+
+* The architecture is RECOVERED from the exported IR program
+  (``models/transformer.decode_roles`` — the same walk the decode export
+  uses), never re-described by the caller; a non-transformer export is
+  refused loudly.
+* Every matmul weight is a COLUMN shard over the ``tp`` mesh axis — each
+  rank computes its slice of the output features with the FULL
+  contraction — and activations all-gather back to replicated at each
+  boundary. q/k/v columns are HEAD blocks, so attention (and the decode
+  KV pool) shards along heads and the flash kernel runs unchanged per
+  rank. Because no contraction dim is ever split and an all-gather is a
+  concatenation, per-element float math is IDENTICAL to the
+  single-device engine: predict logits and greedy decode streams are
+  bit-equal (tested at the lane-aligned shapes tier-1 pins; a fused
+  [D,3D] qkv weight is column-permuted at load so each rank's contiguous
+  slice is its own [q_r | k_r | v_r]).
+* ``dp`` splits batch rows via ``shard_map`` — no collectives at all on
+  the data axis (inference rows are independent). Batch buckets round up
+  to multiples of dp.
+* The collective schedule is therefore STATIC per compiled signature:
+  ``4 * n_layers + 2`` all-gathers when tp > 1, zero otherwise
+  (``expected_collectives``); ``measured_collectives`` counts all-gather
+  instructions in the compiled HLO, and bench.py bars on the two
+  agreeing — a regression that sneaks a reduce-scatter/psum into this
+  program (breaking bit-exactness) fails the round.
+
+The per-signature compile cache, warmup ladder, hot-reload
+stage/commit atomicity (ONE pytree reference swap — every dispatch runs
+wholly on one weights version across ALL shards), chaos hooks, and
+cache counters are inherited from the single-device engines.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .decode import DecodeEngine, _flat_items
+from .engine import ServingEngine
+
+#: decode-pytree leaves that column-shard over tp: {role: column axis}
+_COL_AXIS = {"emb": 1, "out_w": 1, "out_b": 0, "wq": 1, "wk": 1, "wv": 1,
+             "wqkv": 1, "wo": 1, "wup": 1, "bup": 0, "wdown": 1,
+             "bdown": 0}
+
+
+def expected_collectives(cfg: Dict[str, Any], tp: int) -> int:
+    """The column layout's static all-gather count per dispatch: emb +
+    (ctx, attn out, FFN hidden, FFN out) per layer + head."""
+    return 0 if tp <= 1 else 4 * int(cfg["n_layers"]) + 2
+
+
+def count_hlo_collectives(compiled_text: str) -> int:
+    """all-gather instructions in a compiled HLO dump (start/done pairs
+    count once). The deterministic per-dispatch collective contract is
+    judged against this."""
+    n = 0
+    for line in compiled_text.splitlines():
+        s = line.strip()
+        if "= " not in s:
+            continue
+        op = s.split("= ", 1)[1]
+        # strip the result type annotation: "f32[...] all-gather(...)"
+        if (" all-gather(" in op or op.startswith("all-gather(")
+                or " all-gather-start(" in op
+                or op.startswith("all-gather-start(")):
+            n += 1
+    return n
+
+
+def _permute_qkv_cols(w: np.ndarray, tp: int) -> np.ndarray:
+    """Reorder a fused [D, 3D] qkv weight's columns so each tp rank's
+    contiguous 3D/tp slice is [q_r | k_r | v_r] (its own head blocks)."""
+    if tp <= 1:
+        return w
+    D = w.shape[1] // 3
+    per = D // tp
+    cols = np.concatenate([
+        np.arange(j * D + r * per, j * D + (r + 1) * per)
+        for r in range(tp) for j in range(3)])
+    return np.asarray(w)[:, cols]
+
+
+def _shard_mesh(dp: int, tp: int, devices=None, platform: Optional[str] = None):
+    from ..parallel.mesh import serving_mesh
+
+    return serving_mesh(dp, tp, devices=devices, platform=platform)
+
+
+class _ShardedParamStore:
+    """Shared plumbing of both sharded engines: role->spec mapping,
+    sharded device placement, the per-shard HBM account, and the
+    cost-model comm attribution (plan term per dispatch)."""
+
+    def _comm_profile(self):
+        """The analytic profile the comm attribution prices gathers with
+        — built ONCE (the cfg is frozen; this sits on the hot path)."""
+        prof = getattr(self, "_comm_profile_cache", None)
+        if prof is None:
+            from .placement import ModelProfile
+
+            prof = ModelProfile.synthetic(
+                self.cfg["n_layers"], self.cfg["n_heads"],
+                self.cfg["d_model"], self.cfg["d_ff"], self.cfg["vocab"],
+                self.cfg["max_len"])
+            self._comm_profile_cache = prof
+        return prof
+
+    def _predicted_comm_s(self, rows: int, seq: Optional[int] = None) -> float:
+        """Cost-model-attributed collective seconds of one dispatch (the
+        plan's comm term at this shape; 0 when the engine was built
+        without a plan) — feeds pt_serving_shard_collective_seconds."""
+        plan = self.plan
+        if plan is None or self.tp <= 1 or plan.inventory is None:
+            return 0.0
+        inv = plan.inventory
+        b_loc = math.ceil(rows / self.dp)
+        n_coll = expected_collectives(self.cfg, self.tp)
+        return (n_coll * inv.alpha_s
+                + self._comm_profile().gather_bytes(b_loc, seq)
+                * (self.tp - 1) / self.tp / inv.link_bw)
+
+    def _record_collectives(self, rows: int, seq: Optional[int] = None) -> None:
+        """One sharded dispatch ran the static gather schedule: count it
+        (and its plan-modeled seconds) into the attached stats."""
+        if self.stats is None or self.tp <= 1:
+            return
+        self.stats.record_collectives(
+            expected_collectives(self.cfg, self.tp),
+            self._predicted_comm_s(rows, seq))
+
+    def _param_spec(self, role: str):
+        from jax.sharding import PartitionSpec
+
+        ax = _COL_AXIS.get(role)
+        if ax is None or self.tp <= 1:
+            return PartitionSpec()
+        ndim = 2 if role not in ("out_b", "bup", "bdown") else 1
+        spec = [None] * ndim
+        spec[ax if ndim > 1 else 0] = "tp"
+        return PartitionSpec(*spec)
+
+    def _param_specs_pytree(self, params):
+        specs = {k: self._param_spec(k) for k in params if k != "layers"}
+        specs["layers"] = [{k: self._param_spec(k) for k in lp}
+                           for lp in params["layers"]]
+        return specs
+
+    def _shard_put(self, host_params):
+        """Host pytree -> mesh-sharded pytree (wqkv columns permuted so a
+        rank's slice is its own head blocks)."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        def put(role, arr):
+            arr = np.asarray(arr)
+            if role == "wqkv":
+                arr = _permute_qkv_cols(arr, self.tp)
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, self._param_spec(role)))
+
+        out = {k: put(k, v) for k, v in host_params.items() if k != "layers"}
+        out["layers"] = [{k: put(k, v) for k, v in lp.items()}
+                        for lp in host_params["layers"]]
+        return out
+
+    def shard_hbm_bytes(self) -> Dict[int, int]:
+        """Resident param bytes per mesh device — the per-device
+        occupancy gauge's numerator (pools/activations are accounted by
+        the placement cost model, not measured here)."""
+        out = {i: 0 for i in range(len(self.mesh.devices.flat))}
+        dev_index = {d: i for i, d in enumerate(self.mesh.devices.flat)}
+        with self._lock:
+            params = self._params
+        for _path, leaf in _flat_items(params):
+            for s in getattr(leaf, "addressable_shards", []):
+                i = dev_index.get(s.device)
+                if i is not None:
+                    out[i] += int(np.prod(s.data.shape)
+                                  * s.data.dtype.itemsize)
+        return out
+
+
+class ShardedServingEngine(_ShardedParamStore, ServingEngine):
+    """One-shot predict over a (dp, tp) mesh — a drop-in ``ServingEngine``
+    whose compiled step is ``models/transformer.predict_forward`` under
+    ``shard_map``: batch rows split over dp, every weight column-sharded
+    over tp, activations gathered at the static §18 boundaries.
+
+    The export must be a ``transformer_lm`` logits export (one int-ids
+    feed, one per-row [N, T, V] fetch); anything else raises — sharding
+    recovers the architecture from the IR and will not guess.
+    """
+
+    def __init__(self, dirname: str, dp: int = 1, tp: int = 1,
+                 place=None, devices=None, stats=None, plan=None, **kw):
+        self.dp = int(dp)
+        self.tp = int(tp)
+        if self.dp < 1 or self.dp & (self.dp - 1):
+            raise ValueError(f"dp must be a power of two (batch buckets "
+                             f"are), got {dp}")
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        self._ctor_devices = devices
+        self.plan = plan
+        self.stats = stats  # optional: collective-time attribution
+        super().__init__(dirname, place=place, **kw)
+        if len(self.feed_names) != 1 or len(self.fetch_names) != 1:
+            raise ValueError(
+                f"sharded serving wants the transformer_lm logits export "
+                f"(one ids feed, one logits fetch), got feeds="
+                f"{list(self.feed_names)} fetches={list(self.fetch_names)}")
+        if not self.fetch_per_row[self.fetch_names[0]]:
+            raise ValueError("sharded serving: the fetch must be per-row "
+                             "(the [N, T, V] logits)")
+        # dp splits the batch dim of every bucket: round the ladder up to
+        # multiples of dp (pow2 ladder + pow2 dp -> only entries < dp move)
+        if self.dp > 1:
+            self.batch_buckets = tuple(sorted(
+                {int(math.ceil(b / self.dp) * self.dp)
+                 for b in self.batch_buckets}))
+            self.max_batch_size = self.batch_buckets[-1]
+
+    # -- load: roles walk + mesh + column shards (no single-device stage) --
+    def _load_params(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..models.transformer import decode_params_from_scope, \
+            decode_roles
+
+        self.roles, self.cfg = decode_roles(self.program)
+        if self.cfg["n_heads"] % self.tp or self.cfg["d_model"] % self.tp \
+                or self.cfg["d_ff"] % self.tp \
+                or self.cfg["vocab"] % self.tp:
+            raise ValueError(
+                f"tp={self.tp} does not divide the column extents "
+                f"(heads={self.cfg['n_heads']} d_model="
+                f"{self.cfg['d_model']} d_ff={self.cfg['d_ff']} "
+                f"vocab={self.cfg['vocab']}) — the placement searcher "
+                f"only emits divisor splits")
+        self.mesh = _shard_mesh(self.dp, self.tp,
+                                devices=self._ctor_devices,
+                                platform=self._place.jax_device().platform)
+        self._feed_sharding = NamedSharding(self.mesh,
+                                            PartitionSpec("dp", None))
+        host = decode_params_from_scope(self.roles, self.scope)
+        return self._shard_put(host)
+
+    # -- compile cache: shard_map-wrapped predict_forward per signature --
+    def _make_fn(self, sig: Tuple):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..models.transformer import predict_forward
+        from ..parallel._compat import shard_map
+
+        with self._lock:
+            specs = self._param_specs_pytree(self._params)
+        body = functools.partial(predict_forward, cfg=self.cfg,
+                                 tp=self.tp,
+                                 tp_axis="tp" if self.tp > 1 else None)
+        fn = shard_map(lambda p, ids: body(p, ids), mesh=self.mesh,
+                       in_specs=(specs, P("dp", None)),
+                       out_specs=P("dp", None, None), check_vma=False)
+        return jax.jit(fn)
+
+    def _annotate_cost(self, fn, sig: Tuple):
+        from ..flags import get_flag
+
+        if not get_flag("obs_cost_analysis"):
+            return None, None
+        try:
+            from ..obs import cost as obs_cost
+
+            with self._lock:
+                params = self._params
+            avals = obs_cost.abstractify(params)
+            feed_aval = obs_cost.abstractify(
+                np.zeros(sig[0][1], np.dtype(sig[0][2])))
+            res = obs_cost.analyze_jit(fn, avals, feed_aval)
+            return res["flops"], res["bytes"]
+        except Exception:
+            return None, None
+
+    def measured_collectives(self, rows: int) -> int:
+        """Compile (or reuse) the bucket serving ``rows`` and count the
+        all-gather instructions in its HLO — the contract check."""
+        bucket = self.bucket_batch(rows)
+        var = self._feed_vars[self.feed_names[0]]
+        t = tuple(var.shape)[1:]
+        dt = var.dtype.np_dtype if var.dtype is not None else np.int64
+        feeds, sig, _ = self.prepare_request(
+            {self.feed_names[0]: np.zeros((bucket,) + t, dt)})
+        entry = self._get_fn(tuple(
+            (n, feeds[n].shape, str(feeds[n].dtype))
+            for n in self.feed_names))
+        with self._lock:
+            params = self._params
+        ids = feeds[self.feed_names[0]]
+        txt = entry.fn.lower(params, ids).compile().as_text()
+        return count_hlo_collectives(txt)
+
+    @property
+    def expected_collectives_per_dispatch(self) -> int:
+        return expected_collectives(self.cfg, self.tp)
+
+    # -- dispatch: params pytree + dp-sharded ids --
+    def dispatch_prepared(self, feeds: Dict[str, np.ndarray],
+                          rows: int):
+        import jax
+
+        from .engine import InFlightBatch
+
+        bucket = self.bucket_batch(rows)
+        if bucket != rows:
+            feeds = {n: np.concatenate(
+                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
+                for n, a in feeds.items()}
+        sig = tuple((n, feeds[n].shape, str(feeds[n].dtype))
+                    for n in self.feed_names)
+        entry = self._get_fn(sig)
+        if self.chaos is not None:
+            self.chaos.on_dispatch()
+        with self._lock:  # one consistent (params, version) snapshot —
+            params = self._params  # the pytree swap covers EVERY shard
+            version = self.params_version
+        cold = entry.cold
+        t_call = time.monotonic() if cold else 0.0
+        ids = jax.device_put(feeds[self.feed_names[0]], self._feed_sharding)
+        logits = entry.fn(params, ids)
+        if cold:
+            entry.compile_s = time.monotonic() - t_call
+            entry.cold = False
+            from ..obs import get_tracer
+
+            tr = get_tracer()
+            if tr.enabled:
+                tr.add_span("serving/compile", t_call, entry.compile_s,
+                            cat="compile",
+                            args={"bucket": bucket, "dp": self.dp,
+                                  "tp": self.tp, "flops": entry.flops})
+        self._record_collectives(bucket)
+        return InFlightBatch([logits], rows, bucket, version,
+                             flops=entry.flops)
+
+    # -- hot reload: decode-style pytree validation, sharded staging --
+    def stage_params(self, dirname: str) -> Dict[str, Any]:
+        """Load + validate a re-exported dir against the frozen roles,
+        then place the column shards — all WITHOUT touching the live set.
+        ``commit_params`` (inherited) is ONE pytree reference store, so
+        every dispatch snapshots a wholly-old or wholly-new set across
+        ALL shards (PR-2's guarantee, mesh-wide)."""
+        from .. import io as model_io
+        from ..core.executor import Scope
+        from ..models.transformer import decode_params_from_scope, \
+            decode_roles
+
+        scope = Scope()
+        program, _f, _t = model_io.load_inference_model(dirname, None,
+                                                        scope=scope)
+        roles, cfg = decode_roles(program)
+        for k in ("n_layers", "n_heads", "d_model", "d_ff", "vocab",
+                  "max_len"):
+            if cfg[k] != self.cfg[k]:
+                raise ValueError(
+                    f"reload {dirname!r}: architecture mismatch — {k} "
+                    f"{cfg[k]} != frozen {self.cfg[k]}")
+        staged = decode_params_from_scope(roles, scope)
+        with self._lock:
+            live = self._params
+        old_flat = dict(_flat_items(live))
+        new_flat = dict(_flat_items(staged))
+        if set(old_flat) != set(new_flat):
+            raise ValueError(
+                f"reload {dirname!r}: parameter set mismatch "
+                f"(+{sorted(set(new_flat) - set(old_flat))} "
+                f"-{sorted(set(old_flat) - set(new_flat))})")
+        for path, old in old_flat.items():
+            new = new_flat[path]
+            if tuple(old.shape) != tuple(new.shape) \
+                    or np.dtype(old.dtype) != np.dtype(new.dtype):
+                raise ValueError(
+                    f"reload {dirname!r}: param {path} shape/dtype "
+                    f"mismatch ({tuple(new.shape)}/{np.dtype(new.dtype)} "
+                    f"vs frozen {tuple(old.shape)}/{np.dtype(old.dtype)})")
+        return self._shard_put(staged)
+
+
+class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
+    """Decode serving over a tp mesh: the slot-pooled KV cache sharded
+    along HEADS (``[L, slots+1, max_len, H/tp, Dh]`` per rank), params
+    column-sharded, one shard_map-compiled chunk fn per (lanes, chunk,
+    window) signature. ``GenerationBatcher`` — continuous batching, the
+    slot scheduler, deadlines, drain, reload barrier — runs on top
+    UNCHANGED, and steady-state decode still compiles nothing (the same
+    cache-counter contract, tested).
+
+    dp is meaningless inside one decode engine (the slot pool IS the
+    batch); data-parallel decode is replica groups, the fleet tier's
+    business."""
+
+    def __init__(self, dirname: str, tp: int = 1, place=None, devices=None,
+                 plan=None, stats=None, **kw):
+        self.tp = int(tp)
+        self.dp = 1
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        self._ctor_devices = devices
+        self.plan = plan
+        self.stats = stats  # optional: collective attribution
+        self.mesh = None  # built on first _device_put_params
+        super().__init__(dirname, place=place, **kw)
+
+    @property
+    def expected_collectives_per_dispatch(self) -> int:
+        return expected_collectives(self.cfg, self.tp)
+
+    def _device_put_params(self, host_params):
+        c = self.cfg
+        if c["n_heads"] % self.tp or c["d_model"] % self.tp \
+                or c["d_ff"] % self.tp or c["vocab"] % self.tp:
+            raise ValueError(
+                f"tp={self.tp} does not divide the column extents "
+                f"(heads={c['n_heads']} d_model={c['d_model']} "
+                f"d_ff={c['d_ff']} vocab={c['vocab']})")
+        if self.mesh is None:
+            self.mesh = _shard_mesh(1, self.tp,
+                                    devices=self._ctor_devices,
+                                    platform=self._place.jax_device()
+                                    .platform)
+        return self._shard_put(host_params)
+
+    def _pool_spec(self):
+        from jax.sharding import PartitionSpec
+
+        # [L, slots+1, max_len, H, Dh]: heads axis over tp
+        return PartitionSpec(None, None, None,
+                             "tp" if self.tp > 1 else None, None)
+
+    def _alloc_pools(self):
+        import jax
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, self._pool_spec())
+        z = np.zeros(self._pool_shape, np.float32)
+        return (jax.device_put(z, sharding), jax.device_put(z, sharding))
+
+    def _make_chunk_fn(self, lanes: int, chunk: int, window: int):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..models.transformer import decode_forward_chunk
+        from ..parallel._compat import shard_map
+
+        with self._lock:
+            specs = self._param_specs_pytree(self._params)
+        body = functools.partial(decode_forward_chunk, cfg=self.cfg,
+                                 window=window, tp=self.tp,
+                                 tp_axis="tp" if self.tp > 1 else None)
+        pool = self._pool_spec()
+        fn = shard_map(
+            lambda p, pk, pv, tok, pos, val, slot:
+                body(p, pk, pv, tok, pos, val, slot),
+            mesh=self.mesh,
+            in_specs=(specs, pool, pool, P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), pool, pool), check_vma=False)
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def dispatch_chunk(self, tokens, positions, valids, slots, window: int):
+        out = super().dispatch_chunk(tokens, positions, valids, slots,
+                                     window)
+        # each chunk runs the same static gather schedule as predict —
+        # count it so a decode-only sharded replica's collective
+        # instruments move too (.shape only: tokens may be the pipelined
+        # device carry, and materializing it here would sync the pipeline)
+        lanes, chunk = tokens.shape
+        self._record_collectives(lanes, seq=chunk)
+        return out
+
+    def measured_collectives(self, window: Optional[int] = None) -> int:
+        """all-gather count in the compiled steady-state decode step."""
+        import jax
+
+        window = window or self.kv_buckets[0]
+        entry = self._get_fn(self.max_slots, 1, window)
+        toks = np.zeros((self.max_slots, 1), np.int32)
+        zeros = np.zeros(self.max_slots, np.int32)
+        slots = np.full(self.max_slots, self.trash_slot, np.int32)
+        with self._lock:
+            params = self._params
+        txt = entry.fn.lower(
+            params, self.pool_k, self.pool_v,
+            jax.numpy.asarray(toks), zeros, zeros, slots).compile().as_text()
+        return count_hlo_collectives(txt)

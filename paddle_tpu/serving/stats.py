@@ -149,6 +149,30 @@ class ServingStats:
         r.gauge("pt_serving_decode_tokens_per_second",
                 "Windowed generated-token rate",
                 callback=self.decode_tokens_rate)
+        # sharded-serving instruments (serving/sharded.py, docs/design.md
+        # §18): shard count makes MFU an AGGREGATE across the mesh (the
+        # denominator scales with devices — a fleet router scraping a
+        # sharded replica must not read shard 0's peak), per-shard HBM
+        # gauges carry the column layout's per-device residency, and the
+        # collective counters attribute comm cost per dispatch.
+        self._shard_count = r.gauge(
+            "pt_serving_shard_count",
+            "Devices one model spans (1 = unsharded)")
+        self._shard_count.set(1)
+        self._shard_hbm = r.gauge(
+            "pt_serving_shard_hbm_bytes",
+            "Resident model bytes per mesh device", labelnames=("shard",))
+        self._shard_occ = r.gauge(
+            "pt_serving_shard_occupancy",
+            "Per-device resident bytes / modeled HBM capacity",
+            labelnames=("shard",))
+        self._collectives = r.counter(
+            "pt_serving_shard_collectives_total",
+            "All-gathers dispatched by the sharded step")
+        self._collective_s = r.counter(
+            "pt_serving_shard_collective_seconds_total",
+            "Cost-model-attributed collective seconds (placement plan "
+            "comm term per dispatch)")
         # latency ring (last N latencies, seconds) bounds the percentile
         # cost; rates count in separate per-second buckets so high
         # throughput can't push events out before their window expires
@@ -327,6 +351,38 @@ class ServingStats:
         """Windowed generated tokens/s (the decode throughput gauge)."""
         return self._decode_tokens_window.rate()
 
+    # -- sharded serving (serving/sharded.py) --
+    def set_shard_count(self, n: int) -> None:
+        """One model spans ``n`` devices: the MFU denominator becomes
+        ``n * peak`` (aggregate across shards, not shard 0's chip)."""
+        self._shard_count.set(max(1, int(n)))
+
+    @property
+    def shard_count(self) -> int:
+        return int(self._shard_count.value) or 1
+
+    def set_shard_hbm(self, per_shard_bytes: Dict[int, int],
+                      capacity_bytes: Optional[float] = None) -> None:
+        """Per-device resident bytes (and occupancy fraction when the
+        modeled HBM capacity is known) — engine.shard_hbm_bytes() feeds
+        this at load and after every reload commit."""
+        for idx, b in per_shard_bytes.items():
+            self._shard_hbm.labels(shard=str(idx)).set(float(b))
+            if capacity_bytes:
+                self._shard_occ.labels(shard=str(idx)).set(
+                    float(b) / capacity_bytes)
+
+    def record_collectives(self, count: int, seconds: float) -> None:
+        """One sharded dispatch ran ``count`` all-gathers costing the
+        plan-modeled ``seconds`` of link time."""
+        self._collectives.inc(count)
+        if seconds > 0:
+            self._collective_s.inc(seconds)
+
+    @property
+    def collectives(self) -> int:
+        return int(self._collectives.value)
+
     @property
     def decode_tokens(self) -> int:
         return int(self._decode_tokens.value)
@@ -356,9 +412,13 @@ class ServingStats:
         return self._flops_window.rate()
 
     def mfu(self) -> float:
+        """Windowed FLOP/s over the peak of EVERY device the model spans
+        — for a sharded engine the aggregate across shards (shard 0's
+        chip peak alone would overstate a replica's utilization to the
+        fleet router by the shard count)."""
         from ..obs.cost import peak_flops
 
-        peak = peak_flops()
+        peak = peak_flops() * self.shard_count
         return self.flops_rate() / peak if peak > 0 else 0.0
 
     def stage_summary(self) -> Dict[str, Dict[str, float]]:
@@ -446,6 +506,8 @@ class ServingStats:
             "stages_ms": self.stage_summary(),
             "flops_per_s": self.flops_rate(),
             "mfu": self.mfu(),
+            "shards": self.shard_count,
+            "collectives": self.collectives,
             "decode": self.decode_summary(),
         }
         if extra:

@@ -1,0 +1,283 @@
+"""Placement searcher (serving/placement.py, ISSUE 8): cost-model units
+with dimensional checks (bytes, seconds, FLOPs — the SlotScheduler test
+discipline), infeasible-HBM rejection, must-shard proof, plan determinism
+for fixed inputs, and the exported-IR profile walk."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.placement import (GIB, DeviceInventory, ModelProfile,
+                                          NoFeasiblePlacement,
+                                          PlacementSearcher, TrafficProfile,
+                                          plan_table, profile_export)
+
+# a mid-size synthetic model the units reason about by hand
+L, H, D, FF, V, T = 4, 8, 256, 1024, 4096, 512
+
+
+@pytest.fixture()
+def profile():
+    return ModelProfile.synthetic(L, H, D, FF, V, T)
+
+
+@pytest.fixture()
+def traffic():
+    return TrafficProfile([(1, 0.5), (8, 0.5)], seq_len=T)
+
+
+# ---------------------------------------------------------------------------
+# cost-model units (dimensional checks)
+# ---------------------------------------------------------------------------
+
+
+def test_profile_byte_accounting(profile):
+    """bytes_sharded is exactly the matmul-weight param count x 4 (f32):
+    emb + per-layer (qkv + out + FFN weights/biases) + head."""
+    expect_sharded = 4 * (V * D + L * (4 * D * D + 2 * D * FF + FF + D)
+                          + D * V + V)
+    expect_repl = 4 * (T * D + (2 * L * 2 + 2) * D)
+    assert profile.bytes_sharded == expect_sharded
+    assert profile.bytes_replicated == expect_repl
+    assert profile.param_bytes == expect_sharded + expect_repl
+
+
+def test_per_device_bytes_scale_inverse_tp(profile, traffic):
+    """The column layout shards every matmul weight: per-device param
+    bytes = replicated + sharded/tp, EXACTLY."""
+    inv = DeviceInventory(8, hbm_gb=1e3)
+    s = PlacementSearcher(profile, inv, traffic)
+    for tp in (1, 2, 4):
+        plan = s.score(1, tp)
+        assert plan.param_bytes_per_device == pytest.approx(
+            profile.bytes_replicated + profile.bytes_sharded / tp)
+
+
+def test_flops_dimensional(profile):
+    """FLOPs are linear in rows and carry the 2*N matmul term."""
+    f1 = profile.flops_fwd(1, T)
+    assert profile.flops_fwd(4, T) == pytest.approx(4 * f1)
+    n_mat = L * (4 * D * D + 2 * D * FF) + D * V
+    assert f1 == pytest.approx(T * (2 * n_mat + 2 * L * D * T))
+
+
+def test_comm_seconds_halve_with_doubled_link(profile, traffic):
+    """comm_s = n_coll*alpha + gathered_bytes*(tp-1)/tp / link_bw — pure
+    bytes/bandwidth, so doubling the link halves the transfer term."""
+    slow = PlacementSearcher(
+        profile, DeviceInventory(4, hbm_gb=1e3, link_gbps=10.0,
+                                 alpha_us=0.0), traffic).score(1, 4)
+    fast = PlacementSearcher(
+        profile, DeviceInventory(4, hbm_gb=1e3, link_gbps=20.0,
+                                 alpha_us=0.0), traffic).score(1, 4)
+    assert slow.comm_s == pytest.approx(2 * fast.comm_s)
+    assert slow.collective_bytes_per_step == fast.collective_bytes_per_step
+
+
+def test_compute_seconds_halve_with_doubled_peak(profile, traffic):
+    slow = PlacementSearcher(
+        profile, DeviceInventory(2, hbm_gb=1e3, peak_tflops=100.0),
+        traffic).score(1, 1)
+    fast = PlacementSearcher(
+        profile, DeviceInventory(2, hbm_gb=1e3, peak_tflops=200.0),
+        traffic).score(1, 1)
+    assert slow.compute_s == pytest.approx(2 * fast.compute_s)
+
+
+def test_collective_schedule_is_static(profile):
+    """4L+2 all-gathers when tp>1, zero when tp=1 — the §18 contract the
+    compiled-HLO count is judged against (test_serving_sharded)."""
+    assert profile.collectives_per_dispatch(1) == 0
+    for tp in (2, 4, 8):
+        assert profile.collectives_per_dispatch(tp) == 4 * L + 2
+
+
+def test_gather_bytes_formula(profile):
+    """Gathered bytes per dispatch are exact: per row-token, emb D +
+    per-layer (2D attention + FF hidden + D FFN out) + head V, f32."""
+    per_row = T * (D + L * (3 * D + FF) + V) * 4
+    assert profile.gather_bytes(1, T) == pytest.approx(per_row)
+    assert profile.gather_bytes(8, T) == pytest.approx(8 * per_row)
+
+
+def test_dp_serving_needs_no_collectives(profile, traffic):
+    inv = DeviceInventory(8, hbm_gb=1e3)
+    plan = PlacementSearcher(profile, inv, traffic).score(8, 1)
+    assert plan.comm_s == 0.0
+    assert plan.collectives_per_dispatch == 0
+    assert plan.collective_bytes_per_step == 0.0
+
+
+def test_tp_candidates_are_divisors(profile):
+    """tp must divide heads AND every column extent the layout splits."""
+    assert profile.max_tp(8) == [1, 2, 4, 8]
+    odd = ModelProfile.synthetic(2, 6, 96, 192, 384, 64)
+    # 6 heads: tp in {1, 2, 3, 6}; all divide 96/192/384
+    assert odd.max_tp(8) == [1, 2, 3, 6]
+
+
+# ---------------------------------------------------------------------------
+# feasibility: HBM rejection + must-shard
+# ---------------------------------------------------------------------------
+
+
+def test_infeasible_hbm_rejected_with_reason(profile, traffic):
+    tiny = DeviceInventory(4, hbm_gb=1e-6)
+    s = PlacementSearcher(profile, tiny, traffic)
+    for plan in s.all_plans():
+        assert not plan.feasible
+        assert "exceed modeled HBM" in plan.reason
+    with pytest.raises(NoFeasiblePlacement) as ei:
+        s.search()
+    assert "dp=1 tp=1" in str(ei.value)
+
+
+def test_must_shard_model_rejects_every_tp1_plan(traffic):
+    """A model whose parameter bytes exceed one chip's modeled HBM: every
+    tp=1 plan (any dp — dp replicates the weights) is infeasible, and the
+    chosen plan carries a real tensor split."""
+    prof = ModelProfile.synthetic(L, H, D, FF, V, T)
+    hbm_gb = prof.param_bytes * 0.8 / GIB
+    inv = DeviceInventory(8, hbm_gb=hbm_gb, link_gbps=45.0)
+    tr = TrafficProfile([(1, 1.0)], seq_len=64)  # tiny activations
+    s = PlacementSearcher(prof, inv, tr)
+    for plan in s.all_plans():
+        if plan.tp == 1:
+            assert not plan.feasible, f"dp={plan.dp} tp=1 must not fit"
+    chosen = s.search()
+    assert chosen.feasible and chosen.tp >= 2
+    with pytest.raises(NoFeasiblePlacement):
+        s.search(max_devices=1)
+
+
+def test_p95_budget_gates_feasibility(profile):
+    inv = DeviceInventory(2, hbm_gb=1e3, peak_tflops=0.001)
+    tr = TrafficProfile([(8, 1.0)], seq_len=T, p95_budget_ms=0.001)
+    s = PlacementSearcher(profile, inv, tr)
+    with pytest.raises(NoFeasiblePlacement) as ei:
+        s.search()
+    assert "p95" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# determinism + the curve
+# ---------------------------------------------------------------------------
+
+
+def test_plan_determinism(profile, traffic):
+    """Same inputs -> the same plan, repeatedly and across fresh searcher
+    objects (pure arithmetic over a sorted candidate list with a total
+    tie-break order; no RNG anywhere)."""
+    inv = DeviceInventory(8, hbm_gb=1e3)
+    first = PlacementSearcher(profile, inv, traffic).search().as_dict()
+    for _ in range(3):
+        again = PlacementSearcher(
+            ModelProfile.synthetic(L, H, D, FF, V, T),
+            DeviceInventory(8, hbm_gb=1e3),
+            TrafficProfile([(1, 0.5), (8, 0.5)], seq_len=T),
+        ).search().as_dict()
+        assert again == first
+
+
+def test_qps_per_chip_curve_shape(profile):
+    """One entry per chip count; the must-shard regime reports null until
+    the first feasible split, then real numbers at the fixed p95."""
+    hbm_gb = profile.param_bytes * 0.8 / GIB
+    inv = DeviceInventory(4, hbm_gb=hbm_gb)
+    tr = TrafficProfile([(1, 1.0)], seq_len=64)
+    curve = PlacementSearcher(profile, inv, tr).qps_per_chip_curve()
+    assert [c["chips"] for c in curve] == [1, 2, 3, 4]
+    assert curve[0]["qps_per_chip"] is None  # must-shard: 1 chip can't
+    feasible = [c for c in curve if c["qps_per_chip"] is not None]
+    assert feasible and all(c["tp"] >= 2 for c in feasible)
+
+
+def test_plan_table_renders_feasible_and_not(profile, traffic):
+    s = PlacementSearcher(profile, DeviceInventory(2, hbm_gb=1e-6), traffic)
+    txt = plan_table(s.all_plans())
+    assert "INFEASIBLE" in txt and "qps/chip" in txt
+
+
+# ---------------------------------------------------------------------------
+# traffic + export profiling
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_profile_validation_and_p95():
+    tr = TrafficProfile([(1, 0.9), (16, 0.1)])
+    assert tr.p95_rows() == 16  # the tail bucket carries the p95
+    assert TrafficProfile([(4, 1.0)]).p95_rows() == 4
+    with pytest.raises(ValueError):
+        TrafficProfile([])
+    with pytest.raises(ValueError):
+        TrafficProfile([(0, 1.0)])
+
+
+def test_traffic_from_stats():
+    from paddle_tpu.serving.stats import ServingStats
+
+    stats = ServingStats()
+    for _ in range(4):
+        stats.record_batch(6, 8)
+    tr = TrafficProfile.from_stats(stats, seq_len=128)
+    assert tr.batch_mix == [(6, 1.0)]
+    assert TrafficProfile.from_stats(ServingStats()).batch_mix == [(1, 1.0)]
+
+
+def test_profile_export_walks_the_ir(tmp_path):
+    """profile_export recovers the architecture via decode_roles and
+    accounts the ACTUAL saved arrays' bytes; the XLA cost-analysis
+    cross-check annotates real lowered-step FLOPs."""
+    import paddle_tpu as fluid
+    from paddle_tpu import io
+    from paddle_tpu.models.transformer import transformer_lm
+
+    v, t, d, h, l, ff = 64, 16, 32, 4, 2, 64
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[t], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[t], dtype="int64")
+            logits, _ = transformer_lm(ids, labels, vocab_size=v, max_len=t,
+                                       d_model=d, n_heads=h, n_layers=l,
+                                       d_ff=ff)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=3)
+        io.save_inference_model(str(tmp_path / "m"), ["ids"], [logits], exe,
+                                main, scope=scope)
+    prof = profile_export(str(tmp_path / "m"))
+    assert prof.cfg["n_layers"] == l and prof.cfg["d_model"] == d
+    assert prof.cfg["vocab"] == v and prof.cfg["n_heads"] == h
+    # exact byte account: every float param is f32; emb/head/qkv/ffn and
+    # their biases shard, pos + layer norms replicate
+    expect_sharded = 4 * (v * d + l * (4 * d * d + 2 * d * ff + ff + d)
+                          + d * v + v)
+    expect_repl = 4 * (t * d + (2 * l * 2 + 2) * d)
+    assert prof.bytes_sharded == expect_sharded
+    assert prof.bytes_replicated == expect_repl
+    assert prof.xla_flops is None or prof.xla_flops > 0
+    # a non-transformer export refuses to profile (the IR walk raises)
+    with fluid.unique_name.guard():
+        main2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main2, startup2):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3)
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        scope2 = fluid.Scope()
+        exe2.run(startup2, scope=scope2)
+        io.save_inference_model(str(tmp_path / "fc"), ["x"], [pred], exe2,
+                                main2, scope=scope2)
+    with pytest.raises(ValueError, match="embedding lookup"):
+        profile_export(str(tmp_path / "fc"))
+
+
+def test_decode_pool_rides_the_hbm_account(profile):
+    """decode_slots adds the KV pool's per-device head shard."""
+    inv = DeviceInventory(4, hbm_gb=1e3)
+    base = PlacementSearcher(
+        profile, inv, TrafficProfile([(1, 1.0)], seq_len=64)).score(1, 2)
+    with_pool = PlacementSearcher(
+        profile, inv, TrafficProfile([(1, 1.0)], seq_len=64,
+                                     decode_slots=8)).score(1, 2)
+    expect = profile.decode_pool_bytes(8) / 2
+    assert with_pool.hbm_bytes_per_device - base.hbm_bytes_per_device == \
+        pytest.approx(expect)

@@ -119,6 +119,53 @@ def test_paddle_cli_fleet_status_table(tmp_path):
     assert srv.endpoint in report
 
 
+def _export_tiny_lm(dirname):
+    import paddle_tpu as fluid
+    from paddle_tpu import io
+    from paddle_tpu.models.transformer import transformer_lm
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[16], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[16], dtype="int64")
+            logits, _ = transformer_lm(ids, labels, vocab_size=64,
+                                       max_len=16, d_model=32, n_heads=4,
+                                       n_layers=2, d_ff=64)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=3)
+        io.save_inference_model(dirname, ["ids"], [logits], exe, main,
+                                scope=scope)
+    return dirname
+
+
+def test_paddle_cli_placement_report(tmp_path):
+    """`paddle_cli.py placement` prints the scored candidate table + the
+    chosen plan (splits, comm bytes/step, per-device HBM); an inventory
+    nothing fits yields no chosen plan -> the nonzero-exit signal."""
+    d = _export_tiny_lm(str(tmp_path / "lm"))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import paddle_cli
+    finally:
+        sys.path.pop(0)
+    report, chosen = paddle_cli.placement_report(
+        d, chips=4, batch_mix="1:0.5,4:0.5", seq_len=16)
+    assert chosen is not None and chosen.feasible
+    assert "chosen: dp=" in report and "qps/chip" in report
+    assert "per-device HBM" in report and "all-gathers" in report
+    # nothing fits a micro-HBM inventory: chosen None = exit 1 in cmd
+    report2, chosen2 = paddle_cli.placement_report(
+        d, chips=4, hbm_gb=1e-9, batch_mix="1:1.0", seq_len=16)
+    assert chosen2 is None
+    assert "NO FEASIBLE PLAN" in report2
+    assert paddle_cli.cmd_placement([d, "--chips", "2",
+                                     "--seq-len", "16"]) == 0
+    assert paddle_cli.cmd_placement([d, "--chips", "2",
+                                     "--hbm-gb", "1e-9"]) == 1
+
+
 def test_op_parity_audit_clean():
     """Every reference op (SURVEY §2b) is matched or redesign-mapped."""
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
@@ -195,8 +242,10 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all seven tracked metrics carry a bar (r6 added decode serving)
-    assert len(bench.BARS) == 7
+    # all eight tracked metrics carry a bar (r8 added sharded serving)
+    assert len(bench.BARS) == 8
+    shd = bench.BARS["sharded_serving_qps_per_chip"]
+    assert shd["field"] == "value" and shd["min"] == 1.0
     # pass: above bar
     bench._emit({"metric": "transformer_lm_train_tokens_per_sec_per_chip",
                  "value": 150000.0, "unit": "tokens/sec", "mfu": 0.648})

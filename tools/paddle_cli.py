@@ -14,6 +14,12 @@ Subcommands:
              scraped from each endpoint's healthz + /metrics; endpoints
              as args or comma-separated. Unreachable replicas render as
              circuit=open.
+  placement — run the parallelism placement searcher over an exported
+             inference dir (serving/placement.py): prints the scored
+             (dp, tp) candidate table and the chosen PlacementPlan
+             (splits, predicted comm bytes/step, per-device HBM).
+             NONZERO exit when no plan fits the modeled HBM — the
+             must-shard signal a deploy script can gate on.
 """
 from __future__ import annotations
 
@@ -184,7 +190,7 @@ def fleet_rows(endpoints, timeout=3.0):
     for ep in endpoints:
         row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
                "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
-               "weights": "-", "decode": ""}
+               "shards": "-", "weights": "-", "decode": ""}
         try:
             with ServingClient(ep, timeout=timeout) as c:
                 hz = c.healthz()
@@ -195,6 +201,7 @@ def fleet_rows(endpoints, timeout=3.0):
                 capacity=int(m["queue_capacity"]),
                 occupancy=int(m["occupancy"]),
                 mfu=m["mfu"],
+                shards=int(m.get("shards", 1)),
                 weights=int(m["weights_version"]))
             d = hz.get("decode")
             if d:
@@ -208,14 +215,15 @@ def fleet_rows(endpoints, timeout=3.0):
 
 def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
-             f"{'occ':>5}{'mfu':>11}{'weights':>9}  decode"]
+             f"{'occ':>5}{'mfu':>11}{'shards':>7}{'weights':>9}  decode"]
     for r in rows:
         q = (f"{r['queue']}/{r['capacity']}"
              if r["queue"] != "-" else "-")
         mfu = f"{r['mfu']:.2e}" if r["mfu"] != "-" else "-"
         lines.append(f"{r['endpoint']:<24}{r['health']:<12}"
                      f"{r['circuit']:<9}{q:>9}{str(r['occupancy']):>5}"
-                     f"{mfu:>11}{str(r['weights']):>9}  {r['decode']}")
+                     f"{mfu:>11}{str(r.get('shards', '-')):>7}"
+                     f"{str(r['weights']):>9}  {r['decode']}")
     healthy = sum(1 for r in rows if r["health"] == "healthy")
     lines.append(f"{healthy}/{len(rows)} replicas healthy")
     return "\n".join(lines)
@@ -240,10 +248,97 @@ def cmd_fleet(argv):
     return 0 if all(r["health"] == "healthy" for r in rows) else 1
 
 
+# -- placement search ------------------------------------------------------
+
+
+def _parse_batch_mix(spec):
+    """"1:0.7,8:0.3" -> [(1, 0.7), (8, 0.3)]."""
+    out = []
+    for part in spec.split(","):
+        rows, _, weight = part.partition(":")
+        out.append((int(rows), float(weight or 1.0)))
+    return out
+
+
+def placement_report(dirname, chips=8, hbm_gb=16.0, peak_tflops=197.0,
+                     hbm_gbps=820.0, link_gbps=45.0, batch_mix="1:0.7,8:0.3",
+                     p95_ms=None, seq_len=None, decode_slots=0):
+    """(report_text, chosen_plan_or_None) — the testable core of
+    ``cmd_placement``."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.serving.placement import (DeviceInventory,
+                                              NoFeasiblePlacement,
+                                              PlacementSearcher,
+                                              TrafficProfile, plan_table,
+                                              profile_export)
+
+    prof = profile_export(dirname)
+    inv = DeviceInventory(chips, hbm_gb=hbm_gb, peak_tflops=peak_tflops,
+                          hbm_gbps=hbm_gbps, link_gbps=link_gbps)
+    traffic = TrafficProfile(_parse_batch_mix(batch_mix), seq_len=seq_len,
+                             p95_budget_ms=p95_ms, decode_slots=decode_slots)
+    searcher = PlacementSearcher(prof, inv, traffic)
+    lines = [f"{dirname}: {prof.cfg['n_layers']}L x d{prof.cfg['d_model']} "
+             f"x ff{prof.cfg['d_ff']} x V{prof.cfg['vocab']} "
+             f"({prof.param_bytes / 2**30:.3f} GiB params, "
+             f"xla_flops/row={prof.xla_flops})",
+             f"inventory: {chips} x {hbm_gb} GiB @ {peak_tflops} TFLOP/s, "
+             f"link {link_gbps} GB/s",
+             plan_table(searcher.all_plans())]
+    try:
+        chosen = searcher.search()
+    except NoFeasiblePlacement as e:
+        lines.append(f"NO FEASIBLE PLAN: {e}")
+        return "\n".join(lines), None
+    lines.append(
+        f"chosen: dp={chosen.dp} tp={chosen.tp} "
+        f"({chosen.devices} chips)  per-device HBM "
+        f"{chosen.hbm_bytes_per_device / 2**30:.3f} GiB "
+        f"({chosen.hbm_fraction:.0%})  comm "
+        f"{chosen.collective_bytes_per_step / 2**20:.2f} MiB/step over "
+        f"{chosen.collectives_per_dispatch} all-gathers  predicted "
+        f"{chosen.predicted_qps:.1f} QPS "
+        f"({chosen.predicted_qps_per_chip:.1f}/chip) at p95 "
+        f"{chosen.predicted_p95_ms:.2f} ms")
+    return "\n".join(lines), chosen
+
+
+def cmd_placement(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py placement",
+        description="search (dp, tp) parallelism placements for an "
+                    "exported inference dir under the §18 cost model")
+    ap.add_argument("export_dir", help="io.save_inference_model output dir")
+    ap.add_argument("--chips", type=int, default=8)
+    ap.add_argument("--hbm-gb", type=float, default=16.0)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--hbm-gbps", type=float, default=820.0)
+    ap.add_argument("--link-gbps", type=float, default=45.0)
+    ap.add_argument("--batch-mix", default="1:0.7,8:0.3",
+                    metavar="ROWS:W,...", help="traffic batch-size mix")
+    ap.add_argument("--p95-ms", type=float, default=None,
+                    help="fixed p95 budget (plans over it are infeasible)")
+    ap.add_argument("--seq-len", type=int, default=None)
+    ap.add_argument("--decode-slots", type=int, default=0,
+                    help="account a decode KV pool of this many slots")
+    args = ap.parse_args(argv)
+    report, chosen = placement_report(
+        args.export_dir, chips=args.chips, hbm_gb=args.hbm_gb,
+        peak_tflops=args.peak_tflops, hbm_gbps=args.hbm_gbps,
+        link_gbps=args.link_gbps, batch_mix=args.batch_mix,
+        p95_ms=args.p95_ms, seq_len=args.seq_len,
+        decode_slots=args.decode_slots)
+    print(report)
+    return 0 if chosen is not None else 1
+
+
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle_cli.py {train|version|trace|fleet} [args...]")
+        print("usage: paddle_cli.py {train|version|trace|fleet|placement} "
+              "[args...]")
         return 0
     sub = sys.argv[1]
     if sub == "version":
@@ -256,7 +351,10 @@ def main():
         return cmd_trace(sys.argv[2:])
     if sub == "fleet":
         return cmd_fleet(sys.argv[2:])
-    print(f"unknown subcommand {sub!r}; use train|version|trace|fleet")
+    if sub == "placement":
+        return cmd_placement(sys.argv[2:])
+    print(f"unknown subcommand {sub!r}; use "
+          f"train|version|trace|fleet|placement")
     return 2
 
 

@@ -294,7 +294,7 @@ def _print_fleet_report(fleet, r):
         print(f"shed_by_tenant={snap['shed_by_tenant']} "
               f"quota_by_tenant={snap['quota_by_tenant']}")
     print(f"{'replica':<22}{'health':<10}{'circuit':<10}{'queue':>6}"
-          f"{'occ':>5}{'served':>8}{'p95_ms':>9}{'mfu':>10}")
+          f"{'occ':>5}{'served':>8}{'p95_ms':>9}{'mfu':>10}{'shards':>7}")
     for info in snap["replicas"]:
         ep = info["endpoint"]
         srv = next((s for s in fleet.servers
@@ -310,7 +310,8 @@ def _print_fleet_report(fleet, r):
               f"{int(info['queue_depth'] or 0):>6}"
               f"{int(info['occupancy'] or 0):>5}"
               f"{served:>8}{p95:>9}"
-              f"{(info['mfu'] or 0.0):>10.2e}")
+              f"{(info['mfu'] or 0.0):>10.2e}"
+              f"{info.get('shards', 1):>7}")
 
 
 def bench(endpoint, feeds, clients, duration, retries=0, deadline_ms=None):
@@ -372,6 +373,10 @@ def _main_fleet(args, shapes, tracer):
                      "batch_timeout_ms": args.batch_timeout_ms,
                      "queue_capacity": args.queue_capacity,
                      "pipeline_depth": args.pipeline_depth}
+    if args.mesh is not None:
+        # each replica becomes a sharded model group: the router's scraped
+        # gauges (MFU, shard HBM, occupancy) aggregate across its shards
+        server_kwargs["mesh"] = args.mesh
     if args.generate:
         decode = {"gen_queue_capacity": args.queue_capacity}
         if args.max_slots is not None:
@@ -477,6 +482,14 @@ def main(argv=None):
                          "bench THROUGH the router (requires --model-dir); "
                          "composes with --chaos (fleet-level kill/restart/"
                          "partition/slow storm) and --generate")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="span ONE model over an N-device mesh per server "
+                         "(tensor-parallel; serving/sharded.py). Composes "
+                         "with --fleet: each replica is a sharded model "
+                         "group whose scraped gauges (MFU, shard HBM) "
+                         "aggregate across its shards. Host runs need "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count set (this flag sets it when unset)")
     ap.add_argument("--tenant", action="append", default=[],
                     metavar="name:priority[:rate[:burst]]",
                     help="fleet tenant spec (repeatable); clients round-"
@@ -527,6 +540,18 @@ def main(argv=None):
                  "--model-dir")
     if args.fleet is not None and not args.model_dir:
         ap.error("--fleet spawns in-process replicas; it needs --model-dir")
+    if args.mesh is not None:
+        if not args.model_dir:
+            ap.error("--mesh builds in-process sharded engines; it needs "
+                     "--model-dir")
+        # the virtual-device flag must land before jax initializes its
+        # backends — this works because serve_bench only imports jax
+        # lazily through the server construction below
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(8, args.mesh)}").strip()
     retries = args.retries if args.retries is not None else \
         (8 if args.chaos else 0)
 
@@ -567,8 +592,13 @@ def main(argv=None):
                 batch_timeout_ms=args.batch_timeout_ms,
                 queue_capacity=args.queue_capacity,
                 pipeline_depth=args.pipeline_depth, warmup=True, chaos=chaos,
-                decode=decode)
+                decode=decode, mesh=args.mesh)
             endpoint = server.endpoint
+            if args.mesh is not None:
+                print(f"sharded engine: mesh dp={server.mesh_spec['dp']} "
+                      f"tp={server.mesh_spec['tp']} "
+                      f"({server.engine.expected_collectives_per_dispatch} "
+                      f"all-gathers/dispatch)")
             for n in server.engine.feed_names:
                 if n not in shapes:
                     var = server.engine._feed_vars[n]
