@@ -235,6 +235,7 @@ BARS = {
 # 0.1-4.8% of their steps)
 BAR_TOL = 0.02
 _FAILURES = []
+_WATCHDOG = [None]  # SLOWatchdog armed by main() (bench-round sanity SLO)
 
 
 def _emit(rec):
@@ -281,6 +282,22 @@ def _emit(rec):
             rec["obs"] = {"spans": spans, "trace_file": TRACE_FILE}
     except Exception:
         pass  # telemetry must never break the bench record
+    try:
+        # black-box attachment (docs §19): typed event counts + the SLO
+        # watchdog's evaluation ride every record, so a regressed round's
+        # JSON says WHAT happened (sheds, spikes, breaches), not just how
+        # fast it was
+        from paddle_tpu.obs import events as _ev
+
+        log = _ev.get_event_log()
+        if log.enabled:
+            rec.setdefault("obs", {})["events"] = log.counts()
+            rec["obs"]["events_dropped"] = log.dropped
+        if _WATCHDOG[0] is not None:
+            _WATCHDOG[0].evaluate_now()
+            rec.setdefault("obs", {})["slo"] = _WATCHDOG[0].summary()
+    except Exception:
+        pass
     print(json.dumps(rec))
 
 
@@ -1128,9 +1145,33 @@ def bench_sharded_serving():
 
 def main():
     from paddle_tpu import obs
+    from paddle_tpu.obs import SLO, SLOWatchdog, get_event_log, get_registry
 
     obs.enable()
     obs.get_tracer().clear()
+    # the black box rides every round: typed events (sheds, NaN sentinels,
+    # chaos) + an SLO watchdog whose summary lands in each record. The one
+    # declared bench SLO is a train-MFU sanity floor — a round whose MFU
+    # gauge reads ~0 while steps dispatched means the cost annotation or
+    # the dispatch pipeline broke, which the per-class bars would blame on
+    # the wrong thing.
+    get_event_log().enable()
+
+    def _mfu():
+        # the MFU gauge rides a 10 s RateWindow: during serving-only
+        # workloads (decode/sharded benches) no train step dispatches and
+        # the window decays to 0 — that is idleness, not a breach. Judge
+        # the floor only while training FLOPs are actually flowing.
+        r = get_registry()
+        rate = r.get("pt_train_flops_per_second")
+        if rate is None or rate.value <= 0:
+            return 1.0  # idle: vacuously above any sane MFU floor
+        g = r.get("pt_train_mfu")
+        return g.value if g is not None else 0.0
+
+    _WATCHDOG[0] = SLOWatchdog(
+        [SLO("train_mfu", 1e-4, _mfu, kind="gauge", floor=True,
+             consecutive=1)])
     for bench_fn, metric, unit in (
             (bench_transformer_lm,
              "transformer_lm_train_tokens_per_sec_per_chip", "tokens/sec"),

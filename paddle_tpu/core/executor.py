@@ -267,6 +267,12 @@ class Executor:
         # cache_key -> XLA cost-analysis FLOPs (annotated lazily on the
         # first run of each entry — obs/cost.py, feeds the MFU gauges)
         self._flops: Dict[Any, Any] = {}
+        # numerics-sentinel host state (flags.obs_sentinel, docs §19):
+        # EMAs for spike detection, the one-bundle-per-incident latch, and
+        # a dedicated monotone step counter for event attribution (the
+        # PRNG seed list is NOT a step id — an explicit seed repeats)
+        self._sentinel = {"loss_ema": None, "norm_ema": None,
+                          "nan_dumped": False, "steps": 0}
 
     # -- public API --
     def run(
@@ -416,6 +422,65 @@ class Executor:
                     f"(first bad index {np.argwhere(~np.isfinite(arr))[0].tolist()})"
                 )
 
+    #: a loss / update-norm this many times its EMA is a spike event
+    SENTINEL_SPIKE_FACTOR = 10.0
+
+    def _sentinel_check(self, step_ids, fetches, finite, norms) -> None:
+        """Host side of the numerics sentinels (flags.obs_sentinel,
+        docs §19): read the per-step finiteness bits and update norms the
+        compiled window stacked, emit step-attributed events (NaN, update-
+        norm spike, loss spike vs a running EMA), and dump ONE flight-
+        recorder bundle on the first NaN of the run. ``step_ids`` come
+        from this executor's dedicated sentinel step counter (monotone
+        across windows regardless of seeding mode). Never raises — the
+        sentinel observes a sick run, ``check_nan_inf`` is the killer."""
+        from ..obs import flight as obs_flight
+        from ..obs.events import get_event_log, init_from_flags
+
+        init_from_flags()  # obs_sentinel implies the event log
+        ev = get_event_log()
+        finite = np.asarray(finite).reshape(-1)
+        norms = np.asarray(norms, np.float64).reshape(-1)
+        losses = None
+        if fetches:
+            try:
+                a = np.asarray(fetches[0], np.float64)
+                losses = a.reshape(a.shape[0], -1).mean(axis=1)
+            except Exception:
+                losses = None
+        st = self._sentinel
+        for i, sid in enumerate(step_ids):
+            sid = int(sid)
+            if not bool(finite[i]):
+                if ev.enabled:
+                    ev.emit("nan_detected", severity="error", step=sid,
+                            update_norm=float(norms[i]),
+                            loss=(float(losses[i]) if losses is not None
+                                  else None))
+                if not st["nan_dumped"]:
+                    st["nan_dumped"] = True
+                    obs_flight.get_recorder().maybe_dump(
+                        {"type": "nan", "step": sid})
+                continue  # a NaN window must not poison the EMAs
+            n = float(norms[i])
+            ema = st["norm_ema"]
+            if ema is not None and ema > 0 \
+                    and n > self.SENTINEL_SPIKE_FACTOR * ema:
+                if ev.enabled:
+                    ev.emit("grad_norm_spike", severity="warn", step=sid,
+                            update_norm=n, ema=ema)
+            st["norm_ema"] = n if ema is None else 0.9 * ema + 0.1 * n
+            if losses is not None and np.isfinite(losses[i]):
+                l = float(abs(losses[i]))
+                lema = st["loss_ema"]
+                if lema is not None and lema > 0 \
+                        and l > self.SENTINEL_SPIKE_FACTOR * lema:
+                    if ev.enabled:
+                        ev.emit("loss_spike", severity="warn", step=sid,
+                                loss=float(losses[i]), ema=lema)
+                st["loss_ema"] = l if lema is None else \
+                    0.9 * lema + 0.1 * l
+
     # -- multi-step (pipelined) API --
     def run_steps(
         self,
@@ -517,13 +582,21 @@ class Executor:
         from ..flags import get_flag
         from ..profiler import RecordEvent  # lazy: profiler imports jax
 
+        # sentinel ON compiles a DIFFERENT program (extra finiteness /
+        # update-norm reductions stacked per step) — its own cache key;
+        # sentinel off reuses the exact PR-8 key and code path, so the
+        # off-path numerics are bit-identical by construction
+        sentinel = bool(get_flag("obs_sentinel"))
         cache_key = (program.uid, program.version, block_idx, step_sig,
                      tuple(fetch_names), self.amp, "steps", invariant, k)
+        if sentinel:
+            cache_key = cache_key + ("sentinel",)
         entry = self._cache_get_or_compile(
             cache_key, f"block{block_idx} steps k={k} sig={step_sig}",
             "executor_compile_steps",
             lambda: self._compile_steps(program, block_idx, feed_names,
-                                        fetch_names, invariant))
+                                        fetch_names, invariant,
+                                        sentinel=sentinel))
         fn, readonly_names, donated_names, state_out_names = entry
 
         readonly = {}
@@ -561,9 +634,12 @@ class Executor:
         from ..obs import get_tracer
 
         tr = get_tracer()
+        sent_finite = sent_norms = None
         with RecordEvent(f"executor_run_steps/block{block_idx}"):
             with tr.span("train/device_window", cat="train", k=k):
                 fetches, new_state = fn(feed_vals, readonly, state, keys)
+                if sentinel:
+                    fetches, sent_finite, sent_norms = fetches
                 for n in state_out_names:
                     scope.set(n, new_state[n])
             if return_numpy:
@@ -571,6 +647,11 @@ class Executor:
                     fetches = [np.asarray(v) for v in fetches]
         # the annotated FLOPs cover the WHOLE k-step window program
         _record_step_flops(flops, steps=k)
+        if sentinel:
+            base = self._sentinel["steps"]
+            self._sentinel["steps"] = base + k
+            self._sentinel_check(range(base + 1, base + k + 1), fetches,
+                                 sent_finite, sent_norms)
         if get_flag("check_nan_inf"):
             self._check_nan_inf(fetch_names, fetches, state_out_names,
                                 new_state)
@@ -615,22 +696,48 @@ class Executor:
         return jitted, readonly_names, donated_names, state_out_names
 
     def _compile_steps(self, program: Program, block_idx: int, feed_names,
-                       fetch_names, invariant: bool):
+                       fetch_names, invariant: bool, sentinel: bool = False):
         """Roll the traced step into a ``lax.scan`` over the window.
 
         The carry is the FULL state-out dict (donated, so params update in
         place across the whole window); per-step fetches stack as scan ys.
         The body compiles once regardless of k — window length only changes
         the leading axis of the stacked inputs.
+
+        ``sentinel`` (flags.obs_sentinel, docs §19) stacks two extra ys
+        per step — a global finiteness bit over fetches + updated state,
+        and the l2 norm of the parameter update (under SGD a scaled grad
+        norm) — cheap fused reductions the host sentinel reads at window
+        boundaries. OFF leaves this function byte-for-byte the PR-8 path.
         """
         step, readonly_names, donated_names, state_out_names = build_step_fn(
             program, block_idx, feed_names, fetch_names, amp=self.amp
         )
 
+        def _is_float(a):
+            return jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+
         def one_step(state, feed_k, readonly, key):
             donated = {n: state[n] for n in donated_names}
             fetches, new_state = step(feed_k, readonly, donated, key)
-            return {**state, **new_state}, fetches
+            merged = {**state, **new_state}
+            if not sentinel:
+                return merged, fetches
+            finite = jnp.bool_(True)
+            for v in list(fetches) + [new_state[n] for n in state_out_names
+                                      if n in new_state]:
+                if _is_float(v):
+                    finite = jnp.logical_and(
+                        finite, jnp.all(jnp.isfinite(
+                            jnp.asarray(v, jnp.float32))))
+            sq = jnp.float32(0.0)
+            for n in donated_names:
+                if not _is_float(merged[n]):
+                    continue
+                d = (jnp.asarray(merged[n], jnp.float32)
+                     - jnp.asarray(state[n], jnp.float32))
+                sq = sq + jnp.sum(d * d)
+            return merged, (fetches, finite, jnp.sqrt(sq))
 
         if invariant:
             def multi(feed_vals, readonly, state, keys):

@@ -62,6 +62,20 @@ _DEFAULTS: Dict[str, Any] = {
     # chip peak for the MFU gauges, TFLOP/s (bench.py's TPU v5 lite bf16
     # nominal); the gauge is flops_per_sec / (obs_peak_tflops * 1e12)
     "obs_peak_tflops": 197.0,
+    # structured event log (obs/events.py, docs/design.md §19): obs_events
+    # turns the black box on (zero-cost disabled — every emit site is one
+    # attribute read); capacity bounds the overwrite ring
+    "obs_events": False,
+    "obs_events_capacity": 4096,
+    # training numerics sentinels (docs/design.md §19): adds cheap
+    # finiteness + update-norm reductions to every run_steps window and
+    # host-side loss-spike detection; first NaN emits a step-attributed
+    # event and dumps a flight-recorder bundle. Implies obs_events. The
+    # OFF path compiles the exact PR-8 program (bit-identity tested).
+    "obs_sentinel": False,
+    # where automatic postmortem bundles land ("" = <tempdir>/
+    # paddle_tpu_flight); obs/flight.py FlightRecorder.dump
+    "obs_flight_dir": "",
 }
 
 _flags: Dict[str, Any] = {}

@@ -18,9 +18,22 @@ cannot attribute.
   executor and serving compile caches), powering the live MFU gauges.
 * ``http``    — ``MetricsServer``: a standalone ``GET /metrics`` endpoint
   for training jobs (ServingServer answers /metrics on its own port).
+* ``events``  — ``EventLog``: typed, bounded, thread-safe structured
+  events (health transitions, circuit trips, failovers, reloads, sheds,
+  chaos injections, NaN sentinels) with pluggable sinks incl. a stdlib-
+  ``logging`` one-line-JSON bridge; zero-cost when disabled (docs §19).
+* ``flight``  — ``FlightRecorder``: postmortem bundles (events + span
+  exemplars + metrics + flags + provider snapshots), sampled request
+  capture and a bit-identical replay harness, triggered by worker-thread
+  crashes / SLO breaches / NaN sentinels / signals / ``dump()``.
+* ``slo``     — ``SLOWatchdog``: declarative multi-window burn-rate SLOs
+  (p95 ceiling, error-rate budget, MFU / decode-tokens floors) evaluated
+  off the existing registry; breaches export ``pt_slo_*``, emit events,
+  and trip flight-recorder dumps.
 
 Turn tracing on with ``flags.set_flag("obs_trace", True)`` (or
-``PT_FLAG_OBS_TRACE=1``), or programmatically ``obs.enable()``.
+``PT_FLAG_OBS_TRACE=1``), or programmatically ``obs.enable()``; the
+event log with ``obs_events`` / ``events.get_event_log().enable()``.
 """
 from .trace import (ExemplarStore, Span, Tracer, disable, enable,  # noqa: F401
                     get_tracer, init_from_flags, new_trace_id)
@@ -28,11 +41,19 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       RateWindow, get_registry)
 from .cost import abstractify, analyze_jit, flops_of_lowered, peak_flops  # noqa: F401
 from .http import MetricsServer  # noqa: F401
+from .events import (DISCARDED, Event, EventLog,  # noqa: F401
+                     LoggingJSONSink, enable_json_logging, get_event_log)
+from .flight import (FlightRecorder, get_recorder, load_bundle,  # noqa: F401
+                     replay_bundle, validate_bundle)
+from .slo import SLO, SLOWatchdog, judge_bench, parse_slo_spec  # noqa: F401
 
 __all__ = [
-    "Counter", "ExemplarStore", "Gauge", "Histogram", "MetricsRegistry",
-    "MetricsServer", "RateWindow", "Span", "Tracer", "abstractify",
-    "analyze_jit",
-    "disable", "enable", "flops_of_lowered", "get_registry", "get_tracer",
-    "init_from_flags", "new_trace_id", "peak_flops",
+    "Counter", "DISCARDED", "Event", "EventLog", "ExemplarStore",
+    "FlightRecorder", "Gauge", "Histogram", "LoggingJSONSink",
+    "MetricsRegistry", "MetricsServer", "RateWindow", "SLO", "SLOWatchdog",
+    "Span", "Tracer", "abstractify", "analyze_jit",
+    "disable", "enable", "enable_json_logging", "flops_of_lowered",
+    "get_event_log", "get_recorder", "get_registry", "get_tracer",
+    "init_from_flags", "judge_bench", "load_bundle", "new_trace_id",
+    "parse_slo_spec", "peak_flops", "replay_bundle", "validate_bundle",
 ]

@@ -1,0 +1,289 @@
+"""Structured event log: the black-box half of the obs plane (docs §19).
+
+PR 5 answers "how fast is it *right now*" — gauges, histograms, spans.
+Nothing records *what happened*: health transitions, circuit trips,
+failovers, reload commits, shed decisions, chaos injections and NaN
+sentinels exist only as counters, and the evidence dies with the process.
+This module is the typed, bounded, thread-safe event log every subsystem
+emits into; the flight recorder (obs/flight.py) snapshots it into
+postmortem bundles and ``paddle_cli doctor`` reconstructs incident
+timelines from it.
+
+Design constraints (the PR-5 discipline, verbatim):
+
+* **zero-cost when disabled** — every instrumentation site is guarded by
+  one ``log.enabled`` attribute read; a disabled ``emit()`` records
+  nothing and returns one shared ``DISCARDED`` sentinel (identity-tested
+  like the tracer's no-op span).
+* **bounded** — events land in an overwrite ring with a ``dropped``
+  counter; a week of chaos cannot leak memory through its own black box.
+* **typed** — ``type`` comes from the taxonomy below (unknown types are
+  allowed but counted under their own label); each event carries
+  monotonic time, wall time, severity, and trace/step id links so the
+  doctor can join events against spans and SLO breaches.
+* **counted** — every recorded event increments
+  ``pt_events_total{type,severity}`` in the log's registry, so even a
+  rotated-out event leaves a scrape-able trace.
+* **pluggable sinks** — ``add_sink(fn)`` fans each event out (e.g. the
+  stdlib-``logging`` one-line-JSON bridge, ``LoggingJSONSink``); a sink
+  that raises is counted (``sink_errors``), never allowed to take down
+  the hot path.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: the event taxonomy (docs/design.md §19). Emitting an unlisted type is
+#: legal — the list documents what the instrumented tree produces and what
+#: ``paddle_cli doctor`` knows how to rank.
+EVENT_TYPES = (
+    # serving / fleet plane
+    "health_transition",     # healthy/degraded/draining (+ fleet scope)
+    "circuit_open", "circuit_half_open", "circuit_close",
+    "failover", "hedge", "hedge_win",
+    "reload_stage", "reload_commit",
+    "scale_event",
+    "deadline_shed", "load_shed", "quota_reject", "queue_full",
+    "batch_failed", "decode_step_failed",
+    "no_healthy_replicas",
+    "replica_unreachable", "replica_reachable",
+    # chaos plane
+    "chaos_inject",
+    # training numerics sentinels
+    "nan_detected", "loss_spike", "grad_norm_spike",
+    # watchdog / recorder
+    "slo_breach", "worker_exception", "bundle_dumped",
+)
+
+SEVERITIES = ("debug", "info", "warn", "error")
+
+
+class Event:
+    """One recorded occurrence. ``t`` is monotonic seconds (joinable with
+    span timestamps), ``wall`` unix seconds (human timelines), ``step``
+    a training step id, ``trace_id`` the request correlation id."""
+
+    __slots__ = ("eid", "type", "severity", "t", "wall", "trace_id",
+                 "step", "attrs")
+
+    def __init__(self, eid, type, severity, t, wall, trace_id, step, attrs):
+        self.eid = eid
+        self.type = type
+        self.severity = severity
+        self.t = t
+        self.wall = wall
+        self.trace_id = trace_id
+        self.step = step
+        self.attrs = attrs
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"eid": self.eid, "type": self.type, "severity": self.severity,
+             "t": self.t, "wall": self.wall}
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.step is not None:
+            d["step"] = self.step
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class _Discarded:
+    """Shared sentinel a disabled ``emit()`` returns — the identity test
+    asserts no per-call allocation on the disabled path."""
+
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return "<event discarded: log disabled>"
+
+
+DISCARDED = _Discarded()
+
+
+class EventLog:
+    """Bounded, thread-safe ring of typed events + sink fan-out."""
+
+    def __init__(self, capacity: int = 4096, registry=None):
+        self._lock = threading.Lock()
+        self._enabled = False
+        self.capacity = max(1, int(capacity))
+        self._ring: List[Event] = []
+        self._next = 0
+        self._eid = 0
+        self.dropped = 0
+        self.sink_errors = 0
+        self._sinks: List[Callable[[Event], None]] = []
+        self._registry = registry
+        self._counter = None  # lazy: pt_events_total{type,severity}
+
+    # -- switches --
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity: Optional[int] = None) -> "EventLog":
+        with self._lock:
+            if capacity is not None and max(1, int(capacity)) != self.capacity:
+                self.capacity = max(1, int(capacity))
+                self._ring = []
+                self._next = 0
+            self._enabled = True
+        return self
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._next = 0
+            self.dropped = 0
+
+    # -- sinks --
+    def add_sink(self, fn: Callable[[Event], None]) -> None:
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn: Callable[[Event], None]) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    def _count(self, type: str, severity: str) -> None:
+        c = self._counter
+        if c is None:
+            from .metrics import get_registry
+
+            r = self._registry or get_registry()
+            c = self._counter = r.counter(
+                "pt_events_total", "Structured events by type and severity",
+                labelnames=("type", "severity"))
+        try:
+            c.labels(type=type, severity=severity).inc()
+        except Exception:
+            pass  # a broken registry must not take down the emitter
+
+    # -- recording --
+    def emit(self, type: str, severity: str = "info",
+             trace_id: Optional[str] = None, step: Optional[int] = None,
+             **attrs):
+        """Record one event; returns it (or ``DISCARDED`` when disabled).
+        Hot-path sites guard with ``if log.enabled:`` so a disabled log
+        costs one attribute read and zero allocation."""
+        if not self._enabled:
+            return DISCARDED
+        if severity not in SEVERITIES:
+            severity = "info"
+        now = time.monotonic()
+        with self._lock:
+            self._eid += 1
+            ev = Event(self._eid, type, severity, now, time.time(),
+                       trace_id, step, attrs or None)
+            if len(self._ring) < self.capacity:
+                self._ring.append(ev)
+            else:
+                self._ring[self._next] = ev
+                self.dropped += 1
+            self._next = (self._next + 1) % self.capacity
+            sinks = list(self._sinks)
+        self._count(type, severity)
+        for s in sinks:
+            try:
+                s(ev)
+            except Exception:
+                self.sink_errors += 1
+        return ev
+
+    # -- reading --
+    def events(self, type: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               min_severity: Optional[str] = None) -> List[Event]:
+        """Recorded events oldest-first, optionally filtered."""
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                out = list(self._ring)
+            else:
+                out = self._ring[self._next:] + self._ring[:self._next]
+        if type is not None:
+            out = [e for e in out if e.type == type]
+        if trace_id is not None:
+            out = [e for e in out if e.trace_id == trace_id]
+        if min_severity is not None:
+            floor = SEVERITIES.index(min_severity)
+            out = [e for e in out
+                   if SEVERITIES.index(e.severity) >= floor]
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        """{type: count} over the RETAINED ring (rotated-out events live
+        on only in ``pt_events_total``)."""
+        out: Dict[str, int] = {}
+        for e in self.events():
+            out[e.type] = out.get(e.type, 0) + 1
+        return out
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return [e.to_dict() for e in self.events()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class LoggingJSONSink:
+    """Bridge events into stdlib ``logging`` as one-line JSON — the
+    structured-logging satellite: faults were silently counted, now every
+    one is a grep-able log line. Severity maps onto logging levels."""
+
+    LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+              "warn": logging.WARNING, "error": logging.ERROR}
+
+    def __init__(self, logger: str = "paddle_tpu.events"):
+        self._log = logging.getLogger(logger)
+
+    def __call__(self, ev: Event) -> None:
+        self._log.log(self.LEVELS.get(ev.severity, logging.INFO),
+                      json.dumps(ev.to_dict(), sort_keys=True, default=str))
+
+
+_default = EventLog()
+_json_sink: Optional[LoggingJSONSink] = None
+_json_lock = threading.Lock()
+
+
+def get_event_log() -> EventLog:
+    """The process-wide default event log every instrumentation site
+    emits into (the event-plane sibling of ``get_tracer()``)."""
+    return _default
+
+
+def enable_json_logging(logger: str = "paddle_tpu.events") -> EventLog:
+    """Enable the default log (if off) and attach ONE shared stdlib-
+    ``logging`` JSON sink — the ``log_json=`` / ``--log-json`` wiring.
+    Idempotent."""
+    global _json_sink
+    with _json_lock:
+        if _json_sink is None:
+            _json_sink = LoggingJSONSink(logger)
+            _default.add_sink(_json_sink)
+    if not _default.enabled:
+        _default.enable()
+    return _default
+
+
+def init_from_flags() -> EventLog:
+    """Honor ``flags.obs_events`` / ``obs_events_capacity`` (an env var
+    alone turns the black box on); ``obs_sentinel`` implies events — a
+    sentinel with nowhere to record would be a silent sentinel."""
+    from ..flags import get_flag
+
+    if not _default.enabled and (get_flag("obs_events")
+                                 or get_flag("obs_sentinel")):
+        _default.enable(int(get_flag("obs_events_capacity")))
+    return _default

@@ -1,0 +1,460 @@
+"""FlightRecorder: postmortem bundles + sampled request capture + replay.
+
+The black-box flight recorder of the serving/training stack (docs §19).
+It holds *references* to the live telemetry — the event log, the tracer's
+p99 exemplars, metrics registries, flags, and any registered providers
+(each ``ServingServer`` / ``FleetRouter`` / ``SLOWatchdog`` contributes a
+snapshot callable) — and, on a trigger, freezes everything into ONE
+schema-versioned JSON bundle an operator can carry away from the incident:
+
+* **triggers** — an unhandled exception on a paddle-tpu worker thread
+  (``arm()`` chains ``threading.excepthook``), an SLO breach (the
+  watchdog calls ``maybe_dump``), the first training NaN (executor
+  sentinel), a signal (``install_signal_handler``), or an explicit
+  ``dump()``. Automatic triggers are rate-limited per reason so a breach
+  storm cannot write a thousand bundles.
+* **zero-cost when off** — the recorder does nothing until triggered;
+  the only hot-path touch is the *sampled* request capture, guarded by
+  one counter compare at the serving handler.
+* **request capture + replay** — 1-in-N successful predict/generate
+  requests are captured (inputs, bucket signature, seed, weights
+  version, output digest) into a bounded ring; ``replay_bundle()``
+  re-runs each capture against a FRESH engine built from the recorded
+  export dir and verifies bit-identical outputs (serving is
+  deterministic: frozen weights, fixed PRNG key, greedy decode).
+
+Bundle schema v1 (validated by ``validate_bundle``)::
+
+    {schema_version, created_unix, created_monotonic, trigger,
+     events: [...], events_dropped, event_counts,
+     exemplars: [...], metrics: {name: prometheus_text},
+     flags: {...}, providers: {name: {...}}, captures: [...],
+     process: {python, jax, pid}}
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .events import get_event_log
+from .metrics import get_registry
+from .trace import get_tracer
+
+SCHEMA_VERSION = 1
+
+#: keys every v1 bundle must carry (validate_bundle enforces)
+REQUIRED_KEYS = ("schema_version", "created_unix", "created_monotonic",
+                 "trigger", "events", "events_dropped", "event_counts",
+                 "exemplars", "metrics", "flags", "providers", "captures",
+                 "process")
+
+#: encoded arrays above this many bytes keep only their digest (bundles
+#: must stay carry-able; the digest alone still proves bit-identity)
+MAX_CAPTURE_BYTES = 1 << 20
+
+
+def encode_array(arr: np.ndarray) -> Dict[str, Any]:
+    arr = np.ascontiguousarray(arr)
+    out: Dict[str, Any] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    if arr.nbytes <= MAX_CAPTURE_BYTES:
+        out["data"] = arr.tolist()
+    return out
+
+
+def decode_array(spec: Dict[str, Any]) -> np.ndarray:
+    return np.asarray(spec["data"], dtype=spec["dtype"]).reshape(
+        spec["shape"])
+
+
+def output_digest(arrays) -> str:
+    """Canonical sha256 over (dtype, shape, raw bytes) of every output —
+    the bit-identity witness replay compares against."""
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class FlightRecorder:
+    """Snapshot the live telemetry into postmortem bundles on triggers."""
+
+    def __init__(self, events=None, tracer=None, registry=None,
+                 dir: Optional[str] = None, capture_limit: int = 64,
+                 min_dump_interval_s: float = 2.0):
+        self.events = events or get_event_log()
+        self.tracer = tracer or get_tracer()
+        self.registry = registry or get_registry()
+        self.dir = dir  # None -> flags.obs_flight_dir -> tempdir
+        self.capture_limit = int(capture_limit)
+        self.min_dump_interval_s = float(min_dump_interval_s)
+        self._lock = threading.Lock()
+        self._providers: Dict[str, Callable[[], Any]] = {}
+        self._captures: deque = deque(maxlen=self.capture_limit)
+        self._capture_seq = 0
+        self._last_dump: Dict[str, float] = {}  # trigger type -> monotonic
+        self.dumps: List[str] = []  # bundle paths written
+        self.dump_errors = 0
+        self._armed = False
+        self._prev_excepthook = None
+
+    # -- providers ---------------------------------------------------------
+    def register_provider(self, name: str, fn: Callable[[], Any]) -> str:
+        """Register a snapshot callable whose result lands under
+        ``providers[name]`` in every bundle (a server's weights version +
+        placement, a router's replica table, the watchdog's summary).
+        Returns the name as an unregister token."""
+        with self._lock:
+            self._providers[name] = fn
+        return name
+
+    def unregister_provider(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    # -- request capture ---------------------------------------------------
+    def capture_predict(self, model_dir: str, feeds: Dict[str, Any],
+                        outputs, weights_version=None,
+                        trace_id: Optional[str] = None,
+                        seed: int = 0) -> None:
+        """Record one successful predict: inputs, bucket signature, seed,
+        weights version, and the outputs' digest (+ data when small).
+        Never raises — capture is telemetry, not the data path."""
+        try:
+            enc = {n: encode_array(np.asarray(a)) for n, a in feeds.items()}
+            rows = next(iter(enc.values()))["shape"][0] if enc else 0
+            sig = sorted((n, s["shape"][1:], s["dtype"])
+                         for n, s in enc.items())
+            with self._lock:
+                self._capture_seq += 1
+                self._captures.append({
+                    "id": self._capture_seq, "kind": "predict",
+                    "model_dir": model_dir, "feeds": enc, "rows": rows,
+                    "bucket_sig": sig, "seed": int(seed),
+                    "weights_version": weights_version,
+                    "trace_id": trace_id, "wall": time.time(),
+                    "outputs": [encode_array(np.asarray(o))
+                                for o in outputs],
+                    "digest": output_digest(outputs)})
+        except Exception:
+            pass
+
+    def capture_generate(self, model_dir: str, prompt,
+                         max_new_tokens: Optional[int], eos_id,
+                         tokens, weights_version=None,
+                         trace_id: Optional[str] = None) -> None:
+        """Record one successful generation (prompt, budget, eos, weights
+        version, produced token ids). Never raises."""
+        try:
+            with self._lock:
+                self._capture_seq += 1
+                self._captures.append({
+                    "id": self._capture_seq, "kind": "generate",
+                    "model_dir": model_dir,
+                    "prompt": [int(t) for t in
+                               np.asarray(prompt).reshape(-1)],
+                    "max_new_tokens": (int(max_new_tokens)
+                                       if max_new_tokens is not None
+                                       else None),
+                    "eos_id": int(eos_id) if eos_id is not None else None,
+                    "weights_version": weights_version,
+                    "trace_id": trace_id, "wall": time.time(),
+                    "tokens": [int(t) for t in tokens]})
+        except Exception:
+            pass
+
+    @property
+    def captures(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._captures)
+
+    # -- bundles -----------------------------------------------------------
+    def _resolve_dir(self) -> str:
+        if self.dir:
+            return self.dir
+        from ..flags import get_flag
+
+        d = get_flag("obs_flight_dir")
+        return d or os.path.join(tempfile.gettempdir(), "paddle_tpu_flight")
+
+    def snapshot(self, trigger: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        """Freeze the telemetry into one schema-v1 bundle dict."""
+        with self._lock:
+            providers = dict(self._providers)
+            captures = list(self._captures)
+        prov_out: Dict[str, Any] = {}
+        for name, fn in providers.items():
+            try:
+                prov_out[name] = fn()
+            except Exception as e:  # a dead provider must not kill the dump
+                prov_out[name] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            metrics = {"default": self.registry.expose()}
+        except Exception:
+            metrics = {}
+        try:
+            from ..flags import flags as _flags
+
+            flag_snap = _flags()
+        except Exception:
+            flag_snap = {}
+        try:
+            import jax
+
+            jax_ver = jax.__version__
+        except Exception:
+            jax_ver = None
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "created_unix": time.time(),
+            "created_monotonic": time.monotonic(),
+            "trigger": dict(trigger or {"type": "manual"}),
+            "events": self.events.snapshot(),
+            "events_dropped": self.events.dropped,
+            "event_counts": self.events.counts(),
+            "exemplars": self.tracer.exemplars.snapshot(),
+            "metrics": metrics,
+            "flags": flag_snap,
+            "providers": prov_out,
+            "captures": captures,
+            "process": {"python": sys.version.split()[0], "jax": jax_ver,
+                        "pid": os.getpid()},
+        }
+
+    def dump(self, path: Optional[str] = None,
+             trigger: Optional[Dict[str, Any]] = None) -> str:
+        """Write one bundle; returns its path. An explicit dump is never
+        rate-limited (the operator asked)."""
+        bundle = self.snapshot(trigger)
+        if path is None:
+            d = self._resolve_dir()
+            os.makedirs(d, exist_ok=True)
+            ttype = bundle["trigger"].get("type", "manual")
+            path = os.path.join(
+                d, f"flight_{ttype}_{int(time.time() * 1e3)}_"
+                   f"{os.getpid()}.json")
+        with open(path, "w") as f:
+            json.dump(bundle, f, default=str)
+        with self._lock:
+            self.dumps.append(path)
+        ev = self.events
+        if ev.enabled:
+            ev.emit("bundle_dumped", path=path,
+                    trigger=bundle["trigger"].get("type"))
+        return path
+
+    def maybe_dump(self, trigger: Dict[str, Any]) -> Optional[str]:
+        """Rate-limited automatic dump (one per trigger type per
+        ``min_dump_interval_s``); returns the path or None. Never raises
+        — an automatic trigger fires from hot/exception paths."""
+        ttype = trigger.get("type", "auto")
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(ttype, -1e18)
+            if now - last < self.min_dump_interval_s:
+                return None
+            self._last_dump[ttype] = now
+        try:
+            return self.dump(trigger=trigger)
+        except Exception:
+            self.dump_errors += 1
+            return None
+
+    def clear(self) -> None:
+        """Reset captures/dump history/rate limits (tests)."""
+        with self._lock:
+            self._captures.clear()
+            self._last_dump.clear()
+            self.dumps = []
+
+    # -- automatic triggers ------------------------------------------------
+    _WORKER_PREFIXES = ("paddle-tpu", "pt-fleet")
+
+    def arm(self, dir: Optional[str] = None) -> "FlightRecorder":
+        """Install the worker-thread crash trigger: an unhandled exception
+        on any ``paddle-tpu-*`` / ``pt-fleet-*`` thread (engine, batcher,
+        decode loop, fleet scraper/hedger, chaos) emits a
+        ``worker_exception`` event and dumps a bundle. Chains the previous
+        ``threading.excepthook``. Idempotent."""
+        if dir is not None:
+            self.dir = dir
+        if self._armed:
+            return self
+        self._armed = True
+        prev = threading.excepthook
+        self._prev_excepthook = prev
+        rec = self
+
+        def hook(args):
+            try:
+                name = getattr(args.thread, "name", "") or ""
+                if name.startswith(rec._WORKER_PREFIXES):
+                    ev = rec.events
+                    if ev.enabled:
+                        ev.emit("worker_exception", severity="error",
+                                thread=name,
+                                exc=f"{getattr(args.exc_type, '__name__', args.exc_type)}: "
+                                    f"{args.exc_value}")
+                    rec.maybe_dump({"type": "worker_exception",
+                                    "thread": name,
+                                    "exc": str(args.exc_value)})
+            except Exception:
+                pass
+            prev(args)
+
+        threading.excepthook = hook
+        return self
+
+    def disarm(self) -> None:
+        if self._armed and self._prev_excepthook is not None:
+            threading.excepthook = self._prev_excepthook
+        self._armed = False
+        self._prev_excepthook = None
+
+    def install_signal_handler(self, signum=None) -> None:
+        """SIGUSR2 (default) -> dump a bundle. Main thread only (a CPython
+        ``signal.signal`` constraint)."""
+        import signal as _signal
+
+        signum = _signal.SIGUSR2 if signum is None else signum
+
+        def _on(sig, frame):
+            threading.Thread(
+                target=lambda: self.maybe_dump({"type": "signal",
+                                                "signum": int(sig)}),
+                daemon=True, name="paddle-tpu-flight-dump").start()
+
+        _signal.signal(signum, _on)
+
+
+_default_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-wide default recorder (servers, routers, the executor
+    sentinel, and the SLO watchdog all feed/trip this one)."""
+    return _default_recorder
+
+
+# -- bundle validation -----------------------------------------------------
+
+def validate_bundle(bundle: Dict[str, Any]) -> List[str]:
+    """Schema-v1 check; returns a list of problems (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(bundle, dict):
+        return ["bundle is not a JSON object"]
+    for k in REQUIRED_KEYS:
+        if k not in bundle:
+            problems.append(f"missing key {k!r}")
+    if bundle.get("schema_version") != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {bundle.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}")
+    if not isinstance(bundle.get("trigger"), dict) or \
+            "type" not in (bundle.get("trigger") or {}):
+        problems.append("trigger must be a dict with a 'type'")
+    for i, ev in enumerate(bundle.get("events") or []):
+        for k in ("eid", "type", "severity", "t", "wall"):
+            if k not in ev:
+                problems.append(f"events[{i}] missing {k!r}")
+                break
+    for i, cap in enumerate(bundle.get("captures") or []):
+        kind = cap.get("kind")
+        if kind not in ("predict", "generate"):
+            problems.append(f"captures[{i}] bad kind {kind!r}")
+        elif kind == "predict" and ("feeds" not in cap
+                                    or "digest" not in cap):
+            problems.append(f"captures[{i}] predict missing feeds/digest")
+        elif kind == "generate" and ("prompt" not in cap
+                                     or "tokens" not in cap):
+            problems.append(f"captures[{i}] generate missing prompt/tokens")
+    return problems
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+# -- replay harness --------------------------------------------------------
+
+def replay_bundle(bundle, model_dir: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+    """Re-run every captured request against a FRESH engine built from
+    the capture's recorded export dir (``model_dir`` overrides, e.g. the
+    bundle traveled to another machine) and verify bit-identical outputs.
+
+    Predicts re-run through ``ServingEngine.run_batch`` (same bucket
+    ladder, same fixed PRNG key) and compare output digests; generations
+    re-run through ``generate_sequential`` (the same compiled signatures
+    the continuous batcher used — lane-independent math) and compare
+    exact token ids. Returns one ``{id, kind, ok, detail}`` per capture
+    (``ok=None`` = skipped: a digest-only capture whose inputs were too
+    large to travel — not a bit-identity failure).
+    """
+    if isinstance(bundle, str):
+        bundle = load_bundle(bundle)
+    # lazy: obs must stay importable without the serving tree
+    from ..serving.decode import DecodeEngine, generate_sequential
+    from ..serving.engine import ServingEngine
+
+    results: List[Dict[str, Any]] = []
+    engines: Dict[str, ServingEngine] = {}
+    dengines: Dict[str, DecodeEngine] = {}
+    for cap in bundle.get("captures") or []:
+        d = model_dir or cap.get("model_dir")
+        entry = {"id": cap.get("id"), "kind": cap.get("kind"),
+                 "weights_version": cap.get("weights_version")}
+        try:
+            if cap["kind"] == "predict":
+                if any("data" not in s for s in cap["feeds"].values()):
+                    # digest-only capture (a feed exceeded
+                    # MAX_CAPTURE_BYTES): the inputs did not travel, so
+                    # bit-identity cannot be re-verified — skipped, not
+                    # failed
+                    entry["ok"] = None
+                    entry["detail"] = ("skipped: feeds captured "
+                                       "digest-only (over the capture "
+                                       "size limit)")
+                    results.append(entry)
+                    continue
+                eng = engines.get(d)
+                if eng is None:
+                    eng = engines[d] = ServingEngine(
+                        d, max_batch_size=max(32, int(cap.get("rows") or 1)))
+                feeds = {n: decode_array(s)
+                         for n, s in cap["feeds"].items()}
+                outs = eng.run_batch(feeds)
+                got = output_digest(outs)
+                entry["ok"] = got == cap["digest"]
+                entry["detail"] = ("bit-identical" if entry["ok"] else
+                                   f"digest {got[:12]} != "
+                                   f"{cap['digest'][:12]}")
+            else:
+                deng = dengines.get(d)
+                if deng is None:
+                    deng = dengines[d] = DecodeEngine(d, max_slots=1)
+                budget = cap.get("max_new_tokens") or len(cap["tokens"])
+                toks = generate_sequential(
+                    deng, [np.asarray(cap["prompt"], np.int64)], budget,
+                    eos_id=cap.get("eos_id"))[0]
+                entry["ok"] = toks == list(cap["tokens"])
+                entry["detail"] = ("bit-identical" if entry["ok"] else
+                                   f"tokens {toks} != {cap['tokens']}")
+        except Exception as e:
+            entry["ok"] = False
+            entry["detail"] = f"replay error: {type(e).__name__}: {e}"
+        results.append(entry)
+    return results

@@ -18,9 +18,10 @@ Scrape-pull only; nothing here ever blocks a training step.
 """
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from .metrics import MetricsRegistry, get_registry
 
@@ -35,6 +36,16 @@ class _Handler(BaseHTTPRequestHandler):
             body = srv.registry.expose().encode()
             self.send_response(200)
             self.send_header("Content-Type", CONTENT_TYPE)
+        elif path == "/healthz" and srv.healthz_cb is not None:
+            # a caller-supplied liveness dict (the fleet router serves its
+            # state/pressure here) — JSON, like ServingServer's healthz
+            try:
+                body = (json.dumps(srv.healthz_cb()) + "\n").encode()
+                self.send_response(200)
+            except Exception:
+                body = b"{\"ok\": false}\n"
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
         elif path == "/healthz":
             body = b"ok\n"
             self.send_response(200)
@@ -59,9 +70,11 @@ class MetricsServer(ThreadingHTTPServer):
     allow_reuse_address = True
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 healthz: Optional[Callable[[], dict]] = None):
         super().__init__((host, port), _Handler)
         self.registry = registry or get_registry()
+        self.healthz_cb = healthz
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True,
                                         name="paddle-tpu-metrics")

@@ -1,0 +1,341 @@
+"""Declarative multi-window burn-rate SLO watchdog (docs §19).
+
+PR 5's gauges say what the system is doing; nothing says whether that is
+*acceptable* or rings when it stops being so. This module evaluates
+declared objectives off the EXISTING telemetry (no new instrumentation on
+the hot paths):
+
+* **ratio SLOs** (error rate): the classic SRE shape. Budget = the
+  allowed bad fraction (``target``); burn rate = observed_fraction /
+  target over a window. Evaluated over TWO windows (fast + slow, e.g.
+  5 s / 60 s in-process): a breach requires BOTH above
+  ``burn_threshold``, so a single bad second cannot page while a
+  sustained burn cannot hide in a long average.
+* **gauge SLOs** (p95 latency ceiling, MFU floor, decode tokens/s
+  floor): burn = value / target (ceilings) or target / value (floors);
+  a breach requires ``consecutive`` evaluations over threshold — the
+  gauge analogue of the two-window rule.
+
+The watchdog exports ``pt_slo_burn_rate{slo}`` and
+``pt_slo_breach_total{slo}``, emits a typed ``slo_breach`` event per
+breach, and trips the flight recorder (``maybe_dump`` — rate-limited) so
+every breach leaves a postmortem bundle behind. ``judge_bench`` is the
+offline twin: it judges a finished serve_bench run against declared SLOs
+(the serving counterpart of bench.py's per-class bars) with nonzero exit
+on breach.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+_EPS = 1e-12
+
+
+class SLO:
+    """One declared objective.
+
+    ``kind='ratio'``: ``read(window_s) -> (bad, total)``; burn =
+    (bad/total) / target per window; breach when every window burns past
+    ``burn_threshold``.
+
+    ``kind='gauge'``: ``read() -> value``; burn = value/target (ceiling)
+    or target/value (``floor=True``); breach after ``consecutive``
+    evaluations over threshold.
+    """
+
+    def __init__(self, name: str, target: float, read: Callable,
+                 kind: str = "gauge", floor: bool = False,
+                 windows: Sequence[float] = (5.0, 60.0),
+                 burn_threshold: float = 1.0, consecutive: int = 2):
+        if kind not in ("ratio", "gauge"):
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        self.name = name
+        self.target = float(target)
+        self.read = read
+        self.kind = kind
+        self.floor = bool(floor)
+        self.windows = tuple(float(w) for w in windows)
+        self.burn_threshold = float(burn_threshold)
+        self.consecutive = max(1, int(consecutive))
+        self._over_streak = 0
+
+    def burns(self) -> List[float]:
+        """Current burn rate per window (gauge SLOs report one value)."""
+        if self.kind == "ratio":
+            out = []
+            for w in self.windows:
+                bad, total = self.read(w)
+                frac = bad / total if total else 0.0
+                out.append(frac / max(self.target, _EPS))
+            return out
+        v = float(self.read())
+        if self.floor:
+            return [self.target / max(v, _EPS)]
+        return [v / max(self.target, _EPS)]
+
+    def evaluate(self) -> Dict[str, Any]:
+        """One evaluation: burn rates + the (streak-aware) breach bit."""
+        burns = self.burns()
+        over = all(b >= self.burn_threshold for b in burns)
+        if self.kind == "gauge":
+            self._over_streak = self._over_streak + 1 if over else 0
+            breached = self._over_streak >= self.consecutive
+        else:
+            breached = over
+        return {"slo": self.name, "kind": self.kind, "target": self.target,
+                "burns": [round(b, 4) for b in burns],
+                "burn": round(max(burns), 4), "breached": breached}
+
+
+class SLOWatchdog:
+    """Evaluate a set of SLOs on an interval; export burn gauges, count
+    breaches, emit events, and trip flight-recorder dumps."""
+
+    def __init__(self, slos: Sequence[SLO] = (), registry=None,
+                 recorder=None, events=None, interval_s: float = 1.0,
+                 start: bool = False):
+        from .events import get_event_log
+        from .metrics import get_registry
+
+        self.slos: List[SLO] = list(slos)
+        self.registry = registry or get_registry()
+        self.events = events or get_event_log()
+        self._recorder = recorder  # None -> lazy default (flight.py)
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._burn_gauge = self.registry.gauge(
+            "pt_slo_burn_rate", "Current SLO burn rate (worst window)",
+            labelnames=("slo",))
+        self._breach_counter = self.registry.counter(
+            "pt_slo_breach_total", "SLO breach evaluations",
+            labelnames=("slo",))
+        for s in self.slos:  # zeros visible before the first breach
+            self._breach_counter.labels(slo=s.name)
+        self.evals = 0
+        self._last: Dict[str, Dict[str, Any]] = {}
+        self._breaches: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    @property
+    def recorder(self):
+        if self._recorder is None:
+            from .flight import get_recorder
+
+            self._recorder = get_recorder()
+        return self._recorder
+
+    def add(self, slo: SLO) -> "SLOWatchdog":
+        with self._lock:
+            self.slos.append(slo)
+        self._breach_counter.labels(slo=slo.name)
+        return self
+
+    def evaluate_now(self) -> Dict[str, Dict[str, Any]]:
+        """One synchronous sweep (the loop does this on ``interval_s``).
+        Returns {slo: evaluation}."""
+        with self._lock:
+            slos = list(self.slos)
+        out: Dict[str, Dict[str, Any]] = {}
+        for s in slos:
+            try:
+                res = s.evaluate()
+            except Exception as e:  # a broken reader must not kill the dog
+                res = {"slo": s.name, "error": f"{type(e).__name__}: {e}",
+                       "burn": 0.0, "breached": False}
+            out[s.name] = res
+            self._burn_gauge.labels(slo=s.name).set(res["burn"])
+            if res["breached"]:
+                self._breach_counter.labels(slo=s.name).inc()
+                with self._lock:
+                    self._breaches[s.name] = \
+                        self._breaches.get(s.name, 0) + 1
+                if self.events.enabled:
+                    self.events.emit("slo_breach", severity="error",
+                                     slo=s.name, burn=res["burn"],
+                                     target=s.target, kind=s.kind)
+                self.recorder.maybe_dump(
+                    {"type": "slo_breach", "slo": s.name,
+                     "burn": res["burn"], "target": s.target})
+        with self._lock:
+            self.evals += 1
+            self._last = out
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Last evaluation + cumulative breach counts (rides postmortem
+        bundles as the ``slo`` provider and bench records)."""
+        with self._lock:
+            return {"evals": self.evals, "breaches": dict(self._breaches),
+                    "last": dict(self._last),
+                    "slos": [{"slo": s.name, "kind": s.kind,
+                              "target": s.target, "floor": s.floor}
+                             for s in self.slos]}
+
+    # -- lifecycle --
+    def start(self) -> "SLOWatchdog":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self.recorder.register_provider("slo", self.summary)
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="paddle-tpu-slo-watchdog")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.evaluate_now()
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        if self._recorder is not None:
+            self._recorder.unregister_provider("slo")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- declarative constructors -----------------------------------------
+    @staticmethod
+    def serving_slos(stats, p95_ms: Optional[float] = None,
+                     err_rate: Optional[float] = None,
+                     mfu_floor: Optional[float] = None,
+                     decode_tps_floor: Optional[float] = None,
+                     windows: Sequence[float] = (5.0, 60.0),
+                     consecutive: int = 2) -> List[SLO]:
+        """SLOs over one ``ServingStats``: p95 latency ceiling, error
+        rate (failed + deadline_exceeded over completed+bad), MFU floor,
+        decode tokens/s floor. Pass only the bars you declare."""
+        out: List[SLO] = []
+        if p95_ms is not None:
+            def _p95():
+                return stats.snapshot()["latency_ms"]["p95"]
+
+            out.append(SLO("p95_ms", p95_ms, _p95, kind="gauge",
+                           consecutive=consecutive))
+        if err_rate is not None:
+            def _ratio(w):
+                bad = (stats.recent("failed", w)
+                       + stats.recent("deadline_exceeded", w))
+                good = stats.recent("completed", w)
+                return bad, bad + good
+
+            out.append(SLO("err_rate", err_rate, _ratio, kind="ratio",
+                           windows=windows))
+        if mfu_floor is not None:
+            out.append(SLO("mfu", mfu_floor, stats.mfu, kind="gauge",
+                           floor=True, consecutive=consecutive))
+        if decode_tps_floor is not None:
+            out.append(SLO("decode_tokens_per_s", decode_tps_floor,
+                           stats.decode_tokens_rate, kind="gauge",
+                           floor=True, consecutive=consecutive))
+        return out
+
+    @staticmethod
+    def fleet_slos(fleet_stats, p95_ms: Optional[float] = None,
+                   err_rate_per_s: Optional[float] = None,
+                   consecutive: int = 2) -> List[SLO]:
+        """SLOs over a ``FleetStats`` (router plane): router p95 ceiling
+        and failed-requests/s ceiling."""
+        out: List[SLO] = []
+        if p95_ms is not None:
+            def _p95():
+                return fleet_stats.snapshot()["latency_ms"]["p95"]
+
+            out.append(SLO("fleet_p95_ms", p95_ms, _p95, kind="gauge",
+                           consecutive=consecutive))
+        if err_rate_per_s is not None:
+            state = {"last": (time.monotonic(), fleet_stats.failed)}
+
+            def _rate():
+                now, cur = time.monotonic(), fleet_stats.failed
+                t0, prev = state["last"]
+                state["last"] = (now, cur)
+                return (cur - prev) / max(now - t0, _EPS)
+
+            out.append(SLO("fleet_err_per_s", err_rate_per_s, _rate,
+                           kind="gauge", consecutive=consecutive))
+        return out
+
+
+# -- offline judgment (tools/serve_bench.py --slo) -------------------------
+
+#: spec key -> (result keys to try, ceiling/floor). err_rate is derived.
+_BENCH_KEYS = {
+    "p50_ms": (("p50_ms", "gen_p50_ms"), False),
+    "p95_ms": (("p95_ms", "gen_p95_ms"), False),
+    "p99_ms": (("p99_ms",), False),
+    "ttft_p95_ms": (("ttft_p95_ms",), False),
+    "qps_min": (("qps",), True),
+    "tokens_per_s_min": (("tokens_per_s",), True),
+    "err_rate": ((), False),
+}
+
+
+def parse_slo_spec(spec: str) -> Dict[str, float]:
+    """"p95_ms=50,err_rate=0.01" -> {"p95_ms": 50.0, ...}; unknown keys
+    raise (a typo'd bar that silently never judges is worse than none)."""
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        k = k.strip()
+        if k not in _BENCH_KEYS:
+            raise ValueError(f"unknown SLO key {k!r}; known: "
+                             f"{sorted(_BENCH_KEYS)}")
+        out[k] = float(v)
+    if not out:
+        raise ValueError("empty SLO spec")
+    return out
+
+
+def _bench_err_rate(result: Dict[str, Any]) -> Tuple[float, str]:
+    bad = (result.get("errors", 0) + result.get("retry_exhausted", 0)
+           + result.get("deadline_missed", 0))
+    ok = result.get("requests", result.get("generations", 0))
+    total = ok + bad
+    return (bad / total if total else 0.0,
+            f"{bad}/{total} failed|exhausted|deadline")
+
+
+def judge_bench(result: Dict[str, Any],
+                specs: Dict[str, float]) -> Tuple[bool, List[str]]:
+    """Judge one serve_bench result dict against declared SLOs; returns
+    (ok, report lines). A missing metric is a breach — a bar that cannot
+    be measured must fail loudly, not pass silently."""
+    ok = True
+    lines: List[str] = []
+    for key, target in specs.items():
+        if key == "err_rate":
+            value, detail = _bench_err_rate(result)
+            passed = value <= target
+            lines.append(
+                f"{'SLO ok    ' if passed else 'SLO BREACH'} "
+                f"err_rate={value:.4f} (target <= {target:g}; {detail})")
+            ok &= passed
+            continue
+        keys, is_floor = _BENCH_KEYS[key]
+        value = next((result[k] for k in keys if k in result), None)
+        if value is None:
+            lines.append(f"SLO BREACH {key}: metric "
+                         f"{'/'.join(keys)} missing from the run")
+            ok = False
+            continue
+        passed = value >= target if is_floor else value <= target
+        op = ">=" if is_floor else "<="
+        lines.append(f"{'SLO ok    ' if passed else 'SLO BREACH'} "
+                     f"{key}={value:.3f} (target {op} {target:g})")
+        ok &= passed
+    return ok, lines

@@ -39,6 +39,15 @@ plane). Pieces, composable or used together via ``ServingServer``:
   kills/restarts, partitions, and slow replicas.
 * ``errors`` (errors.py) — the typed error hierarchy + wire codes.
 
+Since PR 9 the whole stack is black-boxed (docs/design.md §19): faults,
+health transitions, circuit trips, failovers, reloads, sheds, and chaos
+injections emit typed events (``paddle_tpu.obs.events`` — zero-cost when
+off, ``log_json=True`` bridges them to stdlib logging as one-line JSON),
+``ServingServer(capture_every=N)`` samples requests for bit-identical
+replay, and the flight recorder (``paddle_tpu.obs.flight``) freezes
+everything into postmortem bundles that ``tools/paddle_cli.py doctor``
+reconstructs.
+
 Quickstart::
 
     import paddle_tpu as fluid

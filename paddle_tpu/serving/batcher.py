@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..obs.events import get_event_log
 from .engine import ServingEngine
 from .errors import DeadlineExceeded, QueueFullError, ShuttingDown  # noqa: F401 (QueueFullError re-exported: PR-1 import site)
 from .stats import ServingStats
@@ -52,7 +53,7 @@ from .stats import ServingStats
 class _Request:
     __slots__ = ("feeds", "sig", "rows", "future", "t_submit", "deadline",
                  "trace_id", "t_enqueue", "t_dequeue", "t_dispatched",
-                 "timings")
+                 "timings", "weights_version")
 
     def __init__(self, feeds, sig, rows, deadline=None, trace_id=None,
                  t_submit=None):
@@ -61,6 +62,7 @@ class _Request:
         self.rows = rows
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.trace_id = trace_id  # wire-propagated correlation id, or None
+        self.weights_version = None  # params version the batch ran on
         self.future: Future = Future()
         # t_submit is the START of submit() (so the pad stage is inside the
         # measured latency and the per-stage spans sum to it)
@@ -147,6 +149,10 @@ class MicroBatcher:
         if deadline is not None and t0 >= deadline:
             if self.stats:
                 self.stats.record_deadline()
+            ev = get_event_log()
+            if ev.enabled:
+                ev.emit("deadline_shed", severity="warn", trace_id=trace_id,
+                        where="submit", overshoot_ms=(t0 - deadline) * 1e3)
             raise DeadlineExceeded(t0 - deadline, "submit")
         padded, sig, rows = self.engine.prepare_request(feeds)
         if rows > self.max_batch_size:
@@ -175,6 +181,11 @@ class MicroBatcher:
                     self._pending -= 1
                 if self.stats:
                     self.stats.record_reject()
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("queue_full", severity="warn",
+                            trace_id=trace_id, depth=self.queue_depth,
+                            capacity=self.queue_capacity)
                 raise QueueFullError(self.queue_depth,
                                      self.queue_capacity) from None
         req.t_enqueue = time.monotonic()
@@ -279,6 +290,11 @@ class MicroBatcher:
                                                     "coalesce")):
             if self.stats:
                 self.stats.record_deadline()
+            ev = get_event_log()
+            if ev.enabled:
+                ev.emit("deadline_shed", severity="warn",
+                        trace_id=req.trace_id, where="coalesce",
+                        overshoot_ms=(now - req.deadline) * 1e3)
         return True
 
     def _loop(self) -> None:
@@ -337,6 +353,13 @@ class MicroBatcher:
     def _fail_batch(self, batch: List[_Request], e: Exception) -> None:
         if self.stats:
             self.stats.record_failure(len(batch))
+        ev = get_event_log()
+        if ev.enabled:
+            ev.emit("batch_failed", severity="error",
+                    trace_id=next((r.trace_id for r in batch
+                                   if r.trace_id), None),
+                    requests=len(batch),
+                    error=f"{type(e).__name__}: {e}"[:200])
         for r in batch:
             self._complete(r, exc=e)
 
@@ -447,6 +470,9 @@ class MicroBatcher:
         now = time.monotonic()
         scatter_s = now - t_synced
         for r, res in zip(batch, results):
+            # the params snapshot this batch ran on: the capture/flight
+            # plane reads it off the resolved future (fut.request)
+            r.weights_version = inflight.weights_version
             # ALL timings land BEFORE the future resolves: set_result wakes
             # the server handler, which reads r.timings — a write after it
             # would race the handler's dict iteration (and "total" must not

@@ -38,7 +38,15 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..obs.events import get_event_log
 from .errors import InjectedFault
+
+#: injector counter -> the fault name its chaos_inject event carries (the
+#: postmortem tests join events back to ``injected`` counts through this)
+FAULT_NAMES = {"slow_calls": "slow_call", "errors": "error",
+               "dropped_conns": "drop_conn", "stalls": "stall",
+               "kills": "kill", "restarts": "restart",
+               "partitions": "partition", "slow_replicas": "slow"}
 
 
 class ChaosInjector:
@@ -97,7 +105,11 @@ class ChaosInjector:
             if self._rng.random() >= prob:
                 return False
             self.injected[counter] += 1
-            return True
+        ev = get_event_log()
+        if ev.enabled:
+            ev.emit("chaos_inject", severity="warn",
+                    fault=FAULT_NAMES.get(counter, counter), seed=self.seed)
+        return True
 
     # -- hooks (each called from exactly one layer) --
     def on_dispatch(self) -> None:
@@ -222,6 +234,11 @@ class FleetChaos:
             if cname:
                 with self._lock:
                     self.injected[cname] += 1
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("chaos_inject",
+                            fault=FAULT_NAMES.get(cname, cname),
+                            seed=self.seed)
 
     # -- the storm loop --
     def _loop(self) -> None:
@@ -252,6 +269,10 @@ class FleetChaos:
                             (time.monotonic() + self.restart_delay_s,
                              lambda i=i: self.fleet.restart_replica(i),
                              "restarts"))
+                    ev = get_event_log()
+                    if ev.enabled:
+                        ev.emit("chaos_inject", severity="warn",
+                                fault="kill", replica=i, seed=self.seed)
                 alive = self.fleet.alive_indices()
                 unfaulted = [i for i in alive if i not in self._partitioned
                              and i not in self._slowed]
@@ -263,6 +284,10 @@ class FleetChaos:
                 with self._lock:
                     self.injected["partitions"] += 1
                     self._partitioned.add(i)
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("chaos_inject", severity="warn",
+                            fault="partition", replica=i, seed=self.seed)
 
                 def _heal_part(i=i):
                     self.fleet.set_partition(i, False)
@@ -281,6 +306,10 @@ class FleetChaos:
                 with self._lock:
                     self.injected["slow_replicas"] += 1
                     self._slowed.add(i)
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("chaos_inject", severity="warn", fault="slow",
+                            replica=i, seed=self.seed)
 
                 def _heal_slow(i=i):
                     self.fleet.set_slow(i, False)

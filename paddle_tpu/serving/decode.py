@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.events import get_event_log
 from .engine import pow2_ladder, round_up
 from .errors import DeadlineExceeded, QueueFullError, ServingUnavailable, \
     ShuttingDown
@@ -662,6 +663,10 @@ class GenerationBatcher:
                             "clear in time — retry")
             if self.stats and record:
                 self.stats.record_reload()
+            ev = get_event_log()
+            if ev.enabled:
+                ev.emit("reload_commit", plane="decode",
+                        version=self._reload_version)
             return self._reload_version
 
     def _commit_staged(self) -> None:
@@ -827,6 +832,11 @@ class GenerationBatcher:
                                                      "mid-generation")):
                 if self.stats:
                     self.stats.record_deadline()
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("deadline_shed", severity="warn",
+                            trace_id=g.trace_id, where="mid-generation",
+                            tokens=len(g.tokens))
             self.engine.free_slot(g.slot)
             self._lanes[i] = None
             changed = True
@@ -843,6 +853,12 @@ class GenerationBatcher:
             # the device call itself failed: every lane in it fails typed
             err = e if isinstance(e, ServingUnavailable) else \
                 ServingUnavailable(f"decode step failed: {e}")
+            ev = get_event_log()
+            if ev.enabled:
+                ev.emit("decode_step_failed", severity="error",
+                        where="sync", lanes=sum(1 for g in lanes_snap
+                                                if g is not None),
+                        error=f"{type(e).__name__}: {e}"[:200])
             changed = False
             for i, g in enumerate(lanes_snap):
                 if g is None or g.done:
@@ -1009,6 +1025,12 @@ class GenerationBatcher:
                 except Exception as e:
                     err = e if isinstance(e, ServingUnavailable) else \
                         ServingUnavailable(f"decode dispatch failed: {e}")
+                    ev = get_event_log()
+                    if ev.enabled:
+                        ev.emit("decode_step_failed", severity="error",
+                                where="dispatch",
+                                lanes=self.active,
+                                error=f"{type(e).__name__}: {e}"[:200])
                     for i, g in enumerate(self._lanes):
                         if g is None:
                             continue

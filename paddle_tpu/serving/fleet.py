@@ -56,6 +56,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs import get_tracer, new_trace_id
+from ..obs.events import get_event_log
 from .errors import (DeadlineExceeded, FleetOverloaded, NoHealthyReplicas,
                      RetryBudgetExceeded, ServingError, ServingRejected,
                      ServingUnavailable, TenantQuotaExceeded)
@@ -148,7 +149,8 @@ class _Circuit:
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
-    def __init__(self, threshold: int = 3, cooldown_s: float = 2.0):
+    def __init__(self, threshold: int = 3, cooldown_s: float = 2.0,
+                 listener=None):
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
         self.state = self.CLOSED
@@ -156,11 +158,24 @@ class _Circuit:
         self.opened_at = 0.0
         self._probing = False
         self._lock = threading.Lock()
+        # transition callback(old, new) — the router wires the event log
+        # through here so every open/half-open/close leaves a record
+        self.listener = listener
+
+    def _set_state(self, new: str) -> None:
+        """Caller holds ``_lock``. Notifies the listener on real
+        transitions; a broken listener never breaks the breaker."""
+        old, self.state = self.state, new
+        if old != new and self.listener is not None:
+            try:
+                self.listener(old, new)
+            except Exception:
+                pass
 
     def _tick_locked(self) -> None:
         if (self.state == self.OPEN
                 and time.monotonic() - self.opened_at >= self.cooldown_s):
-            self.state = self.HALF_OPEN
+            self._set_state(self.HALF_OPEN)
             self._probing = False
 
     def would_allow(self) -> bool:
@@ -184,7 +199,7 @@ class _Circuit:
 
     def on_success(self) -> None:
         with self._lock:
-            self.state = self.CLOSED
+            self._set_state(self.CLOSED)
             self.failures = 0
             self._probing = False
 
@@ -192,13 +207,13 @@ class _Circuit:
         """Record a breaker-class fault; True when this trip OPENED it."""
         with self._lock:
             if self.state == self.HALF_OPEN:
-                self.state = self.OPEN
+                self._set_state(self.OPEN)
                 self.opened_at = time.monotonic()
                 self._probing = False
                 return True
             self.failures += 1
             if self.state == self.CLOSED and self.failures >= self.threshold:
-                self.state = self.OPEN
+                self._set_state(self.OPEN)
                 self.opened_at = time.monotonic()
                 return True
             return False
@@ -352,7 +367,9 @@ class FleetRouter:
                  scale_cooldown_s: float = 10.0, min_replicas: int = 1,
                  max_conns_per_replica: int = 8,
                  stats: Optional[FleetStats] = None, seed: int = 0,
-                 start_scraper: bool = True):
+                 start_scraper: bool = True, log_json: bool = False,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1"):
         self.retries = int(retries)
         self.attempt_retries = int(attempt_retries)
         self.request_timeout = request_timeout
@@ -385,6 +402,20 @@ class FleetRouter:
         self._last_scale_t = 0.0
         self._last_qpr = 0.0
         self._closed = False
+        from ..obs.events import (enable_json_logging,
+                                  init_from_flags as events_from_flags)
+
+        events_from_flags()
+        if log_json:
+            enable_json_logging()
+        self._events = get_event_log()
+        self._last_fleet_state = "healthy"
+        # flight-recorder provider: every bundle carries the router's view
+        from ..obs import flight as obs_flight
+
+        self._flight = obs_flight.get_recorder()
+        self._flight_provider = self._flight.register_provider(
+            f"fleet:{id(self):x}", self._flight_info)
         r = self.stats.registry
         r.gauge("pt_fleet_replicas", "Registered replicas",
                 callback=lambda: float(len(self._replicas)))
@@ -408,6 +439,16 @@ class FleetRouter:
             labelnames=("replica",))
         for ep in endpoints:
             self.add_replica(ep)
+        # the FleetRouter satellite: a plain-HTTP scrape surface for the
+        # pt_fleet_* registry (the router was the one unscrapable tier) —
+        # GET /metrics + /healthz via the shared obs MetricsServer
+        self.metrics_server = None
+        if metrics_port is not None:
+            from ..obs.http import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                host=metrics_host, port=metrics_port,
+                registry=self.stats.registry, healthz=self._healthz_info)
         self._stop = threading.Event()
         self._scraper = None
         self._scrape_exec = None
@@ -418,6 +459,41 @@ class FleetRouter:
                 target=self._scrape_loop, daemon=True,
                 name="pt-fleet-scraper")
             self._scraper.start()
+
+    def _healthz_info(self) -> Dict[str, Any]:
+        """The HTTP /healthz body of the router's own scrape endpoint."""
+        state = self.fleet_state()
+        return {"ok": state != "unavailable", "state": state,
+                "replicas": len(self._replicas),
+                "healthy_replicas": self.healthy_replica_count(),
+                "pressure": self.pressure(),
+                "qps_per_replica": self._last_qpr}
+
+    @property
+    def metrics_endpoint(self) -> Optional[str]:
+        return (self.metrics_server.endpoint
+                if self.metrics_server is not None else None)
+
+    def _flight_info(self) -> Dict[str, Any]:
+        """Provider snapshot for postmortem bundles (obs/flight.py)."""
+        return {"fleet_state": self.fleet_state(),
+                "pressure": self.pressure(),
+                "qps_per_replica": self._last_qpr,
+                "replicas": self.replicas_info(),
+                "metrics": self.stats.expose()}
+
+    def _circuit_listener(self, endpoint: str):
+        """A per-replica breaker transition -> typed event closure."""
+        def _on(old: str, new: str) -> None:
+            ev = self._events
+            if not ev.enabled:
+                return
+            typ = {"open": "circuit_open", "half_open": "circuit_half_open",
+                   "closed": "circuit_close"}[new]
+            ev.emit(typ, severity="warn" if new == "open" else "info",
+                    replica=endpoint, frm=old)
+
+        return _on
 
     # -- replica membership ------------------------------------------------
     def add_replica(self, endpoint: str) -> ReplicaHandle:
@@ -430,6 +506,7 @@ class FleetRouter:
                               self.max_conns_per_replica,
                               self.circuit_threshold,
                               self.circuit_cooldown_s)
+            h.circuit.listener = self._circuit_listener(endpoint)
             self._replicas[endpoint] = h
         if h.try_begin_scrape():  # the loop may already have it
             try:
@@ -496,10 +573,18 @@ class FleetRouter:
         bar = self.shed_base + prio * self.shed_step
         if p >= bar:
             self.stats.record_shed(name)
+            if self._events.enabled:
+                self._events.emit("load_shed", severity="warn",
+                                  scope="fleet", tenant=name,
+                                  priority=prio, pressure=round(p, 4),
+                                  bar=round(bar, 4))
             raise FleetOverloaded(name, prio, p, bar)
         if cfg is not None and cfg.bucket is not None \
                 and not cfg.bucket.take():
             self.stats.record_quota(name)
+            if self._events.enabled:
+                self._events.emit("quota_reject", severity="warn",
+                                  tenant=name, rate=cfg.rate or 0.0)
             raise TenantQuotaExceeded(name, cfg.rate or 0.0,
                                       cfg.bucket.retry_after())
 
@@ -553,14 +638,21 @@ class FleetRouter:
             text = h.control.call("metrics")["text"]
         except Exception:
             h.control.close()  # reconnect next round
+            was = h.reachable
             h.reachable = False
             self.stats.record_scrape(False)
+            if was and self._events.enabled:
+                self._events.emit("replica_unreachable", severity="warn",
+                                  replica=h.endpoint)
             return False
         h.health = hz.get("state", "unknown")
         h.has_decode = "decode" in hz
         h.metrics = scraped_gauges(hz, text)
         h.scraped_at = time.monotonic()
+        was = h.reachable
         h.reachable = True
+        if not was and self._events.enabled:
+            self._events.emit("replica_reachable", replica=h.endpoint)
         self.stats.record_scrape(True)
         return True
 
@@ -592,6 +684,13 @@ class FleetRouter:
             # prune to the registered membership each round
             self._circuit_gauge.prune(h.endpoint for h in reps
                                       if h.endpoint in self._replicas)
+            st = self.fleet_state()
+            prev, self._last_fleet_state = self._last_fleet_state, st
+            if prev != st and self._events.enabled:
+                self._events.emit("health_transition",
+                                  severity="warn" if st != "healthy"
+                                  else "info",
+                                  scope="fleet", frm=prev, to=st)
             self._eval_autoscale()
 
     def _eval_autoscale(self) -> None:
@@ -604,6 +703,10 @@ class FleetRouter:
         if self.scale_up_qps is not None and qpr > self.scale_up_qps:
             self._last_scale_t = now
             self.stats.record_scale("up")
+            if self._events.enabled:
+                self._events.emit("scale_event", direction="up",
+                                  qps_per_replica=round(qpr, 3),
+                                  healthy=healthy)
             if self.on_scale_up is not None:
                 try:
                     self.on_scale_up(self, qpr)
@@ -613,6 +716,10 @@ class FleetRouter:
               and healthy > self.min_replicas):
             self._last_scale_t = now
             self.stats.record_scale("down")
+            if self._events.enabled:
+                self._events.emit("scale_event", direction="down",
+                                  qps_per_replica=round(qpr, 3),
+                                  healthy=healthy)
             if self.on_scale_down is not None:
                 try:
                     self.on_scale_down(self, qpr)
@@ -727,6 +834,10 @@ class FleetRouter:
                              session=session)
             if rep is None:
                 self.stats.record_failure()
+                if self._events.enabled:
+                    self._events.emit("no_healthy_replicas",
+                                      severity="error", trace_id=t_id,
+                                      op=op, replicas=len(self._replicas))
                 raise NoHealthyReplicas(len(self._replicas), last)
             inner_budget = min(budget, used + self.attempt_retries)
             try:
@@ -761,6 +872,12 @@ class FleetRouter:
                 raise RetryBudgetExceeded(used + 1, last)
             used += 1  # the failover re-send costs one budget unit
             self.stats.record_failover(op)
+            if self._events.enabled:
+                self._events.emit("failover", severity="warn",
+                                  trace_id=t_id, op=op,
+                                  failed_replica=rep.endpoint,
+                                  attempt=used,
+                                  error=f"{type(last).__name__}"[:80])
 
     def _attempt(self, rep: ReplicaHandle, op: str, payload: Dict[str, Any],
                  deadline: Optional[float], t_id: Optional[str],
@@ -866,6 +983,9 @@ class FleetRouter:
             rep2.circuit.release_probe()
             return fut1.result()
         self.stats.record_hedge()
+        if self._events.enabled:
+            self._events.emit("hedge", trace_id=t_id,
+                              primary=rep.endpoint, hedge=rep2.endpoint)
         with get_tracer().span("fleet/hedge", trace_id=t_id,
                                primary=rep.endpoint, hedge=rep2.endpoint):
             # inner_budget=attempt_no -> zero inner retries for the hedge
@@ -897,6 +1017,9 @@ class FleetRouter:
                         continue
                     if f is fut2:
                         self.stats.record_hedge_win()
+                        if self._events.enabled:
+                            self._events.emit("hedge_win", trace_id=t_id,
+                                              hedge=rep2.endpoint)
                     for p in pending:
                         # cancel-on-first-win: the loser finishes in the
                         # background and is discarded
@@ -941,6 +1064,14 @@ class FleetRouter:
                 finally:
                     h.pool.release(c, broken=broken)
             out[h.endpoint] = ver
+            if self._events.enabled:
+                # version None = the replica was skipped mid-roll (down /
+                # typed refusal) — that is postmortem signal too
+                self._events.emit("reload_commit",
+                                  severity="info" if ver is not None
+                                  else "warn",
+                                  scope="fleet", replica=h.endpoint,
+                                  version=ver)
         self.stats.record_reload()
         return out
 
@@ -960,6 +1091,9 @@ class FleetRouter:
         if self._closed:
             return
         self._closed = True
+        self._flight.unregister_provider(self._flight_provider)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
         self._stop.set()
         if self._scraper is not None:
             self._scraper.join(timeout=5)

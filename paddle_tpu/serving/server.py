@@ -143,6 +143,10 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"error": ShuttingDown("server draining").info()}
         if state == "degraded" and srv.should_shed():
             srv.stats.record_shed()
+            if srv._events.enabled:
+                srv._events.emit("load_shed", severity="warn",
+                                 endpoint=srv.endpoint, state=state,
+                                 queue_depth=srv.batcher.queue_depth)
             return {"error": LoadShedError(
                 state, srv.batcher.queue_depth,
                 srv.batcher.queue_capacity).info()}
@@ -189,6 +193,16 @@ class _Handler(socketserver.StreamRequestHandler):
                 e = ServingUnavailable(
                     f"request timed out after {wait:.1f}s server-side")
             return {"error": e.info()}
+        if srv.capture_every:
+            with srv._capture_lock:
+                srv._capture_n += 1
+                take = srv._capture_n % srv.capture_every == 0
+            if take:
+                req = getattr(fut, "request", None)
+                srv._flight.capture_predict(
+                    srv.engine.dirname, feeds, outs,
+                    weights_version=getattr(req, "weights_version", None),
+                    trace_id=trace_id)
         result: Dict[str, Any] = {
             "fetches": [_encode_fetch(o) for o in outs]}
         if trace_id is not None:
@@ -216,6 +230,10 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"error": ShuttingDown("server draining").info()}
         if state == "degraded" and srv.should_shed():
             srv.stats.record_shed()
+            if srv._events.enabled:
+                srv._events.emit("load_shed", severity="warn",
+                                 endpoint=srv.endpoint, state=state,
+                                 plane="decode")
             return {"error": LoadShedError(
                 state, srv.gen_batcher.queue_depth,
                 srv.gen_batcher.queue_capacity).info()}
@@ -249,6 +267,16 @@ class _Handler(socketserver.StreamRequestHandler):
                 e = ServingUnavailable(
                     f"generation timed out after {wait:.1f}s server-side")
             return {"error": e.info()}
+        if srv.capture_every:
+            with srv._capture_lock:
+                srv._gen_capture_n += 1
+                take = srv._gen_capture_n % srv.capture_every == 0
+            if take:
+                srv._flight.capture_generate(
+                    srv.decode_engine.dirname, tokens,
+                    params.get("max_new_tokens"), params.get("eos_id"),
+                    res.tokens, weights_version=res.weights_version,
+                    trace_id=trace_id)
         result: Dict[str, Any] = {
             "tokens": [int(t) for t in res.tokens],
             "ttft_ms": res.ttft_s * 1e3,
@@ -279,6 +307,7 @@ class ServingServer(socketserver.ThreadingTCPServer):
                  shed_prob: Optional[float] = None, shed_seed: int = 0,
                  drain_timeout: float = 30.0, chaos=None,
                  handle_signals: bool = False, decode=None, mesh=None,
+                 log_json: bool = False, capture_every: int = 0,
                  **engine_kwargs):
         super().__init__((host, port), _Handler)
         self.batcher = None
@@ -403,8 +432,32 @@ class ServingServer(socketserver.ThreadingTCPServer):
             # pull-gauges into the stats registry so GET /metrics carries
             # queue/pipeline/compile/weights state without push traffic
             from ..obs import init_from_flags
+            from ..obs.events import (enable_json_logging, get_event_log,
+                                      init_from_flags as events_from_flags)
 
             init_from_flags()
+            events_from_flags()  # PT_FLAG_OBS_EVENTS turns the black box on
+            if log_json:
+                # structured-logging bridge: every event (health
+                # transitions, sheds, reload commits, faults) becomes one
+                # JSON line through stdlib logging — faults were silently
+                # counted before, now they are grep-able
+                enable_json_logging()
+            self._events = get_event_log()
+            self._last_health = "healthy"
+            self._health_lock = threading.Lock()
+            # sampled request capture for the flight recorder (docs §19):
+            # 1-in-N successful predicts/generates land in the bundle with
+            # enough state (inputs, bucket signature, seed, weights
+            # version) to replay bit-identically
+            self.capture_every = max(0, int(capture_every))
+            self._capture_n = 0
+            self._gen_capture_n = 0
+            self._capture_lock = threading.Lock()
+            from ..obs import flight as obs_flight
+
+            self._flight = obs_flight.get_recorder()
+            self._flight_provider = None  # named after the port binds
             # sharded engine: the §18 shard plane — shard count scales the
             # MFU denominator (gauges AGGREGATE across the mesh; a fleet
             # router must not read shard 0 only), per-device HBM residency
@@ -499,8 +552,29 @@ class ServingServer(socketserver.ThreadingTCPServer):
             raise
         if handle_signals:
             self.install_signal_handlers()
+        # every bundle the flight recorder dumps carries this server's
+        # identity, weights version, placement plan, and metric page
+        self._flight_provider = self._flight.register_provider(
+            f"serving:{self.endpoint}", self._flight_info)
         self._thread = threading.Thread(target=self.serve_forever, daemon=True)
         self._thread.start()
+
+    def _flight_info(self) -> Dict[str, Any]:
+        """Provider snapshot for postmortem bundles (obs/flight.py)."""
+        info: Dict[str, Any] = {
+            "endpoint": self.endpoint,
+            "model_dir": self.engine.dirname,
+            "health": self.health_state(),
+            "weights_version": self.engine.params_version,
+            "queue_depth": self.batcher.queue_depth,
+            "queue_capacity": self.batcher.queue_capacity,
+            "compile_cache": self.engine.cache_info(),
+            "placement": self.mesh_spec,
+            "metrics": self.stats.expose(),
+        }
+        if self.decode_engine is not None:
+            info["decode_weights_version"] = self.decode_engine.params_version
+        return info
 
     @property
     def endpoint(self) -> str:
@@ -514,18 +588,34 @@ class ServingServer(socketserver.ThreadingTCPServer):
         failures / deadline misses) > ``healthy``. Window counters decay,
         so a server left alone after a fault burst RETURNS to healthy."""
         if self._draining:
-            return "draining"
+            return self._note_health("draining")
         cap = self.batcher.queue_capacity
         if cap and self.batcher.queue_depth / cap >= self.degraded_queue_ratio:
-            return "degraded"
+            return self._note_health("degraded")
         w = self.health_window_s
         bad = (self.stats.recent("rejected", w)
                + self.stats.recent("failed", w)
                + self.stats.recent("deadline_exceeded", w))
         good = self.stats.recent("completed", w)
         if bad and bad >= self.degraded_error_ratio * (bad + good):
-            return "degraded"
-        return "healthy"
+            return self._note_health("degraded")
+        return self._note_health("healthy")
+
+    def _note_health(self, state: str) -> str:
+        """Emit a typed event on every health-state TRANSITION (the PR-2
+        machine finally leaves a record; the counters alone could never
+        say when it degraded). The compare-and-swap is locked — handler
+        threads and scrapes call ``health_state()`` concurrently, and a
+        transition must be emitted exactly once with the true ``frm``."""
+        with self._health_lock:
+            prev, self._last_health = self._last_health, state
+            changed = prev != state
+        if changed and self._events.enabled:
+            self._events.emit("health_transition",
+                              severity="warn" if state != "healthy"
+                              else "info",
+                              endpoint=self.endpoint, frm=prev, to=state)
+        return state
 
     def shed_probability(self) -> float:
         """How aggressively a degraded server sheds: proportional to how
@@ -616,6 +706,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
         commit runs inside it (microseconds of pause). If the pipeline
         fails to quiesce the reload is REFUSED with a retryable
         ``unavailable`` rather than swapping mid-flight."""
+        if self._events.enabled:
+            self._events.emit("reload_stage", endpoint=self.endpoint,
+                              dirname=dirname)
         staged = self.engine.stage_params(dirname)  # slow; traffic flows
         swapped: Dict[str, int] = {}
 
@@ -627,6 +720,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 "reload: dispatch pipeline did not quiesce within the "
                 "barrier timeout — retry")
         self.stats.record_reload()
+        if self._events.enabled:
+            self._events.emit("reload_commit", endpoint=self.endpoint,
+                              version=swapped["version"])
         out = {"weights_version": swapped["version"]}
         if self.gen_batcher is not None:
             # decode reloads at its own barrier — a token boundary with no
@@ -662,6 +758,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
             if self._closed:
                 return
             self._closed = True
+        if self._flight_provider is not None:
+            self._flight.unregister_provider(self._flight_provider)
         self._draining = True
         if drain:
             self.drain(timeout)

@@ -74,10 +74,17 @@ class Trainer:
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  param_path: Optional[str] = None, place=None,
                  checkpoint_config: Optional[CheckpointConfig] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, log_json: bool = False):
         self.checkpoint_cfg = checkpoint_config
         self.place = place
         self.stop_requested = False
+        if log_json:
+            # structured-logging bridge (docs §19): obs events — incl. the
+            # training numerics sentinels — become one-line JSON through
+            # stdlib logging instead of dying as in-memory counters
+            from .obs.events import enable_json_logging
+
+            enable_json_logging()
 
         self.train_program = Program()
         self.startup_program = Program()

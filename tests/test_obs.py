@@ -437,10 +437,18 @@ def test_tracer_spans_on_training_hot_path():
 
 def test_disabled_tracer_no_overhead_on_serving(model_dir):
     """With the tracer off the batcher/server must not allocate spans or
-    tag requests (the zero-cost contract)."""
+    tag requests (the zero-cost contract) — and with the EVENT LOG off
+    (PR 9) the same traffic must record zero events and zero captures."""
+    from paddle_tpu.obs import get_event_log, get_recorder
+
     tracer = obs.get_tracer()
     assert not tracer.enabled
     tracer.clear()
+    log = get_event_log()
+    assert not log.enabled
+    log.clear()
+    rec = get_recorder()
+    n_caps = len(rec.captures)
     with ServingServer(model_dir, max_batch_size=8,
                        batch_timeout_ms=1.0) as srv:
         with ServingClient(srv.endpoint) as c:
@@ -448,6 +456,25 @@ def test_disabled_tracer_no_overhead_on_serving(model_dir):
             c.predict({"x": x})
     assert len(tracer) == 0
     assert not tracer.exemplars.snapshot()
+    assert len(log) == 0 and log.dropped == 0
+    assert len(rec.captures) == n_caps  # capture off by default
+
+
+def test_disabled_event_log_is_allocation_free():
+    """PR-5 identity discipline extended to the event log: disabled
+    ``emit()`` returns ONE shared sentinel and records nothing."""
+    from paddle_tpu.obs.events import DISCARDED, EventLog
+
+    log = EventLog()
+    assert not log.enabled
+    a = log.emit("anything", severity="error", foo=1)
+    b = log.emit("else")
+    assert a is b is DISCARDED, \
+        "disabled emit() must return the shared sentinel"
+    assert len(log) == 0 and log.dropped == 0
+    log.enable()
+    assert log.emit("real").type == "real"
+    assert len(log) == 1
 
 
 # -- trace tooling --------------------------------------------------------

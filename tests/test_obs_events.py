@@ -1,0 +1,548 @@
+"""Black-box flight recorder (ISSUE 9): structured events, postmortem
+bundles, SLO watchdog, request capture + replay, numerics sentinels,
+``paddle_cli doctor``.
+
+Acceptance surface:
+* the event log is typed/bounded/counted and bridges to stdlib logging
+  as one-line JSON;
+* serving faults leave typed events with trace-id links;
+* the SLO watchdog burns multi-window, exports ``pt_slo_*``, and trips
+  flight-recorder dumps;
+* bundles are schema-valid and captured predict/generate requests replay
+  BIT-IDENTICALLY against fresh engines;
+* an unhandled worker-thread exception dumps a bundle;
+* ``obs_sentinel`` emits step-attributed NaN/spike events + a bundle on
+  first NaN, and the sentinel-off ``run_steps`` numerics are bit-identical;
+* ``paddle_cli doctor`` reconstructs the timeline with suspect-ranked
+  findings; the FleetRouter serves its own HTTP /metrics.
+"""
+import importlib.util
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, io
+from paddle_tpu.obs import events as obs_events
+from paddle_tpu.obs import flight as obs_flight
+from paddle_tpu.obs import slo as obs_slo
+from paddle_tpu.serving import (DeadlineExceeded, ServingClient,
+                                ServingServer, ServingStats)
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "paddle_cli", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "paddle_cli.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    return cli
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    np.random.seed(31)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        d = str(tmp_path_factory.mktemp("flight") / "model")
+        io.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+    return d
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory):
+    from test_serving_decode import _export_lm
+
+    return _export_lm(str(tmp_path_factory.mktemp("flight_lm") / "lm"),
+                      seed=29)
+
+
+@pytest.fixture()
+def event_log():
+    """The default event log, enabled + cleared for one test and fully
+    restored after (other tests assert it stays silent)."""
+    log = obs_events.get_event_log()
+    log.enable(capacity=4096)
+    log.clear()
+    yield log
+    log.disable()
+    log.clear()
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    rec = obs_flight.get_recorder()
+    rec.clear()
+    rec.dir = str(tmp_path / "flight")
+    yield rec
+    rec.disarm()
+    rec.clear()
+    rec.dir = None
+
+
+# -- event log core --------------------------------------------------------
+
+
+def test_event_ring_bounded_typed_and_counted(event_log):
+    from paddle_tpu.obs import get_registry
+
+    before = 0
+    c = get_registry().get("pt_events_total")
+    if c is not None:
+        before = sum(int(ch.value)
+                     for ch in c.children().values())
+    event_log.enable(capacity=8)
+    for i in range(20):
+        ev = event_log.emit("chaos_inject", severity="warn", fault="stall",
+                            i=i)
+        assert ev.type == "chaos_inject" and ev.severity == "warn"
+        assert ev.t > 0 and ev.wall > 0
+    assert len(event_log) == 8
+    assert event_log.dropped == 12
+    # oldest-first order, monotone eids
+    evs = event_log.events()
+    assert [e.attrs["i"] for e in evs] == list(range(12, 20))
+    assert event_log.counts() == {"chaos_inject": 8}
+    # every emit (even rotated-out ones) hit pt_events_total
+    c = get_registry().get("pt_events_total")
+    total = sum(int(ch.value) for ch in c.children().values())
+    assert total >= before + 20
+    text = get_registry().expose()
+    assert 'pt_events_total{type="chaos_inject",severity="warn"}' in text
+
+
+def test_event_filters_and_severity(event_log):
+    event_log.emit("failover", severity="warn", trace_id="t1", op="predict")
+    event_log.emit("circuit_open", severity="warn", replica="r0")
+    event_log.emit("nan_detected", severity="error", step=7)
+    event_log.emit("reload_commit", version=2)
+    assert [e.type for e in event_log.events(trace_id="t1")] == ["failover"]
+    assert [e.type for e in event_log.events(min_severity="error")] == \
+        ["nan_detected"]
+    assert event_log.events(type="nan_detected")[0].step == 7
+    # unknown severity coerces to info, not a crash
+    assert event_log.emit("x", severity="bogus").severity == "info"
+
+
+def test_logging_json_sink_one_line_json(event_log):
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("paddle_tpu.events")
+    h = _Capture()
+    logger.addHandler(h)
+    logger.setLevel(logging.DEBUG)
+    sink = obs_events.LoggingJSONSink()
+    event_log.add_sink(sink)
+    try:
+        event_log.emit("load_shed", severity="warn", tenant="free",
+                       pressure=0.7)
+    finally:
+        event_log.remove_sink(sink)
+        logger.removeHandler(h)
+    assert len(records) == 1
+    parsed = json.loads(records[0])  # ONE line, valid JSON
+    assert parsed["type"] == "load_shed" and parsed["severity"] == "warn"
+    assert parsed["attrs"]["tenant"] == "free"
+    # a raising sink is counted, never propagated
+    def _boom(ev):
+        raise RuntimeError("sink bug")
+
+    event_log.add_sink(_boom)
+    try:
+        event_log.emit("hedge")
+    finally:
+        event_log.remove_sink(_boom)
+    assert event_log.sink_errors >= 1
+
+
+# -- serving emits typed events --------------------------------------------
+
+
+def test_serving_emits_events_with_trace_links(model_dir, event_log):
+    """Deadline sheds, load sheds, health transitions, and reload
+    stage/commit all leave typed events; request-linked ones carry the
+    wire trace id."""
+    with ServingServer(model_dir, max_batch_size=8, batch_timeout_ms=1.0,
+                       queue_capacity=8, shed_prob=1.0,
+                       degraded_queue_ratio=0.25,
+                       start_batcher=False) as srv:
+        X = np.zeros((1, 4), "float32")
+        # expired-at-submit shed, with a trace id
+        with pytest.raises(DeadlineExceeded):
+            srv.batcher.submit({"x": X}, deadline=time.monotonic() - 0.01,
+                               trace_id="feedbeefcafe0001")
+        sheds = event_log.events(type="deadline_shed")
+        assert sheds and sheds[-1].trace_id == "feedbeefcafe0001"
+        assert sheds[-1].attrs["where"] == "submit"
+        # queue pressure -> degraded transition + a shed answer
+        futs = [srv.batcher.submit({"x": X}) for _ in range(4)]
+        assert srv.health_state() == "degraded"
+        trans = event_log.events(type="health_transition")
+        assert any(e.attrs["to"] == "degraded" for e in trans)
+        with ServingClient(srv.endpoint) as c:
+            with pytest.raises(Exception):
+                c.predict({"x": X})
+        assert event_log.events(type="load_shed")
+        srv.batcher.start()
+        for f in futs:
+            f.result(timeout=30)
+    # reload events
+    event_log.clear()
+    with ServingServer(model_dir, batch_timeout_ms=1.0) as srv:
+        with ServingClient(srv.endpoint) as c:
+            c.reload(model_dir)
+    types = [e.type for e in event_log.events()]
+    assert "reload_stage" in types and "reload_commit" in types
+    commit = event_log.events(type="reload_commit")[0]
+    assert commit.attrs["version"] == 2
+
+
+# -- SLO watchdog ----------------------------------------------------------
+
+
+def test_slo_watchdog_burn_breach_and_dump(event_log, recorder):
+    from paddle_tpu.obs.metrics import MetricsRegistry
+
+    stats = ServingStats()
+    for _ in range(20):
+        stats.record_done(0.002)
+    reg = MetricsRegistry()
+    wd = obs_slo.SLOWatchdog(
+        obs_slo.SLOWatchdog.serving_slos(stats, p95_ms=100.0,
+                                         err_rate=0.05,
+                                         windows=(1.0, 5.0)),
+        registry=reg, recorder=recorder, events=event_log)
+    out = wd.evaluate_now()
+    assert not out["p95_ms"]["breached"] and not out["err_rate"]["breached"]
+    assert out["err_rate"]["burns"] == [0.0, 0.0]
+    # burn the error budget: 10 failures against 20 successes
+    stats.record_failure(10)
+    out = wd.evaluate_now()
+    assert out["err_rate"]["breached"]
+    assert out["err_rate"]["burn"] > 1.0
+    # exported instruments
+    text = reg.expose()
+    assert 'pt_slo_burn_rate{slo="err_rate"}' in text
+    assert 'pt_slo_breach_total{slo="err_rate"} 1' in text
+    assert 'pt_slo_breach_total{slo="p95_ms"} 0' in text
+    # typed event + automatic (rate-limited) bundle dump
+    breaches = event_log.events(type="slo_breach")
+    assert breaches and breaches[0].attrs["slo"] == "err_rate"
+    assert len(recorder.dumps) == 1
+    bundle = obs_flight.load_bundle(recorder.dumps[0])
+    assert obs_flight.validate_bundle(bundle) == []
+    assert bundle["trigger"]["type"] == "slo_breach"
+    # a second breach inside the rate-limit window does NOT dump again
+    wd.evaluate_now()
+    assert len(recorder.dumps) == 1
+    summary = wd.summary()
+    assert summary["breaches"]["err_rate"] >= 2
+    wd.close()
+
+
+def test_slo_gauge_consecutive_rule():
+    vals = {"v": 200.0}
+    s = obs_slo.SLO("p95_ms", 100.0, lambda: vals["v"], kind="gauge",
+                    consecutive=2)
+    assert not s.evaluate()["breached"]  # first over: streak 1
+    assert s.evaluate()["breached"]      # second consecutive: breach
+    vals["v"] = 10.0
+    assert not s.evaluate()["breached"]  # recovered: streak resets
+    f = obs_slo.SLO("mfu", 0.5, lambda: 0.25, kind="gauge", floor=True,
+                    consecutive=1)
+    r = f.evaluate()
+    assert r["breached"] and r["burn"] == pytest.approx(2.0)
+
+
+def test_judge_bench_and_spec_parsing():
+    specs = obs_slo.parse_slo_spec("p95_ms=50, err_rate=0.1,qps_min=1")
+    assert specs == {"p95_ms": 50.0, "err_rate": 0.1, "qps_min": 1.0}
+    with pytest.raises(ValueError):
+        obs_slo.parse_slo_spec("p95ms=50")  # typo'd key fails loudly
+    ok, lines = obs_slo.judge_bench(
+        {"p95_ms": 20.0, "qps": 100.0, "requests": 100, "errors": 0,
+         "retry_exhausted": 0, "deadline_missed": 0}, specs)
+    assert ok and all("SLO ok" in l for l in lines)
+    ok, lines = obs_slo.judge_bench(
+        {"p95_ms": 80.0, "qps": 100.0, "requests": 8, "errors": 2,
+         "retry_exhausted": 0, "deadline_missed": 0}, specs)
+    assert not ok
+    assert sum("BREACH" in l for l in lines) == 2  # p95 + err_rate
+    # generation-mode key aliasing
+    ok, _ = obs_slo.judge_bench({"gen_p95_ms": 10.0, "generations": 5,
+                                 "errors": 0},
+                                {"p95_ms": 50.0})
+    assert ok
+    # a missing metric is a breach, not a silent pass
+    ok, lines = obs_slo.judge_bench({}, {"qps_min": 1.0})
+    assert not ok and "missing" in lines[0]
+
+
+# -- flight bundles + replay -----------------------------------------------
+
+
+def test_bundle_schema_valid_and_doctor_report(model_dir, event_log,
+                                               recorder):
+    event_log.emit("circuit_open", severity="warn", replica="127.0.0.1:1")
+    event_log.emit("failover", severity="warn", trace_id="aa11bb22cc33dd44",
+                   op="predict", failed_replica="127.0.0.1:1")
+    event_log.emit("slo_breach", severity="error", slo="p95_ms", burn=3.0)
+    path = recorder.dump(trigger={"type": "manual", "who": "test"})
+    bundle = obs_flight.load_bundle(path)
+    assert obs_flight.validate_bundle(bundle) == []
+    for k in obs_flight.REQUIRED_KEYS:
+        assert k in bundle
+    assert bundle["event_counts"]["failover"] == 1
+    # the dump itself left a bundle_dumped event (next bundle would carry it)
+    assert event_log.events(type="bundle_dumped")
+    # doctor reconstructs the timeline + findings
+    cli = _load_cli()
+    text, findings, problems = cli.doctor_report(bundle)
+    assert problems == []
+    assert "schema: valid" in text
+    assert "incident timeline" in text
+    assert "circuit_open" in text and "failover" in text
+    assert "aa11bb22cc33dd44" in text  # trace-id link printed
+    assert "suspect-ranked findings" in text
+    assert findings  # something warn/error ranked
+    assert any("slo" in t.lower() or "breach" in t.lower()
+               for _, t in findings)
+    # a truncated bundle is schema-INVALID and the doctor says so
+    bad = {k: v for k, v in bundle.items() if k != "events"}
+    bad["schema_version"] = 99
+    text2, _, problems2 = cli.doctor_report(bad)
+    assert problems2 and "SCHEMA INVALID" in text2
+
+
+def test_captured_predict_and_generate_replay_bit_identical(
+        model_dir, lm_dir, event_log, recorder):
+    """THE acceptance bit: a captured predict and a captured generation
+    replay bit-identically from the bundle against fresh engines."""
+    X = np.random.RandomState(5).randn(2, 4).astype("float32")
+    with ServingServer(model_dir, max_batch_size=8, batch_timeout_ms=1.0,
+                       capture_every=1) as srv:
+        with ServingClient(srv.endpoint) as c:
+            for i in range(3):
+                c.predict({"x": X + i}, trace=f"cap{i:013d}")
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 97, size=(5,)).astype(np.int64),
+               rng.randint(0, 97, size=(3,)).astype(np.int64)]
+    with ServingServer(lm_dir, max_batch_size=1, warmup=False,
+                       decode={"max_slots": 2}, capture_every=1) as srv:
+        with ServingClient(srv.endpoint) as c:
+            for p in prompts:
+                c.generate(p, max_new_tokens=6)
+    caps = recorder.captures
+    assert sum(1 for c in caps if c["kind"] == "predict") == 3
+    assert sum(1 for c in caps if c["kind"] == "generate") == 2
+    for c in caps:
+        assert c["weights_version"] == 1
+    path = recorder.dump(trigger={"type": "manual"})
+    bundle = obs_flight.load_bundle(path)
+    assert obs_flight.validate_bundle(bundle) == []
+    results = obs_flight.replay_bundle(bundle)
+    assert len(results) == 5
+    for r in results:
+        assert r["ok"], r
+        assert r["detail"] == "bit-identical"
+    # the CLI replay path agrees
+    cli = _load_cli()
+    assert cli.cmd_replay([path]) == 0
+    assert cli.cmd_doctor([path, "--replay"]) == 0
+    # a corrupted capture FAILS replay (the harness really compares)
+    bundle["captures"][0]["digest"] = "0" * 64
+    bad = dict(bundle)
+    results = obs_flight.replay_bundle(bad)
+    assert not results[0]["ok"] and all(r["ok"] for r in results[1:])
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_worker_thread_exception_dumps_bundle(event_log, recorder):
+    recorder.arm()
+    t = threading.Thread(
+        target=lambda: (_ for _ in ()).throw(RuntimeError("worker bug")),
+        name="paddle-tpu-crash-test")
+    t.start()
+    t.join(10)
+    deadline = time.monotonic() + 5
+    while not recorder.dumps and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert recorder.dumps, "worker crash did not dump a bundle"
+    evs = event_log.events(type="worker_exception")
+    assert evs and evs[0].attrs["thread"] == "paddle-tpu-crash-test"
+    assert "worker bug" in evs[0].attrs["exc"]
+    bundle = obs_flight.load_bundle(recorder.dumps[0])
+    assert obs_flight.validate_bundle(bundle) == []
+    assert bundle["trigger"]["type"] == "worker_exception"
+    # an unrelated thread's crash does NOT trigger (prefix-gated)
+    n = len(recorder.dumps)
+    t2 = threading.Thread(
+        target=lambda: (_ for _ in ()).throw(ValueError("not ours")),
+        name="user-thread")
+    t2.start()
+    t2.join(10)
+    assert len(recorder.dumps) == n
+
+
+# -- numerics sentinels ----------------------------------------------------
+
+
+def _train_fixture():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=4))
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    return main, startup, loss
+
+
+def test_sentinel_off_bit_identical_and_on_matches(event_log):
+    """The acceptance numerics bar: sentinel-off run_steps is the
+    untouched PR-8 path (same cache key shape, bit-identical across
+    executors), and sentinel-ON only ADDS reductions — the training
+    math itself stays bit-identical."""
+    with fluid.unique_name.guard():
+        main, startup, loss = _train_fixture()
+        feeds = [{"x": np.random.RandomState(i).randn(2, 8)
+                  .astype("float32")} for i in range(4)]
+
+        def run(sentinel):
+            scope = fluid.Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope, seed=3)
+            flags.set_flag("obs_sentinel", sentinel)
+            try:
+                out = exe.run_steps(main, feeds, fetch_list=[loss.name],
+                                    scope=scope, seed=7)
+            finally:
+                flags.set_flag("obs_sentinel", False)
+            return np.asarray(out[0])
+
+        off1, off2, on = run(False), run(False), run(True)
+        np.testing.assert_array_equal(off1, off2)
+        np.testing.assert_array_equal(off1, on)
+    # a healthy window emits no NaN events
+    assert not event_log.events(type="nan_detected")
+
+
+def test_sentinel_nan_event_and_bundle(event_log, recorder):
+    with fluid.unique_name.guard():
+        main, startup, loss = _train_fixture()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope, seed=3)
+        flags.set_flag("obs_sentinel", True)
+        try:
+            good = [{"x": np.ones((2, 8), "float32")} for _ in range(2)]
+            exe.run_steps(main, good, fetch_list=[loss.name], scope=scope)
+            assert not event_log.events(type="nan_detected")
+            bad = [{"x": np.full((2, 8), np.nan, "float32")}
+                   for _ in range(3)]
+            exe.run_steps(main, bad, fetch_list=[loss.name], scope=scope)
+        finally:
+            flags.set_flag("obs_sentinel", False)
+    nans = event_log.events(type="nan_detected")
+    assert len(nans) == 3  # step-attributed: one per poisoned step
+    assert all(e.step is not None for e in nans)
+    assert len({e.step for e in nans}) == 3
+    # exactly ONE bundle on the first NaN (the latch)
+    nan_dumps = [p for p in recorder.dumps if "nan" in os.path.basename(p)]
+    assert len(nan_dumps) == 1
+    bundle = obs_flight.load_bundle(nan_dumps[0])
+    assert obs_flight.validate_bundle(bundle) == []
+    assert bundle["trigger"]["type"] == "nan"
+    assert bundle["flags"]["obs_sentinel"] is True
+
+
+def test_sentinel_spike_events(event_log):
+    """A sudden 100x loss/update jump after a calm EMA emits spike
+    events (warn, step-attributed) without killing the run."""
+    with fluid.unique_name.guard():
+        main, startup, loss = _train_fixture()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope, seed=3)
+        flags.set_flag("obs_sentinel", True)
+        try:
+            calm = [{"x": np.full((2, 8), 0.1, "float32")}
+                    for _ in range(4)]
+            exe.run_steps(main, calm, fetch_list=[loss.name], scope=scope)
+            spike = [{"x": np.full((2, 8), 1e4, "float32")}]
+            exe.run_steps(main, spike, fetch_list=[loss.name], scope=scope)
+        finally:
+            flags.set_flag("obs_sentinel", False)
+    types = {e.type for e in event_log.events()}
+    assert "grad_norm_spike" in types or "loss_spike" in types
+
+
+# -- fleet router HTTP metrics (satellite) ---------------------------------
+
+
+def test_fleet_router_http_metrics_and_cli(model_dir, event_log):
+    from paddle_tpu.serving import LocalFleet
+
+    with LocalFleet(model_dir, 2,
+                    server_kwargs={"batch_timeout_ms": 1.0},
+                    router_kwargs={"scrape_interval_s": 0.05,
+                                   "metrics_port": 0}) as fl:
+        X = np.random.randn(1, 4).astype("float32")
+        fl.router.predict({"x": X})
+        ep = fl.router.metrics_endpoint
+        assert ep is not None
+        body = urllib.request.urlopen(
+            f"http://{ep}/metrics", timeout=10).read().decode()
+        assert 'pt_fleet_requests_total{event="completed"} 1' in body
+        assert "pt_fleet_pressure" in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://{ep}/healthz", timeout=10).read().decode())
+        assert hz["replicas"] == 2 and "state" in hz
+        # paddle_cli fleet --router reads the same surface
+        cli = _load_cli()
+        summary = cli.router_summary(ep)
+        assert summary["reachable"] and summary["replicas"] == 2
+        report = cli.router_report(summary)
+        assert "replicas=" in report and "pressure=" in report
+    # unreachable after close
+    cli = _load_cli()
+    assert not cli.router_summary(ep, timeout=0.5)["reachable"]
+
+
+def _load_serve_bench():
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    return sb
+
+
+def test_serve_bench_slo_gate(model_dir, capsys):
+    sb = _load_serve_bench()
+    rc = sb.main(["--model-dir", model_dir, "--clients", "1",
+                  "--duration", "0.4", "--slo",
+                  "p95_ms=100000,err_rate=1.0"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "SLO JUDGMENT: ok" in out
+    rc = sb.main(["--model-dir", model_dir, "--clients", "1",
+                  "--duration", "0.4", "--slo", "p95_ms=0.000001"])
+    out = capsys.readouterr().out
+    assert rc != 0
+    assert "SLO BREACH" in out

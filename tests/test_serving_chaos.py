@@ -333,7 +333,18 @@ def test_chaos_storm_typed_errors_only_then_healthy(model_dirs):
     """Connection drops + slow steps + step faults + queue stalls for a
     bounded window against backoff-retrying clients: every request ends in
     a numerically-correct success or a TYPED error (no hangs, no silent
-    loss), the server drains cleanly, and healthz returns to healthy."""
+    loss), the server drains cleanly, and healthz returns to healthy.
+
+    PR 9: the storm runs with the event log on — afterwards the black box
+    must hold a typed ``chaos_inject`` event for EVERY injected fault
+    (counts joined back through the injector's own counters) with zero
+    ring drops."""
+    from paddle_tpu.obs.events import get_event_log
+    from paddle_tpu.serving.chaos import FAULT_NAMES
+
+    event_log = get_event_log()
+    event_log.enable(capacity=8192)
+    event_log.clear()
     dir_a = model_dirs[0]
     pred = Predictor(dir_a, place=fluid.CPUPlace())
     chaos = ChaosInjector(seed=11, slow_call_prob=0.15, slow_call_ms=20.0,
@@ -394,6 +405,26 @@ def test_chaos_storm_typed_errors_only_then_healthy(model_dirs):
     # and shutdown drains cleanly
     srv.close()
     assert srv.batcher.pending == 0
+
+    # the black box reconstructs the storm: one typed chaos_inject event
+    # per injected fault (slow_call/error/drop_conn/stall), zero drops
+    try:
+        assert event_log.dropped == 0
+        injected = chaos.snapshot()["injected"]
+        by_fault = {}
+        for e in event_log.events(type="chaos_inject"):
+            f = e.attrs["fault"]
+            by_fault[f] = by_fault.get(f, 0) + 1
+        for counter, n in injected.items():
+            assert by_fault.get(FAULT_NAMES[counter], 0) == n, \
+                (counter, by_fault, injected)
+        # organic consequences left typed events too: every injected
+        # step fault surfaced as a typed batch failure
+        if injected["errors"]:
+            assert "batch_failed" in event_log.counts()
+    finally:
+        event_log.disable()
+        event_log.clear()
 
 
 # ---------------------------------------------------------------------------

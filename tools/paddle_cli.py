@@ -20,6 +20,15 @@ Subcommands:
              (splits, predicted comm bytes/step, per-device HBM).
              NONZERO exit when no plan fits the modeled HBM — the
              must-shard signal a deploy script can gate on.
+  doctor   — reconstruct an incident from a flight-recorder postmortem
+             bundle (obs/flight.py): schema validation, the event
+             timeline (events joined with span exemplars and SLO
+             breaches via trace ids), dominant-stage/replica
+             attribution, and suspect-ranked findings. ``--replay``
+             re-runs the bundle's captured predict/generate requests
+             against fresh engines and verifies bit-identical outputs.
+             Exit 2 on a schema-invalid bundle, 1 on replay mismatch.
+  replay   — just the replay harness over a bundle's captures.
 """
 from __future__ import annotations
 
@@ -213,6 +222,56 @@ def fleet_rows(endpoints, timeout=3.0):
     return rows
 
 
+def router_summary(endpoint, timeout=3.0):
+    """Scrape a FleetRouter's own HTTP /metrics + /healthz (the router
+    satellite: FleetRouter(metrics_port=...)) into one status dict."""
+    import json as _json
+    import urllib.request
+
+    sys.path.insert(0, REPO)
+    from paddle_tpu.serving.fleet import parse_prometheus_gauges
+
+    out = {"endpoint": endpoint, "reachable": False}
+    try:
+        hz = _json.loads(urllib.request.urlopen(
+            f"http://{endpoint}/healthz", timeout=timeout).read().decode())
+        text = urllib.request.urlopen(
+            f"http://{endpoint}/metrics", timeout=timeout).read().decode()
+        g = parse_prometheus_gauges(text)
+    except Exception:
+        return out
+    # pt_fleet_failovers_total is labeled by op — parse_prometheus_gauges
+    # keeps only the first sample per family, so sum the series by hand
+    failovers = 0.0
+    for line in text.splitlines():
+        if line.startswith("pt_fleet_failovers_total{"):
+            try:
+                failovers += float(line.rsplit(None, 1)[1])
+            except (IndexError, ValueError):
+                pass
+    out.update(
+        reachable=True, state=hz.get("state", "?"),
+        replicas=int(g.get("pt_fleet_replicas", 0)),
+        healthy=int(g.get("pt_fleet_healthy_replicas", 0)),
+        pressure=g.get("pt_fleet_pressure", 0.0),
+        qps_per_replica=g.get("pt_fleet_qps_per_replica", 0.0),
+        hedges=int(g.get("pt_fleet_hedges_total", 0)),
+        failovers=int(failovers),
+        circuit_opens=int(g.get("pt_fleet_circuit_open_total", 0)))
+    return out
+
+
+def router_report(r):
+    if not r.get("reachable"):
+        return f"router {r['endpoint']}: UNREACHABLE"
+    return (f"router {r['endpoint']}: state={r['state']} "
+            f"replicas={r['healthy']}/{r['replicas']} healthy  "
+            f"pressure={r['pressure']:.2f}  "
+            f"qps/replica={r['qps_per_replica']:.1f}  "
+            f"hedges={r['hedges']} failovers={r['failovers']} "
+            f"circuit_opens={r['circuit_opens']}")
+
+
 def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
              f"{'occ':>5}{'mfu':>11}{'shards':>7}{'weights':>9}  decode"]
@@ -241,11 +300,257 @@ def cmd_fleet(argv):
                          "comma-separated)")
     ap.add_argument("--timeout", type=float, default=3.0,
                     help="per-replica scrape timeout (s)")
+    ap.add_argument("--router", metavar="HOST:PORT", default=None,
+                    help="also scrape a FleetRouter's own HTTP metrics "
+                         "endpoint (FleetRouter(metrics_port=...)) and "
+                         "print the router-level gauges above the table")
     args = ap.parse_args(argv)
+    router_ok = True
+    if args.router:
+        r = router_summary(args.router, timeout=args.timeout)
+        print(router_report(r))
+        router_ok = bool(r.get("reachable")) \
+            and r.get("state") == "healthy"
     eps = [e for spec in args.endpoints for e in spec.split(",") if e]
     rows = fleet_rows(eps, timeout=args.timeout)
     print(fleet_report(rows))
-    return 0 if all(r["health"] == "healthy" for r in rows) else 1
+    return 0 if router_ok \
+        and all(r["health"] == "healthy" for r in rows) else 1
+
+
+# -- postmortem doctor -----------------------------------------------------
+
+
+def _fmt_attrs(attrs, limit=4):
+    if not attrs:
+        return ""
+    items = list(attrs.items())[:limit]
+    s = " ".join(f"{k}={v}" for k, v in items)
+    return s if len(s) <= 76 else s[:73] + "..."
+
+
+def _exemplar_stage_totals(bundle):
+    """stage/span name -> total ms across the bundle's span exemplars."""
+    totals = {}
+    for ex in bundle.get("exemplars") or []:
+        for sp in ex.get("spans") or []:
+            name = sp.get("name", "?")
+            dur = sp.get("dur_ms")
+            if dur is None:
+                dur = sp.get("dur", 0.0) * 1e3
+            totals[name] = totals.get(name, 0.0) + float(dur)
+    return totals
+
+
+def doctor_findings(bundle):
+    """Suspect-ranked findings: [(score, text)] most-suspect first.
+    Heuristics over the joined evidence: error events dominate, then
+    chaos/warn activity per replica, NaN sentinels, SLO breaches, and the
+    dominant stage of the retained p99 exemplars."""
+    events = bundle.get("events") or []
+    findings = []
+    # chaos injections aggregate across ALL severities (faults are warn,
+    # heals like restarts are info — the harness's activity is one story)
+    faults = {}
+    for e in events:
+        if e.get("type") == "chaos_inject":
+            f = (e.get("attrs") or {}).get("fault", "?")
+            faults[f] = faults.get(f, 0) + 1
+    if faults:
+        findings.append((3 * sum(faults.values()),
+                         f"chaos harness injected "
+                         f"{sum(faults.values())} faults: "
+                         + ", ".join(f"{k} x{v}"
+                                     for k, v in sorted(faults.items()))))
+    # typed error/warn events grouped by (type, replica)
+    by_key = {}
+    for e in events:
+        if e.get("severity") not in ("warn", "error") \
+                or e.get("type") == "chaos_inject":
+            continue
+        attrs = e.get("attrs") or {}
+        key = (e.get("type"), attrs.get("replica") or attrs.get("endpoint"))
+        by_key.setdefault(key, []).append(e)
+    for (typ, rep), evs in by_key.items():
+        sev = any(x.get("severity") == "error" for x in evs)
+        score = len(evs) * (10 if sev else 3)
+        where = f" on {rep}" if rep else ""
+        if typ == "nan_detected":
+            steps = sorted(x.get("step") for x in evs
+                           if x.get("step") is not None)
+            findings.append((score * 5, f"training numerics: NaN at "
+                             f"step(s) {steps[:5]} — see the captured "
+                             f"metrics/flags for the config that produced "
+                             f"it"))
+        elif typ == "slo_breach":
+            slos = {}
+            for x in evs:
+                s = (x.get("attrs") or {}).get("slo", "?")
+                slos[s] = slos.get(s, 0) + 1
+            findings.append((score * 2, "SLO burn: "
+                             + ", ".join(f"{k} breached x{v}"
+                                         for k, v in sorted(slos.items()))))
+        else:
+            findings.append((score, f"{len(evs)} x {typ}{where}"))
+    # 2) dominant stage across exemplar span lists
+    totals = _exemplar_stage_totals(bundle)
+    if totals:
+        total = sum(totals.values())
+        stage, ms = max(totals.items(), key=lambda kv: kv[1])
+        if total > 0:
+            findings.append((int(ms), f"dominant stage across p99 "
+                             f"exemplars: {stage} "
+                             f"({ms / total:.0%} of retained span time)"))
+    # 3) dropped events = incomplete evidence
+    if bundle.get("events_dropped"):
+        findings.append((1, f"event ring dropped "
+                         f"{bundle['events_dropped']} events — raise "
+                         f"obs_events_capacity for complete postmortems"))
+    findings.sort(key=lambda f: -f[0])
+    return findings
+
+
+def doctor_report(bundle, top=40):
+    """(report_text, findings, schema_problems) — the testable core of
+    ``cmd_doctor``."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.obs.flight import validate_bundle
+
+    problems = validate_bundle(bundle)
+    lines = []
+    trig = bundle.get("trigger") or {}
+    lines.append(f"postmortem bundle schema v{bundle.get('schema_version')} "
+                 f"— trigger: {trig.get('type', '?')} "
+                 f"{_fmt_attrs({k: v for k, v in trig.items() if k != 'type'})}")
+    if problems:
+        lines.append("SCHEMA INVALID:")
+        lines.extend(f"  - {p}" for p in problems)
+    else:
+        lines.append("schema: valid")
+    events = sorted(bundle.get("events") or [], key=lambda e: e.get("t", 0))
+    lines.append(f"events: {len(events)} retained, "
+                 f"{bundle.get('events_dropped', 0)} dropped; counts: "
+                 + (", ".join(f"{k}={v}" for k, v in
+                              sorted((bundle.get('event_counts')
+                                      or {}).items())) or "none"))
+    if events:
+        t0 = events[0].get("t", 0.0)
+        lines.append("")
+        lines.append("incident timeline (relative seconds):")
+        shown = events if len(events) <= top else events[-top:]
+        if len(events) > top:
+            lines.append(f"  ... {len(events) - top} earlier events elided "
+                         f"(--top)")
+        for e in shown:
+            tid = f"  [{e['trace_id']}]" if e.get("trace_id") else ""
+            step = f" step={e['step']}" if e.get("step") is not None else ""
+            lines.append(f"  +{e.get('t', 0.0) - t0:8.3f}s "
+                         f"{e.get('severity', '?'):<5} "
+                         f"{e.get('type', '?'):<22}"
+                         f"{_fmt_attrs(e.get('attrs'))}{step}{tid}")
+    # events <-> exemplar spans join by trace id
+    ex_keys = {ex.get("key") for ex in bundle.get("exemplars") or []}
+    linked = sorted({e["trace_id"] for e in events
+                     if e.get("trace_id") in ex_keys})
+    if linked:
+        lines.append("")
+        lines.append(f"traces linked to retained span exemplars: "
+                     f"{', '.join(linked[:8])}")
+    breaches = [e for e in events if e.get("type") == "slo_breach"]
+    if breaches:
+        lines.append("")
+        lines.append("SLO breaches:")
+        for e in breaches[:10]:
+            lines.append(f"  {_fmt_attrs(e.get('attrs'))}")
+    slo_prov = (bundle.get("providers") or {}).get("slo")
+    if isinstance(slo_prov, dict) and slo_prov.get("breaches"):
+        lines.append(f"watchdog totals: {slo_prov['breaches']} over "
+                     f"{slo_prov.get('evals')} evaluations")
+    findings = doctor_findings(bundle)
+    lines.append("")
+    lines.append("suspect-ranked findings:")
+    if findings:
+        for i, (score, text) in enumerate(findings[:10], 1):
+            lines.append(f"  {i}. [{score:>5}] {text}")
+    else:
+        lines.append("  (no warn/error evidence — quiet bundle)")
+    caps = bundle.get("captures") or []
+    lines.append("")
+    lines.append(f"captured requests: {len(caps)} "
+                 f"({sum(1 for c in caps if c.get('kind') == 'predict')} "
+                 f"predict, "
+                 f"{sum(1 for c in caps if c.get('kind') == 'generate')} "
+                 f"generate) — replay with `paddle_cli.py doctor --replay`")
+    return "\n".join(lines), findings, problems
+
+
+def _print_replay(results):
+    ok = True
+    for r in results:
+        # ok=None = skipped (digest-only capture): reported, not a failure
+        ok &= r.get("ok") is not False
+        flag = {True: "OK  ", False: "FAIL", None: "SKIP"}[r.get("ok")]
+        print(f"  capture #{r.get('id')} {r.get('kind'):<9} "
+              f"{flag} {r.get('detail')}")
+    n_ok = sum(1 for r in results if r.get("ok"))
+    n_skip = sum(1 for r in results if r.get("ok") is None)
+    tail = f" ({n_skip} skipped)" if n_skip else ""
+    print(f"replay: {n_ok}/{len(results) - n_skip} bit-identical{tail}")
+    return ok
+
+
+def cmd_doctor(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py doctor",
+        description="reconstruct an incident from a flight-recorder "
+                    "postmortem bundle")
+    ap.add_argument("bundle", help="bundle JSON (FlightRecorder.dump)")
+    ap.add_argument("--top", type=int, default=40,
+                    help="timeline rows to print")
+    ap.add_argument("--replay", action="store_true",
+                    help="re-run the captured requests and verify "
+                         "bit-identical outputs")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, REPO)
+    from paddle_tpu.obs.flight import load_bundle, replay_bundle
+
+    bundle = load_bundle(args.bundle)
+    text, _findings, problems = doctor_report(bundle, top=args.top)
+    print(text)
+    if problems:
+        return 2
+    if args.replay:
+        results = replay_bundle(bundle)
+        if results:
+            if not _print_replay(results):
+                return 1
+        else:
+            print("replay: no captures in the bundle")
+    return 0
+
+
+def cmd_replay(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py replay",
+        description="re-run a bundle's captured requests against fresh "
+                    "engines; verify bit-identical outputs")
+    ap.add_argument("bundle")
+    ap.add_argument("--model-dir", default=None,
+                    help="override the captures' recorded export dir")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, REPO)
+    from paddle_tpu.obs.flight import load_bundle, replay_bundle
+
+    results = replay_bundle(load_bundle(args.bundle),
+                            model_dir=args.model_dir)
+    if not results:
+        print("no captures in the bundle")
+        return 0
+    return 0 if _print_replay(results) else 1
 
 
 # -- placement search ------------------------------------------------------
@@ -337,8 +642,8 @@ def cmd_placement(argv):
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle_cli.py {train|version|trace|fleet|placement} "
-              "[args...]")
+        print("usage: paddle_cli.py {train|version|trace|fleet|placement|"
+              "doctor|replay} [args...]")
         return 0
     sub = sys.argv[1]
     if sub == "version":
@@ -353,8 +658,12 @@ def main():
         return cmd_fleet(sys.argv[2:])
     if sub == "placement":
         return cmd_placement(sys.argv[2:])
+    if sub == "doctor":
+        return cmd_doctor(sys.argv[2:])
+    if sub == "replay":
+        return cmd_replay(sys.argv[2:])
     print(f"unknown subcommand {sub!r}; use "
-          f"train|version|trace|fleet|placement")
+          f"train|version|trace|fleet|placement|doctor|replay")
     return 2
 
 

@@ -28,10 +28,17 @@ decode plane: aggregate generated tokens/s, time-to-first-token and
 inter-token latency p50/p95, mean/max KV-slot occupancy (sampled), and
 the decode compile cache (steady state must show zero recompiles).
 
+``--slo p95_ms=...,err_rate=...`` judges the finished run against
+declared SLOs (obs/slo.py judge_bench) with NONZERO exit on breach — the
+serving twin of bench.py's per-class bars; ``--log-json`` routes the
+structured event log (obs/events.py) through stdlib logging as one-line
+JSON.
+
 Examples::
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
-        --clients 8 --duration 10 --rows 1 --max-batch-size 16
+        --clients 8 --duration 10 --rows 1 --max-batch-size 16 \
+        --slo p95_ms=50,err_rate=0.01
     python tools/serve_bench.py --endpoint 127.0.0.1:9000 --shape x=4
     JAX_PLATFORMS=cpu python tools/serve_bench.py --model-dir /tmp/model \
         --chaos --chaos-seed 7 --duration 6 --deadline-ms 500
@@ -345,6 +352,24 @@ def bench(endpoint, feeds, clients, duration, retries=0, deadline_ms=None):
             "p99_ms": _percentile(lats, 0.99) * 1e3}
 
 
+def _judge_slo(args, result, rc):
+    """The --slo satellite: judge the finished run against declared SLOs
+    (the serving twin of bench.py's per-class bars). Returns the exit
+    code — nonzero on any breach."""
+    if not args.slo:
+        return rc
+    from paddle_tpu.obs.slo import judge_bench, parse_slo_spec
+
+    ok, lines = judge_bench(result, parse_slo_spec(args.slo))
+    for line in lines:
+        print(line)
+    if not ok:
+        print("SLO JUDGMENT: BREACH (nonzero exit)", file=sys.stderr)
+        return rc or 1
+    print("SLO JUDGMENT: ok")
+    return rc
+
+
 def _parse_tenants(specs):
     """name:priority[:rate[:burst]] -> [(name, priority, rate, burst)]."""
     out = []
@@ -443,7 +468,7 @@ def _main_fleet(args, shapes, tracer):
         if tracer is not None:
             n = tracer.dump(args.trace_out)
             print(f"chrome trace: {args.trace_out} ({n} spans)")
-        return 0 if r["errors"] == 0 else 1
+        return _judge_slo(args, r, 0 if r["errors"] == 0 else 1)
     finally:
         if storm is not None:
             storm.stop()
@@ -532,7 +557,33 @@ def main(argv=None):
                     help="enable the obs span tracer and write a Chrome "
                          "trace (chrome://tracing / ui.perfetto.dev) of "
                          "the run; inspect with tools/paddle_cli.py trace")
+    ap.add_argument("--slo", metavar="k=v,...",
+                    help="judge the run against declared SLOs — e.g. "
+                         "p95_ms=50,err_rate=0.01,qps_min=100 (generation "
+                         "runs: tokens_per_s_min, ttft_p95_ms) — with "
+                         "NONZERO exit on breach (the serving twin of "
+                         "bench.py's bars)")
+    ap.add_argument("--log-json", action="store_true",
+                    help="route structured obs events (health "
+                         "transitions, sheds, faults, chaos injections) "
+                         "through stdlib logging as one-line JSON")
     args = ap.parse_args(argv)
+    if args.log_json:
+        import logging
+
+        logging.basicConfig(level=logging.INFO,
+                            format="%(name)s %(message)s")
+        from paddle_tpu.obs.events import enable_json_logging
+
+        enable_json_logging()
+    if args.slo:
+        # validate the spec BEFORE spending the bench time on a typo
+        from paddle_tpu.obs.slo import parse_slo_spec
+
+        try:
+            parse_slo_spec(args.slo)
+        except ValueError as e:
+            ap.error(str(e))
     if not args.model_dir and not args.endpoint:
         ap.error("one of --model-dir / --endpoint is required")
     if args.chaos and not args.model_dir:
@@ -665,7 +716,7 @@ def main(argv=None):
             if tracer is not None:
                 n = tracer.dump(args.trace_out)
                 print(f"chrome trace: {args.trace_out} ({n} spans)")
-            return 0 if r["errors"] == 0 else 1
+            return _judge_slo(args, r, 0 if r["errors"] == 0 else 1)
 
         rng = np.random.RandomState(0)
         feeds = {n: rng.rand(args.rows, *dims).astype("float32")
@@ -723,7 +774,7 @@ def main(argv=None):
             n = tracer.dump(args.trace_out)
             print(f"chrome trace: {args.trace_out} ({n} spans; "
                   f"summarize with tools/paddle_cli.py trace)")
-        return 0 if r["errors"] == 0 else 1
+        return _judge_slo(args, r, 0 if r["errors"] == 0 else 1)
     finally:
         if server is not None:
             server.close()
