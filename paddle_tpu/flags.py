@@ -76,6 +76,19 @@ _DEFAULTS: Dict[str, Any] = {
     # where automatic postmortem bundles land ("" = <tempdir>/
     # paddle_tpu_flight); obs/flight.py FlightRecorder.dump
     "obs_flight_dir": "",
+    # CPU serving lane (serving/quant.py, docs/design.md §20):
+    # serving_quantize is the default weight-only quantization mode of
+    # every ServingServer built without an explicit quantize= — "" = f32,
+    # "int8"/"bf16" = forced, "auto" = adopt the export's measured
+    # cpu_tuned.json (written by `tools/perf_lab.py cpu` only on a >5%
+    # closed-loop win)
+    "serving_quantize": "",
+    # XLA CPU thread-pool shaping (quant.apply_cpu_flags; must apply
+    # BEFORE jax initializes): 0 = backend default, 1 = single-threaded
+    # Eigen, N>1 = restrict process affinity to N cores. cpu_pin also
+    # pins affinity at the current/default width.
+    "cpu_threads": 0,
+    "cpu_pin": False,
 }
 
 _flags: Dict[str, Any] = {}

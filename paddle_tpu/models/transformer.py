@@ -459,6 +459,93 @@ def decode_params_from_scope(roles, scope):
     return params
 
 
+def train_successor_lm_export(dirname, vocab_size=512, max_len=32,
+                              d_model=128, n_heads=4, n_layers=2, d_ff=512,
+                              seed=11, steps=120, lr=3e-3, batch=8):
+    """Train a tiny causal LM on the deterministic successor task
+    (labels = (ids*3 + 7) mod V) and export it for inference — the ONE
+    pinned-export builder bench.py's cpu_quantized workload and
+    `perf_lab.py cpu` share, so the bar and the tuning sweep always
+    measure the same model. A trained export matters for the quantized
+    lane: random-init greedy margins are quantization-noise-sized, a
+    model confident on the successor task agrees with its quantized twin
+    at 100% (docs/design.md §20)."""
+    import paddle_tpu as fluid
+    from .. import io as model_io
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[max_len], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[max_len],
+                                       dtype="int64")
+            logits, loss = transformer_lm(
+                ids, labels, vocab_size=vocab_size, max_len=max_len,
+                d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+                d_ff=d_ff)
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(lr).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)
+        rng = np.random.RandomState(seed)
+        for _ in range(steps):
+            x = rng.randint(0, vocab_size, (batch, max_len)).astype(np.int64)
+            exe.run(main, feed={"ids": x, "labels": (x * 3 + 7) % vocab_size},
+                    fetch_list=[loss], scope=scope)
+        model_io.save_inference_model(dirname, ["ids"], [logits], exe,
+                                      test_prog, scope=scope)
+    return dirname
+
+
+def _w_leaf(w):
+    """Split a serving weight leaf into ``(stored, scale)``. Leaves come in
+    three forms (docs/design.md §20): a plain f32 array (stock), a bf16
+    array (weight-only bf16 storage), or an int8 ``{"q", "s"}`` dict
+    (weight-only per-output-channel symmetric int8 — serving/quant.py
+    builds them). The forwards below stay bit-identical to the exported IR
+    program on f32 leaves: the f32 branch of every helper is the exact
+    pre-quantization expression."""
+    if isinstance(w, dict):
+        return w["q"], w["s"]
+    return w, None
+
+
+def _w_cols(w):
+    """Output-feature count of a weight leaf (the reshape target)."""
+    return (w["q"] if isinstance(w, dict) else w).shape[-1]
+
+
+def _embed_rows(emb, ids):
+    """Gather embedding rows from a (possibly quantized) table — only the
+    gathered rows dequantize, never the whole [V, D] table."""
+    import jax.numpy as jnp
+
+    from ..ops.quant import dequant_rows
+
+    if isinstance(emb, dict):
+        return dequant_rows(emb["q"], ids, emb["s"])
+    if emb.dtype != jnp.float32:  # bf16 storage
+        return dequant_rows(emb, ids)
+    return jnp.take(emb, ids, axis=0)  # stock path, expression unchanged
+
+
+def _dc_matmul(a, w):
+    """decode_forward_chunk's weight matmul over a leaf. The f32 branch is
+    verbatim ``a @ w`` — the expression whose bit-match against the IR op
+    kernels the decode tests pin — and the quantized branches are the §20
+    kernel (f32-accumulated dot, per-output-channel scale in the
+    weight side — see ops/quant.dequant_matmul for why the scale must
+    not ride the output)."""
+    import jax.numpy as jnp
+
+    if isinstance(w, dict):
+        return a @ (w["q"].astype(jnp.float32) * w["s"])
+    if w.dtype != jnp.float32:  # bf16 storage
+        return a @ w.astype(jnp.float32)
+    return a @ w
+
+
 def _tp_gather(tp_axis):
     """Last-axis all-gather over a shard_map mesh axis (identity when no
     axis) — the ONE collective of the serving tier's tensor layout. Column
@@ -503,6 +590,7 @@ def predict_forward(params, ids, *, cfg, tp: int = 1, tp_axis=None):
     import jax.numpy as jnp
 
     from ..ops.pallas_attention import flash_attention_fwd
+    from ..ops.quant import dequant_matmul
 
     B, t = ids.shape
     H = cfg["n_heads"]
@@ -512,10 +600,12 @@ def predict_forward(params, ids, *, cfg, tp: int = 1, tp_axis=None):
     gather = _tp_gather(tp_axis if tp > 1 else None)
 
     def fc(x, w, b=None):
-        # ops/math.py mul: flatten to 2D, f32-accumulated dot, reshape back
-        out = jnp.dot(x.reshape(-1, x.shape[-1]), w,
-                      preferred_element_type=jnp.float32)
-        out = out.astype(jnp.float32).reshape(x.shape[:-1] + (w.shape[-1],))
+        # ops/math.py mul: flatten to 2D, f32-accumulated dot, reshape
+        # back. Quantized leaves (docs §20) dequantize inside the dot —
+        # the f32 branch of dequant_matmul is this exact stock expression
+        q, s = _w_leaf(w)
+        out = dequant_matmul(x.reshape(-1, x.shape[-1]), q, s)
+        out = out.astype(jnp.float32).reshape(x.shape[:-1] + (_w_cols(w),))
         return out if b is None else out + b
 
     def ln(x, s, b):
@@ -526,7 +616,7 @@ def predict_forward(params, ids, *, cfg, tp: int = 1, tp_axis=None):
         y = (x - mean) * jax.lax.rsqrt(var + eps)
         return y * s.reshape((1, 1, -1)) + b.reshape((1, 1, -1))
 
-    x = gather(jnp.take(params["emb"], ids.astype(jnp.int32), axis=0))
+    x = gather(_embed_rows(params["emb"], ids.astype(jnp.int32)))
     x = x + params["pos"][0][:t]
     for lp in params["layers"]:
         a = ln(x, lp["ln1_s"], lp["ln1_b"])
@@ -619,15 +709,16 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
             jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
         return (x - mean) * jax.lax.rsqrt(var + eps) * s + b
 
-    x = gather(params["emb"][tokens]) + params["pos"][0][posm]
+    x = gather(_embed_rows(params["emb"], tokens)) + params["pos"][0][posm]
     key_idx = jnp.arange(window, dtype=jnp.int32)
     mask = key_idx[None, None, None, :] <= posm[:, None, :, None]  # [B,1,C,W]
     for li, lp in enumerate(params["layers"]):
         a = ln(x, lp["ln1_s"], lp["ln1_b"])
         if "wqkv" in lp:
-            q, k, v = jnp.split(a @ lp["wqkv"], 3, axis=-1)
+            q, k, v = jnp.split(_dc_matmul(a, lp["wqkv"]), 3, axis=-1)
         else:
-            q, k, v = a @ lp["wq"], a @ lp["wk"], a @ lp["wv"]
+            q, k, v = (_dc_matmul(a, lp["wq"]), _dc_matmul(a, lp["wk"]),
+                       _dc_matmul(a, lp["wv"]))
         q = q.reshape(B, C, H_loc, Dh)
         k = k.reshape(B, C, H_loc, Dh)
         v = v.reshape(B, C, H_loc, Dh)
@@ -645,20 +736,20 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
         p = jnp.exp(logits - lse[..., None])
         ctx = gather(jnp.einsum("bhck,bkhd->bchd", p, vw)
                      .reshape(B, C, D // tp))
-        x = x + gather(ctx @ lp["wo"])
+        x = x + gather(_dc_matmul(ctx, lp["wo"]))
         f = ln(x, lp["ln2_s"], lp["ln2_b"])
-        h = f @ lp["wup"]
+        h = _dc_matmul(f, lp["wup"])
         if "bup" in lp:
             h = h + lp["bup"]
         h = jnp.maximum(h, 0.0)
-        f2 = gather(h) @ lp["wdown"]
+        f2 = _dc_matmul(gather(h), lp["wdown"])
         if "bdown" in lp:
             f2 = f2 + lp["bdown"]
         x = x + gather(f2)
     xn = ln(x, params["lnf_s"], params["lnf_b"])
     last = jnp.maximum(valids - 1, 0)
     xl = xn[jnp.arange(B), last]  # [B, D] — each lane's last valid position
-    head_logits = xl @ params["out_w"]
+    head_logits = _dc_matmul(xl, params["out_w"])
     if "out_b" in params:
         head_logits = head_logits + params["out_b"]
     head_logits = gather(head_logits)

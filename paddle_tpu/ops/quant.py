@@ -72,3 +72,82 @@ def fake_dequantize_max_abs(ctx, ins, attrs):
     max_range = attrs.get("max_range", 127.0)
     s = scale.reshape(-1)[0].astype(x.dtype)
     return {"Out": [x * s / jnp.asarray(max_range, x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# Weight-only quantized serving kernels (docs/design.md §20)
+#
+# Unlike the fake-quant ops above (QAT: float values snapped to the int grid
+# inside a TRAINING graph), these are the inference-side kernels: the weight
+# is STORED quantized — per-output-channel symmetric int8 (+ one f32 scale
+# per column) or bf16 — and dequantized on the fly inside the contraction
+# with f32 accumulation (``preferred_element_type``). The scale folds into
+# the convert pass the dot operand materializes anyway (weight-side; see
+# dequant_matmul for why an output-epilogue scale breaks cross-layout
+# bit-equality). serving/quant.py owns quantization/calibration; this module
+# owns the one matmul kernel so the serving forwards (models/transformer.py)
+# and the op registry lane below share a single definition.
+# ---------------------------------------------------------------------------
+
+
+def dequant_matmul(x2, q, scale=None):
+    """``x2 [M, K] @ dequant(q) -> [M, N] f32`` with f32 accumulation.
+
+    ``q`` is an int8 ``[K, N]`` weight with per-output-channel ``scale``
+    ``[N]``, or a bf16/f16 ``[K, N]`` weight (``scale=None`` — bf16 storage
+    needs no scale, the convert IS the dequant). An f32 ``q`` passes
+    through the stock dot unchanged (byte-identical serving when the
+    quantized lane is off).
+
+    The scale rides the WEIGHT side of the contraction —
+    ``dot(x, convert(q) * s)`` — deliberately, not the output epilogue:
+    the dot operand must materialize anyway (the convert pass), so the
+    scale folds into that same elementwise pass for free, and the dot's
+    output feeds downstream residual adds WITHOUT an adjacent multiply.
+    An output-epilogue ``dot(..) * s`` is one flop cheaper on paper but
+    XLA fuses it into a following add as a single-rounded FMA in some
+    layouts and not others (the sharded program has an all-gather in
+    between) — measured on XLA CPU as a 1e-5-class logit divergence that
+    breaks the §18 cross-layout bit-equality contract;
+    ``optimization_barrier`` does NOT suppress that FMA. Weight-side
+    scaling keeps every multiply an elementwise pre-pass whose per-column
+    results are identical under any column split."""
+    if q.dtype == jnp.int8:
+        w = q.astype(jnp.float32)
+        if scale is not None:
+            w = w * scale
+        return jnp.dot(x2, w, preferred_element_type=jnp.float32)
+    w = q if q.dtype == jnp.float32 else q.astype(jnp.float32)
+    return jnp.dot(x2, w, preferred_element_type=jnp.float32)
+
+
+def dequant_rows(q, ids, scale=None):
+    """Embedding-table sibling of ``dequant_matmul``: gather rows of a
+    quantized ``[V, D]`` table. The dequant (convert · scale) applies to
+    the TABLE and the gather picks dequantized rows — same rationale as
+    the weight-side scale above: a row-side ``gathered * s`` would FMA
+    into the following position add in layout-dependent ways."""
+    if q.dtype == jnp.int8:
+        table = q.astype(jnp.float32)
+        if scale is not None:
+            table = table * scale
+        return jnp.take(table, ids, axis=0)
+    rows = jnp.take(q, ids, axis=0)
+    return rows if rows.dtype == jnp.float32 else rows.astype(jnp.float32)
+
+
+@register_op("weight_only_quant_matmul", inputs=("X", "QWeight", "Scale"),
+             outputs=("Out",), no_grad=True)
+def weight_only_quant_matmul(ctx, ins, attrs):
+    """Inference-only fc over a quantized weight store: the op-registry
+    lane of the CPU serving tier (docs/design.md §20). ``QWeight`` is the
+    int8 (with per-column ``Scale``) or bf16 stored weight; the kernel is
+    the same weight-side-scaled f32-accumulated dot the quantized serving
+    engines run (see ``dequant_matmul`` for why the scale must NOT move
+    to an output epilogue), so a program using this op serves
+    bit-identically to ``QuantizedServingEngine`` on the same store."""
+    x, q = ins["X"][0], ins["QWeight"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"] else None
+    x2 = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    out = dequant_matmul(x2, q, scale)
+    return {"Out": [out.reshape(x.shape[:-1] + (q.shape[-1],))]}

@@ -37,6 +37,16 @@ plane). Pieces, composable or used together via ``ServingServer``:
   replica failover under one shared retry budget, rolling reload, and
   autoscale hooks; ``FleetChaos`` (chaos.py) storms it with replica
   kills/restarts, partitions, and slow replicas.
+* ``QuantizedServingEngine`` / ``QuantizedDecodeEngine`` (quant.py,
+  docs/design.md §20) — weight-only int8/bf16 serving: per-output-channel
+  symmetric stores (~26% of the f32 resident bytes at int8) dequantized
+  on the fly with f32 accumulation, a typed accuracy contract
+  (``quantize_export`` refuses below the greedy-token-agreement floor),
+  quantized hot reload (ints and scales swap as one store), bit-safe
+  column sharding (``quantize=`` on the sharded engines), and the
+  measured CPU lane: ``tools/perf_lab.py cpu`` writes ``cpu_tuned.json``
+  only on a >5% closed-loop win and ``ServingServer(quantize="auto")``
+  adopts it.
 * ``errors`` (errors.py) — the typed error hierarchy + wire codes.
 
 Since PR 9 the whole stack is black-boxed (docs/design.md §19): faults,
@@ -73,6 +83,9 @@ from .fleet import FleetRouter, LocalFleet, TokenBucket  # noqa: F401
 from .placement import (DeviceInventory, ModelProfile,  # noqa: F401
                         NoFeasiblePlacement, PlacementPlan,
                         PlacementSearcher, TrafficProfile, profile_export)
+from .quant import (QuantizationError, QuantizedDecodeEngine,  # noqa: F401
+                    QuantizedServingEngine, QuantizedStore, calibrate_error,
+                    quantize_export)
 from .server import ServingClient, ServingServer  # noqa: F401
 from .sharded import (ShardedDecodeEngine,  # noqa: F401
                       ShardedServingEngine, expected_collectives)
@@ -84,10 +97,13 @@ __all__ = [
     "GenerationBatcher", "GenerationResult", "InjectedFault",
     "LoadShedError", "LocalFleet", "MicroBatcher", "ModelProfile",
     "NoFeasiblePlacement", "NoHealthyReplicas", "PlacementPlan",
-    "PlacementSearcher", "QueueFullError", "RetryBudgetExceeded",
-    "ServingClient", "ServingEngine", "ServingError", "ServingRejected",
+    "PlacementSearcher", "QuantizationError", "QuantizedDecodeEngine",
+    "QuantizedServingEngine", "QuantizedStore", "QueueFullError",
+    "RetryBudgetExceeded", "ServingClient", "ServingEngine",
+    "ServingError", "ServingRejected",
     "ServingServer", "ServingStats", "ServingUnavailable",
     "ShardedDecodeEngine", "ShardedServingEngine", "ShuttingDown",
     "SlotScheduler", "TenantQuotaExceeded", "TokenBucket",
-    "TrafficProfile", "expected_collectives", "profile_export",
+    "TrafficProfile", "calibrate_error", "expected_collectives",
+    "profile_export", "quantize_export",
 ]

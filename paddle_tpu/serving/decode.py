@@ -57,23 +57,56 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs.events import get_event_log
-from .engine import pow2_ladder, round_up
+from .engine import _flat_items, pow2_ladder, round_up  # noqa: F401
 from .errors import DeadlineExceeded, QueueFullError, ServingUnavailable, \
     ShuttingDown
 from .stats import ServingStats
 
 
-def _flat_items(tree, prefix="params"):
-    """Deterministic (path, leaf) walk of the decode params pytree —
-    version-proof stand-in for tree_leaves_with_path."""
-    if isinstance(tree, dict):
-        for k in sorted(tree):
-            yield from _flat_items(tree[k], f"{prefix}.{k}")
-    elif isinstance(tree, (list, tuple)):
-        for i, v in enumerate(tree):
-            yield from _flat_items(v, f"{prefix}[{i}]")
-    else:
-        yield prefix, tree
+def stage_decode_params(engine, dirname: str, transform=None):
+    """Shared reload-staging validation of every decode-roles engine
+    (DecodeEngine, the sharded engines, serving/quant.py's quantized
+    engines): load + IR-walk a re-exported dir, compare its architecture
+    against the engine's frozen ``cfg``, materialize the host pytree,
+    apply ``transform`` (the quantized engines re-quantize at their
+    frozen mode HERE, before validation, so ``.q``/``.s`` leaves compare
+    — and later commit — together), and flat-compare shapes/dtypes
+    against the live set. Returns the HOST pytree; the caller device-
+    places it (plain, sharded, or quantized placement)."""
+    from .. import io as model_io
+    from ..core.executor import Scope
+    from ..models.transformer import decode_params_from_scope, decode_roles
+
+    scope = Scope()
+    program, _f, _t = model_io.load_inference_model(dirname, None,
+                                                    scope=scope)
+    roles, cfg = decode_roles(program)
+    for k in ("n_layers", "n_heads", "d_model", "d_ff", "vocab", "max_len"):
+        if cfg[k] != engine.cfg[k]:
+            raise ValueError(
+                f"reload {dirname!r}: architecture mismatch — {k} "
+                f"{cfg[k]} != frozen {engine.cfg[k]}")
+    staged = decode_params_from_scope(roles, scope)
+    if transform is not None:
+        staged = transform(staged)
+    with engine._lock:
+        live = engine._params
+    old_flat = dict(_flat_items(live))
+    new_flat = dict(_flat_items(staged))
+    if set(old_flat) != set(new_flat):
+        raise ValueError(
+            f"reload {dirname!r}: parameter set mismatch "
+            f"(+{sorted(set(new_flat) - set(old_flat))} "
+            f"-{sorted(set(old_flat) - set(new_flat))})")
+    for path, old in old_flat.items():
+        new = new_flat[path]
+        if tuple(old.shape) != tuple(new.shape) \
+                or np.dtype(old.dtype) != np.dtype(new.dtype):
+            raise ValueError(
+                f"reload {dirname!r}: param {path} shape/dtype mismatch "
+                f"({tuple(new.shape)}/{np.dtype(new.dtype)} vs frozen "
+                f"{tuple(old.shape)}/{np.dtype(old.dtype)})")
+    return staged
 
 
 class _ChunkEntry:
@@ -98,6 +131,18 @@ class DecodeEngine:
     pool carry. ``stage_params`` is safe from any thread; ``commit_params``
     must run at a token boundary (the batcher's reload barrier does).
     """
+
+    #: weight-only quantization mode of the resident params (None = f32;
+    #: serving/quant.py's QuantizedDecodeEngine sets "int8"/"bf16")
+    quant_mode: Optional[str] = None
+
+    def weights_bytes(self) -> int:
+        """Resident decode-weight bytes (the KV pools are NOT counted —
+        quantization never touches them, docs/design.md §20)."""
+        with self._lock:
+            params = self._params
+        return int(sum(int(getattr(leaf, "nbytes", 0))
+                       for _p, leaf in _flat_items(params)))
 
     def __init__(self, dirname: str, place=None,
                  max_slots: Optional[int] = None,
@@ -359,42 +404,18 @@ class DecodeEngine:
         self.pool_k, self.pool_v = self._alloc_pools()
 
     # -- hot weight reload --
+    def _stage_transform(self, staged: Dict[str, Any]) -> Dict[str, Any]:
+        """Hook applied to the staged HOST pytree BEFORE validation: the
+        quantized engines re-quantize at their frozen mode here so ints
+        and scales validate — and commit — together (serving/quant.py)."""
+        return staged
+
     def stage_params(self, dirname: str) -> Dict[str, Any]:
         """Load + validate a re-exported dir against the frozen decode
         roles WITHOUT touching the live params (the slow half of a reload;
         safe while generations run). Returns the staged device pytree."""
-        from .. import io as model_io
-        from ..core.executor import Scope
-        from ..models.transformer import decode_params_from_scope, \
-            decode_roles
-
-        scope = Scope()
-        program, _f, _t = model_io.load_inference_model(dirname, None,
-                                                        scope=scope)
-        roles, cfg = decode_roles(program)
-        for k in ("n_layers", "n_heads", "d_model", "d_ff", "vocab",
-                  "max_len"):
-            if cfg[k] != self.cfg[k]:
-                raise ValueError(
-                    f"reload {dirname!r}: architecture mismatch — {k} "
-                    f"{cfg[k]} != frozen {self.cfg[k]}")
-        staged = decode_params_from_scope(roles, scope)
-        old_flat = dict(_flat_items(self._params))
-        new_flat = dict(_flat_items(staged))
-        if set(old_flat) != set(new_flat):
-            raise ValueError(
-                f"reload {dirname!r}: parameter set mismatch "
-                f"(+{sorted(set(new_flat) - set(old_flat))} "
-                f"-{sorted(set(old_flat) - set(new_flat))})")
-        for path, old in old_flat.items():
-            new = new_flat[path]
-            if tuple(old.shape) != tuple(new.shape) \
-                    or np.dtype(old.dtype) != np.dtype(new.dtype):
-                raise ValueError(
-                    f"reload {dirname!r}: param {path} shape/dtype mismatch "
-                    f"({tuple(new.shape)}/{np.dtype(new.dtype)} vs frozen "
-                    f"{tuple(old.shape)}/{np.dtype(old.dtype)})")
-        return self._device_put_params(staged)
+        return self._device_put_params(
+            stage_decode_params(self, dirname, self._stage_transform))
 
     def commit_params(self, staged: Dict[str, Any]) -> int:
         """One reference store; every later dispatch snapshots the new

@@ -71,6 +71,21 @@ _pow2_ladder = pow2_ladder
 _round_up = round_up
 
 
+def _flat_items(tree, prefix="params"):
+    """Deterministic (path, leaf) walk of a params pytree — version-proof
+    stand-in for tree_leaves_with_path. Quantized int8 leaves ({"q", "s"}
+    dicts, serving/quant.py) flatten into BOTH members, so reload
+    validation compares scales and quantized ints alike."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _flat_items(tree[k], f"{prefix}.{k}")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _flat_items(v, f"{prefix}[{i}]")
+    else:
+        yield prefix, tree
+
+
 class InFlightBatch:
     """A dispatched-but-not-synced device call: the handle between the
     engine's host-prepare (``dispatch_prepared``) and device-complete
@@ -114,6 +129,21 @@ class ServingEngine:
     Thread-safe: ``run_batch`` may be called from any thread (the micro
     batcher uses one), cache and counters are lock-guarded.
     """
+
+    #: weight-only quantization mode of the resident param store (None =
+    #: f32; serving/quant.py engines set "int8"/"bf16") — the
+    #: pt_serving_quant_mode gauge reads this
+    quant_mode: Optional[str] = None
+
+    def weights_bytes(self) -> int:
+        """Resident serving-weight bytes (logical, across all shards for
+        a sharded engine) — the pt_serving_weights_bytes gauge. A
+        quantized store reports its quantized size: int8 weights are 1/4
+        the f32 bytes plus one f32 scale per output channel."""
+        with self._lock:
+            params = self._params
+        return int(sum(int(getattr(leaf, "nbytes", 0))
+                       for _p, leaf in _flat_items(params)))
 
     def __init__(self, dirname: str, place=None, max_batch_size: int = 32,
                  batch_buckets: Optional[Sequence[int]] = None,

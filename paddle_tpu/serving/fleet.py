@@ -106,6 +106,11 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
         "shards": g.get("pt_serving_shard_count", 1.0),
         "weights_version": g.get("pt_serving_weights_version",
                                  float(hz.get("weights_version", 0))),
+        # quantized serving (docs §20): 0=f32 1=int8 2=bf16
+        # (quant.QUANT_MODE_GAUGE), and the resident weight-store bytes —
+        # a capacity-aware router can weight replicas by real footprint
+        "quant_mode": g.get("pt_serving_quant_mode", 0.0),
+        "weights_bytes": g.get("pt_serving_weights_bytes", 0.0),
     }
 
 

@@ -193,6 +193,15 @@ REPLICATED_ROLES = ("pos", "lnf_s", "lnf_b", "ln1_s", "ln1_b", "ln2_s",
                     "ln2_b")
 
 
+def _quant_leaf_bytes(nelem: int, out_channels: int) -> Dict[str, float]:
+    """Stored bytes of one quantizable weight per mode: int8 = 1 byte per
+    element + one f32 scale per output channel; bf16 = 2 bytes per
+    element (no scale). Matches serving/quant.quantize_weight exactly —
+    the byte-accounting tests compare against real quantized arrays."""
+    return {"int8": float(nelem) + 4.0 * out_channels,
+            "bf16": 2.0 * float(nelem)}
+
+
 class ModelProfile:
     """Byte/FLOP account of one exported transformer LM.
 
@@ -205,13 +214,16 @@ class ModelProfile:
     to the plan as a cross-check on the analytic numbers."""
 
     __slots__ = ("cfg", "bytes_sharded", "bytes_replicated", "dtype_bytes",
-                 "xla_flops", "xla_bytes", "xla_rows", "source")
+                 "xla_flops", "xla_bytes", "xla_rows", "source",
+                 "quant_bytes", "quant_mode")
 
     def __init__(self, cfg: Dict[str, Any], bytes_sharded: float,
                  bytes_replicated: float, dtype_bytes: int = 4,
                  xla_flops: Optional[float] = None,
                  xla_bytes: Optional[float] = None,
-                 xla_rows: Optional[int] = None, source: str = "synthetic"):
+                 xla_rows: Optional[int] = None, source: str = "synthetic",
+                 quant_bytes: Optional[Dict[str, float]] = None,
+                 quant_mode: Optional[str] = None):
         self.cfg = dict(cfg)
         self.bytes_sharded = float(bytes_sharded)
         self.bytes_replicated = float(bytes_replicated)
@@ -220,6 +232,12 @@ class ModelProfile:
         self.xla_bytes = xla_bytes
         self.xla_rows = xla_rows
         self.source = source
+        # weight-only quantized SHARDED bytes per mode (docs §20). The
+        # quantizable roles (serving/quant.QUANT_ROLES) are all sharded
+        # roles, so the replicated account never changes under
+        # quantization; ``quantize(mode)`` swaps bytes_sharded to these.
+        self.quant_bytes = dict(quant_bytes or {})
+        self.quant_mode = quant_mode
 
     @classmethod
     def synthetic(cls, n_layers: int, n_heads: int, d_model: int,
@@ -228,13 +246,41 @@ class ModelProfile:
         """Analytic profile from the architecture alone — the searcher
         unit tests and the perf_lab sweep grid run on these."""
         D, FF, V = d_model, d_ff, vocab
-        sharded = V * D + n_layers * (4 * D * D + 2 * D * FF + FF + D) \
-            + D * V + V
+        quantizable = V * D + n_layers * (4 * D * D + 2 * D * FF) + D * V
+        bias = n_layers * (FF + D) + V  # bup/bdown per layer + out_b
+        sharded = quantizable + bias
+        # per-output-channel scale counts: emb D; per layer wq/wk/wv 3D +
+        # wo D + wup FF + wdown D; head V
+        scales = D + n_layers * (5 * D + FF) + V
         replicated = max_len * D + (2 * n_layers * 2 + 2) * D
         cfg = {"n_layers": n_layers, "n_heads": n_heads, "d_model": D,
                "d_ff": FF, "vocab": V, "max_len": max_len, "eps": 1e-5}
+        quant = {
+            "int8": quantizable * 1.0 + scales * 4.0 + bias * dtype_bytes,
+            "bf16": quantizable * 2.0 + bias * dtype_bytes,
+        }
         return cls(cfg, sharded * dtype_bytes, replicated * dtype_bytes,
-                   dtype_bytes=dtype_bytes)
+                   dtype_bytes=dtype_bytes, quant_bytes=quant)
+
+    def quantize(self, mode: Optional[str]) -> "ModelProfile":
+        """This model's byte account under weight-only quantization: the
+        same profile with ``bytes_sharded`` swapped to the stored
+        int8/bf16 sizes (int8 weights are 1/4 the f32 HBM plus one f32
+        scale per output channel; the decode KV pool and activations stay
+        f32 — ``decode_pool_bytes``/``flops_fwd``/``gather_bytes`` are
+        untouched). A must-shard f32 model can become single-chip under
+        this account, and the searcher proves it (tested)."""
+        if mode in (None, "", "f32"):
+            return self
+        if mode not in self.quant_bytes:
+            raise ValueError(f"no quantized byte account for mode {mode!r} "
+                             f"(have {sorted(self.quant_bytes)})")
+        return ModelProfile(
+            self.cfg, self.quant_bytes[mode], self.bytes_replicated,
+            dtype_bytes=self.dtype_bytes, xla_flops=self.xla_flops,
+            xla_bytes=self.xla_bytes, xla_rows=self.xla_rows,
+            source=f"{self.source} [quantized {mode}]",
+            quant_bytes=self.quant_bytes, quant_mode=mode)
 
     @property
     def param_bytes(self) -> float:
@@ -284,6 +330,8 @@ class ModelProfile:
                 "param_bytes": self.param_bytes,
                 "bytes_sharded": self.bytes_sharded,
                 "bytes_replicated": self.bytes_replicated,
+                "quant_mode": self.quant_mode,
+                "quant_bytes": dict(self.quant_bytes),
                 "xla_flops": self.xla_flops, "xla_bytes": self.xla_bytes}
 
 
@@ -308,11 +356,24 @@ def profile_export(dirname: str, xla_cost: bool = True) -> ModelProfile:
     params = decode_params_from_scope(roles, scope)
 
     sharded = repl = 0.0
+    quant = {"int8": 0.0, "bf16": 0.0}
 
     def account(role, arr):
         nonlocal sharded, repl
+        from .quant import QUANT_ROLES
+
         if role in SHARDED_ROLES:
             sharded += arr.nbytes
+            if role in QUANT_ROLES:
+                # EXACT quantized sizes of the actual saved arrays (the
+                # byte-accounting tests compare these against real
+                # quantize_weight outputs' nbytes)
+                qb = _quant_leaf_bytes(int(arr.size), int(arr.shape[-1]))
+                quant["int8"] += qb["int8"]
+                quant["bf16"] += qb["bf16"]
+            else:
+                quant["int8"] += arr.nbytes
+                quant["bf16"] += arr.nbytes
         else:
             repl += arr.nbytes
 
@@ -326,7 +387,7 @@ def profile_export(dirname: str, xla_cost: bool = True) -> ModelProfile:
 
     dtype_bytes = int(params["out_w"].dtype.itemsize)
     prof = ModelProfile(cfg, sharded, repl, dtype_bytes=dtype_bytes,
-                        source=dirname)
+                        source=dirname, quant_bytes=quant)
     if xla_cost:
         try:
             import numpy as np

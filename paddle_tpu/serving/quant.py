@@ -1,0 +1,570 @@
+"""Weight-only quantized serving + the CPU tuning lane (docs/design.md §20).
+
+Every published CPU number before this module ran an untuned f32 backend.
+This module gives serving an opt-in quantized weight store and makes the
+CPU lane's configuration a *measured* choice, the PR-4 autotune discipline
+applied to serving:
+
+* **Weight-only quantization** — ``quantize_export(dirname, mode)`` walks
+  a frozen ``transformer_lm`` inference export (``decode_roles``, the one
+  IR walk the decode/sharded/placement tiers already share) and quantizes
+  every fc/matmul/fused-QKV weight (``QUANT_ROLES``): per-output-channel
+  symmetric int8 (one f32 scale per column, ``{"q", "s"}`` leaves) or bf16
+  storage. Activations, layer norms, biases, the position table, and the
+  decode KV pools stay f32. The matmul kernel (``ops/quant.dequant_matmul``)
+  dequantizes on the fly with f32 accumulation; the per-channel scale
+  folds into the convert pass the dot operand materializes anyway
+  (weight-side — an output-epilogue scale FMA-fuses into following adds
+  in layout-dependent ways and breaks cross-layout bit-equality, see the
+  kernel's docstring).
+* **Accuracy contract** — ``calibrate_error`` reports the max-abs logit
+  error and the greedy-token (top-1) agreement of the quantized forward
+  against the f32 reference on calibration feeds; ``quantize_export``
+  refuses with a typed ``QuantizationError`` when agreement falls below
+  the floor, so the lane is opt-in-safe: a model whose greedy streams the
+  int8 grid would change cannot be quantized by accident.
+* **Engines** — ``QuantizedServingEngine`` / ``QuantizedDecodeEngine``
+  drop into the unchanged MicroBatcher / GenerationBatcher /
+  ServingServer stack. Hot reload stages the NEW export through the same
+  quantizer, so scales and quantized ints validate and swap together in
+  the ONE reference store every dispatch snapshots — wholly-old-or-
+  wholly-new now includes the scales. The sharded engines
+  (serving/sharded.py ``quantize=``) shard ``q`` by the same column
+  blocks as the f32 layout and the scale vector by the matching output
+  blocks, so the bit-safety argument is preserved *within* the quantized
+  lane: no contraction ever splits, dp2×tp2 int8 equals single-device
+  int8 bit-for-bit.
+* **CPU tuning** — ``apply_cpu_flags`` shapes the XLA CPU thread pool /
+  process affinity (must run pre-jax-init; ``flags.cpu_threads`` /
+  ``flags.cpu_pin``), and ``tools/perf_lab.py cpu`` sweeps threads ×
+  quant mode × bucket ladder in subprocesses, writing ``cpu_tuned.json``
+  next to the export ONLY on a measured >5% closed-loop win
+  (``ADOPTION_MIN_WIN``). ``ServingServer(quantize="auto")`` adopts what
+  the sweep proved (``resolve_quantize``) and serves f32 otherwise —
+  measurement decides, never hope. On hosts whose XLA build has no int8
+  GEMM (dequant runs through convert + the f32 dot), the sweep typically
+  adopts f32; the quantized lane still buys 4x smaller resident weights,
+  which is what flips must-shard models to single-chip in the placement
+  searcher (serving/placement.py ``ModelProfile.quantize``).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .engine import InFlightBatch, ServingEngine, _flat_items
+from .decode import DecodeEngine
+
+QUANT_MODES = ("int8", "bf16")
+
+#: decode-pytree roles that quantize: every fc/matmul/fused-QKV weight of
+#: the transformer (plus the embedding table — its gathered rows dequant
+#: per lookup). Layer norms, biases, and the position table stay f32: they
+#: are O(D) where the weights are O(D^2), and their error would ride every
+#: activation.
+QUANT_ROLES = frozenset({"emb", "wq", "wk", "wv", "wqkv", "wo",
+                         "wup", "wdown", "out_w"})
+
+#: default greedy-token agreement floor quantize_export refuses below
+DEFAULT_AGREEMENT_FLOOR = 0.999
+
+#: a tuned CPU config is adopted only when its closed-loop QPS beats the
+#: untuned f32 baseline by at least this much (the PR-4 >5% autotune bar)
+ADOPTION_MIN_WIN = 0.05
+
+#: filename of the tuned-config sidecar perf_lab writes next to an export
+TUNED_CONFIG_NAME = "cpu_tuned.json"
+
+#: pt_serving_quant_mode gauge encoding (fleet table / scraped_gauges)
+QUANT_MODE_GAUGE = {None: 0.0, "": 0.0, "f32": 0.0, "int8": 1.0, "bf16": 2.0}
+QUANT_MODE_NAMES = {0: "f32", 1: "int8", 2: "bf16"}
+
+
+class QuantizationError(ValueError):
+    """Typed refusal of the accuracy contract: the quantized forward's
+    greedy-token agreement against the f32 reference fell below the floor.
+    Carries the measured numbers so the operator sees how far off the
+    grid landed."""
+
+    def __init__(self, mode: str, agreement: float, floor: float,
+                 max_abs_err: float):
+        self.mode = mode
+        self.agreement = float(agreement)
+        self.floor = float(floor)
+        self.max_abs_err = float(max_abs_err)
+        super().__init__(
+            f"weight-only {mode} quantization refused: greedy-token "
+            f"agreement {agreement:.4f} below the {floor:.4f} floor "
+            f"(max abs logit error {max_abs_err:.3e}) — the quantized "
+            f"lane would change served tokens")
+
+
+def _check_mode(mode: str) -> str:
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quantization mode {mode!r}; "
+                         f"known: {QUANT_MODES}")
+    return mode
+
+
+# ---------------------------------------------------------------------------
+# quantization of host weights
+# ---------------------------------------------------------------------------
+
+
+def quantize_weight(w, mode: str):
+    """One weight -> its quantized leaf.
+
+    ``int8``: per-OUTPUT-channel symmetric — scale[j] = max|w[:, j]| / 127,
+    q = clip(rint(w / scale), ±127) int8; returns ``{"q": int8, "s": f32}``.
+    The round-trip error is bounded elementwise by ``scale/2`` (tested).
+    ``bf16``: plain bf16 storage (the convert is the dequant; no scale).
+    """
+    import ml_dtypes
+
+    _check_mode(mode)
+    w = np.asarray(w)
+    if mode == "bf16":
+        return w.astype(ml_dtypes.bfloat16)
+    reduce_axes = tuple(range(w.ndim - 1))  # all but the output channel
+    scale = np.abs(w).max(axis=reduce_axes) / 127.0
+    scale = np.where(scale == 0.0, 1.0, scale).astype(np.float32)
+    q = np.clip(np.rint(w.astype(np.float32) / scale), -127, 127) \
+        .astype(np.int8)
+    return {"q": q, "s": scale}
+
+
+def dequantize_weight(leaf) -> np.ndarray:
+    """Quantized leaf -> its f32 reconstruction (tests/error analysis —
+    the serving path never materializes this)."""
+    if isinstance(leaf, dict):
+        return leaf["q"].astype(np.float32) * leaf["s"]
+    return np.asarray(leaf).astype(np.float32)
+
+
+def is_quantized_leaf(leaf) -> bool:
+    import ml_dtypes
+
+    return isinstance(leaf, dict) or (
+        hasattr(leaf, "dtype")
+        and leaf.dtype in (np.dtype(ml_dtypes.bfloat16), np.int8))
+
+
+def quantize_params(host_params: Dict[str, Any], mode: str) -> Dict[str, Any]:
+    """Decode-roles host pytree -> the same tree with QUANT_ROLES leaves
+    quantized (idempotent: an already-quantized tree passes through)."""
+    _check_mode(mode)
+
+    def leaf(role, v):
+        if role in QUANT_ROLES and not is_quantized_leaf(v):
+            return quantize_weight(v, mode)
+        return v if is_quantized_leaf(v) else np.asarray(v)
+
+    out = {k: leaf(k, v) for k, v in host_params.items() if k != "layers"}
+    out["layers"] = [{k: leaf(k, v) for k, v in lp.items()}
+                     for lp in host_params["layers"]]
+    return out
+
+
+def is_quantized_params(params: Dict[str, Any]) -> bool:
+    return any(is_quantized_leaf(leaf) for _p, leaf in _flat_items(params))
+
+
+def param_bytes(params: Dict[str, Any]) -> int:
+    """Total leaf bytes of a (possibly quantized) params pytree."""
+    return int(sum(int(getattr(leaf, "nbytes", 0))
+                   for _p, leaf in _flat_items(params)))
+
+
+# ---------------------------------------------------------------------------
+# export loading + the accuracy contract
+# ---------------------------------------------------------------------------
+
+
+def _load_host(dirname: str):
+    """(roles, cfg, host_params, feed_len) of a transformer_lm export."""
+    from .. import io as model_io
+    from ..core.executor import Scope
+    from ..models.transformer import decode_params_from_scope, decode_roles
+
+    scope = Scope()
+    program, feed_names, _fetch = model_io.load_inference_model(
+        dirname, None, scope=scope)
+    roles, cfg = decode_roles(program)
+    host = decode_params_from_scope(roles, scope)
+    feed_len = None
+    var = program.global_block().find_var_recursive(feed_names[0])
+    if var is not None and var.shape is not None and len(var.shape) > 1 \
+            and var.shape[1] not in (None, -1):
+        feed_len = int(var.shape[1])
+    return roles, cfg, host, feed_len
+
+
+def _calibration_ids(cfg: Dict[str, Any], feeds, feed_len: Optional[int],
+                     sample_rows: int, seed: int) -> np.ndarray:
+    if feeds is not None:
+        if isinstance(feeds, dict):
+            if len(feeds) != 1:
+                raise ValueError(f"calibration feeds want the one ids "
+                                 f"feed, got {sorted(feeds)}")
+            feeds = next(iter(feeds.values()))
+        ids = np.asarray(feeds)
+        if ids.ndim != 2:
+            raise ValueError(f"calibration ids must be [rows, T], got "
+                             f"shape {ids.shape}")
+        return ids.astype(np.int32)
+    rng = np.random.RandomState(seed)
+    t = feed_len or cfg["max_len"]
+    return rng.randint(0, cfg["vocab"], (sample_rows, t)).astype(np.int32)
+
+
+def _compare_forwards(cfg, host, qparams, ids) -> Dict[str, Any]:
+    """f32 vs quantized whole-sequence logits on the SAME pure-jax forward
+    (models/transformer.predict_forward — bit-identical to the exported IR
+    program on f32 leaves, tested in tests/test_serving_sharded.py)."""
+    import jax
+
+    from ..models.transformer import predict_forward
+
+    fwd = jax.jit(functools.partial(predict_forward, cfg=cfg))
+    ref = np.asarray(fwd(host, ids))
+    qlog = np.asarray(fwd(qparams, ids))
+    agree = float(np.mean(
+        np.argmax(ref, axis=-1) == np.argmax(qlog, axis=-1)))
+    err = np.abs(qlog - ref)
+    return {
+        "positions": int(ref.shape[0] * ref.shape[1]),
+        "max_abs_logit_err": float(err.max()),
+        "mean_abs_logit_err": float(err.mean()),
+        "token_agreement": agree,
+        "top1_agreement": agree,  # greedy token IS the top-1 logit
+    }
+
+
+def calibrate_error(dirname: str, feeds=None, mode: str = "int8",
+                    sample_rows: int = 8, seed: int = 0) -> Dict[str, Any]:
+    """The accuracy contract's measurement: quantize ``dirname``'s weights
+    at ``mode`` and report max-abs/mean-abs logit error plus greedy-token
+    (top-1) agreement against the f32 forward on ``feeds`` (a ``[rows,
+    T]`` ids array / one-entry feed dict; synthesized from the export's
+    declared shape when omitted)."""
+    _check_mode(mode)
+    _roles, cfg, host, feed_len = _load_host(dirname)
+    ids = _calibration_ids(cfg, feeds, feed_len, sample_rows, seed)
+    rep = _compare_forwards(cfg, host, quantize_params(host, mode), ids)
+    rep["mode"] = mode
+    return rep
+
+
+class QuantizedStore:
+    """What ``quantize_export`` hands back: the quantized host pytree plus
+    everything the engines and the placement accountant need — roles, cfg,
+    per-mode byte sizes, and the calibration report (when run)."""
+
+    __slots__ = ("dirname", "mode", "roles", "cfg", "params",
+                 "weights_bytes", "f32_bytes", "calibration")
+
+    def __init__(self, dirname, mode, roles, cfg, params, weights_bytes,
+                 f32_bytes, calibration=None):
+        self.dirname = dirname
+        self.mode = mode
+        self.roles = roles
+        self.cfg = cfg
+        self.params = params
+        self.weights_bytes = int(weights_bytes)
+        self.f32_bytes = int(f32_bytes)
+        self.calibration = calibration
+
+
+def quantize_export(dirname: str, mode: str = "int8",
+                    calibration_feeds=None,
+                    agreement_floor: float = DEFAULT_AGREEMENT_FLOOR,
+                    calibrate: bool = True,
+                    sample_rows: int = 8, seed: int = 0) -> QuantizedStore:
+    """Quantize a frozen inference export's weights for serving.
+
+    With ``calibrate`` (the default), the quantized forward is judged
+    against the f32 reference on ``calibration_feeds`` (synthesized when
+    omitted) and the export is REFUSED with a typed ``QuantizationError``
+    when greedy-token agreement falls below ``agreement_floor`` — the
+    opt-in-safe contract: served tokens must not change. ``calibrate=
+    False`` skips the forward passes (the engines use it after the
+    operator's export has already passed the gate once)."""
+    _check_mode(mode)
+    _roles, cfg, host, feed_len = _load_host(dirname)
+    qparams = quantize_params(host, mode)
+    store = QuantizedStore(dirname, mode, _roles, cfg, qparams,
+                           weights_bytes=param_bytes(qparams),
+                           f32_bytes=param_bytes(host))
+    if calibrate:
+        ids = _calibration_ids(cfg, calibration_feeds, feed_len,
+                               sample_rows, seed)
+        rep = _compare_forwards(cfg, host, qparams, ids)
+        rep["mode"] = mode
+        store.calibration = rep
+        if rep["token_agreement"] < agreement_floor:
+            raise QuantizationError(mode, rep["token_agreement"],
+                                    agreement_floor,
+                                    rep["max_abs_logit_err"])
+    return store
+
+
+# ---------------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------------
+
+
+class QuantizedServingEngine(ServingEngine):
+    """One-shot predict over a weight-only quantized param store — a
+    drop-in ``ServingEngine`` whose compiled step is
+    ``models/transformer.predict_forward`` over quantized leaves (the same
+    pure-jax forward the sharded engines run; its f32 branch is
+    bit-identical to the exported IR program, so the ONLY difference
+    f32-vs-quantized A/Bs measure is the quantization itself).
+
+    The export must be a ``transformer_lm`` logits export — quantization
+    recovers the weight roles from the IR (``decode_roles``) and will not
+    guess at an arbitrary program. The bucket ladder, LRU compile cache,
+    warmup, and chaos hooks are inherited unchanged; ``reload_params``
+    re-quantizes the new export at the frozen mode, so every dispatch
+    snapshots a wholly-old-or-wholly-new (weights AND scales) store."""
+
+    def __init__(self, dirname: str, mode: str = "int8", place=None, **kw):
+        self.quant_mode = _check_mode(mode)
+        super().__init__(dirname, place=place, **kw)
+        if len(self.feed_names) != 1 or len(self.fetch_names) != 1:
+            raise ValueError(
+                f"quantized serving wants the transformer_lm logits export "
+                f"(one ids feed, one logits fetch), got feeds="
+                f"{list(self.feed_names)} fetches={list(self.fetch_names)}")
+        if not self.fetch_per_row[self.fetch_names[0]]:
+            raise ValueError("quantized serving: the fetch must be per-row "
+                             "(the [N, T, V] logits)")
+
+    # -- load: roles walk + quantize + device placement --
+    def _load_params(self):
+        import jax
+
+        from ..models.transformer import decode_params_from_scope, \
+            decode_roles
+
+        self.roles, self.cfg = decode_roles(self.program)
+        host = decode_params_from_scope(self.roles, self.scope)
+        qhost = quantize_params(host, self.quant_mode)
+        with jax.default_device(self._device):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._device), qhost)
+
+    # -- compile cache: predict_forward over the quantized store --
+    def _make_fn(self, sig: Tuple):
+        import jax
+
+        from ..models.transformer import predict_forward
+
+        return jax.jit(functools.partial(predict_forward, cfg=self.cfg))
+
+    def _annotate_cost(self, fn, sig: Tuple):
+        from ..flags import get_flag
+
+        if not get_flag("obs_cost_analysis"):
+            return None, None
+        try:
+            from ..obs import cost as obs_cost
+
+            with self._lock:
+                params = self._params
+            avals = obs_cost.abstractify(params)
+            feed_aval = obs_cost.abstractify(
+                np.zeros(sig[0][1], np.dtype(sig[0][2])))
+            res = obs_cost.analyze_jit(fn, avals, feed_aval)
+            return res["flops"], res["bytes"]
+        except Exception:
+            return None, None
+
+    def dispatch_prepared(self, feeds: Dict[str, np.ndarray], rows: int):
+        import jax
+
+        bucket = self.bucket_batch(rows)
+        if bucket != rows:
+            feeds = {n: np.concatenate(
+                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)])
+                for n, a in feeds.items()}
+        sig = tuple((n, feeds[n].shape, str(feeds[n].dtype))
+                    for n in self.feed_names)
+        entry = self._get_fn(sig)
+        if self.chaos is not None:
+            self.chaos.on_dispatch()
+        with self._lock:  # one consistent (params, version) snapshot:
+            params = self._params  # ints and scales swap as ONE reference
+            version = self.params_version
+        cold = entry.cold
+        t_call = time.monotonic() if cold else 0.0
+        with jax.default_device(self._device):
+            ids = jax.device_put(feeds[self.feed_names[0]], self._device)
+            logits = entry.fn(params, ids)
+        if cold:
+            entry.compile_s = time.monotonic() - t_call
+            entry.cold = False
+            from ..obs import get_tracer
+
+            tr = get_tracer()
+            if tr.enabled:
+                tr.add_span("serving/compile", t_call, entry.compile_s,
+                            cat="compile",
+                            args={"bucket": bucket,
+                                  "quantize": self.quant_mode,
+                                  "flops": entry.flops})
+        return InFlightBatch([logits], rows, bucket, version,
+                             flops=entry.flops)
+
+    # -- hot reload: re-quantize the new export at the frozen mode --
+    def stage_params(self, dirname: str) -> Dict[str, Any]:
+        """Reload staging through the quantizer (decode.stage_decode_params
+        — the one shared validator): the staged set re-quantizes at the
+        frozen mode BEFORE the flat validation, so the comparison covers
+        the ``.q``/``.s`` paths alike and a reload can never swap ints
+        without their scales (or vice versa)."""
+        import jax
+
+        from .decode import stage_decode_params
+
+        staged = stage_decode_params(
+            self, dirname, lambda host: quantize_params(host,
+                                                        self.quant_mode))
+        with jax.default_device(self._device):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._device), staged)
+
+
+class QuantizedDecodeEngine(DecodeEngine):
+    """Decode serving over a quantized param store: the slot-pooled KV
+    cache stays f32 and UNTOUCHED (quantizing the pool would change the
+    attention math mid-stream); only the weight contractions dequantize on
+    the fly. ``GenerationBatcher`` — continuous batching, deadlines,
+    drain, the token-boundary reload barrier — runs on top unchanged, and
+    steady-state decode still compiles nothing (the same cache-counter
+    contract, tested)."""
+
+    def __init__(self, dirname: str, mode: str = "int8", **kw):
+        self.quant_mode = _check_mode(mode)
+        super().__init__(dirname, **kw)
+
+    def _device_put_params(self, host_params):
+        if not is_quantized_params(host_params):
+            host_params = quantize_params(host_params, self.quant_mode)
+        return super()._device_put_params(host_params)
+
+    def _stage_transform(self, staged: Dict[str, Any]) -> Dict[str, Any]:
+        # reload staging through the quantizer: the staged set quantizes
+        # at the frozen mode BEFORE the flat validation, so the comparison
+        # covers scales and ints alike, and the commit (one reference
+        # store at the batcher's token boundary) swaps them together
+        return quantize_params(staged, self.quant_mode)
+
+
+# ---------------------------------------------------------------------------
+# CPU lane: thread-pool shaping + the measured tuned config
+# ---------------------------------------------------------------------------
+
+
+def apply_cpu_flags(threads: Optional[int] = None,
+                    pin: Optional[bool] = None) -> bool:
+    """Best-effort XLA CPU thread/affinity shaping from ``flags.cpu_threads``
+    / ``flags.cpu_pin`` (or explicit arguments). Two mechanisms with
+    different windows:
+
+    * **process CPU affinity** (``threads >= 1`` or ``pin``): applies
+      IMMEDIATELY and caps the cores every thread pool — Eigen included —
+      can actually run on, so it works even after jax is up;
+    * **XLA_FLAGS** ``--xla_cpu_multi_thread_eigen=false`` (``threads ==
+      1``): read once at CPU backend creation, so it only lands while no
+      jax computation has run yet (importing paddle_tpu imports jax, but
+      the backend initializes lazily at first use). The perf_lab sweep
+      runs each config in a fresh subprocess for exactly this reason.
+
+    Returns True when the XLA_FLAGS path could still take effect (no
+    backend initialized yet), False when only the affinity applied."""
+    from ..flags import get_flag
+
+    threads = int(get_flag("cpu_threads")) if threads is None else int(threads)
+    pin = bool(get_flag("cpu_pin")) if pin is None else bool(pin)
+    xb = sys.modules.get("jax._src.xla_bridge")
+    pre_init = not (xb is not None and getattr(xb, "_backends", None))
+    if threads == 1 and pre_init:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in xf:
+            os.environ["XLA_FLAGS"] = \
+                (xf + " --xla_cpu_multi_thread_eigen=false").strip()
+    if (pin or threads >= 1) and hasattr(os, "sched_setaffinity"):
+        want = threads if threads > 0 else (os.cpu_count() or 1)
+        try:
+            have = sorted(os.sched_getaffinity(0))
+            os.sched_setaffinity(0, set(have[:max(1, want)]))
+        except OSError:
+            pass  # containers may forbid affinity changes; best effort
+    return pre_init
+
+
+def tuned_config_path(dirname: str) -> str:
+    return os.path.join(dirname, TUNED_CONFIG_NAME)
+
+
+def write_tuned_config(dirname: str, config: Dict[str, Any]) -> str:
+    """Persist a measured CPU serving config next to the export (the
+    perf_lab cpu sweep's output — only written on a >5% closed-loop win,
+    so the file's existence IS the adoption decision)."""
+    cfg = dict(config)
+    cfg.setdefault("schema", 1)
+    cfg.setdefault("written_by", "tools/perf_lab.py cpu")
+    path = tuned_config_path(dirname)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cfg, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_tuned_config(dirname: str) -> Optional[Dict[str, Any]]:
+    path = tuned_config_path(dirname)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def resolve_quantize(dirname: Optional[str], spec) -> Optional[str]:
+    """Normalize a ``quantize=`` spelling to a mode or None.
+
+    ``None``/``""``/``"f32"`` = off; ``"int8"``/``"bf16"`` = forced;
+    ``"auto"`` = adopt the export's measured ``cpu_tuned.json`` when one
+    exists (the perf_lab sweep only writes it on a >5% win) and f32
+    otherwise."""
+    if spec in (None, "", "f32", False):
+        return None
+    if spec == "auto":
+        cfg = load_tuned_config(dirname) if dirname else None
+        mode = (cfg or {}).get("quantize")
+        return _check_mode(mode) if mode else None
+    return _check_mode(spec)
+
+
+def adopt_tuned(dirname: str) -> Optional[Dict[str, Any]]:
+    """The FULL ``quantize="auto"`` adoption: load the export's measured
+    ``cpu_tuned.json`` and apply its thread shaping (``apply_cpu_flags``
+    — the affinity half works even post-init). Returns the config dict
+    (the server applies its ``max_batch_size``/``quantize`` itself) or
+    None when nothing was measured. The process-global affinity change is
+    deliberate and opt-in twice over: the operator both ran the sweep
+    (the file only exists after a >5% win) and asked for "auto"."""
+    cfg = load_tuned_config(dirname)
+    if cfg and cfg.get("threads"):
+        apply_cpu_flags(threads=int(cfg["threads"]))
+    return cfg

@@ -308,12 +308,40 @@ class ServingServer(socketserver.ThreadingTCPServer):
                  drain_timeout: float = 30.0, chaos=None,
                  handle_signals: bool = False, decode=None, mesh=None,
                  log_json: bool = False, capture_every: int = 0,
-                 **engine_kwargs):
+                 quantize=None, **engine_kwargs):
         super().__init__((host, port), _Handler)
         self.batcher = None
         self.decode_engine = None
         self.gen_batcher = None
         try:
+            # weight-only quantized serving (serving/quant.py, docs §20):
+            # None falls back to the serving_quantize flag; "auto" adopts
+            # the export's measured cpu_tuned.json (perf_lab cpu writes it
+            # only on a >5% closed-loop win); "int8"/"bf16" force the mode
+            from ..flags import get_flag
+            from .quant import adopt_tuned, resolve_quantize
+
+            if quantize is None:
+                # the flag is a fleet-wide default for dirname-built
+                # servers ONLY: a prebuilt engine (possibly already
+                # quantized) must keep working with the flag set
+                quantize = (get_flag("serving_quantize") or None) \
+                    if isinstance(model, str) else None
+            if quantize and not isinstance(model, str):
+                raise ValueError(
+                    "quantize= quantizes the exported dir's weight store "
+                    "(pass the model dirname, or prebuild a "
+                    "QuantizedServingEngine without quantize=)")
+            if quantize == "auto" and isinstance(model, str):
+                # full adoption of the measured config: thread shaping is
+                # applied by adopt_tuned; the tuned bucket cap lands here
+                # unless the caller pinned one explicitly
+                tuned = adopt_tuned(model)
+                if tuned and max_batch_size is None \
+                        and tuned.get("max_batch_size"):
+                    max_batch_size = int(tuned["max_batch_size"])
+            self.quant_mode = resolve_quantize(
+                model if isinstance(model, str) else None, quantize)
             # mesh (docs/design.md §18): span ONE model over dp*tp devices.
             # int N = {"dp": 1, "tp": N} (the one-model-across-N-chips
             # headline); a dict names both axes; a PlacementPlan carries a
@@ -344,6 +372,17 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 model = ShardedServingEngine(
                     model, dp=self.mesh_spec["dp"],
                     tp=self.mesh_spec["tp"], plan=plan,
+                    quantize=self.quant_mode,
+                    max_batch_size=engine_kwargs.pop("max_batch_size",
+                                                     None)
+                    or max_batch_size or 32, **engine_kwargs)
+                engine_kwargs = {}
+            elif self.quant_mode is not None:
+                from .quant import QuantizedServingEngine
+
+                self._mesh_model_dir = model  # decode= still needs the dir
+                model = QuantizedServingEngine(
+                    model, mode=self.quant_mode,
                     max_batch_size=engine_kwargs.pop("max_batch_size",
                                                      None)
                     or max_batch_size or 32, **engine_kwargs)
@@ -410,7 +449,13 @@ class ServingServer(socketserver.ThreadingTCPServer):
                         from .sharded import ShardedDecodeEngine
 
                         self.decode_engine = ShardedDecodeEngine(
-                            decode_dir, tp=self.mesh_spec["tp"], **dknobs)
+                            decode_dir, tp=self.mesh_spec["tp"],
+                            quantize=self.quant_mode, **dknobs)
+                    elif self.quant_mode is not None:
+                        from .quant import QuantizedDecodeEngine
+
+                        self.decode_engine = QuantizedDecodeEngine(
+                            decode_dir, mode=self.quant_mode, **dknobs)
                     else:
                         self.decode_engine = DecodeEngine(decode_dir,
                                                           **dknobs)
@@ -497,6 +542,25 @@ class ServingServer(socketserver.ThreadingTCPServer):
             r.gauge("pt_serving_weights_version",
                     "Params version (bumped by hot reload)",
                     callback=lambda: self.engine.params_version)
+            # quantized-serving surfaces (docs §20): mode encodes 0=f32 /
+            # 1=int8 / 2=bf16 (quant.QUANT_MODE_GAUGE — scraped_gauges and
+            # the paddle_cli fleet table decode it); bytes is the LIVE
+            # resident weight store (predict + decode param sets), so a
+            # quantized replica's 4x-smaller footprint is scrapeable
+            from .quant import QUANT_MODE_GAUGE
+
+            self.quant_mode = self.engine.quant_mode or self.quant_mode
+            r.gauge("pt_serving_quant_mode",
+                    "Weight-only quantization mode (0=f32 1=int8 2=bf16)",
+                    callback=lambda: QUANT_MODE_GAUGE.get(
+                        self.engine.quant_mode, 0.0))
+            r.gauge("pt_serving_weights_bytes",
+                    "Resident serving weight bytes (quantized store when "
+                    "armed; decode params included)",
+                    callback=lambda: float(
+                        self.engine.weights_bytes()
+                        + (self.decode_engine.weights_bytes()
+                           if self.decode_engine is not None else 0)))
             r.gauge("pt_serving_compile_cache_hits",
                     "Serving compile-cache hits",
                     callback=lambda: self.engine.cache_hits)
@@ -647,7 +711,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
              "fetches": list(self.engine.fetch_names),
              "queue_depth": self.batcher.queue_depth,
              "queue_capacity": self.batcher.queue_capacity,
-             "weights_version": self.engine.params_version}
+             "weights_version": self.engine.params_version,
+             "quantize": self.engine.quant_mode or "f32"}
         if self.mesh_spec is not None:
             h["shards"] = {"dp": self.mesh_spec["dp"],
                            "tp": self.mesh_spec["tp"],
@@ -676,6 +741,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
             "weights_version": self.engine.params_version,
             "pipeline_depth": self.batcher.pipeline_depth,
             "in_flight": self.batcher.in_flight,
+            "quantize": self.engine.quant_mode or "f32",
+            "weights_bytes": self.engine.weights_bytes(),
         }
         if self.mesh_spec is not None:
             extra["placement"] = {

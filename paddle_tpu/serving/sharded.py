@@ -80,17 +80,22 @@ def count_hlo_collectives(compiled_text: str) -> int:
     return n
 
 
+def _qkv_col_perm(three_d: int, tp: int) -> np.ndarray:
+    """Column permutation making each tp rank's contiguous 3D/tp slice of
+    a fused [D, 3D] qkv weight its own [q_r | k_r | v_r] head blocks."""
+    D = three_d // 3
+    per = D // tp
+    return np.concatenate([
+        np.arange(j * D + r * per, j * D + (r + 1) * per)
+        for r in range(tp) for j in range(3)])
+
+
 def _permute_qkv_cols(w: np.ndarray, tp: int) -> np.ndarray:
     """Reorder a fused [D, 3D] qkv weight's columns so each tp rank's
     contiguous 3D/tp slice is [q_r | k_r | v_r] (its own head blocks)."""
     if tp <= 1:
         return w
-    D = w.shape[1] // 3
-    per = D // tp
-    cols = np.concatenate([
-        np.arange(j * D + r * per, j * D + (r + 1) * per)
-        for r in range(tp) for j in range(3)])
-    return np.asarray(w)[:, cols]
+    return np.asarray(w)[:, _qkv_col_perm(w.shape[1], tp)]
 
 
 def _shard_mesh(dp: int, tp: int, devices=None, platform: Optional[str] = None):
@@ -152,24 +157,56 @@ class _ShardedParamStore:
         spec[ax if ndim > 1 else 0] = "tp"
         return PartitionSpec(*spec)
 
+    def _leaf_spec(self, role: str, leaf):
+        """Per-leaf partition spec. A quantized int8 leaf ({"q", "s"},
+        serving/quant.py) shards ``q`` by the SAME column blocks as the
+        f32 weight and the per-output-channel scale vector by the
+        matching output blocks — each rank's epilogue multiplies its own
+        columns by its own scales, so the bit-safety argument (no split
+        contraction, gather = concatenation) holds inside the quantized
+        lane. bf16 leaves shard like their f32 siblings."""
+        if isinstance(leaf, dict):
+            from jax.sharding import PartitionSpec
+
+            ax = _COL_AXIS.get(role)
+            if ax is None or self.tp <= 1:
+                return {"q": PartitionSpec(), "s": PartitionSpec()}
+            qspec = [None] * leaf["q"].ndim
+            qspec[ax] = "tp"
+            return {"q": PartitionSpec(*qspec), "s": PartitionSpec("tp")}
+        return self._param_spec(role)
+
     def _param_specs_pytree(self, params):
-        specs = {k: self._param_spec(k) for k in params if k != "layers"}
-        specs["layers"] = [{k: self._param_spec(k) for k in lp}
+        specs = {k: self._leaf_spec(k, v)
+                 for k, v in params.items() if k != "layers"}
+        specs["layers"] = [{k: self._leaf_spec(k, v) for k, v in lp.items()}
                            for lp in params["layers"]]
         return specs
 
     def _shard_put(self, host_params):
         """Host pytree -> mesh-sharded pytree (wqkv columns permuted so a
-        rank's slice is its own head blocks)."""
+        rank's slice is its own head blocks; a quantized wqkv permutes q
+        columns AND scales by the same index, keeping each rank's scale
+        aligned with its columns)."""
         import jax
         from jax.sharding import NamedSharding
 
-        def put(role, arr):
-            arr = np.asarray(arr)
+        def put(role, leaf):
+            spec = self._leaf_spec(role, leaf)
+            if isinstance(leaf, dict):
+                q, s = np.asarray(leaf["q"]), np.asarray(leaf["s"])
+                if role == "wqkv" and self.tp > 1:
+                    cols = _qkv_col_perm(q.shape[1], self.tp)
+                    q, s = q[:, cols], s[cols]
+                return {
+                    "q": jax.device_put(
+                        q, NamedSharding(self.mesh, spec["q"])),
+                    "s": jax.device_put(
+                        s, NamedSharding(self.mesh, spec["s"]))}
+            arr = np.asarray(leaf)
             if role == "wqkv":
                 arr = _permute_qkv_cols(arr, self.tp)
-            return jax.device_put(
-                arr, NamedSharding(self.mesh, self._param_spec(role)))
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
         out = {k: put(k, v) for k, v in host_params.items() if k != "layers"}
         out["layers"] = [{k: put(k, v) for k, v in lp.items()}
@@ -205,7 +242,8 @@ class ShardedServingEngine(_ShardedParamStore, ServingEngine):
     """
 
     def __init__(self, dirname: str, dp: int = 1, tp: int = 1,
-                 place=None, devices=None, stats=None, plan=None, **kw):
+                 place=None, devices=None, stats=None, plan=None,
+                 quantize=None, **kw):
         self.dp = int(dp)
         self.tp = int(tp)
         if self.dp < 1 or self.dp & (self.dp - 1):
@@ -216,6 +254,10 @@ class ShardedServingEngine(_ShardedParamStore, ServingEngine):
         self._ctor_devices = devices
         self.plan = plan
         self.stats = stats  # optional: collective-time attribution
+        if quantize is not None:
+            from .quant import _check_mode
+
+            self.quant_mode = _check_mode(quantize)
         super().__init__(dirname, place=place, **kw)
         if len(self.feed_names) != 1 or len(self.fetch_names) != 1:
             raise ValueError(
@@ -256,6 +298,10 @@ class ShardedServingEngine(_ShardedParamStore, ServingEngine):
         self._feed_sharding = NamedSharding(self.mesh,
                                             PartitionSpec("dp", None))
         host = decode_params_from_scope(self.roles, self.scope)
+        if self.quant_mode is not None:
+            from .quant import quantize_params
+
+            host = quantize_params(host, self.quant_mode)
         return self._shard_put(host)
 
     # -- compile cache: shard_map-wrapped predict_forward per signature --
@@ -356,46 +402,28 @@ class ShardedServingEngine(_ShardedParamStore, ServingEngine):
                              flops=entry.flops)
 
     # -- hot reload: decode-style pytree validation, sharded staging --
+    def _stage_transform(self, staged: Dict[str, Any]) -> Dict[str, Any]:
+        """Quantized sharding re-quantizes the staged set at the frozen
+        mode BEFORE validation: the .q/.s paths flat-compare together,
+        so quantized ints and their scales stage — and later commit —
+        as one set."""
+        if self.quant_mode is not None:
+            from .quant import quantize_params
+
+            return quantize_params(staged, self.quant_mode)
+        return staged
+
     def stage_params(self, dirname: str) -> Dict[str, Any]:
-        """Load + validate a re-exported dir against the frozen roles,
-        then place the column shards — all WITHOUT touching the live set.
+        """Load + validate a re-exported dir against the frozen roles
+        (decode.stage_decode_params — the one shared validator), then
+        place the column shards — all WITHOUT touching the live set.
         ``commit_params`` (inherited) is ONE pytree reference store, so
         every dispatch snapshots a wholly-old or wholly-new set across
         ALL shards (PR-2's guarantee, mesh-wide)."""
-        from .. import io as model_io
-        from ..core.executor import Scope
-        from ..models.transformer import decode_params_from_scope, \
-            decode_roles
+        from .decode import stage_decode_params
 
-        scope = Scope()
-        program, _f, _t = model_io.load_inference_model(dirname, None,
-                                                        scope=scope)
-        roles, cfg = decode_roles(program)
-        for k in ("n_layers", "n_heads", "d_model", "d_ff", "vocab",
-                  "max_len"):
-            if cfg[k] != self.cfg[k]:
-                raise ValueError(
-                    f"reload {dirname!r}: architecture mismatch — {k} "
-                    f"{cfg[k]} != frozen {self.cfg[k]}")
-        staged = decode_params_from_scope(roles, scope)
-        with self._lock:
-            live = self._params
-        old_flat = dict(_flat_items(live))
-        new_flat = dict(_flat_items(staged))
-        if set(old_flat) != set(new_flat):
-            raise ValueError(
-                f"reload {dirname!r}: parameter set mismatch "
-                f"(+{sorted(set(new_flat) - set(old_flat))} "
-                f"-{sorted(set(old_flat) - set(new_flat))})")
-        for path, old in old_flat.items():
-            new = new_flat[path]
-            if tuple(old.shape) != tuple(new.shape) \
-                    or np.dtype(old.dtype) != np.dtype(new.dtype):
-                raise ValueError(
-                    f"reload {dirname!r}: param {path} shape/dtype "
-                    f"mismatch ({tuple(new.shape)}/{np.dtype(new.dtype)} "
-                    f"vs frozen {tuple(old.shape)}/{np.dtype(old.dtype)})")
-        return self._shard_put(staged)
+        return self._shard_put(
+            stage_decode_params(self, dirname, self._stage_transform))
 
 
 class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
@@ -412,7 +440,7 @@ class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
     business."""
 
     def __init__(self, dirname: str, tp: int = 1, place=None, devices=None,
-                 plan=None, stats=None, **kw):
+                 plan=None, stats=None, quantize=None, **kw):
         self.tp = int(tp)
         self.dp = 1
         if self.tp < 1:
@@ -421,6 +449,10 @@ class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
         self.plan = plan
         self.stats = stats  # optional: collective attribution
         self.mesh = None  # built on first _device_put_params
+        if quantize is not None:
+            from .quant import _check_mode
+
+            self.quant_mode = _check_mode(quantize)
         super().__init__(dirname, place=place, **kw)
 
     @property
@@ -440,7 +472,22 @@ class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
                                     devices=self._ctor_devices,
                                     platform=self._place.jax_device()
                                     .platform)
+        if self.quant_mode is not None:
+            from .quant import is_quantized_params, quantize_params
+
+            if not is_quantized_params(host_params):
+                host_params = quantize_params(host_params, self.quant_mode)
         return self._shard_put(host_params)
+
+    def _stage_transform(self, staged):
+        # quantized reload: re-quantize BEFORE the flat validation (ints
+        # and scales compare — and swap — together); the base
+        # stage_params then routes through _device_put_params -> shards
+        if self.quant_mode is not None:
+            from .quant import quantize_params
+
+            return quantize_params(staged, self.quant_mode)
+        return staged
 
     def _pool_spec(self):
         from jax.sharding import PartitionSpec

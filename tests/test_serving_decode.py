@@ -293,8 +293,12 @@ def test_abort_close_resolves_typed(engine):
     """drain=False: in-flight + queued generations resolve ShuttingDown,
     nothing hangs, every slot is returned."""
     gb = GenerationBatcher(engine, queue_capacity=16)
+    # 16 generations through 4 slots: several waves of work, so the abort
+    # always lands while some are still queued/in flight (8 fast ones
+    # could all finish before the 0.05 s sleep on a warm cache, making
+    # the `shut > 0` assertion race machine load)
     futs = [gb.submit(np.ones(4, np.int64), max_new_tokens=28)
-            for _ in range(8)]
+            for _ in range(16)]
     time.sleep(0.05)  # let a few admit
     gb.close(drain=False)
     done_ok = shut = 0

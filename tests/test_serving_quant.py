@@ -1,0 +1,555 @@
+"""Weight-only quantized serving (serving/quant.py + the CPU lane, ISSUE 11).
+
+Acceptance contract: per-output-channel symmetric int8 round-trips inside
+the scale/2 bound; the quantized engines' greedy tokens AGREE 100% with
+the f32 engines on trained exports (and `quantize_export` REFUSES, typed,
+when they would not — the opt-in-safe accuracy contract); quantized decode
+keeps zero steady-state recompiles and continuous==sequential streams; hot
+reload swaps quantized ints and their scales as ONE reference store
+(straddling traffic sees wholly-old-or-wholly-new); sharded int8 dp2×tp2
+is BIT-identical to single-device int8 (the §18 column layout's bit-safety
+holds inside the quantized lane); the placement accountant's quantized
+byte sizes are EXACT against real quantized arrays and flip a must-shard
+model to a feasible single-chip plan; and the tuned-config adoption path
+(`quantize="auto"`) only arms what `perf_lab cpu` measured.
+
+Runs on the conftest-forced 8-virtual-CPU-device mesh. The trained export
+fixture matters: greedy margins of a RANDOM-INIT tiny model are
+quantization-noise-sized (agreement ~0.96, which is what the refusal test
+exploits); a model trained on the deterministic successor task is
+confident and agrees exactly.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.models.transformer import transformer_lm
+from paddle_tpu.serving import (DecodeEngine, GenerationBatcher,
+                                ServingClient, ServingEngine, ServingServer,
+                                ShardedServingEngine)
+from paddle_tpu.serving.decode import generate_sequential
+from paddle_tpu.serving.errors import ServingError
+from paddle_tpu.serving.fleet import scraped_gauges
+from paddle_tpu.serving.placement import (DeviceInventory,
+                                          NoFeasiblePlacement,
+                                          PlacementSearcher, TrafficProfile,
+                                          profile_export)
+from paddle_tpu.serving.quant import (QUANT_ROLES, QuantizationError,
+                                      QuantizedDecodeEngine,
+                                      QuantizedServingEngine,
+                                      calibrate_error, dequantize_weight,
+                                      load_tuned_config, param_bytes,
+                                      quantize_export, quantize_params,
+                                      quantize_weight, resolve_quantize,
+                                      write_tuned_config)
+
+V, T, D, H, L, FF = 128, 32, 64, 4, 2, 128
+
+
+def _export_lm(dirname, seed, trained=False, fused_qkv=False, steps=90):
+    """Tiny causal-LM export. ``trained=True`` fits the deterministic
+    successor task (labels = (ids*3+7) mod V) so greedy margins are
+    trained-model confident; untrained exports get the symmetry-breaking
+    perturbation only (margins ~ quantization noise)."""
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+            logits, loss = transformer_lm(
+                ids, labels, vocab_size=V, max_len=T, d_model=D, n_heads=H,
+                n_layers=L, d_ff=FF, fused_qkv=fused_qkv)
+            test_prog = main.clone(for_test=True)
+            if trained:
+                fluid.optimizer.Adam(3e-3).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)
+        if trained:
+            rng = np.random.RandomState(seed)
+            for _ in range(steps):
+                x = rng.randint(0, V, (8, T)).astype(np.int64)
+                exe.run(main, feed={"ids": x, "labels": (x * 3 + 7) % V},
+                        fetch_list=[loss], scope=scope)
+        else:
+            rng = np.random.RandomState(seed + 1000)
+            for name in scope.var_names():
+                w = np.asarray(scope.get(name))
+                if np.issubdtype(w.dtype, np.floating):
+                    scope.set(name, w + 0.5 * rng.randn(*w.shape)
+                              .astype(w.dtype))
+        io.save_inference_model(dirname, ["ids"], [logits], exe, test_prog,
+                                scope=scope)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def trained_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("quant")
+    return (_export_lm(str(root / "a"), seed=11, trained=True),
+            _export_lm(str(root / "b"), seed=47, trained=True))
+
+
+@pytest.fixture(scope="module")
+def raw_dir(tmp_path_factory):
+    return _export_lm(str(tmp_path_factory.mktemp("quant_raw") / "lm"),
+                      seed=11)
+
+
+@pytest.fixture(scope="module")
+def f32_engine(trained_dirs):
+    return ServingEngine(trained_dirs[0], place=fluid.CPUPlace())
+
+
+@pytest.fixture(scope="module")
+def int8_engine(trained_dirs):
+    return QuantizedServingEngine(trained_dirs[0], mode="int8",
+                                  place=fluid.CPUPlace())
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.RandomState(3)
+    return {"ids": rng.randint(0, V, (5, T)).astype(np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# quantization math
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_weight_roundtrip_error_bound():
+    """Per-output-channel symmetric int8: |w - q*s| <= s/2 elementwise,
+    scale per LAST axis, int8 storage; a zero column is safe (scale 1)."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(48, 96).astype(np.float32) * rng.rand(96).astype(np.float32)
+    w[:, 7] = 0.0  # degenerate column must not divide by zero
+    leaf = quantize_weight(w, "int8")
+    assert leaf["q"].dtype == np.int8 and leaf["q"].shape == w.shape
+    assert leaf["s"].dtype == np.float32 and leaf["s"].shape == (96,)
+    assert np.abs(leaf["q"]).max() <= 127
+    err = np.abs(dequantize_weight(leaf) - w)
+    assert (err <= leaf["s"][None, :] / 2 + 1e-7).all()
+    assert (dequantize_weight(leaf)[:, 7] == 0.0).all()
+    # bf16 storage: plain half-width array, no scale
+    import ml_dtypes
+
+    b = quantize_weight(w, "bf16")
+    assert b.dtype == ml_dtypes.bfloat16 and b.nbytes == w.nbytes // 2
+    with pytest.raises(ValueError):
+        quantize_weight(w, "int4")
+
+
+def test_quantize_params_covers_exactly_the_matmul_roles(trained_dirs):
+    store = quantize_export(trained_dirs[0], "int8", calibrate=False)
+    top = {k: v for k, v in store.params.items() if k != "layers"}
+    for role, leaf in top.items():
+        assert isinstance(leaf, dict) == (role in QUANT_ROLES), role
+    for lp in store.params["layers"]:
+        for role, leaf in lp.items():
+            assert isinstance(leaf, dict) == (role in QUANT_ROLES), role
+    # int8 + per-column scales land near 1/4 of the f32 store
+    assert store.weights_bytes / store.f32_bytes < 0.30
+
+
+def test_dequant_kernels_match_numpy_reference():
+    """ops/quant.dequant_matmul / dequant_rows vs the numpy math, and the
+    registered weight_only_quant_matmul op runs the same kernel."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def, registered_ops
+    from paddle_tpu.ops.quant import dequant_matmul, dequant_rows
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 32).astype(np.float32)
+    w = rng.randn(32, 24).astype(np.float32)
+    leaf = quantize_weight(w, "int8")
+    want = x @ (leaf["q"].astype(np.float32) * leaf["s"])
+    got = np.asarray(dequant_matmul(jnp.asarray(x), jnp.asarray(leaf["q"]),
+                                    jnp.asarray(leaf["s"])))
+    assert np.allclose(got, want, atol=1e-5)
+    ids = rng.randint(0, 32, (3, 4))
+    rows = np.asarray(dequant_rows(jnp.asarray(leaf["q"].T.copy()),
+                                   jnp.asarray(ids),
+                                   jnp.asarray(
+                                       np.ones(32, np.float32))))
+    assert rows.shape == (3, 4, 32)
+    assert "weight_only_quant_matmul" in registered_ops()
+    out = get_op_def("weight_only_quant_matmul").impl(
+        None, {"X": [jnp.asarray(x)], "QWeight": [jnp.asarray(leaf["q"])],
+               "Scale": [jnp.asarray(leaf["s"])]}, {})["Out"][0]
+    assert np.allclose(np.asarray(out), want, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# the accuracy contract
+# ---------------------------------------------------------------------------
+
+
+def test_calibrate_error_reports_agreement(trained_dirs):
+    rep = calibrate_error(trained_dirs[0], mode="int8")
+    assert rep["token_agreement"] == 1.0 == rep["top1_agreement"]
+    assert 0.0 < rep["max_abs_logit_err"] < 1.0
+    assert rep["mean_abs_logit_err"] <= rep["max_abs_logit_err"]
+    assert rep["mode"] == "int8" and rep["positions"] > 0
+
+
+def test_quantize_export_refuses_below_floor_typed(raw_dir):
+    """The opt-in-safe gate: on the RANDOM-INIT export the int8 grid
+    flips greedy tokens (margins are noise-sized), so quantize_export
+    refuses with the typed QuantizationError carrying the numbers."""
+    with pytest.raises(QuantizationError) as ei:
+        quantize_export(raw_dir, "int8")
+    err = ei.value
+    assert isinstance(err, ValueError)  # typed AND catchable generically
+    assert err.mode == "int8"
+    assert err.agreement < err.floor == pytest.approx(0.999)
+    assert err.max_abs_err > 0
+    # an explicit lower floor lets the same export through, store intact
+    store = quantize_export(raw_dir, "int8", agreement_floor=0.5)
+    assert store.calibration["token_agreement"] >= 0.5
+    assert store.mode == "int8"
+
+
+def test_quantized_engine_refuses_non_lm_export(tmp_path):
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(x, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=0)
+        io.save_inference_model(str(tmp_path / "mlp"), ["x"], [y], exe,
+                                main, scope=scope)
+    with pytest.raises(ValueError):
+        QuantizedServingEngine(str(tmp_path / "mlp"), mode="int8",
+                               place=fluid.CPUPlace())
+    with pytest.raises(ValueError):
+        QuantizedServingEngine(str(tmp_path / "mlp"), mode="fp8",
+                               place=fluid.CPUPlace())
+
+
+# ---------------------------------------------------------------------------
+# predict engines
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_predict_agrees_and_is_deterministic(f32_engine,
+                                                       int8_engine, batch):
+    ref = f32_engine.run_batch(batch)[0]
+    out = int8_engine.run_batch(batch)[0]
+    assert out.shape == ref.shape
+    # greedy tokens agree EXACTLY; logits within the int8 grid's error
+    assert (ref.argmax(-1) == out.argmax(-1)).all()
+    assert np.abs(ref - out).max() < 1.0
+    assert not np.array_equal(ref, out)  # it really quantized
+    # deterministic: the quantized lane is a pure function of the store
+    assert np.array_equal(out, int8_engine.run_batch(batch)[0])
+    assert int8_engine.quant_mode == "int8"
+    assert f32_engine.quant_mode is None
+    assert int8_engine.weights_bytes() < 0.35 * f32_engine.weights_bytes()
+
+
+def test_bf16_engine_agrees(trained_dirs, f32_engine, batch):
+    eng = QuantizedServingEngine(trained_dirs[0], mode="bf16",
+                                 place=fluid.CPUPlace())
+    ref = f32_engine.run_batch(batch)[0]
+    out = eng.run_batch(batch)[0]
+    assert (ref.argmax(-1) == out.argmax(-1)).all()
+    assert eng.weights_bytes() < 0.6 * f32_engine.weights_bytes()
+
+
+# ---------------------------------------------------------------------------
+# decode path: streams, zero recompiles, continuous batching
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_decode_streams_agree_zero_recompiles(trained_dirs):
+    f32 = DecodeEngine(trained_dirs[0], max_slots=4)
+    q8 = QuantizedDecodeEngine(trained_dirs[0], mode="int8", max_slots=4)
+    prompts = [np.random.RandomState(5 + i).randint(0, V, (4 + i,))
+               for i in range(4)]
+    ref = generate_sequential(f32, prompts, 12)
+    sq = generate_sequential(q8, prompts, 12)
+    assert sq == ref  # greedy token agreement on the decode path
+    misses = q8.cache_info()["misses"]
+    assert generate_sequential(q8, prompts, 12) == sq
+    assert q8.cache_info()["misses"] == misses  # zero steady-state compiles
+    # continuous batching over the quantized engine bit-matches its own
+    # sequential reference (same compiled signatures, lane-independent)
+    gb = GenerationBatcher(q8, queue_capacity=8)
+    try:
+        futs = [gb.submit(p, max_new_tokens=12) for p in prompts]
+        cont = [f.result(timeout=60).tokens for f in futs]
+    finally:
+        gb.close()
+    assert cont == sq
+    assert q8.cache_info()["misses"] == misses
+    assert q8.quant_mode == "int8"
+
+
+# ---------------------------------------------------------------------------
+# hot reload: ints and scales swap as one store
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_reload_atomic_wholly_old_or_new(trained_dirs, batch):
+    eng = QuantizedServingEngine(trained_dirs[0], mode="int8",
+                                 place=fluid.CPUPlace())
+    ref_a = eng.run_batch(batch)[0]
+    ref_b = QuantizedServingEngine(trained_dirs[1], mode="int8",
+                                   place=fluid.CPUPlace()
+                                   ).run_batch(batch)[0]
+    assert not np.array_equal(ref_a, ref_b)
+    results, errs = [], []
+
+    def traffic():
+        try:
+            for _ in range(12):
+                results.append(eng.run_batch(batch)[0])
+        except Exception as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    threads = [threading.Thread(target=traffic) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)
+    version = eng.reload_params(trained_dirs[1])
+    for t in threads:
+        t.join(60)
+    assert not errs
+    assert version == 2
+    # every straddling dispatch is WHOLLY old or WHOLLY new: a torn swap
+    # (new ints under old scales or vice versa) matches neither reference
+    for out in results:
+        assert np.array_equal(out, ref_a) or np.array_equal(out, ref_b)
+    assert np.array_equal(eng.run_batch(batch)[0], ref_b)
+
+
+def test_quantized_reload_validates_and_requantizes(trained_dirs, tmp_path):
+    """The staged set re-quantizes at the frozen mode: the flat validation
+    walks .q AND .s paths together (a reload can never swap ints without
+    their scales), and a bad dir refuses with the live store untouched."""
+    from paddle_tpu.serving.engine import _flat_items
+
+    eng = QuantizedServingEngine(trained_dirs[0], mode="int8",
+                                 place=fluid.CPUPlace())
+    staged = eng.stage_params(trained_dirs[1])
+    flat = dict(_flat_items(staged))
+    assert any(p.endswith(".q") for p in flat)
+    assert any(p.endswith(".s") for p in flat)
+    v0 = eng.params_version
+    with pytest.raises(Exception):
+        eng.stage_params(str(tmp_path / "nonexistent"))
+    assert eng.params_version == v0  # live store untouched by the refusal
+
+
+# ---------------------------------------------------------------------------
+# sharded: bit-safety inside the quantized lane
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 2), (1, 4)])
+def test_sharded_int8_bit_identical(trained_dirs, int8_engine, batch,
+                                    dp, tp):
+    eng = ShardedServingEngine(trained_dirs[0], dp=dp, tp=tp,
+                               place=fluid.CPUPlace(), quantize="int8")
+    ref = int8_engine.run_batch(batch)[0]
+    out = eng.run_batch(batch)[0]
+    assert np.array_equal(ref, out), f"dp={dp} tp={tp} diverged"
+    # the quantized lane keeps the static §18 collective schedule
+    assert eng.measured_collectives(4) == (0 if tp == 1 else 4 * L + 2)
+    assert eng.quant_mode == "int8"
+
+
+def test_sharded_fused_qkv_int8_bit_identical(tmp_path):
+    d = _export_lm(str(tmp_path / "fused"), seed=7, trained=True,
+                   fused_qkv=True)
+    ref = QuantizedServingEngine(d, mode="int8", place=fluid.CPUPlace())
+    eng = ShardedServingEngine(d, dp=1, tp=2, place=fluid.CPUPlace(),
+                               quantize="int8")
+    ids = np.random.RandomState(9).randint(0, V, (4, T)).astype(np.int64)
+    assert np.array_equal(ref.run_batch({"ids": ids})[0],
+                          eng.run_batch({"ids": ids})[0])
+
+
+# ---------------------------------------------------------------------------
+# placement: exact quantized byte accounting + the must-shard flip
+# ---------------------------------------------------------------------------
+
+
+def test_placement_quantized_bytes_exact(trained_dirs):
+    """profile_export's per-mode byte account equals the REAL quantized
+    arrays' nbytes, exactly — no estimate anywhere."""
+    prof = profile_export(trained_dirs[0], xla_cost=False)
+    for mode in ("int8", "bf16"):
+        store = quantize_export(trained_dirs[0], mode, calibrate=False)
+        qprof = prof.quantize(mode)
+        assert qprof.param_bytes == store.weights_bytes
+        assert qprof.bytes_replicated == prof.bytes_replicated
+        assert qprof.quant_mode == mode
+    assert prof.quantize(None) is prof
+    # param_bytes() over the real quantized pytree IS the store size
+    store = quantize_export(trained_dirs[0], "int8", calibrate=False)
+    assert param_bytes(store.params) == store.weights_bytes
+
+
+def test_placement_must_shard_flips_single_chip(trained_dirs):
+    """Modeled HBM midway between the int8 and f32 single-chip needs:
+    every f32 single-chip plan is rejected (must-shard) while the int8
+    account fits one chip — the quantization headline the plan table
+    shows side by side."""
+    prof = profile_export(trained_dirs[0], xla_cost=False)
+    traffic = TrafficProfile([(2, 1.0)], seq_len=T)
+    probe = PlacementSearcher(prof, DeviceInventory(4, hbm_gb=1e6), traffic)
+    f32_need = probe.score(1, 1).hbm_bytes_per_device
+    q_need = PlacementSearcher(prof.quantize("int8"),
+                               DeviceInventory(4, hbm_gb=1e6),
+                               traffic).score(1, 1).hbm_bytes_per_device
+    assert q_need < f32_need
+    hbm_gb = (f32_need + q_need) / 2 / (1024.0 ** 3)
+    inv = DeviceInventory(4, hbm_gb=hbm_gb)
+    with pytest.raises(NoFeasiblePlacement):
+        PlacementSearcher(prof, inv, traffic).search(max_devices=1)
+    plan = PlacementSearcher(prof.quantize("int8"), inv,
+                             traffic).search(max_devices=1)
+    assert (plan.dp, plan.tp) == (1, 1)
+    assert plan.hbm_bytes_per_device <= inv.hbm_bytes
+
+
+def test_synthetic_profile_quant_account_is_consistent():
+    from paddle_tpu.serving.placement import ModelProfile
+
+    prof = ModelProfile.synthetic(2, 4, 64, 128, 128, 32)
+    q = prof.quantize("int8")
+    # int8 must land between 1/4 (pure weights) and ~1/3 of f32 sharded
+    assert 0.25 * prof.bytes_sharded < q.bytes_sharded \
+        < 0.40 * prof.bytes_sharded
+    b = prof.quantize("bf16")
+    assert 0.5 * prof.bytes_sharded < b.bytes_sharded \
+        <= 0.55 * prof.bytes_sharded
+    with pytest.raises(ValueError):
+        prof.quantize("int3")
+
+
+# ---------------------------------------------------------------------------
+# server surfaces: gauges, scrape contract, fleet table, tuned config
+# ---------------------------------------------------------------------------
+
+
+def test_server_gauges_scrape_and_fleet_row(trained_dirs, batch):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import paddle_cli
+
+    with ServingServer(trained_dirs[0], quantize="int8",
+                       warmup=False) as srv:
+        with ServingClient(srv.endpoint) as c:
+            c.predict(batch)
+            hz = c.healthz()
+            text = c.metrics()
+            assert hz["quantize"] == "int8"
+            assert "pt_serving_quant_mode 1" in text.replace(".0", "")
+            assert "pt_serving_weights_bytes" in text
+            g = scraped_gauges(hz, text)
+            assert g["quant_mode"] == 1.0
+            assert g["weights_bytes"] > 0
+            snap = c.stats()
+            assert snap["quantize"] == "int8"
+            assert snap["weights_bytes"] == srv.engine.weights_bytes()
+        rows = paddle_cli.fleet_rows([srv.endpoint])
+        assert rows[0]["quant"] == "int8"
+        assert "quant" in paddle_cli.fleet_report(rows).splitlines()[0]
+
+
+def test_tuned_config_auto_adoption(trained_dirs, tmp_path_factory):
+    d = trained_dirs[1]
+    assert load_tuned_config(d) is None
+    assert resolve_quantize(d, "auto") is None  # no measured win: f32
+    assert resolve_quantize(d, None) is None
+    assert resolve_quantize(d, "int8") == "int8"
+    with pytest.raises(ValueError):
+        resolve_quantize(d, "fp4")
+    # threads: 0 — adopt_tuned applies a REAL affinity cap for threads>=1,
+    # which would pin the whole test process on multi-core dev machines
+    path = write_tuned_config(d, {"quantize": "int8", "threads": 0,
+                                  "max_batch_size": 4, "win": 0.08})
+    try:
+        cfg = load_tuned_config(d)
+        assert cfg["quantize"] == "int8" and cfg["schema"] == 1
+        assert resolve_quantize(d, "auto") == "int8"
+        with ServingServer(d, quantize="auto", warmup=False) as srv:
+            assert srv.engine.quant_mode == "int8"
+            # the measured bucket cap is adopted too (full-config "auto")
+            assert srv.engine.max_batch_size == 4
+        with ServingServer(d, quantize="auto", max_batch_size=16,
+                           warmup=False) as srv:
+            assert srv.engine.max_batch_size == 16  # explicit wins
+    finally:
+        import os
+
+        os.remove(path)
+    with ServingServer(d, quantize="auto", warmup=False) as srv:
+        assert srv.engine.quant_mode is None  # nothing measured, f32
+
+
+# ---------------------------------------------------------------------------
+# chaos: the §12 invariants hold with a quantized engine
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_chaos_storm_typed_errors_only(trained_dirs, batch):
+    """The PR-2 storm invariant on a QUANTIZED server: every request
+    succeeds (with correct quantized output) or fails with a typed
+    serving error, and the server is healthy after the window."""
+    from paddle_tpu.serving.chaos import ChaosInjector
+
+    chaos = ChaosInjector(seed=5, slow_call_prob=0.2, slow_call_ms=20.0,
+                          error_prob=0.15, drop_conn_prob=0.1,
+                          stall_prob=0.1, stall_ms=10.0,
+                          fault_window_s=2.0)
+    with ServingServer(trained_dirs[0], quantize="int8", chaos=chaos,
+                       warmup=True, queue_capacity=16) as srv:
+        ref = srv.engine.run_batch(batch)[0]
+        chaos.arm()
+        ok = bad = 0
+        errs = []
+
+        def client_loop(tid):
+            nonlocal ok, bad
+            with ServingClient(srv.endpoint, retries=8,
+                               retry_seed=tid) as c:
+                for _ in range(10):
+                    try:
+                        out = c.predict(batch)[0]
+                        if np.allclose(out, ref):
+                            ok += 1
+                        else:  # pragma: no cover - corruption detector
+                            bad += 1
+                    except ServingError:
+                        ok += 1  # typed = the contract held
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+
+        threads = [threading.Thread(target=client_loop, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errs and bad == 0 and ok == 30
+        assert sum(chaos.snapshot()["injected"].values()) > 0
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if srv.health_state() == "healthy":
+                break
+            time.sleep(0.05)
+        assert srv.health_state() == "healthy"

@@ -242,10 +242,13 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all eight tracked metrics carry a bar (r8 added sharded serving)
-    assert len(bench.BARS) == 8
+    # all nine tracked metrics carry a bar (r8 added sharded serving,
+    # r10 the quantized CPU serving lane)
+    assert len(bench.BARS) == 9
     shd = bench.BARS["sharded_serving_qps_per_chip"]
     assert shd["field"] == "value" and shd["min"] == 1.0
+    cpuq = bench.BARS["cpu_quantized_serving_qps_ratio"]
+    assert cpuq["field"] == "value" and cpuq["min"] == 0.85
     # pass: above bar
     bench._emit({"metric": "transformer_lm_train_tokens_per_sec_per_chip",
                  "value": 150000.0, "unit": "tokens/sec", "mfu": 0.648})

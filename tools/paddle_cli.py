@@ -199,11 +199,13 @@ def fleet_rows(endpoints, timeout=3.0):
     for ep in endpoints:
         row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
                "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
-               "shards": "-", "weights": "-", "decode": ""}
+               "shards": "-", "weights": "-", "quant": "-", "decode": ""}
         try:
             with ServingClient(ep, timeout=timeout) as c:
                 hz = c.healthz()
                 m = scraped_gauges(hz, c.metrics())
+            from paddle_tpu.serving.quant import QUANT_MODE_NAMES
+
             row.update(
                 health=hz.get("state", "?"), circuit="closed",
                 queue=int(m["queue_depth"]),
@@ -211,6 +213,8 @@ def fleet_rows(endpoints, timeout=3.0):
                 occupancy=int(m["occupancy"]),
                 mfu=m["mfu"],
                 shards=int(m.get("shards", 1)),
+                quant=QUANT_MODE_NAMES.get(int(m.get("quant_mode", 0)),
+                                           "f32"),
                 weights=int(m["weights_version"]))
             d = hz.get("decode")
             if d:
@@ -274,7 +278,8 @@ def router_report(r):
 
 def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
-             f"{'occ':>5}{'mfu':>11}{'shards':>7}{'weights':>9}  decode"]
+             f"{'occ':>5}{'mfu':>11}{'shards':>7}{'quant':>7}"
+             f"{'weights':>9}  decode"]
     for r in rows:
         q = (f"{r['queue']}/{r['capacity']}"
              if r["queue"] != "-" else "-")
@@ -282,6 +287,7 @@ def fleet_report(rows):
         lines.append(f"{r['endpoint']:<24}{r['health']:<12}"
                      f"{r['circuit']:<9}{q:>9}{str(r['occupancy']):>5}"
                      f"{mfu:>11}{str(r.get('shards', '-')):>7}"
+                     f"{str(r.get('quant', '-')):>7}"
                      f"{str(r['weights']):>9}  {r['decode']}")
     healthy = sum(1 for r in rows if r["health"] == "healthy")
     lines.append(f"{healthy}/{len(rows)} replicas healthy")
@@ -567,9 +573,13 @@ def _parse_batch_mix(spec):
 
 def placement_report(dirname, chips=8, hbm_gb=16.0, peak_tflops=197.0,
                      hbm_gbps=820.0, link_gbps=45.0, batch_mix="1:0.7,8:0.3",
-                     p95_ms=None, seq_len=None, decode_slots=0):
+                     p95_ms=None, seq_len=None, decode_slots=0,
+                     quantize=None):
     """(report_text, chosen_plan_or_None) — the testable core of
-    ``cmd_placement``."""
+    ``cmd_placement``. With ``quantize`` the f32 and quantized byte
+    accounts are searched SIDE BY SIDE (the headline row: a model that
+    must-shard at f32 but fits one chip under int8 — the quantized store
+    is ~1/4 the HBM); the returned plan is the QUANTIZED one."""
     sys.path.insert(0, REPO)
     from paddle_tpu.serving.placement import (DeviceInventory,
                                               NoFeasiblePlacement,
@@ -582,29 +592,54 @@ def placement_report(dirname, chips=8, hbm_gb=16.0, peak_tflops=197.0,
                           hbm_gbps=hbm_gbps, link_gbps=link_gbps)
     traffic = TrafficProfile(_parse_batch_mix(batch_mix), seq_len=seq_len,
                              p95_budget_ms=p95_ms, decode_slots=decode_slots)
-    searcher = PlacementSearcher(prof, inv, traffic)
     lines = [f"{dirname}: {prof.cfg['n_layers']}L x d{prof.cfg['d_model']} "
              f"x ff{prof.cfg['d_ff']} x V{prof.cfg['vocab']} "
              f"({prof.param_bytes / 2**30:.3f} GiB params, "
              f"xla_flops/row={prof.xla_flops})",
              f"inventory: {chips} x {hbm_gb} GiB @ {peak_tflops} TFLOP/s, "
-             f"link {link_gbps} GB/s",
-             plan_table(searcher.all_plans())]
-    try:
-        chosen = searcher.search()
-    except NoFeasiblePlacement as e:
-        lines.append(f"NO FEASIBLE PLAN: {e}")
-        return "\n".join(lines), None
-    lines.append(
-        f"chosen: dp={chosen.dp} tp={chosen.tp} "
-        f"({chosen.devices} chips)  per-device HBM "
-        f"{chosen.hbm_bytes_per_device / 2**30:.3f} GiB "
-        f"({chosen.hbm_fraction:.0%})  comm "
-        f"{chosen.collective_bytes_per_step / 2**20:.2f} MiB/step over "
-        f"{chosen.collectives_per_dispatch} all-gathers  predicted "
-        f"{chosen.predicted_qps:.1f} QPS "
-        f"({chosen.predicted_qps_per_chip:.1f}/chip) at p95 "
-        f"{chosen.predicted_p95_ms:.2f} ms")
+             f"link {link_gbps} GB/s"]
+    profiles = [("f32", prof)]
+    if quantize:
+        qprof = prof.quantize(quantize)
+        lines.append(
+            f"quantized ({quantize}): params "
+            f"{qprof.param_bytes / 2**30:.3f} GiB "
+            f"({qprof.param_bytes / prof.param_bytes:.0%} of f32)")
+        profiles.append((quantize, qprof))
+    chosen = None
+    single_chip = {}
+    for label, p in profiles:
+        searcher = PlacementSearcher(p, inv, traffic)
+        lines.append(f"--- {label} plan table ---")
+        lines.append(plan_table(searcher.all_plans()))
+        try:
+            single_chip[label] = searcher.search(max_devices=1)
+        except NoFeasiblePlacement:
+            single_chip[label] = None
+        try:
+            best = searcher.search()
+        except NoFeasiblePlacement as e:
+            lines.append(f"{label}: NO FEASIBLE PLAN: {e}")
+            continue
+        lines.append(
+            f"{label} chosen: dp={best.dp} tp={best.tp} "
+            f"({best.devices} chips)  per-device HBM "
+            f"{best.hbm_bytes_per_device / 2**30:.3f} GiB "
+            f"({best.hbm_fraction:.0%})  comm "
+            f"{best.collective_bytes_per_step / 2**20:.2f} MiB/step over "
+            f"{best.collectives_per_dispatch} all-gathers  predicted "
+            f"{best.predicted_qps:.1f} QPS "
+            f"({best.predicted_qps_per_chip:.1f}/chip) at p95 "
+            f"{best.predicted_p95_ms:.2f} ms")
+        chosen = best  # with --quantize, the quantized plan is returned
+    if quantize and single_chip.get("f32") is None \
+            and single_chip.get(quantize) is not None:
+        lines.append(
+            f"HEADLINE: must-shard at f32 (no single-chip plan fits "
+            f"{hbm_gb} GiB) but SINGLE-CHIP under {quantize} "
+            f"(dp={single_chip[quantize].dp} tp={single_chip[quantize].tp}, "
+            f"{single_chip[quantize].hbm_bytes_per_device / 2**30:.3f} "
+            f"GiB/dev)")
     return "\n".join(lines), chosen
 
 
@@ -628,13 +663,18 @@ def cmd_placement(argv):
     ap.add_argument("--seq-len", type=int, default=None)
     ap.add_argument("--decode-slots", type=int, default=0,
                     help="account a decode KV pool of this many slots")
+    ap.add_argument("--quantize", choices=("int8", "bf16"), default=None,
+                    help="also search the weight-only quantized byte "
+                         "account side by side (int8 weights ~1/4 the "
+                         "HBM; a must-shard model can become single-chip "
+                         "— the headline row) and return ITS plan")
     args = ap.parse_args(argv)
     report, chosen = placement_report(
         args.export_dir, chips=args.chips, hbm_gb=args.hbm_gb,
         peak_tflops=args.peak_tflops, hbm_gbps=args.hbm_gbps,
         link_gbps=args.link_gbps, batch_mix=args.batch_mix,
         p95_ms=args.p95_ms, seq_len=args.seq_len,
-        decode_slots=args.decode_slots)
+        decode_slots=args.decode_slots, quantize=args.quantize)
     print(report)
     return 0 if chosen is not None else 1
 

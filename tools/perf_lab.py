@@ -30,6 +30,16 @@ chip/XLA limits. Variants:
                                      # measured step time for a real tiny
                                      # model on the host CPU mesh; winner
                                      # as final JSON line (docs §18)
+  python tools/perf_lab.py cpu [DIR] # CPU serving tuning sweep: threads x
+                                     # weight-only quant mode (f32/int8/
+                                     # bf16) x bucket ladder, each cell a
+                                     # fresh subprocess (thread flags are
+                                     # pre-jax-init only); writes the
+                                     # export's cpu_tuned.json ONLY on a
+                                     # >5% closed-loop win with the
+                                     # agreement floor held (docs §20) —
+                                     # ServingServer(quantize="auto")
+                                     # adopts it
 
 Prints images/sec and analytic MFU (12.3 GFLOP/img fwd+bwd on a
 ~197 TFLOP/s bf16 v5e chip) for the resnet modes; step_ms per knob for
@@ -479,6 +489,179 @@ def placement_mode(seed: int = 5):
                       "rows": rows}))
 
 
+def _cpu_child(argv):
+    """One sweep cell, run in a FRESH process: `perf_lab.py cpu-child
+    EXPORT QUANT THREADS MAX_BATCH REPS`. A fresh process because the
+    XLA_FLAGS half of the thread shaping is read once at CPU backend
+    creation — in this child no computation has run yet, so
+    ``serving/quant.apply_cpu_flags`` (the ONE thread-shaping
+    implementation) still lands its env edit before the lazy backend
+    comes up. Prints ONE JSON line the parent collects."""
+    import json
+    import os
+
+    export, quant, threads, max_batch, reps = (
+        argv[0], argv[1], int(argv[2]), int(argv[3]), int(argv[4]))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.quant import (QuantizedServingEngine,
+                                          apply_cpu_flags)
+
+    if threads > 0:
+        assert apply_cpu_flags(threads=threads), \
+            "cpu-child: backend initialized before thread shaping"
+
+    buckets = [b for b in (1, 2, 4, 8, 16, 32) if b <= max_batch]
+    if quant == "f32":
+        eng = ServingEngine(export, place=fluid.CPUPlace(),
+                            batch_buckets=buckets)
+    else:
+        eng = QuantizedServingEngine(export, mode=quant,
+                                     place=fluid.CPUPlace(),
+                                     batch_buckets=buckets)
+    var = eng._feed_vars[eng.feed_names[0]]
+    t = int(var.shape[1])
+    if hasattr(eng, "cfg"):
+        vocab = eng.cfg["vocab"]
+    else:  # plain f32 engine: recover the vocab from the IR walk
+        from paddle_tpu.models.transformer import decode_roles
+
+        vocab = decode_roles(eng.program)[1]["vocab"]
+    rng = np.random.RandomState(0)
+    full = {eng.feed_names[0]:
+            rng.randint(0, vocab, (max_batch, t)).astype(np.int64)}
+    one = {eng.feed_names[0]:
+           rng.randint(0, vocab, (1, t)).astype(np.int64)}
+    for feeds in (full, one):  # compile both measured buckets
+        eng.run_batch(feeds)
+        eng.run_batch(feeds)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        eng.run_batch(full)
+    bucket_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        eng.run_batch(one)
+    one_s = (time.perf_counter() - t0) / reps
+    print(json.dumps({
+        "quantize": quant, "threads": threads, "max_batch": max_batch,
+        "qps": round(max_batch / bucket_s, 2),
+        "row_ms": round(one_s * 1e3, 3),
+        "weights_bytes": eng.weights_bytes()}))
+
+
+def cpu_mode():
+    """`perf_lab.py cpu [EXPORT_DIR]` — the CPU serving tuning sweep
+    (docs/design.md §20): threads x weight-only quant mode x bucket
+    ladder, every cell a fresh subprocess (thread flags are pre-jax-init
+    only), closed-loop QPS at the full bucket as the score. The chosen
+    config is written to the export's ``cpu_tuned.json`` ONLY when it
+    beats the untuned f32 baseline by >5% closed-loop (the PR-4 autotune
+    adoption bar) AND, for quantized candidates, greedy-token agreement
+    holds the quantize_export floor — `ServingServer(quantize="auto")`
+    then adopts it. Final line: the chosen config as JSON."""
+    import json
+    import os
+    import subprocess
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    export = sys.argv[2] if len(sys.argv) > 2 else None
+    if export is None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # the ONE pinned-export builder bench.py's cpu_quantized workload
+        # shares — the bar and this sweep must measure the same model
+        from paddle_tpu.models.transformer import train_successor_lm_export
+
+        export = os.path.join(tempfile.mkdtemp(prefix="perf_lab_cpu_"), "lm")
+        print(f"no export given: training the pinned successor-task LM "
+              f"(confident greedy margins — the agreement gate needs a "
+              f"trained model) -> {export}")
+        train_successor_lm_export(export)
+
+    from paddle_tpu.serving.quant import (ADOPTION_MIN_WIN,
+                                          DEFAULT_AGREEMENT_FLOOR,
+                                          calibrate_error,
+                                          write_tuned_config)
+
+    # quantized candidates must hold the accuracy contract to be adoptable
+    agreement = {}
+    for mode in ("int8", "bf16"):
+        rep = calibrate_error(export, mode=mode)
+        agreement[mode] = rep["token_agreement"]
+        print(f"calibration {mode}: token agreement "
+              f"{rep['token_agreement']:.4f}, max abs logit err "
+              f"{rep['max_abs_logit_err']:.3e}")
+
+    ncpu = os.cpu_count() or 1
+    # 0 = backend default pool, 1 = single-threaded Eigen (a DISTINCT
+    # config even on a 1-core host — the flag changes the threadpool
+    # machinery, not just its width), ncpu = full width when it differs
+    thread_grid = sorted({0, 1} | ({ncpu} if ncpu > 1 else set()))
+    quant_grid = ("f32", "int8", "bf16")
+    batch_grid = (4, 8, 16)
+    reps = int(os.environ.get("PERF_LAB_CPU_REPS", "30"))
+    here = os.path.abspath(__file__)
+    rows = []
+    print(f"{'quant':<6}{'threads':>8}{'max_batch':>10}{'qps':>10}"
+          f"{'row_ms':>9}{'weights':>12}")
+    for quant in quant_grid:
+        for threads in thread_grid:
+            for mb in batch_grid:
+                try:
+                    r = subprocess.run(
+                        [sys.executable, here, "cpu-child", export, quant,
+                         str(threads), str(mb), str(reps)],
+                        capture_output=True, text=True, timeout=600)
+                except subprocess.TimeoutExpired:
+                    # one slow cell is a FAILED row, not a lost sweep —
+                    # the rows already measured still decide adoption
+                    print(f"{quant:<6}{threads:>8}{mb:>10}  FAILED: "
+                          f"timed out after 600s")
+                    continue
+                if r.returncode != 0:
+                    print(f"{quant:<6}{threads:>8}{mb:>10}  FAILED: "
+                          f"{(r.stderr or '')[-120:]}")
+                    continue
+                rec = json.loads(r.stdout.strip().splitlines()[-1])
+                rows.append(rec)
+                print(f"{quant:<6}{threads:>8}{mb:>10}{rec['qps']:>10.1f}"
+                      f"{rec['row_ms']:>9.3f}{rec['weights_bytes']:>12}")
+    base = next((r for r in rows if r["quantize"] == "f32"
+                 and r["threads"] == 0 and r["max_batch"] == 8), None)
+    eligible = [r for r in rows
+                if r["quantize"] == "f32"
+                or agreement.get(r["quantize"], 0.0)
+                >= DEFAULT_AGREEMENT_FLOOR]
+    best = max(eligible, key=lambda r: r["qps"]) if eligible else None
+    out = {"export": export, "baseline": base, "best": best, "rows": rows}
+    if base and best and best is not base:
+        win = best["qps"] / base["qps"] - 1.0
+        out["win"] = round(win, 4)
+        if win > ADOPTION_MIN_WIN:
+            cfg = {"quantize": None if best["quantize"] == "f32"
+                   else best["quantize"],
+                   "threads": best["threads"],
+                   "max_batch_size": best["max_batch"],
+                   "win": round(win, 4),
+                   "baseline_qps": base["qps"], "qps": best["qps"],
+                   "agreement": agreement.get(best["quantize"]),
+                   "host_cpus": ncpu}
+            path = write_tuned_config(export, cfg)
+            out["adopted"] = cfg
+            print(f"ADOPTED (+{win:.1%} closed-loop > "
+                  f"{ADOPTION_MIN_WIN:.0%} bar): {path}")
+        else:
+            print(f"NOT adopted: best win {win:+.1%} is under the "
+                  f"{ADOPTION_MIN_WIN:.0%} bar — measurement says the "
+                  f"untuned f32 baseline stands on this host")
+    print(json.dumps(out))
+
+
 def main():
     layout = sys.argv[1] if len(sys.argv) > 1 else "nchw"
     if layout == "pipeline":
@@ -489,6 +672,12 @@ def main():
         return
     if layout == "placement":
         placement_mode()
+        return
+    if layout == "cpu":
+        cpu_mode()
+        return
+    if layout == "cpu-child":
+        _cpu_child(sys.argv[2:])
         return
     rng = np.random.RandomState(0)
     params, blocks = init_params(rng, layout)

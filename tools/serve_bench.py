@@ -386,18 +386,21 @@ def _parse_tenants(specs):
     return out
 
 
-def _main_fleet(args, shapes, tracer):
+def _main_fleet(args, shapes, tracer, quantize=None):
     """The --fleet path: N local replicas behind a FleetRouter, traffic
     driven THROUGH the router; --chaos runs the fleet-level storm.
     ``--retries`` becomes the router's per-attempt client budget
     (composed under the shared ``--fleet-retries`` failover budget);
     unlike single-server mode it defaults to 0 even under --chaos —
-    the router's failover, not the inner client, owns chaos retries."""
+    the router's failover, not the inner client, owns chaos retries.
+    Returns ``(exit_code, result_dict)`` so the --quantize A/B driver can
+    compare lanes."""
     tenants = _parse_tenants(args.tenant)
     server_kwargs = {"max_batch_size": args.max_batch_size,
                      "batch_timeout_ms": args.batch_timeout_ms,
                      "queue_capacity": args.queue_capacity,
-                     "pipeline_depth": args.pipeline_depth}
+                     "pipeline_depth": args.pipeline_depth,
+                     "quantize": quantize}
     if args.mesh is not None:
         # each replica becomes a sharded model group: the router's scraped
         # gauges (MFU, shard HBM, occupancy) aggregate across its shards
@@ -468,7 +471,7 @@ def _main_fleet(args, shapes, tracer):
         if tracer is not None:
             n = tracer.dump(args.trace_out)
             print(f"chrome trace: {args.trace_out} ({n} spans)")
-        return _judge_slo(args, r, 0 if r["errors"] == 0 else 1)
+        return _judge_slo(args, r, 0 if r["errors"] == 0 else 1), r
     finally:
         if storm is not None:
             storm.stop()
@@ -553,6 +556,14 @@ def main(argv=None):
     ap.add_argument("--vocab", type=int, default=None,
                     help="prompt token id range (--generate + --endpoint; "
                          "--model-dir reads it from the export)")
+    ap.add_argument("--quantize", choices=("int8", "bf16"), default=None,
+                    help="A/B the weight-only quantized serving lane "
+                         "(serving/quant.py) against f32 on one export: "
+                         "the same bench runs twice (lane A f32, lane B "
+                         "quantized), then the calibrated max-abs logit "
+                         "error + greedy-token-agreement line and the "
+                         "QPS/p95 (or tokens/s with --generate) ratios. "
+                         "Composes with --generate, --fleet, and --mesh")
     ap.add_argument("--trace-out", metavar="FILE",
                     help="enable the obs span tracer and write a Chrome "
                          "trace (chrome://tracing / ui.perfetto.dev) of "
@@ -591,6 +602,9 @@ def main(argv=None):
                  "--model-dir")
     if args.fleet is not None and not args.model_dir:
         ap.error("--fleet spawns in-process replicas; it needs --model-dir")
+    if args.quantize and not args.model_dir:
+        ap.error("--quantize A/Bs quantized engines over one export; it "
+                 "needs --model-dir")
     if args.mesh is not None:
         if not args.model_dir:
             ap.error("--mesh builds in-process sharded engines; it needs "
@@ -618,9 +632,64 @@ def main(argv=None):
         tracer = obs.enable()
         tracer.clear()
 
-    if args.fleet is not None:
-        return _main_fleet(args, shapes, tracer)
+    if args.quantize:
+        return _main_quantize_ab(args, shapes, tracer, retries)
 
+    if args.fleet is not None:
+        return _main_fleet(args, shapes, tracer)[0]
+
+    return _main_single(args, shapes, tracer, retries)[0]
+
+
+def _main_quantize_ab(args, shapes, tracer, retries):
+    """The --quantize satellite: the SAME bench twice over one export —
+    lane A f32, lane B weight-only quantized — then the calibrated
+    accuracy line (max abs logit error + greedy-token agreement,
+    serving/quant.calibrate_error) and the A/B ratios. Composes with
+    --generate (tokens/s lanes), --fleet (every replica quantized), and
+    --mesh (sharded quantized engines)."""
+    from paddle_tpu.serving.quant import calibrate_error
+
+    lanes = {}
+    # the baseline lane passes "" (explicit f32), NOT None: None would
+    # fall back to the serving_quantize flag and quantize BOTH lanes
+    for label, mode in (("f32", ""), (args.quantize, args.quantize)):
+        print(f"=== lane {label} ===")
+        if args.fleet is not None:
+            rc, r = _main_fleet(args, shapes, tracer, quantize=mode)
+        else:
+            rc, r = _main_single(args, shapes, tracer, retries,
+                                 quantize=mode)
+        lanes[label] = (rc, r)
+    cal = calibrate_error(args.model_dir, mode=args.quantize)
+    print(f"calibrated accuracy ({args.quantize} vs f32): max abs logit "
+          f"error {cal['max_abs_logit_err']:.3e}, greedy-token agreement "
+          f"{cal['token_agreement']:.4f} over {cal['positions']} positions")
+    a, b = lanes["f32"][1], lanes[args.quantize][1]
+
+    def tokens_per_s(r):
+        # bench_generate reports tokens_per_s directly; bench_fleet's
+        # generation result carries raw tokens + elapsed instead
+        if "tokens_per_s" in r:
+            return r["tokens_per_s"]
+        return r.get("tokens", 0) / r["elapsed_s"] if r["elapsed_s"] else 0.0
+
+    if args.generate:
+        ra, rb = tokens_per_s(a), tokens_per_s(b)
+        lat_key = "ttft_p95_ms" if "ttft_p95_ms" in a else "p95_ms"
+        print(f"A/B {args.quantize} vs f32: tokens/s {rb:.1f} vs {ra:.1f} "
+              f"= {rb / ra if ra else 0.0:.3f}x  "
+              f"{lat_key} {b[lat_key]:.1f} vs {a[lat_key]:.1f} ms")
+    else:
+        ra, rb = a["qps"], b["qps"]
+        print(f"A/B {args.quantize} vs f32: QPS {rb:.1f} vs {ra:.1f} "
+              f"= {rb / ra if ra else 0.0:.3f}x  "
+              f"p95 {b['p95_ms']:.2f} vs {a['p95_ms']:.2f} ms")
+    return lanes["f32"][0] or lanes[args.quantize][0]
+
+
+def _main_single(args, shapes, tracer, retries, quantize=None):
+    """One single-server bench lane; returns ``(exit_code, result)``."""
     server = None
     chaos = None
     try:
@@ -643,8 +712,12 @@ def main(argv=None):
                 batch_timeout_ms=args.batch_timeout_ms,
                 queue_capacity=args.queue_capacity,
                 pipeline_depth=args.pipeline_depth, warmup=True, chaos=chaos,
-                decode=decode, mesh=args.mesh)
+                decode=decode, mesh=args.mesh, quantize=quantize)
             endpoint = server.endpoint
+            if server.engine.quant_mode:
+                print(f"quantized engine: {server.engine.quant_mode} "
+                      f"weight store, {server.engine.weights_bytes()} "
+                      f"resident bytes")
             if args.mesh is not None:
                 print(f"sharded engine: mesh dp={server.mesh_spec['dp']} "
                       f"tp={server.mesh_spec['tp']} "
@@ -671,9 +744,10 @@ def main(argv=None):
             endpoint = args.endpoint
             if args.generate:
                 if args.vocab is None:
-                    ap.error("--generate --endpoint needs --vocab")
+                    raise SystemExit("--generate --endpoint needs --vocab")
             elif not shapes:
-                ap.error("--endpoint needs at least one --shape name=dims")
+                raise SystemExit("--endpoint needs at least one "
+                                 "--shape name=dims")
 
         if args.generate:
             pr = _parse_range(args.prompt_tokens, "prompt-tokens")
@@ -716,7 +790,7 @@ def main(argv=None):
             if tracer is not None:
                 n = tracer.dump(args.trace_out)
                 print(f"chrome trace: {args.trace_out} ({n} spans)")
-            return _judge_slo(args, r, 0 if r["errors"] == 0 else 1)
+            return _judge_slo(args, r, 0 if r["errors"] == 0 else 1), r
 
         rng = np.random.RandomState(0)
         feeds = {n: rng.rand(args.rows, *dims).astype("float32")
@@ -774,7 +848,7 @@ def main(argv=None):
             n = tracer.dump(args.trace_out)
             print(f"chrome trace: {args.trace_out} ({n} spans; "
                   f"summarize with tools/paddle_cli.py trace)")
-        return _judge_slo(args, r, 0 if r["errors"] == 0 else 1)
+        return _judge_slo(args, r, 0 if r["errors"] == 0 else 1), r
     finally:
         if server is not None:
             server.close()
