@@ -169,6 +169,20 @@ REGRESSION_PCT = 0.03  # >3% drop vs the previous round is flagged loudly
 TRACE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "BENCH_trace.json")
 _WORKLOAD_T0 = [0.0]
+_TUNE_T0 = [None]  # tuner-provenance snapshot at workload start
+
+
+def _workload_start():
+    """Mark a workload boundary: the span-aggregation clock AND the tuner
+    provenance snapshot (per-record counts are diffs against this, not
+    the cumulative process window)."""
+    _WORKLOAD_T0[0] = time.monotonic()
+    try:
+        from paddle_tpu import tune
+
+        _TUNE_T0[0] = tune.provenance()
+    except Exception:
+        _TUNE_T0[0] = None
 
 
 def _workload_spans():
@@ -228,6 +242,17 @@ BARS = {
                   "static schedule exactly (ratio 1.0), with bit-equal "
                   "outputs and zero steady-state recompiles enforced "
                   "in-workload"},
+    "kernel_tuner_warm_db_contract": {
+        "field": "value", "min": 1.0,
+        "source": "ISSUE 12 acceptance: a warm TuningDB round performs "
+                  "ZERO on-chip re-measurements and reproduces the memo'd "
+                  "routing decisions bit-identically (exact hit/stale "
+                  "provenance; adopted-but-stale entries never route; on "
+                  "a non-TPU backend the routing table stays empty and "
+                  "the stock training path is byte-identical under flag "
+                  "off vs auto — the PR-4 discipline). Deterministic by "
+                  "construction: 1.0 = contract holds, any violation "
+                  "raises (value 0)"},
     "cpu_quantized_serving_qps_ratio": {
         "field": "value", "min": 0.85, "provisional": True,
         "source": "BASELINE.md quantized-CPU-serving bar: int8 closed-"
@@ -293,6 +318,24 @@ def _emit(rec):
             rec["obs"] = {"spans": spans, "trace_file": TRACE_FILE}
     except Exception:
         pass  # telemetry must never break the bench record
+    try:
+        # tuner provenance rides every record (ISSUE 12), diffed against
+        # THIS workload's start snapshot (_workload_start): hit = a
+        # warm-DB decision replayed with zero on-chip re-measurement,
+        # miss = a fresh A/B paid by this workload, stale = a dead
+        # measurement reported and routed around — so a record's counts
+        # attribute to its own workload, not the whole round so far
+        from paddle_tpu import tune
+
+        prov = tune.provenance()
+        base = _TUNE_T0[0] or {}
+        delta = {k: max(0, prov[k] - base.get(k, 0))
+                 for k in ("hits", "misses", "stale")}
+        delta["entries"] = prov["entries"]
+        if any(delta.values()):
+            rec["tune"] = delta
+    except Exception:
+        pass
     try:
         # black-box attachment (docs §19): typed event counts + the SLO
         # watchdog's evaluation ride every record, so a regressed round's
@@ -1232,6 +1275,153 @@ def bench_cpu_quantized_serving():
     })
 
 
+def _tuner_stock_byte_identity():
+    """The PR-4 discipline, re-verified against a warm DB: a small fc
+    training program's losses must be BYTE-identical under
+    pallas_dw_matmul off vs auto when autotune hydrated from a warm
+    (adopted-entries) DB on a non-TPU backend — i.e. warm entries must
+    route NOTHING here. Returns True or raises."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as ptflags
+
+    def losses():
+        with fluid.unique_name.guard():
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                x = fluid.layers.data("x", shape=[64], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                h = fluid.layers.fc(x, size=64, act="relu")
+                p = fluid.layers.fc(h, size=1)
+                loss = fluid.layers.mean(fluid.layers.square(
+                    fluid.layers.elementwise_sub(p, y)))
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss,
+                                                                startup)
+            exe = fluid.Executor(fluid.default_place())
+            scope = fluid.Scope()
+            exe.run(startup, scope=scope, seed=5)
+            rng = np.random.RandomState(1)
+            feed = {"x": rng.randn(128, 64).astype("float32"),
+                    "y": rng.randn(128, 1).astype("float32")}
+            return [np.asarray(exe.run(main_prog, feed=feed,
+                                       fetch_list=[loss],
+                                       scope=scope)[0]).tobytes()
+                    for _ in range(3)]
+
+    saved = ptflags.get_flag("pallas_dw_matmul")
+    try:
+        ptflags.set_flag("pallas_dw_matmul", "off")
+        off = losses()
+        ptflags.set_flag("pallas_dw_matmul", "auto")
+        on = losses()
+    finally:
+        ptflags.set_flag("pallas_dw_matmul", saved)
+    if off != on:
+        raise ValueError("stock path not byte-identical under flag "
+                         "off vs auto with a warm DB on a non-TPU backend")
+    return True
+
+
+def bench_tuner_contract():
+    """Tenth workload class (ISSUE 12): the persistent tuner's warm-DB
+    contract, deterministic by construction. A pre-populated TuningDB —
+    one adopted entry, one rejected entry (both recorded under THIS
+    backend/runtime), one deliberately foreign-backend entry — is
+    consulted by two independent autotune rounds. Each round must pay
+    ZERO on-chip measurements (``pallas_matmul.measure_count`` flat),
+    report exact provenance (2 hits, 1 stale, 0 misses), and derive
+    bit-identical routing decisions; the adopted entry routes ONLY on a
+    real TPU (on this CPU round the routing table must stay empty and
+    the stock training path byte-identical under flag off vs auto).
+    Value 1.0 = contract holds; any violation raises -> value 0."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from paddle_tpu import flags as ptflags
+    from paddle_tpu import tune
+    from paddle_tpu.ops import pallas_matmul
+    from paddle_tpu.ops.pallas_attention import _interpret_default
+
+    old_path = ptflags.get_flag("tune_db_path")
+    old_ro = ptflags.get_flag("tune_readonly")
+    db_path = os.path.join(tempfile.mkdtemp(prefix="bench_tune_"),
+                           "tuning.json")
+    db = tune.TuningDB(db_path)
+    shapes = [(256, 128, 512), (128, 256, 512), (512, 512, 1024)]
+    db.put("dw_matmul", shapes[0], "float32", decision="adopt",
+           config={"strategy": "direct", "blocks": None},
+           baseline_ms=1.0, best_ms=0.80, source="bench tuner-contract")
+    db.put("dw_matmul", shapes[1], "float32", decision="reject",
+           baseline_ms=1.0, best_ms=0.99, source="bench tuner-contract")
+    db.put("dw_matmul", shapes[2], "float32", decision="adopt",
+           config={"strategy": "transpose", "blocks": None},
+           baseline_ms=1.0, best_ms=0.70, source="bench tuner-contract",
+           backend="tuner-contract-foreign", runtime="jaxlib-0.0.0")
+    db.save()
+
+    def one_round():
+        pallas_matmul.reset_autotune()
+        tune.configure(path=db_path, readonly=True)
+        plan = pallas_matmul.autotune(shapes, dtype=jnp.float32,
+                                      verbose=False)
+        prov = tune.provenance()
+        # the memo'd decision map, re-derived from the DB itself (pure —
+        # no counters touched): what "bit-identical" is judged against
+        db2 = tune.get_db()
+        decisions = {}
+        for s in shapes:
+            ent, status = db2.lookup("dw_matmul", s, "float32")
+            decisions["x".join(map(str, s))] = (
+                status, ent["decision"] if ent else None,
+                json.dumps((ent or {}).get("config"), sort_keys=True))
+        return plan, prov, decisions
+
+    try:
+        m0 = pallas_matmul.measure_count
+        plan_a, prov_a, dec_a = one_round()
+        plan_b, prov_b, dec_b = one_round()
+        if pallas_matmul.measure_count != m0:
+            raise ValueError(
+                f"warm-DB autotune re-measured on chip "
+                f"({pallas_matmul.measure_count - m0} slope windows)")
+        if plan_a != plan_b or dec_a != dec_b:
+            raise ValueError("warm-DB routing decisions were not "
+                             "bit-identical across rounds")
+        for prov in (prov_a, prov_b):
+            got = (prov["hits"], prov["stale"], prov["misses"])
+            if got != (2, 1, 0):
+                raise ValueError(
+                    f"provenance mismatch: hits/stale/misses {got}, "
+                    f"expected (2, 1, 0)")
+        interp = _interpret_default()
+        expected_plan = {} if interp else {shapes[0]: ("direct", None)}
+        if plan_a != expected_plan:
+            raise ValueError(
+                f"routing table {plan_a} != expected {expected_plan} "
+                f"(interpret={interp}); adopted-but-stale or non-TPU "
+                f"entries must never route")
+        byte_identical = _tuner_stock_byte_identity() if interp else None
+    finally:
+        ptflags.set_flag("tune_db_path", old_path)
+        ptflags.set_flag("tune_readonly", old_ro)
+        tune.configure()  # reopen the round's real DB, reset the window
+        pallas_matmul.reset_autotune()
+    _emit({
+        "metric": "kernel_tuner_warm_db_contract",
+        "value": 1.0,
+        "unit": "x",
+        "remeasurements": 0,
+        "provenance_per_round": {"hits": 2, "stale": 1, "misses": 0},
+        "routing_decisions": dec_a,
+        "routed_plan": {"x".join(map(str, s)): list(v)
+                        for s, v in plan_a.items()},
+        "stock_path_byte_identical": byte_identical,
+        "db": db_path,
+        "config": {"entries": 3, "adopted": 1, "rejected": 1, "stale": 1,
+                   "rounds": 2},
+    })
+
+
 def bench_sharded_serving():
     """Eighth workload class (ISSUE 8): run the sharded A/B in a child
     process that forces an 8-virtual-device host platform, then re-emit
@@ -1263,11 +1453,19 @@ def bench_sharded_serving():
 
 
 def main():
+    from paddle_tpu import flags as ptflags
     from paddle_tpu import obs
     from paddle_tpu.obs import SLO, SLOWatchdog, get_event_log, get_registry
 
     obs.enable()
     obs.get_tracer().clear()
+    # warm the kernel tuner across rounds (ISSUE 12): the repo-local
+    # TUNE_DB.json (which `tools/perf_lab.py tune` also populates) answers
+    # _maybe_tune_dw's autotune with ZERO on-chip re-measurement once a
+    # round has recorded its verdicts; an explicit flag always wins
+    if not ptflags.is_set("tune_db_path"):
+        ptflags.set_flag("tune_db_path", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "TUNE_DB.json"))
     # the black box rides every round: typed events (sheds, NaN sentinels,
     # chaos) + an SLO watchdog whose summary lands in each record. The one
     # declared bench SLO is a train-MFU sanity floor — a round whose MFU
@@ -1310,15 +1508,17 @@ def main():
              "sharded_serving_qps_per_chip", "x"),
             (bench_cpu_quantized_serving,
              "cpu_quantized_serving_qps_ratio", "x"),
+            (bench_tuner_contract,
+             "kernel_tuner_warm_db_contract", "x"),
     ):
         try:
-            _WORKLOAD_T0[0] = time.monotonic()
+            _workload_start()
             bench_fn()
         except Exception as e:  # the flagship line must survive any failure
             _emit({"metric": metric, "value": 0.0, "unit": unit,
                    "error": str(e)[:200]})
     try:
-        _WORKLOAD_T0[0] = time.monotonic()
+        _workload_start()
         bench_resnet()
     except Exception as e:
         _emit({"metric": "resnet50_train_images_per_sec_per_chip",

@@ -225,6 +225,12 @@ def build_step_fn(program: Program, block_idx: int, feed_names, fetch_names,
     hand in details/multi_devices_graph_builder.cc).
     Returns (step, readonly_names, donated_names, state_out_names).
     """
+    # open the flags-configured tuning DB (if any) BEFORE tracing: the op
+    # kernels consult it at lowering time (registry.tuned_op_config /
+    # pallas_matmul._PLAN), and a warm DB must answer the first trace too
+    from .. import tune
+
+    tune.ensure_loaded()
     state_in_names, state_out_names = _collect_block_io(program, block_idx, feed_names)
     donated_names = [n for n in state_in_names if n in set(state_out_names)]
     readonly_names = [n for n in state_in_names if n not in set(donated_names)]

@@ -135,6 +135,25 @@ def register_op(
     return deco
 
 
+def tuned_op_config(op_type: str, shape, dtype: str):
+    """Lowering-time tuning-DB consultation for op kernels (PR 12): the
+    adopted config for ``op_type × shape-bucket × dtype`` on the CURRENT
+    backend+runtime, or None (miss / stale / rejected — the stock
+    schedule stands). This is the op registry's side of the tuner
+    contract: kernels ask here while tracing, so a warm DB routes them
+    with zero on-chip re-measurement and a broken DB can only ever mean
+    "untuned", never "untraceable"."""
+    try:
+        from .. import tune
+
+        ent, status = tune.lookup(op_type, shape, dtype)
+        if status == "hit" and ent.get("decision") == "adopt":
+            return ent.get("config") or None
+    except Exception:
+        pass
+    return None
+
+
 def get_op_def(type: str) -> OpDef:
     if type not in _REGISTRY:
         raise KeyError(f"op {type!r} is not registered")

@@ -89,6 +89,15 @@ _DEFAULTS: Dict[str, Any] = {
     # pins affinity at the current/default width.
     "cpu_threads": 0,
     "cpu_pin": False,
+    # persistent kernel-tuning database (paddle_tpu/tune, docs/design.md
+    # §21): tune_db_path points the process at an on-disk TuningDB ("" = a
+    # process-local in-memory DB). Warm entries route kernels with ZERO
+    # on-chip re-measurement; stale entries (backend/jaxlib mismatch) are
+    # reported via pt_tune_* and fall back to stock paths. tune_readonly
+    # consults but never writes (bench contract rounds, serving replicas
+    # on shared storage).
+    "tune_db_path": "",
+    "tune_readonly": False,
 }
 
 _flags: Dict[str, Any] = {}

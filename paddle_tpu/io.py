@@ -400,6 +400,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     vars = [v for v in program.list_vars()
             if v.persistable and (v.name in referenced)]
     save_vars(executor, dirname, program, vars=vars, scope=scope)
+    # a serving export travels with the tuning DB that shaped it (docs
+    # §21): serving engines merge this tuned.json on start. Best-effort;
+    # no entries (or a broken DB) simply means no bundle.
+    try:
+        from . import tune
+
+        tune.save_bundle(dirname)
+    except Exception:
+        pass
     return fetch_names
 
 
@@ -550,6 +559,17 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
     save_persistables(executor, cur, main_program, scope=scope)
     for table in (host_tables or []):
         table.save(_host_table_dir(cur, table.name, jax.process_index()))
+    if jax.process_index() == 0:
+        # the tuning DB travels with the checkpoint (docs/design.md §21):
+        # bundle the active entries BEFORE the manifest so the digest
+        # covers them; chief-only — the DB is process-global state, not a
+        # per-host shard. Best-effort: a broken DB must not fail a save.
+        try:
+            from . import tune
+
+            tune.save_bundle(cur)
+        except Exception:
+            pass
     if jax.process_count() > 1:
         # every host must finish its shard writes before the chief marks the
         # checkpoint complete (<- pservers each checkpointing their shard,
@@ -665,6 +685,15 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
                 f"save (host-table shards are per-process and do not "
                 f"reshard — resume with the saved process count, then "
                 f"resize)") from e
+    # hydrate the tuning service from the checkpoint's bundled tuned.json
+    # (if any): resuming on a different backend/jaxlib merges the entries
+    # as STALE — reported via pt_tune_stale_entries, never routed
+    try:
+        from . import tune
+
+        tune.load_bundled(cur)
+    except Exception:
+        pass
     return serial
 
 

@@ -685,7 +685,8 @@ def random_crop(x, shape, seed=None, name: Optional[str] = None):
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    q_block: int = 512, k_block: int = 512,
+                    q_block: Optional[int] = None,
+                    k_block: Optional[int] = None,
                     heads_per_block: Optional[int] = None,
                     name: Optional[str] = None):
     """Fused attention over [N, T, H, D] tensors (Pallas kernel on TPU,
@@ -693,7 +694,10 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     had no attention op at all — its transformer benchmark composed
     matmul+softmax (test_parallel_executor_transformer.py); this is the
     TPU-native fusion of that pattern. ``heads_per_block`` overrides the
-    small-head packing (default 128//d_head, VMEM-clamped)."""
+    small-head packing (default 128//d_head, VMEM-clamped). Block knobs
+    left None are a TUNABLE surface: the kernel resolves them through the
+    persistent tuning DB on TPU (docs/design.md §21) and falls back to the
+    512/512 defaults; an explicit value pins the schedule exactly."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     # per-query logsumexp saved for the FlashAttention-2 backward kernels

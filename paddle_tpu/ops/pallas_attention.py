@@ -28,6 +28,14 @@ from ..core.registry import register_op
 
 _NEG_INF = -1e30
 
+# the pre-PR-12 fixed schedule: one 512-token q/k block pair. Still the
+# fallback everywhere; since PR 12 the knobs are a TUNABLE SURFACE — any
+# knob left None is filled from the persistent TuningDB (resolve below),
+# which `tools/perf_lab.py tune` populates from measured sweeps targeting
+# the probe_fa_gap short-sequence gap (the ~3x small-grid tax at T=1024).
+DEFAULT_Q_BLOCK = 512
+DEFAULT_K_BLOCK = 512
+
 
 def _interpret_default():
     # interpret anywhere except a real TPU (jax.default_device overrides
@@ -99,6 +107,80 @@ def _causal_hi(qi, q_block, block_k, n_blocks):
 
 
 # ---------------------------------------------------------------------------
+# tunable schedule surface (PR 12): q_block × k_block × heads_per_block
+# ---------------------------------------------------------------------------
+
+
+def flash_key(t, h, d):
+    """The flash kernels' TuningDB shape bucket: (T, H, D), batch-free —
+    block/pack viability and the per-cell schedule depend on the sequence
+    layout, not on how many (batch × head) grid rows repeat it."""
+    return (int(t), int(h), int(d))
+
+
+def resolve_flash_config(t, h, d, dtype, q_block=None, k_block=None,
+                         heads_per_block=None):
+    """Fill unpinned (None) flash schedule knobs from the tuning DB.
+
+    Explicit choices always win (the pre-PR-12 contract: a caller-pinned
+    q_block is honored exactly; ``heads_per_block="auto"`` is the explicit
+    spelling of the `_heads_per_block` auto-pack, for callers — the
+    probe_fa_gap baseline — that must pin the DEFAULT schedule rather than
+    leave the knob tunable). On a non-TPU backend nothing is consulted
+    and the 512/512/auto defaults apply, so CPU programs are byte-identical
+    with or without a warm DB — only a fresh, adopted, current-backend
+    entry (written by `perf_lab.py tune` on a measured >5% win) changes
+    the schedule. Returns ``(q_block, k_block, heads_per_block)`` with
+    ``heads_per_block`` possibly None (= auto-pack)."""
+    explicit_auto = heads_per_block == "auto"
+    if explicit_auto:
+        heads_per_block = None
+    if (q_block is None or k_block is None
+            or (heads_per_block is None and not explicit_auto)) \
+            and not _interpret_default():
+        from ..core.registry import tuned_op_config
+
+        cfg = tuned_op_config("flash_attention", flash_key(t, h, d),
+                              str(jnp.dtype(dtype))) or {}
+
+        def tuned_int(name):
+            # a hand-edited DB value that isn't a positive int must mean
+            # "untuned", not a TypeError inside _fit_block at trace time
+            v = cfg.get(name)
+            return int(v) if isinstance(v, int) and v > 0 else None
+
+        if q_block is None:
+            q_block = tuned_int("q_block")
+        if k_block is None:
+            k_block = tuned_int("k_block")
+        if heads_per_block is None and not explicit_auto:
+            heads_per_block = tuned_int("heads_per_block")
+    return (q_block or DEFAULT_Q_BLOCK, k_block or DEFAULT_K_BLOCK,
+            heads_per_block)
+
+
+def flash_candidates(t, h, d):
+    """The sweep's search space over the flash schedule surface: aligned
+    (q_block, k_block) pairs dividing T × viable head packs (power-of-two
+    divisors of H under the dkv backward's VMEM budget — the same 4 MB
+    full-T bound ``_heads_per_block`` backs off on). Deterministic order;
+    the 512/512/auto default is the baseline, not a member."""
+    blocks = [blk for blk in (128, 256, 512, 1024)
+              if blk <= t and t % blk == 0]
+    if not blocks:
+        fb = _fit_block(t, DEFAULT_Q_BLOCK)
+        blocks = [fb] if fb else []
+    hpbs, hpb = [], 1
+    while hpb <= h:
+        if h % hpb == 0 and (hpb == 1
+                             or hpb * t * d * 2 * 4 <= 4 * 1024 * 1024):
+            hpbs.append(hpb)
+        hpb *= 2
+    return [{"q_block": qb, "k_block": kb, "heads_per_block": hb}
+            for qb in blocks for kb in blocks for hb in hpbs]
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
@@ -155,13 +237,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None,
-                        q_block=512, k_block=512, interpret=None,
+                        q_block=None, k_block=None, interpret=None,
                         return_lse=False, heads_per_block=None):
-    """q,k,v: [B, T, H, D] -> out [B, T, H, D] (and lse [B, T, H])."""
+    """q,k,v: [B, T, H, D] -> out [B, T, H, D] (and lse [B, T, H]).
+    ``q_block``/``k_block``/``heads_per_block`` left None resolve through
+    the tuning DB (TPU only) and fall back to the 512/512/auto defaults."""
     b, t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _interpret_default()
+    q_block, k_block, heads_per_block = resolve_flash_config(
+        t, h, d, q.dtype, q_block, k_block, heads_per_block)
     q_block = _fit_block(t, q_block)
     k_block = _fit_block(t, k_block)
     if q_block is None or k_block is None:
@@ -327,16 +413,20 @@ def _dense_bwd_with_lse(q, k, v, out, lse, do, causal, sc):
 
 
 def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
-                        q_block=512, k_block=512, interpret=None,
+                        q_block=None, k_block=None, interpret=None,
                         heads_per_block=None):
     """FlashAttention-2 backward. All of q/k/v/out/do: [B, T, H, D];
     lse: [B, T, H]. Returns (dq, dk, dv). The provided lse is honored as-is
     (it may be a globally-merged ring LSE), including in the ragged-shape
-    dense fallback."""
+    dense fallback. None knobs resolve like the forward's (the lse is a
+    per-query scalar whose [n_q, q_block] staging is a pure reshape, so
+    fwd and bwd need not even agree on blocks to stay correct)."""
     b, t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _interpret_default()
+    q_block, k_block, heads_per_block = resolve_flash_config(
+        t, h, d, q.dtype, q_block, k_block, heads_per_block)
     q_block = _fit_block(t, q_block)
     k_block = _fit_block(t, k_block)
     if q_block is None or k_block is None:
@@ -459,14 +549,14 @@ def flash_attention_op(ctx, ins, attrs):
         # if the no-outside-reader assumption is ever violated the consumer
         # fails loudly instead of silently computing with zeros.
         out = flash_attention(q, k, v, causal, scale,
-                              attrs.get("q_block", 512),
-                              attrs.get("k_block", 512),
+                              attrs.get("q_block"),
+                              attrs.get("k_block"),
                               attrs.get("heads_per_block"))
         lse = lax.stop_gradient(jnp.full(q.shape[:3], jnp.nan, jnp.float32))
         return {"Out": [out], "LSE": [lse]}
     out, lse = flash_attention_fwd(
         q, k, v, causal=causal, scale=scale,
-        q_block=attrs.get("q_block", 512), k_block=attrs.get("k_block", 512),
+        q_block=attrs.get("q_block"), k_block=attrs.get("k_block"),
         return_lse=True, heads_per_block=attrs.get("heads_per_block"),
     )
     return {"Out": [out], "LSE": [lse]}
@@ -489,8 +579,8 @@ def flash_attention_grad_op(ctx, ins, attrs):
     else:
         gq, gk, gv = flash_attention_bwd(
             q, k, v, out, lse, g, causal=causal, scale=scale,
-            q_block=attrs.get("q_block", 512),
-            k_block=attrs.get("k_block", 512),
+            q_block=attrs.get("q_block"),
+            k_block=attrs.get("k_block"),
             heads_per_block=attrs.get("heads_per_block"))
     return {"Q@GRAD": [gq], "K@GRAD": [gk], "V@GRAD": [gv]}
 
@@ -504,9 +594,10 @@ def flash_attention_grad_op(ctx, ins, attrs):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=False, scale=None, q_block=512,
-                    k_block=512, heads_per_block=None):
-    """Differentiable flash attention over [B, T, H, D] (jax.grad-ready)."""
+def flash_attention(q, k, v, causal=False, scale=None, q_block=None,
+                    k_block=None, heads_per_block=None):
+    """Differentiable flash attention over [B, T, H, D] (jax.grad-ready).
+    None block knobs resolve through the tuning DB, else 512/512/auto."""
     return flash_attention_fwd(q, k, v, causal=causal, scale=scale,
                                q_block=q_block, k_block=k_block,
                                heads_per_block=heads_per_block)

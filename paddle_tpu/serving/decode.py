@@ -160,6 +160,12 @@ class DecodeEngine:
             decode_roles
 
         self.dirname = dirname
+        # merge the export's bundled tuned.json before anything traces —
+        # same contract as ServingEngine (docs/design.md §21): stale
+        # entries reported, never routed; corrupt bundle = counted error
+        from .. import tune
+
+        self.tune_bundle = tune.load_bundled(dirname)
         self._place = place or default_place()
         self._device = self._place.jax_device()
         self.scope = Scope()

@@ -156,6 +156,15 @@ class ServingEngine:
         from ..core.types import default_place
 
         self.dirname = dirname
+        # the export travels with its tuning DB (docs/design.md §21):
+        # merge the bundled tuned.json BEFORE the program freezes, so the
+        # lowering-time consultations below hit warm entries; entries
+        # recorded under another backend/jaxlib merge as stale — counted
+        # in pt_tune_stale_entries, never routed — and a corrupt bundle is
+        # a counted load error, never a failed engine start
+        from .. import tune
+
+        self.tune_bundle = tune.load_bundled(dirname)
         self.batch_buckets = tuple(sorted(batch_buckets)) if batch_buckets \
             else _pow2_ladder(int(max_batch_size))
         # the ladder IS the contract: a custom ladder caps (or raises) the

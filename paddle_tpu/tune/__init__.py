@@ -1,0 +1,29 @@
+"""paddle_tpu.tune — the persistent kernel autotuner as a framework service.
+
+docs/design.md §21. Two layers:
+
+* ``db`` — ``TuningDB``: the schema-versioned on-disk store (op ×
+  shape-bucket × dtype × backend × runtime keys; measured slopes, margins,
+  adopt/reject provenance; last-write-wins merge; typed corrupt refusal).
+* ``service`` — the process-global instance op kernels consult at lowering
+  time (``lookup``), sweeps write through (``record``), and artifacts
+  travel with (``save_bundle``/``load_bundled``), instrumented as
+  ``pt_tune_*``.
+
+Populated offline by ``tools/perf_lab.py tune`` (the search sweep) and
+online by ``pallas_matmul.autotune`` misses; inspected by
+``tools/paddle_cli.py tune``.
+"""
+from .db import (BUNDLE_NAME, SCHEMA_VERSION, TuningDB,  # noqa: F401
+                 TuningDBError, backend_signature, make_key,
+                 runtime_signature)
+from .service import (bundle_path, configure, ensure_loaded,  # noqa: F401
+                      flush, get_db, load_bundled, lookup, provenance,
+                      record, reset, save_bundle)
+
+__all__ = [
+    "BUNDLE_NAME", "SCHEMA_VERSION", "TuningDB", "TuningDBError",
+    "backend_signature", "bundle_path", "configure", "ensure_loaded",
+    "flush", "get_db", "load_bundled", "lookup", "make_key", "provenance",
+    "record", "reset", "runtime_signature", "save_bundle",
+]

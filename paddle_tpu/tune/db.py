@@ -1,0 +1,351 @@
+"""TuningDB: the persistent, schema-versioned kernel-tuning database.
+
+PR 4 proved the per-shape on-chip A/B (``pallas_matmul.autotune``) but kept
+its memo process-local: every warm bench round re-paid the measurement, the
+memo covered exactly one kernel, and the r4/r5 "ledger of negatives" in
+docs/perf.md was enumerated by hand. This module turns that memo into
+framework infrastructure (ROADMAP item 3; the CUDA-L2 line of PAPERS.md —
+systematic search beating vendor lowerings — needs somewhere durable to put
+what the search learned):
+
+* one **key** per decision — ``op × shape-bucket × dtype × backend ×
+  runtime-version`` (the five things that invalidate a kernel measurement);
+* one **entry** per key carrying the measured slopes for every candidate,
+  the chosen config, the win margin, and decision provenance (who measured
+  it, when, adopt or reject) — the rejects ARE the ledger of negatives,
+  generated instead of hand-kept;
+* **staleness is structural**: an entry recorded under another backend or
+  jaxlib is found (so it can be reported) but never routed — dead
+  measurements fall back to stock paths, loudly via the ``pt_tune_*``
+  instruments (tune/service.py);
+* **durability discipline matches io.py**: atomic tmp+replace publishes, a
+  corrupt or alien-schema file is a typed ``TuningDBError`` (an ``IOError``,
+  like the checkpoint-manifest refusal) — routing kernels off garbage is
+  the one thing this must never do;
+* **concurrent writers merge last-write-wins**: ``save()`` re-reads the
+  file and merges by ``updated_at``, so two sweep processes sharing a DB
+  path lose nothing but ties.
+
+The DB travels with artifacts: ``io.save_checkpoint`` and
+``io.save_inference_model`` bundle the active entries as ``tuned.json``
+(service.save_bundle), and every serving engine merges a bundled DB on
+start — a tuned model carries its tuning to the machine that serves it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: bump when the entry layout changes; ``_migrate`` must learn the upgrade
+SCHEMA_VERSION = 1
+
+#: the artifact-travel filename (checkpoint dirs, serving exports)
+BUNDLE_NAME = "tuned.json"
+
+_DECISIONS = ("adopt", "reject")
+#: fields every entry must carry to be trusted (corrupt-file refusal)
+_REQUIRED_FIELDS = ("op", "shape", "dtype", "backend", "runtime", "decision")
+
+
+class TuningDBError(IOError):
+    """Typed refusal: unreadable, corrupt, or alien-schema tuning DB (the
+    checkpoint-manifest IOError discipline — never route on garbage)."""
+
+
+def backend_signature() -> str:
+    """Platform the process's computations land on — the same question
+    ``pallas_attention._interpret_default`` asks, answered as a key field:
+    a 'tpu' entry consulted on CPU is stale, not wrong."""
+    try:
+        import jax
+
+        dev = jax.config.jax_default_device
+        return dev.platform if dev is not None else jax.default_backend()
+    except Exception:  # pragma: no cover - jax must exist, but never raise
+        return "unknown"
+
+
+def runtime_signature() -> str:
+    """The jaxlib the measurements were made under: a new XLA can reshuffle
+    which lowering wins, so entries are version-scoped, not forever."""
+    try:
+        import jaxlib
+
+        return "jaxlib-" + getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # pragma: no cover
+        return "unknown"
+
+
+def _shape_str(shape: Sequence[int]) -> str:
+    return "x".join(str(int(d)) for d in shape)
+
+
+def publish_entries(path: str, entries: Dict[str, dict]) -> str:
+    """THE schema-v1 publish: atomic tmp+``os.replace`` of
+    ``{"schema": N, "entries": ...}`` — shared by ``TuningDB.save`` and
+    the artifact bundles (service.save_bundle), so the two on-disk forms
+    can never silently diverge. The tmp name is UNIQUE per writer
+    (mkstemp in the target dir): the concurrent-writer promise above is
+    only as good as two processes never truncating each other's
+    half-written tmp file."""
+    import tempfile
+
+    payload = {"schema": SCHEMA_VERSION, "entries": entries}
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+        dir=os.path.dirname(os.path.abspath(path)) or ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return path
+
+
+def make_key(op: str, shape: Sequence[int], dtype: str,
+             backend: Optional[str] = None,
+             runtime: Optional[str] = None) -> str:
+    """The ONE key normalization: ``op|MxNxK|dtype|backend|runtime``.
+    Shape buckets are op-defined (dW keys are exact (m, n, k); flash keys
+    are batch-free (t, h, d) — config viability doesn't depend on batch);
+    backend/runtime default to the current process's signatures."""
+    return "|".join((
+        str(op), _shape_str(shape), str(dtype),
+        backend_signature() if backend is None else str(backend),
+        runtime_signature() if runtime is None else str(runtime)))
+
+
+def _fresh_prefix(op: str, shape: Sequence[int], dtype: str) -> str:
+    return "|".join((str(op), _shape_str(shape), str(dtype))) + "|"
+
+
+def _validate_entries(entries: Any, where: str) -> Dict[str, dict]:
+    if not isinstance(entries, dict):
+        raise TuningDBError(f"corrupt tuning DB {where}: entries must be an "
+                            f"object, got {type(entries).__name__}")
+    for key, ent in entries.items():
+        if not isinstance(ent, dict):
+            raise TuningDBError(f"corrupt tuning DB {where}: entry {key!r} "
+                                f"is not an object")
+        missing = [f for f in _REQUIRED_FIELDS if f not in ent]
+        if missing:
+            raise TuningDBError(f"corrupt tuning DB {where}: entry {key!r} "
+                                f"lacks {missing}")
+        if ent["decision"] not in _DECISIONS:
+            raise TuningDBError(f"corrupt tuning DB {where}: entry {key!r} "
+                                f"decision {ent['decision']!r} not in "
+                                f"{_DECISIONS}")
+    return entries
+
+
+def lookup_entries(entries: Dict[str, dict], op: str, shape: Sequence[int],
+                   dtype: str) -> Tuple[Optional[dict], str]:
+    """The ONE key-matching rule, over any entry dict (``TuningDB.lookup``
+    and the service's bundle overlay share it): exact five-part key match
+    = 'hit'; same op × shape × dtype under another backend/runtime =
+    'stale'; else 'miss'."""
+    key = make_key(op, shape, dtype)
+    ent = entries.get(key)
+    if ent is not None:
+        return ent, "hit"
+    prefix = _fresh_prefix(op, shape, dtype)
+    for k in sorted(entries):
+        if k.startswith(prefix):
+            return entries[k], "stale"
+    return None, "miss"
+
+
+class TuningDB:
+    """On-disk (or in-memory when ``path`` is None) tuning database."""
+
+    def __init__(self, path: Optional[str] = None, readonly: bool = False):
+        self.path = path
+        self.readonly = bool(readonly)
+        self.entries: Dict[str, dict] = {}
+        if path and os.path.exists(path):
+            self.entries = self._read(path)
+
+    # -- persistence --
+    @staticmethod
+    def _read(path: str) -> Dict[str, dict]:
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except ValueError as e:
+            raise TuningDBError(f"corrupt tuning DB {path!r}: not valid "
+                                f"JSON ({e})")
+        except OSError as e:
+            raise TuningDBError(f"unreadable tuning DB {path!r}: {e}")
+        return TuningDB._migrate(raw, path)
+
+    @staticmethod
+    def _migrate(raw: Any, where: str) -> Dict[str, dict]:
+        """Upgrade any known on-disk layout to the current in-memory form.
+
+        schema 0 — the PR-4-era ad-hoc memo dump: a flat ``{key: entry}``
+        object with no ``schema`` wrapper; entries may lack backend/runtime
+        fields, which migrate to ``"unknown"`` (structurally stale: a
+        measurement whose backend nobody recorded must never route).
+        schema 1 — ``{"schema": 1, "entries": {...}}``.
+        A schema NEWER than this build refuses loudly: silently reading a
+        future layout is how dead measurements route kernels."""
+        if not isinstance(raw, dict):
+            raise TuningDBError(f"corrupt tuning DB {where}: top level must "
+                                f"be an object, got {type(raw).__name__}")
+        if "schema" not in raw:
+            # schema-0 legacy: flat {key: entry}; normalize in place
+            entries = {}
+            for key, ent in raw.items():
+                if not isinstance(ent, dict):
+                    raise TuningDBError(
+                        f"corrupt tuning DB {where}: legacy entry {key!r} "
+                        f"is not an object")
+                ent = dict(ent)
+                ent.setdefault("backend", "unknown")
+                ent.setdefault("runtime", "unknown")
+                ent.setdefault("updated_at", 0.0)
+                ent.setdefault("source", "schema-0 migration")
+                entries[key] = ent
+            return _validate_entries(entries, where)
+        schema = raw.get("schema")
+        if not isinstance(schema, int) or schema < 0:
+            raise TuningDBError(f"corrupt tuning DB {where}: schema "
+                                f"{schema!r} is not a version number")
+        if schema > SCHEMA_VERSION:
+            raise TuningDBError(
+                f"tuning DB {where} has schema {schema}, this build reads "
+                f"<= {SCHEMA_VERSION}; refusing to guess at a future layout")
+        return _validate_entries(raw.get("entries", {}), where)
+
+    def save(self, merge: bool = True) -> Optional[str]:
+        """Publish the DB atomically, merging concurrent writers.
+
+        Last-write-wins at entry granularity: the file's current entries
+        are re-read and merged by ``updated_at`` (our in-memory entries win
+        ties — they were explicitly put), then the union is tmp+replace
+        published. Two processes writing disjoint keys both survive; the
+        same key resolves to the newer measurement. ``merge=False``
+        overwrites instead — the DELETION publish (``prune_stale`` means
+        the removal, so the union must not resurrect what it dropped).
+        No-op for in-memory DBs; a readonly DB refuses with the typed
+        error."""
+        if self.readonly:
+            raise TuningDBError("tuning DB is readonly (tune_readonly)")
+        if not self.path:
+            return None
+        # the read-merge-publish below is a lost-update window without
+        # cross-process exclusion: two writers that both _read() before
+        # either replaces would drop each other's disjoint keys. An
+        # advisory flock on a sidecar closes it; best-effort (NFS-ish
+        # filesystems may refuse — then the window is merely narrow again)
+        lockfd = None
+        try:
+            import fcntl
+
+            lockfd = os.open(self.path + ".lock",
+                             os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(lockfd, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            if lockfd is not None:
+                os.close(lockfd)
+                lockfd = None
+        try:
+            if merge and os.path.exists(self.path):
+                try:
+                    current = self._read(self.path)
+                except TuningDBError:
+                    # the bytes on disk are already garbage; refusing to
+                    # save would hold fresh measurements hostage to them
+                    current = {}
+                merged = dict(current)
+                for key, ent in self.entries.items():
+                    cur = merged.get(key)
+                    if cur is None or (ent.get("updated_at", 0.0)
+                                       >= cur.get("updated_at", 0.0)):
+                        merged[key] = ent
+                self.entries = merged
+            return publish_entries(self.path, self.entries)
+        finally:
+            if lockfd is not None:
+                os.close(lockfd)  # closing releases the flock
+
+    # -- entries --
+    def put(self, op: str, shape: Sequence[int], dtype: str, decision: str,
+            config: Optional[Dict[str, Any]] = None,
+            baseline_ms: Optional[float] = None,
+            best_ms: Optional[float] = None,
+            slopes: Optional[Dict[str, float]] = None, source: str = "",
+            backend: Optional[str] = None, runtime: Optional[str] = None,
+            updated_at: Optional[float] = None) -> str:
+        """Record one measured decision; returns its key. ``decision`` is
+        'adopt' (``config`` names the winning kernel/schedule) or 'reject'
+        (the negative: stock stands, and the slopes say by how much)."""
+        if decision not in _DECISIONS:
+            raise ValueError(f"decision must be one of {_DECISIONS}, "
+                             f"got {decision!r}")
+        if decision == "adopt" and not config:
+            raise ValueError("an adopt entry must carry the adopted config")
+        backend = backend_signature() if backend is None else str(backend)
+        runtime = runtime_signature() if runtime is None else str(runtime)
+        key = make_key(op, shape, dtype, backend, runtime)
+        margin = None
+        if baseline_ms and best_ms:
+            margin = round(float(best_ms) / float(baseline_ms), 4)
+        self.entries[key] = {
+            "op": str(op), "shape": [int(d) for d in shape],
+            "dtype": str(dtype), "backend": backend, "runtime": runtime,
+            "decision": decision, "config": config,
+            "baseline_ms": baseline_ms, "best_ms": best_ms,
+            "margin": margin, "slopes": slopes or {}, "source": source,
+            "updated_at": float(time.time() if updated_at is None
+                                else updated_at),
+        }
+        return key
+
+    def lookup(self, op: str, shape: Sequence[int],
+               dtype: str) -> Tuple[Optional[dict], str]:
+        """``(entry, status)`` for the current backend/runtime.
+
+        'hit' — a fresh entry (exact five-part key match): route on it with
+        zero re-measurement. 'stale' — an entry exists for this op × shape
+        × dtype but was measured under another backend or runtime: report
+        it, never route it. 'miss' — nothing recorded."""
+        return lookup_entries(self.entries, op, shape, dtype)
+
+    def is_stale(self, entry: dict) -> bool:
+        return (entry.get("backend") != backend_signature()
+                or entry.get("runtime") != runtime_signature())
+
+    def stale_entries(self) -> List[str]:
+        return [k for k, e in self.entries.items() if self.is_stale(e)]
+
+    def prune_stale(self) -> int:
+        """Drop every backend/runtime-mismatched entry; returns the count.
+        (``paddle_cli tune --prune-stale`` — dead measurements are clutter
+        once the mismatch is understood.)"""
+        stale = self.stale_entries()
+        for k in stale:
+            del self.entries[k]
+        return len(stale)
+
+    def merge(self, entries: Dict[str, dict]) -> int:
+        """Merge foreign entries (a bundled ``tuned.json``) last-write-wins
+        by ``updated_at``; returns how many landed."""
+        n = 0
+        for key, ent in _validate_entries(entries, "<merge>").items():
+            cur = self.entries.get(key)
+            if cur is None or (ent.get("updated_at", 0.0)
+                               > cur.get("updated_at", 0.0)):
+                self.entries[key] = dict(ent)
+                n += 1
+        return n
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def items(self) -> Iterable[Tuple[str, dict]]:
+        return sorted(self.entries.items())

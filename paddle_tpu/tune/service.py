@@ -1,0 +1,279 @@
+"""The process-global tuning service: what op kernels consult at lowering.
+
+One active ``TuningDB`` per process, opened from ``flags.tune_db_path``
+("" = a process-local in-memory DB) the first time anything asks.  Every
+consultation is counted — hit / miss / stale — twice: as plain provenance
+ints (``provenance()``, reset by ``configure``; bench records attach them
+per workload) and as the cumulative ``pt_tune_*`` Prometheus instruments,
+so a serving replica routing on dead measurements is visible from /metrics
+before anyone reads a log.
+
+The service is deliberately boring about failure: a corrupt DB at the
+flagged path raises the typed ``TuningDBError`` exactly once per open
+attempt for callers that asked for the DB (``get_db``), while the hot-path
+helpers (``lookup``, ``load_bundled``, ``ensure_loaded``) swallow it into
+``pt_tune_load_errors_total`` and answer "miss" — lowering must never die
+because a side file rotted, it must just stop being tuned.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .db import (BUNDLE_NAME, TuningDB, TuningDBError, lookup_entries,
+                 publish_entries)
+
+_lock = threading.RLock()
+_state: Dict[str, Any] = {"db": None, "path": None, "error": None,
+                          "bundled": {}, "hits": 0, "misses": 0,
+                          "stale": 0, "load_errors": 0}
+_instruments: Dict[str, Any] = {}
+
+_STATUS_FIELD = {"hit": "hits", "miss": "misses", "stale": "stale"}
+
+
+def _metrics() -> Dict[str, Any]:
+    if not _instruments:
+        from ..obs import get_registry
+
+        r = get_registry()
+        _instruments.update(
+            hits=r.counter("pt_tune_hits_total",
+                           "Tuning-DB lookups answered by a fresh entry "
+                           "(zero on-chip re-measurement)"),
+            misses=r.counter("pt_tune_misses_total",
+                             "Tuning-DB lookups with nothing recorded"),
+            stale=r.counter("pt_tune_stale_total",
+                            "Tuning-DB lookups that found only a backend/"
+                            "runtime-mismatched entry (stock-path "
+                            "fallback)"),
+            load_errors=r.counter("pt_tune_load_errors_total",
+                                  "Corrupt/alien tuning DBs or bundles "
+                                  "refused at load"),
+            entries=r.gauge("pt_tune_entries",
+                            "Entries in the active tuning DB"),
+            stale_entries=r.gauge("pt_tune_stale_entries",
+                                  "Active-DB entries recorded under another "
+                                  "backend/runtime (reported, never "
+                                  "routed)"),
+        )
+    return _instruments
+
+
+def _set_entry_gauges(db: TuningDB) -> None:
+    """Entry census gauges cover the DISTINCT union of the active DB and
+    the bundle overlay (an artifact re-imported on the host that produced
+    it shares keys with the active DB — those are one consultable entry,
+    not two; the active DB shadows overlay duplicates, as in lookup)."""
+    from .db import backend_signature, runtime_signature
+
+    union = dict(_state["bundled"])
+    union.update(db.entries)
+    b_sig, r_sig = backend_signature(), runtime_signature()
+    stale = sum(1 for e in union.values()
+                if e.get("backend") != b_sig or e.get("runtime") != r_sig)
+    m = _metrics()
+    m["entries"].set(float(len(union)))
+    m["stale_entries"].set(float(stale))
+
+
+def get_db() -> TuningDB:
+    """The active DB, opened lazily from ``flags.tune_db_path``. Raises
+    ``TuningDBError`` when the flagged file is corrupt or alien-schema."""
+    from .. import flags
+
+    path = flags.get_flag("tune_db_path") or None
+    readonly = bool(flags.get_flag("tune_readonly"))
+    with _lock:
+        db = _state["db"]
+        if db is not None and _state["path"] == path:
+            db.readonly = readonly
+            return db
+        if _state["error"] is not None and _state["path"] == path:
+            # the open already failed for this path: re-raise the cached
+            # refusal instead of re-reading+re-parsing the rotten file on
+            # EVERY lowering-time lookup (configure()/reset() clear it)
+            raise _state["error"]
+        try:
+            db = TuningDB(path, readonly=readonly)
+        except TuningDBError as e:
+            _state["load_errors"] += 1
+            _state["path"], _state["error"], _state["db"] = path, e, None
+            _metrics()["load_errors"].inc()
+            raise
+        _state["db"], _state["path"], _state["error"] = db, path, None
+        _set_entry_gauges(db)
+        db.readonly = readonly
+        return db
+
+
+def ensure_loaded() -> None:
+    """Open the flagged DB (if any) so lowering-time lookups hit warm
+    entries; never raises — a broken DB means untuned, not broken."""
+    try:
+        get_db()
+    except TuningDBError:
+        pass
+
+
+def configure(path: Optional[str] = None,
+              readonly: Optional[bool] = None) -> TuningDB:
+    """Point the service at a DB (tests / bench / sweeps): sets the flags,
+    drops the cached DB so the next access reopens, and resets the
+    per-window provenance counters (the Prometheus counters stay
+    cumulative, as counters must)."""
+    from .. import flags
+
+    if path is not None:
+        flags.set_flag("tune_db_path", path)
+    if readonly is not None:
+        flags.set_flag("tune_readonly", readonly)
+    with _lock:
+        _state.update(db=None, path=None, error=None, bundled={}, hits=0,
+                      misses=0, stale=0)
+    return get_db()
+
+
+def reset() -> None:
+    """Test hook: forget the active DB and every provenance count."""
+    with _lock:
+        _state.update(db=None, path=None, error=None, bundled={}, hits=0,
+                      misses=0, stale=0, load_errors=0)
+
+
+def lookup(op: str, shape, dtype: str) -> Tuple[Optional[dict], str]:
+    """``(entry, status)`` with provenance accounting — THE consultation
+    point (core.registry.tuned_op_config and pallas_matmul.autotune call
+    this). Consults the active DB first, then the artifact-bundle overlay
+    (load_bundled); only a fresh 'hit' returns an entry — 'stale' and
+    'miss' return None so callers fall back to stock paths without
+    re-checking. Runs under the service lock: a concurrently merging
+    engine must not change the dict mid-scan."""
+    try:
+        db = get_db()
+    except TuningDBError:
+        return None, "miss"
+    with _lock:
+        ent, status = db.lookup(op, shape, str(dtype))
+        if status != "hit" and _state["bundled"]:
+            bent, bstatus = lookup_entries(_state["bundled"], op, shape,
+                                           str(dtype))
+            if bstatus == "hit" or (bstatus == "stale"
+                                    and status == "miss"):
+                ent, status = bent, bstatus
+        _state[_STATUS_FIELD[status]] += 1
+    _metrics()[_STATUS_FIELD[status]].inc()
+    return (ent if status == "hit" else None), status
+
+
+def record(op: str, shape, dtype: str, decision: str,
+           config: Optional[Dict[str, Any]] = None,
+           baseline_ms: Optional[float] = None,
+           best_ms: Optional[float] = None,
+           slopes: Optional[Dict[str, float]] = None,
+           source: str = "", save: bool = True) -> Optional[str]:
+    """Write one measured decision into the active DB and persist it
+    (unless the DB is in-memory or ``tune_readonly``). Adoptions AND
+    rejections both land — the rejects are the generated ledger of
+    negatives. ``save=False`` defers the file publish — a sweep recording
+    dozens of entries batches them and calls ``flush()`` once, instead of
+    paying a full merge+rewrite per entry. Returns the key, or None when
+    a broken DB ate the write."""
+    try:
+        db = get_db()
+    except TuningDBError:
+        return None
+    with _lock:
+        key = db.put(op, shape, str(dtype), decision, config=config,
+                     baseline_ms=baseline_ms, best_ms=best_ms,
+                     slopes=slopes, source=source)
+        if save and db.path and not db.readonly:
+            db.save()
+        _set_entry_gauges(db)
+    return key
+
+
+def flush() -> Optional[str]:
+    """Publish deferred ``record(save=False)`` writes; no-op for
+    in-memory/readonly DBs."""
+    try:
+        db = get_db()
+    except TuningDBError:
+        return None
+    with _lock:
+        if db.path and not db.readonly:
+            return db.save()
+    return None
+
+
+def provenance() -> Dict[str, Any]:
+    """The per-window consultation counts (since the last ``configure`` /
+    ``reset``) plus the active DB's size — what bench records attach."""
+    with _lock:
+        db = _state["db"]
+        return {"hits": _state["hits"], "misses": _state["misses"],
+                "stale": _state["stale"],
+                "load_errors": _state["load_errors"],
+                "entries": len(db.entries) if db is not None else 0,
+                "path": _state["path"]}
+
+
+# -- artifact travel (tuned.json bundles) --
+
+
+def bundle_path(dirname: str) -> str:
+    return os.path.join(dirname, BUNDLE_NAME)
+
+
+def save_bundle(dirname: str) -> Optional[str]:
+    """Bundle the active DB's entries into ``<dirname>/tuned.json`` —
+    called by ``io.save_checkpoint`` and ``io.save_inference_model`` so a
+    trained/exported artifact carries its tuning. Only the active DB's
+    own entries travel (not the bundle overlay — re-exporting must not
+    launder another artifact's measurements into a new provenance). No
+    entries, no file."""
+    try:
+        db = get_db()
+    except TuningDBError:
+        return None
+    with _lock:
+        if not db.entries:
+            return None
+        return publish_entries(bundle_path(dirname), dict(db.entries))
+
+
+def load_bundled(dirname: str) -> Optional[Dict[str, int]]:
+    """Merge ``<dirname>/tuned.json`` (if present) into the service's
+    BUNDLE OVERLAY — engine/checkpoint start-up. The overlay is consulted
+    by ``lookup`` after the active DB but is never persisted: the bundle
+    is the artifact's copy, not a writer of the shared DB, so a later
+    ``save()``/``flush()`` cannot launder foreign entries into the host's
+    TuningDB. Stale entries are counted into ``pt_tune_stale_entries``
+    and never routed. A corrupt bundle is a counted load error, never an
+    exception: serving must come up untuned rather than not at all.
+    Returns ``{"merged": n, "stale": s}`` or None when there is no
+    bundle."""
+    path = bundle_path(dirname)
+    if not os.path.exists(path):
+        return None
+    try:
+        entries = TuningDB._read(path)
+        db = get_db()
+    except TuningDBError:
+        with _lock:
+            _state["load_errors"] += 1
+        _metrics()["load_errors"].inc()
+        return None
+    with _lock:
+        bundled = _state["bundled"]
+        merged = 0
+        for key, ent in entries.items():
+            cur = bundled.get(key)
+            if cur is None or (ent.get("updated_at", 0.0)
+                               > cur.get("updated_at", 0.0)):
+                bundled[key] = dict(ent)
+                merged += 1
+        stale = sum(1 for e in entries.values() if db.is_stale(e))
+        _set_entry_gauges(db)
+    return {"merged": merged, "stale": stale}

@@ -166,6 +166,80 @@ def test_paddle_cli_placement_report(tmp_path):
                                      "--hbm-gb", "1e-9"]) == 1
 
 
+def test_paddle_cli_tune_table(tmp_path):
+    """`paddle_cli.py tune <db>`: one row per entry with decision, config,
+    margin, age, staleness; --prune-stale drops mismatched entries and
+    persists; a corrupt or future-schema file exits nonzero (2)."""
+    import json as _json
+
+    from paddle_tpu import tune
+
+    db_path = str(tmp_path / "tuning.json")
+    db = tune.TuningDB(db_path)
+    db.put("dw_matmul", (1024, 32000, 8192), "bfloat16", "adopt",
+           config={"strategy": "direct", "blocks": None},
+           baseline_ms=4.4, best_ms=3.1, source="test")
+    db.put("dw_matmul", (1024, 4096, 8192), "bfloat16", "reject",
+           baseline_ms=2.0, best_ms=1.97, source="test")
+    db.put("flash_attention", (1024, 8, 128), "bfloat16", "adopt",
+           config={"q_block": 256, "k_block": 256, "heads_per_block": 1},
+           backend="tpu-v9", runtime="jaxlib-9.9.9", source="test")
+    db.save()
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import paddle_cli
+    finally:
+        sys.path.pop(0)
+    report, rdb = paddle_cli.tune_report(db_path)
+    assert "1024x32000x8192" in report and "strategy=direct" in report
+    assert "reject" in report and "stock" in report
+    assert "STALE" in report and "tpu-v9" in report
+    assert "3 entries (2 adopted, 1 rejected, 1 stale)" in report
+    assert paddle_cli.cmd_tune([db_path]) == 0
+    # prune: the stale flash entry goes, the file shrinks to 2 entries
+    report2, _ = paddle_cli.tune_report(db_path, prune_stale=True)
+    assert "pruned 1 stale entries" in report2
+    assert len(tune.TuningDB(db_path)) == 2
+    # corrupt file and future schema: typed refusal -> exit 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("so corrupt")
+    assert paddle_cli.cmd_tune([str(bad)]) == 2
+    future = tmp_path / "future.json"
+    future.write_text(_json.dumps({"schema": tune.SCHEMA_VERSION + 1,
+                                   "entries": {}}))
+    assert paddle_cli.cmd_tune([str(future)]) == 2
+    assert paddle_cli.cmd_tune([str(tmp_path / "missing.json")]) == 2
+
+
+def test_probe_fa_gap_list_and_perf_lab_tune_dry(tmp_path):
+    """The sweep surface is inspectable off-TPU: `probe_fa_gap --list`
+    prints the candidate space per config, and `perf_lab.py tune` on a
+    CPU backend prints the search space, records NOTHING (no DB file),
+    and exits 0 — on-chip A/Bs on an interpreter are refused, the PR-4
+    discipline."""
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "probe_fa_gap.py"),
+         "--list", "1,4,256,32"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["config"] == {"B": 1, "H": 4, "T": 256, "D": 32}
+    assert {"q_block": 128, "k_block": 256,
+            "heads_per_block": 4} in rec["candidates"]
+    db = str(tmp_path / "sweep_db.json")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_lab.py"),
+         "tune", db],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r2.returncode == 0, r2.stderr[-1500:]
+    last = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert last["measured"] is False and last["adopted"] == []
+    assert "no TPU backend" in r2.stdout
+    assert not os.path.exists(db)  # nothing recorded off-chip
+
+
 def test_op_parity_audit_clean():
     """Every reference op (SURVEY §2b) is matched or redesign-mapped."""
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
@@ -242,13 +316,15 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all nine tracked metrics carry a bar (r8 added sharded serving,
-    # r10 the quantized CPU serving lane)
-    assert len(bench.BARS) == 9
+    # all ten tracked metrics carry a bar (r8 added sharded serving, r10
+    # the quantized CPU serving lane, r11/ISSUE-12 the tuner contract)
+    assert len(bench.BARS) == 10
     shd = bench.BARS["sharded_serving_qps_per_chip"]
     assert shd["field"] == "value" and shd["min"] == 1.0
     cpuq = bench.BARS["cpu_quantized_serving_qps_ratio"]
     assert cpuq["field"] == "value" and cpuq["min"] == 0.85
+    tunr = bench.BARS["kernel_tuner_warm_db_contract"]
+    assert tunr["field"] == "value" and tunr["min"] == 1.0
     # pass: above bar
     bench._emit({"metric": "transformer_lm_train_tokens_per_sec_per_chip",
                  "value": 150000.0, "unit": "tokens/sec", "mfu": 0.648})

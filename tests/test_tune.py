@@ -1,0 +1,546 @@
+"""paddle_tpu/tune — the persistent kernel autotuner service (ISSUE 12).
+
+Covers the TuningDB contract (schema versioning + migration, last-write-
+wins concurrent-writer merge, stale-entry fallback, typed corrupt-file
+refusal — the checkpoint-manifest IOError discipline), the artifact-travel
+round trips (save/load_checkpoint and a serving export both bundle/load
+``tuned.json``), the warm-DB autotune path (zero on-chip re-measurement,
+non-TPU routes nothing, pretend-TPU routes the adopted entry), and the
+flash-attention tunable schedule surface (explicit > tuned > default,
+numerics invariant under tuned blocks).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, io, tune
+from paddle_tpu.ops import pallas_matmul
+from paddle_tpu.tune import TuningDB, TuningDBError
+
+
+@pytest.fixture
+def tune_env(tmp_path):
+    """A fresh tuning service pointed at a tmp DB; restores the flags and
+    forgets the service state afterwards."""
+    saved = {k: flags.get_flag(k) for k in ("tune_db_path",
+                                            "tune_readonly")}
+    tune.reset()
+    pallas_matmul.reset_autotune()
+    db_path = str(tmp_path / "tuning.json")
+    tune.configure(path=db_path, readonly=False)
+    try:
+        yield db_path
+    finally:
+        flags.set_flags(saved)
+        tune.reset()
+        pallas_matmul.reset_autotune()
+
+
+# ---------------------------------------------------------------------------
+# TuningDB core
+# ---------------------------------------------------------------------------
+
+
+def test_db_put_lookup_save_roundtrip(tmp_path):
+    path = str(tmp_path / "db.json")
+    db = TuningDB(path)
+    key = db.put("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+                 config={"strategy": "direct", "blocks": None},
+                 baseline_ms=2.0, best_ms=1.5, slopes={"xla": 2.0,
+                                                       "direct": 1.5},
+                 source="test")
+    assert tune.backend_signature() in key and "64x32x128" in key
+    ent, status = db.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert status == "hit" and ent["decision"] == "adopt"
+    assert ent["margin"] == 0.75  # best/baseline, the recorded win
+    assert not db.is_stale(ent)
+    db.save()
+    # reload: same entry, same verdict
+    db2 = TuningDB(path)
+    ent2, status2 = db2.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert status2 == "hit" and ent2 == ent
+    # different dtype/shape/op are misses, not near-hits
+    assert db2.lookup("dw_matmul", (64, 32, 128), "float32")[1] == "miss"
+    assert db2.lookup("dw_matmul", (64, 32, 129), "bfloat16")[1] == "miss"
+    assert db2.lookup("flash_attention", (64, 32, 128),
+                      "bfloat16")[1] == "miss"
+
+
+def test_db_adopt_requires_config_and_valid_decision(tmp_path):
+    db = TuningDB(str(tmp_path / "db.json"))
+    with pytest.raises(ValueError):
+        db.put("dw_matmul", (8, 8, 8), "float32", "adopt")  # no config
+    with pytest.raises(ValueError):
+        db.put("dw_matmul", (8, 8, 8), "float32", "maybe")
+
+
+def test_db_stale_entry_found_but_not_fresh(tmp_path):
+    db = TuningDB(str(tmp_path / "db.json"))
+    db.put("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+           config={"strategy": "direct"}, backend="tpu-v9",
+           runtime="jaxlib-9.9.9")
+    ent, status = db.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert status == "stale" and db.is_stale(ent)
+    assert db.stale_entries() and db.prune_stale() == 1
+    assert db.lookup("dw_matmul", (64, 32, 128), "bfloat16")[1] == "miss"
+
+
+def test_db_corrupt_file_typed_refusal(tmp_path):
+    # not JSON at all
+    p = tmp_path / "garbage.json"
+    p.write_text("not json {")
+    with pytest.raises(TuningDBError):
+        TuningDB(str(p))
+    # JSON but not an object
+    p2 = tmp_path / "list.json"
+    p2.write_text("[1, 2, 3]")
+    with pytest.raises(TuningDBError):
+        TuningDB(str(p2))
+    # an entry missing required fields
+    p3 = tmp_path / "fields.json"
+    p3.write_text(json.dumps({"schema": 1,
+                              "entries": {"k": {"op": "dw_matmul"}}}))
+    with pytest.raises(TuningDBError):
+        TuningDB(str(p3))
+    # the refusal is IOError-typed (checkpoint-manifest discipline)
+    assert issubclass(TuningDBError, IOError)
+
+
+def test_db_schema_versioning_and_migration(tmp_path):
+    # schema 0 (the PR-4-era flat memo dump, no wrapper): migrates, and
+    # the field-less legacy entries come back structurally stale
+    legacy = {
+        "dw_matmul|64x32x128|bfloat16|old|old": {
+            "op": "dw_matmul", "shape": [64, 32, 128],
+            "dtype": "bfloat16", "decision": "adopt",
+            "config": {"strategy": "direct"},
+        }
+    }
+    p = tmp_path / "v0.json"
+    p.write_text(json.dumps(legacy))
+    db = TuningDB(str(p))
+    ent, status = db.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert status == "stale"  # migrated backend="unknown" never routes
+    assert ent["backend"] == "unknown"
+    db.save()  # persists upgraded
+    raw = json.loads(p.read_text())
+    assert raw["schema"] == tune.SCHEMA_VERSION
+    # a FUTURE schema refuses loudly instead of guessing
+    p2 = tmp_path / "future.json"
+    p2.write_text(json.dumps({"schema": tune.SCHEMA_VERSION + 1,
+                              "entries": {}}))
+    with pytest.raises(TuningDBError):
+        TuningDB(str(p2))
+
+
+def test_db_concurrent_writers_last_write_wins(tmp_path):
+    path = str(tmp_path / "shared.json")
+    a, b = TuningDB(path), TuningDB(path)
+    a.put("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+          config={"strategy": "direct"}, updated_at=100.0)
+    a.put("dw_matmul", (32, 32, 64), "bfloat16", "reject",
+          updated_at=100.0)
+    a.save()
+    # b raced: disjoint key + a NEWER verdict for the shared key
+    b.put("flash_attention", (128, 4, 32), "bfloat16", "adopt",
+          config={"q_block": 128, "k_block": 128}, updated_at=100.0)
+    b.put("dw_matmul", (64, 32, 128), "bfloat16", "reject",
+          updated_at=200.0)
+    b.save()
+    merged = TuningDB(path)
+    assert len(merged) == 3  # nothing lost
+    ent, st = merged.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert st == "hit" and ent["decision"] == "reject"  # newer won
+    assert merged.lookup("flash_attention", (128, 4, 32),
+                         "bfloat16")[1] == "hit"
+    # an OLDER write arriving later loses the merge
+    c = TuningDB(path)
+    c.put("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+          config={"strategy": "transpose"}, updated_at=50.0)
+    c.save()
+    ent2, _ = TuningDB(path).lookup("dw_matmul", (64, 32, 128),
+                                    "bfloat16")
+    assert ent2["decision"] == "reject"
+
+
+def test_db_readonly_refuses_save(tmp_path):
+    db = TuningDB(str(tmp_path / "ro.json"), readonly=True)
+    db.put("dw_matmul", (8, 8, 8), "float32", "reject")
+    with pytest.raises(TuningDBError):
+        db.save()
+
+
+# ---------------------------------------------------------------------------
+# service: provenance, readonly flag, gauges
+# ---------------------------------------------------------------------------
+
+
+def test_service_lookup_provenance_and_gauges(tune_env):
+    from paddle_tpu.obs import get_registry
+
+    tune.record("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+                config={"strategy": "direct"}, baseline_ms=2.0,
+                best_ms=1.0, source="test")
+    ent, status = tune.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert status == "hit" and ent is not None
+    assert tune.lookup("dw_matmul", (1, 2, 3), "bfloat16") == (None, "miss")
+    db = tune.get_db()
+    db.put("dw_matmul", (9, 9, 9), "bfloat16", "adopt",
+           config={"strategy": "direct"}, backend="elsewhere")
+    ent3, status3 = tune.lookup("dw_matmul", (9, 9, 9), "bfloat16")
+    assert ent3 is None and status3 == "stale"  # found, reported, not used
+    prov = tune.provenance()
+    assert (prov["hits"], prov["misses"], prov["stale"]) == (1, 1, 1)
+    assert prov["entries"] == 2
+    r = get_registry()
+    assert r.get("pt_tune_hits_total").value >= 1.0
+    assert r.get("pt_tune_stale_total").value >= 1.0
+    assert r.get("pt_tune_misses_total").value >= 1.0
+
+
+def test_service_readonly_flag_blocks_writes(tune_env):
+    tune.record("dw_matmul", (64, 32, 128), "bfloat16", "reject",
+                source="writable")
+    flags.set_flag("tune_readonly", True)
+    tune.record("dw_matmul", (32, 32, 32), "bfloat16", "reject",
+                source="readonly")  # lands in memory, must NOT persist
+    on_disk = TuningDB(tune_env)
+    assert on_disk.lookup("dw_matmul", (64, 32, 128),
+                          "bfloat16")[1] == "hit"
+    assert on_disk.lookup("dw_matmul", (32, 32, 32),
+                          "bfloat16")[1] == "miss"
+
+
+def test_service_corrupt_db_counts_load_error_not_crash(tmp_path):
+    saved = {k: flags.get_flag(k) for k in ("tune_db_path",
+                                            "tune_readonly")}
+    tune.reset()
+    bad = tmp_path / "bad.json"
+    bad.write_text("}{")
+    flags.set_flag("tune_db_path", str(bad))
+    try:
+        with pytest.raises(TuningDBError):
+            tune.get_db()
+        # the hot-path helpers degrade to miss/no-op instead of raising
+        assert tune.lookup("dw_matmul", (8, 8, 8),
+                           "float32") == (None, "miss")
+        tune.ensure_loaded()
+        assert tune.provenance()["load_errors"] >= 1
+    finally:
+        flags.set_flags(saved)
+        tune.reset()
+
+
+# ---------------------------------------------------------------------------
+# warm-DB autotune: zero re-measurement, routing discipline
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_warm_db_zero_measure_cpu_routes_nothing(tune_env):
+    shape = (256, 128, 512)
+    tune.record("dw_matmul", shape, "float32", "adopt",
+                config={"strategy": "direct", "blocks": None},
+                baseline_ms=1.0, best_ms=0.8, source="test")
+    tune.configure(path=tune_env)  # reset the provenance window
+    pallas_matmul.reset_autotune()
+    m0 = pallas_matmul.measure_count
+    plan = pallas_matmul.autotune([shape], dtype=np.float32, verbose=False)
+    assert pallas_matmul.measure_count == m0  # warm: no on-chip slope
+    assert plan == {}  # non-TPU backend routes NOTHING (PR-4 contract)
+    assert tune.provenance()["hits"] == 1
+    # memoized: a second call does not even consult the DB again
+    pallas_matmul.autotune([shape], dtype=np.float32, verbose=False)
+    assert tune.provenance()["hits"] == 1
+
+
+def test_autotune_warm_db_routes_on_pretend_tpu(tune_env, monkeypatch):
+    """With the backend gate lifted (pretend-TPU), a warm adopted entry
+    hydrates the routing plan with zero measurement and routed_dot serves
+    it; the rejected and stale entries never route."""
+    import jax.numpy as jnp
+
+    adopted, rejected = (32, 16, 64), (16, 32, 64)
+    tune.record("dw_matmul", adopted, "float32", "adopt",
+                config={"strategy": "direct", "blocks": None},
+                baseline_ms=1.0, best_ms=0.5, source="test")
+    tune.record("dw_matmul", rejected, "float32", "reject",
+                baseline_ms=1.0, best_ms=0.99, source="test")
+    stale = (8, 8, 8)
+    tune.get_db().put("dw_matmul", stale, "float32", "adopt",
+                      config={"strategy": "transpose"}, backend="foreign")
+    monkeypatch.setattr(pallas_matmul, "_interpret_default", lambda: False)
+    pallas_matmul.reset_autotune()
+    m0 = pallas_matmul.measure_count
+    plan = pallas_matmul.autotune([adopted, rejected, stale],
+                                  dtype=np.float32, verbose=False)
+    # even on (pretend-)TPU: zero measurements — a STALE entry pins stock
+    # without a mid-round re-A/B (the offline sweep owns re-measurement)
+    assert pallas_matmul.measure_count == m0
+    assert plan == {adopted: ("direct", None)}
+    saved = {k: flags.get_flag(k) for k in ("pallas_dw_matmul",)}
+    flags.set_flag("pallas_dw_matmul", "auto")
+    try:
+        x = jnp.zeros((64, 32), jnp.float32)
+        y = jnp.zeros((32, 16), jnp.float32)
+        assert pallas_matmul.routed_dot(x, y, jnp.float32) is not None
+        # the rejected shape keeps the stock path
+        x2 = jnp.zeros((64, 16), jnp.float32)
+        y2 = jnp.zeros((16, 32), jnp.float32)
+        assert pallas_matmul.routed_dot(x2, y2, jnp.float32) is None
+    finally:
+        flags.set_flags(saved)
+
+
+def test_autotune_reset_spellings_and_block_plans():
+    pallas_matmul.reset_autotune({(32, 16, 64): "direct"})
+    assert pallas_matmul._PLAN[(32, 16, 64)] == ("direct", None)
+    pallas_matmul.reset_autotune(
+        {(32, 16, 64): {"strategy": "transpose", "blocks": [16, 16, 32]}})
+    assert pallas_matmul._PLAN[(32, 16, 64)] == ("transpose", (16, 16, 32))
+    with pytest.raises(ValueError):
+        pallas_matmul.reset_autotune({(1, 1, 1): "sideways"})
+    pallas_matmul.reset_autotune()
+    assert not pallas_matmul._PLAN
+
+
+def test_dw_matmul_with_tuned_block_plan_matches_reference():
+    """A (strategy, blocks) plan from the sweep must compute the same
+    dW as the default-plan kernel and the numpy oracle (interpret mode
+    binds on-chip numerics)."""
+    rng = np.random.RandomState(3)
+    a = rng.randn(64, 32).astype("float32")
+    b = rng.randn(64, 16).astype("float32")
+    want = a.T @ b
+    got = np.asarray(pallas_matmul.dw_matmul(
+        a, b, strategy="direct", out_dtype=np.float32,
+        blocks=(32, 16, 32), interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # plan_candidates: ranked, head == plan_blocks, all tile exactly
+    cands = pallas_matmul.plan_candidates(1024, 4096, 8192, top=3)
+    assert cands[0] == pallas_matmul.plan_blocks(1024, 4096, 8192)
+    assert len(cands) == len(set(cands)) and len(cands) <= 3
+    for (bm, bn, bk) in cands:
+        assert 1024 % bm == 0 and 4096 % bn == 0 and 8192 % bk == 0
+
+
+# ---------------------------------------------------------------------------
+# flash-attention tunable schedule surface
+# ---------------------------------------------------------------------------
+
+
+def test_flash_config_resolution_order(tune_env, monkeypatch):
+    from paddle_tpu.ops import pallas_attention as pa
+
+    t, h, d = 256, 4, 32
+    # CPU: never consults, defaults apply
+    assert pa.resolve_flash_config(t, h, d, np.float32) == (512, 512, None)
+    # "auto" is the EXPLICIT auto-pack spelling: resolves to None (the
+    # _heads_per_block default) and pins the knob against the DB — the
+    # probe_fa_gap baseline measures the point it names
+    assert pa.resolve_flash_config(t, h, d, np.float32,
+                                   heads_per_block="auto") == (512, 512,
+                                                               None)
+    tune.record("flash_attention", pa.flash_key(t, h, d), "float32",
+                "adopt", config={"q_block": 128, "k_block": 256,
+                                 "heads_per_block": 2},
+                baseline_ms=2.0, best_ms=1.0, source="test")
+    assert pa.resolve_flash_config(t, h, d, np.float32) == (512, 512, None)
+    # pretend-TPU: the tuned schedule fills the None knobs...
+    monkeypatch.setattr(pa, "_interpret_default", lambda: False)
+    assert pa.resolve_flash_config(t, h, d, np.float32) == (128, 256, 2)
+    # ..."auto" still pins the head pack against the tuned value
+    assert pa.resolve_flash_config(t, h, d, np.float32,
+                                   heads_per_block="auto") == (128, 256,
+                                                               None)
+    # ...but explicit choices always win
+    assert pa.resolve_flash_config(t, h, d, np.float32,
+                                   q_block=512) == (512, 256, 2)
+    assert pa.resolve_flash_config(t, h, d, np.float32, q_block=64,
+                                   k_block=64,
+                                   heads_per_block=1) == (64, 64, 1)
+    # a REJECTED flash entry leaves the defaults alone
+    tune.record("flash_attention", pa.flash_key(512, h, d), "float32",
+                "reject", baseline_ms=1.0, best_ms=0.99, source="test")
+    assert pa.resolve_flash_config(512, h, d, np.float32) == (512, 512,
+                                                              None)
+
+
+def test_flash_candidates_viable_and_numerics_invariant():
+    from paddle_tpu.ops.pallas_attention import (flash_attention_fwd,
+                                                 flash_candidates)
+
+    cands = flash_candidates(1024, 8, 128)
+    assert {"q_block": 128, "k_block": 256, "heads_per_block": 1} in cands
+    for c in cands:
+        assert 1024 % c["q_block"] == 0 and 1024 % c["k_block"] == 0
+        assert 8 % c["heads_per_block"] == 0
+    # the dkv VMEM budget prunes big packs at long T (the _heads_per_block
+    # backoff rule)
+    lc = flash_candidates(4096, 8, 128)
+    assert all(c["heads_per_block"] == 1 for c in lc)
+    # numerics: a non-default schedule computes the same attention
+    rng = np.random.RandomState(0)
+    q = rng.randn(1, 256, 4, 32).astype("float32")
+    base = np.asarray(flash_attention_fwd(q, q, q, causal=True,
+                                          q_block=512, k_block=512))
+    tuned = np.asarray(flash_attention_fwd(q, q, q, causal=True,
+                                           q_block=128, k_block=128,
+                                           heads_per_block=2))
+    np.testing.assert_allclose(tuned, base, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# artifact travel: checkpoints and serving exports carry tuned.json
+# ---------------------------------------------------------------------------
+
+
+def _tiny_export(dirname):
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=3)
+        io.save_inference_model(dirname, ["x"], [pred], exe, main,
+                                scope=scope)
+    return dirname
+
+
+def test_checkpoint_roundtrip_bundles_tuned_json(tune_env, tmp_path):
+    tune.record("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+                config={"strategy": "direct"}, baseline_ms=2.0,
+                best_ms=1.0, source="roundtrip")
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=1)
+        ckpt = str(tmp_path / "ckpts")
+        serial = io.save_checkpoint(exe, ckpt, main_program=main,
+                                    scope=scope)
+        cur = os.path.join(ckpt, f"checkpoint_{serial}")
+        bundle = os.path.join(cur, "tuned.json")
+        assert os.path.exists(bundle)
+        # the digest manifest covers the bundle (corruption surfaces)
+        manifest = json.loads(
+            open(os.path.join(cur, "_MANIFEST.json")).read())
+        assert "tuned.json" in manifest["files"]
+        assert io.verify_checkpoint(cur) is None
+        # a FRESH service (empty in-memory DB) hydrates from the load
+        tune.reset()
+        flags.set_flag("tune_db_path", "")
+        io.load_checkpoint(exe, ckpt, main_program=main, scope=scope)
+        ent, status = tune.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+        assert status == "hit" and ent["source"] == "roundtrip"
+
+
+def test_serving_export_roundtrip_engine_loads_bundle(tune_env, tmp_path):
+    from paddle_tpu.serving import ServingEngine
+
+    tune.record("flash_attention", (128, 4, 32), "bfloat16", "adopt",
+                config={"q_block": 128, "k_block": 128}, baseline_ms=2.0,
+                best_ms=1.0, source="export-roundtrip")
+    d = _tiny_export(str(tmp_path / "m"))
+    assert os.path.exists(os.path.join(d, "tuned.json"))
+    # fresh service: the engine's start-up merge is the only hydration
+    tune.reset()
+    flags.set_flag("tune_db_path", "")
+    eng = ServingEngine(d, place=fluid.CPUPlace(), max_batch_size=4)
+    assert eng.tune_bundle == {"merged": 1, "stale": 0}
+    ent, status = tune.lookup("flash_attention", (128, 4, 32), "bfloat16")
+    assert status == "hit" and ent["source"] == "export-roundtrip"
+    out = eng.run_batch({"x": np.ones((2, 4), "float32")})[0]
+    assert out.shape == (2, 3)
+
+
+def test_serving_export_stale_bundle_reported_not_routed(tune_env,
+                                                         tmp_path):
+    from paddle_tpu.obs import get_registry
+    from paddle_tpu.serving import ServingEngine
+
+    db = tune.get_db()
+    db.put("dw_matmul", (64, 32, 128), "bfloat16", "adopt",
+           config={"strategy": "direct"}, backend="tpu-v9",
+           runtime="jaxlib-9.9.9")
+    db.save()
+    d = _tiny_export(str(tmp_path / "m"))
+    tune.reset()
+    flags.set_flag("tune_db_path", "")
+    eng = ServingEngine(d, place=fluid.CPUPlace(), max_batch_size=4)
+    assert eng.tune_bundle == {"merged": 1, "stale": 1}
+    assert get_registry().get("pt_tune_stale_entries").value == 1.0
+    ent, status = tune.lookup("dw_matmul", (64, 32, 128), "bfloat16")
+    assert ent is None and status == "stale"  # reported, never routed
+
+
+def test_bundle_overlay_never_persists_to_shared_db(tune_env, tmp_path):
+    """A loaded bundle is consultable but NOT a writer of the shared DB:
+    a later record()+save must not launder the artifact's (possibly
+    foreign) entries into the host's TuningDB file."""
+    tune.record("flash_attention", (64, 2, 16), "bfloat16", "adopt",
+                config={"q_block": 64, "k_block": 64}, baseline_ms=2.0,
+                best_ms=1.0, source="travel")
+    d = _tiny_export(str(tmp_path / "m"))
+    # a host with its own shared writable DB loads the artifact's bundle
+    host_db = str(tmp_path / "host_db.json")
+    tune.configure(path=host_db, readonly=False)
+    assert tune.load_bundled(d) == {"merged": 1, "stale": 0}
+    ent, status = tune.lookup("flash_attention", (64, 2, 16), "bfloat16")
+    assert status == "hit" and ent["source"] == "travel"  # consultable
+    tune.record("dw_matmul", (32, 32, 64), "bfloat16", "reject",
+                source="host")  # save=True publishes the host DB
+    on_disk = TuningDB(host_db)
+    assert on_disk.lookup("dw_matmul", (32, 32, 64),
+                          "bfloat16")[1] == "hit"
+    assert on_disk.lookup("flash_attention", (64, 2, 16),
+                          "bfloat16")[1] == "miss"  # bundle NOT laundered
+
+
+def test_malformed_adopted_configs_never_trace_crash(tune_env,
+                                                     monkeypatch):
+    """A hand-edited DB with garbage configs must mean 'untuned', not a
+    ValueError/TypeError inside the next trace."""
+    from paddle_tpu.ops import pallas_attention as pa
+
+    db = tune.get_db()
+    # wrong-length block plan + non-dividing block plan
+    db.put("dw_matmul", (32, 16, 64), "float32", "adopt",
+           config={"strategy": "direct", "blocks": [128, 128]})
+    db.put("dw_matmul", (16, 32, 64), "float32", "adopt",
+           config={"strategy": "direct", "blocks": [13, 7, 5]})
+    monkeypatch.setattr(pallas_matmul, "_interpret_default", lambda: False)
+    pallas_matmul.reset_autotune()
+    plan = pallas_matmul.autotune([(32, 16, 64), (16, 32, 64)],
+                                  dtype=np.float32, verbose=False)
+    # wrong length -> not routed; non-dividing -> routed with planner
+    # blocks (None), never the crashing plan
+    assert plan == {(16, 32, 64): ("direct", None)}
+    # flash: string/negative tuned values resolve to the defaults
+    tune.record("flash_attention", pa.flash_key(128, 2, 16), "float32",
+                "adopt", config={"q_block": "512", "k_block": -4,
+                                 "heads_per_block": 2.5}, source="bad")
+    monkeypatch.setattr(pa, "_interpret_default", lambda: False)
+    assert pa.resolve_flash_config(128, 2, 16, np.float32) == (512, 512,
+                                                               None)
+
+
+def test_engine_survives_corrupt_bundle(tune_env, tmp_path):
+    from paddle_tpu.serving import ServingEngine
+
+    d = _tiny_export(str(tmp_path / "m"))
+    with open(os.path.join(d, "tuned.json"), "w") as f:
+        f.write("definitely not json")
+    before = tune.provenance()["load_errors"]
+    eng = ServingEngine(d, place=fluid.CPUPlace(), max_batch_size=4)
+    assert eng.tune_bundle is None  # counted load error, engine is up
+    assert tune.provenance()["load_errors"] == before + 1
+    out = eng.run_batch({"x": np.ones((2, 4), "float32")})[0]
+    assert out.shape == (2, 3)

@@ -29,6 +29,13 @@ Subcommands:
              against fresh engines and verifies bit-identical outputs.
              Exit 2 on a schema-invalid bundle, 1 on replay mismatch.
   replay   — just the replay harness over a bundle's captures.
+  tune     — inspect a persistent kernel-tuning DB (paddle_tpu/tune,
+             docs/design.md §21): one row per entry (op, shape, dtype,
+             decision, chosen config, measured margin, age, staleness on
+             this backend/runtime) plus the adopted/rejected/stale
+             census. ``--prune-stale`` drops backend/runtime-mismatched
+             entries and saves. Exit 2 on a corrupt or schema-mismatched
+             file (the typed TuningDBError refusal).
 """
 from __future__ import annotations
 
@@ -559,6 +566,105 @@ def cmd_replay(argv):
     return 0 if _print_replay(results) else 1
 
 
+# -- tuning DB inspection --------------------------------------------------
+
+
+def _fmt_age(seconds):
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.0f}m"
+    if seconds < 172800:
+        return f"{seconds / 3600:.1f}h"
+    return f"{seconds / 86400:.1f}d"
+
+
+def tune_report(db_path, prune_stale=False):
+    """Render the tuning DB as a table: one row per entry (key fields,
+    decision, chosen config, measured margin, age, staleness on THIS
+    backend/runtime). ``prune_stale`` drops the backend/runtime-mismatched
+    entries and persists. Raises ``TuningDBError`` (schema mismatch /
+    corrupt file) for ``cmd_tune`` to turn into a nonzero exit."""
+    import time as _time
+
+    sys.path.insert(0, REPO)
+    from paddle_tpu import tune
+
+    db = tune.TuningDB(db_path)
+    pruned = 0
+    if prune_stale:
+        pruned = db.prune_stale()
+        if pruned and db.path:
+            db.save(merge=False)  # publish the deletion, don't resurrect
+            mdir = os.path.dirname(os.path.abspath(db_path))
+            if os.path.exists(os.path.join(mdir, "_MANIFEST.json")):
+                # pruning a checkpoint's bundled tuned.json rewrote a
+                # digest-covered file — refresh the manifest (the
+                # reshard_sharded_var discipline) or the valid checkpoint
+                # would read as corrupt at the next load
+                from paddle_tpu import io as pt_io
+
+                pt_io.write_checkpoint_manifest(mdir)
+    now = _time.time()
+    header = (f"{'op':<18}{'shape':<18}{'dtype':<10}{'decision':<9}"
+              f"{'config':<34}{'margin':>7}{'age':>7}  stale?")
+    lines = [header, "-" * len(header)]
+    n_adopt = n_reject = n_stale = 0
+    for _key, ent in db.items():
+        stale = db.is_stale(ent)
+        n_stale += stale
+        n_adopt += ent["decision"] == "adopt"
+        n_reject += ent["decision"] == "reject"
+        cfg = ent.get("config")
+        if ent["decision"] == "reject" or not cfg:
+            cfg_s = "stock"
+        else:
+            cfg_s = ",".join(f"{k}={v}" for k, v in sorted(cfg.items())
+                             if v is not None)
+        margin = ent.get("margin")
+        lines.append(
+            f"{ent['op']:<18}"
+            f"{'x'.join(str(s) for s in ent['shape']):<18}"
+            f"{ent['dtype']:<10}{ent['decision']:<9}{cfg_s[:33]:<34}"
+            f"{margin if margin is not None else '-':>7}"
+            f"{_fmt_age(max(0.0, now - ent.get('updated_at', 0.0))):>7}"
+            f"  {'STALE (' + ent['backend'] + '/' + ent['runtime'] + ')' if stale else '-'}")
+    lines.append(f"{len(db)} entries ({n_adopt} adopted, {n_reject} "
+                 f"rejected, {n_stale} stale) — schema "
+                 f"{tune.SCHEMA_VERSION}, backend "
+                 f"{tune.backend_signature()}/{tune.runtime_signature()}")
+    if prune_stale:
+        lines.append(f"pruned {pruned} stale entries")
+    return "\n".join(lines), db
+
+
+def cmd_tune(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py tune",
+        description="inspect a persistent kernel-tuning DB "
+                    "(docs/design.md §21); nonzero exit on a corrupt or "
+                    "schema-mismatched file")
+    ap.add_argument("db", help="TuningDB path (or a bundled tuned.json)")
+    ap.add_argument("--prune-stale", action="store_true",
+                    help="drop backend/runtime-mismatched entries and save")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, REPO)
+    from paddle_tpu.tune import TuningDBError
+
+    if not os.path.exists(args.db):
+        print(f"no tuning DB at {args.db!r}", file=sys.stderr)
+        return 2
+    try:
+        report, _db = tune_report(args.db, prune_stale=args.prune_stale)
+    except TuningDBError as e:
+        print(f"tuning DB refused: {e}", file=sys.stderr)
+        return 2
+    print(report)
+    return 0
+
+
 # -- placement search ------------------------------------------------------
 
 
@@ -683,7 +789,7 @@ def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle_cli.py {train|version|trace|fleet|placement|"
-              "doctor|replay} [args...]")
+              "doctor|replay|tune} [args...]")
         return 0
     sub = sys.argv[1]
     if sub == "version":
@@ -702,8 +808,10 @@ def main():
         return cmd_doctor(sys.argv[2:])
     if sub == "replay":
         return cmd_replay(sys.argv[2:])
+    if sub == "tune":
+        return cmd_tune(sys.argv[2:])
     print(f"unknown subcommand {sub!r}; use "
-          f"train|version|trace|fleet|placement|doctor|replay")
+          f"train|version|trace|fleet|placement|doctor|replay|tune")
     return 2
 
 

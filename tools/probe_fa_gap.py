@@ -1,4 +1,4 @@
-"""Flash-attention schedule-gap probe (VERDICT r5 item 4).
+"""Flash-attention schedule-gap probe (VERDICT r5 item 4; sweep in PR 12).
 
 The r5 audit measured the bench-config flash kernels (T=1024, 512-token
 blocks -> a 2-cell-per-(batch*head) grid) at 8.2 ms/step against a
@@ -12,11 +12,22 @@ T=4096, the gap is T=1024-specific (amortization), not a kernel-schedule
 defect — and the perf.md sentence "only a materially different schedule
 could attack it" gets scoped to short sequences.
 
+``--sweep`` (PR 12) replaces the fixed two-point comparison with a drive
+of the tunable flash schedule surface itself: every viable
+(q_block, k_block, heads_per_block) candidate from
+``pallas_attention.flash_candidates`` is slope-timed against the 512/512
+default baseline, so the short-sequence gap is attacked by search instead
+of by two hand-picked points. ``tools/perf_lab.py tune`` builds on exactly
+this sweep and applies the adoption discipline (>5% measured win -> a
+TuningDB entry; anything else -> a recorded negative). ``--list`` prints
+the candidate space without measuring (inspectable on any backend).
+
 Floor model: 8 MXU passes/layer (2 fwd + 6 bwd, the FA-2 recipe — the
 QK^T replay runs in BOTH backward kernels), each 2*B*H*(T^2/2)*D FLOPs
 causal, at the chip's measured 190 TF/s big-matmul rate.
 
-Usage: python tools/probe_fa_gap.py [B,H,T,D ...]
+Usage: python tools/probe_fa_gap.py [--sweep|--list] [--iters N]
+           [--reps N] [B,H,T,D ...]
 """
 import json
 import sys
@@ -34,11 +45,14 @@ def floor_ms(b, h, t, d):
     return flops / (MEASURED_PEAK_TFS * 1e12) * 1e3
 
 
-def measure(b, h, t, d, iters=8, reps=3):
-    """One layer's flash fwd+bwd ms via the shared chained-window slope
-    (profiler.chained_slope_ms — the same instrument pallas_matmul's
-    autotune uses; the q-scaling chain keeps XLA from hoisting or DCE'ing
-    the loop-invariant kernel calls)."""
+def measure(b, h, t, d, iters=8, reps=3, q_block=512, k_block=512,
+            heads_per_block="auto"):
+    """One layer's flash fwd+bwd ms at ONE schedule point via the shared
+    chained-window slope (profiler.chained_slope_ms — the same instrument
+    pallas_matmul's autotune uses; the q-scaling chain keeps XLA from
+    hoisting or DCE'ing the loop-invariant kernel calls). The schedule
+    knobs are passed EXPLICITLY so the probe always measures the point it
+    names, never whatever the tuning DB currently resolves."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -51,7 +65,8 @@ def measure(b, h, t, d, iters=8, reps=3):
 
     def step(q):
         out, vjp = jax.vjp(
-            lambda q: flash_attention(q, q, q, True, None, 512, 512), q)
+            lambda q: flash_attention(q, q, q, True, None, q_block, k_block,
+                                      heads_per_block), q)
         (dq,) = vjp(out)
         return dq
 
@@ -71,12 +86,71 @@ def measure(b, h, t, d, iters=8, reps=3):
     return chained_slope_ms(window, iters=iters, reps=reps, args=(q0,))
 
 
+def sweep(b, h, t, d, iters=8, reps=3, candidates=None):
+    """Drive the flash schedule surface: slope-time every candidate config
+    and return ``(baseline_ms, rows)`` — baseline is the 512/512/auto
+    default, rows carry each candidate's config, ms, and ratio vs the
+    baseline (sorted fastest first). The kernel-level instrument
+    `perf_lab.py tune` applies the adoption discipline on top of."""
+    from paddle_tpu.ops.pallas_attention import flash_candidates
+
+    cands = (flash_candidates(t, h, d) if candidates is None
+             else list(candidates))
+    base_ms = measure(b, h, t, d, iters=iters, reps=reps)
+    rows = []
+    for cfg in cands:
+        ms = measure(b, h, t, d, iters=iters, reps=reps, **cfg)
+        rows.append({"config": dict(cfg), "fwd_bwd_ms": round(ms, 3),
+                     "vs_default": round(ms / base_ms, 3)})
+    rows.sort(key=lambda r: r["fwd_bwd_ms"])
+    return base_ms, rows
+
+
+def _parse_args(argv):
+    opts = {"sweep": False, "list": False, "iters": 8, "reps": 3}
+    configs = []
+    it = iter(argv)
+    for a in it:
+        if a == "--sweep":
+            opts["sweep"] = True
+        elif a == "--list":
+            opts["list"] = True
+        elif a == "--iters":
+            opts["iters"] = int(next(it))
+        elif a == "--reps":
+            opts["reps"] = int(next(it))
+        else:
+            configs.append(tuple(int(x) for x in a.split(",")))
+    return opts, (configs or list(CONFIGS))
+
+
 if __name__ == "__main__":
-    configs = ([tuple(int(x) for x in s.split(",")) for s in sys.argv[1:]]
-               or CONFIGS)
+    opts, configs = _parse_args(sys.argv[1:])
+    if opts["list"]:
+        from paddle_tpu.ops.pallas_attention import flash_candidates
+
+        for (b, h, t, d) in configs:
+            print(json.dumps({
+                "config": {"B": b, "H": h, "T": t, "D": d},
+                "candidates": flash_candidates(t, h, d),
+            }), flush=True)
+        sys.exit(0)
     for (b, h, t, d) in configs:
-        ms = measure(b, h, t, d)
         fl = floor_ms(b, h, t, d)
+        if opts["sweep"]:
+            base_ms, rows = sweep(b, h, t, d, iters=opts["iters"],
+                                  reps=opts["reps"])
+            best = rows[0] if rows else None
+            print(json.dumps({
+                "config": {"B": b, "H": h, "T": t, "D": d},
+                "default_ms": round(base_ms, 3),
+                "analytic_floor_ms": round(fl, 3),
+                "default_tax_ratio": round(base_ms / fl, 2),
+                "best": best,
+                "rows": rows,
+            }), flush=True)
+            continue
+        ms = measure(b, h, t, d, iters=opts["iters"], reps=opts["reps"])
         print(json.dumps({
             "config": {"B": b, "H": h, "T": t, "D": d},
             "fwd_bwd_ms": round(ms, 3),
