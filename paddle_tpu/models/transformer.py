@@ -640,6 +640,107 @@ def predict_forward(params, ids, *, cfg, tp: int = 1, tp_axis=None):
     return gather(fc(xn, params["out_w"], params.get("out_b")))
 
 
+def decode_forward_paged(params, pool_k, pool_v, tokens, positions, valids,
+                         slots, page_tables, *, cfg, window, page_len,
+                         tp: int = 1, tp_axis=None):
+    """``decode_forward_chunk`` through one page indirection: the pools are
+    ``[L, n_pages, page_len, H, Dh]`` and each slot's KV lives in the
+    fixed-size pages its ``page_tables`` row names, instead of one dense
+    ``max_len`` row per slot (serving/kvcache.py owns the page
+    accounting). Same math, same signatures discipline:
+
+    * ``page_tables`` [n_slots, max_len/page_len] int32 — logical page j
+      of slot s lives in physical page ``page_tables[s, j]`` (unmapped
+      entries point at the trash page). STATIC shape: the table is a
+      plain extra input, so the compile-cache key stays (lanes, chunk,
+      window) and steady-state decode still compiles nothing.
+    * writes scatter through the table (position p -> page ``p //
+      page_len``, offset ``p % page_len``); reads gather the window's
+      ``window / page_len`` pages per lane and flatten them back to the
+      dense ``[B, W, H, Dh]`` layout.
+
+    Because the gathered window holds exactly the values the dense engine
+    would slice (masked tail positions differ only where the mask already
+    writes -1e30 over both), every downstream op sees bit-identical
+    inputs at identical shapes — greedy streams through a paged pool are
+    BIT-IDENTICAL to the unpaged engine (tested cold-vs-warm-prefix,
+    dense-vs-paged, and sharded dp/tp in tests/test_serving_kvcache.py).
+    With ``tp > 1`` the pools hold each rank's head subset (pages shard
+    along heads exactly like the dense pool) and the table replicates.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, C = tokens.shape
+    H = cfg["n_heads"]
+    D = cfg["d_model"]
+    Dh = D // H
+    eps = cfg["eps"]
+    scale = 1.0 / (Dh ** 0.5)
+    max_len = page_tables.shape[1] * page_len
+    H_loc = H // tp
+    gather = _tp_gather(tp_axis if tp > 1 else None)
+
+    posm = jnp.minimum(positions[:, None] + jnp.arange(C, dtype=jnp.int32),
+                       max_len - 1)  # [B, C]
+    ptab = page_tables[slots]  # [B, max_pages] — each lane's page map
+    # physical (page, offset) of every position this chunk writes
+    wpage = jnp.take_along_axis(ptab, posm // page_len, axis=1)  # [B, C]
+    woff = posm % page_len
+    # the window's page prefix, gathered per lane then flattened back to
+    # the dense [B, W, H, Dh] the attention expressions expect
+    ptab_w = ptab[:, :window // page_len]  # [B, P] — static slice
+
+    def ln(x, s, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.maximum(
+            jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * s + b
+
+    x = gather(_embed_rows(params["emb"], tokens)) + params["pos"][0][posm]
+    key_idx = jnp.arange(window, dtype=jnp.int32)
+    mask = key_idx[None, None, None, :] <= posm[:, None, :, None]  # [B,1,C,W]
+    for li, lp in enumerate(params["layers"]):
+        a = ln(x, lp["ln1_s"], lp["ln1_b"])
+        if "wqkv" in lp:
+            q, k, v = jnp.split(_dc_matmul(a, lp["wqkv"]), 3, axis=-1)
+        else:
+            q, k, v = (_dc_matmul(a, lp["wq"]), _dc_matmul(a, lp["wk"]),
+                       _dc_matmul(a, lp["wv"]))
+        q = q.reshape(B, C, H_loc, Dh)
+        k = k.reshape(B, C, H_loc, Dh)
+        v = v.reshape(B, C, H_loc, Dh)
+        pool_k = pool_k.at[li, wpage, woff].set(k)
+        pool_v = pool_v.at[li, wpage, woff].set(v)
+        kw = pool_k[li][ptab_w].reshape(B, window, H_loc, Dh)
+        vw = pool_v[li][ptab_w].reshape(B, window, H_loc, Dh)
+        logits = jnp.einsum("bchd,bkhd->bhck", q, kw) * scale
+        logits = jnp.where(mask, logits, -1e30)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        p = jnp.exp(logits - lse[..., None])
+        ctx = gather(jnp.einsum("bhck,bkhd->bchd", p, vw)
+                     .reshape(B, C, D // tp))
+        x = x + gather(_dc_matmul(ctx, lp["wo"]))
+        f = ln(x, lp["ln2_s"], lp["ln2_b"])
+        h = _dc_matmul(f, lp["wup"])
+        if "bup" in lp:
+            h = h + lp["bup"]
+        h = jnp.maximum(h, 0.0)
+        f2 = _dc_matmul(gather(h), lp["wdown"])
+        if "bdown" in lp:
+            f2 = f2 + lp["bdown"]
+        x = x + gather(f2)
+    xn = ln(x, params["lnf_s"], params["lnf_b"])
+    last = jnp.maximum(valids - 1, 0)
+    xl = xn[jnp.arange(B), last]
+    head_logits = _dc_matmul(xl, params["out_w"])
+    if "out_b" in params:
+        head_logits = head_logits + params["out_b"]
+    head_logits = gather(head_logits)
+    next_tok = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
+    return next_tok, head_logits, positions + valids, pool_k, pool_v
+
+
 def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
                          slots, *, cfg, window, tp: int = 1, tp_axis=None):
     """One decode/prefill chunk over the slot-pooled KV cache. Pure jax —

@@ -47,6 +47,16 @@ plane). Pieces, composable or used together via ``ServingServer``:
   measured CPU lane: ``tools/perf_lab.py cpu`` writes ``cpu_tuned.json``
   only on a >5% closed-loop win and ``ServingServer(quantize="auto")``
   adopts it.
+* ``PagedDecodeEngine`` / ``ShardedPagedDecodeEngine`` /
+  ``QuantizedPagedDecodeEngine`` (kvcache.py, docs/design.md §22) —
+  decode serving over a paged KV pool (fixed-size page blocks + per-slot
+  page tables as a static-shape gather index; ~half the dense HBM
+  reservation at the default overcommit) with a radix-tree prefix cache:
+  shared prompt prefixes prefill ONCE, ref-counted and LRU-evicted,
+  invalidated by hot reload, bit-identical greedy streams vs the unpaged
+  engine; cache-aware slot-scheduler admission, typed
+  ``KVPoolExhausted`` backpressure, ``pt_serving_kv_pages`` /
+  ``pt_serving_prefix_*`` gauges.
 * ``errors`` (errors.py) — the typed error hierarchy + wire codes.
 
 Since PR 9 the whole stack is black-boxed (docs/design.md §19): faults,
@@ -76,9 +86,13 @@ from .decode import (DecodeEngine, GenerationBatcher,  # noqa: F401
                      GenerationResult, SlotScheduler)
 from .engine import ServingEngine  # noqa: F401
 from .errors import (DeadlineExceeded, FleetOverloaded,  # noqa: F401
-                     InjectedFault, LoadShedError, NoHealthyReplicas,
-                     RetryBudgetExceeded, ServingError, ServingRejected,
-                     ServingUnavailable, ShuttingDown, TenantQuotaExceeded)
+                     InjectedFault, KVPoolExhausted, LoadShedError,
+                     NoHealthyReplicas, RetryBudgetExceeded, ServingError,
+                     ServingRejected, ServingUnavailable, ShuttingDown,
+                     TenantQuotaExceeded)
+from .kvcache import (PagedDecodeEngine,  # noqa: F401
+                      QuantizedPagedDecodeEngine, RadixPrefixCache,
+                      ShardedPagedDecodeEngine)
 from .fleet import FleetRouter, LocalFleet, TokenBucket  # noqa: F401
 from .placement import (DeviceInventory, ModelProfile,  # noqa: F401
                         NoFeasiblePlacement, PlacementPlan,
@@ -95,14 +109,17 @@ __all__ = [
     "ChaosInjector", "DeadlineExceeded", "DecodeEngine", "DeviceInventory",
     "FleetChaos", "FleetOverloaded", "FleetRouter", "FleetStats",
     "GenerationBatcher", "GenerationResult", "InjectedFault",
-    "LoadShedError", "LocalFleet", "MicroBatcher", "ModelProfile",
-    "NoFeasiblePlacement", "NoHealthyReplicas", "PlacementPlan",
+    "KVPoolExhausted", "LoadShedError", "LocalFleet", "MicroBatcher",
+    "ModelProfile", "NoFeasiblePlacement", "NoHealthyReplicas",
+    "PagedDecodeEngine", "PlacementPlan",
     "PlacementSearcher", "QuantizationError", "QuantizedDecodeEngine",
+    "QuantizedPagedDecodeEngine",
     "QuantizedServingEngine", "QuantizedStore", "QueueFullError",
-    "RetryBudgetExceeded", "ServingClient", "ServingEngine",
-    "ServingError", "ServingRejected",
+    "RadixPrefixCache", "RetryBudgetExceeded", "ServingClient",
+    "ServingEngine", "ServingError", "ServingRejected",
     "ServingServer", "ServingStats", "ServingUnavailable",
-    "ShardedDecodeEngine", "ShardedServingEngine", "ShuttingDown",
+    "ShardedDecodeEngine", "ShardedPagedDecodeEngine",
+    "ShardedServingEngine", "ShuttingDown",
     "SlotScheduler", "TenantQuotaExceeded", "TokenBucket",
     "TrafficProfile", "calibrate_error", "expected_collectives",
     "profile_export", "quantize_export",

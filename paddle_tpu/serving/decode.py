@@ -518,7 +518,7 @@ class _Generation:
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "trace_id",
                  "future", "t_submit", "t_first_token", "t_last_token",
-                 "tokens", "slot", "version", "timings", "done")
+                 "tokens", "slot", "version", "timings", "done", "peek")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline, trace_id):
         self.prompt = prompt
@@ -535,6 +535,7 @@ class _Generation:
         self.version = None  # params version pinned at admission
         self.timings: Dict[str, float] = {}
         self.done = False
+        self.peek = None  # memoized (prefix_epoch, hit_tokens)
 
 
 class GenerationResult:
@@ -756,9 +757,20 @@ class GenerationBatcher:
                                 "reason": reason,
                                 "weights_version": gen.version})
         if gen.t_first_token is not None:
-            tr.add_span("serve/prefill_ttft", gen.t_submit,
-                        gen.t_first_token - gen.t_submit, cat="serving",
-                        trace_id=gen.trace_id, parent=sid)
+            pid = tr.add_span("serve/prefill_ttft", gen.t_submit,
+                              gen.t_first_token - gen.t_submit,
+                              cat="serving", trace_id=gen.trace_id,
+                              parent=sid)
+            hit = gen.timings.get("prefix_hit_tokens")
+            if hit:
+                # the paged engine's radix match: how much of this TTFT
+                # was served from cached KV instead of prefill FLOPs
+                tr.add_span("serve/prefix_match", gen.t_submit,
+                            gen.timings.get("prefix_match", 0.0),
+                            cat="serving", trace_id=gen.trace_id,
+                            parent=pid,
+                            args={"hit_tokens": int(hit),
+                                  "prompt": int(gen.prompt.shape[0])})
 
     def _admit(self, gen: _Generation) -> bool:
         """Prefill one queued generation into a free slot. Returns False
@@ -766,10 +778,27 @@ class GenerationBatcher:
         t0 = time.monotonic()
         slot = self.engine.alloc_slot()
         try:
-            tok_dev, _logits, version = self.engine.prefill(slot, gen.prompt)
+            if getattr(self.engine, "supports_page_reservation", False):
+                # paged engine: claim the worst-case page span up front
+                # so pool pressure sheds HERE (typed, retryable) instead
+                # of failing an in-flight batch at a later boundary
+                tok_dev, _logits, version = self.engine.prefill(
+                    slot, gen.prompt,
+                    reserve_new_tokens=gen.max_new_tokens)
+            else:
+                tok_dev, _logits, version = self.engine.prefill(
+                    slot, gen.prompt)
             first = int(np.asarray(tok_dev)[0])  # host sync: TTFT token
         except Exception as e:
             self.engine.free_slot(slot)
+            if isinstance(e, QueueFullError):
+                # typed backpressure (KV page pool exhausted, nothing
+                # evictable): shed as a rejection, not a failure — the
+                # QueueFullError lineage is retryable once lanes retire
+                if self.stats:
+                    self.stats.record_reject()
+                self._resolve(gen, exc=e)
+                return False
             if self.stats:
                 self.stats.record_failure()
             self._resolve(gen, exc=e if isinstance(e, ServingUnavailable)
@@ -781,7 +810,16 @@ class GenerationBatcher:
         gen.tokens.append(first)
         gen.t_first_token = gen.t_last_token = time.monotonic()
         gen.timings["prefill"] = dt
-        bucket = self.engine.prompt_bucket(gen.prompt.shape[0])
+        hit = int(getattr(self.engine, "last_prefix_hit", 0))
+        if hit:
+            gen.timings["prefix_hit_tokens"] = hit
+            gen.timings["prefix_match"] = getattr(
+                self.engine, "last_prefix_match_s", 0.0)
+        # the measured cost belongs to the bucket actually prefilled: a
+        # prefix hit only ran the suffix (cache-aware admission prices
+        # the same bucket through peek_prefix_len)
+        bucket = self.engine.prompt_bucket(
+            max(1, gen.prompt.shape[0] - hit))
         self.scheduler.observe_prefill(bucket, dt)
         if self.stats:
             self.stats.record_stage("prefill", dt)
@@ -973,8 +1011,23 @@ class GenerationBatcher:
         queued = self._pull_queued(free)
         if not queued:
             return changed
-        buckets = [self.engine.prompt_bucket(g.prompt.shape[0])
-                   for g in queued]
+        # cache-aware admission (docs §22): a paged engine's prefix hit
+        # shrinks the modeled prefill cost to the uncached suffix, so
+        # high-hit requests admit earlier under the same stall budget.
+        # Peeks (a radix walk each) memoize per generation against the
+        # cache epoch — a deferred queue is re-priced only when an
+        # intern/evict/invalidate could have changed the answer
+        peek = getattr(self.engine, "peek_prefix_len", None)
+        epoch = getattr(self.engine, "prefix_epoch", 0)
+        buckets = []
+        for g in queued:
+            hit = 0
+            if peek is not None:
+                if g.peek is None or g.peek[0] != epoch:
+                    g.peek = (epoch, peek(g.prompt))
+                hit = g.peek[1]
+            buckets.append(self.engine.prompt_bucket(
+                max(1, g.prompt.shape[0] - hit)))
         oldest = time.monotonic() - queued[0].t_submit
         k = self.scheduler.plan(free, buckets, self.active,
                                 self.engine.window_bucket(self._max_pos()),

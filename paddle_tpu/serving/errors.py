@@ -56,6 +56,28 @@ class QueueFullError(ServingError):
                 "queue_depth": self.queue_depth, "capacity": self.capacity}
 
 
+class KVPoolExhausted(QueueFullError):
+    """Paged-KV backpressure (serving/kvcache.py): the page pool has no
+    free page and nothing cached is evictable (every cached page is
+    pinned by an in-flight generation). QueueFullError lineage — the
+    request was NOT admitted and MAY be retried once generations retire;
+    the wire shape adds the pool numbers so the operator can tell pool
+    pressure from queue pressure."""
+
+    def __init__(self, needed: int, free_pages: int, total_pages: int):
+        self.needed = needed
+        self.free_pages = free_pages
+        self.total_pages = total_pages
+        ServingError.__init__(
+            self, f"KV page pool exhausted: need {needed} page(s), "
+            f"{free_pages}/{total_pages} free and nothing evictable")
+
+    def info(self) -> Dict[str, Any]:
+        return {"code": "rejected", "reason": "kv_pool_exhausted",
+                "needed": self.needed, "free_pages": self.free_pages,
+                "total_pages": self.total_pages}
+
+
 class ShuttingDown(ServingError):
     """The server (or batcher) is draining/closed: not enqueued, retryable
     against a replica — this instance will not take new work."""

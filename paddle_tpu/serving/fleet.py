@@ -89,6 +89,17 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
     for servers predating a gauge. ONE source of truth — the router's
     scraper and ``paddle_cli fleet`` both read through here."""
     g = parse_prometheus_gauges(metrics_text)
+    # pt_serving_kv_pages is labeled by state (free|active|cached) and the
+    # first-sample rule above would keep only one — parse the family by
+    # hand (absent on unpaged replicas: all zeros)
+    kv = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("pt_serving_kv_pages{"):
+            try:
+                state = line.split('state="', 1)[1].split('"', 1)[0]
+                kv[state] = float(line.rsplit(None, 1)[1])
+            except (IndexError, ValueError):
+                pass
     return {
         "queue_depth": g.get("pt_serving_queue_depth",
                              float(hz.get("queue_depth", 0))),
@@ -111,6 +122,17 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
         # a capacity-aware router can weight replicas by real footprint
         "quant_mode": g.get("pt_serving_quant_mode", 0.0),
         "weights_bytes": g.get("pt_serving_weights_bytes", 0.0),
+        # paged-KV serving (docs §22): page-pool pressure + prefix-cache
+        # hit rate. A session-affinity router prefers the replica already
+        # holding a session's prefix (highest hit rate / cached pages);
+        # all zeros on unpaged replicas.
+        "kv_pages_free": kv.get("free", 0.0),
+        "kv_pages_active": kv.get("active", 0.0),
+        "kv_pages_cached": kv.get("cached", 0.0),
+        "prefix_hits": g.get("pt_serving_prefix_hits_total", 0.0),
+        "prefix_hit_tokens": g.get("pt_serving_prefix_hit_tokens_total",
+                                   0.0),
+        "prefix_hit_rate": g.get("pt_serving_prefix_hit_rate", 0.0),
     }
 
 

@@ -1,0 +1,804 @@
+"""Paged KV pool + radix-tree prefix cache (docs/design.md §22).
+
+The slot-pooled decode engine (serving/decode.py) reserves one dense
+worst-case ``[max_len, H, Dh]`` KV row per slot and pays full prefill for
+every generation — even though real traffic is dominated by shared
+prefixes (system prompts, few-shot templates, chat history). This module
+replaces both costs without touching the one thing the decode tier holds
+sacred: ONE compiled step per (lanes, chunk, window) signature and zero
+steady-state recompiles.
+
+* **Paged pool** — K/V live in ``pool_pages`` fixed-size page blocks
+  (``[L, pages+1, page_len, H, Dh]``; the +1 row is the trash page
+  inactive lanes write into, the paged sibling of the dense trash slot).
+  Each slot owns a page-table row — a STATIC-shape int32 gather index
+  passed to every dispatch — so the compiled step is the dense step plus
+  one gather level (``models/transformer.decode_forward_paged``). Pages
+  are allocated lazily at token boundaries: HBM reserved for KV follows
+  the tokens actually resident, not ``max_slots * max_len``, and the
+  default pool (``overcommit`` 2.0) reserves HALF the dense account at
+  equal ``max_slots`` (``placement.py`` carries the same arithmetic).
+* **Radix prefix cache** — completed prompt prefixes are interned into a
+  page-granular trie: one node per FULL page, keyed by the page's
+  ``page_len`` token ids under its parent's path (the KV of a token
+  depends on its whole prefix; the trie path IS that dependency).
+  Admission matches an incoming prompt against the trie and prefills
+  only the uncached suffix; matched pages are REF-COUNTED (a page read
+  by an in-flight generation is never freed) and unreferenced nodes are
+  evicted leaf-first LRU under a pool-pressure watermark. The cache is
+  keyed by ``weights_version``: a hot reload invalidates the whole tree
+  (wholly-old-or-wholly-new extends to cached KV — no stale-weights KV
+  is ever served), with still-referenced pages freed as their readers
+  retire.
+* **Bit-identity** — a matched page holds exactly the K/V an identical
+  prefill would recompute (greedy decode is deterministic), and the
+  paged gather flattens back to the dense ``[B, W, H, Dh]`` window, so
+  greedy streams are BIT-IDENTICAL to the unpaged engine: dense-vs-paged,
+  cold-vs-warm-prefix, and single-device-vs-tp-sharded parity are all
+  pinned in tests/test_serving_kvcache.py, and bench.py's
+  ``prefix_cache_decode`` workload re-asserts them every round.
+
+``PagedDecodeEngine`` is a drop-in ``DecodeEngine``: ``GenerationBatcher``
+(continuous batching, deadlines, drain, the reload barrier) runs on top
+unchanged, and the batcher's admission cost model sees the cache through
+``peek_prefix_len`` — a hit shrinks the modeled prefill cost, so
+high-hit requests admit earlier under the same stall budget (the
+SlotScheduler's cache-aware term). ``ShardedPagedDecodeEngine`` shards
+the page pool along heads exactly like the dense pool;
+``QuantizedPagedDecodeEngine`` keeps the pool f32 (quantization never
+touches KV, docs §20). Pool exhaustion sheds typed
+(``KVPoolExhausted``, QueueFullError lineage).
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .decode import DecodeEngine
+from .errors import KVPoolExhausted
+from .quant import QuantizedDecodeEngine
+from .sharded import ShardedDecodeEngine
+
+
+class PagePool:
+    """Host-side accounting of the device page pool: a free list plus a
+    per-page state tag (``free`` | ``active`` — exclusively owned by one
+    slot | ``cached`` — owned by the prefix tree). The device arrays live
+    on the engine (donated through the compiled step); this object only
+    decides WHICH page a position lands in."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError("page pool needs at least one page")
+        self.n_pages = int(n_pages)
+        self._free: List[int] = list(range(self.n_pages))
+        self._state = ["free"] * self.n_pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def counts(self) -> Dict[str, int]:
+        c = {"free": 0, "active": 0, "cached": 0}
+        for s in self._state:
+            c[s] += 1
+        return c
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise KVPoolExhausted(n, len(self._free), self.n_pages)
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._state[p] = "active"
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if self._state[p] == "free":
+                raise ValueError(f"double free of page {p}")
+            self._state[p] = "free"
+            self._free.append(p)
+
+    def to_cached(self, page: int) -> None:
+        """Transfer an active page's ownership to the prefix tree."""
+        if self._state[page] != "active":
+            raise ValueError(f"page {page} is {self._state[page]}, "
+                             f"not active")
+        self._state[page] = "cached"
+
+    def cached_free(self, page: int) -> None:
+        """The tree released a page (eviction / invalidation drain)."""
+        if self._state[page] != "cached":
+            raise ValueError(f"page {page} is {self._state[page]}, "
+                             f"not cached")
+        self._state[page] = "free"
+        self._free.append(page)
+
+
+class _RadixNode:
+    """One cached page: ``page_len`` tokens of K/V at one trie depth."""
+
+    __slots__ = ("key", "page", "children", "parent", "ref", "last_use",
+                 "dead")
+
+    def __init__(self, key, page, parent):
+        self.key = key          # tuple of page_len token ids
+        self.page = page        # physical page id
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.parent = parent
+        self.ref = 0            # in-flight generations reading this page
+        self.last_use = 0.0
+        self.dead = False       # invalidated; page freed when ref hits 0
+
+    def detach(self) -> None:
+        if self.parent is not None:
+            self.parent.children.pop(self.key, None)
+            self.parent = None
+
+
+class RadixPrefixCache:
+    """Page-granular radix tree over prompt token ids, keyed by
+    ``weights_version``. Not thread-safe by design — exactly one thread
+    (the batcher loop / a test) owns the engine's pool carry, and the
+    cache is part of that carry."""
+
+    def __init__(self, page_len: int, pool: PagePool, version: int = 1):
+        self.page_len = int(page_len)
+        self.pool = pool
+        self.version = int(version)
+        self.root = _RadixNode(None, None, None)
+        self.nodes = 0          # live (matchable) node count
+        self.evictions = 0
+        self.invalidations = 0
+        #: bumped whenever match results could change (insert adoption,
+        #: eviction, invalidation) — memoized peeks key on this
+        self.epoch = 0
+        #: live nodes with ref == 0 — the evictable-page count, kept
+        #: incrementally at every 0<->1 ref crossing so the admission
+        #: capacity check is O(1), not a tree walk
+        self.unpinned = 0
+        self._zombies: List[_RadixNode] = []  # dead, ref > 0
+
+    # -- matching --
+    def _chunks(self, tokens: np.ndarray, n_pages: int):
+        pl = self.page_len
+        for j in range(n_pages):
+            yield tuple(int(t) for t in tokens[j * pl:(j + 1) * pl])
+
+    def match(self, tokens: np.ndarray, version: int) -> List[_RadixNode]:
+        """Longest cached chain of FULL pages covering a strict prefix of
+        ``tokens`` — capped at ``(len - 1) // page_len`` pages so at
+        least one suffix token is always left to prefill (the first
+        generated token comes from real logits, never from the cache)."""
+        if version != self.version:
+            return []
+        cap = (len(tokens) - 1) // self.page_len
+        out: List[_RadixNode] = []
+        node = self.root
+        for chunk in self._chunks(tokens, cap):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        return out
+
+    def acquire(self, nodes: Sequence[_RadixNode]) -> None:
+        now = time.monotonic()
+        for n in nodes:
+            if n.ref == 0 and not n.dead:
+                self.unpinned -= 1
+            n.ref += 1
+            n.last_use = now
+
+    def release(self, nodes: Sequence[_RadixNode]) -> None:
+        now = time.monotonic()
+        for n in nodes:
+            n.ref -= 1
+            n.last_use = now
+            if n.ref == 0:
+                if n.dead:
+                    # invalidated while read: the page outlived the tree
+                    # only for its in-flight readers, which just retired
+                    self.pool.cached_free(n.page)
+                    try:
+                        self._zombies.remove(n)
+                    except ValueError:
+                        pass
+                else:
+                    self.unpinned += 1
+
+    # -- interning --
+    def insert(self, tokens: np.ndarray, first_page: int,
+               pages: Sequence[int], version: int
+               ) -> List[Tuple[_RadixNode, bool]]:
+        """Intern pages ``first_page .. first_page+len(pages)-1`` of a
+        prompt whose earlier pages are already cached (the matched
+        chain). Returns ``[(node, adopted)]`` per page: ``adopted=True``
+        means the tree took ownership of OUR page; ``False`` means an
+        equal prefix was interned concurrently and the existing node
+        stands (our page stays with the caller). A version mismatch
+        interns nothing — KV computed under old weights never enters the
+        new tree."""
+        if version != self.version or not pages:
+            return []
+        node = self.root
+        out: List[Tuple[_RadixNode, bool]] = []
+        now = time.monotonic()
+        for j, chunk in enumerate(self._chunks(
+                tokens, first_page + len(pages))):
+            child = node.children.get(chunk)
+            if j < first_page:
+                if child is None:  # matched chain evicted underneath us —
+                    return out     # impossible while acquired; be safe
+                node = child
+                continue
+            if child is None:
+                child = _RadixNode(chunk, pages[j - first_page], node)
+                child.last_use = now
+                node.children[chunk] = child
+                self.nodes += 1
+                self.epoch += 1
+                self.unpinned += 1  # born ref 0; the interner acquires
+                out.append((child, True))
+            else:
+                child.last_use = now
+                out.append((child, False))
+            node = child
+        return out
+
+    # -- eviction / invalidation --
+    def _evictable_leaves(self) -> List[_RadixNode]:
+        out = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.ref == 0:
+                out.append(n)
+        return out
+
+    def evictable_count(self) -> int:
+        """Live cached pages with no in-flight reader — O(1), maintained
+        at every 0<->1 ref crossing. Readers acquire whole root-paths,
+        so ``parent.ref >= child.ref`` always holds and every ref==0
+        node heads a fully-evictable subtree: the unpinned count IS the
+        evictable-page count."""
+        return self.unpinned
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages, oldest-unused leaves first (a
+        parent becomes a leaf once its children go, so deep cold chains
+        drain root-ward). Pages pinned by in-flight readers (ref > 0)
+        are NEVER freed. Returns the number actually freed."""
+        import heapq
+
+        # one DFS for the initial leaf set, then a heap: evicting a
+        # chain's tail pushes its newly-exposed parent as a candidate
+        # (an older parent must go before a warmer chain's leaf), at
+        # O(log n) per page instead of a full-tree rescan per page
+        heap = [(n.last_use, id(n), n) for n in self._evictable_leaves()]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < n_pages and heap:
+            _, _, n = heapq.heappop(heap)
+            if n.children or n.ref != 0 or n.parent is None:
+                continue  # stale candidate
+            parent = n.parent
+            n.detach()
+            self.pool.cached_free(n.page)
+            self.nodes -= 1
+            self.unpinned -= 1  # only ref==0 nodes reach here
+            self.evictions += 1
+            self.epoch += 1
+            freed += 1
+            if parent is not self.root and not parent.children \
+                    and parent.ref == 0:
+                heapq.heappush(heap, (parent.last_use, id(parent), parent))
+        return freed
+
+    def invalidate(self, new_version: int) -> None:
+        """Hot reload committed: every cached page was computed under the
+        old weights and must never be matched again. Unreferenced pages
+        free immediately; pages still read by in-flight (old-version)
+        generations become zombies and free at release."""
+        stack = list(self.root.children.values())
+        self.root.children = {}
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            n.parent = None
+            n.dead = True
+            self.nodes -= 1
+            if n.ref == 0:
+                self.pool.cached_free(n.page)
+            else:
+                self._zombies.append(n)
+        self.version = int(new_version)
+        self.invalidations += 1
+        self.epoch += 1
+        self.unpinned = 0  # no live nodes remain
+
+
+class _PagedKVMixin:
+    """The paged-pool behavior, mixed over any decode-roles engine
+    (plain / sharded / quantized). Overrides the pool allocation, the
+    chunk function, dispatch (page backing + the table input), prefill
+    (prefix match + suffix-only chunk train + interning), and the slot
+    lifecycle; everything else — compile cache, reload staging, chaos
+    hooks, the batcher on top — is inherited unchanged."""
+
+    def __init__(self, dirname: str, *args,
+                 page_len: int = 16, pool_pages: Optional[int] = None,
+                 overcommit: float = 2.0, evict_watermark: float = 0.0,
+                 prefix_cache: bool = True, **kw):
+        self.page_len = int(page_len)
+        if self.page_len < 1:
+            raise ValueError("page_len must be >= 1")
+        self._pool_pages_req = pool_pages
+        self.overcommit = float(overcommit)
+        if self.overcommit < 1.0:
+            raise ValueError("overcommit must be >= 1.0 (an overcommit "
+                             "below 1 reserves MORE than the dense pool)")
+        self.evict_watermark = float(evict_watermark)
+        if not 0.0 <= self.evict_watermark < 1.0:
+            raise ValueError("evict_watermark is a free-pool fraction in "
+                             "[0, 1)")
+        self._prefix_enabled = bool(prefix_cache)
+        self.prefix_queries = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.last_prefix_hit = 0
+        self.last_prefix_match_s = 0.0
+        super().__init__(dirname, *args, **kw)
+        for b in self.kv_buckets:
+            if b % self.page_len:
+                raise ValueError(
+                    f"page_len {self.page_len} must divide every KV "
+                    f"window bucket (got {self.kv_buckets})")
+        # the warm ladder is bigger than the dense diagonal one (every
+        # chunk-under-wider-window pair): the LRU compile cache must hold
+        # ALL of warmup's signatures or warmup evicts its own work and
+        # steady state recompiles anyway
+        k = len(self.kv_buckets)
+        need = 2 * k + k * (k - 1) // 2 + 4
+        if self.cache_capacity < need:
+            self.cache_capacity = need
+
+    # -- pool/paging state (rebuilt by every _alloc_pools call) --
+    def _init_paging(self) -> None:
+        c = self.cfg
+        if self.max_len % self.page_len:
+            raise ValueError(f"page_len {self.page_len} must divide "
+                             f"max_len {self.max_len}")
+        self.pages_per_slot = self.max_len // self.page_len
+        pages = self._pool_pages_req
+        if pages is None:
+            pages = math.ceil(self.max_slots * self.pages_per_slot
+                              / self.overcommit)
+        # one generation can always run to max_len, whatever the ratio
+        self.pool_pages = max(int(pages), self.pages_per_slot)
+        self.trash_page = self.pool_pages
+        L, H = c["n_layers"], c["n_heads"]
+        Dh = c["d_model"] // H
+        self._pool_shape = (L, self.pool_pages + 1, self.page_len, H, Dh)
+        self.page_pool = PagePool(self.pool_pages)
+        self.prefix_cache = RadixPrefixCache(
+            self.page_len, self.page_pool,
+            version=self.params_version) if self._prefix_enabled else None
+        n_rows = self.max_slots + 1
+        self._page_table = np.full((n_rows, self.pages_per_slot),
+                                   self.trash_page, np.int32)
+        self._slot_owned: List[List[int]] = [[] for _ in range(n_rows)]
+        self._slot_nodes: List[List[_RadixNode]] = [[] for _ in range(n_rows)]
+        self._slot_mapped = [0] * n_rows
+        self._slot_reserved = [0] * n_rows
+        self._frontier = [0] * n_rows
+
+    def _alloc_pools(self):
+        # resets ALL page/cache accounting with the device arrays — only
+        # sound with no slot in flight (warmup hygiene, like the dense
+        # reset_pool contract)
+        self._init_paging()
+        return super()._alloc_pools()
+
+    def kv_pages_info(self) -> Dict[str, int]:
+        c = self.page_pool.counts()
+        c.update(total=self.pool_pages, page_len=self.page_len)
+        return c
+
+    def prefix_info(self) -> Dict[str, int]:
+        return {"queries": self.prefix_queries, "hits": self.prefix_hits,
+                "hit_tokens": self.prefix_hit_tokens,
+                "nodes": self.prefix_cache.nodes if self.prefix_cache else 0,
+                "evictions": (self.prefix_cache.evictions
+                              if self.prefix_cache else 0)}
+
+    def kv_pool_bytes(self) -> int:
+        """Device bytes of the paged K+V pool (full, pre-tp-split)."""
+        return int(2 * 4 * np.prod(self._pool_shape))
+
+    # -- page allocation --
+    def _alloc_pages(self, n: int) -> List[int]:
+        pool = self.page_pool
+        deficit = n - pool.free_count
+        if deficit > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(deficit)
+        if n > pool.free_count:
+            raise KVPoolExhausted(n, pool.free_count, pool.n_pages)
+        pages = pool.alloc(n)
+        if self.evict_watermark > 0 and self.prefix_cache is not None:
+            target = int(math.ceil(self.evict_watermark * pool.n_pages))
+            if pool.free_count < target:
+                self.prefix_cache.evict(target - pool.free_count)
+        return pages
+
+    def _ensure_slot_pages(self, slot: int, upto_pos: int) -> None:
+        need = math.ceil(min(upto_pos, self.max_len) / self.page_len)
+        have = self._slot_mapped[slot]
+        if need <= have:
+            return
+        pages = self._alloc_pages(need - have)
+        for p in pages:
+            self._page_table[slot, have] = p
+            self._slot_owned[slot].append(p)
+            have += 1
+        self._slot_mapped[slot] = have
+
+    def _unbacked_reservations(self) -> int:
+        """Worst-case pages admitted generations may still demand: the
+        sum over slots of (reserved - already mapped). The admission
+        invariant ``unbacked <= free + evictable`` makes mid-generation
+        exhaustion impossible for reservation-admitted traffic — every
+        future page claim is covered by a free page or an unpinned
+        cached page eviction can reclaim."""
+        return sum(max(0, r - m) for r, m in zip(self._slot_reserved,
+                                                 self._slot_mapped))
+
+    def _release_slot(self, slot: int) -> None:
+        nodes, self._slot_nodes[slot] = self._slot_nodes[slot], []
+        if nodes and self.prefix_cache is not None:
+            self.prefix_cache.release(nodes)
+        owned, self._slot_owned[slot] = self._slot_owned[slot], []
+        if owned:
+            self.page_pool.free(owned)
+        self._slot_mapped[slot] = 0
+        self._slot_reserved[slot] = 0
+        self._frontier[slot] = 0
+        self._page_table[slot, :] = self.trash_page
+
+    def free_slot(self, slot: int) -> None:
+        super().free_slot(slot)
+        self._release_slot(slot)
+
+    # -- compiled step: the paged chunk fn --
+    def _make_chunk_fn(self, lanes: int, chunk: int, window: int):
+        import functools
+
+        import jax
+
+        from ..models.transformer import decode_forward_paged
+
+        mesh = getattr(self, "mesh", None)
+        tp = getattr(self, "tp", 1)
+        if mesh is None:
+            return jax.jit(functools.partial(
+                decode_forward_paged, cfg=self.cfg, window=window,
+                page_len=self.page_len), donate_argnums=(1, 2))
+        # sharded: pools hold each rank's head subset (axis 3 of the
+        # paged shape, exactly like the dense pool's _pool_spec); params
+        # are column shards; the page table replicates
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel._compat import shard_map
+
+        with self._lock:
+            specs = self._param_specs_pytree(self._params)
+        body = functools.partial(decode_forward_paged, cfg=self.cfg,
+                                 window=window, page_len=self.page_len,
+                                 tp=tp, tp_axis="tp" if tp > 1 else None)
+        pool = self._pool_spec()
+        fn = shard_map(
+            lambda p, pk, pv, tok, pos, val, slot, tab:
+                body(p, pk, pv, tok, pos, val, slot, tab),
+            mesh=mesh,
+            in_specs=(specs, pool, pool, P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), pool, pool), check_vma=False)
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def dispatch_chunk(self, tokens, positions, valids, slots,
+                       window: int):
+        """The dense dispatch plus page backing: before the device call,
+        every valid lane's write span gets pages (lazy allocation — the
+        per-slot frontier is the host's mirror of ``positions``, which
+        may be a device carry we must not sync). The page table rides as
+        one small replicated int32 input; the compile-cache key is
+        unchanged, so zero steady-state recompiles stays a hard
+        contract. ``slots``/``valids`` are host arrays at every call
+        site (the batcher's steady-state carry keeps only
+        tokens/positions on device)."""
+        import jax
+
+        if window % self.page_len:
+            raise ValueError(f"window {window} not a multiple of "
+                             f"page_len {self.page_len}")
+        slots_np = np.asarray(slots, np.int32)
+        valids_np = np.asarray(valids, np.int32)
+        tokens = jax.numpy.asarray(tokens, jax.numpy.int32)
+        lanes, chunk = tokens.shape
+        for i in range(lanes):
+            s = int(slots_np[i])
+            v = int(valids_np[i])
+            if v <= 0 or s >= self.max_slots:
+                continue
+            # back the VALID span only: a bucket-padded tail's garbage
+            # writes land in the trash page through the unmapped table
+            # entries (they are masked until a later real write maps a
+            # page and produces the position for real — the paged
+            # sibling of dense write-then-overwrite-before-visible), so
+            # padding never costs pages
+            self._ensure_slot_pages(s, self._frontier[s] + v)
+            self._frontier[s] += v
+        entry = self._get_fn(lanes, chunk, window)
+        if self.chaos is not None:
+            self.chaos.on_dispatch()
+        with self._lock:
+            params = self._params
+            version = self.params_version
+        cold = entry.cold
+        t0 = time.monotonic() if cold else 0.0
+        with jax.default_device(self._device):
+            # the table goes as host numpy: jit places (and on a mesh,
+            # replicates) it per spec; at max_slots * max_len/page_len
+            # int32s the per-dispatch upload is noise
+            next_tok, logits, new_pos, self.pool_k, self.pool_v = entry.fn(
+                params, self.pool_k, self.pool_v, tokens,
+                jax.numpy.asarray(positions, jax.numpy.int32),
+                jax.numpy.asarray(valids_np),
+                jax.numpy.asarray(slots_np), self._page_table.copy())
+        if cold:
+            entry.compile_s = time.monotonic() - t0
+            entry.cold = False
+            from ..obs import get_tracer
+
+            tr = get_tracer()
+            if tr.enabled:
+                tr.add_span("serving/decode_compile", t0, entry.compile_s,
+                            cat="compile", args={"lanes": lanes,
+                                                 "chunk": chunk,
+                                                 "window": window,
+                                                 "paged": True})
+        if getattr(self, "tp", 1) > 1 and hasattr(self,
+                                                  "_record_collectives"):
+            self._record_collectives(lanes, seq=chunk)
+        return next_tok, logits, new_pos, version
+
+    # -- prefill: match, suffix-only chunk train, intern --
+    @property
+    def prefix_epoch(self) -> int:
+        """Changes whenever a peek could change (intern/evict/invalidate)
+        — the batcher memoizes per-generation peeks against this."""
+        return self.prefix_cache.epoch if self.prefix_cache is not None \
+            else 0
+
+    def peek_prefix_len(self, prompt) -> int:
+        """Cached-prefix length (tokens) an admission of ``prompt`` would
+        reuse RIGHT NOW — read-only (no refs, no LRU touch). The batcher
+        feeds this to the slot scheduler so the cost model prices only
+        the uncached suffix."""
+        if self.prefix_cache is None:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            version = self.params_version
+        return len(self.prefix_cache.match(prompt, version)) * self.page_len
+
+    #: GenerationBatcher._admit passes the generation budget so the whole
+    #: resident span is reserved (see prefill's reserve_new_tokens)
+    supports_page_reservation = True
+
+    def prefill(self, slot: int, prompt: np.ndarray,
+                use_cache: bool = True,
+                reserve_new_tokens: Optional[int] = None
+                ) -> Tuple[Any, Any, int]:
+        """Prefix-aware prefill: the longest cached full-page chain maps
+        straight into the slot's page table (acquired, never copied) and
+        only the suffix runs device chunks — TTFT and prefill FLOPs drop
+        by the hit fraction. After the train, the prompt's OWN full
+        pages are interned so concurrent identical prompts hit without
+        waiting for retirement. ``use_cache=False`` (warmup) bypasses
+        both match and intern so the compile ladder is exercised
+        end-to-end and the tree stays clean.
+
+        ``reserve_new_tokens`` (the batcher passes the generation's
+        budget) reserves the WORST-CASE page span — ``ceil((prompt +
+        budget) / page_len)`` capped at the pool row — against ``free +
+        evictable`` before any device work: if admitting this generation
+        could later starve the pool (its own growth, or another
+        reservation's) it sheds HERE, typed (``KVPoolExhausted``,
+        QueueFullError lineage), instead of killing an in-flight batch
+        at some future token boundary. Pages still allocate lazily —
+        reservation is a capacity claim, not an allocation — so shared
+        prefix pages and early-EOS retirements keep the pool win."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        self.prompt_bucket(n)  # length guard
+        self._release_slot(slot)  # warmup / tests reuse slots freely
+        with self._lock:
+            version_now = self.params_version
+        hit_nodes: List[_RadixNode] = []
+        hit = 0
+        self.last_prefix_match_s = 0.0
+        if use_cache and self.prefix_cache is not None:
+            t0 = time.monotonic()
+            self.prefix_queries += 1
+            hit_nodes = self.prefix_cache.match(prompt, version_now)
+            if hit_nodes:
+                self.prefix_cache.acquire(hit_nodes)
+                self._slot_nodes[slot] = list(hit_nodes)
+                for j, nd in enumerate(hit_nodes):
+                    self._page_table[slot, j] = nd.page
+                self._slot_mapped[slot] = len(hit_nodes)
+                hit = len(hit_nodes) * self.page_len
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += hit
+            self.last_prefix_match_s = time.monotonic() - t0
+        # admission capacity check: this slot's worst-case claim, on top
+        # of every other in-flight claim, must fit free + evictable
+        span = n if reserve_new_tokens is None \
+            else min(n + int(reserve_new_tokens), self.max_len)
+        reserve = math.ceil(span / self.page_len)
+        need = max(0, reserve - self._slot_mapped[slot])
+        pool = self.page_pool
+        evictable = (self.prefix_cache.evictable_count()
+                     if self.prefix_cache is not None else 0)
+        if self._unbacked_reservations() + need \
+                > pool.free_count + evictable:
+            free_now = pool.free_count
+            self._release_slot(slot)  # drop the acquired hit refs
+            raise KVPoolExhausted(need, free_now, pool.n_pages)
+        self._slot_reserved[slot] = reserve
+        self.last_prefix_hit = hit
+        self._frontier[slot] = hit
+        chunk = self.prefill_chunk if self.prefill_chunk > 0 else 0
+        out = None
+        start = hit
+        while start < n:
+            if chunk:
+                c = chunk
+                valid = min(c, n - start)
+            else:
+                c = self.prompt_bucket(n - hit)
+                valid = n - start
+            buf = np.zeros((1, c), np.int32)
+            buf[0, :valid] = prompt[start:start + valid]
+            window = self.window_bucket(start + valid)
+            out = self.dispatch_chunk(
+                buf, np.array([start], np.int32),
+                np.array([valid], np.int32),
+                np.array([slot], np.int32), window)
+            start += valid
+        next_tok, logits, _new_pos, version = out
+        if use_cache and self.prefix_cache is not None \
+                and version == version_now \
+                and version == self.prefix_cache.version:
+            self._intern(slot, prompt, len(hit_nodes))
+        return next_tok, logits, version
+
+    def _intern(self, slot: int, prompt: np.ndarray,
+                matched_pages: int) -> None:
+        full = prompt.shape[0] // self.page_len
+        if full <= matched_pages:
+            return
+        pages = [int(self._page_table[slot, j])
+                 for j in range(matched_pages, full)]
+        placed = self.prefix_cache.insert(prompt, matched_pages, pages,
+                                          self.prefix_cache.version)
+        for (node, adopted), page in zip(placed, pages):
+            if adopted:
+                # ownership moves to the tree; this generation keeps
+                # reading the page, so it pins it like a matched node
+                self._slot_owned[slot].remove(page)
+                self.page_pool.to_cached(page)
+                self.prefix_cache.acquire([node])
+                self._slot_nodes[slot].append(node)
+            # not adopted: a concurrent identical prefill interned the
+            # same chunk first — our copy stays slot-owned (the table
+            # already points at it; values are bit-identical) and frees
+            # at retirement
+
+    def warmup(self) -> int:
+        """The dense warmup ladder with the prefix cache bypassed (a hit
+        would skip chunks of the train and leave signatures to compile
+        at serve time; zero-prompt warmup traffic must not be interned),
+        PLUS the warm-prefix suffix signatures: a prefix hit makes a
+        whole-prompt prefill run chunk bucket ``prompt_bucket(n - hit)``
+        under window ``window_bucket(n)`` — OFF-DIAGONAL (chunk <
+        window) pairs the dense diagonal ladder never mints. Every such
+        pair is precompiled here (O(ladder²/2) extra signatures), so
+        the first warm request per shape does NOT pay a serve-time
+        compile — the zero-steady-state-recompiles contract covers warm
+        prefixes too (the bench workload's gate snapshots misses right
+        after this call)."""
+        misses0 = self.cache_misses
+        slot = self.alloc_slot()
+        try:
+            for b in self.kv_buckets:
+                self.prefill(slot, np.zeros(min(b, self.max_len - 1),
+                                            np.int32), use_cache=False)
+            if self.prefill_chunk <= 0 and self._prefix_enabled:
+                # off-diagonal warm-suffix pairs: chunk c under every
+                # wider window w, driven through the trash slot (writes
+                # land in the trash page; no pages, no interning)
+                for ci, c in enumerate(self.kv_buckets):
+                    for w in self.kv_buckets[ci + 1:]:
+                        self.dispatch_chunk(
+                            np.zeros((1, c), np.int32),
+                            np.zeros(1, np.int32),
+                            np.full(1, c, np.int32),
+                            np.full(1, self.trash_slot, np.int32), w)
+            for w in self.kv_buckets:
+                lanes = self.max_slots
+                toks = np.zeros((lanes, 1), np.int32)
+                self.dispatch_chunk(
+                    toks, np.zeros(lanes, np.int32),
+                    np.zeros(lanes, np.int32),
+                    np.full(lanes, self.trash_slot, np.int32), w)
+        finally:
+            self.free_slot(slot)
+            self.reset_pool()
+        return self.cache_misses - misses0
+
+    # -- reload: commit invalidates the tree --
+    def commit_params(self, staged) -> int:
+        version = super().commit_params(staged)
+        if self.prefix_cache is not None:
+            self.prefix_cache.invalidate(version)
+        return version
+
+
+class PagedDecodeEngine(_PagedKVMixin, DecodeEngine):
+    """Single-device decode engine over the paged KV pool + radix prefix
+    cache. Drop-in for ``DecodeEngine`` under ``GenerationBatcher``."""
+
+
+class ShardedPagedDecodeEngine(_PagedKVMixin, ShardedDecodeEngine):
+    """Paged decode over a tp mesh: the page pool shards along HEADS
+    (``[L, pages+1, page_len, H/tp, Dh]`` per rank — the same axis and
+    spec as the dense sharded pool), params column-shard, the page table
+    replicates, and the prefix cache is host-side state shared by all
+    shards (one table row names the same pages on every rank). Greedy
+    streams stay bit-identical to the single-device paged engine."""
+
+    def measured_collectives(self, window: Optional[int] = None) -> int:
+        """all-gather count in the compiled steady-state paged step."""
+        import jax
+
+        from .sharded import count_hlo_collectives
+
+        window = window or self.kv_buckets[0]
+        entry = self._get_fn(self.max_slots, 1, window)
+        toks = np.zeros((self.max_slots, 1), np.int32)
+        zeros = np.zeros(self.max_slots, np.int32)
+        slots = np.full(self.max_slots, self.trash_slot, np.int32)
+        with self._lock:
+            params = self._params
+        txt = entry.fn.lower(
+            params, self.pool_k, self.pool_v,
+            jax.numpy.asarray(toks), zeros, zeros, slots,
+            jax.numpy.asarray(self._page_table)).compile().as_text()
+        return count_hlo_collectives(txt)
+
+
+class QuantizedPagedDecodeEngine(_PagedKVMixin, QuantizedDecodeEngine):
+    """Weight-only quantized params over the paged pool. The pool (and
+    every cached page) stays f32 — quantization never touches KV
+    (docs §20) — so prefix reuse composes with the quantized lane
+    without touching its accuracy contract."""

@@ -137,14 +137,25 @@ class TrafficProfile:
 
     ``batch_mix`` is ``[(rows, weight)]``; weights need not sum to 1.
     ``decode_slots > 0`` adds the decode KV pool's per-device head shard
-    to the HBM account (the pool rides the same tp split)."""
+    to the HBM account (the pool rides the same tp split). With
+    ``kv_page_len`` set the account is the PAGED pool (docs §22):
+    ``pages * page_len`` resident positions instead of the dense
+    ``max_slots * max_len`` worst case, where ``pages`` defaults to the
+    dense position count divided by ``kv_overcommit`` — the overcommit
+    ratio is the operator's statement of expected prefix sharing +
+    partial residency, and the searcher prices exactly the pool the
+    paged engine would allocate."""
 
-    __slots__ = ("batch_mix", "seq_len", "p95_budget_ms", "decode_slots")
+    __slots__ = ("batch_mix", "seq_len", "p95_budget_ms", "decode_slots",
+                 "kv_page_len", "kv_overcommit", "kv_pages")
 
     def __init__(self, batch_mix: Sequence[Tuple[int, float]],
                  seq_len: Optional[int] = None,
                  p95_budget_ms: Optional[float] = None,
-                 decode_slots: int = 0):
+                 decode_slots: int = 0,
+                 kv_page_len: Optional[int] = None,
+                 kv_overcommit: float = 2.0,
+                 kv_pages: Optional[int] = None):
         mix = [(int(b), float(w)) for b, w in batch_mix if w > 0]
         if not mix or any(b < 1 for b, _ in mix):
             raise ValueError(f"batch_mix needs positive rows/weights: "
@@ -153,6 +164,9 @@ class TrafficProfile:
         self.seq_len = seq_len
         self.p95_budget_ms = p95_budget_ms
         self.decode_slots = int(decode_slots)
+        self.kv_page_len = int(kv_page_len) if kv_page_len else None
+        self.kv_overcommit = float(kv_overcommit)
+        self.kv_pages = int(kv_pages) if kv_pages else None
 
     @classmethod
     def from_stats(cls, stats, seq_len: Optional[int] = None,
@@ -181,7 +195,10 @@ class TrafficProfile:
     def as_dict(self) -> Dict[str, Any]:
         return {"batch_mix": list(self.batch_mix), "seq_len": self.seq_len,
                 "p95_budget_ms": self.p95_budget_ms,
-                "decode_slots": self.decode_slots}
+                "decode_slots": self.decode_slots,
+                "kv_page_len": self.kv_page_len,
+                "kv_overcommit": self.kv_overcommit,
+                "kv_pages": self.kv_pages}
 
 
 #: decode-style param-pytree roles whose matmul weights column-shard 1/tp
@@ -323,6 +340,25 @@ class ModelProfile:
         f32 each (serving/decode.py's pool shape)."""
         c = self.cfg
         return 2.0 * 4 * c["n_layers"] * (slots + 1) * c["max_len"] \
+            * c["d_model"]
+
+    def decode_paged_pool_bytes(self, slots: int, page_len: int = 16,
+                                overcommit: float = 2.0,
+                                pages: Optional[int] = None) -> float:
+        """K+V bytes of the PAGED pool (serving/kvcache.py's shape,
+        ``[L, pages+1, page_len, H, Dh]`` f32 each, pre-tp-split).
+        ``pages`` defaults to the engine's own sizing rule — the dense
+        position count over the overcommit ratio, floored at one full
+        generation — so the searcher and the allocator agree to the
+        byte. Strictly below ``decode_pool_bytes`` at equal slots for
+        any overcommit > 1 (asserted by the bench workload's byte
+        gate)."""
+        c = self.cfg
+        per_slot = c["max_len"] // page_len
+        if pages is None:
+            pages = max(math.ceil(slots * per_slot / max(overcommit, 1.0)),
+                        per_slot)
+        return 2.0 * 4 * c["n_layers"] * (pages + 1) * page_len \
             * c["d_model"]
 
     def as_dict(self) -> Dict[str, Any]:
@@ -494,8 +530,14 @@ class PlacementSearcher:
             return (max(compute_s, hbm_s) + comm_s, compute_s, hbm_s,
                     comm_s)
 
-        pool = prof.decode_pool_bytes(tr.decode_slots) / tp \
-            if tr.decode_slots else 0.0
+        if tr.decode_slots and tr.kv_page_len:
+            pool = prof.decode_paged_pool_bytes(
+                tr.decode_slots, tr.kv_page_len, tr.kv_overcommit,
+                tr.kv_pages) / tp
+        elif tr.decode_slots:
+            pool = prof.decode_pool_bytes(tr.decode_slots) / tp
+        else:
+            pool = 0.0
         peak_b_loc = math.ceil(max(b for b, _ in tr.batch_mix) / dp)
         hbm_per_dev = per_dev_params + act_bytes(peak_b_loc) + pool
         plan = PlacementPlan(

@@ -443,19 +443,39 @@ class ServingServer(socketserver.ThreadingTCPServer):
                         max_len=dcfg.pop("max_len", None),
                         kv_buckets=dcfg.pop("kv_buckets", None),
                         prefill_chunk=dcfg.pop("prefill_chunk", None))
+                    # paged KV pool + radix prefix cache (docs §22):
+                    # "paged": True arms it; the page knobs imply it
+                    page_knobs = {k: dcfg.pop(k) for k in
+                                  ("page_len", "pool_pages", "overcommit",
+                                   "evict_watermark", "prefix_cache")
+                                  if k in dcfg}
+                    paged = bool(dcfg.pop("paged", False)) or bool(page_knobs)
+                    if paged:
+                        dknobs.update(page_knobs)
                     if self.mesh_spec and self.mesh_spec["tp"] > 1:
                         # decode rides the tp axis only: the slot pool IS
                         # the batch; its dp story is fleet replicas (§18)
-                        from .sharded import ShardedDecodeEngine
-
-                        self.decode_engine = ShardedDecodeEngine(
+                        if paged:
+                            from .kvcache import ShardedPagedDecodeEngine \
+                                as _Dec
+                        else:
+                            from .sharded import ShardedDecodeEngine as _Dec
+                        self.decode_engine = _Dec(
                             decode_dir, tp=self.mesh_spec["tp"],
                             quantize=self.quant_mode, **dknobs)
                     elif self.quant_mode is not None:
-                        from .quant import QuantizedDecodeEngine
-
-                        self.decode_engine = QuantizedDecodeEngine(
+                        if paged:
+                            from .kvcache import QuantizedPagedDecodeEngine \
+                                as _Dec
+                        else:
+                            from .quant import QuantizedDecodeEngine as _Dec
+                        self.decode_engine = _Dec(
                             decode_dir, mode=self.quant_mode, **dknobs)
+                    elif paged:
+                        from .kvcache import PagedDecodeEngine
+
+                        self.decode_engine = PagedDecodeEngine(decode_dir,
+                                                               **dknobs)
                     else:
                         self.decode_engine = DecodeEngine(decode_dir,
                                                           **dknobs)
@@ -578,6 +598,30 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 r.gauge("pt_serving_decode_pending",
                         "Accepted generations not yet resolved",
                         callback=lambda: self.gen_batcher.pending)
+            if hasattr(self.decode_engine, "kv_pages_info"):
+                # paged KV pool + prefix cache (docs §22): page states
+                # feed capacity-aware routing, the hit gauges feed
+                # session-affinity scoring (a replica already holding a
+                # session's prefix serves its next turn cheapest)
+                _eng = self.decode_engine
+                kvg = r.gauge("pt_serving_kv_pages",
+                              "Paged KV pool pages by state",
+                              labelnames=("state",))
+                for st in ("free", "active", "cached"):
+                    kvg.labels(state=st).set_callback(
+                        lambda s=st: _eng.kv_pages_info()[s])
+                r.gauge("pt_serving_prefix_hits_total",
+                        "Admissions that reused a cached prefix",
+                        callback=lambda: _eng.prefix_hits)
+                r.gauge("pt_serving_prefix_hit_tokens_total",
+                        "Prompt tokens served from cached KV instead of "
+                        "prefill",
+                        callback=lambda: _eng.prefix_hit_tokens)
+                r.gauge("pt_serving_prefix_hit_rate",
+                        "prefix hits / prefix queries",
+                        callback=lambda: (_eng.prefix_hits
+                                          / _eng.prefix_queries
+                                          if _eng.prefix_queries else 0.0))
             # health state machine + probabilistic load shedding
             self.degraded_queue_ratio = degraded_queue_ratio
             self.degraded_error_ratio = degraded_error_ratio
@@ -724,6 +768,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 "active_slots": self.decode_engine.active_slots,
                 "queue_depth": self.gen_batcher.queue_depth,
                 "weights_version": self.decode_engine.params_version}
+            if hasattr(self.decode_engine, "kv_pages_info"):
+                h["decode"]["kv_pages"] = self.decode_engine.kv_pages_info()
+                h["decode"]["prefix"] = self.decode_engine.prefix_info()
         return h
 
     def metrics_text(self) -> str:
@@ -753,6 +800,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
         if self.gen_batcher is not None:
             extra["decode_compile_cache"] = self.decode_engine.cache_info()
             extra["decode_queue_depth"] = self.gen_batcher.queue_depth
+            if hasattr(self.decode_engine, "kv_pages_info"):
+                extra["decode_kv_pages"] = self.decode_engine.kv_pages_info()
+                extra["decode_prefix"] = self.decode_engine.prefix_info()
         if self.chaos is not None:
             extra["chaos"] = self.chaos.snapshot()
         return self.stats.snapshot(extra=extra)

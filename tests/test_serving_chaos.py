@@ -448,6 +448,23 @@ def test_generation_chaos_storm_typed_errors_only(lm_dir):
     BIT-CORRECT success or a TYPED error (a mid-generation step fault
     fails every in-flight lane retryably — no partial streams leak), the
     server returns to healthy after the window, and shutdown drains."""
+    _run_generation_storm(lm_dir, {"max_slots": 4})
+
+
+def test_generation_chaos_storm_paged_engine(lm_dir):
+    """The SAME storm over the paged-KV prefix-cache engine (ISSUE 13):
+    chaos composes with page allocation, radix interning, and prefix
+    hits — typed-errors-only, bit-correct successes, slots AND pages all
+    returned after the drain."""
+    srv = _run_generation_storm(
+        lm_dir, {"max_slots": 4, "paged": True, "page_len": 8,
+                 "pool_pages": 20})
+    info = srv.decode_engine.kv_pages_info()
+    assert info["active"] == 0  # every non-cached page came back
+    assert srv.decode_engine.prefix_queries > 0
+
+
+def _run_generation_storm(lm_dir, decode_cfg):
     from paddle_tpu.serving.decode import generate_sequential
 
     chaos = ChaosInjector(seed=13, slow_call_prob=0.05, slow_call_ms=10.0,
@@ -455,7 +472,7 @@ def test_generation_chaos_storm_typed_errors_only(lm_dir):
                           stall_prob=0.05, stall_ms=10.0, fault_window_s=1.0)
     srv = ServingServer(lm_dir, max_batch_size=1, queue_capacity=32,
                         health_window_s=1.0, warmup=True,
-                        decode={"max_slots": 4}, chaos=chaos)
+                        decode=decode_cfg, chaos=chaos)
     # reference streams come from the same engine with the injector
     # temporarily unhooked (references are oracle, not traffic)
     srv.decode_engine.chaos = None
@@ -513,3 +530,4 @@ def test_generation_chaos_storm_typed_errors_only(lm_dir):
     srv.close()  # graceful: in-flight generations finish, slots return
     assert srv.gen_batcher.pending == 0
     assert srv.decode_engine.free_slots == srv.decode_engine.max_slots
+    return srv

@@ -1,0 +1,592 @@
+"""Paged KV pool + radix-tree prefix cache (serving/kvcache.py, ISSUE 13).
+
+Acceptance contract: greedy streams through the paged pool are
+BIT-IDENTICAL to the unpaged engine — dense-vs-paged, cold-vs-warm-prefix,
+and single-device-vs-tp-sharded; a prefix hit prefills ONLY the uncached
+suffix; steady-state decode (warm prefixes included) compiles NOTHING;
+hot reload invalidates cached prefixes (no stale-weights KV is ever
+served, even for readers in flight at the commit); ref-counted eviction
+never frees a page an in-flight generation reads; pool exhaustion sheds
+typed (``KVPoolExhausted``, QueueFullError lineage); and the paged HBM
+account undercuts the dense one at equal ``max_slots``.
+
+Everything runs on JAX_PLATFORMS=cpu (conftest) with the same tiny
+2-layer symmetry-broken LM export the decode suite uses.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (DecodeEngine, GenerationBatcher,
+                                KVPoolExhausted, PagedDecodeEngine,
+                                QueueFullError, ServingClient,
+                                ServingServer, ServingStats)
+from paddle_tpu.serving.decode import generate_sequential
+from paddle_tpu.serving.kvcache import PagePool, RadixPrefixCache
+from test_serving_decode import V, T, _export_lm
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def lm_dirs(tmp_path_factory):
+    """A (serving), B (same arch, different weights — reload)."""
+    root = tmp_path_factory.mktemp("kvcache")
+    return (_export_lm(str(root / "a"), seed=11),
+            _export_lm(str(root / "b"), seed=47))
+
+
+@pytest.fixture(scope="module")
+def dense(lm_dirs):
+    return DecodeEngine(lm_dirs[0], max_slots=4)
+
+
+@pytest.fixture(scope="module")
+def paged(lm_dirs):
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=4, page_len=PAGE,
+                            pool_pages=16)
+    eng.warmup()
+    return eng
+
+
+def _prompts(rng, n, lo=2, hi=14):
+    return [rng.randint(0, V, size=(int(rng.randint(lo, hi)),))
+            .astype(np.int64) for _ in range(n)]
+
+
+def _templated(rng, template, n, lo=2, hi=6):
+    return [np.concatenate([template, s])
+            for s in _prompts(rng, n, lo, hi)]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: dense vs paged, cold vs warm
+# ---------------------------------------------------------------------------
+
+
+def test_paged_pool_shape_and_bytes(dense, paged):
+    """The paged pool is page blocks, not dense rows — and smaller."""
+    L, rows, plen = paged.pool_k.shape[:3]
+    assert plen == PAGE and rows == paged.pool_pages + 1
+    assert paged.pool_k.nbytes < dense.pool_k.nbytes
+    assert paged.kv_pool_bytes() == 2 * paged.pool_k.nbytes
+
+
+def test_dense_vs_paged_bit_identical(dense, paged):
+    """THE tentpole gate: same export, same prompts, same greedy streams
+    through the page indirection — token for token."""
+    rng = np.random.RandomState(1)
+    prompts = _prompts(rng, 8)
+    limits = [int(m) for m in rng.randint(1, 16, size=len(prompts))]
+    ref = generate_sequential(dense, prompts, limits)
+    assert generate_sequential(paged, prompts, limits) == ref
+    # not vacuous: distinct prompts decode distinct streams
+    assert len({tuple(o) for o in ref}) > 1
+
+
+def test_cold_vs_warm_prefix_bit_identical(dense, paged):
+    """A warm admission (prefix served from cached pages) produces the
+    EXACT stream of a cold one — reused KV is the KV a full prefill
+    would recompute."""
+    rng = np.random.RandomState(2)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    prompts = _templated(rng, template, 4)
+    ref = generate_sequential(dense, prompts, 10)
+    q0, h0 = paged.prefix_queries, paged.prefix_hits
+    cold = generate_sequential(paged, prompts, 10)   # interns the template
+    warm = generate_sequential(paged, prompts, 10)   # hits it
+    assert cold == ref and warm == ref
+    assert paged.prefix_queries - q0 == 8
+    assert paged.prefix_hits - h0 >= 7  # all but the very first admission
+    assert paged.free_slots == paged.max_slots
+
+
+def test_hit_prefills_only_the_suffix(paged):
+    """A full-template hit advances the write frontier past the cached
+    pages: only suffix positions run device prefill."""
+    rng = np.random.RandomState(3)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    warmer = np.concatenate([template, rng.randint(0, V, size=(3,))])
+    probe = np.concatenate([template, rng.randint(0, V, size=(4,))])
+    generate_sequential(paged, [warmer], 2)
+    tokens0 = paged.prefix_hit_tokens
+    generate_sequential(paged, [probe], 2)
+    assert paged.last_prefix_hit == 2 * PAGE
+    assert paged.prefix_hit_tokens - tokens0 == 2 * PAGE
+
+
+def test_cache_capped_below_full_prompt(paged):
+    """A prompt wholly covered by cached pages still prefills >= 1 token
+    — the first generated token comes from real logits (the cache holds
+    KV, not logits)."""
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    ref = generate_sequential(paged, [prompt], 4)  # interns page 1 only
+    out = generate_sequential(paged, [prompt], 4)  # exact same prompt
+    assert out == ref
+    # cap: (2*PAGE - 1) // PAGE = 1 page, never both
+    assert paged.last_prefix_hit == PAGE
+
+
+def test_batcher_on_paged_engine_bit_matches(dense, paged):
+    """Continuous batching over the paged engine == the dense sequential
+    reference, with hits flowing mid-batch (in-flight interning)."""
+    rng = np.random.RandomState(5)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    prompts = _templated(rng, template, 6) + _prompts(rng, 4)
+    limits = [int(m) for m in rng.randint(1, 12, size=len(prompts))]
+    ref = generate_sequential(dense, prompts, limits)
+    stats = ServingStats()
+    gb = GenerationBatcher(paged, stats=stats, queue_capacity=16)
+    try:
+        futs = [gb.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, limits)]
+        results = [f.result(timeout=120) for f in futs]
+    finally:
+        gb.close()
+    assert [r.tokens for r in results] == ref
+    assert paged.free_slots == paged.max_slots
+    info = paged.kv_pages_info()
+    assert info["active"] == 0  # every non-cached page came back
+
+
+def test_zero_steady_state_recompiles_warm_prefixes(lm_dirs):
+    """Warm-prefix admission reuses signatures WARMUP compiled — the
+    page table is an input, not a shape, and the off-diagonal
+    (suffix-bucket, window) pairs a prefix hit mints are part of the
+    warm ladder. The snapshot is taken right after warmup: the very
+    FIRST warm request must not pay a serve-time compile."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=4, page_len=PAGE,
+                            pool_pages=16)
+    eng.warmup()
+    misses = eng.cache_info()["misses"]
+    rng = np.random.RandomState(6)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    prompts = _templated(rng, template, 5)
+    gb = GenerationBatcher(eng, queue_capacity=16)
+    try:
+        # pass 1 interns the template AND hits it (requests 2+); pass 2
+        # is fully warm — none may compile anything
+        [f.result(timeout=120) for f in
+         [gb.submit(p, max_new_tokens=6) for p in prompts]]
+        [f.result(timeout=120) for f in
+         [gb.submit(p, max_new_tokens=6) for p in prompts]]
+    finally:
+        gb.close()
+    info = eng.cache_info()
+    assert info["misses"] == misses, f"warm prefixes recompiled: {info}"
+    assert eng.prefix_hits > 0
+
+
+# ---------------------------------------------------------------------------
+# reload invalidation: no stale-weights KV is ever served
+# ---------------------------------------------------------------------------
+
+
+def test_reload_invalidates_cached_prefixes(lm_dirs):
+    """Wave 1 interns prefixes under v1; the reload barrier commits v2;
+    wave 2 (same prompts) must MISS the cache and decode the v2 streams
+    — wholly-old-or-wholly-new extends to cached KV."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=PAGE,
+                            pool_pages=12)
+    eng.warmup()
+    rng = np.random.RandomState(7)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    prompts = _templated(rng, template, 2)
+    ref_v1 = generate_sequential(eng, prompts, 12)
+    gb = GenerationBatcher(eng, queue_capacity=8)
+    try:
+        wave1 = [gb.submit(p, max_new_tokens=12) for p in prompts]
+        assert gb.reload(lm_dirs[1]) == 2  # barrier: drains, then commits
+        hits_before = eng.prefix_hits
+        assert eng.prefix_cache.nodes == 0  # the whole tree invalidated
+        wave2 = [gb.submit(p, max_new_tokens=12) for p in prompts]
+        r1 = [f.result(timeout=120) for f in wave1]
+        r2 = [f.result(timeout=120) for f in wave2]
+        hits_after_wave2 = eng.prefix_hits
+    finally:
+        gb.close()
+    assert [r.tokens for r in r1] == ref_v1
+    assert [r.weights_version for r in r2] == [2, 2]
+    # the first v2 admission of the template MUST NOT have hit v1 pages
+    ref_v2 = generate_sequential(eng, prompts, 12)  # engine now at v2
+    assert [r.tokens for r in r2] == ref_v2
+    assert ref_v1 != ref_v2  # the swap is observable
+    # wave2's first admission missed; its sibling may hit the re-interned
+    # v2 prefix — but never a v1 one (version-keyed match)
+    assert hits_after_wave2 - hits_before <= 1
+    assert eng.prefix_cache.version == 2
+
+
+def test_invalidation_frees_unreferenced_pages_immediately(lm_dirs):
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=PAGE,
+                            pool_pages=8)
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(0, V, size=(2 * PAGE + 3,)).astype(np.int64)
+    generate_sequential(eng, [prompt], 2)
+    assert eng.kv_pages_info()["cached"] == 2
+    eng.commit_params(eng.stage_params(lm_dirs[0]))  # same arch reload
+    info = eng.kv_pages_info()
+    assert info["cached"] == 0 and info["free"] == eng.pool_pages
+    assert eng.prefix_cache.invalidations == 1
+
+
+def test_invalidation_with_inflight_reader_defers_free(lm_dirs):
+    """A reader pinned to cached pages at invalidation time keeps them
+    alive (zombies) until it retires — then they free, and they were
+    never matchable in between."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=PAGE,
+                            pool_pages=8)
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, V, size=(2 * PAGE + 3,)).astype(np.int64)
+    generate_sequential(eng, [prompt], 2)  # interns 2 pages
+    slot = eng.alloc_slot()
+    eng.prefill(slot, prompt)  # in-flight reader pins both cached pages
+    assert eng.last_prefix_hit == 2 * PAGE
+    eng.commit_params(eng.stage_params(lm_dirs[0]))
+    info = eng.kv_pages_info()
+    assert info["cached"] == 2  # zombies: dead but pinned
+    assert eng.prefix_cache.match(prompt, eng.params_version) == []
+    eng.free_slot(slot)  # the reader retires
+    info = eng.kv_pages_info()
+    assert info["cached"] == 0 and info["free"] == eng.pool_pages
+
+
+# ---------------------------------------------------------------------------
+# ref-counted eviction + typed exhaustion
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_never_frees_inflight_pages(lm_dirs):
+    """Pool pressure evicts only UNREFERENCED cached pages; pages read
+    by an in-flight generation survive any demand, and the demand that
+    cannot be met sheds typed."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=3, page_len=PAGE,
+                            pool_pages=6)
+    rng = np.random.RandomState(10)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    prompt = np.concatenate([template, rng.randint(0, V, size=(3,))])
+    generate_sequential(eng, [prompt], 2)  # 2 cached pages, 4 free
+    slot = eng.alloc_slot()
+    eng.prefill(slot, prompt, reserve_new_tokens=4)  # pins both, owns 1
+    pinned = {nd.page for nd in eng._slot_nodes[slot]}
+    assert len(pinned) == 2
+    # burn the rest of the pool: a cold prompt that wants every free page
+    cold = rng.randint(0, V, size=(3 * PAGE,)).astype(np.int64)
+    slot2 = eng.alloc_slot()
+    with pytest.raises(KVPoolExhausted):
+        # needs 4 pages (3 prompt + growth); 3 free + 0 evictable
+        eng.prefill(slot2, cold, reserve_new_tokens=PAGE + 1)
+    # the pinned pages were NOT sacrificed to the failed demand
+    assert {nd.page for nd in eng._slot_nodes[slot]} == pinned
+    states = eng.page_pool.counts()
+    assert states["cached"] == 2
+    eng.free_slot(slot2)
+    eng.free_slot(slot)
+    # with the reader retired the same demand can now evict and admit
+    eng.prefill(slot2 := eng.alloc_slot(), cold,
+                reserve_new_tokens=PAGE + 1)
+    eng.free_slot(slot2)
+
+
+def test_pool_exhaustion_is_queue_full_lineage(lm_dirs):
+    """The typed shed rides the batcher end to end: QueueFullError
+    lineage (retryable rejection), counted as a reject, and the engine
+    state is fully released."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=4, page_len=PAGE,
+                            pool_pages=4)
+    eng.warmup()
+    assert issubclass(KVPoolExhausted, QueueFullError)
+    stats = ServingStats()
+    gb = GenerationBatcher(eng, stats=stats, queue_capacity=8)
+    prompts = [np.arange(2 * PAGE + 5, dtype=np.int64) % V
+               for _ in range(4)]
+    futs = [gb.submit(p, max_new_tokens=8) for p in prompts]
+    ok = shed = 0
+    for f in futs:
+        try:
+            f.result(timeout=60)
+            ok += 1
+        except KVPoolExhausted:
+            shed += 1
+    gb.close()
+    assert ok >= 1 and shed >= 1 and ok + shed == 4
+    assert stats.snapshot()["rejected"] == shed
+    assert eng.free_slots == eng.max_slots
+    assert eng.kv_pages_info()["active"] == 0
+
+
+def test_lru_eviction_order(lm_dirs):
+    """Under pressure the OLDEST unused template evicts first; the
+    recently used one keeps hitting."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=PAGE,
+                            pool_pages=6)
+    rng = np.random.RandomState(11)
+    t_old = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    t_hot = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    generate_sequential(eng, [np.concatenate([t_old, [1]])], 1)
+    generate_sequential(eng, [np.concatenate([t_hot, [2]])], 1)
+    generate_sequential(eng, [np.concatenate([t_hot, [3]])], 1)  # touch
+    assert eng.kv_pages_info()["cached"] == 4
+    # a cold 3-page demand must evict 1+ pages: t_old's chain goes first
+    cold = rng.randint(0, V, size=(3 * PAGE + 2,)).astype(np.int64)
+    generate_sequential(eng, [cold], 1)
+    assert eng.prefix_cache.evictions >= 1
+    assert eng.peek_prefix_len(np.concatenate([t_hot, [9]])) == 2 * PAGE
+    assert eng.peek_prefix_len(np.concatenate([t_old, [9]])) < 2 * PAGE
+
+
+def test_evict_watermark_keeps_free_headroom(lm_dirs):
+    """With a watermark, allocation proactively evicts cold cache down
+    to the free-fraction target instead of waiting for hard demand."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=PAGE,
+                            pool_pages=8, evict_watermark=0.5)
+    rng = np.random.RandomState(12)
+    for i in range(3):  # three 2-page templates -> 6 cached, 2 free
+        t = rng.randint(0, V, size=(2 * PAGE + 1,)).astype(np.int64)
+        generate_sequential(eng, [t], 1)
+        info = eng.kv_pages_info()
+        assert info["free"] >= int(0.5 * eng.pool_pages) - 1, info
+
+
+def test_page_pool_accounting_is_strict():
+    pool = PagePool(4)
+    pages = pool.alloc(3)
+    assert pool.counts() == {"free": 1, "active": 3, "cached": 0}
+    pool.to_cached(pages[0])
+    pool.free(pages[1:])
+    assert pool.counts() == {"free": 3, "active": 0, "cached": 1}
+    with pytest.raises(ValueError):
+        pool.free([pages[1]])  # double free
+    with pytest.raises(ValueError):
+        pool.to_cached(pages[1])  # not active
+    with pytest.raises(KVPoolExhausted):
+        pool.alloc(5)
+    pool.cached_free(pages[0])
+    assert pool.counts()["free"] == 4
+
+
+def test_radix_tree_is_path_keyed():
+    """Two prompts sharing page 1 but differing in page 2 share ONE node
+    then branch — and a different first page never matches at all."""
+    pool = PagePool(8)
+    cache = RadixPrefixCache(2, pool, version=1)
+    a = np.array([1, 2, 3, 4], np.int32)
+    b = np.array([1, 2, 9, 9], np.int32)
+    c = np.array([5, 5, 3, 4], np.int32)
+    cache.insert(a, 0, pool.alloc(2), 1)
+    assert len(cache.match(np.append(a, 0), 1)) == 2
+    assert cache.evictable_count() == 2  # O(1) unpinned counter
+    chain = cache.match(np.append(a, 0), 1)
+    cache.acquire(chain)
+    assert cache.evictable_count() == 0  # pinned by the reader
+    cache.release(chain)
+    assert cache.evictable_count() == 2
+    assert len(cache.match(np.append(b, 0), 1)) == 1  # shares page 1 only
+    assert cache.match(np.append(c, 0), 1) == []
+    assert cache.match(np.append(a, 0), 2) == []  # version-keyed
+    # duplicate insert adopts nothing
+    dup = pool.alloc(1)
+    placed = cache.insert(a[:2], 0, dup, 1)
+    assert placed == [(cache.match(np.append(a, 0), 1)[0], False)]
+
+
+# ---------------------------------------------------------------------------
+# scheduler cache-awareness + serving surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_admission_cost_model_sees_the_cache(lm_dirs):
+    """peek_prefix_len shrinks the bucket the scheduler prices: a warm
+    template admits under a stall budget that blocks its cold twin."""
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=4, page_len=PAGE,
+                            pool_pages=16)
+    rng = np.random.RandomState(13)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    warm = np.concatenate([template, [7]])
+    generate_sequential(eng, [warm], 1)  # intern
+    assert eng.peek_prefix_len(warm) == 2 * PAGE
+    cold = rng.randint(0, V, size=(2 * PAGE + 1,)).astype(np.int64)
+    assert eng.peek_prefix_len(cold) == 0
+    from paddle_tpu.serving import SlotScheduler
+
+    s = SlotScheduler(itl_budget_ms=5.0)
+    s.observe_step(16, 0.001)
+    s.observe_prefill(32, 0.050)  # cold 17-token prompt: 10x the budget
+    s.observe_prefill(16, 0.001)  # warm suffix bucket: measured cheap
+    # (_admit feeds the EMA at the SUFFIX bucket, so warm admissions
+    # train exactly this entry)
+    cold_bucket = eng.prompt_bucket(cold.shape[0])
+    warm_bucket = eng.prompt_bucket(
+        max(1, warm.shape[0] - eng.peek_prefix_len(warm)))
+    assert warm_bucket < cold_bucket
+    assert s.plan(free=1, queued_buckets=[cold_bucket], active=3,
+                  window=16) == 0
+    assert s.plan(free=1, queued_buckets=[warm_bucket], active=3,
+                  window=16) == 1
+
+
+def test_server_paged_decode_end_to_end(lm_dirs):
+    """decode={"paged": True} arms the paged engine behind the server:
+    generate RPCs hit the cache, healthz/stats/metrics carry the page
+    and prefix surfaces, and the fleet scraper reads them."""
+    from paddle_tpu.serving.fleet import scraped_gauges
+
+    with ServingServer(lm_dirs[0], max_batch_size=1, warmup=True,
+                       decode={"paged": True, "page_len": PAGE,
+                               "pool_pages": 16, "max_slots": 4}) as srv:
+        assert isinstance(srv.decode_engine, PagedDecodeEngine)
+        rng = np.random.RandomState(14)
+        template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+        prompts = _templated(rng, template, 6)
+        ref = generate_sequential(srv.decode_engine, prompts, 5)
+        with ServingClient(srv.endpoint) as c:
+            outs = [c.generate(p, max_new_tokens=5)["tokens"]
+                    for p in prompts]
+            assert outs == ref
+            h = c.healthz()["decode"]
+            assert h["kv_pages"]["total"] == 16
+            assert h["prefix"]["hits"] >= 5
+            s = c.stats()
+            assert s["decode_kv_pages"]["page_len"] == PAGE
+            assert s["decode_prefix"]["hit_tokens"] > 0
+        text = srv.metrics_text()
+        for name in ('pt_serving_kv_pages{state="free"}',
+                     'pt_serving_kv_pages{state="active"}',
+                     'pt_serving_kv_pages{state="cached"}',
+                     "pt_serving_prefix_hits_total",
+                     "pt_serving_prefix_hit_tokens_total",
+                     "pt_serving_prefix_hit_rate"):
+            assert name in text, name
+        g = scraped_gauges(srv.healthz(), text)
+        assert g["kv_pages_free"] + g["kv_pages_active"] \
+            + g["kv_pages_cached"] == 16
+        assert g["prefix_hits"] >= 5 and g["prefix_hit_rate"] > 0
+
+
+def test_prefix_match_span_under_prefill_ttft(lm_dirs):
+    from paddle_tpu import obs
+
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=PAGE,
+                            pool_pages=12)
+    rng = np.random.RandomState(15)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    warm = np.concatenate([template, [3]])
+    generate_sequential(eng, [warm], 1)
+    tracer = obs.enable()
+    tracer.clear()
+    try:
+        gb = GenerationBatcher(eng, queue_capacity=4)
+        try:
+            gb.submit(warm, max_new_tokens=3).result(timeout=60)
+        finally:
+            gb.close()
+        spans = {s.name: s for s in tracer.spans()}
+        assert "serve/prefill_ttft" in spans
+        pm = spans["serve/prefix_match"]
+        assert pm.args["hit_tokens"] == 2 * PAGE
+        assert pm.parent == spans["serve/prefill_ttft"].sid
+    finally:
+        obs.disable()
+        tracer.clear()
+
+
+# ---------------------------------------------------------------------------
+# sharded + quantized composition
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_paged_bit_identical_and_zero_recompiles(tmp_path):
+    """tp=2 paged decode (pool sharded along heads, table replicated)
+    bit-matches the single-device paged engine — cold AND warm — and
+    the §18 collective schedule holds in the compiled paged step. Uses
+    the sharded suite's tp-divisible export at the lane-aligned shapes
+    where cross-layout bit-equality is pinned (docs §18)."""
+    from test_serving_sharded import V as SV
+    from test_serving_sharded import _export_lm as _export_shardable
+
+    from paddle_tpu.serving import expected_collectives
+    from paddle_tpu.serving.kvcache import ShardedPagedDecodeEngine
+
+    d = _export_shardable(str(tmp_path / "shard_lm"), seed=21)
+    single = PagedDecodeEngine(d, max_slots=4, page_len=PAGE,
+                               pool_pages=16)
+    eng = ShardedPagedDecodeEngine(d, tp=2, max_slots=4,
+                                   page_len=PAGE, pool_pages=16)
+    compiles = eng.warmup()
+    assert compiles > 0
+    rng = np.random.RandomState(16)
+    template = rng.randint(0, SV, size=(2 * PAGE,)).astype(np.int64)
+    prompts = ([np.concatenate([template, s]) for s in
+                [rng.randint(0, SV, size=(int(rng.randint(2, 6)),))
+                 for _ in range(3)]]
+               + [rng.randint(0, SV, size=(int(rng.randint(2, 14)),))
+                  .astype(np.int64) for _ in range(2)])
+    limits = [int(m) for m in rng.randint(2, 10, size=len(prompts))]
+    ref = generate_sequential(single, prompts, limits)
+    assert generate_sequential(eng, prompts, limits) == ref  # cold-ish
+    misses = eng.cache_info()["misses"]
+    assert generate_sequential(eng, prompts, limits) == ref  # warm
+    assert eng.cache_info()["misses"] == misses
+    assert eng.prefix_hits > 0  # the warm pass really hit
+    assert eng.measured_collectives() == \
+        expected_collectives(eng.cfg, 2)
+
+
+def test_quantized_paged_pool_stays_f32(lm_dirs):
+    """Quantized params over the paged pool: the pool (and every cached
+    page) stays f32, and the quantized greedy streams agree cold vs
+    warm (the quantized engine's own accuracy contract covers the
+    f32-vs-quantized delta)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.kvcache import QuantizedPagedDecodeEngine
+
+    eng = QuantizedPagedDecodeEngine(lm_dirs[0], mode="int8", max_slots=2,
+                                     page_len=PAGE, pool_pages=12)
+    assert eng.quant_mode == "int8"
+    assert eng.pool_k.dtype == jnp.float32
+    rng = np.random.RandomState(17)
+    template = rng.randint(0, V, size=(2 * PAGE,)).astype(np.int64)
+    prompts = _templated(rng, template, 3)
+    cold = generate_sequential(eng, prompts, 6)
+    warm = generate_sequential(eng, prompts, 6)
+    assert cold == warm
+    assert eng.prefix_hits > 0
+
+
+# ---------------------------------------------------------------------------
+# placement accounting
+# ---------------------------------------------------------------------------
+
+
+def test_paged_kv_account_undercuts_dense():
+    from paddle_tpu.serving.placement import ModelProfile
+
+    prof = ModelProfile.synthetic(2, 4, 64, 128, 512, 256)
+    dense_b = prof.decode_pool_bytes(8)
+    paged_b = prof.decode_paged_pool_bytes(8, page_len=16, overcommit=2.0)
+    assert paged_b < dense_b
+    # the model account equals the engine's real allocation rule
+    eng_pages = max(8 * (256 // 16) // 2, 256 // 16)
+    assert paged_b == 2.0 * 4 * 2 * (eng_pages + 1) * 16 * 64
+
+
+def test_searcher_prices_the_paged_pool():
+    """The same traffic fits tighter HBM under the paged account — a
+    dense-infeasible placement becomes feasible at kv_page_len."""
+    from paddle_tpu.serving.placement import (DeviceInventory, ModelProfile,
+                                              PlacementSearcher,
+                                              TrafficProfile)
+
+    prof = ModelProfile.synthetic(4, 8, 512, 2048, 32000, 2048)
+    hbm_gb = (prof.param_bytes + prof.decode_pool_bytes(64) * 0.6) / 1024**3
+    inv = DeviceInventory(1, hbm_gb=hbm_gb, peak_tflops=100.0)
+    dense_tr = TrafficProfile([(8, 1.0)], seq_len=128, decode_slots=64)
+    paged_tr = TrafficProfile([(8, 1.0)], seq_len=128, decode_slots=64,
+                              kv_page_len=16, kv_overcommit=2.0)
+    dense_plan = PlacementSearcher(prof, inv, dense_tr).score(1, 1)
+    paged_plan = PlacementSearcher(prof, inv, paged_tr).score(1, 1)
+    assert not dense_plan.feasible
+    assert paged_plan.feasible
+    assert paged_plan.hbm_bytes_per_device < dense_plan.hbm_bytes_per_device
+    assert paged_tr.as_dict()["kv_page_len"] == 16

@@ -316,15 +316,18 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all ten tracked metrics carry a bar (r8 added sharded serving, r10
-    # the quantized CPU serving lane, r11/ISSUE-12 the tuner contract)
-    assert len(bench.BARS) == 10
+    # all eleven tracked metrics carry a bar (r8 added sharded serving,
+    # r10 the quantized CPU serving lane, r11/ISSUE-12 the tuner
+    # contract, r13/ISSUE-13 the paged-KV prefix-cache workload)
+    assert len(bench.BARS) == 11
     shd = bench.BARS["sharded_serving_qps_per_chip"]
     assert shd["field"] == "value" and shd["min"] == 1.0
     cpuq = bench.BARS["cpu_quantized_serving_qps_ratio"]
     assert cpuq["field"] == "value" and cpuq["min"] == 0.85
     tunr = bench.BARS["kernel_tuner_warm_db_contract"]
     assert tunr["field"] == "value" and tunr["min"] == 1.0
+    pfx = bench.BARS["prefix_cache_decode_hit_token_ratio"]
+    assert pfx["field"] == "value" and pfx["min"] == 2.0
     # pass: above bar
     bench._emit({"metric": "transformer_lm_train_tokens_per_sec_per_chip",
                  "value": 150000.0, "unit": "tokens/sec", "mfu": 0.648})

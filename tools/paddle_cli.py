@@ -206,7 +206,8 @@ def fleet_rows(endpoints, timeout=3.0):
     for ep in endpoints:
         row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
                "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
-               "shards": "-", "weights": "-", "quant": "-", "decode": ""}
+               "shards": "-", "weights": "-", "quant": "-", "kv": "-",
+               "decode": ""}
         try:
             with ServingClient(ep, timeout=timeout) as c:
                 hz = c.healthz()
@@ -223,6 +224,15 @@ def fleet_rows(endpoints, timeout=3.0):
                 quant=QUANT_MODE_NAMES.get(int(m.get("quant_mode", 0)),
                                            "f32"),
                 weights=int(m["weights_version"]))
+            # paged-KV column: in-use/total pages + prefix-cache hit rate
+            # (the session-affinity signal; "-" on unpaged replicas)
+            total_pg = int(m.get("kv_pages_free", 0)
+                           + m.get("kv_pages_active", 0)
+                           + m.get("kv_pages_cached", 0))
+            if total_pg:
+                used = int(m["kv_pages_active"] + m["kv_pages_cached"])
+                row["kv"] = (f"{used}/{total_pg}pg "
+                             f"{m.get('prefix_hit_rate', 0.0):.0%}")
             d = hz.get("decode")
             if d:
                 row["decode"] = (f"{d['active_slots']}/{d['max_slots']} "
@@ -286,7 +296,7 @@ def router_report(r):
 def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
              f"{'occ':>5}{'mfu':>11}{'shards':>7}{'quant':>7}"
-             f"{'weights':>9}  decode"]
+             f"{'weights':>9}{'kv':>15}  decode"]
     for r in rows:
         q = (f"{r['queue']}/{r['capacity']}"
              if r["queue"] != "-" else "-")
@@ -295,7 +305,8 @@ def fleet_report(rows):
                      f"{r['circuit']:<9}{q:>9}{str(r['occupancy']):>5}"
                      f"{mfu:>11}{str(r.get('shards', '-')):>7}"
                      f"{str(r.get('quant', '-')):>7}"
-                     f"{str(r['weights']):>9}  {r['decode']}")
+                     f"{str(r['weights']):>9}"
+                     f"{str(r.get('kv', '-')):>15}  {r['decode']}")
     healthy = sum(1 for r in rows if r["health"] == "healthy")
     lines.append(f"{healthy}/{len(rows)} replicas healthy")
     return "\n".join(lines)

@@ -346,6 +346,127 @@ def decode_mode(n_requests: int = 32, seed: int = 7):
                       "rows": rows}))
 
 
+def kv_mode(n_requests: int = 32, seed: int = 9):
+    """Paged-KV sweep (docs/design.md §22): page size x pool pages x
+    eviction watermark over a bimodal prefix mix, winner as the final
+    JSON line (the PR-4 adoption discipline: record, don't hand-tune).
+
+    The mix is bimodal the way real prefix traffic is: ~70% of requests
+    share one of K hot templates (zipf-popular — these want big hits and
+    cheap suffix prefill), ~30% are cold unique prompts (these want the
+    pool to not be hogged by cached pages — the eviction watermark's
+    job). Each config runs once warm-up (executables) and once measured;
+    the score is measured tokens/s with the hit-token ratio and pool
+    pressure recorded alongside, and exhaustion sheds counted (a config
+    that sheds is reported, not hidden)."""
+    import json
+    import os
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import paddle_tpu as fluid
+    from paddle_tpu import io
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.serving.decode import GenerationBatcher
+    from paddle_tpu.serving.errors import QueueFullError
+    from paddle_tpu.serving.kvcache import PagedDecodeEngine
+
+    V, T, D, H, L, FF = 512, 128, 64, 4, 2, 128
+    SLOTS = 8
+    d = os.path.join(tempfile.mkdtemp(prefix="perf_lab_kv_"), "lm")
+    with fluid.unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+            logits, _loss = transformer_lm(
+                ids, labels, vocab_size=V, max_len=T, d_model=D, n_heads=H,
+                n_layers=L, d_ff=FF)
+        exe = fluid.Executor(fluid.default_place())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)
+        io.save_inference_model(d, ["ids"], [logits], exe, main_prog,
+                                scope=scope)
+
+    rng = np.random.RandomState(seed)
+    templates = [rng.randint(0, V, size=(40,)) for _ in range(3)]
+    zipf = np.array([1.0, 0.5, 0.33])
+    zipf /= zipf.sum()
+    reqs = []
+    for _ in range(n_requests):
+        if rng.rand() < 0.7:  # hot: shared template + short suffix
+            t = int(rng.choice(3, p=zipf))
+            prompt = np.concatenate([
+                templates[t],
+                rng.randint(0, V, size=(int(rng.randint(2, 8)),))])
+        else:  # cold: unique prompt, no reuse possible
+            prompt = rng.randint(0, V, size=(int(rng.randint(8, 48)),))
+        reqs.append((prompt, int(rng.randint(8, 24))))
+    print(f"kv sweep: {n_requests} generations (70% over 3 zipf "
+          f"templates x 40 tokens, 30% cold), LM V={V} T={T} D={D} L={L}, "
+          f"{SLOTS} slots")
+
+    rows = []
+    for page_len in (8, 16):
+        for pool_frac, pool_label in ((1.0, "dense-equiv"),
+                                      (0.5, "overcommit2"),
+                                      (0.25, "overcommit4")):
+            for watermark in (0.0, 0.25):
+                pool_pages = max(int(SLOTS * (T // page_len) * pool_frac),
+                                 T // page_len)
+                eng = PagedDecodeEngine(
+                    d, max_slots=SLOTS, page_len=page_len,
+                    pool_pages=pool_pages, evict_watermark=watermark)
+                eng.warmup()
+
+                def run_once(eng=eng):
+                    gb = GenerationBatcher(eng, queue_capacity=n_requests)
+                    shed = 0
+                    try:
+                        t0 = time.monotonic()
+                        futs = [gb.submit(p, max_new_tokens=b)
+                                for p, b in reqs]
+                        toks = 0
+                        for f in futs:
+                            try:
+                                toks += len(f.result(timeout=600).tokens)
+                            except QueueFullError:
+                                shed += 1
+                        return toks, time.monotonic() - t0, shed
+                    finally:
+                        gb.close()
+
+                run_once()  # warm executables AND the prefix tree
+                toks, dt, shed = run_once()
+                pinfo = eng.prefix_info()
+                prefilled = max(
+                    1, 2 * sum(p.shape[0] for p, _ in reqs)
+                    - pinfo["hit_tokens"])
+                rows.append({
+                    "page_len": page_len, "pool_pages": pool_pages,
+                    "pool": pool_label, "watermark": watermark,
+                    "tokens": toks, "seconds": round(dt, 3),
+                    "tokens_per_s": round(toks / dt, 1) if dt else 0.0,
+                    "shed": shed,
+                    "hit_token_ratio": round(
+                        pinfo["hit_tokens"] / prefilled, 3),
+                    "evictions": pinfo["evictions"],
+                    "signatures": eng.cache_info()["size"]})
+                r = rows[-1]
+                print(f"page_len={page_len:<3} pool={pool_label:<12} "
+                      f"wm={watermark:<5} {r['tokens_per_s']:8.1f} tok/s  "
+                      f"hit_ratio={r['hit_token_ratio']:<6} "
+                      f"shed={shed} evictions={r['evictions']}")
+    best = max(rows, key=lambda r: (r["shed"] == 0, r["tokens_per_s"]))
+    print("chosen config:")
+    print(json.dumps({"chosen": {k: best[k] for k in
+                                 ("page_len", "pool_pages", "pool",
+                                  "watermark")},
+                      "tokens_per_s": best["tokens_per_s"],
+                      "hit_token_ratio": best["hit_token_ratio"],
+                      "rows": rows}))
+
+
 def placement_mode(seed: int = 5):
     """Placement-searcher sweep + a predicted-vs-measured closing loop.
 
@@ -807,6 +928,9 @@ def main():
         return
     if layout == "decode":
         decode_mode()
+        return
+    if layout == "kv":
+        kv_mode()
         return
     if layout == "placement":
         placement_mode()

@@ -1,0 +1,132 @@
+"""Seq2seq per-fusion MFU ceiling audit (the r5 open item: 33% MFU bar,
+no audited ceiling).
+
+The transformer/ResNet bars are defended by per-fusion audits (BASELINE.md
+"Roofline-adjusted..."); seq2seq's 33% bar was only ever a measured
+number. This probe composes the r4/r5 trace ledger (docs/perf.md
+"Sequence workloads" + "Seq2seq round 5" — hlo_stats-attributed device
+time per term, each term's bound mechanism named) into a defended
+ceiling the same way: every term is priced at its MECHANISM floor —
+measured per-shape matmul rates for the MXU terms, the measured VMEM
+write bound for the scan-body fusions, HBM stream rates for the
+optimizer/stacking traffic — and the ceiling is total model FLOPs over
+the floor-sum step time.
+
+Terms (per bench step: B=128, T=64, E=H=512, V=30k, fwd+bwd under AMP,
+r5 measured 15.53 ms = 33.6% MFU):
+
+* head matmuls (CE head + its dW/dx): measured 160-190 TF/s, already
+  within ~5% of the audited per-shape rates — floor ~4.1 ms.
+* scan bodies (LSTM cell + attention fusions fwd/bwd): VMEM-write-bound
+  at the measured ~2.4 TB/s, 7-config ledger of negatives — floor
+  ~3.2 ms.
+* gate projections + CE statistics (the hoisted [N*T, E] x [E, 4H]
+  pair, r4 items 1-3): at measured fwd/dx rates — floor ~5.0 ms.
+* scan-residual stacking: bf16 since r5; floor = bf16 bytes at the
+  measured stream rate — ~0.85 ms.
+* dense Adam on the two [30k, 512] tables: 856 GB/s measured whole-table
+  stream; the floor prices the NAMED lever (lazy/sparse row Adam over
+  gathered rows only) — ~0.55 ms.
+* embedding scatter-add: scatter-rate bound — ~0.65 ms.
+
+On-chip, ``--measure`` slope-times the real bench step next to the
+floor-sum (the probe_tlm discipline: model-level slope is the stable
+instrument); off-chip the analytic table stands alone. The final JSON
+line carries the defended ceiling for BASELINE.md.
+
+Usage: python tools/probe_s2s_ceiling.py [--measure]
+"""
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+#: per-term floors, milliseconds per bench step. Provenance: the r4
+#: hlo_stats-attributed trace (docs/perf.md "Sequence workloads",
+#: "remaining profile" paragraph) re-priced after the r5 bf16-stacking
+#: win; "mechanism" names why the term cannot go below its floor from
+#: above XLA (the r3/r5 precedent: in-kernel alternatives measured and
+#: LOST — the flash ledger of negatives, the Pallas conv loss).
+TERMS = [
+    {"term": "head_matmuls", "floor_ms": 4.1,
+     "r5_ms": 4.3, "mechanism": "MXU at measured 160-190 TF/s per shape "
+     "(fwd/dx near peak; the dW share rides the r6 tuner verdict)"},
+    {"term": "scan_bodies", "floor_ms": 3.2,
+     "r5_ms": 3.5, "mechanism": "VMEM write bound ~2.4 TB/s, "
+     "7-config measured local optimum (r4+r5 ledger)"},
+    {"term": "gates_and_ce", "floor_ms": 5.0,
+     "r5_ms": 5.2, "mechanism": "hoisted gate matmuls + CE statistic "
+     "chains at measured per-shape rates (r4 items 1-3 already "
+     "removed the layout copy and the f32 logits round-trip)"},
+    {"term": "scan_stacking", "floor_ms": 0.85,
+     "r5_ms": 0.9, "mechanism": "bf16 per-step output stacking at the "
+     "measured stream rate (r5 halved it; the f32 carry is correctness)"},
+    {"term": "optimizer", "floor_ms": 0.55,
+     "r5_ms": 0.95, "mechanism": "NAMED HEADROOM: dense Adam streams "
+     "both [30k,512] tables at 856 GB/s; a lazy row Adam touching only "
+     "gathered rows is the one audited lever left"},
+    {"term": "embedding_scatter", "floor_ms": 0.65,
+     "r5_ms": 0.7, "mechanism": "scatter-add at measured scatter rates "
+     "(device-side SelectedRows measured SLOWER at this table size)"},
+]
+
+
+def flops_per_step():
+    """The bench's own analytic account (bench.bench_seq2seq)."""
+    import bench
+
+    e, h, v, t = bench.S2S_EMBED, bench.S2S_HIDDEN, bench.S2S_VOCAB, \
+        bench.S2S_LEN
+    fwd = 2 * bench.S2S_BATCH * t * (
+        (e * 4 * h + h * 4 * h) + h * h
+        + ((e + h) * 4 * h + h * 4 * h) + 2 * t * h + h * v)
+    return 3 * fwd
+
+
+def main():
+    import bench
+
+    total = flops_per_step()
+    floor_ms = sum(t["floor_ms"] for t in TERMS)
+    r5_ms = sum(t["r5_ms"] for t in TERMS)
+    ceiling_mfu = total / (floor_ms / 1e3) / 1e12 / bench.PEAK_TFLOPS
+    r5_mfu = total / (r5_ms / 1e3) / 1e12 / bench.PEAK_TFLOPS
+    print(f"seq2seq bench step: {total / 1e9:.1f} GFLOP "
+          f"(B={bench.S2S_BATCH} T={bench.S2S_LEN} H={bench.S2S_HIDDEN} "
+          f"V={bench.S2S_VOCAB}), chip peak {bench.PEAK_TFLOPS} TF/s")
+    print(f"{'term':<20}{'r5 ms':>8}{'floor ms':>10}  mechanism")
+    for t in TERMS:
+        print(f"{t['term']:<20}{t['r5_ms']:>8.2f}{t['floor_ms']:>10.2f}  "
+              f"{t['mechanism']}")
+    print(f"{'SUM':<20}{r5_ms:>8.2f}{floor_ms:>10.2f}")
+    print(f"attributed r5 step {r5_ms:.2f} ms -> {r5_mfu:.1%} MFU "
+          f"(measured r5: 15.53 ms, 33.6%)")
+    print(f"defended ceiling: {floor_ms:.2f} ms -> {ceiling_mfu:.1%} MFU")
+    measured = None
+    if "--measure" in sys.argv:
+        # the authoritative instrument: slope-time the real bench step
+        run_step, fetch = bench.build_seq2seq(k=bench.PIPE_K)
+        step_s, spread = bench._slope_time(run_step, fetch, warmup=3,
+                                           iters=250, reps=5,
+                                           steps_per_call=bench.PIPE_K)
+        measured = {"step_ms": round(step_s * 1e3, 3),
+                    "spread_ms": round(spread * 1e3, 3),
+                    "mfu": round(total / step_s / 1e12
+                                 / bench.PEAK_TFLOPS, 4)}
+        print(f"measured: {measured['step_ms']} ms/step "
+              f"({measured['mfu']:.1%} MFU, spread "
+              f"{measured['spread_ms']} ms)")
+    print(json.dumps({
+        "workload": "seq2seq_nmt",
+        "flops_per_step": total,
+        "attributed_r5_ms": round(r5_ms, 2),
+        "floor_sum_ms": round(floor_ms, 2),
+        "defended_ceiling_mfu": round(ceiling_mfu, 4),
+        "bar_mfu": 0.33,
+        "terms": TERMS,
+        "measured": measured,
+    }))
+
+
+if __name__ == "__main__":
+    main()

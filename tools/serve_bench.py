@@ -28,6 +28,17 @@ decode plane: aggregate generated tokens/s, time-to-first-token and
 inter-token latency p50/p95, mean/max KV-slot occupancy (sampled), and
 the decode compile cache (steady state must show zero recompiles).
 
+``--prefix-mix K:TLEN`` (with ``--generate``) switches the prompt shape
+to the shared-prefix workload the paged KV pool exists for: K templates
+of TLEN tokens each, template popularity zipf-distributed
+(``--zipf-a``), each request = template + random suffix
+(``--prompt-tokens`` sizes the suffix). The in-process server arms the
+paged engine + radix prefix cache (docs §22; tune with
+``--kv-page-len`` / ``--kv-pool-pages`` / ``--kv-overcommit`` /
+``--kv-watermark``), and the report adds the prefix plane: hit rate,
+hit tokens, pages in use by state, and TTFT split cold-vs-warm (first
+request of a template vs the rest).
+
 ``--slo p95_ms=...,err_rate=...`` judges the finished run against
 declared SLOs (obs/slo.py judge_bench) with NONZERO exit on breach — the
 serving twin of bench.py's per-class bars; ``--log-json`` routes the
@@ -194,6 +205,117 @@ def bench_generate(endpoint, vocab, clients, duration, prompt_range,
             "occupancy_mean": (sum(occ_samples) / len(occ_samples))
             if occ_samples else 0.0,
             "occupancy_max": max(occ_samples) if occ_samples else 0.0}
+
+
+def _prefix_client_loop(endpoint, templates, zipf_p, vocab, seed,
+                        suffix_range, token_range, stop, out, retries,
+                        deadline_ms, seen, seen_lock):
+    """One closed-loop prefix-mix client: zipf-sampled template + random
+    suffix. TTFTs are split cold/warm by whether this request was the
+    FIRST to issue its template fleet-wide (approximate under
+    concurrency — two racing firsts both run cold but only one is
+    counted cold; the split is a report, not a gate)."""
+    rng = np.random.RandomState(seed)
+    lat, cold_ttft, warm_ttft, tokens, done = [], [], [], 0, 0
+    rejected = deadline_missed = exhausted = errors = 0
+    with ServingClient(endpoint, retries=retries, backoff_base_ms=5.0,
+                       retry_seed=seed) as c:
+        while not stop.is_set():
+            t = int(rng.choice(len(templates), p=zipf_p))
+            suffix = rng.randint(0, vocab, size=(
+                int(rng.randint(suffix_range[0], suffix_range[1] + 1)),))
+            prompt = np.concatenate([templates[t], suffix])
+            budget = int(rng.randint(token_range[0], token_range[1] + 1))
+            with seen_lock:
+                cold = t not in seen
+                seen.add(t)
+            t0 = time.monotonic()
+            try:
+                r = c.generate(prompt, max_new_tokens=budget,
+                               timeout_ms=deadline_ms)
+                lat.append(time.monotonic() - t0)
+                (cold_ttft if cold else warm_ttft).append(
+                    r["ttft_ms"] / 1e3)
+                tokens += len(r["tokens"])
+                done += 1
+            except ServingRejected:
+                rejected += 1
+                time.sleep(0.001)
+            except DeadlineExceeded:
+                deadline_missed += 1
+            except RetryBudgetExceeded:
+                exhausted += 1
+            except Exception:
+                import traceback
+
+                print(f"prefix-mix client {seed} error:\n"
+                      f"{traceback.format_exc()}", file=sys.stderr)
+                errors += 1
+                break
+        retries_used = c.retries_total
+    out.append({"lat": lat, "cold_ttft": cold_ttft, "warm_ttft": warm_ttft,
+                "tokens": tokens, "done": done, "rejected": rejected,
+                "deadline_missed": deadline_missed, "exhausted": exhausted,
+                "errors": errors, "retries": retries_used})
+
+
+def bench_prefix_mix(endpoint, vocab, clients, duration, templates,
+                     zipf_a, suffix_range, token_range, retries=0,
+                     deadline_ms=None):
+    """Closed-loop prefix-mix bench: K shared templates, zipf popularity.
+    The server-side prefix/page gauges are scraped at the end — they are
+    the ground truth the client-side cold/warm split approximates."""
+    ranks = np.arange(1, len(templates) + 1, dtype=np.float64)
+    zipf_p = ranks ** -zipf_a
+    zipf_p /= zipf_p.sum()
+    stop = threading.Event()
+    out = []
+    seen, seen_lock = set(), threading.Lock()
+    threads = [threading.Thread(
+        target=_prefix_client_loop,
+        args=(endpoint, templates, zipf_p, vocab, i, suffix_range,
+              token_range, stop, out, retries, deadline_ms, seen,
+              seen_lock), daemon=True)
+        for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(60)
+    elapsed = time.monotonic() - t0
+    lats = sorted(x for r in out for x in r["lat"])
+    cold = sorted(x for r in out for x in r["cold_ttft"])
+    warm = sorted(x for r in out for x in r["warm_ttft"])
+    tokens = sum(r["tokens"] for r in out)
+    done = sum(r["done"] for r in out)
+    res = {"elapsed_s": elapsed, "generations": done, "tokens": tokens,
+           "tokens_per_s": tokens / elapsed if elapsed else 0.0,
+           "rejected": sum(r["rejected"] for r in out),
+           "deadline_missed": sum(r["deadline_missed"] for r in out),
+           "retry_exhausted": sum(r["exhausted"] for r in out),
+           "errors": sum(r["errors"] for r in out),
+           "client_retries": sum(r["retries"] for r in out),
+           # whole-generation latency under the SAME keys bench_generate
+           # emits, so --slo p95_ms/... judges this workload too
+           "gen_p50_ms": _percentile(lats, 0.50) * 1e3,
+           "gen_p95_ms": _percentile(lats, 0.95) * 1e3,
+           "ttft_p50_ms": _percentile(sorted(cold + warm), 0.50) * 1e3,
+           "ttft_p95_ms": _percentile(sorted(cold + warm), 0.95) * 1e3,
+           "cold_generations": len(cold), "warm_generations": len(warm),
+           "ttft_cold_p50_ms": _percentile(cold, 0.50) * 1e3,
+           "ttft_cold_p95_ms": _percentile(cold, 0.95) * 1e3,
+           "ttft_warm_p50_ms": _percentile(warm, 0.50) * 1e3,
+           "ttft_warm_p95_ms": _percentile(warm, 0.95) * 1e3}
+    try:
+        with ServingClient(endpoint) as c:
+            d = c.healthz().get("decode") or {}
+            res["kv_pages"] = d.get("kv_pages") or {}
+            res["prefix"] = d.get("prefix") or {}
+    except Exception:
+        res["kv_pages"], res["prefix"] = {}, {}
+    return res
 
 
 def _fleet_client_loop(router, feeds, tenant, stop, out, deadline_ms,
@@ -546,7 +668,34 @@ def main(argv=None):
     ap.add_argument("--gen-tokens", default="8:64", metavar="LO:HI",
                     help="per-generation max_new_tokens range (--generate)")
     ap.add_argument("--prompt-tokens", default="2:16", metavar="LO:HI",
-                    help="per-generation prompt length range (--generate)")
+                    help="per-generation prompt length range (--generate); "
+                         "with --prefix-mix this sizes the per-request "
+                         "SUFFIX after the shared template")
+    ap.add_argument("--prefix-mix", metavar="K:TLEN", default=None,
+                    help="shared-prefix generation workload: K templates "
+                         "of TLEN tokens, zipf-popular, each request = "
+                         "template + random suffix. Implies --generate "
+                         "and (with --model-dir) a paged-KV decode "
+                         "engine; reports prefix-hit rate, pages in use, "
+                         "and TTFT cold-vs-warm")
+    ap.add_argument("--zipf-a", type=float, default=1.1,
+                    help="zipf exponent of template popularity "
+                         "(--prefix-mix)")
+    ap.add_argument("--paged-kv", action="store_true",
+                    help="serve decode through the paged KV pool + radix "
+                         "prefix cache (docs §22) even without "
+                         "--prefix-mix")
+    ap.add_argument("--kv-page-len", type=int, default=None,
+                    help="tokens per KV page (paged engine; default 16)")
+    ap.add_argument("--kv-pool-pages", type=int, default=None,
+                    help="explicit page-pool size (default: "
+                         "max_slots*max_len/page_len/overcommit)")
+    ap.add_argument("--kv-overcommit", type=float, default=None,
+                    help="dense-positions / pool-positions ratio sizing "
+                         "the default pool (default 2.0)")
+    ap.add_argument("--kv-watermark", type=float, default=None,
+                    help="free-page fraction below which cached prefixes "
+                         "evict LRU (default 0: evict on demand only)")
     ap.add_argument("--max-slots", type=int, default=None,
                     help="KV slot pool size of the in-process decode "
                          "engine (--generate + --model-dir; default: the "
@@ -579,6 +728,8 @@ def main(argv=None):
                          "transitions, sheds, faults, chaos injections) "
                          "through stdlib logging as one-line JSON")
     args = ap.parse_args(argv)
+    if args.prefix_mix:
+        args.generate = True  # the prefix mix IS a generation workload
     if args.log_json:
         import logging
 
@@ -707,6 +858,14 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
                 if args.prefill_chunk is not None:
                     decode["prefill_chunk"] = args.prefill_chunk
                 decode["gen_queue_capacity"] = args.queue_capacity
+                if args.paged_kv or args.prefix_mix:
+                    decode["paged"] = True
+                for knob, val in (("page_len", args.kv_page_len),
+                                  ("pool_pages", args.kv_pool_pages),
+                                  ("overcommit", args.kv_overcommit),
+                                  ("evict_watermark", args.kv_watermark)):
+                    if val is not None:
+                        decode[knob] = val
             server = ServingServer(
                 args.model_dir, max_batch_size=args.max_batch_size,
                 batch_timeout_ms=args.batch_timeout_ms,
@@ -748,6 +907,60 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
             elif not shapes:
                 raise SystemExit("--endpoint needs at least one "
                                  "--shape name=dims")
+
+        if args.prefix_mix:
+            k, _, tlen = args.prefix_mix.partition(":")
+            try:
+                k, tlen = int(k), int(tlen)
+            except ValueError:
+                raise SystemExit(f"--prefix-mix wants K:TLEN, got "
+                                 f"{args.prefix_mix!r}")
+            if k < 1 or tlen < 1:
+                raise SystemExit("--prefix-mix wants K >= 1, TLEN >= 1")
+            pr = _parse_range(args.prompt_tokens, "prompt-tokens")
+            tr = _parse_range(args.gen_tokens, "gen-tokens")
+            trng = np.random.RandomState(12345)  # fixed: re-runs re-hit
+            templates = [trng.randint(0, args.vocab, size=(tlen,))
+                         for _ in range(k)]
+            print(f"benching {endpoint}: {args.clients} closed-loop "
+                  f"PREFIX-MIX clients, {args.duration:.0f}s — "
+                  f"{k} templates x {tlen} tokens (zipf a={args.zipf_a}), "
+                  f"suffixes {pr[0]}-{pr[1]}, budgets {tr[0]}-{tr[1]}")
+            r = bench_prefix_mix(endpoint, args.vocab, args.clients,
+                                 args.duration, templates, args.zipf_a,
+                                 pr, tr, retries=retries,
+                                 deadline_ms=args.deadline_ms)
+            print(f"generations={r['generations']} tokens={r['tokens']} "
+                  f"tokens/s={r['tokens_per_s']:.1f} "
+                  f"rejected={r['rejected']} errors={r['errors']}")
+            print(f"generation latency: p50={r['gen_p50_ms']:.1f}ms "
+                  f"p95={r['gen_p95_ms']:.1f}ms")
+            p = r.get("prefix") or {}
+            queries = p.get("queries", 0)
+            print(f"prefix cache: hit rate "
+                  f"{p.get('hits', 0) / queries if queries else 0.0:.2%} "
+                  f"({p.get('hits', 0)}/{queries} admissions, "
+                  f"{p.get('hit_tokens', 0)} tokens served from cache, "
+                  f"{p.get('nodes', 0)} cached pages, "
+                  f"{p.get('evictions', 0)} evictions)")
+            kv = r.get("kv_pages") or {}
+            if kv:
+                print(f"kv pages: {kv.get('active', 0)} active + "
+                      f"{kv.get('cached', 0)} cached / "
+                      f"{kv.get('total', 0)} total "
+                      f"(page_len={kv.get('page_len')}, "
+                      f"{kv.get('free', 0)} free)")
+            print(f"ttft cold (first use of a template): "
+                  f"p50={r['ttft_cold_p50_ms']:.1f}ms "
+                  f"p95={r['ttft_cold_p95_ms']:.1f}ms "
+                  f"(n={r['cold_generations']})")
+            print(f"ttft warm: p50={r['ttft_warm_p50_ms']:.1f}ms "
+                  f"p95={r['ttft_warm_p95_ms']:.1f}ms "
+                  f"(n={r['warm_generations']})")
+            if tracer is not None:
+                n = tracer.dump(args.trace_out)
+                print(f"chrome trace: {args.trace_out} ({n} spans)")
+            return _judge_slo(args, r, 0 if r["errors"] == 0 else 1), r
 
         if args.generate:
             pr = _parse_range(args.prompt_tokens, "prompt-tokens")
