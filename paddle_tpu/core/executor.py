@@ -306,8 +306,15 @@ class Executor:
     def _run_on_device(self, program, feed, fetch_names, scope, return_numpy,
                        block_idx, seed):
         from ..obs import get_tracer as _get_tracer
+        from ..obs.goodput import get_accountant
 
+        acct = get_accountant()
         feed_names = tuple(sorted(feed))
+        # goodput accounting (docs §23): host_input covers method entry up
+        # to the device dispatch; the compile interval nested inside is
+        # carved out by the sweep's priorities, so host work and compiles
+        # never double count
+        t_acct = time.monotonic() if acct.enabled else 0.0
         with _get_tracer().span("train/host_prep", cat="train"):
             feed_vals = {k: _to_device_array(v, program, k, self._device)
                          for k, v in feed.items()}
@@ -355,15 +362,25 @@ class Executor:
         from ..obs import get_tracer
 
         tr = get_tracer()
+        if acct.enabled:
+            acct.account("host_input", t_acct, time.monotonic() - t_acct)
         with RecordEvent(f"executor_run/block{block_idx}"):
+            t_acct = time.monotonic() if acct.enabled else 0.0
             with tr.span("train/device_dispatch", cat="train"):
                 fetches, new_state = fn(feed_vals, readonly, donated, key)
                 for n in state_out_names:
                     scope.set(n, new_state[n])
+            if acct.enabled:
+                acct.account("device_compute", t_acct,
+                             time.monotonic() - t_acct)
             if return_numpy:
                 # the host sync point: np conversion blocks on the device
+                t_acct = time.monotonic() if acct.enabled else 0.0
                 with tr.span("train/fetch_sync", cat="train"):
                     fetches = [np.asarray(v) for v in fetches]
+                if acct.enabled:
+                    acct.account("fetch_sync", t_acct,
+                                 time.monotonic() - t_acct)
         _record_step_flops(flops)
         if get_flag("check_nan_inf"):
             # <- FLAGS_check_nan_inf (operator.cc RunImpl tail): scan every
@@ -400,6 +417,10 @@ class Executor:
         flops = None
         if get_flag("obs_cost_analysis") and (
                 get_tracer().enabled or is_set("obs_cost_analysis")):
+            from ..obs.goodput import get_accountant
+
+            acct = get_accountant()
+            t_acct = time.monotonic() if acct.enabled else 0.0
             try:
                 from ..obs import abstractify, analyze_jit
 
@@ -407,6 +428,10 @@ class Executor:
                 flops = analyze_jit(fn, *avals)["flops"]
             except Exception:
                 flops = None
+            if acct.enabled:
+                # the annotation re-lowers the whole step once per cache
+                # entry: seconds of XLA work — billed as compile (docs §23)
+                acct.account("compile", t_acct, time.monotonic() - t_acct)
         self._flops[cache_key] = flops
         while len(self._flops) > self._cache_capacity * 2:
             self._flops.pop(next(iter(self._flops)))
@@ -553,8 +578,14 @@ class Executor:
     def _run_steps_on_device(self, program, feeds, invariant, k, fetch_names,
                              scope, return_numpy, block_idx, seed):
         from ..obs import get_tracer as _get_tracer
+        from ..obs.goodput import get_accountant
 
+        acct = get_accountant()
         feed_names = tuple(sorted(feeds if invariant else feeds[0]))
+        # goodput accounting (docs §23): host_input spans method entry to
+        # the device dispatch; nested compile/h2d intervals are carved
+        # out by the sweep's priorities
+        t_acct = time.monotonic() if acct.enabled else 0.0
         with _get_tracer().span("train/host_prep", cat="train", k=k):
             if invariant:
                 feed_vals = {n: _to_device_array(feeds[n], program, n,
@@ -580,7 +611,17 @@ class Executor:
                         # ONE H2D transfer per name for the whole window
                         stacked = np.stack(
                             [_coerce_host(v, program, n) for v in vals])
-                        feed_vals[n] = jax.device_put(stacked, self._device)
+                        t_h2d = time.monotonic()
+                        with _get_tracer().span("train/h2d", cat="train",
+                                                feed=n):
+                            feed_vals[n] = jax.device_put(stacked,
+                                                          self._device)
+                        if acct.enabled:
+                            # nested inside host_prep: the sweep's h2d
+                            # priority carves the transfer out of
+                            # host_input instead of double counting
+                            acct.account("h2d", t_h2d,
+                                         time.monotonic() - t_h2d)
                 step_sig = tuple(
                     (n, feed_vals[n].shape[1:], str(feed_vals[n].dtype))
                     for n in feed_names)
@@ -640,17 +681,27 @@ class Executor:
         from ..obs import get_tracer
 
         tr = get_tracer()
+        if acct.enabled:
+            acct.account("host_input", t_acct, time.monotonic() - t_acct)
         sent_finite = sent_norms = None
         with RecordEvent(f"executor_run_steps/block{block_idx}"):
+            t_acct = time.monotonic() if acct.enabled else 0.0
             with tr.span("train/device_window", cat="train", k=k):
                 fetches, new_state = fn(feed_vals, readonly, state, keys)
                 if sentinel:
                     fetches, sent_finite, sent_norms = fetches
                 for n in state_out_names:
                     scope.set(n, new_state[n])
+            if acct.enabled:
+                acct.account("device_compute", t_acct,
+                             time.monotonic() - t_acct)
             if return_numpy:
+                t_acct = time.monotonic() if acct.enabled else 0.0
                 with tr.span("train/fetch_sync", cat="train"):
                     fetches = [np.asarray(v) for v in fetches]
+                if acct.enabled:
+                    acct.account("fetch_sync", t_acct,
+                                 time.monotonic() - t_acct)
         # the annotated FLOPs cover the WHOLE k-step window program
         _record_step_flops(flops, steps=k)
         if sentinel:
@@ -675,12 +726,17 @@ class Executor:
         entry = self._cache.get(cache_key)
         if entry is None:
             from ..obs import get_tracer
+            from ..obs.goodput import get_accountant
 
             _train_metrics()["compiles"].inc()
+            acct = get_accountant()
+            t_acct = time.monotonic() if acct.enabled else 0.0
             t_c = time.perf_counter()
             with RecordEvent(event):
                 with get_tracer().span(f"train/{event}", cat="compile"):
                     entry = compile_fn()
+            if acct.enabled:
+                acct.account("compile", t_acct, time.monotonic() - t_acct)
             if get_flag("log_compile"):
                 print(f"[compile] {log_label} "
                       f"{time.perf_counter() - t_c:.3f}s", flush=True)

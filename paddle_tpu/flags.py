@@ -76,6 +76,20 @@ _DEFAULTS: Dict[str, Any] = {
     # where automatic postmortem bundles land ("" = <tempdir>/
     # paddle_tpu_flight); obs/flight.py FlightRecorder.dump
     "obs_flight_dir": "",
+    # goodput accountant (obs/goodput.py, docs/design.md §23): classify
+    # every wall-clock second of training windows and every request-second
+    # of serving into the exhaustive taxonomy; exports pt_goodput_ratio /
+    # pt_badput_seconds_total{category}. Zero-cost disabled (one attribute
+    # read per instrumentation site).
+    "obs_goodput": False,
+    # where bench/serving profile artifacts land ("" = next to the caller:
+    # bench writes PROFILE_rNN.json into the repo root, serve_bench into
+    # the cwd); obs/profile.py save_profile
+    "obs_profile_dir": "",
+    # wall-time regression tolerance of the differential attributor
+    # (obs/profile.py diff_profiles): a profile pair whose wall ratio
+    # exceeds 1 + tol emits perf_regression and can trip the recorder
+    "obs_profile_diff_tolerance": 0.03,
     # CPU serving lane (serving/quant.py, docs/design.md §20):
     # serving_quantize is the default weight-only quantization mode of
     # every ServingServer built without an explicit quantize= — "" = f32,

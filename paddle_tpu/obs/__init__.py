@@ -46,14 +46,27 @@ from .events import (DISCARDED, Event, EventLog,  # noqa: F401
 from .flight import (FlightRecorder, get_recorder, load_bundle,  # noqa: F401
                      replay_bundle, validate_bundle)
 from .slo import SLO, SLOWatchdog, judge_bench, parse_slo_spec  # noqa: F401
+from .goodput import (GOOD_CATEGORIES, TRAIN_CATEGORIES,  # noqa: F401
+                      GoodputAccountant, get_accountant,
+                      serving_categories)
+from .profile import (ProfileError, attribute_regression,  # noqa: F401
+                      build_profile, diff_profiles, format_diff,
+                      goodput_report, load_profile, profile_from_window,
+                      save_profile)
 
 __all__ = [
     "Counter", "DISCARDED", "Event", "EventLog", "ExemplarStore",
-    "FlightRecorder", "Gauge", "Histogram", "LoggingJSONSink",
-    "MetricsRegistry", "MetricsServer", "RateWindow", "SLO", "SLOWatchdog",
-    "Span", "Tracer", "abstractify", "analyze_jit",
+    "FlightRecorder", "GOOD_CATEGORIES", "Gauge", "GoodputAccountant",
+    "Histogram", "LoggingJSONSink",
+    "MetricsRegistry", "MetricsServer", "ProfileError", "RateWindow",
+    "SLO", "SLOWatchdog",
+    "Span", "TRAIN_CATEGORIES", "Tracer", "abstractify", "analyze_jit",
+    "attribute_regression", "build_profile", "diff_profiles",
     "disable", "enable", "enable_json_logging", "flops_of_lowered",
-    "get_event_log", "get_recorder", "get_registry", "get_tracer",
-    "init_from_flags", "judge_bench", "load_bundle", "new_trace_id",
-    "parse_slo_spec", "peak_flops", "replay_bundle", "validate_bundle",
+    "format_diff", "get_accountant", "get_event_log", "get_recorder",
+    "get_registry", "get_tracer", "goodput_report",
+    "init_from_flags", "judge_bench", "load_bundle", "load_profile",
+    "new_trace_id", "parse_slo_spec", "peak_flops", "profile_from_window",
+    "replay_bundle", "save_profile", "serving_categories",
+    "validate_bundle",
 ]

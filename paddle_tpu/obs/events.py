@@ -57,6 +57,9 @@ EVENT_TYPES = (
     "nan_detected", "loss_spike", "grad_norm_spike",
     # watchdog / recorder
     "slo_breach", "worker_exception", "bundle_dumped",
+    # differential attribution (obs/profile.py, docs §23): a profile pair
+    # regressed beyond tolerance — attrs name the owning category
+    "perf_regression",
 )
 
 SEVERITIES = ("debug", "info", "warn", "error")

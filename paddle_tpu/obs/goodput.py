@@ -1,0 +1,487 @@
+"""Goodput accountant: classify every second of wall time, name its owner.
+
+PRs 5/9 gave the stack signals (spans, stage histograms, typed events);
+nothing *accounts* for time — when a bench round slips or a replica's p95
+drifts, a human greps spans by hand. This module is the attribution tier
+(docs/design.md §23): an exhaustive, non-overlapping taxonomy over the
+existing instrumentation, with a closure invariant (categories sum to the
+measured wall within tolerance) so "where did the time go" is a framework
+answer, not an investigation.
+
+Two planes, one accountant:
+
+* **training** — instrumented code (the executor's ``run``/``run_steps``
+  paths, the prefetcher) feeds raw intervals via ``account(category, t0,
+  dur)``; a *window* (``window()`` context manager, one ``run_steps``
+  bench loop, a trainer epoch) classifies them with a priority sweep into
+  ``device_compute / host_input / h2d / compile / fetch_sync / idle``.
+  The sweep attributes every instant of the window to exactly ONE
+  category (overlaps resolve by priority: a device-bound instant is
+  device_compute even while the prefetcher stages the next batch — time
+  hidden behind the device is not badput), so the closure invariant
+  ``sum(categories) == wall`` holds exactly by construction; ``idle`` is
+  the uncovered remainder and *attributed* time (non-idle) is the
+  coverage witness the ``goodput_accounting_closure`` bench bar judges.
+* **serving** — per-request accounting off the stage timings the batcher
+  already records: the ONE stage list in ``serving/stats.py`` (``STAGES``)
+  plus the accountant's non-stage request categories (``retry_backoff``,
+  ``shed``) and the per-request ``idle`` residual. Categories sum to the
+  request's measured wall (``timings["total"]``) within tolerance because
+  the stage timestamps are contiguous by construction (batcher.py).
+
+Design constraints (the PR-5 discipline, verbatim):
+
+* **zero-cost when disabled** — ``window()`` returns one shared no-op
+  singleton (identity-tested), ``account*()`` is one attribute read and
+  an early return; every instrumentation site guards on ``enabled``.
+* **bounded** — raw intervals land in an overwrite ring with a dropped
+  counter; a week of accounting cannot leak memory.
+* **one source of truth** — the windowed ``pt_goodput_ratio`` gauge and
+  the ``pt_badput_seconds_total{category}`` counters are ``obs.metrics``
+  instruments on the accountant's registry (a server binds its stats
+  registry, so ``GET /metrics`` carries them and ``scraped_gauges()``
+  rolls them up fleet-wide); ``summary()`` reads the same state.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import MetricsRegistry, RateWindow, get_registry
+
+#: training-plane taxonomy (docs §23). ``idle`` is the sweep residual.
+TRAIN_CATEGORIES = ("device_compute", "host_input", "h2d", "compile",
+                    "fetch_sync", "idle")
+
+#: sweep priorities: at any instant the highest-priority *active* interval
+#: owns it (device beats everything — host work overlapped with the device
+#: is hidden, not badput; an h2d transfer nested inside host_prep carves
+#: its own category out of the parent instead of double counting)
+TRAIN_PRIORITY = {"device_compute": 5, "compile": 4, "fetch_sync": 3,
+                  "h2d": 2, "host_input": 1}
+
+#: categories whose seconds count as GOODPUT (the device doing, or the
+#: host blocked on, useful model math); everything else — queueing,
+#: padding, compiles, backoff sleeps, sheds, idle — is badput
+GOOD_CATEGORIES = frozenset({
+    "device_compute", "fetch_sync",            # train plane
+    "dispatch", "device_sync", "prefill", "decode_step",  # serving plane
+})
+
+#: per-request closure tolerance: stage timestamps are contiguous by
+#: construction, so 5% absorbs only scheduler jitter between stamps
+CLOSURE_TOL = 0.05
+
+
+def serving_categories() -> Tuple[str, ...]:
+    """The serving request taxonomy: the ONE stage list owned by
+    ``serving/stats.py`` (shared with the batcher and the stage
+    histograms — ISSUE 14 dedup) plus the accountant's non-stage request
+    categories and the residual. Lazy import: obs must stay importable
+    without the serving tree."""
+    from ..serving.stats import EXTRA_REQUEST_CATEGORIES, STAGES
+
+    return STAGES + EXTRA_REQUEST_CATEGORIES + ("idle",)
+
+
+class _NoopWindow:
+    """Shared do-nothing window: the disabled-accountant fast path
+    allocates NOTHING per call (tests assert identity)."""
+
+    __slots__ = ()
+    result = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_WINDOW = _NoopWindow()
+
+
+class _Window:
+    """An open accounting window; closing sweeps the raw intervals into
+    the train taxonomy and snapshots the serving request accounting that
+    landed while it was open."""
+
+    __slots__ = ("_acct", "label", "result")
+
+    def __init__(self, acct, label):
+        self._acct = acct
+        self.label = label
+        self.result = None
+
+    def __enter__(self):
+        self._acct.begin_window(self.label)
+        return self
+
+    def __exit__(self, *exc):
+        self.result = self._acct.end_window()
+        return False
+
+
+def _sweep(intervals: Sequence[Tuple[str, float, float]], t0: float,
+           t1: float) -> Tuple[Dict[str, float], float]:
+    """Priority-classify raw (category, start, dur) intervals over
+    [t0, t1]: every instant goes to the highest-priority active category;
+    uncovered instants are the returned idle. Exhaustive and
+    non-overlapping by construction: sum(out) + idle == t1 - t0."""
+    out = {c: 0.0 for c in TRAIN_PRIORITY}
+    events: List[Tuple[float, int, str]] = []
+    for cat, s, d in intervals:
+        a, b = max(s, t0), min(s + d, t1)
+        if b <= a or cat not in TRAIN_PRIORITY:
+            continue
+        events.append((a, 1, cat))
+        events.append((b, 0, cat))
+    if not events:
+        return out, max(0.0, t1 - t0)
+    events.sort(key=lambda e: (e[0], e[1]))
+    by_prio = sorted(TRAIN_PRIORITY, key=lambda c: -TRAIN_PRIORITY[c])
+    active = {c: 0 for c in TRAIN_PRIORITY}
+    cur, idle = t0, 0.0
+    for t, kind, cat in events:
+        if t > cur:
+            top = next((c for c in by_prio if active[c] > 0), None)
+            if top is None:
+                idle += t - cur
+            else:
+                out[top] += t - cur
+            cur = t
+        active[cat] += 1 if kind else -1
+    if t1 > cur:
+        idle += t1 - cur
+    return out, idle
+
+
+class GoodputAccountant:
+    """Thread-safe time accountant over both planes (docs §23)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 window_s: float = 10.0, max_intervals: int = 65536):
+        self._lock = threading.Lock()
+        self._enabled = False
+        self.registry = registry
+        self.max_intervals = max(16, int(max_intervals))
+        self._intervals: deque = deque(maxlen=self.max_intervals)
+        self.intervals_dropped = 0
+        # cumulative per-category seconds (profiles read these)
+        self._train_cum: Dict[str, float] = {}
+        self._serve_cum: Dict[str, float] = {}
+        self._serve_wall = 0.0       # sum of request walls accounted
+        self._serve_attributed = 0.0
+        self._serve_requests = 0
+        self._closure_violations = 0  # requests outside CLOSURE_TOL
+        # current window state (begin_window/end_window)
+        self._win_t0: Optional[float] = None
+        self._win_label = ""
+        self._win_serve: Dict[str, float] = {}
+        self._win_serve_wall = 0.0
+        self._win_serve_attr = 0.0
+        self._win_serve_requests = 0
+        self.last_window: Optional[Dict[str, Any]] = None
+        # windowed good/bad rates -> the live ratio gauge
+        self._good_rate = RateWindow(window_s)
+        self._bad_rate = RateWindow(window_s)
+        self._badput_counter = None
+        if registry is not None:
+            self._ensure_instruments()
+
+    # -- switches ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, registry: Optional[MetricsRegistry] = None
+               ) -> "GoodputAccountant":
+        if registry is not None:
+            self.registry = registry
+        self._ensure_instruments()
+        self._enabled = True
+        return self
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def reset(self) -> None:
+        """Drop all accounted state (tests, round boundaries)."""
+        with self._lock:
+            self._intervals.clear()
+            self.intervals_dropped = 0
+            self._train_cum = {}
+            self._serve_cum = {}
+            self._serve_wall = self._serve_attributed = 0.0
+            self._serve_requests = 0
+            self._closure_violations = 0
+            self._win_t0 = None
+            self.last_window = None
+
+    def _ensure_instruments(self) -> None:
+        r = self.registry or get_registry()
+        self.registry = r
+        r.gauge("pt_goodput_ratio",
+                "Windowed goodput seconds / accounted seconds "
+                "(1.0 when nothing was accounted in the window)",
+                callback=self.goodput_ratio)
+        self._badput_counter = r.counter(
+            "pt_badput_seconds_total",
+            "Accounted non-productive seconds by category",
+            labelnames=("category",))
+
+    # -- recording ---------------------------------------------------------
+    def account(self, category: str, t0: float, dur: float) -> None:
+        """Record one raw training-plane interval (``t0`` monotonic
+        seconds). Classification happens at window close — instrumented
+        sites just report what they measured."""
+        if not self._enabled or dur <= 0:
+            return
+        with self._lock:
+            if len(self._intervals) == self._intervals.maxlen:
+                self.intervals_dropped += 1
+            self._intervals.append((category, t0, dur))
+
+    def account_request(self, timings: Dict[str, float],
+                        t0: Optional[float] = None) -> None:
+        """Classify one completed serving request's stage timings
+        (``serving/stats.STAGES`` names + ``total``). The residual
+        (wall minus attributed stages) is the request's ``idle``;
+        requests whose attributed time misses the wall by more than
+        ``CLOSURE_TOL`` are counted as closure violations. ``t0`` (the
+        request's submit monotonic time) additionally records the stage
+        intervals into the ring so the timeline export can draw them."""
+        if not self._enabled or not timings:
+            return
+        cats = serving_categories()
+        wall = float(timings.get("total") or 0.0)
+        attributed = 0.0
+        good = bad = 0.0
+        with self._lock:
+            t = t0
+            for stage in cats:
+                dur = timings.get(stage)
+                if not dur or dur <= 0:
+                    continue
+                attributed += dur
+                self._serve_cum[stage] = self._serve_cum.get(stage, 0.0) + dur
+                if self._win_t0 is not None:
+                    self._win_serve[stage] = \
+                        self._win_serve.get(stage, 0.0) + dur
+                if stage in GOOD_CATEGORIES:
+                    good += dur
+                else:
+                    bad += dur
+                    if self._badput_counter is not None:
+                        self._badput_counter.labels(category=stage).inc(dur)
+                if t is not None:
+                    if len(self._intervals) == self._intervals.maxlen:
+                        self.intervals_dropped += 1
+                    self._intervals.append((stage, t, dur))
+                    t += dur
+            if wall <= 0:
+                wall = attributed
+            idle = max(0.0, wall - attributed)
+            if idle > 0:
+                self._serve_cum["idle"] = \
+                    self._serve_cum.get("idle", 0.0) + idle
+                bad += idle
+                if self._badput_counter is not None:
+                    self._badput_counter.labels(category="idle").inc(idle)
+                if self._win_t0 is not None:
+                    self._win_serve["idle"] = \
+                        self._win_serve.get("idle", 0.0) + idle
+            self._serve_wall += wall
+            self._serve_attributed += attributed
+            self._serve_requests += 1
+            if wall > 0 and abs(wall - attributed) > CLOSURE_TOL * wall:
+                self._closure_violations += 1
+            if self._win_t0 is not None:
+                self._win_serve_wall += wall
+                self._win_serve_attr += attributed
+                self._win_serve_requests += 1
+        if good:
+            self._good_rate.add(good)
+        if bad:
+            self._bad_rate.add(bad)
+
+    def account_shed(self, seconds: float) -> None:
+        """A request shed after spending ``seconds`` in the system
+        (deadline shed at coalesce time, mid-generation shed): its whole
+        wall is the ``shed`` category."""
+        if not self._enabled or seconds <= 0:
+            return
+        self.account_request({"total": seconds, "shed": seconds})
+
+    def account_retry_backoff(self, seconds: float) -> None:
+        """Client-side retry backoff sleep: request-seconds the caller
+        spent waiting to try again."""
+        if not self._enabled or seconds <= 0:
+            return
+        self.account_request({"total": seconds, "retry_backoff": seconds})
+
+    # -- windows -----------------------------------------------------------
+    def window(self, label: str = ""):
+        """Context manager over one accounting window (a bench workload,
+        a trainer epoch). Disabled: the shared no-op singleton."""
+        if not self._enabled:
+            return _NOOP_WINDOW
+        return _Window(self, label)
+
+    def begin_window(self, label: str = "") -> None:
+        with self._lock:
+            self._win_t0 = time.monotonic()
+            self._win_label = label
+            self._win_serve = {}
+            self._win_serve_wall = self._win_serve_attr = 0.0
+            self._win_serve_requests = 0
+
+    def end_window(self) -> Optional[Dict[str, Any]]:
+        """Close the current window: sweep the train intervals that
+        intersect it, snapshot the serving accounting that landed in it,
+        and return the summary (also kept as ``last_window``)."""
+        t1 = time.monotonic()
+        with self._lock:
+            if self._win_t0 is None:
+                return None
+            t0, label = self._win_t0, self._win_label
+            self._win_t0 = None
+            intervals = [iv for iv in self._intervals if iv[1] + iv[2] > t0
+                         and iv[1] < t1 and iv[0] in TRAIN_PRIORITY]
+            serve = dict(self._win_serve)
+            serve_wall = self._win_serve_wall
+            serve_attr = self._win_serve_attr
+            serve_n = self._win_serve_requests
+        cats, idle = _sweep(intervals, t0, t1)
+        wall = t1 - t0
+        attributed = sum(cats.values())
+        train_good = sum(s for c, s in cats.items() if c in GOOD_CATEGORIES)
+        train_bad = attributed - train_good + idle
+        if train_good:
+            self._good_rate.add(train_good)
+        if train_bad:
+            self._bad_rate.add(train_bad)
+        if self._badput_counter is not None:
+            for c, s in cats.items():
+                if s > 0 and c not in GOOD_CATEGORIES:
+                    self._badput_counter.labels(category=c).inc(s)
+            if idle > 0:
+                self._badput_counter.labels(category="idle").inc(idle)
+        with self._lock:
+            for c, s in cats.items():
+                if s > 0:
+                    self._train_cum[c] = self._train_cum.get(c, 0.0) + s
+            if idle > 0:
+                self._train_cum["idle"] = \
+                    self._train_cum.get("idle", 0.0) + idle
+        train_cats = {c: s for c, s in cats.items() if s > 0}
+        train_cats["idle"] = idle
+        good = train_good + sum(s for c, s in serve.items()
+                                if c in GOOD_CATEGORIES)
+        accounted = attributed + idle + sum(serve.values())
+        self.last_window = {
+            "label": label,
+            "wall_s": wall,
+            "t0_monotonic": t0,
+            "train": {
+                "categories": train_cats,
+                "attributed_s": attributed,
+                # closure witness: fraction of the window explained by
+                # real (non-idle) categories
+                "closure": attributed / wall if wall > 0 else 1.0,
+            },
+            "serving": {
+                "categories": serve,
+                "wall_s": serve_wall,
+                "attributed_s": serve_attr,
+                "closure": serve_attr / serve_wall if serve_wall > 0 else 1.0,
+                "requests": serve_n,
+            },
+            "goodput_ratio": good / accounted if accounted > 0 else 1.0,
+        }
+        return self.last_window
+
+    def classify_range(self, t0: float, t1: float) -> Dict[str, Any]:
+        """Ad-hoc train-plane attribution over an arbitrary monotonic
+        range WITHOUT touching the window state — for callers (the bench
+        closure workload) measuring inside an already-open window."""
+        cats, idle = _sweep(self.intervals(), t0, t1)
+        wall = max(0.0, t1 - t0)
+        attributed = sum(cats.values())
+        out = {c: s for c, s in cats.items() if s > 0}
+        out["idle"] = idle
+        return {
+            "categories": out,
+            "wall_s": wall,
+            "attributed_s": attributed,
+            "closure": attributed / wall if wall > 0 else 1.0,
+        }
+
+    # -- reading -----------------------------------------------------------
+    def goodput_ratio(self) -> float:
+        """Windowed good / (good + bad) accounted seconds; 1.0 when the
+        window saw nothing (idleness is not a verdict)."""
+        g, b = self._good_rate.rate(), self._bad_rate.rate()
+        return g / (g + b) if (g + b) > 0 else 1.0
+
+    def summary(self) -> Dict[str, Any]:
+        """Rollup for stats RPCs / flight providers: cumulative category
+        seconds per plane, closure witnesses, the live ratio."""
+        with self._lock:
+            train = dict(self._train_cum)
+            serve = dict(self._serve_cum)
+            wall, attr = self._serve_wall, self._serve_attributed
+            n, viol = self._serve_requests, self._closure_violations
+        return {
+            "goodput_ratio": self.goodput_ratio(),
+            "train": {"categories": train},
+            "serving": {
+                "categories": serve,
+                "wall_s": wall,
+                "attributed_s": attr,
+                "closure": attr / wall if wall > 0 else 1.0,
+                "requests": n,
+                "closure_violations": viol,
+            },
+        }
+
+    def intervals(self) -> List[Tuple[str, float, float]]:
+        """Snapshot of the raw interval ring (category, t0, dur) —
+        monotonic-clock absolute, oldest first."""
+        with self._lock:
+            return list(self._intervals)
+
+    def dump_intervals(self, path: str) -> int:
+        """Write the per-category interval lanes for the timeline export
+        (``tools/timeline.py --goodput_path``); returns the count."""
+        ivs = self.intervals()
+        t0 = min((s for _, s, _ in ivs), default=time.monotonic())
+        doc = {"schema": 1, "t0_monotonic": t0,
+               "intervals": [{"category": c, "t0": s, "dur": d,
+                              "good": c in GOOD_CATEGORIES}
+                             for c, s, d in ivs]}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return len(ivs)
+
+
+_default = GoodputAccountant()
+
+
+def get_accountant() -> GoodputAccountant:
+    """The process-wide default accountant every instrumentation site
+    feeds (the attribution-plane sibling of ``get_tracer()``)."""
+    return _default
+
+
+def init_from_flags() -> GoodputAccountant:
+    """Honor ``flags.obs_goodput`` (an env var alone turns accounting
+    on) — called lazily by the instrumented entry points."""
+    from ..flags import get_flag
+
+    if get_flag("obs_goodput") and not _default.enabled:
+        _default.enable()
+    return _default

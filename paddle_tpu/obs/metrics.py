@@ -362,6 +362,12 @@ class MetricsRegistry:
         with self._lock:
             return self._instruments.get(name)
 
+    def instruments(self) -> Dict[str, _Instrument]:
+        """Snapshot of every registered family by name — the metrics-doc
+        generator (obs/metrics_doc.py) walks this."""
+        with self._lock:
+            return dict(self._instruments)
+
     def unregister(self, name: str) -> None:
         with self._lock:
             self._instruments.pop(name, None)

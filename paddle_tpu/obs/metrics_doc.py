@@ -1,0 +1,179 @@
+"""Generate ``docs/metrics.md`` from the live registries (docs §23).
+
+The ``pt_*`` metric namespace grew across nine PRs with no single
+contract: every subsystem registers instruments where it runs, and the
+only census was grepping. This module makes the doc a DERIVED artifact:
+
+* ``live_instruments()`` instantiates the registry-bearing subsystems
+  against throwaway registries (``ServingStats``, ``FleetStats``, the
+  goodput accountant, the event log's counter, the SLO watchdog, the
+  train/tune instrument families) and walks what they registered — name,
+  type, labels, and the HELP text straight from the source of truth;
+* ``scan_source_names()`` regex-scans the package for ``pt_*`` string
+  literals — the completeness backstop that catches instruments created
+  lazily on paths too heavy to instantiate here (server pull-gauges,
+  paged-KV gauges);
+* ``render_doc()`` merges both into one markdown table. Names found only
+  by the scan are still listed (with their source files), so the doc is
+  exhaustive by construction.
+
+The drift test (tests/test_obs_goodput.py) asserts every scanned name
+appears in the checked-in ``docs/metrics.md``: adding an instrument
+without regenerating (``paddle_cli metrics-doc``) fails CI.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .metrics import MetricsRegistry
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: string literals that LOOK like metric names but are not one concrete
+#: instrument (prefix matches, format templates)
+_SCAN_EXCLUDE = re.compile(r"(_$|^pt_$)")
+
+_NAME_RE = re.compile(r"""["'](pt_[a-z0-9_]+)["']""")
+
+
+def _collect(reg: MetricsRegistry, source: str,
+             out: Dict[str, Dict[str, object]]) -> None:
+    for name, inst in reg.instruments().items():
+        if not name.startswith("pt_"):
+            continue
+        out.setdefault(name, {
+            "type": inst.typ,
+            "labels": tuple(inst.labelnames),
+            "help": inst.help,
+            "source": source,
+        })
+
+
+def live_instruments() -> Dict[str, Dict[str, object]]:
+    """{name: {type, labels, help, source}} from instantiating the
+    registry-bearing subsystems against throwaway registries."""
+    out: Dict[str, Dict[str, object]] = {}
+    # serving + fleet planes: the stats objects register everything in
+    # their constructors
+    from ..serving.stats import FleetStats, ServingStats
+
+    _collect(ServingStats(registry=MetricsRegistry()).registry,
+             "serving/stats.py ServingStats", out)
+    _collect(FleetStats(registry=MetricsRegistry()).registry,
+             "serving/stats.py FleetStats", out)
+    # attribution plane (docs §23)
+    from .goodput import GoodputAccountant
+
+    _collect(GoodputAccountant(registry=MetricsRegistry()).registry,
+             "obs/goodput.py GoodputAccountant", out)
+    # black box + watchdog
+    r = MetricsRegistry()
+    from .events import EventLog
+
+    log = EventLog(registry=r)
+    log.enable()
+    log.emit("health_transition")  # forces the lazy counter
+    _collect(r, "obs/events.py EventLog", out)
+    r = MetricsRegistry()
+    from .slo import SLOWatchdog
+
+    SLOWatchdog([], registry=r)
+    _collect(r, "obs/slo.py SLOWatchdog", out)
+    # training + tuner planes register into the PROCESS default registry
+    # lazily; poke them, then read only their families off it
+    from ..core.executor import _train_metrics
+    from .metrics import get_registry
+
+    _train_metrics()
+    _collect_prefixed(get_registry(), "pt_train_",
+                      "core/executor.py _train_metrics", out)
+    try:
+        from ..tune import service as tune_service
+
+        tune_service._metrics()
+        _collect_prefixed(get_registry(), "pt_tune_",
+                          "tune/service.py", out)
+    except Exception:
+        pass
+    return out
+
+
+def _collect_prefixed(reg: MetricsRegistry, prefix: str, source: str,
+                      out: Dict[str, Dict[str, object]]) -> None:
+    for name, inst in reg.instruments().items():
+        if name.startswith(prefix):
+            out.setdefault(name, {
+                "type": inst.typ,
+                "labels": tuple(inst.labelnames),
+                "help": inst.help,
+                "source": source,
+            })
+
+
+def scan_source_names(root: str = _PKG_ROOT) -> Dict[str, List[str]]:
+    """{pt_* literal: [files]} across the package source — the
+    completeness backstop for instruments registered on paths too heavy
+    to instantiate (server pull-gauges, paged-KV engines)."""
+    found: Dict[str, List[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for m in _NAME_RE.finditer(text):
+                name = m.group(1)
+                if _SCAN_EXCLUDE.search(name):
+                    continue
+                files = found.setdefault(name, [])
+                if rel not in files:
+                    files.append(rel)
+    return found
+
+
+def render_doc() -> str:
+    """The full ``docs/metrics.md`` markdown text."""
+    live = live_instruments()
+    scanned = scan_source_names()
+    names = sorted(set(live) | set(scanned))
+    lines = [
+        "# Metric namespace contract (`pt_*`)",
+        "",
+        "GENERATED by `tools/paddle_cli.py metrics-doc` — do not edit by "
+        "hand; regenerate after adding or renaming an instrument (the "
+        "drift test in tests/test_obs_goodput.py fails on a `pt_*` name "
+        "missing from this file).",
+        "",
+        "Conventions (docs/design.md §15): `pt_<plane>_<what>_<unit>`; "
+        "counters end in `_total`, durations are seconds, gauges are "
+        "instantaneous (some are scrape-time callbacks).",
+        "",
+        "| metric | type | labels | description |",
+        "|---|---|---|---|",
+    ]
+    for name in names:
+        info = live.get(name)
+        if info:
+            labels = ", ".join(info["labels"]) or "-"
+            help_ = str(info["help"]).replace("|", "\\|")
+            typ = info["type"]
+        else:
+            labels = "-"
+            typ = "(runtime)"
+            files = ", ".join(sorted(scanned.get(name, []))[:3])
+            help_ = f"registered lazily at runtime; see {files}"
+        lines.append(f"| `{name}` | {typ} | {labels} | {help_} |")
+    lines.append("")
+    lines.append(f"{len(names)} instruments "
+                 f"({len(live)} described from live registries, "
+                 f"{len(set(scanned) - set(live))} source-scanned).")
+    lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,365 @@
+"""Persisted profiles + the differential attributor (docs §23).
+
+A *profile* is one schema-versioned JSON artifact freezing a goodput
+accounting window: the taxonomy breakdown of one bench workload or one
+serving run. The point of persisting it is the DIFF — two rounds of the
+same workload, subtracted per category, name the owner of a regression
+("step +8%; 91% of the delta in fetch_sync") instead of leaving a human
+to grep spans. The differential attributor:
+
+* normalizes per unit (steps / requests) when both profiles carry units,
+  so a longer run is not read as a slower one;
+* exploits the closure invariant — category deltas sum to the wall delta,
+  so shares are exact attribution, not correlation;
+* emits a ``perf_regression`` event and (rate-limited) trips the PR-9
+  flight recorder when the wall regresses beyond tolerance, and registers
+  a ``goodput`` provider so postmortem bundles carry the latest profile
+  pair + diff for ``paddle_cli doctor`` to rank.
+
+Durability matches the TuningDB discipline (tune/db.py): atomic
+tmp+replace publish, and a corrupt / field-less / future-schema file is a
+typed ``ProfileError`` (an ``IOError``) — attributing a regression off
+garbage is the one thing this must never do.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .goodput import GOOD_CATEGORIES, GoodputAccountant
+
+#: bump when the profile layout changes; loaders refuse the future
+SCHEMA_VERSION = 1
+
+#: fields every profile must carry to be trusted (corrupt-file refusal)
+_REQUIRED_FIELDS = ("schema", "kind", "workload", "wall_s", "categories")
+
+_KINDS = ("train", "serving")
+
+#: default wall-regression tolerance for the attributor (flag-overridable)
+DEFAULT_TOLERANCE = 0.03
+
+
+class ProfileError(IOError):
+    """Typed refusal: unreadable, corrupt, or alien-schema profile (the
+    checkpoint-manifest / TuningDB IOError discipline)."""
+
+
+def build_profile(kind: str, workload: str, categories: Dict[str, float],
+                  wall_s: float, units: Optional[int] = None,
+                  goodput_ratio: Optional[float] = None,
+                  meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble one schema-v1 profile dict. ``categories`` must be the
+    exhaustive taxonomy breakdown (incl. ``idle``); closure is derived."""
+    if kind not in _KINDS:
+        raise ValueError(f"profile kind must be one of {_KINDS}, got {kind!r}")
+    cats = {c: float(s) for c, s in categories.items() if s > 0}
+    attributed = sum(s for c, s in cats.items() if c != "idle")
+    wall = float(wall_s)
+    good = sum(s for c, s in cats.items() if c in GOOD_CATEGORIES)
+    total = sum(cats.values())
+    return {
+        "schema": SCHEMA_VERSION,
+        "kind": kind,
+        "workload": str(workload),
+        "created_unix": time.time(),
+        "wall_s": wall,
+        "units": int(units) if units else None,
+        "categories": cats,
+        "attributed_s": attributed,
+        "closure": attributed / wall if wall > 0 else 1.0,
+        "goodput_ratio": (goodput_ratio if goodput_ratio is not None
+                          else (good / total if total > 0 else 1.0)),
+        "meta": dict(meta or {}),
+    }
+
+
+def profile_from_window(window: Dict[str, Any], workload: str,
+                        units: Optional[int] = None,
+                        meta: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """Freeze one closed accountant window (``end_window()`` /
+    ``window().result``) into a profile. The plane with the accounted
+    time decides the kind: a workload that completed serving requests is
+    a serving profile (units = requests), otherwise a train profile over
+    the window wall."""
+    serving = window.get("serving") or {}
+    train = window.get("train") or {}
+    if serving.get("requests") and serving.get("wall_s", 0.0) >= \
+            train.get("attributed_s", 0.0):
+        return build_profile(
+            "serving", workload, serving.get("categories") or {},
+            serving.get("wall_s", 0.0),
+            units=units if units is not None else serving.get("requests"),
+            goodput_ratio=window.get("goodput_ratio"), meta=meta)
+    return build_profile(
+        "train", workload, train.get("categories") or {},
+        window.get("wall_s", 0.0), units=units,
+        goodput_ratio=window.get("goodput_ratio"), meta=meta)
+
+
+def capture_profile(acct: GoodputAccountant, kind: str, workload: str,
+                    units: Optional[int] = None,
+                    meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Profile the accountant's CUMULATIVE state for one plane (a serving
+    run's lifetime breakdown; bench windows use ``profile_from_window``)."""
+    s = acct.summary()
+    if kind == "serving":
+        sv = s["serving"]
+        return build_profile("serving", workload, sv["categories"],
+                             sv["wall_s"], units=units or sv["requests"],
+                             goodput_ratio=s["goodput_ratio"], meta=meta)
+    cats = s["train"]["categories"]
+    return build_profile("train", workload, cats, sum(cats.values()),
+                         units=units, goodput_ratio=s["goodput_ratio"],
+                         meta=meta)
+
+
+# -- persistence (TuningDB discipline) --------------------------------------
+
+def validate_profile(p: Any) -> List[str]:
+    problems: List[str] = []
+    if not isinstance(p, dict):
+        return ["profile is not a JSON object"]
+    for k in _REQUIRED_FIELDS:
+        if k not in p:
+            problems.append(f"missing field {k!r}")
+    schema = p.get("schema")
+    if isinstance(schema, int) and schema > SCHEMA_VERSION:
+        problems.append(f"schema {schema} is from the future "
+                        f"(this build reads <= {SCHEMA_VERSION})")
+    elif "schema" in p and not isinstance(schema, int):
+        problems.append(f"schema must be an int, got {type(schema).__name__}")
+    if "kind" in p and p.get("kind") not in _KINDS:
+        problems.append(f"kind must be one of {_KINDS}, got {p.get('kind')!r}")
+    if "categories" in p and not isinstance(p.get("categories"), dict):
+        problems.append("categories must be a mapping")
+    return problems
+
+
+def save_profile(profile: Dict[str, Any], path: str) -> str:
+    """Atomic publish: tmp in the target dir + ``os.replace`` — a reader
+    (or a crashed writer) can never observe a torn profile."""
+    problems = validate_profile(profile)
+    if problems:
+        raise ProfileError(f"refusing to save an invalid profile: "
+                           f"{'; '.join(problems)}")
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".profile_", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(profile, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load_profile(path: str) -> Dict[str, Any]:
+    """Load + validate; typed ``ProfileError`` on unreadable / corrupt /
+    future-schema files (never attribute off garbage)."""
+    try:
+        with open(path) as f:
+            p = json.load(f)
+    except OSError as e:
+        raise ProfileError(f"cannot read profile {path!r}: {e}") from e
+    except ValueError as e:
+        raise ProfileError(f"corrupt profile {path!r}: {e}") from e
+    problems = validate_profile(p)
+    if problems:
+        raise ProfileError(f"invalid profile {path!r}: "
+                           f"{'; '.join(problems)}")
+    return p
+
+
+# -- the differential attributor --------------------------------------------
+
+def _per_unit(p: Dict[str, Any]) -> float:
+    u = p.get("units")
+    return 1.0 / u if u else 1.0
+
+
+def diff_profiles(base: Dict[str, Any], cur: Dict[str, Any],
+                  tolerance: Optional[float] = None) -> Dict[str, Any]:
+    """Attribute ``cur`` minus ``base``: per-category wall deltas
+    (normalized per unit when both profiles carry units) and the owners
+    of the change, shares exact because category deltas sum to the wall
+    delta (closure). ``regressed`` is a wall ratio beyond ``tolerance``
+    (default ``flags.obs_profile_diff_tolerance``)."""
+    for name, p in (("base", base), ("cur", cur)):
+        problems = validate_profile(p)
+        if problems:
+            raise ProfileError(f"diff {name} profile invalid: "
+                               f"{'; '.join(problems)}")
+    if tolerance is None:
+        try:
+            from ..flags import get_flag
+
+            tolerance = float(get_flag("obs_profile_diff_tolerance"))
+        except Exception:
+            tolerance = DEFAULT_TOLERANCE
+    norm_a, norm_b = _per_unit(base), _per_unit(cur)
+    normalized = bool(base.get("units")) and bool(cur.get("units"))
+    if not normalized:
+        norm_a = norm_b = 1.0
+    wall_a = base["wall_s"] * norm_a
+    wall_b = cur["wall_s"] * norm_b
+    wall_delta = wall_b - wall_a
+    wall_ratio = wall_b / wall_a if wall_a > 0 else float("inf")
+    cats = sorted(set(base["categories"]) | set(cur["categories"]))
+    owners = []
+    for c in cats:
+        a = base["categories"].get(c, 0.0) * norm_a
+        b = cur["categories"].get(c, 0.0) * norm_b
+        d = b - a
+        owners.append({
+            "category": c, "base_s": a, "cur_s": b, "delta_s": d,
+            # share of the wall delta this category owns (signed; only
+            # meaningful when the wall actually moved)
+            "share": d / wall_delta if abs(wall_delta) > 1e-12 else 0.0,
+        })
+    owners.sort(key=lambda o: -abs(o["delta_s"]))
+    regressed = wall_a > 0 and wall_ratio > 1.0 + tolerance
+    unit = "unit" if normalized else "run"
+    if owners and abs(wall_delta) > 1e-12:
+        top = owners[0]
+        summary = (f"{cur.get('workload')}: wall/{unit} "
+                   f"{wall_ratio - 1.0:+.1%}; {abs(top['share']):.0%} of "
+                   f"the delta in {top['category']} "
+                   f"({top['delta_s'] * 1e3:+.3f} ms/{unit})")
+    else:
+        summary = f"{cur.get('workload')}: wall/{unit} unchanged"
+    return {
+        "workload": cur.get("workload"),
+        "kind": cur.get("kind"),
+        "normalized_per_unit": normalized,
+        "wall_base_s": wall_a,
+        "wall_cur_s": wall_b,
+        "wall_delta_s": wall_delta,
+        "wall_ratio": wall_ratio,
+        "tolerance": tolerance,
+        "regressed": regressed,
+        "owners": owners,
+        "summary": summary,
+    }
+
+
+def format_diff(diff: Dict[str, Any], top: int = 8) -> str:
+    """Human-readable attribution table for the CLI / bench stderr."""
+    unit = "unit" if diff.get("normalized_per_unit") else "run"
+    lines = [diff["summary"]]
+    lines.append(f"  wall/{unit}: {diff['wall_base_s'] * 1e3:.3f} -> "
+                 f"{diff['wall_cur_s'] * 1e3:.3f} ms "
+                 f"({diff['wall_ratio']:.4f}x, tolerance "
+                 f"{diff['tolerance']:.0%})"
+                 + ("  REGRESSED" if diff["regressed"] else ""))
+    lines.append(f"  {'category':<16} {'base ms':>10} {'cur ms':>10} "
+                 f"{'delta ms':>10} {'share':>7}")
+    for o in diff["owners"][:top]:
+        if abs(o["delta_s"]) < 1e-12 and o["base_s"] == 0 and o["cur_s"] == 0:
+            continue
+        lines.append(f"  {o['category']:<16} {o['base_s'] * 1e3:>10.3f} "
+                     f"{o['cur_s'] * 1e3:>10.3f} "
+                     f"{o['delta_s'] * 1e3:>+10.3f} "
+                     f"{o['share']:>6.0%}")
+    return "\n".join(lines)
+
+
+# -- regression alerting + flight-recorder join -----------------------------
+
+_last_lock = threading.Lock()
+_last_profiles: List[Dict[str, Any]] = []  # bounded pair ring per provider
+_last_diff: Optional[Dict[str, Any]] = None
+_provider_registered = False
+
+
+def _goodput_provider() -> Dict[str, Any]:
+    with _last_lock:
+        return {"profiles": list(_last_profiles), "diff": _last_diff}
+
+
+def _register_provider() -> None:
+    global _provider_registered
+    with _last_lock:
+        if _provider_registered:
+            return
+        _provider_registered = True
+    from .flight import get_recorder
+
+    get_recorder().register_provider("goodput", _goodput_provider)
+
+
+def record_profile(profile: Dict[str, Any]) -> None:
+    """Remember a captured profile (last two per process) and register
+    the ``goodput`` flight provider, so postmortem bundles carry the
+    profile pair for doctor's attribution join."""
+    with _last_lock:
+        _last_profiles.append(profile)
+        del _last_profiles[:-2]
+    _register_provider()
+
+
+def attribute_regression(base: Dict[str, Any], cur: Dict[str, Any],
+                         tolerance: Optional[float] = None,
+                         trip_recorder: bool = True) -> Dict[str, Any]:
+    """Diff two profiles and ALERT: on a wall regression beyond
+    tolerance, emit a ``perf_regression`` event naming the owning
+    category and (rate-limited) dump a flight-recorder bundle. The diff
+    is also remembered for the ``goodput`` bundle provider. Returns the
+    diff either way."""
+    global _last_diff
+    diff = diff_profiles(base, cur, tolerance=tolerance)
+    with _last_lock:
+        _last_diff = diff
+    _register_provider()
+    if diff["regressed"]:
+        from .events import get_event_log
+
+        top = diff["owners"][0] if diff["owners"] else {}
+        ev = get_event_log()
+        if ev.enabled:
+            ev.emit("perf_regression", severity="warn",
+                    workload=diff.get("workload"),
+                    wall_ratio=round(diff["wall_ratio"], 4),
+                    owner=top.get("category"),
+                    owner_share=round(top.get("share", 0.0), 4),
+                    summary=diff["summary"])
+        if trip_recorder:
+            from .flight import get_recorder
+
+            get_recorder().maybe_dump({
+                "type": "perf_regression",
+                "workload": diff.get("workload"),
+                "wall_ratio": round(diff["wall_ratio"], 4),
+                "owner": top.get("category")})
+    return diff
+
+
+def goodput_report(profile: Dict[str, Any]) -> str:
+    """Render one profile as the breakdown table ``paddle_cli goodput``
+    prints."""
+    wall = profile.get("wall_s", 0.0)
+    units = profile.get("units")
+    lines = [f"{profile.get('kind')} profile '{profile.get('workload')}' "
+             f"(schema v{profile.get('schema')}): wall {wall:.3f}s"
+             + (f", {units} units ({wall / units * 1e3:.3f} ms/unit)"
+                if units else ""),
+             f"goodput ratio {profile.get('goodput_ratio', 0.0):.3f}, "
+             f"closure {profile.get('closure', 0.0):.3f} "
+             f"(attributed {profile.get('attributed_s', 0.0):.3f}s)"]
+    lines.append(f"  {'category':<16} {'seconds':>10} {'share':>7}  class")
+    cats = profile.get("categories") or {}
+    total = sum(cats.values()) or 1.0
+    for c, s in sorted(cats.items(), key=lambda kv: -kv[1]):
+        klass = "goodput" if c in GOOD_CATEGORIES else "badput"
+        lines.append(f"  {c:<16} {s:>10.4f} {s / total:>6.1%}  {klass}")
+    return "\n".join(lines)

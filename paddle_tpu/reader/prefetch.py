@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 
@@ -78,17 +79,30 @@ class DevicePrefetcher:
         end = object()
 
         from ..obs import get_tracer
+        from ..obs.goodput import get_accountant
 
         def fill():
             tr = get_tracer()
+            acct = get_accountant()
             try:
                 for batch in self.reader():
+                    t_acct = time.monotonic() if acct.enabled else 0.0
                     with tr.span("prefetch/transform", cat="train"):
                         feed = (self.transform(batch) if self.transform
                                 else batch)
+                    if acct.enabled:
+                        # background-thread host input: the accountant's
+                        # sweep only bills it when NOT hidden behind the
+                        # device (device_compute wins overlaps, docs §23)
+                        acct.account("host_input", t_acct,
+                                     time.monotonic() - t_acct)
                     # the H2D transfer the pipeline hides behind compute
+                    t_acct = time.monotonic() if acct.enabled else 0.0
                     with tr.span("prefetch/place", cat="train"):
                         placed = self._place(feed)
+                    if acct.enabled:
+                        acct.account("h2d", t_acct,
+                                     time.monotonic() - t_acct)
                     while not stop.is_set():
                         try:
                             q.put(placed, timeout=0.1)

@@ -45,9 +45,10 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..obs.events import get_event_log
+from ..obs.goodput import get_accountant
 from .engine import ServingEngine
 from .errors import DeadlineExceeded, QueueFullError, ShuttingDown  # noqa: F401 (QueueFullError re-exported: PR-1 import site)
-from .stats import ServingStats
+from .stats import PREDICT_STAGES, ServingStats
 
 
 class _Request:
@@ -96,6 +97,11 @@ class MicroBatcher:
         self.queue_capacity = int(queue_capacity)
         self.stats = stats
         self.chaos = None  # optional ChaosInjector (queue-stall hook)
+        # goodput accounting (docs §23): per-request stage seconds flow
+        # into the accountant at completion. Defaults to the process
+        # accountant (zero-cost while disabled — one attribute read); a
+        # ServingServer rebinds this to its registry-scoped accountant.
+        self.accountant = get_accountant()
         # depth-2 dispatch pipeline (docs/design.md §13): the worker splits
         # each batch into host-prepare + async device dispatch, then hands
         # the in-flight handle to a completion thread for the host sync and
@@ -290,6 +296,10 @@ class MicroBatcher:
                                                     "coalesce")):
             if self.stats:
                 self.stats.record_deadline()
+            if self.accountant.enabled:
+                # the whole wall this request spent before the shed
+                # decision is the `shed` category (docs §23)
+                self.accountant.account_shed(now - req.t_submit)
             ev = get_event_log()
             if ev.enabled:
                 ev.emit("deadline_shed", severity="warn",
@@ -490,6 +500,12 @@ class MicroBatcher:
                 self.stats.record_stage("scatter", scatter_s)
             if self._complete(r, result=res) and self.stats:
                 self.stats.record_done(r.timings["total"])
+        if self.accountant.enabled:
+            # classify each completed request's stage seconds into the
+            # serving taxonomy (the t_submit anchor lets the accountant
+            # keep timeline-drawable intervals too)
+            for r in batch:
+                self.accountant.account_request(r.timings, t0=r.t_submit)
         self._trace_batch(batch, inflight, t_f, sync_s, scatter_s, now)
 
     def _trace_batch(self, batch, inflight, t_f, sync_s, scatter_s,
@@ -516,8 +532,7 @@ class MicroBatcher:
             # (they were measured on three different threads; the request
             # row in the trace shows them as one contiguous lane)
             t = r.t_submit
-            for stage in ("pad", "queue_wait", "coalesce", "dispatch",
-                          "pipeline_wait", "device_sync", "scatter"):
+            for stage in PREDICT_STAGES:  # the one stage list (stats.py)
                 dur = r.timings.get(stage)
                 if dur is None:
                     continue

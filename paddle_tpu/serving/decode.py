@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs.events import get_event_log
+from ..obs.goodput import get_accountant
 from .engine import _flat_items, pow2_ladder, round_up  # noqa: F401
 from .errors import DeadlineExceeded, QueueFullError, ServingUnavailable, \
     ShuttingDown
@@ -583,6 +584,10 @@ class GenerationBatcher:
         self.pipeline_depth = min(2, max(1, int(pipeline_depth)))
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.chaos = None  # batcher-level hook (queue stall), like MicroBatcher
+        # goodput accounting (docs §23): generation request-seconds flow
+        # into the accountant at retirement (queue_wait/prefill/
+        # decode_step); the server rebinds to its registry-scoped one
+        self.accountant = get_accountant()
         self._queue: "queue.Queue[_Generation]" = \
             queue.Queue(self.queue_capacity)
         self._deferred: deque = deque()  # popped but not yet admitted (FIFO)
@@ -734,12 +739,20 @@ class GenerationBatcher:
         now = time.monotonic()
         total = now - gen.t_submit
         gen.timings["total"] = total
+        if gen.t_first_token is not None:
+            # the generation's decode phase: first token -> retirement
+            # (per-boundary batch costs stay in the decode_step stage
+            # histogram; this is THIS request's share of wall, so the
+            # accountant's categories sum to its wall — docs §23)
+            gen.timings["decode_step"] = max(0.0, now - gen.t_first_token)
         ttft = (gen.t_first_token - gen.t_submit
                 if gen.t_first_token else total)
         if self._resolve(gen, result=GenerationResult(
                 list(gen.tokens), ttft, gen.version, reason)):
             if self.stats:
                 self.stats.record_done(total)
+        if self.accountant.enabled:
+            self.accountant.account_request(gen.timings, t0=gen.t_submit)
         self._trace_generation(gen, now, reason)
 
     def _trace_generation(self, gen: _Generation, now: float,
@@ -776,6 +789,9 @@ class GenerationBatcher:
         """Prefill one queued generation into a free slot. Returns False
         (resolving the future with the typed error) on prefill failure."""
         t0 = time.monotonic()
+        # submit -> admission start is the generation's queue_wait (the
+        # accountant's serving taxonomy; deferred prompts wait longer)
+        gen.timings["queue_wait"] = t0 - gen.t_submit
         slot = self.engine.alloc_slot()
         try:
             if getattr(self.engine, "supports_page_reservation", False):
@@ -897,6 +913,8 @@ class GenerationBatcher:
                                                      "mid-generation")):
                 if self.stats:
                     self.stats.record_deadline()
+                if self.accountant.enabled:
+                    self.accountant.account_shed(now - g.t_submit)
                 ev = get_event_log()
                 if ev.enabled:
                     ev.emit("deadline_shed", severity="warn",
@@ -988,6 +1006,8 @@ class GenerationBatcher:
                                                          "queue")):
                     if self.stats:
                         self.stats.record_deadline()
+                    if self.accountant.enabled:
+                        self.accountant.account_shed(now - g.t_submit)
                 continue
             out.append(g)
         return out

@@ -133,6 +133,11 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
         "prefix_hit_tokens": g.get("pt_serving_prefix_hit_tokens_total",
                                    0.0),
         "prefix_hit_rate": g.get("pt_serving_prefix_hit_rate", 0.0),
+        # goodput accounting (docs §23): windowed good/(good+bad)
+        # request-seconds on the replica. 1.0 when the replica does not
+        # account (or saw nothing in the window) — absence of accounting
+        # must read as neutral, not as a fully-badput replica.
+        "goodput_ratio": g.get("pt_goodput_ratio", 1.0),
     }
 
 

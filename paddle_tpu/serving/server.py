@@ -502,6 +502,21 @@ class ServingServer(socketserver.ThreadingTCPServer):
 
             init_from_flags()
             events_from_flags()  # PT_FLAG_OBS_EVENTS turns the black box on
+            # goodput accounting (docs §23): flag-armed, bound to THIS
+            # server's stats registry so GET /metrics carries
+            # pt_goodput_ratio / pt_badput_seconds_total{category} per
+            # replica (scraped_gauges rolls them up fleet-wide); the
+            # batchers' default process accountant is rebound here
+            from ..flags import get_flag as _get_flag
+            from ..obs.goodput import GoodputAccountant
+
+            self.accountant = None
+            if _get_flag("obs_goodput"):
+                self.accountant = GoodputAccountant(
+                    registry=self.stats.registry).enable()
+                self.batcher.accountant = self.accountant
+                if self.gen_batcher is not None:
+                    self.gen_batcher.accountant = self.accountant
             if log_json:
                 # structured-logging bridge: every event (health
                 # transitions, sheds, reload commits, faults) becomes one
@@ -682,6 +697,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
         }
         if self.decode_engine is not None:
             info["decode_weights_version"] = self.decode_engine.params_version
+        if self.accountant is not None:
+            info["goodput"] = self.accountant.summary()
         return info
 
     @property
@@ -805,6 +822,10 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 extra["decode_prefix"] = self.decode_engine.prefix_info()
         if self.chaos is not None:
             extra["chaos"] = self.chaos.snapshot()
+        if self.accountant is not None:
+            # the goodput breakdown (docs §23): cumulative per-category
+            # request-seconds + the live ratio — serve_bench prints this
+            extra["goodput"] = self.accountant.summary()
         return self.stats.snapshot(extra=extra)
 
     # -- hot weight reload --
@@ -1018,6 +1039,15 @@ class ServingClient:
                 if deadline is not None:
                     sleep = min(sleep, max(0.0, deadline - time.monotonic()))
                 time.sleep(sleep)
+                # init_from_flags, not get_accountant: a client process
+                # has no server/trainer to honor obs_goodput for it
+                from ..obs.goodput import init_from_flags as _goodput_flags
+
+                acct = _goodput_flags()
+                if acct.enabled:
+                    # caller-side badput: seconds this request spent
+                    # sleeping between attempts (docs §23 retry_backoff)
+                    acct.account_retry_backoff(sleep)
                 delay = min(delay * 2, self.backoff_max_s)
 
     def remaining_deadline_ms(self) -> Optional[float]:

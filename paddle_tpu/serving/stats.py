@@ -56,10 +56,24 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
-#: request pipeline stages, in hot-path order (docs/design.md §15); the
-#: trailing pair belongs to the decode serving path (docs/design.md §16)
-STAGES = ("pad", "queue_wait", "coalesce", "dispatch", "pipeline_wait",
-          "device_sync", "scatter", "prefill", "decode_step")
+#: predict-request pipeline stages, in hot-path order (docs/design.md
+#: §15). THE single source of truth for stage names: the batcher's stage
+#: spans, the stage histograms, the goodput accountant's serving taxonomy
+#: (obs/goodput.py) and the tests all consume these constants — a stage
+#: added here is automatically accounted, traced, and documented.
+PREDICT_STAGES = ("pad", "queue_wait", "coalesce", "dispatch",
+                  "pipeline_wait", "device_sync", "scatter")
+
+#: decode-serving stages (docs/design.md §16)
+DECODE_STAGES = ("prefill", "decode_step")
+
+#: every stage, in hot-path order
+STAGES = PREDICT_STAGES + DECODE_STAGES
+
+#: non-stage request-time categories the goodput accountant adds on top
+#: of STAGES (docs/design.md §23): client backoff sleeps and the wall a
+#: shed request spent in the system before the shed decision
+EXTRA_REQUEST_CATEGORIES = ("retry_backoff", "shed")
 
 
 class ServingStats:

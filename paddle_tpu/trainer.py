@@ -165,13 +165,22 @@ class Trainer:
                     yield feeder.feed(batch) if feeder else batch
 
         from .obs import get_tracer, init_from_flags
+        from .obs.goodput import init_from_flags as goodput_from_flags
         tracer = init_from_flags()  # PT_FLAG_OBS_TRACE turns spans on here
+        acct = goodput_from_flags()  # PT_FLAG_OBS_GOODPUT -> accounting
 
         step_count = 0
         for epoch in range(num_epochs):
             event_handler(BeginEpochEvent(epoch))
+            if acct.enabled:
+                # one goodput accounting window per epoch:
+                # acct.last_window carries the taxonomy breakdown after
+                # each epoch (docs §23)
+                acct.begin_window(f"epoch{epoch}")
             for step, feed in enumerate(feed_stream()):
                 if self.stop_requested:
+                    if acct.enabled:
+                        acct.end_window()
                     return
                 begin = BeginStepEvent(epoch, step)
                 begin.fetch_metrics = (step % log_every == 0)
@@ -198,6 +207,8 @@ class Trainer:
                 if (self.checkpoint_cfg
                         and step_count % self.checkpoint_cfg.step_interval == 0):
                     self._save_checkpoint()
+            if acct.enabled:
+                acct.end_window()
             event_handler(EndEpochEvent(epoch))
             if (self.checkpoint_cfg
                     and (epoch + 1) % self.checkpoint_cfg.epoch_interval == 0):

@@ -1,0 +1,538 @@
+"""Goodput accountant + differential profiler (ISSUE 14, docs §23).
+
+Contract highlights:
+* accountant disabled = ZERO allocation on the hot path (shared no-op
+  window singleton, early-return account*());
+* the closure invariant — taxonomy categories incl. idle sum to the
+  measured wall — holds exactly on the train sweep (by construction) and
+  within 5% per serving request, under pipeline depth 1 AND 2, tracer on
+  AND off;
+* profiles persist atomically and refuse corrupt / future-schema files
+  with a typed ``ProfileError`` (the TuningDB discipline);
+* the differential attributor names the injected regressing category as
+  the top contributor and its alert lands in events / bundles / doctor;
+* the serving stage-name list has exactly ONE owner (serving/stats.py),
+  consumed by batcher, accountant, and these tests;
+* every ``pt_*`` instrument the source emits is documented in
+  docs/metrics.md (the metrics-doc drift gate).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.obs import profile as obsprofile
+from paddle_tpu.obs.goodput import (GOOD_CATEGORIES, TRAIN_CATEGORIES,
+                                    GoodputAccountant, _NOOP_WINDOW, _sweep,
+                                    get_accountant, serving_categories)
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.obs.profile import (ProfileError, attribute_regression,
+                                    build_profile, diff_profiles,
+                                    load_profile, save_profile)
+from paddle_tpu.serving.stats import (DECODE_STAGES,
+                                      EXTRA_REQUEST_CATEGORIES,
+                                      PREDICT_STAGES, STAGES, ServingStats)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_accountant():
+    """The batchers/executor feed the process accountant; keep its state
+    from leaking across tests."""
+    acct = get_accountant()
+    yield
+    acct.disable()
+    acct.reset()
+
+
+def _mk_acct():
+    return GoodputAccountant(registry=MetricsRegistry()).enable()
+
+
+# -- taxonomy + shared constants -------------------------------------------
+
+def test_stage_list_has_one_owner():
+    """ISSUE 14 satellite: serving/stats.py owns THE stage-name list;
+    the accountant's serving taxonomy is derived from it, not a copy."""
+    assert STAGES == PREDICT_STAGES + DECODE_STAGES
+    assert serving_categories() == \
+        STAGES + EXTRA_REQUEST_CATEGORIES + ("idle",)
+    # the train taxonomy is exhaustive: sweep categories + idle
+    assert set(TRAIN_CATEGORIES) - {"idle"} == \
+        {"device_compute", "host_input", "h2d", "compile", "fetch_sync"}
+    # goodput classification covers only known categories
+    assert GOOD_CATEGORIES <= set(TRAIN_CATEGORIES) | set(STAGES)
+
+
+def test_batcher_consumes_shared_stage_constant():
+    import paddle_tpu.serving.batcher as batcher_mod
+
+    assert batcher_mod.PREDICT_STAGES is PREDICT_STAGES
+
+
+# -- zero-cost disabled -----------------------------------------------------
+
+def test_disabled_accountant_is_allocation_free():
+    acct = GoodputAccountant()
+    assert not acct.enabled
+    assert acct.window() is acct.window() is _NOOP_WINDOW
+    with acct.window("x"):
+        pass
+    acct.account("device_compute", time.monotonic(), 1.0)
+    acct.account_request({"total": 1.0, "queue_wait": 1.0})
+    acct.account_shed(1.0)
+    acct.account_retry_backoff(1.0)
+    assert acct.intervals() == []
+    assert acct.summary()["serving"]["requests"] == 0
+
+
+# -- the sweep + train closure ---------------------------------------------
+
+def test_sweep_is_exhaustive_and_nonoverlapping():
+    t0 = 100.0
+    ivs = [
+        ("host_input", t0, 0.010),
+        ("h2d", t0 + 0.002, 0.004),          # nested: carves out of host
+        ("device_compute", t0 + 0.010, 0.020),
+        ("host_input", t0 + 0.015, 0.010),   # prefetch overlap: device wins
+        ("fetch_sync", t0 + 0.030, 0.005),
+    ]
+    cats, idle = _sweep(ivs, t0, t0 + 0.040)
+    total = sum(cats.values()) + idle
+    assert abs(total - 0.040) < 1e-9, "closure must hold exactly"
+    assert abs(cats["h2d"] - 0.004) < 1e-9
+    assert abs(cats["host_input"] - 0.006) < 1e-9, \
+        "nested h2d must not double count"
+    assert abs(cats["device_compute"] - 0.020) < 1e-9, \
+        "overlapped prefetch time belongs to the device"
+    assert abs(idle - 0.005) < 1e-9
+
+
+def test_window_closure_and_intervals_ring_bounded():
+    acct = GoodputAccountant(registry=MetricsRegistry(), max_intervals=32)
+    acct.enable()
+    acct.begin_window("w")
+    t0 = time.monotonic()
+    for i in range(100):
+        acct.account("device_compute", t0 + i * 1e-5, 1e-5)
+    w = acct.end_window()
+    assert acct.intervals_dropped > 0 and len(acct.intervals()) == 32
+    assert abs(sum(w["train"]["categories"].values()) - w["wall_s"]) < 1e-9
+
+
+@pytest.mark.parametrize("tracer_on", [False, True])
+def test_train_window_closure_through_real_executor(tracer_on):
+    """Accounting-closure property (ISSUE 14): run_steps windows through
+    the REAL executor — categories sum to wall exactly, coverage is high,
+    and the result is identical with the tracer on or off (accounting is
+    independent of the span plane)."""
+    from paddle_tpu import obs
+
+    if tracer_on:
+        obs.enable()
+    else:
+        obs.disable()
+    acct = get_accountant()
+    acct.enable()
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[8], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(x, size=1)
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(
+                    loss, startup)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(16, 8).astype("float32"),
+                "y": rng.rand(16, 1).astype("float32")}
+        acct.begin_window("train")
+        for _ in range(3):
+            exe.run_steps(main, feed=feed, k=4, fetch_list=[loss],
+                          scope=scope)
+        w = acct.end_window()
+        cats = w["train"]["categories"]
+        assert abs(sum(cats.values()) - w["wall_s"]) <= \
+            0.05 * w["wall_s"] + 1e-9
+        assert cats.get("device_compute", 0) > 0
+        assert cats.get("compile", 0) > 0, \
+            "the first window's compile must be attributed"
+        assert w["train"]["closure"] >= 0.9, cats
+    finally:
+        obs.disable()
+
+
+def test_run_steps_h2d_interval_and_span():
+    """The non-invariant run_steps path stacks per-step host feeds into
+    ONE device_put per name — that transfer is the h2d category and (new
+    in ISSUE 14) a train/h2d span."""
+    from paddle_tpu import obs
+
+    tracer = obs.enable()
+    tracer.clear()
+    acct = get_accountant()
+    acct.enable()
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[4], dtype="float32")
+                pred = fluid.layers.fc(x, size=2)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            exe.run(startup, scope=scope)
+        rng = np.random.RandomState(1)
+        feeds = [{"x": rng.rand(4, 4).astype("float32")} for _ in range(3)]
+        acct.begin_window("h2d")
+        exe.run_steps(main, feed=feeds, fetch_list=[pred], scope=scope)
+        w = acct.end_window()
+        assert w["train"]["categories"].get("h2d", 0) > 0
+        assert any(s.name == "train/h2d" for s in tracer.spans())
+    finally:
+        obs.disable()
+
+
+# -- serving request accounting --------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    np.random.seed(3)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        d = str(tmp_path_factory.mktemp("goodput") / "model")
+        io.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+    return d
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_request_closure_under_pipeline_depths(model_dir, depth):
+    """Accounting-closure property, serving plane: per-request stage
+    seconds + idle sum to the request wall within 5%, pipeline depth 1
+    and 2 (the stage timestamps are contiguous by construction)."""
+    from paddle_tpu.serving import MicroBatcher, ServingEngine
+
+    eng = ServingEngine(model_dir, max_batch_size=8)
+    stats = ServingStats()
+    acct = _mk_acct()
+    b = MicroBatcher(eng, stats=stats, batch_timeout_ms=20.0,
+                     pipeline_depth=depth)
+    b.accountant = acct
+    try:
+        rng = np.random.RandomState(0)
+        futs = [b.submit({"x": rng.rand(1, 4).astype("float32")})
+                for _ in range(6)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        b.close()
+    s = acct.summary()["serving"]
+    assert s["requests"] == 6
+    assert s["closure_violations"] == 0, \
+        "every request must close within the 5% tolerance"
+    assert 0.9 <= s["closure"] <= 1.05
+    cats = s["categories"]
+    # closure by construction: categories (incl idle) sum to the wall
+    assert abs(sum(cats.values()) - s["wall_s"]) <= 0.05 * s["wall_s"]
+    assert cats.get("queue_wait", 0) > 0 or cats.get("coalesce", 0) > 0
+    # only taxonomy names land in the account
+    assert set(cats) <= set(serving_categories())
+
+
+V, T, D, H, L, FF = 97, 32, 32, 4, 2, 64
+
+
+def _export_lm(dirname, seed):
+    from paddle_tpu.models.transformer import transformer_lm
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+            logits, _loss = transformer_lm(
+                ids, labels, vocab_size=V, max_len=T, d_model=D,
+                n_heads=H, n_layers=L, d_ff=FF)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope, seed=seed)
+        io.save_inference_model(dirname, ["ids"], [logits], exe, main,
+                                scope=scope)
+    return dirname
+
+
+def test_generation_accounting_closure(tmp_path):
+    """Decode plane: a generation's queue_wait + prefill + decode_step
+    (+ idle) sum to its wall; the accountant sees every retirement."""
+    from paddle_tpu.serving import DecodeEngine, GenerationBatcher
+
+    d = _export_lm(str(tmp_path / "lm"), seed=9)
+    eng = DecodeEngine(d, max_slots=2)
+    acct = _mk_acct()
+    gb = GenerationBatcher(eng, stats=ServingStats(), queue_capacity=8)
+    gb.accountant = acct
+    try:
+        rng = np.random.RandomState(2)
+        futs = [gb.submit(rng.randint(0, V, size=(4,)), max_new_tokens=5)
+                for _ in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        gb.close()
+    s = acct.summary()["serving"]
+    assert s["requests"] == 4
+    cats = s["categories"]
+    assert cats.get("prefill", 0) > 0 and cats.get("decode_step", 0) > 0
+    assert s["closure_violations"] == 0
+    assert abs(sum(cats.values()) - s["wall_s"]) <= 0.05 * s["wall_s"]
+
+
+def test_shed_backoff_badput_and_ratio_gauge():
+    acct = _mk_acct()
+    acct.account_request({"total": 0.1, "dispatch": 0.06,
+                          "device_sync": 0.04})
+    acct.account_shed(0.2)
+    acct.account_retry_backoff(0.05)
+    cats = acct.summary()["serving"]["categories"]
+    assert cats["shed"] == pytest.approx(0.2)
+    assert cats["retry_backoff"] == pytest.approx(0.05)
+    # good = 0.1, bad = 0.25 -> ratio well below 1
+    r = acct.goodput_ratio()
+    assert 0.0 < r < 1.0
+    text = acct.registry.expose()
+    assert "pt_goodput_ratio" in text
+    assert 'pt_badput_seconds_total{category="shed"}' in text
+    assert 'pt_badput_seconds_total{category="retry_backoff"}' in text
+
+
+def test_scraped_gauges_carry_goodput_ratio():
+    from paddle_tpu.serving.fleet import scraped_gauges
+
+    acct = _mk_acct()
+    acct.account_request({"total": 0.1, "dispatch": 0.1})
+    g = scraped_gauges({}, acct.registry.expose())
+    assert g["goodput_ratio"] == pytest.approx(1.0)
+    # a replica that does not account reads NEUTRAL, not fully-badput
+    assert scraped_gauges({}, "")["goodput_ratio"] == 1.0
+
+
+# -- profiles ---------------------------------------------------------------
+
+def _train_profile(fetch=1.0, device=8.0, units=100, wall=None):
+    cats = {"device_compute": device, "fetch_sync": fetch,
+            "host_input": 0.5, "idle": 0.5}
+    return build_profile("train", "tlm", cats,
+                         wall if wall is not None else sum(cats.values()),
+                         units=units)
+
+
+def test_profile_roundtrip_atomic(tmp_path):
+    p = _train_profile()
+    path = str(tmp_path / "p.json")
+    save_profile(p, path)
+    assert load_profile(path) == p
+    # atomic publish: no temp leftovers
+    assert [f for f in os.listdir(tmp_path)] == ["p.json"]
+
+
+def test_profile_typed_refusals(tmp_path):
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    with pytest.raises(ProfileError):
+        load_profile(str(corrupt))
+    future = tmp_path / "future.json"
+    p = _train_profile()
+    p["schema"] = obsprofile.SCHEMA_VERSION + 1
+    future.write_text(json.dumps(p))
+    with pytest.raises(ProfileError, match="future"):
+        load_profile(str(future))
+    fieldless = tmp_path / "fieldless.json"
+    fieldless.write_text(json.dumps({"schema": 1, "kind": "train"}))
+    with pytest.raises(ProfileError):
+        load_profile(str(fieldless))
+    with pytest.raises(ProfileError):
+        save_profile({"schema": 1}, str(tmp_path / "bad.json"))
+    # missing file is typed too
+    with pytest.raises(ProfileError):
+        load_profile(str(tmp_path / "nope.json"))
+
+
+def test_diff_names_injected_regressing_category():
+    base = _train_profile(fetch=1.0, device=8.0)
+    # inject: fetch_sync +0.728s/unit of a +0.8s/unit wall delta (91%)
+    cur = _train_profile(fetch=1.728, device=8.072)
+    d = diff_profiles(base, cur, tolerance=0.03)
+    assert d["regressed"] is True
+    assert d["owners"][0]["category"] == "fetch_sync"
+    assert d["owners"][0]["share"] == pytest.approx(0.91, abs=0.01)
+    assert "fetch_sync" in d["summary"]
+    # the category deltas sum to the wall delta (closure => exact shares)
+    assert sum(o["delta_s"] for o in d["owners"]) == \
+        pytest.approx(d["wall_delta_s"])
+    # improvement: not a regression
+    assert not diff_profiles(cur, base)["regressed"]
+    # sub-tolerance drift: not a regression
+    tiny = _train_profile(fetch=1.01, device=8.0)
+    assert not diff_profiles(base, tiny, tolerance=0.03)["regressed"]
+
+
+def test_diff_normalizes_per_unit():
+    a = _train_profile(units=100)
+    b = _train_profile(units=200)
+    b["wall_s"] *= 2
+    b["categories"] = {c: 2 * s for c, s in b["categories"].items()}
+    d = diff_profiles(a, b)
+    assert d["normalized_per_unit"] is True
+    assert d["wall_ratio"] == pytest.approx(1.0)
+    assert not d["regressed"]
+
+
+def test_profile_from_window_picks_plane():
+    acct = _mk_acct()
+    acct.begin_window("w")
+    acct.account_request({"total": 0.2, "prefill": 0.05,
+                          "decode_step": 0.14})
+    w = acct.end_window()
+    p = obsprofile.profile_from_window(w, "decode")
+    assert p["kind"] == "serving" and p["units"] == 1
+    assert p["categories"]["decode_step"] == pytest.approx(0.14)
+    acct.begin_window("t")
+    acct.account("device_compute", time.monotonic() - 0.01, 0.005)
+    w = acct.end_window()
+    p = obsprofile.profile_from_window(w, "train")
+    assert p["kind"] == "train"
+
+
+# -- alerting + doctor join -------------------------------------------------
+
+def test_attribution_emits_event_trips_recorder_and_doctor(tmp_path):
+    from paddle_tpu.obs import flight as obs_flight
+    from paddle_tpu.obs.events import get_event_log
+
+    log = get_event_log()
+    log.enable()
+    log.clear()
+    rec = obs_flight.get_recorder()
+    rec.clear()
+    old_dir = rec.dir
+    rec.dir = str(tmp_path)
+    try:
+        base = _train_profile(fetch=1.0, device=8.0)
+        cur = _train_profile(fetch=1.728, device=8.072)
+        d = attribute_regression(base, cur, tolerance=0.03)
+        assert d["regressed"]
+        evs = log.events(type="perf_regression")
+        assert evs and evs[-1].attrs["owner"] == "fetch_sync"
+        assert rec.dumps, "a regression must trip a recorder dump"
+        bundle = rec.snapshot()
+        gp = bundle["providers"]["goodput"]
+        assert gp["diff"]["owners"][0]["category"] == "fetch_sync"
+        # doctor ranks the attribution into its findings
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import paddle_cli
+
+        findings = paddle_cli.doctor_findings(bundle)
+        assert any("goodput attribution" in text and "fetch_sync" in text
+                   for _score, text in findings)
+    finally:
+        rec.dir = old_dir
+        rec.clear()
+        log.disable()
+        log.clear()
+
+
+def test_cli_profile_diff_and_goodput_report(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import paddle_cli
+
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    save_profile(_train_profile(fetch=1.0, device=8.0), a)
+    save_profile(_train_profile(fetch=1.728, device=8.072), b)
+    text, diff = paddle_cli.profile_diff_report(a, b)
+    assert diff["owners"][0]["category"] == "fetch_sync"
+    assert "fetch_sync" in text.splitlines()[0], \
+        "the top contributor must be named up front"
+    assert "REGRESSED" in text
+    # goodput report renders the breakdown of one profile
+    report, rc = paddle_cli.goodput_report_text(a)
+    assert rc == 0 and "device_compute" in report and "goodput" in report
+    # typed refusal surfaces as exit 2
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("{")
+    _text, rc = paddle_cli.goodput_report_text(bad)
+    assert rc == 2
+
+
+# -- metrics-doc drift gate -------------------------------------------------
+
+def test_metrics_doc_has_every_emitted_instrument():
+    """ISSUE 14 satellite: a pt_* instrument name in the source that is
+    missing from docs/metrics.md fails — regenerate with
+    `paddle_cli.py metrics-doc` after adding an instrument."""
+    from paddle_tpu.obs.metrics_doc import scan_source_names
+
+    doc_path = os.path.join(REPO, "docs", "metrics.md")
+    assert os.path.exists(doc_path), \
+        "docs/metrics.md is missing — run paddle_cli.py metrics-doc"
+    with open(doc_path) as f:
+        doc = f.read()
+    missing = sorted(n for n in scan_source_names() if f"`{n}`" not in doc)
+    assert not missing, (
+        f"undocumented pt_* instruments {missing}; regenerate "
+        f"docs/metrics.md with `python tools/paddle_cli.py metrics-doc`")
+    # the new attribution-plane instruments are part of the contract
+    assert "`pt_goodput_ratio`" in doc
+    assert "`pt_badput_seconds_total`" in doc
+
+
+# -- timeline lanes ---------------------------------------------------------
+
+def test_timeline_merges_goodput_category_lanes(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import timeline
+
+    acct = _mk_acct()
+    t0 = time.monotonic()
+    acct.account("device_compute", t0, 0.02)
+    acct.account("fetch_sync", t0 + 0.02, 0.005)
+    acct.account_request({"total": 0.03, "queue_wait": 0.01,
+                          "dispatch": 0.02}, t0=t0 + 0.03)
+    gp_path = str(tmp_path / "goodput.json")
+    n = acct.dump_intervals(gp_path)
+    assert n == 4
+    with open(gp_path) as f:
+        gp = json.load(f)
+    profile = {"events": [{"name": "host", "start": t0, "dur": 0.01,
+                           "tid": 0}]}
+    out = json.loads(timeline.to_chrome_trace(profile, obs_trace=None,
+                                              goodput=gp))
+    lanes = [e for e in out["traceEvents"]
+             if e.get("ph") == "X" and e.get("pid") == 2]
+    assert {e["name"] for e in lanes} == \
+        {"device_compute", "fetch_sync", "queue_wait", "dispatch"}
+    assert all(e["cat"] == "goodput" for e in lanes)
+    # category -> stable lane (tid); good/bad classification rides args
+    by_name = {e["name"]: e for e in lanes}
+    assert by_name["device_compute"]["args"]["good"] is True
+    assert by_name["queue_wait"]["args"]["good"] is False
+    # pid-2 process metadata names the lane group
+    metas = [e for e in out["traceEvents"]
+             if e.get("ph") == "M" and e.get("pid") == 2]
+    assert metas and metas[0]["args"]["name"] == "goodput categories"

@@ -316,10 +316,13 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all eleven tracked metrics carry a bar (r8 added sharded serving,
+    # all twelve tracked metrics carry a bar (r8 added sharded serving,
     # r10 the quantized CPU serving lane, r11/ISSUE-12 the tuner
-    # contract, r13/ISSUE-13 the paged-KV prefix-cache workload)
-    assert len(bench.BARS) == 11
+    # contract, r13/ISSUE-13 the paged-KV prefix-cache workload,
+    # r14/ISSUE-14 the goodput accounting-closure contract)
+    assert len(bench.BARS) == 12
+    gpc = bench.BARS["goodput_accounting_closure"]
+    assert gpc["field"] == "value" and gpc["min"] == 0.95
     shd = bench.BARS["sharded_serving_qps_per_chip"]
     assert shd["field"] == "value" and shd["min"] == 1.0
     cpuq = bench.BARS["cpu_quantized_serving_qps_ratio"]

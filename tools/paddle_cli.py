@@ -207,7 +207,7 @@ def fleet_rows(endpoints, timeout=3.0):
         row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
                "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
                "shards": "-", "weights": "-", "quant": "-", "kv": "-",
-               "decode": ""}
+               "goodput": "-", "decode": ""}
         try:
             with ServingClient(ep, timeout=timeout) as c:
                 hz = c.healthz()
@@ -223,7 +223,10 @@ def fleet_rows(endpoints, timeout=3.0):
                 shards=int(m.get("shards", 1)),
                 quant=QUANT_MODE_NAMES.get(int(m.get("quant_mode", 0)),
                                            "f32"),
-                weights=int(m["weights_version"]))
+                weights=int(m["weights_version"]),
+                # goodput accounting (docs §23): windowed good/(good+bad)
+                # request-seconds; 1.0 = neutral (not accounting / idle)
+                goodput=f"{m.get('goodput_ratio', 1.0):.2f}")
             # paged-KV column: in-use/total pages + prefix-cache hit rate
             # (the session-affinity signal; "-" on unpaged replicas)
             total_pg = int(m.get("kv_pages_free", 0)
@@ -296,7 +299,7 @@ def router_report(r):
 def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
              f"{'occ':>5}{'mfu':>11}{'shards':>7}{'quant':>7}"
-             f"{'weights':>9}{'kv':>15}  decode"]
+             f"{'weights':>9}{'kv':>15}{'goodput':>9}  decode"]
     for r in rows:
         q = (f"{r['queue']}/{r['capacity']}"
              if r["queue"] != "-" else "-")
@@ -306,7 +309,8 @@ def fleet_report(rows):
                      f"{mfu:>11}{str(r.get('shards', '-')):>7}"
                      f"{str(r.get('quant', '-')):>7}"
                      f"{str(r['weights']):>9}"
-                     f"{str(r.get('kv', '-')):>15}  {r['decode']}")
+                     f"{str(r.get('kv', '-')):>15}"
+                     f"{str(r.get('goodput', '-')):>9}  {r['decode']}")
     healthy = sum(1 for r in rows if r["health"] == "healthy")
     lines.append(f"{healthy}/{len(rows)} replicas healthy")
     return "\n".join(lines)
@@ -425,7 +429,41 @@ def doctor_findings(bundle):
             findings.append((int(ms), f"dominant stage across p99 "
                              f"exemplars: {stage} "
                              f"({ms / total:.0%} of retained span time)"))
-    # 3) dropped events = incomplete evidence
+    # 3) differential attribution (docs §23): when the bundle carries a
+    # profile pair, the goodput provider's diff NAMES the owning category
+    # — rank it right with the evidence instead of leaving it to a human
+    gp = (bundle.get("providers") or {}).get("goodput")
+    attributed = False
+    if isinstance(gp, dict):
+        diff = gp.get("diff")
+        if not isinstance(diff, dict) and gp.get("profiles") \
+                and len(gp["profiles"]) >= 2:
+            # a bundle carrying the raw profile pair but no precomputed
+            # diff: run the attributor here
+            try:
+                sys.path.insert(0, REPO)
+                from paddle_tpu.obs.profile import diff_profiles
+
+                diff = diff_profiles(gp["profiles"][-2], gp["profiles"][-1])
+            except Exception:
+                diff = None
+        if isinstance(diff, dict) and diff.get("owners"):
+            attributed = True
+            findings.append((
+                40 if diff.get("regressed") else 5,
+                f"goodput attribution: {diff.get('summary')}"
+                + ("" if diff.get("regressed") else " (within tolerance)")))
+    if not attributed:
+        # perf_regression events carry the attributor's verdict even when
+        # the provider snapshot is absent — restate the summary so the
+        # owning category is named in the findings
+        for e in events:
+            if e.get("type") == "perf_regression":
+                attrs = e.get("attrs") or {}
+                if attrs.get("summary"):
+                    findings.append(
+                        (35, f"perf regression: {attrs['summary']}"))
+    # 4) dropped events = incomplete evidence
     if bundle.get("events_dropped"):
         findings.append((1, f"event ring dropped "
                          f"{bundle['events_dropped']} events — raise "
@@ -796,11 +834,130 @@ def cmd_placement(argv):
     return 0 if chosen is not None else 1
 
 
+# -- goodput / profiles (docs/design.md §23) --------------------------------
+
+
+def goodput_report_text(path):
+    """(text, exit_code) — the testable core of ``cmd_goodput``: render a
+    profile artifact's breakdown, or a flight bundle's goodput provider
+    snapshot (profile pair + diff)."""
+    sys.path.insert(0, REPO)
+    import json as _json
+
+    from paddle_tpu.obs.profile import (ProfileError, format_diff,
+                                        goodput_report, load_profile)
+
+    try:
+        p = load_profile(path)
+        return goodput_report(p), 0
+    except ProfileError as e:
+        profile_err = e
+    # not a profile — maybe a flight bundle carrying the goodput provider
+    try:
+        with open(path) as f:
+            doc = _json.load(f)
+    except (OSError, ValueError):
+        return f"unreadable: {profile_err}", 2
+    gp = (doc.get("providers") or {}).get("goodput") \
+        if isinstance(doc, dict) else None
+    if not isinstance(gp, dict):
+        return (f"{path}: neither a profile ({profile_err}) nor a bundle "
+                f"with a goodput provider", 2)
+    lines = []
+    for prof in gp.get("profiles") or []:
+        lines.append(goodput_report(prof))
+        lines.append("")
+    if isinstance(gp.get("diff"), dict):
+        lines.append(format_diff(gp["diff"]))
+    return ("\n".join(lines) or "bundle goodput provider is empty"), 0
+
+
+def cmd_goodput(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py goodput",
+        description="render the taxonomy breakdown of a profile artifact "
+                    "(obs/profile.py) or a flight bundle's goodput "
+                    "provider")
+    ap.add_argument("path", help="profile JSON or postmortem bundle")
+    args = ap.parse_args(argv)
+    text, rc = goodput_report_text(args.path)
+    print(text)
+    return rc
+
+
+def profile_diff_report(base_path, cur_path, tolerance=None):
+    """(text, diff) — the testable core of ``cmd_profile_diff``: the
+    differential attributor over two persisted profiles, owners ranked."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.obs.profile import (diff_profiles, format_diff,
+                                        load_profile)
+
+    diff = diff_profiles(load_profile(base_path), load_profile(cur_path),
+                         tolerance=tolerance)
+    return format_diff(diff), diff
+
+
+def cmd_profile_diff(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py profile-diff",
+        description="diff two profile artifacts and name the categories "
+                    "owning the delta (nonzero exit on a regression "
+                    "beyond tolerance)")
+    ap.add_argument("base", help="the earlier profile JSON")
+    ap.add_argument("cur", help="the later profile JSON")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="wall-ratio regression tolerance (default: the "
+                         "obs_profile_diff_tolerance flag)")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, REPO)
+    from paddle_tpu.obs.profile import ProfileError
+
+    try:
+        text, diff = profile_diff_report(args.base, args.cur,
+                                         tolerance=args.tolerance)
+    except ProfileError as e:
+        print(f"typed refusal: {e}", file=sys.stderr)
+        return 2
+    print(text)
+    return 1 if diff["regressed"] else 0
+
+
+def cmd_metrics_doc(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_cli.py metrics-doc",
+        description="generate docs/metrics.md from the live registries "
+                    "(+ a source scan for lazily-registered instruments)")
+    ap.add_argument("--out", default=os.path.join(REPO, "docs",
+                                                  "metrics.md"),
+                    help="output path ('-' = stdout)")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, REPO)
+    from paddle_tpu.obs.metrics_doc import render_doc
+
+    text = render_doc()
+    if args.out == "-":
+        print(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"metrics contract written to {args.out} "
+              f"({sum(1 for l in text.splitlines() if l.startswith('| `'))} "
+              f"instruments)")
+    return 0
+
+
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle_cli.py {train|version|trace|fleet|placement|"
-              "doctor|replay|tune} [args...]")
+              "doctor|replay|tune|goodput|profile-diff|metrics-doc} "
+              "[args...]")
         return 0
     sub = sys.argv[1]
     if sub == "version":
@@ -821,8 +978,15 @@ def main():
         return cmd_replay(sys.argv[2:])
     if sub == "tune":
         return cmd_tune(sys.argv[2:])
+    if sub == "goodput":
+        return cmd_goodput(sys.argv[2:])
+    if sub == "profile-diff":
+        return cmd_profile_diff(sys.argv[2:])
+    if sub == "metrics-doc":
+        return cmd_metrics_doc(sys.argv[2:])
     print(f"unknown subcommand {sub!r}; use "
-          f"train|version|trace|fleet|placement|doctor|replay|tune")
+          f"train|version|trace|fleet|placement|doctor|replay|tune|"
+          f"goodput|profile-diff|metrics-doc")
     return 2
 
 

@@ -75,7 +75,8 @@ from paddle_tpu.serving import (DeadlineExceeded, FleetChaos,  # noqa: E402
                                 ServingRejected, ServingServer,
                                 TenantQuotaExceeded)
 from paddle_tpu.serving.chaos import default_profile  # noqa: E402
-from paddle_tpu.serving.stats import _percentile  # noqa: E402
+from paddle_tpu.serving.stats import (DECODE_STAGES,  # noqa: E402
+                                      PREDICT_STAGES, _percentile)
 
 
 def _client_loop(endpoint, feeds, stop, out, retries, deadline_ms, seed):
@@ -727,7 +728,17 @@ def main(argv=None):
                     help="route structured obs events (health "
                          "transitions, sheds, faults, chaos injections) "
                          "through stdlib logging as one-line JSON")
+    ap.add_argument("--goodput", action="store_true",
+                    help="arm the goodput accountant (docs §23) in the "
+                         "in-process server(s) and print the per-category "
+                         "request-second breakdown + goodput ratio")
     args = ap.parse_args(argv)
+    if args.goodput:
+        # must land before server construction: the server binds its
+        # registry-scoped accountant off this flag
+        from paddle_tpu import flags as ptflags
+
+        ptflags.set_flag("obs_goodput", True)
     if args.prefix_mix:
         args.generate = True  # the prefix mix IS a generation workload
     if args.log_json:
@@ -837,6 +848,26 @@ def _main_quantize_ab(args, shapes, tracer, retries):
               f"= {rb / ra if ra else 0.0:.3f}x  "
               f"p95 {b['p95_ms']:.2f} vs {a['p95_ms']:.2f} ms")
     return lanes["f32"][0] or lanes[args.quantize][0]
+
+
+def _print_goodput(s):
+    """Print the server's goodput accounting block (stats RPC ``goodput``
+    key, present when the server runs with obs_goodput / --goodput)."""
+    gp = s.get("goodput")
+    if not gp:
+        return
+    sv = gp.get("serving") or {}
+    cats = sv.get("categories") or {}
+    total = sum(cats.values())
+    print(f"goodput: ratio={gp.get('goodput_ratio', 0.0):.3f} "
+          f"closure={sv.get('closure', 0.0):.3f} "
+          f"({sv.get('requests', 0)} requests, "
+          f"{sv.get('closure_violations', 0)} closure violations)")
+    if total > 0:
+        parts = [f"{c}={v:.3f}s({v / total:.0%})"
+                 for c, v in sorted(cats.items(), key=lambda kv: -kv[1])
+                 if v > 0]
+        print("  request-seconds by category: " + " ".join(parts))
 
 
 def _main_single(args, shapes, tracer, retries, quantize=None):
@@ -993,11 +1024,12 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
                       f"p95={itl.get('p95', 0.0):.3f}ms  "
                       f"cache={s.get('decode_compile_cache')}")
                 stages = s.get("stages_ms") or {}
-                for st in ("prefill", "decode_step"):
+                for st in DECODE_STAGES:
                     if st in stages:
                         print(f"  {st:<12} mean={stages[st]['mean_ms']:8.3f} "
                               f"p95={stages[st]['p95_ms']:8.3f} "
                               f"n={stages[st]['count']}")
+                _print_goodput(s)
                 if "chaos" in s:
                     print(f"chaos: {s['chaos']}")
             if tracer is not None:
@@ -1039,8 +1071,7 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
                 # request's latency actually went (docs/design.md §15)
                 print("stage breakdown (per-request ms, "
                       "mean/p95 over the retained window):")
-                order = ("pad", "queue_wait", "coalesce", "dispatch",
-                         "pipeline_wait", "device_sync", "scatter")
+                order = PREDICT_STAGES  # the one stage list (stats.py)
                 total_mean = 0.0
                 for st in order:
                     d = stages.get(st)
@@ -1055,6 +1086,7 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
             if s.get("flops_per_s"):
                 print(f"mfu: {s.get('mfu', 0.0):.3e} "
                       f"(cost-analysis {s['flops_per_s'] / 1e9:.4f} GFLOP/s)")
+            _print_goodput(s)
             if "chaos" in s:
                 print(f"chaos: {s['chaos']}")
         if tracer is not None:

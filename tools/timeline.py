@@ -43,7 +43,8 @@ class _ChromeTraceFormatter:
                           separators=None if pretty else (",", ":"))
 
 
-def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None) -> str:
+def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None,
+                    goodput: dict = None) -> str:
     """``obs_trace`` (an ``obs.Tracer.to_chrome_trace()`` dict or a loaded
     dump file) merges into the same timeline: profiler host events land on
     pid 0, obs spans on pid 1. When the obs dump carries its absolute
@@ -51,7 +52,12 @@ def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None) -> str:
     the obs lane is re-based onto the profiler's zero so the two planes are
     genuinely time-aligned (both clocks are CLOCK_MONOTONIC on Linux — see
     profiler.RecordEvent re-emission); without it the obs lane keeps its
-    own zero (distinguishable, alignment best-effort)."""
+    own zero (distinguishable, alignment best-effort).
+
+    ``goodput`` (a ``GoodputAccountant.dump_intervals()`` dump) adds the
+    accountant's per-category lanes on pid 2 — one tid per taxonomy
+    category, so the category owning a regression is visible as a lane in
+    the same view as the spans it classifies (docs/design.md §23)."""
     f = _ChromeTraceFormatter()
     f.emit_pid("host", 0)
     events = profile.get("events", [])
@@ -80,6 +86,22 @@ def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None) -> str:
             timestamp_us=e["ts"] + obs_shift_us, duration_us=e["dur"],
             pid=1, tid=e.get("tid", 0), category=e.get("cat", "obs"),
             name=e["name"], args=e.get("args"))
+    if goodput:
+        ivs = goodput.get("intervals") or []
+        if ivs:
+            f.emit_pid("goodput categories", 2)
+            # intervals carry absolute monotonic t0s: rebase onto the
+            # profiler's zero when host events exist, else their own
+            base = t0 if events else min(iv["t0"] for iv in ivs)
+            tids = {}  # category -> stable lane id, first-seen order
+            for iv in ivs:
+                cat = iv.get("category", "?")
+                tid = tids.setdefault(cat, len(tids))
+                f.emit_region(
+                    timestamp_us=(iv["t0"] - base) * 1e6,
+                    duration_us=iv["dur"] * 1e6,
+                    pid=2, tid=tid, category="goodput", name=cat,
+                    args={"good": bool(iv.get("good"))})
     return f.format_to_string(pretty)
 
 
@@ -92,6 +114,10 @@ def main():
     parser.add_argument("--obs_path", type=str, default=None,
                         help="optional obs tracer Chrome-trace dump "
                              "(obs.get_tracer().dump(...)) to merge in")
+    parser.add_argument("--goodput_path", type=str, default=None,
+                        help="optional goodput interval dump "
+                             "(obs.get_accountant().dump_intervals(...)) "
+                             "— adds one lane per taxonomy category")
     args = parser.parse_args()
     with open(args.profile_path) as f:
         profile = json.load(f)
@@ -99,8 +125,13 @@ def main():
     if args.obs_path:
         with open(args.obs_path) as f:
             obs_trace = json.load(f)
+    goodput = None
+    if args.goodput_path:
+        with open(args.goodput_path) as f:
+            goodput = json.load(f)
     with open(args.timeline_path, "w") as f:
-        f.write(to_chrome_trace(profile, pretty=True, obs_trace=obs_trace))
+        f.write(to_chrome_trace(profile, pretty=True, obs_trace=obs_trace,
+                                goodput=goodput))
     print("timeline written to", args.timeline_path)
 
 
