@@ -412,6 +412,20 @@ BARS = {
                   "raises (value 0). Deterministic by construction: the "
                   "sweep is exhaustive and non-overlapping, so only "
                   "missing instrumentation can fail it"},
+    "ddp_training_step_time_ratio": {
+        "field": "value", "min": 0.5, "provisional": True,
+        "source": "ISSUE 15 acceptance: dp4-vs-dp1 wall step-time ratio "
+                  "at fixed global batch on the virtual CPU mesh "
+                  "(measured 1.25x at intro on a 1-core host — the bar "
+                  "guards against pathological sharding overhead, not a "
+                  "TPU scaling claim; BASELINE.md rationale). The "
+                  "REQUIRED gates ride in-workload and raise: two fresh "
+                  "dp4 runs produce BIT-IDENTICAL loss trajectories "
+                  "(rerun determinism), live optimizer-state shard bytes "
+                  "stay within the ZeRO account (opt_state/dp + padding), "
+                  "every accumulator is actually sharded over the dp=4 "
+                  "mesh, and the dp4 loss trajectory stays within 1e-4 "
+                  "relative of dp1"},
     "cpu_quantized_serving_qps_ratio": {
         "field": "value", "min": 0.85, "provisional": True,
         "source": "BASELINE.md quantized-CPU-serving bar: int8 closed-"
@@ -1752,6 +1766,157 @@ def bench_sharded_serving():
     _emit(rec)
 
 
+# THIRTEENTH workload class (ISSUE 15): sharded data-parallel training —
+# dp4-vs-dp1 A/B on one transformer-LM config at FIXED GLOBAL BATCH in a
+# subprocess (the forced virtual-device count must never perturb other
+# lanes). REQUIRED in-workload gates raise: rerun determinism (two fresh
+# dp4 runs bit-identical loss trajectories), optimizer-state residency
+# within the ZeRO account (live shard bytes vs placement.py arithmetic),
+# and loss divergence vs dp1 within tolerance. The barred value is the
+# dp1/dp4 wall step-time ratio at the fixed global batch — on the virtual
+# CPU mesh this is a pathological-overhead guard, not a TPU scaling claim
+# (BASELINE.md rationale).
+DDP_VOCAB = 512
+DDP_T = 32
+DDP_D = 64
+DDP_HEADS = 4
+DDP_LAYERS = 2
+DDP_FF = 128
+DDP_BATCH = 16   # global batch, both lanes
+DDP_K = 2        # optimizer steps per window
+DDP_WINDOWS = 4  # measured windows (after a compile window)
+DDP_LOSS_TOL = 1e-4  # relative, per step (docs §24 tolerance rationale)
+
+
+def _ddp_training_child():
+    """The --ddp-child entry: the sharded-training A/B on the forced
+    8-virtual-device host, ONE JSON record for the parent to re-emit."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.parallel.ddp import ShardedTrainStep
+
+    def build(seed=17):
+        with fluid.unique_name.guard():
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                ids = fluid.layers.data("ids", shape=[DDP_T],
+                                        dtype="int64")
+                labels = fluid.layers.data("labels", shape=[DDP_T],
+                                           dtype="int64")
+                _, loss = transformer_lm(
+                    ids, labels, vocab_size=DDP_VOCAB, max_len=DDP_T,
+                    d_model=DDP_D, n_heads=DDP_HEADS, n_layers=DDP_LAYERS,
+                    d_ff=DDP_FF)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(
+                    loss, startup)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            exe.run(startup, scope=scope, seed=17)
+        return main_prog, exe, scope, loss
+
+    rng = np.random.RandomState(29)
+    X = rng.randint(0, DDP_VOCAB, (DDP_BATCH, DDP_T)).astype(np.int64)
+    feed = {"ids": X, "labels": X}
+
+    def run_lane(dp, zero):
+        prog, exe, scope, loss = build()
+        sts = ShardedTrainStep(prog, dp=dp, accum_steps=1,
+                               zero_stage=zero, executor=exe)
+        losses = []
+        # TWO warm windows before timing: window 1 compiles, window 2
+        # absorbs the one-time recompile the delegate path pays when the
+        # donated device-resident state replaces the startup numpy inputs
+        # (committed-array signature change) — timed windows then compare
+        # steady states, the r5 slope discipline
+        for _ in range(2):
+            out = sts.run_window(feed, k=DDP_K, fetch_list=[loss],
+                                 scope=scope)
+            losses.extend(np.asarray(out[0]).reshape(DDP_K, -1)
+                          .mean(axis=1))
+        t0 = time.monotonic()
+        for _ in range(DDP_WINDOWS):
+            out = sts.run_window(feed, k=DDP_K, fetch_list=[loss],
+                                 scope=scope)
+            losses.extend(np.asarray(out[0]).reshape(DDP_K, -1)
+                          .mean(axis=1))
+        step_s = (time.monotonic() - t0) / (DDP_WINDOWS * DDP_K)
+        return np.asarray(losses, np.float64), step_s, sts, scope
+
+    l1, t1, _s1, _sc1 = run_lane(1, 1)
+    l4a, t4, sts4, scope4 = run_lane(4, 2)
+    l4b, _t4b, _s4b, _sc4b = run_lane(4, 2)
+
+    # GATE 1: rerun determinism — same mesh, same seeds, bit-identical
+    if not np.array_equal(l4a, l4b):
+        raise ValueError(
+            f"dp4 rerun nondeterministic: max |delta| = "
+            f"{np.max(np.abs(l4a - l4b))}")
+    # GATE 2: optimizer-state residency within the ZeRO account
+    res = sts4.state_bytes_per_device(scope4)
+    if res["opt_shard_bytes_per_device"] > res["zero_account_bytes"] * 1.01:
+        raise ValueError(
+            f"optimizer-state residency {res['opt_shard_bytes_per_device']}"
+            f" B/device exceeds the ZeRO account "
+            f"{res['zero_account_bytes']} B")
+    for a in sts4.split.sharded_acc_names:
+        v = scope4.get(a)
+        if len(v.sharding.device_set) != 4:
+            raise ValueError(f"optimizer state {a!r} is not sharded over "
+                             f"the dp=4 mesh")
+    # GATE 3: loss divergence vs single-device within tolerance
+    rel = np.max(np.abs(l4a - l1) / (np.abs(l1) + 1e-12))
+    if rel > DDP_LOSS_TOL:
+        raise ValueError(f"dp4 loss trajectory diverged from dp1: max "
+                         f"relative delta {rel:.2e} > {DDP_LOSS_TOL}")
+
+    print(json.dumps({
+        "metric": "ddp_training_step_time_ratio",
+        "value": round(t1 / t4, 4),
+        "unit": "x",
+        "step_ms_dp1": round(t1 * 1e3, 3),
+        "step_ms_dp4": round(t4 * 1e3, 3),
+        "rerun_deterministic": True,
+        "loss_max_rel_delta_vs_dp1": float(rel),
+        "opt_shard_bytes_per_device": res["opt_shard_bytes_per_device"],
+        "zero_account_bytes": res["zero_account_bytes"],
+        "collectives": sts4.measured_collectives(
+            feed, k=1, fetch_list=[], scope=scope4),
+        "config": {"V": DDP_VOCAB, "T": DDP_T, "D": DDP_D,
+                   "layers": DDP_LAYERS, "global_batch": DDP_BATCH,
+                   "k": DDP_K, "zero_stage": 2},
+    }))
+
+
+def bench_ddp_training():
+    """Thirteenth workload class (ISSUE 15): run the sharded-training A/B
+    in a child process that forces an 8-virtual-device host platform,
+    then re-emit its record through the shared bar/regression judging."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--ddp-child"],
+        capture_output=True, text=True, cwd=here, env=env, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"ddp child failed: {(r.stderr or r.stdout)[-400:]}")
+    rec = None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rec = json.loads(line)
+    if rec is None:
+        raise RuntimeError(f"ddp child emitted no record: "
+                           f"{r.stdout[-400:]}")
+    _emit(rec)
+
+
 # goodput-closure workload config (ISSUE 14): small transformer-LM — the
 # closure contract is structural (does the instrumentation explain the
 # wall), not a throughput claim, so the config only needs to exercise the
@@ -1949,6 +2114,8 @@ def main():
              "prefix_cache_decode_hit_token_ratio", "x"),
             (bench_sharded_serving,
              "sharded_serving_qps_per_chip", "x"),
+            (bench_ddp_training,
+             "ddp_training_step_time_ratio", "x"),
             (bench_cpu_quantized_serving,
              "cpu_quantized_serving_qps_ratio", "x"),
             (bench_tuner_contract,
@@ -1989,5 +2156,7 @@ def main():
 if __name__ == "__main__":
     if "--sharded-child" in sys.argv:
         _sharded_serving_child()
+    elif "--ddp-child" in sys.argv:
+        _ddp_training_child()
     else:
         main()

@@ -96,8 +96,21 @@ def _train_metrics():
                                "XLA cost-analysis FLOPs of dispatched steps"),
             "compiles": r.counter("pt_train_compiles_total",
                                   "Executor compile-cache misses"),
+            # sharded-training plane (parallel/ddp.py, docs §24): the
+            # current data-parallel width and the model-attributed
+            # in-window collective seconds (ring reduce-scatter +
+            # all-gather volumes priced at the configured link bandwidth,
+            # clamped to the measured device window)
+            "dp": r.gauge("pt_train_dp",
+                          "Data-parallel width of the sharded training "
+                          "step (1 = unsharded)"),
+            "collective": r.counter(
+                "pt_train_collective_seconds_total",
+                "Model-attributed reduce-scatter/all-gather seconds "
+                "inside sharded training windows"),
             "window": window,
         }
+        _train_obs["dp"].set(1.0)
         r.gauge("pt_train_flops_per_second",
                 "Windowed rate of cost-analysis FLOPs dispatched",
                 callback=window.rate)

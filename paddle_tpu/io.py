@@ -37,6 +37,7 @@ from .core.ir import Program, Variable, default_main_program
 MODEL_FILENAME = "__model__"
 SUCCESS_MARKER = "_SUCCESS"
 MANIFEST_FILENAME = "_MANIFEST.json"
+ZERO_META_FILENAME = "_ZERO.json"
 CHECKPOINT_PREFIX = "checkpoint"
 SHARD_META_SUFFIX = ".shards.json"
 
@@ -531,7 +532,7 @@ def _pick_verified_serial(checkpoint_dir: str) -> int:
         return -2
     for s in reversed(serials):
         err = verify_checkpoint(
-            os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{s}"))
+            checkpoint_serial_dir(checkpoint_dir, s))
         if err is None:
             return s
         warnings.warn(
@@ -540,9 +541,30 @@ def _pick_verified_serial(checkpoint_dir: str) -> int:
     return -1
 
 
+def read_zero_meta(checkpoint_serial_path: str) -> Optional[dict]:
+    """The ZeRO reshard descriptor a sharded-training checkpoint carries
+    (``parallel/ddp.ShardedTrainStep.zero_meta`` — saved dp, zero stage,
+    and per-accumulator logical shapes, docs §24). ``None`` for
+    checkpoints saved without one; corrupt descriptors raise ``IOError``
+    (the manifest discipline: a checkpoint that LOOKS sharded but whose
+    descriptor cannot be read must not silently load as unsharded)."""
+    path = os.path.join(checkpoint_serial_path, ZERO_META_FILENAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise IOError(f"unreadable ZeRO descriptor at {path}: {e}")
+
+
+def checkpoint_serial_dir(checkpoint_dir: str, serial: int) -> str:
+    return os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+
+
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
                     max_num_checkpoints=3, scope=None, step=None,
-                    host_tables=None):
+                    host_tables=None, zero_meta=None):
     """``host_tables``: HostEmbeddingTable instances checkpointed INSIDE the
     same numbered dir, before its _SUCCESS marker — the reference's pserver
     lookup-table checkpoint (checkpoint_notify table blocks,
@@ -554,7 +576,7 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
 
     os.makedirs(checkpoint_dir, exist_ok=True)
     serial = _next_checkpoint_serial(checkpoint_dir) if step is None else step
-    cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+    cur = checkpoint_serial_dir(checkpoint_dir, serial)
     os.makedirs(cur, exist_ok=True)
     save_persistables(executor, cur, main_program, scope=scope)
     for table in (host_tables or []):
@@ -570,6 +592,13 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
             tune.save_bundle(cur)
         except Exception:
             pass
+        if zero_meta is not None:
+            # the ZeRO reshard descriptor (docs §24) commits BEFORE the
+            # manifest so the digest covers it — a torn descriptor reads
+            # as a corrupt checkpoint, never as an unsharded one
+            _atomic_write(
+                os.path.join(cur, ZERO_META_FILENAME),
+                lambda f: f.write(json.dumps(zero_meta).encode()))
     if jax.process_count() > 1:
         # every host must finish its shard writes before the chief marks the
         # checkpoint complete (<- pservers each checkpointing their shard,
@@ -639,8 +668,8 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            err = (verify_checkpoint(os.path.join(
-                checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}"))
+            err = (verify_checkpoint(
+                checkpoint_serial_dir(checkpoint_dir, serial))
                 if jax.process_index() == 0 else None)
             corrupt = int(multihost_utils.broadcast_one_to_all(
                 np.int64(0 if err is None else 1)))
@@ -650,15 +679,37 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
                     + (f": {err}" if err else " (chief-verified)"))
         else:
             err = verify_checkpoint(
-                os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}"))
+                checkpoint_serial_dir(checkpoint_dir, serial))
             if err is not None:
                 raise IOError(
                     f"checkpoint_{serial} under {checkpoint_dir} is corrupt: "
                     f"{err}")
     if serial < 0:
         raise FileNotFoundError(f"no complete checkpoint under {checkpoint_dir}")
-    cur = os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+    cur = checkpoint_serial_dir(checkpoint_dir, serial)
     load_persistables(executor, cur, main_program, scope=scope)
+    zmeta = read_zero_meta(cur)
+    if zmeta:
+        # a ZeRO-sharded checkpoint (docs §24) stores param-shaped
+        # optimizer accumulators as flat padded 1-D arrays. Restore them
+        # to their LOGICAL shapes here so a plain (unsharded) resume —
+        # Trainer without parallel=, any direct load_checkpoint caller —
+        # trains on correct state instead of crashing (or silently
+        # reinterpreting) flat buffers. A sharded session's own live
+        # multi-shard values are left alone: ShardedTrainStep re-lays
+        # them out for its mesh and validates the descriptor itself.
+        sc = scope or global_scope()
+        for name, info in zmeta.get("vars", {}).items():
+            val = sc.get(name)
+            if val is None or _is_multi_shard(val):
+                continue
+            shape = tuple(info.get("shape") or ())
+            if not shape:
+                continue
+            arr = np.asarray(val)
+            nelem = int(info.get("nelem") or np.prod(shape))
+            if arr.ndim == 1 and arr.shape != shape and arr.size >= nelem:
+                sc.set(name, arr[:nelem].reshape(shape))
     for table in (host_tables or []):
         tdir = _host_table_dir(cur, table.name, jax.process_index())
         if not os.path.exists(os.path.join(tdir, "meta.json")):
@@ -736,5 +787,5 @@ def _next_checkpoint_serial(checkpoint_dir) -> int:
 def _scroll_delete(checkpoint_dir, max_num_checkpoints):
     serials = _checkpoint_serials(checkpoint_dir)
     for s in serials[:-max_num_checkpoints] if max_num_checkpoints > 0 else []:
-        shutil.rmtree(os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{s}"),
+        shutil.rmtree(checkpoint_serial_dir(checkpoint_dir, s),
                       ignore_errors=True)

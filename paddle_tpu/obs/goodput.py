@@ -52,16 +52,21 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .metrics import MetricsRegistry, RateWindow, get_registry
 
-#: training-plane taxonomy (docs §23). ``idle`` is the sweep residual.
-TRAIN_CATEGORIES = ("device_compute", "host_input", "h2d", "compile",
-                    "fetch_sync", "idle")
+#: training-plane taxonomy (docs §23; ``collective`` added by the sharded
+#: trainer, docs §24). ``idle`` is the sweep residual.
+TRAIN_CATEGORIES = ("device_compute", "collective", "host_input", "h2d",
+                    "compile", "fetch_sync", "idle")
 
 #: sweep priorities: at any instant the highest-priority *active* interval
 #: owns it (device beats everything — host work overlapped with the device
 #: is hidden, not badput; an h2d transfer nested inside host_prep carves
-#: its own category out of the parent instead of double counting)
-TRAIN_PRIORITY = {"device_compute": 5, "compile": 4, "fetch_sync": 3,
-                  "h2d": 2, "host_input": 1}
+#: its own category out of the parent instead of double counting).
+#: ``collective`` sits ABOVE device_compute: the sharded trainer feeds its
+#: reduce-scatter/all-gather intervals nested inside the device window
+#: (parallel/ddp.py), and the sweep carves them out of device time — the
+#: closure invariant stays exact by construction.
+TRAIN_PRIORITY = {"collective": 6, "device_compute": 5, "compile": 4,
+                  "fetch_sync": 3, "h2d": 2, "host_input": 1}
 
 #: categories whose seconds count as GOODPUT (the device doing, or the
 #: host blocked on, useful model math); everything else — queueing,

@@ -1,0 +1,476 @@
+"""Parallelism placement: the primitives both planes search over.
+
+PR 8 built the serving-side placement searcher (``serving/placement.py``):
+exhaustive (dp, tp) enumeration under an analytic comm/compute/HBM model,
+feasibility as a hard gate, typed ``NoFeasiblePlacement``. Sharded
+*training* (``parallel/ddp.py``, docs/design.md §24) needs the same
+machinery over a different axis set — (dp, accum_steps, zero_stage) — so
+the pieces that are plane-agnostic live here and both searchers import
+them:
+
+* ``DeviceInventory`` — what a chip offers (HBM, peak FLOP/s, HBM and
+  inter-chip link bandwidth, per-collective latency).
+* ``NoFeasiblePlacement`` — the one typed rejection, carrying every
+  candidate's reason; the axis names are caller-supplied so the message
+  reads ``dp=2 tp=1: ...`` for serving and ``dp=2 accum=4 zero=2: ...``
+  for training.
+* ``TrainProfile`` / ``TrainPlacementSearcher`` — the training half of
+  the tentpole: ZeRO byte accounting (params replicated, grads and
+  optimizer state sharded 1/dp), ring-collective comm modeling
+  (reduce-scatter + all-gather = ``2 * grad_bytes * (dp-1)/dp``), and a
+  step-time model that scores every (dp, accum_steps, zero_stage) split
+  of a global batch. The execution side is
+  ``parallel/ddp.ShardedTrainStep`` — plans here are directly runnable
+  there, and the bench's residency gate checks the live arrays against
+  THIS account.
+
+The search discipline is unchanged from PR 8 (PAPERS.md arXiv
+2110.10548: layouts are searched, not hand-picked; arXiv 2512.02551:
+trust measurement — ``TrainProfile.from_program`` reads FLOPs off the
+real lowered step via XLA cost analysis when it can).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+GIB = 1024 ** 3
+
+
+class NoFeasiblePlacement(ValueError):
+    """No enumerated split fits the device inventory. Carries the
+    per-candidate rejection reasons so the operator sees WHY (typically:
+    bytes exceed HBM at every allowed split)."""
+
+    def __init__(self, reasons: Dict[Tuple, str],
+                 axis_names: Sequence[str] = ("dp", "tp")):
+        self.reasons = dict(reasons)
+
+        def fmt(k):
+            if isinstance(k, tuple):
+                return " ".join(f"{a}={v}" for a, v in zip(axis_names, k))
+            return str(k)
+
+        detail = "; ".join(f"{fmt(k)}: {r}"
+                           for k, r in sorted(reasons.items()))
+        super().__init__(f"no feasible placement — {detail or 'no candidates'}")
+
+
+class DeviceInventory:
+    """One chip class + how many of them (homogeneous — the meshes both
+    planes build are flat)."""
+
+    __slots__ = ("n_devices", "hbm_bytes", "peak_flops", "hbm_bw",
+                 "link_bw", "alpha_s", "name")
+
+    def __init__(self, n_devices: int, hbm_gb: float = 16.0,
+                 peak_tflops: float = 197.0, hbm_gbps: float = 820.0,
+                 link_gbps: float = 45.0, alpha_us: float = 1.0,
+                 name: str = "custom"):
+        if n_devices < 1:
+            raise ValueError("inventory needs at least one device")
+        self.n_devices = int(n_devices)
+        self.hbm_bytes = float(hbm_gb) * GIB
+        self.peak_flops = float(peak_tflops) * 1e12
+        self.hbm_bw = float(hbm_gbps) * 1e9
+        self.link_bw = float(link_gbps) * 1e9
+        self.alpha_s = float(alpha_us) * 1e-6
+        self.name = name
+
+    @classmethod
+    def tpu_v5e(cls, n_devices: int) -> "DeviceInventory":
+        """bench.py's chip nominal: 197 TFLOP/s bf16, 16 GB HBM @ 820
+        GB/s, ~45 GB/s per ICI link."""
+        return cls(n_devices, hbm_gb=16.0, peak_tflops=197.0,
+                   hbm_gbps=820.0, link_gbps=45.0, name="tpu_v5e")
+
+    @classmethod
+    def host(cls, n_devices: int, peak_gflops: float = 50.0,
+             hbm_gb: float = 4.0) -> "DeviceInventory":
+        """A deliberately humble CPU-host inventory for predicted-vs-
+        measured sanity on the tier-1 mesh (tools/perf_lab.py calibrates
+        ``peak_gflops`` from a probe matmul before using it)."""
+        return cls(n_devices, hbm_gb=hbm_gb, peak_tflops=peak_gflops / 1e3,
+                   hbm_gbps=20.0, link_gbps=10.0, alpha_us=20.0,
+                   name="host")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "n_devices": self.n_devices,
+                "hbm_gb": self.hbm_bytes / GIB,
+                "peak_tflops": self.peak_flops / 1e12,
+                "hbm_gbps": self.hbm_bw / 1e9,
+                "link_gbps": self.link_bw / 1e9}
+
+
+#: optimizer op type -> per-parameter accumulator multiplier (how many
+#: param-shaped f32 arrays of optimizer state the update keeps). Scalar
+#: accumulators (Adam's beta pows) are counted separately — they neither
+#: shard nor matter at byte granularity.
+OPT_STATE_MULTIPLIER = {
+    "sgd": 0, "proximal_gd": 0,
+    "momentum": 1, "adagrad": 1, "decayed_adagrad": 1,
+    "proximal_adagrad": 1,
+    "adam": 2, "adamax": 2, "adadelta": 2, "rmsprop": 2, "ftrl": 2,
+}
+
+
+class TrainProfile:
+    """Byte/FLOP account of one *training* program under ZeRO sharding.
+
+    * ``param_bytes`` — the replicated parameter store (every rank holds
+      full params: ZeRO-1/2, not ZeRO-3).
+    * ``grad_bytes`` — one full f32 gradient set (== param element count
+      x 4; gradients accumulate in f32 regardless of param dtype,
+      docs §24). Sharded 1/dp under zero_stage=2, full under stage 1
+      (the local accumulation buffer).
+    * ``opt_state_bytes`` — param-shaped optimizer accumulators
+      (``OPT_STATE_MULTIPLIER``); always sharded 1/dp.
+    * ``act_bytes_per_row`` — forward+backward working set per batch
+      row at peak (per-microbatch: the scan frees activations between
+      microbatches, so accumulation divides this term by accum).
+    * ``flops_per_row`` — fwd+bwd FLOPs per batch row (the standard 3x
+      forward unless measured; ``from_program`` reads the REAL lowered
+      step's XLA cost analysis when available — fwd+bwd+update in one
+      number, measurement over assumption).
+    """
+
+    __slots__ = ("param_bytes", "grad_bytes", "opt_state_bytes",
+                 "act_bytes_per_row", "flops_per_row", "n_tensors",
+                 "source", "optimizer")
+
+    def __init__(self, param_bytes: float, opt_state_bytes: float,
+                 act_bytes_per_row: float, flops_per_row: float,
+                 grad_bytes: Optional[float] = None, n_tensors: int = 1,
+                 source: str = "synthetic", optimizer: str = "?"):
+        self.param_bytes = float(param_bytes)
+        # f32 grads: one float per param element even for low-bit params
+        self.grad_bytes = (float(grad_bytes) if grad_bytes is not None
+                           else float(param_bytes))
+        self.opt_state_bytes = float(opt_state_bytes)
+        self.act_bytes_per_row = float(act_bytes_per_row)
+        self.flops_per_row = float(flops_per_row)
+        self.n_tensors = max(1, int(n_tensors))
+        self.source = source
+        self.optimizer = optimizer
+
+    @classmethod
+    def for_lm(cls, n_params: float, n_layers: int, d_model: int,
+               d_ff: int, vocab: int, seq_len: int,
+               optimizer: str = "adam",
+               source: str = "synthetic_lm") -> "TrainProfile":
+        """The ONE place the transformer-LM training cost formulas live
+        (6N FLOPs/token fwd+bwd, residual + FFN + head-slab activations
+        per token, the per-optimizer state multiplier): callers bring
+        their own ``n_params`` — analytic (``synthetic_lm``) or measured
+        off a real export (``paddle_cli placement --train``) — so the
+        two tables can never silently diverge."""
+        mult = OPT_STATE_MULTIPLIER.get(optimizer, 2)
+        act_per_token = 4.0 * (4 * d_model + d_ff + vocab / 8)
+        return cls(
+            param_bytes=4.0 * n_params,
+            opt_state_bytes=4.0 * n_params * mult,
+            act_bytes_per_row=act_per_token * seq_len,
+            flops_per_row=6.0 * n_params * seq_len,
+            n_tensors=2 + n_layers * 6, source=source,
+            optimizer=optimizer)
+
+    @classmethod
+    def synthetic_lm(cls, n_layers: int, d_model: int, d_ff: int,
+                     vocab: int, seq_len: int,
+                     optimizer: str = "adam") -> "TrainProfile":
+        """Analytic transformer-LM profile (the searcher grid / unit
+        tests): dense param count into ``for_lm``'s shared formulas."""
+        D, FF, V, L = d_model, d_ff, vocab, n_layers
+        n_params = V * D + L * (4 * D * D + 2 * D * FF) + D * V
+        return cls.for_lm(n_params, L, D, FF, V, seq_len,
+                          optimizer=optimizer)
+
+    @classmethod
+    def from_program(cls, program, scope=None, block_idx: int = 0,
+                     feed: Optional[Dict[str, Any]] = None,
+                     xla_cost: bool = True) -> "TrainProfile":
+        """Walk a REAL training program (forward + grad + optimizer ops)
+        into a profile: params and their accumulator multipliers come
+        from the update ops' slots, byte counts from the live scope
+        arrays when given (else the IR-declared shapes), activations
+        from the block's intermediate var shapes, and FLOPs — when a
+        reference ``feed`` is supplied — from XLA's own cost analysis of
+        the lowered step (fwd+bwd+update, measured not assumed)."""
+        import numpy as np
+
+        from .parallel.ddp import split_train_block
+
+        split = split_train_block(program, block_idx)
+        block = program.blocks[block_idx]
+
+        def nelem(name: str) -> int:
+            if scope is not None and scope.get(name) is not None:
+                return int(np.asarray(scope.get(name)).size)
+            var = block.find_var_recursive(name)
+            if var is None or var.shape is None:
+                return 0
+            return int(np.prod([d for d in var.shape if d and d > 0] or [1]))
+
+        param_elems = sum(nelem(p) for p in split.param_names)
+        acc_elems = sum(nelem(a) for a in split.sharded_acc_names)
+        # activations: every non-persistable intermediate the block
+        # produces, per row (dim 0 is the batch dim by convention)
+        act = 0.0
+        seen = set()
+        for op in block.ops[:split.split_idx]:
+            for names in op.outputs.values():
+                for n in names:
+                    if not n or n in seen:
+                        continue
+                    seen.add(n)
+                    var = block.find_var_recursive(n)
+                    if var is None or var.persistable or not var.shape:
+                        continue
+                    per_row = [d for d in var.shape[1:] if d and d > 0]
+                    act += 4.0 * float(np.prod(per_row or [1]))
+        # fwd residuals are re-read by the backward: count the forward
+        # half twice (the grad ops' own outputs are already in the walk)
+        flops = None
+        rows = 1
+        if xla_cost and feed:
+            try:
+                from .core.executor import build_step_fn
+                from .obs import abstractify, analyze_jit
+
+                step, ro, don, _ = build_step_fn(
+                    program, block_idx, sorted(feed), [])
+                feed_avals = {k: abstractify(np.asarray(v))
+                              for k, v in feed.items()}
+                rows = int(next(iter(feed_avals.values())).shape[0])
+                ro_a = {n: abstractify(np.asarray(scope.get(n))) for n in ro}
+                don_a = {n: abstractify(np.asarray(scope.get(n)))
+                         for n in don}
+                key = abstractify(np.zeros((2,), np.uint32))
+                flops = analyze_jit(step, feed_avals, ro_a, don_a,
+                                    key)["flops"]
+            except Exception:
+                flops = None
+        if flops is None:
+            # 3x-forward analytic fallback; a "row" is whatever dim 0 of
+            # the feeds is (tokens-per-row folds into param reuse)
+            flops = 6.0 * param_elems
+            rows = 1
+        return cls(
+            param_bytes=4.0 * param_elems,
+            opt_state_bytes=4.0 * acc_elems,
+            act_bytes_per_row=act,
+            flops_per_row=float(flops) / max(rows, 1),
+            n_tensors=len(split.param_names),
+            source="program", optimizer=split.optimizer_types[0]
+            if split.optimizer_types else "?")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class TrainPlacementPlan:
+    """One scored (dp, accum_steps, zero_stage) split of a fixed global
+    batch: the ZeRO per-device byte account, the modeled comm/compute
+    split, and the step-time/throughput numbers that chose it."""
+
+    __slots__ = ("dp", "accum_steps", "zero_stage", "global_batch",
+                 "microbatch_rows", "feasible", "reason",
+                 "hbm_bytes_per_device", "hbm_fraction",
+                 "param_bytes_per_device", "grad_bytes_per_device",
+                 "opt_bytes_per_device", "act_bytes_per_device",
+                 "comm_bytes_per_step", "collectives_per_step",
+                 "comm_s", "compute_s", "hbm_s", "step_s",
+                 "rows_per_sec", "rows_per_sec_per_chip", "inventory")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    @property
+    def devices(self) -> int:
+        return self.dp
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {k: getattr(self, k) for k in self.__slots__
+             if k != "inventory"}
+        if self.inventory is not None:
+            d["inventory"] = self.inventory.as_dict()
+        return d
+
+    def __repr__(self):
+        if not self.feasible:
+            return (f"TrainPlacementPlan(dp={self.dp}, "
+                    f"accum={self.accum_steps}, zero={self.zero_stage}, "
+                    f"INFEASIBLE: {self.reason})")
+        return (f"TrainPlacementPlan(dp={self.dp}, accum={self.accum_steps},"
+                f" zero={self.zero_stage}, "
+                f"hbm/dev={self.hbm_bytes_per_device / GIB:.2f}GiB, "
+                f"step={self.step_s * 1e3:.2f}ms)")
+
+
+class TrainPlacementSearcher:
+    """Exhaustive (dp, accum_steps, zero_stage) enumeration under the
+    §24 cost model, for one model x one chip count x one global batch.
+
+    Cost model (per optimizer step over the whole global batch ``B``;
+    ``b_loc = B / (dp * accum)`` rows per rank per microbatch)::
+
+        compute_s = flops_per_row * (B / dp) / peak_flops
+        hbm_s     = accum * (3*param + 2*opt/dp) / hbm_bw
+        rs_count  = accum if zero_stage == 2 else 1
+        comm_s    = n_coll * alpha
+                  + (rs_count * grad + param) * (dp-1)/dp / link_bw
+        step_s    = max(compute_s, hbm_s) + comm_s
+
+    with comm the ring formulas for reduce-scatter(grads) and
+    all-gather(params) — ``2 * grad_bytes * (dp-1)/dp`` moved per step
+    at accum=1 — and ``n_coll = n_tensors * (rs_count + 1)``. The model
+    does NOT credit the XLA overlap of collectives with backward (the
+    step executes them inside one compiled program, docs §24): modeled
+    step time is an upper bound, and the bench's measured ratio is the
+    number that gets believed (arXiv 2512.02551 discipline).
+
+    ZeRO HBM gate (hard, per device)::
+
+        params (replicated)
+        + opt_state / dp
+        + grads / (dp if zero_stage == 2 else 1)
+        + act_bytes_per_row * b_loc        # peak per microbatch
+
+    ``accum_steps`` decouples the global batch from per-device HBM:
+    b_loc — and with it the activation term — shrinks by 1/accum while
+    the optimizer math stays the global-batch step.
+    """
+
+    AXIS_NAMES = ("dp", "accum", "zero")
+
+    def __init__(self, profile: TrainProfile, inventory: DeviceInventory,
+                 global_batch: int, max_accum: int = 64):
+        if global_batch < 1:
+            raise ValueError(f"global_batch must be >= 1: {global_batch}")
+        self.profile = profile
+        self.inventory = inventory
+        self.global_batch = int(global_batch)
+        self.max_accum = int(max_accum)
+
+    def score(self, dp: int, accum_steps: int,
+              zero_stage: int) -> TrainPlacementPlan:
+        prof, inv, B = self.profile, self.inventory, self.global_batch
+        plan = TrainPlacementPlan(
+            dp=dp, accum_steps=accum_steps, zero_stage=zero_stage,
+            global_batch=B, inventory=inv)
+        if zero_stage not in (1, 2):
+            plan.feasible = False
+            plan.reason = f"zero_stage must be 1 or 2, got {zero_stage}"
+            return plan
+        if B % (dp * accum_steps):
+            plan.feasible = False
+            plan.reason = (f"global batch {B} not divisible by "
+                           f"dp*accum = {dp * accum_steps}")
+            return plan
+        b_loc = B // (dp * accum_steps)
+        plan.microbatch_rows = b_loc
+        grad_div = dp if zero_stage == 2 else 1
+        plan.param_bytes_per_device = prof.param_bytes
+        plan.grad_bytes_per_device = prof.grad_bytes / grad_div
+        plan.opt_bytes_per_device = prof.opt_state_bytes / dp
+        plan.act_bytes_per_device = prof.act_bytes_per_row * b_loc
+        hbm = (plan.param_bytes_per_device + plan.grad_bytes_per_device
+               + plan.opt_bytes_per_device + plan.act_bytes_per_device)
+        plan.hbm_bytes_per_device = hbm
+        plan.hbm_fraction = hbm / inv.hbm_bytes
+        if hbm > inv.hbm_bytes:
+            plan.feasible = False
+            plan.reason = (f"per-device bytes {hbm / GIB:.2f} GiB exceed "
+                           f"modeled HBM {inv.hbm_bytes / GIB:.2f} GiB")
+            return plan
+        compute_s = prof.flops_per_row * (B / dp) / inv.peak_flops
+        # HBM traffic: each microbatch's fwd+bwd streams the params ~3x
+        # (fwd read, bwd read, update write amortized) + the opt shard
+        hbm_s = accum_steps * (3.0 * prof.param_bytes
+                               + 2.0 * prof.opt_state_bytes / dp) / inv.hbm_bw
+        if dp > 1:
+            rs_count = accum_steps if zero_stage == 2 else 1
+            n_coll = prof.n_tensors * (rs_count + 1)
+            comm_bytes = (rs_count * prof.grad_bytes + prof.param_bytes) \
+                * (dp - 1) / dp
+            comm_s = n_coll * inv.alpha_s + comm_bytes / inv.link_bw
+        else:
+            n_coll, comm_bytes, comm_s = 0, 0.0, 0.0
+        plan.collectives_per_step = n_coll
+        plan.comm_bytes_per_step = comm_bytes
+        plan.compute_s, plan.hbm_s, plan.comm_s = compute_s, hbm_s, comm_s
+        plan.step_s = max(compute_s, hbm_s) + comm_s
+        plan.rows_per_sec = B / plan.step_s
+        plan.rows_per_sec_per_chip = plan.rows_per_sec / dp
+        plan.feasible = True
+        return plan
+
+    def candidates(self, max_devices: Optional[int] = None
+                   ) -> List[Tuple[int, int, int]]:
+        n = min(self.inventory.n_devices,
+                max_devices or self.inventory.n_devices)
+        dps = []
+        d = 1
+        while d <= n:
+            dps.append(d)
+            d *= 2
+        out = []
+        for dp in dps:
+            accum = 1
+            while accum <= self.max_accum and dp * accum <= self.global_batch:
+                if self.global_batch % (dp * accum) == 0:
+                    for z in (1, 2):
+                        out.append((dp, accum, z))
+                accum *= 2
+        return sorted(out)
+
+    def all_plans(self, max_devices: Optional[int] = None
+                  ) -> List[TrainPlacementPlan]:
+        return [self.score(*c) for c in self.candidates(max_devices)]
+
+    def search(self, max_devices: Optional[int] = None
+               ) -> TrainPlacementPlan:
+        """The best feasible plan: minimum modeled step time for the
+        fixed global batch (training wants the optimizer step done, not
+        per-chip elegance — the global batch is the unit of progress);
+        ties break toward fewer devices, then fewer accumulation steps
+        (less latency per optimizer step), then the lower zero stage
+        (fewer collectives) — a total order, so the choice is
+        deterministic for fixed inputs."""
+        best, reasons = None, {}
+        for plan in self.all_plans(max_devices):
+            if not plan.feasible:
+                reasons[(plan.dp, plan.accum_steps, plan.zero_stage)] = \
+                    plan.reason
+                continue
+            key = (plan.step_s, plan.dp, plan.accum_steps,
+                   plan.zero_stage)
+            if best is None or key < best[0]:
+                best = (key, plan)
+        if best is None:
+            raise NoFeasiblePlacement(reasons, axis_names=self.AXIS_NAMES)
+        return best[1]
+
+
+def train_plan_table(plans: Sequence[TrainPlacementPlan]) -> str:
+    """Fixed-width table of scored train plans (paddle_cli placement
+    --train / perf_lab train_scale both print through here)."""
+    lines = [f"{'dp':>4}{'accum':>7}{'zero':>6}{'b_loc':>7}{'hbm/dev':>10}"
+             f"{'fit':>6}{'step_ms':>9}{'rows/s/chip':>13}{'comm_ms':>9}"
+             f"  status"]
+    for p in plans:
+        if p.feasible:
+            lines.append(
+                f"{p.dp:>4}{p.accum_steps:>7}{p.zero_stage:>6}"
+                f"{p.microbatch_rows:>7}"
+                f"{p.hbm_bytes_per_device / GIB:>9.2f}G"
+                f"{p.hbm_fraction:>6.0%}"
+                f"{p.step_s * 1e3:>9.3f}{p.rows_per_sec_per_chip:>13.1f}"
+                f"{p.comm_s * 1e3:>9.3f}  ok")
+        else:
+            lines.append(
+                f"{p.dp:>4}{p.accum_steps:>7}{p.zero_stage:>6}{'-':>7}"
+                f"{(p.hbm_bytes_per_device or 0) / GIB:>9.2f}G{'-':>6}"
+                f"{'-':>9}{'-':>13}{'-':>9}  INFEASIBLE: {p.reason}")
+    return "\n".join(lines)

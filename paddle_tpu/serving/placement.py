@@ -71,65 +71,10 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-GIB = 1024 ** 3
-
-
-class NoFeasiblePlacement(ValueError):
-    """No enumerated (dp, tp) split fits the device inventory. Carries the
-    per-candidate rejection reasons so the operator sees WHY (typically:
-    param bytes exceed HBM at every allowed tp)."""
-
-    def __init__(self, reasons: Dict[Tuple[int, int], str]):
-        self.reasons = dict(reasons)
-        detail = "; ".join(f"dp={d} tp={t}: {r}"
-                           for (d, t), r in sorted(reasons.items()))
-        super().__init__(f"no feasible placement — {detail or 'no candidates'}")
-
-
-class DeviceInventory:
-    """One chip class + how many of them (homogeneous — the mesh the
-    serving tier builds is flat)."""
-
-    __slots__ = ("n_devices", "hbm_bytes", "peak_flops", "hbm_bw",
-                 "link_bw", "alpha_s", "name")
-
-    def __init__(self, n_devices: int, hbm_gb: float = 16.0,
-                 peak_tflops: float = 197.0, hbm_gbps: float = 820.0,
-                 link_gbps: float = 45.0, alpha_us: float = 1.0,
-                 name: str = "custom"):
-        if n_devices < 1:
-            raise ValueError("inventory needs at least one device")
-        self.n_devices = int(n_devices)
-        self.hbm_bytes = float(hbm_gb) * GIB
-        self.peak_flops = float(peak_tflops) * 1e12
-        self.hbm_bw = float(hbm_gbps) * 1e9
-        self.link_bw = float(link_gbps) * 1e9
-        self.alpha_s = float(alpha_us) * 1e-6
-        self.name = name
-
-    @classmethod
-    def tpu_v5e(cls, n_devices: int) -> "DeviceInventory":
-        """bench.py's chip nominal: 197 TFLOP/s bf16, 16 GB HBM @ 820
-        GB/s, ~45 GB/s per ICI link."""
-        return cls(n_devices, hbm_gb=16.0, peak_tflops=197.0,
-                   hbm_gbps=820.0, link_gbps=45.0, name="tpu_v5e")
-
-    @classmethod
-    def host(cls, n_devices: int, peak_gflops: float = 50.0,
-             hbm_gb: float = 4.0) -> "DeviceInventory":
-        """A deliberately humble CPU-host inventory for predicted-vs-
-        measured sanity on the tier-1 mesh (tools/perf_lab.py calibrates
-        ``peak_gflops`` from a probe matmul before using it)."""
-        return cls(n_devices, hbm_gb=hbm_gb, peak_tflops=peak_gflops / 1e3,
-                   hbm_gbps=20.0, link_gbps=10.0, alpha_us=20.0,
-                   name="host")
-
-    def as_dict(self) -> Dict[str, Any]:
-        return {"name": self.name, "n_devices": self.n_devices,
-                "hbm_gb": self.hbm_bytes / GIB,
-                "peak_tflops": self.peak_flops / 1e12,
-                "hbm_gbps": self.hbm_bw / 1e9,
-                "link_gbps": self.link_bw / 1e9}
+# plane-agnostic primitives promoted to paddle_tpu/placement.py (ISSUE 15:
+# the training searcher shares them); re-exported here so every PR-8-era
+# import site keeps working
+from ..placement import GIB, DeviceInventory, NoFeasiblePlacement  # noqa: F401
 
 
 class TrafficProfile:

@@ -74,7 +74,15 @@ class Trainer:
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  param_path: Optional[str] = None, place=None,
                  checkpoint_config: Optional[CheckpointConfig] = None,
-                 seed: Optional[int] = None, log_json: bool = False):
+                 seed: Optional[int] = None, log_json: bool = False,
+                 parallel: Optional[dict] = None):
+        """``parallel``: sharded data-parallel training (docs §24) —
+        ``{"dp": N, "accum_steps": K, "zero_stage": 1|2}`` wraps every
+        training step in a ``parallel.ddp.ShardedTrainStep``: each reader
+        batch is one GLOBAL batch (``rows % (dp*accum) == 0``), grads
+        reduce-scatter over the mesh, optimizer state shards 1/dp, and
+        checkpoints carry the ZeRO reshard descriptor so a resume at a
+        different dp re-lays the state out."""
         self.checkpoint_cfg = checkpoint_config
         self.place = place
         self.stop_requested = False
@@ -104,6 +112,13 @@ class Trainer:
         self.scope = Scope()
         self.exe = Executor(place)
         self.exe.run(self.startup_program, scope=self.scope, seed=seed)
+
+        self.ddp = None
+        if parallel:
+            from .parallel.ddp import ShardedTrainStep
+
+            self.ddp = ShardedTrainStep(self.train_program,
+                                        executor=self.exe, **parallel)
 
         if param_path:
             fluid_io.load_persistables(self.exe, param_path,
@@ -188,12 +203,48 @@ class Trainer:
                 t_step = time.monotonic()
                 with tracer.span("train/step", cat="train", epoch=epoch,
                                  step=step, fetch=begin.fetch_metrics):
-                    metrics = self.exe.run(
-                        self.train_program, feed=feed,
-                        fetch_list=fetch if begin.fetch_metrics else [],
-                        scope=self.scope, return_numpy=False)
-                    # host conversion (the sync point) only on fetch steps
-                    metrics = [np.asarray(m) for m in (metrics or [])]
+                    if self.ddp is not None:
+                        # one sharded optimizer step: the reader batch is
+                        # the global batch (invariant feed — copy-free
+                        # reshape, no per-step restack); fetches come
+                        # back stacked [1, accum, dp, ...]. Scalar
+                        # fetches (a mean loss) report the mean over
+                        # microbatches/ranks — the fused-batch mean,
+                        # since microbatches are equal-sized. BATCH-FIRST
+                        # fetches (IR-declared leading dim -1) reassemble
+                        # in the ORIGINAL global-batch row order: the
+                        # window split rows as [accum, dp, b_loc], so a
+                        # C-order reshape inverts it exactly. Anything
+                        # else (a param norm, a weight) is not per-row
+                        # data — hand back the honest [accum, dp, ...]
+                        # stack rather than gluing duplicated copies.
+                        outs = self.ddp.run_window(
+                            feed, k=1,
+                            fetch_list=fetch if begin.fetch_metrics else [],
+                            scope=self.scope, return_numpy=False)
+                        blk = self.train_program.global_block()
+                        names = fetch if begin.fetch_metrics else []
+                        metrics = []
+                        for name, m in zip(names, outs or []):
+                            a = np.asarray(m)[0]  # [accum, dp, ...]
+                            var = blk.find_var_recursive(name)
+                            shp = tuple(var.shape) if var is not None \
+                                and var.shape else ()
+                            if a.ndim <= 2:
+                                metrics.append(np.asarray(a.mean()))
+                            elif shp and shp[0] == -1:
+                                metrics.append(
+                                    a.reshape((-1,) + a.shape[3:]))
+                            else:
+                                metrics.append(a)
+                    else:
+                        metrics = self.exe.run(
+                            self.train_program, feed=feed,
+                            fetch_list=fetch if begin.fetch_metrics else [],
+                            scope=self.scope, return_numpy=False)
+                        # host conversion (the sync point) only on fetch
+                        # steps
+                        metrics = [np.asarray(m) for m in (metrics or [])]
                 if tracer.enabled:
                     dur = time.monotonic() - t_step
                     if tracer.exemplars.would_retain(dur):
@@ -246,7 +297,9 @@ class Trainer:
             self.exe, self.checkpoint_cfg.checkpoint_dir,
             main_program=self.train_program,
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
-            scope=self.scope)
+            scope=self.scope,
+            zero_meta=self.ddp.zero_meta() if self.ddp is not None
+            else None)
 
 
 class Inferencer:

@@ -64,8 +64,11 @@ def test_stage_list_has_one_owner():
     assert serving_categories() == \
         STAGES + EXTRA_REQUEST_CATEGORIES + ("idle",)
     # the train taxonomy is exhaustive: sweep categories + idle
+    # (ISSUE 15 added `collective` — the sharded trainer's in-window
+    # reduce-scatter/all-gather attribution, docs §24)
     assert set(TRAIN_CATEGORIES) - {"idle"} == \
-        {"device_compute", "host_input", "h2d", "compile", "fetch_sync"}
+        {"device_compute", "collective", "host_input", "h2d", "compile",
+         "fetch_sync"}
     # goodput classification covers only known categories
     assert GOOD_CATEGORIES <= set(TRAIN_CATEGORIES) | set(STAGES)
 

@@ -281,3 +281,143 @@ def test_decode_pool_rides_the_hbm_account(profile):
     expect = profile.decode_pool_bytes(8) / 2
     assert with_pool.hbm_bytes_per_device - base.hbm_bytes_per_device == \
         pytest.approx(expect)
+
+
+# ---------------------------------------------------------------------------
+# training placement searcher (paddle_tpu/placement.py, ISSUE 15 / docs §24)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.placement import (OPT_STATE_MULTIPLIER, TrainProfile,  # noqa: E402
+                                  TrainPlacementSearcher, train_plan_table)
+
+
+@pytest.fixture()
+def tprofile():
+    return TrainProfile.synthetic_lm(L, D, FF, V, T, optimizer="adam")
+
+
+def test_train_zero_hbm_account_exact(tprofile):
+    """params replicated + opt/dp + grads/(dp if zero2) + act*b_loc —
+    the §24 account, checked arithmetically."""
+    inv = DeviceInventory(8, hbm_gb=1e4)
+    s = TrainPlacementSearcher(tprofile, inv, global_batch=64)
+    p = s.score(4, 2, 2)
+    b_loc = 64 // (4 * 2)
+    want = (tprofile.param_bytes + tprofile.opt_state_bytes / 4
+            + tprofile.grad_bytes / 4
+            + tprofile.act_bytes_per_row * b_loc)
+    assert p.feasible
+    assert p.hbm_bytes_per_device == pytest.approx(want)
+    # zero_stage=1 keeps the FULL local grad accumulation buffer
+    p1 = s.score(4, 2, 1)
+    assert p1.hbm_bytes_per_device - p.hbm_bytes_per_device == \
+        pytest.approx(tprofile.grad_bytes * (1 - 1 / 4))
+
+
+def test_train_accum_shrinks_activation_term(tprofile):
+    """accum_steps decouples global batch from per-device HBM: doubling
+    accum halves b_loc and with it the activation term."""
+    inv = DeviceInventory(8, hbm_gb=1e4)
+    s = TrainPlacementSearcher(tprofile, inv, global_batch=64)
+    a1 = s.score(2, 1, 2).act_bytes_per_device
+    a2 = s.score(2, 2, 2).act_bytes_per_device
+    a4 = s.score(2, 4, 2).act_bytes_per_device
+    assert a2 == pytest.approx(a1 / 2) and a4 == pytest.approx(a1 / 4)
+
+
+def test_train_comm_model_dimensional(tprofile):
+    """ring reduce-scatter+all-gather = (rs*grad + param)*(dp-1)/dp;
+    doubling link bandwidth halves the volume term; zero_stage=2 pays
+    accum x the reduce-scatter volume."""
+    inv1 = DeviceInventory(8, hbm_gb=1e4, link_gbps=45.0, alpha_us=0.0)
+    inv2 = DeviceInventory(8, hbm_gb=1e4, link_gbps=90.0, alpha_us=0.0)
+    s1 = TrainPlacementSearcher(tprofile, inv1, 64)
+    s2 = TrainPlacementSearcher(tprofile, inv2, 64)
+    p1, p2 = s1.score(4, 1, 1), s2.score(4, 1, 1)
+    assert p1.comm_bytes_per_step == pytest.approx(
+        (tprofile.grad_bytes + tprofile.param_bytes) * 3 / 4)
+    assert p1.comm_s == pytest.approx(2 * p2.comm_s)
+    # zero2 at accum=4: 4x the grad reduce-scatter volume
+    z2 = s1.score(4, 4, 2)
+    assert z2.comm_bytes_per_step == pytest.approx(
+        (4 * tprofile.grad_bytes + tprofile.param_bytes) * 3 / 4)
+    # dp=1 needs no collectives at all
+    assert s1.score(1, 2, 1).comm_s == 0.0
+
+
+def test_train_search_deterministic_and_typed_refusal(tprofile):
+    inv = DeviceInventory(8, hbm_gb=1e4)
+    a = TrainPlacementSearcher(tprofile, inv, 64).search()
+    b = TrainPlacementSearcher(tprofile, inv, 64).search()
+    assert (a.dp, a.accum_steps, a.zero_stage) == \
+        (b.dp, b.accum_steps, b.zero_stage)
+    tiny = DeviceInventory(8, hbm_gb=1e-6)
+    with pytest.raises(NoFeasiblePlacement) as ei:
+        TrainPlacementSearcher(tprofile, tiny, 64).search()
+    assert "dp=1 accum=1 zero=1" in str(ei.value)
+    assert ei.value.reasons  # every candidate carries its reason
+
+
+def test_train_search_scales_out_when_compute_bound(tprofile):
+    """With free links and compute-bound steps, more dp = shorter steps;
+    with expensive links the searcher stays small. (The model must be
+    able to pick EITHER side — a searcher that always answers dp=1 or
+    always answers dp=max is a constant, not a model.)"""
+    fast = DeviceInventory(8, hbm_gb=1e4, link_gbps=1e6, alpha_us=0.0)
+    slow = DeviceInventory(8, hbm_gb=1e4, link_gbps=0.001)
+    best_fast = TrainPlacementSearcher(tprofile, fast, 64).search()
+    best_slow = TrainPlacementSearcher(tprofile, slow, 64).search()
+    assert best_fast.dp == 8
+    assert best_slow.dp == 1
+
+
+def test_train_accum_unlocks_infeasible_batch(tprofile):
+    """The decoupling claim: a global batch whose activations exceed HBM
+    at accum=1 goes feasible at higher accum (same dp)."""
+    act_at = lambda accum: tprofile.act_bytes_per_row * (4096 // (8 * accum))
+    need = tprofile.param_bytes + tprofile.opt_state_bytes / 8 \
+        + tprofile.grad_bytes / 8
+    hbm = (need + (act_at(1) + act_at(4)) / 2) / GIB
+    inv = DeviceInventory(8, hbm_gb=hbm)
+    s = TrainPlacementSearcher(tprofile, inv, 4096)
+    assert not s.score(8, 1, 2).feasible
+    assert s.score(8, 4, 2).feasible
+
+
+def test_train_profile_from_real_program():
+    """TrainProfile.from_program walks a REAL minimized program: exact
+    param bytes off the scope arrays, the adam 2x opt-state multiplier,
+    measured XLA FLOPs when a reference feed is given."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(fluid.layers.fc(x, size=16), size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss,
+                                                              startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=0)
+    feed = {"x": np.zeros((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    prof = TrainProfile.from_program(main, scope=scope, feed=feed)
+    param_elems = 8 * 16 + 16 + 16 * 1 + 1
+    assert prof.param_bytes == 4.0 * param_elems
+    assert prof.opt_state_bytes == pytest.approx(
+        4.0 * param_elems * OPT_STATE_MULTIPLIER["adam"])
+    assert prof.optimizer == "adam"
+    assert prof.n_tensors == 4
+    assert prof.flops_per_row > 0
+    assert prof.act_bytes_per_row > 0
+
+
+def test_train_plan_table_renders_infeasible_rows(tprofile):
+    inv = DeviceInventory(2, hbm_gb=1e-6)
+    plans = TrainPlacementSearcher(tprofile, inv, 8).all_plans()
+    text = train_plan_table(plans)
+    assert "INFEASIBLE" in text and "zero" in text

@@ -166,6 +166,35 @@ def test_paddle_cli_placement_report(tmp_path):
                                      "--hbm-gb", "1e-9"]) == 1
 
 
+def test_paddle_cli_placement_train_table(tmp_path):
+    """`paddle_cli.py placement --train N` (ISSUE 15): the (dp, accum,
+    zero_stage) training table prints next to the serving one with
+    per-device ZeRO HBM and modeled step time; an inventory the train
+    searcher cannot fit turns into the nonzero exit."""
+    d = _export_tiny_lm(str(tmp_path / "lm"))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import paddle_cli
+    finally:
+        sys.path.pop(0)
+    report, chosen = paddle_cli.placement_report(
+        d, chips=4, batch_mix="1:1.0", seq_len=16, train_chips=8,
+        train_batch=32)
+    assert chosen is not None
+    assert "train plan table" in report and "train chosen: dp=" in report
+    assert "zero" in report and "rows/s/chip" in report
+    # the train table enumerates both zero stages and accum splits
+    report2, chosen2 = paddle_cli.placement_report(
+        d, chips=4, batch_mix="1:1.0", seq_len=16, train_chips=8,
+        train_batch=32, hbm_gb=1e-9)
+    assert chosen2 is None and "NO FEASIBLE PLAN" in report2
+    assert paddle_cli.cmd_placement([d, "--chips", "2", "--seq-len", "16",
+                                     "--train", "4"]) == 0
+    assert paddle_cli.cmd_placement([d, "--chips", "2", "--seq-len", "16",
+                                     "--train", "4",
+                                     "--hbm-gb", "1e-9"]) == 1
+
+
 def test_paddle_cli_tune_table(tmp_path):
     """`paddle_cli.py tune <db>`: one row per entry with decision, config,
     margin, age, staleness; --prune-stale drops mismatched entries and
@@ -316,11 +345,15 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all twelve tracked metrics carry a bar (r8 added sharded serving,
+    # all thirteen tracked metrics carry a bar (r8 added sharded serving,
     # r10 the quantized CPU serving lane, r11/ISSUE-12 the tuner
     # contract, r13/ISSUE-13 the paged-KV prefix-cache workload,
-    # r14/ISSUE-14 the goodput accounting-closure contract)
-    assert len(bench.BARS) == 12
+    # r14/ISSUE-14 the goodput accounting-closure contract, r15/ISSUE-15
+    # the sharded data-parallel training workload)
+    assert len(bench.BARS) == 13
+    ddp = bench.BARS["ddp_training_step_time_ratio"]
+    assert ddp["field"] == "value" and ddp["min"] == 0.5
+    assert ddp.get("provisional") is True
     gpc = bench.BARS["goodput_accounting_closure"]
     assert gpc["field"] == "value" and gpc["min"] == 0.95
     shd = bench.BARS["sharded_serving_qps_per_chip"]

@@ -726,15 +726,65 @@ def _parse_batch_mix(spec):
     return out
 
 
+def train_placement_report(prof, chips=8, hbm_gb=16.0, peak_tflops=197.0,
+                           hbm_gbps=820.0, link_gbps=45.0,
+                           global_batch=64, optimizer="adam"):
+    """(report_text, chosen_plan_or_None) — the TRAINING placement table
+    (docs §24): every (dp, accum_steps, zero_stage) split of the global
+    batch scored under the ZeRO byte account and the ring-collective
+    step-time model. ``prof`` is the serving ``ModelProfile`` the export
+    walk already produced — the training profile derives from it (same
+    params; f32 grads; optimizer-state multiplier by optimizer type)."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.placement import (DeviceInventory, NoFeasiblePlacement,
+                                      TrainProfile, TrainPlacementSearcher,
+                                      train_plan_table)
+
+    cfg = prof.cfg
+    # measured element count off the real export; the cost formulas are
+    # TrainProfile.for_lm's — ONE owner, shared with the searcher grid
+    tprof = TrainProfile.for_lm(
+        prof.param_bytes / prof.dtype_bytes, cfg["n_layers"],
+        cfg["d_model"], cfg["d_ff"], cfg["vocab"], cfg["max_len"],
+        optimizer=optimizer, source=prof.source)
+    inv = DeviceInventory(chips, hbm_gb=hbm_gb, peak_tflops=peak_tflops,
+                          hbm_gbps=hbm_gbps, link_gbps=link_gbps)
+    searcher = TrainPlacementSearcher(tprof, inv, global_batch)
+    mult = tprof.opt_state_bytes / tprof.param_bytes
+    lines = [f"--- train plan table (global batch {global_batch}, "
+             f"{optimizer}: params + {mult:.0f}x opt state) ---",
+             train_plan_table(searcher.all_plans())]
+    try:
+        best = searcher.search()
+    except NoFeasiblePlacement as e:
+        lines.append(f"train: NO FEASIBLE PLAN: {e}")
+        return "\n".join(lines), None
+    lines.append(
+        f"train chosen: dp={best.dp} accum={best.accum_steps} "
+        f"zero={best.zero_stage}  per-device HBM "
+        f"{best.hbm_bytes_per_device / 2**30:.3f} GiB "
+        f"({best.hbm_fraction:.0%})  comm "
+        f"{best.comm_bytes_per_step / 2**20:.2f} MiB/step over "
+        f"{best.collectives_per_step} collectives  modeled step "
+        f"{best.step_s * 1e3:.2f} ms "
+        f"({best.rows_per_sec_per_chip:.1f} rows/s/chip)")
+    return "\n".join(lines), best
+
+
 def placement_report(dirname, chips=8, hbm_gb=16.0, peak_tflops=197.0,
                      hbm_gbps=820.0, link_gbps=45.0, batch_mix="1:0.7,8:0.3",
                      p95_ms=None, seq_len=None, decode_slots=0,
-                     quantize=None):
+                     quantize=None, train_chips=None, train_batch=64,
+                     train_optimizer="adam"):
     """(report_text, chosen_plan_or_None) — the testable core of
     ``cmd_placement``. With ``quantize`` the f32 and quantized byte
     accounts are searched SIDE BY SIDE (the headline row: a model that
     must-shard at f32 but fits one chip under int8 — the quantized store
-    is ~1/4 the HBM); the returned plan is the QUANTIZED one."""
+    is ~1/4 the HBM); the returned plan is the QUANTIZED one. With
+    ``train_chips`` the TRAINING (dp, accum_steps, zero_stage) table
+    prints next to the serving one; when the train search finds nothing
+    the report carries its NO FEASIBLE PLAN line and the returned plan
+    is ``None`` (the nonzero-exit signal)."""
     sys.path.insert(0, REPO)
     from paddle_tpu.serving.placement import (DeviceInventory,
                                               NoFeasiblePlacement,
@@ -795,6 +845,17 @@ def placement_report(dirname, chips=8, hbm_gb=16.0, peak_tflops=197.0,
             f"(dp={single_chip[quantize].dp} tp={single_chip[quantize].tp}, "
             f"{single_chip[quantize].hbm_bytes_per_device / 2**30:.3f} "
             f"GiB/dev)")
+    if train_chips:
+        # the training table rides next to the serving one (ISSUE 15):
+        # same export, same inventory class, the §24 searcher
+        ttext, tplan = train_placement_report(
+            prof, chips=train_chips, hbm_gb=hbm_gb,
+            peak_tflops=peak_tflops, hbm_gbps=hbm_gbps,
+            link_gbps=link_gbps, global_batch=train_batch,
+            optimizer=train_optimizer)
+        lines.append(ttext)
+        if tplan is None:
+            chosen = None  # train infeasibility is the exit signal too
     return "\n".join(lines), chosen
 
 
@@ -823,13 +884,24 @@ def cmd_placement(argv):
                          "account side by side (int8 weights ~1/4 the "
                          "HBM; a must-shard model can become single-chip "
                          "— the headline row) and return ITS plan")
+    ap.add_argument("--train", type=int, default=None, metavar="N_CHIPS",
+                    help="also print the TRAINING (dp, accum, zero_stage) "
+                         "candidate table for N chips — ZeRO per-device "
+                         "HBM + modeled step time (docs §24); nonzero "
+                         "exit when nothing fits")
+    ap.add_argument("--train-batch", type=int, default=64,
+                    help="global batch the train searcher splits")
+    ap.add_argument("--train-optimizer", default="adam",
+                    help="optimizer type for the ZeRO state multiplier")
     args = ap.parse_args(argv)
     report, chosen = placement_report(
         args.export_dir, chips=args.chips, hbm_gb=args.hbm_gb,
         peak_tflops=args.peak_tflops, hbm_gbps=args.hbm_gbps,
         link_gbps=args.link_gbps, batch_mix=args.batch_mix,
         p95_ms=args.p95_ms, seq_len=args.seq_len,
-        decode_slots=args.decode_slots, quantize=args.quantize)
+        decode_slots=args.decode_slots, quantize=args.quantize,
+        train_chips=args.train, train_batch=args.train_batch,
+        train_optimizer=args.train_optimizer)
     print(report)
     return 0 if chosen is not None else 1
 

@@ -620,6 +620,124 @@ def placement_mode(seed: int = 5):
                       "rows": rows}))
 
 
+def _train_child(argv):
+    """One train_scale cell, run in a FRESH process: `perf_lab.py
+    train-child DP ACCUM ZERO WINDOWS K GLOBAL_BATCH`. Fresh because the
+    forced virtual-device count must land before jax initializes and must
+    never perturb the other lanes' thread pools (the PR-8 --mesh trick).
+    Prints ONE JSON line the parent collects."""
+    import json
+    import os
+
+    dp, accum, zero, windows, k, gb = (int(a) for a in argv[:6])
+    flags_env = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags_env:
+        os.environ["XLA_FLAGS"] = (
+            flags_env + f" --xla_force_host_platform_device_count="
+            f"{max(dp, 1)}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_lm
+    from paddle_tpu.parallel.ddp import ShardedTrainStep
+
+    V, T, D, H, L, FF = 512, 32, 64, 4, 2, 128
+    with fluid.unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[T], dtype="int64")
+            labels = fluid.layers.data("labels", shape=[T], dtype="int64")
+            _, loss = transformer_lm(ids, labels, vocab_size=V, max_len=T,
+                                     d_model=D, n_heads=H, n_layers=L,
+                                     d_ff=FF)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope, seed=11)
+    sts = ShardedTrainStep(main_prog, dp=dp, accum_steps=accum,
+                           zero_stage=zero, executor=exe)
+    rng = np.random.RandomState(5)
+    X = rng.randint(0, V, (gb, T)).astype(np.int64)
+    feed = {"ids": X, "labels": X}
+    # two warm windows: window 1 compiles, window 2 absorbs the one-time
+    # recompile the dp=1 delegate path pays when donated device-resident
+    # state replaces the startup numpy inputs — timed cells compare
+    # steady states across dp
+    for _ in range(2):
+        out = sts.run_window(feed, k=k, fetch_list=[loss], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(windows):
+        out = sts.run_window(feed, k=k, fetch_list=[loss], scope=scope)
+    step_s = (time.perf_counter() - t0) / (windows * k)
+    res = sts.state_bytes_per_device(scope)
+    print(json.dumps({
+        "dp": dp, "accum": accum, "zero_stage": zero,
+        "global_batch": gb, "k": k,
+        "step_ms": round(step_s * 1e3, 3),
+        "rows_per_sec": round(gb / step_s, 1),
+        "rows_per_sec_per_chip": round(gb / step_s / dp, 1),
+        "loss_final": float(np.asarray(out[0]).mean()),
+        "opt_shard_bytes_per_device": res["opt_shard_bytes_per_device"],
+        "zero_account_bytes": res["zero_account_bytes"],
+    }))
+
+
+def train_scale_mode(windows: int = 4, k: int = 2, global_batch: int = 32):
+    """`perf_lab.py train_scale` — sweep dp x accum_steps x zero_stage in
+    fresh subprocesses (each child forces its own virtual-device count
+    before jax initializes — the PR-8 --mesh discipline, so the forced
+    mesh never perturbs other lanes), print the table, and emit the
+    winner (max rows/s/chip at the fixed global batch, ties to the
+    simpler config) as the final JSON line."""
+    import json
+    import os
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    env = {key: v for key, v in os.environ.items() if key != "PYTHONPATH"}
+    env.pop("XLA_FLAGS", None)  # each child forces its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    grid = [(dp, accum, zero)
+            for dp in (1, 2, 4, 8)
+            for accum in (1, 2, 4)
+            for zero in (1, 2)
+            if global_batch % (dp * accum) == 0
+            and not (dp == 1 and zero == 2 and accum == 1)]
+    rows = []
+    print(f"{'dp':>4}{'accum':>7}{'zero':>6}{'step_ms':>9}"
+          f"{'rows/s':>9}{'rows/s/chip':>13}{'opt_B/dev':>11}  note")
+    for dp, accum, zero in grid:
+        r = subprocess.run(
+            [sys.executable, here, "train-child", str(dp), str(accum),
+             str(zero), str(windows), str(k), str(global_batch)],
+            capture_output=True, text=True, env=env, timeout=900)
+        if r.returncode != 0:
+            print(f"{dp:>4}{accum:>7}{zero:>6}{'-':>9}{'-':>9}{'-':>13}"
+                  f"{'-':>11}  FAILED: {(r.stderr or '')[-120:]}")
+            continue
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        rows.append(rec)
+        print(f"{dp:>4}{accum:>7}{zero:>6}{rec['step_ms']:>9.3f}"
+              f"{rec['rows_per_sec']:>9.1f}"
+              f"{rec['rows_per_sec_per_chip']:>13.1f}"
+              f"{int(rec['opt_shard_bytes_per_device']):>11}")
+    if not rows:
+        print(json.dumps({"error": "every train_scale cell failed"}))
+        sys.exit(1)
+    best = max(rows, key=lambda r: (r["rows_per_sec_per_chip"],
+                                    -r["dp"], -r["accum"],
+                                    -r["zero_stage"]))
+    print("chosen config:")
+    print(json.dumps({"chosen": {key: best[key] for key in
+                                 ("dp", "accum", "zero_stage")},
+                      "step_ms": best["step_ms"],
+                      "rows_per_sec_per_chip":
+                          best["rows_per_sec_per_chip"],
+                      "rows": rows}))
+
+
 def _cpu_child(argv):
     """One sweep cell, run in a FRESH process: `perf_lab.py cpu-child
     EXPORT QUANT THREADS MAX_BATCH REPS`. A fresh process because the
@@ -940,6 +1058,12 @@ def main():
         return
     if layout == "cpu-child":
         _cpu_child(sys.argv[2:])
+        return
+    if layout == "train_scale":
+        train_scale_mode()
+        return
+    if layout == "train-child":
+        _train_child(sys.argv[2:])
         return
     if layout == "tune":
         tune_mode()
