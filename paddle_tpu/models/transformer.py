@@ -640,8 +640,51 @@ def predict_forward(params, ids, *, cfg, tp: int = 1, tp_axis=None):
     return gather(fc(xn, params["out_w"], params.get("out_b")))
 
 
+def _decode_epilogue(xn, params, gather, positions, valids, sample,
+                     full_logits):
+    """Shared head of both decode forwards: final-LN activations ->
+    ``(next_tokens, logits)``.
+
+    * ``full_logits=False`` (the steady-state step): logits at each
+      lane's LAST VALID chunk position, ``[B, V]``. ``sample=None``
+      keeps the historical greedy argmax; a sample dict
+      (serving/sampling.py) runs the fused policy epilogue — greedy
+      (temp 0) rows still resolve to the same argmax bit-exactly, so
+      the policy rides as data without forking the executable.
+    * ``full_logits=True`` (speculative verify): logits at EVERY chunk
+      position, ``[B, C, V]`` — position j scores the token after the
+      j-th chunk token, which is exactly the per-proposal target
+      distribution the rejection sampler needs. ``next_tokens`` stays
+      the last-valid argmax (the host does all verify-side sampling).
+    """
+    import jax.numpy as jnp
+
+    B = xn.shape[0]
+    last = jnp.maximum(valids - 1, 0)
+    if full_logits:
+        head = _dc_matmul(xn, params["out_w"])
+        if "out_b" in params:
+            head = head + params["out_b"]
+        head = gather(head)  # [B, C, V]
+        hl = head[jnp.arange(B), last]
+        return jnp.argmax(hl, axis=-1).astype(jnp.int32), head
+    xl = xn[jnp.arange(B), last]  # [B, D] — each lane's last valid position
+    head_logits = _dc_matmul(xl, params["out_w"])
+    if "out_b" in params:
+        head_logits = head_logits + params["out_b"]
+    head_logits = gather(head_logits)
+    if sample is None:
+        next_tok = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
+    else:
+        from ..serving.sampling import sample_tokens
+
+        next_tok = sample_tokens(head_logits, sample, positions, valids)
+    return next_tok, head_logits
+
+
 def decode_forward_paged(params, pool_k, pool_v, tokens, positions, valids,
-                         slots, page_tables, *, cfg, window, page_len,
+                         slots, page_tables, sample=None, *, cfg, window,
+                         page_len, full_logits: bool = False,
                          tp: int = 1, tp_axis=None):
     """``decode_forward_chunk`` through one page indirection: the pools are
     ``[L, n_pages, page_len, H, Dh]`` and each slot's KV lives in the
@@ -684,8 +727,13 @@ def decode_forward_paged(params, pool_k, pool_v, tokens, positions, valids,
     posm = jnp.minimum(positions[:, None] + jnp.arange(C, dtype=jnp.int32),
                        max_len - 1)  # [B, C]
     ptab = page_tables[slots]  # [B, max_pages] — each lane's page map
-    # physical (page, offset) of every position this chunk writes
+    # physical (page, offset) of every position this chunk writes;
+    # invalid chunk columns divert to the trash page (last pool row) so
+    # a clamped ``posm`` can never scatter garbage over a real lane's
+    # pages — speculative verify chunks run right up to the pool edge
     wpage = jnp.take_along_axis(ptab, posm // page_len, axis=1)  # [B, C]
+    wpage = jnp.where(jnp.arange(C, dtype=jnp.int32)[None, :]
+                      < valids[:, None], wpage, pool_k.shape[1] - 1)
     woff = posm % page_len
     # the window's page prefix, gathered per lane then flattened back to
     # the dense [B, W, H, Dh] the attention expressions expect
@@ -731,18 +779,15 @@ def decode_forward_paged(params, pool_k, pool_v, tokens, positions, valids,
             f2 = f2 + lp["bdown"]
         x = x + gather(f2)
     xn = ln(x, params["lnf_s"], params["lnf_b"])
-    last = jnp.maximum(valids - 1, 0)
-    xl = xn[jnp.arange(B), last]
-    head_logits = _dc_matmul(xl, params["out_w"])
-    if "out_b" in params:
-        head_logits = head_logits + params["out_b"]
-    head_logits = gather(head_logits)
-    next_tok = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
+    next_tok, head_logits = _decode_epilogue(xn, params, gather, positions,
+                                             valids, sample, full_logits)
     return next_tok, head_logits, positions + valids, pool_k, pool_v
 
 
 def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
-                         slots, *, cfg, window, tp: int = 1, tp_axis=None):
+                         slots, sample=None, *, cfg, window,
+                         full_logits: bool = False,
+                         tp: int = 1, tp_axis=None):
     """One decode/prefill chunk over the slot-pooled KV cache. Pure jax —
     the decode engine jits this per (batch, chunk, window) signature with
     the pools donated, so steady-state decode is one fixed executable.
@@ -824,9 +869,15 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
         k = k.reshape(B, C, H_loc, Dh)
         v = v.reshape(B, C, H_loc, Dh)
         # slot as a scatter dim: one compiled step serves every in-flight
-        # generation, wherever its pool row lives
-        pool_k = pool_k.at[li, slots[:, None], posm].set(k)
-        pool_v = pool_v.at[li, slots[:, None], posm].set(v)
+        # generation, wherever its pool row lives; invalid chunk columns
+        # divert to the trash row so a clamped posm can never scatter
+        # over a real lane's pool edge (speculative verify chunks land
+        # there with per-lane partial valids)
+        slot_w = jnp.where(jnp.arange(C, dtype=jnp.int32)[None, :]
+                           < valids[:, None], slots[:, None],
+                           pool_k.shape[1] - 1)
+        pool_k = pool_k.at[li, slot_w, posm].set(k)
+        pool_v = pool_v.at[li, slot_w, posm].set(v)
         # static window slice FIRST, then the slot gather — XLA moves
         # W*H*Dh rows per lane instead of max_len*H*Dh
         kw = pool_k[li, :, :window][slots]  # [B, W, H, Dh]
@@ -848,13 +899,8 @@ def decode_forward_chunk(params, pool_k, pool_v, tokens, positions, valids,
             f2 = f2 + lp["bdown"]
         x = x + gather(f2)
     xn = ln(x, params["lnf_s"], params["lnf_b"])
-    last = jnp.maximum(valids - 1, 0)
-    xl = xn[jnp.arange(B), last]  # [B, D] — each lane's last valid position
-    head_logits = _dc_matmul(xl, params["out_w"])
-    if "out_b" in params:
-        head_logits = head_logits + params["out_b"]
-    head_logits = gather(head_logits)
-    next_tok = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
+    next_tok, head_logits = _decode_epilogue(xn, params, gather, positions,
+                                             valids, sample, full_logits)
     return next_tok, head_logits, positions + valids, pool_k, pool_v
 
 

@@ -57,6 +57,14 @@ plane). Pieces, composable or used together via ``ServingServer``:
   engine; cache-aware slot-scheduler admission, typed
   ``KVPoolExhausted`` backpressure, ``pt_serving_kv_pages`` /
   ``pt_serving_prefix_*`` gauges.
+* ``sampling`` / ``SpecDecoder`` (sampling.py, spec.py, docs/design.md
+  §25) — the token-policy subsystem: per-lane temperature/top-k/top-p
+  sampling rides the ONE compiled decode step as runtime data (greedy
+  lanes stay bit-identical to argmax; sampled lanes are deterministic
+  per (request, seed) under any co-tenancy), and speculative decoding
+  verifies k draft proposals per lane in one batched target step with
+  exact-distribution rejection sampling
+  (``GenerationBatcher(spec=SpecDecoder(...))``).
 * ``errors`` (errors.py) — the typed error hierarchy + wire codes.
 
 Since PR 9 the whole stack is black-boxed (docs/design.md §19): faults,
@@ -103,6 +111,7 @@ from .quant import (QuantizationError, QuantizedDecodeEngine,  # noqa: F401
 from .server import ServingClient, ServingServer  # noqa: F401
 from .sharded import (ShardedDecodeEngine,  # noqa: F401
                       ShardedServingEngine, expected_collectives)
+from .spec import SpecDecoder  # noqa: F401
 from .stats import FleetStats, ServingStats  # noqa: F401
 
 __all__ = [
@@ -120,7 +129,7 @@ __all__ = [
     "ServingServer", "ServingStats", "ServingUnavailable",
     "ShardedDecodeEngine", "ShardedPagedDecodeEngine",
     "ShardedServingEngine", "ShuttingDown",
-    "SlotScheduler", "TenantQuotaExceeded", "TokenBucket",
+    "SlotScheduler", "SpecDecoder", "TenantQuotaExceeded", "TokenBucket",
     "TrafficProfile", "calibrate_error", "expected_collectives",
     "profile_export", "quantize_export",
 ]

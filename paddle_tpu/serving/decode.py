@@ -219,10 +219,14 @@ class DecodeEngine:
         self.trash_slot = self.max_slots
         self.pool_k, self.pool_v = self._alloc_pools()
         self._free: List[int] = list(range(self.max_slots))
-        self._cache: "OrderedDict[Tuple[int, int, int], _ChunkEntry]" = \
-            OrderedDict()
+        self._cache: "OrderedDict[Tuple[int, int, int, bool], _ChunkEntry]" \
+            = OrderedDict()
         self.cache_hits = 0
         self.cache_misses = 0
+        # cached all-greedy sample dicts per lane count: the identity
+        # policy every pre-sampling call site implicitly ran with —
+        # passing it keeps those paths bit-identical (sampling.py)
+        self._default_samples: Dict[int, Dict[str, np.ndarray]] = {}
 
     # -- placement hooks (serving/sharded.py overrides both) --
     def _device_put_params(self, host_params):
@@ -274,22 +278,36 @@ class DecodeEngine:
                 f"(max_len {self.max_len})")
         return round_up(length, self.kv_buckets)
 
+    def default_sample(self, lanes: int) -> Dict[str, np.ndarray]:
+        """The all-greedy sample dict for ``lanes`` lanes (cached)."""
+        s = self._default_samples.get(lanes)
+        if s is None:
+            from .sampling import greedy_sample
+
+            s = greedy_sample(lanes)
+            self._default_samples[lanes] = s
+        return s
+
     # -- compile cache --
-    def _make_chunk_fn(self, lanes: int, chunk: int, window: int):
-        """One fresh jit wrapper for a (lanes, chunk, window) signature
-        (eviction drops the executable). The sharded engine overrides
-        this with its shard_map-wrapped chunk (serving/sharded.py); the
-        LRU/counter machinery in ``_get_fn`` is shared."""
+    def _make_chunk_fn(self, lanes: int, chunk: int, window: int,
+                       full: bool = False):
+        """One fresh jit wrapper for a (lanes, chunk, window, full)
+        signature (eviction drops the executable). The sharded engine
+        overrides this with its shard_map-wrapped chunk
+        (serving/sharded.py); the LRU/counter machinery in ``_get_fn``
+        is shared. ``full=True`` compiles the speculative-verify variant
+        returning per-position logits ``[B, C, V]``."""
         import jax
 
         from ..models.transformer import decode_forward_chunk
 
         return jax.jit(functools.partial(decode_forward_chunk, cfg=self.cfg,
-                                         window=window),
+                                         window=window, full_logits=full),
                        donate_argnums=(1, 2))
 
-    def _get_fn(self, lanes: int, chunk: int, window: int) -> _ChunkEntry:
-        key = (lanes, chunk, window)
+    def _get_fn(self, lanes: int, chunk: int, window: int,
+                full: bool = False) -> _ChunkEntry:
+        key = (lanes, chunk, window, full)
         with self._lock:
             entry = self._cache.get(key)
             if entry is not None:
@@ -297,7 +315,7 @@ class DecodeEngine:
                 self._cache.move_to_end(key)
                 return entry
             self.cache_misses += 1
-        entry = _ChunkEntry(self._make_chunk_fn(lanes, chunk, window))
+        entry = _ChunkEntry(self._make_chunk_fn(lanes, chunk, window, full))
         with self._lock:
             entry = self._cache.setdefault(key, entry)
             while len(self._cache) > self.cache_capacity:
@@ -311,18 +329,26 @@ class DecodeEngine:
 
     # -- dispatch --
     def dispatch_chunk(self, tokens, positions, valids, slots,
-                       window: int):
+                       window: int, sample=None, full: bool = False):
         """One async device call of the chunk function over the CURRENT
         pool carry. Inputs may be numpy (a structural boundary rebuilt the
         lanes) or device arrays (the steady-state carry). Returns
         ``(next_tokens, logits, new_positions, version)`` — device arrays,
         NOT synced; the pools are replaced in place (donated).
+
+        ``sample`` is the per-lane policy pytree (serving/sampling.py);
+        ``None`` dispatches the cached all-greedy identity. ``full=True``
+        selects the speculative-verify variant whose logits output is
+        per-position ``[B, C, V]`` — a DIFFERENT compiled signature, so
+        speculative warmup must precompile it.
         """
         import jax
 
         tokens = jax.numpy.asarray(tokens, jax.numpy.int32)
         lanes, chunk = tokens.shape
-        entry = self._get_fn(lanes, chunk, window)
+        if sample is None:
+            sample = self.default_sample(lanes)
+        entry = self._get_fn(lanes, chunk, window, full)
         if self.chaos is not None:
             self.chaos.on_dispatch()
         with self._lock:
@@ -335,7 +361,7 @@ class DecodeEngine:
                 params, self.pool_k, self.pool_v, tokens,
                 jax.numpy.asarray(positions, jax.numpy.int32),
                 jax.numpy.asarray(valids, jax.numpy.int32),
-                jax.numpy.asarray(slots, jax.numpy.int32))
+                jax.numpy.asarray(slots, jax.numpy.int32), sample)
         if cold:
             entry.compile_s = time.monotonic() - t0
             entry.cold = False
@@ -349,12 +375,15 @@ class DecodeEngine:
                                                  "window": window})
         return next_tok, logits, new_pos, version
 
-    def prefill(self, slot: int, prompt: np.ndarray) -> Tuple[Any, Any, int]:
+    def prefill(self, slot: int, prompt: np.ndarray,
+                sample=None) -> Tuple[Any, Any, int]:
         """Write a prompt's K/V into ``slot`` and return its first
         generated token: ``(next_token [1] device, logits [1, V] device,
         version)``. The prompt runs as one bucketed chunk, or — when
         ``prefill_chunk`` > 0 — as a train of fixed-size chunks so a long
         prompt never stalls in-flight decode lanes for its whole length.
+        ``sample`` (a 1-lane policy dict) governs the FIRST generated
+        token; the final chunk's epilogue draws it.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = prompt.shape[0]
@@ -377,7 +406,7 @@ class DecodeEngine:
             out = self.dispatch_chunk(
                 buf, np.array([start], np.int32),
                 np.array([valid], np.int32),
-                np.array([slot], np.int32), window)
+                np.array([slot], np.int32), window, sample=sample)
             start += valid
         next_tok, logits, _new_pos, version = out
         return next_tok, logits, version
@@ -462,6 +491,59 @@ class SlotScheduler:
         # measured EMAs keyed by bucket (prefill) / window (step)
         self._prefill_ema: Dict[int, float] = {}
         self._step_ema: Dict[int, float] = {}
+        # speculative cost model: acceptance-rate EMA plus per-draft-step
+        # and per-verify-round cost EMAs — draft depth is priced against
+        # the inter-token-latency budget like everything else here
+        self._accept_ema: Optional[float] = None
+        self._draft_step_ema: Optional[float] = None
+        self._verify_ema: Optional[float] = None
+
+    # -- speculative cost model --
+    def observe_spec(self, accepted: int, proposed: int) -> None:
+        if proposed <= 0:
+            return
+        a = accepted / proposed
+        self._accept_ema = a if self._accept_ema is None \
+            else 0.8 * self._accept_ema + 0.2 * a
+
+    def observe_draft(self, steps: int, seconds: float) -> None:
+        if steps <= 0:
+            return
+        per = seconds / steps
+        self._draft_step_ema = per if self._draft_step_ema is None \
+            else 0.8 * self._draft_step_ema + 0.2 * per
+
+    def observe_verify(self, seconds: float) -> None:
+        self._verify_ema = seconds if self._verify_ema is None \
+            else 0.8 * self._verify_ema + 0.2 * seconds
+
+    @property
+    def spec_acceptance(self) -> Optional[float]:
+        return self._accept_ema
+
+    def plan_draft_depth(self, k_max: int) -> int:
+        """Draft depth for the next speculative round: maximize expected
+        committed tokens per second of round cost, subject to the round
+        fitting the inter-token-latency budget. With per-proposal
+        acceptance ``a``, a depth-k round commits
+        ``E(k) = 1 + a + ... + a^k`` tokens in expectation (every round
+        commits at least the residual/bonus token) and costs
+        ``k * draft_step + verify``."""
+        k_max = max(1, int(k_max))
+        a = 0.7 if self._accept_ema is None else self._accept_ema
+        draft_s = self._draft_step_ema or 1e-4
+        verify_s = self._verify_ema or self.step_cost(0)
+        best_k, best_rate = 1, 0.0
+        for k in range(1, k_max + 1):
+            expect = (k + 1) if a >= 1.0 else \
+                (1.0 - a ** (k + 1)) / (1.0 - a)
+            cost = k * draft_s + verify_s
+            if cost > self.itl_budget_s and k > 1:
+                break
+            rate = expect / max(cost, 1e-9)
+            if rate > best_rate:
+                best_k, best_rate = k, rate
+        return best_k
 
     def observe_prefill(self, bucket: int, seconds: float) -> None:
         old = self._prefill_ema.get(bucket)
@@ -519,9 +601,13 @@ class _Generation:
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "trace_id",
                  "future", "t_submit", "t_first_token", "t_last_token",
-                 "tokens", "slot", "version", "timings", "done", "peek")
+                 "tokens", "slot", "version", "timings", "done", "peek",
+                 "temperature", "top_k", "top_p", "seed", "want_logprobs",
+                 "logprobs", "base_key")
 
-    def __init__(self, prompt, max_new_tokens, eos_id, deadline, trace_id):
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline, trace_id,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                 logprobs=False):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -537,18 +623,36 @@ class _Generation:
         self.timings: Dict[str, float] = {}
         self.done = False
         self.peek = None  # memoized (prefix_epoch, hit_tokens)
+        # token policy (sampling.py): temp 0 = the greedy bit-identical
+        # path; a sampled lane's stream is keyed by (seed, token index)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = 0 if seed is None else int(seed)
+        self.want_logprobs = bool(logprobs)
+        self.logprobs: List[float] = []
+        self.base_key = None  # u32[2], built lazily at admission
+
+    @property
+    def sampled(self) -> bool:
+        return self.temperature > 0.0
 
 
 class GenerationResult:
     """What a generation future resolves with."""
 
-    __slots__ = ("tokens", "ttft_s", "weights_version", "finish_reason")
+    __slots__ = ("tokens", "ttft_s", "weights_version", "finish_reason",
+                 "logprobs")
 
-    def __init__(self, tokens, ttft_s, weights_version, finish_reason):
+    def __init__(self, tokens, ttft_s, weights_version, finish_reason,
+                 logprobs=None):
         self.tokens = tokens
         self.ttft_s = ttft_s
         self.weights_version = weights_version
-        self.finish_reason = finish_reason  # "eos" | "length"
+        # "eos" | "budget" (max_new_tokens spent) | "pool-edge" (the KV
+        # rows ran out) | "deadline" (mid-generation shed, partial)
+        self.finish_reason = finish_reason
+        self.logprobs = logprobs  # per-token model logprobs, if requested
 
 
 class GenerationBatcher:
@@ -573,11 +677,20 @@ class GenerationBatcher:
                  scheduler: Optional[SlotScheduler] = None,
                  pipeline_depth: int = 2,
                  default_max_new_tokens: int = 64,
+                 spec=None,
                  start: bool = True):
         self.engine = engine
         self.queue_capacity = int(queue_capacity)
         self.stats = stats
         self.scheduler = scheduler or SlotScheduler()
+        # speculative decoder (serving/spec.py): when armed, each token
+        # boundary runs one synchronous draft/verify/accept ROUND instead
+        # of one pipelined step — rounds commit 1..k+1 tokens per lane,
+        # so the depth-2 carry does not apply (the round is its own sync)
+        self.spec = spec
+        if spec is not None:
+            spec.bind(engine, self.scheduler, stats)
+            pipeline_depth = 1
         # depth 2 = enqueue step k+1 on step k's device carries before
         # syncing step k; deeper would let the host's window estimate lag
         # behind the true positions (see _max_pos), so the knob is 1 or 2
@@ -617,7 +730,10 @@ class GenerationBatcher:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None,
+               logprobs: bool = False) -> Future:
         t0 = time.monotonic()
         if self._closed:
             raise ShuttingDown("generation batcher closed")
@@ -629,11 +745,16 @@ class GenerationBatcher:
         if prompt.shape[0] < 1:
             raise ValueError("empty prompt")  # terminal, not retryable
         self.engine.prompt_bucket(prompt.shape[0])  # length guard, raises
+        from .sampling import validate_policy
+
+        validate_policy(float(temperature), int(top_k), float(top_p))
         mnt = int(self.default_max_new_tokens if max_new_tokens is None
                   else max_new_tokens)
         if mnt < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        gen = _Generation(prompt, mnt, eos_id, deadline, trace_id)
+        gen = _Generation(prompt, mnt, eos_id, deadline, trace_id,
+                          temperature=temperature, top_k=top_k, top_p=top_p,
+                          seed=seed, logprobs=logprobs)
         with self._close_lock:
             if self._closed:
                 raise ShuttingDown("generation batcher closed")
@@ -650,6 +771,8 @@ class GenerationBatcher:
                                      self.queue_capacity) from None
         if self.stats:
             self.stats.record_submit()
+            if gen.sampled:
+                self.stats.record_sampled_request()
         gen.future.request = gen
         return gen.future
 
@@ -748,7 +871,9 @@ class GenerationBatcher:
         ttft = (gen.t_first_token - gen.t_submit
                 if gen.t_first_token else total)
         if self._resolve(gen, result=GenerationResult(
-                list(gen.tokens), ttft, gen.version, reason)):
+                list(gen.tokens), ttft, gen.version, reason,
+                logprobs=list(gen.logprobs) if gen.want_logprobs
+                else None)):
             if self.stats:
                 self.stats.record_done(total)
         if self.accountant.enabled:
@@ -792,6 +917,15 @@ class GenerationBatcher:
         # submit -> admission start is the generation's queue_wait (the
         # accountant's serving taxonomy; deferred prompts wait longer)
         gen.timings["queue_wait"] = t0 - gen.t_submit
+        sample1 = None
+        if gen.sampled:
+            from .sampling import base_key, greedy_sample, lane_policy
+
+            if gen.base_key is None:
+                gen.base_key = base_key(gen.seed)
+            sample1 = greedy_sample(1)
+            lane_policy(sample1, 0, gen.temperature, gen.top_k, gen.top_p,
+                        gen.base_key, gen.prompt.shape[0])
         slot = self.engine.alloc_slot()
         try:
             if getattr(self.engine, "supports_page_reservation", False):
@@ -800,10 +934,11 @@ class GenerationBatcher:
                 # of failing an in-flight batch at a later boundary
                 tok_dev, _logits, version = self.engine.prefill(
                     slot, gen.prompt,
-                    reserve_new_tokens=gen.max_new_tokens)
+                    reserve_new_tokens=gen.max_new_tokens,
+                    sample=sample1)
             else:
                 tok_dev, _logits, version = self.engine.prefill(
-                    slot, gen.prompt)
+                    slot, gen.prompt, sample=sample1)
             first = int(np.asarray(tok_dev)[0])  # host sync: TTFT token
         except Exception as e:
             self.engine.free_slot(slot)
@@ -824,6 +959,10 @@ class GenerationBatcher:
         gen.slot = slot
         gen.version = version
         gen.tokens.append(first)
+        if gen.want_logprobs:
+            from .sampling import logprob_of
+
+            gen.logprobs.append(logprob_of(np.asarray(_logits)[0], first))
         gen.t_first_token = gen.t_last_token = time.monotonic()
         gen.timings["prefill"] = dt
         hit = int(getattr(self.engine, "last_prefix_hit", 0))
@@ -848,13 +987,18 @@ class GenerationBatcher:
             self.engine.free_slot(slot)
             self._finish(gen, "eos")
             return True
-        if len(gen.tokens) >= gen.max_new_tokens or \
-                gen.prompt.shape[0] + len(gen.tokens) >= self.engine.max_len:
+        if len(gen.tokens) >= gen.max_new_tokens:
             self.engine.free_slot(slot)
-            self._finish(gen, "length")
+            self._finish(gen, "budget")
+            return True
+        if gen.prompt.shape[0] + len(gen.tokens) >= self.engine.max_len:
+            self.engine.free_slot(slot)
+            self._finish(gen, "pool-edge")
             return True
         lane = self._lanes.index(None)
         self._lanes[lane] = gen
+        if self.spec is not None:
+            self.spec.admit(slot, gen.prompt, first)
         return True
 
     def _lane_arrays(self):
@@ -871,7 +1015,26 @@ class GenerationBatcher:
             pos[i] = g.prompt.shape[0] + len(g.tokens) - 1
             val[i] = 1
             slots[i] = g.slot
-        return toks, pos, val, slots
+        return toks, pos, val, slots, self._sample_arrays()
+
+    def _sample_arrays(self):
+        """Per-lane policy vectors for the current lane set, or ``None``
+        when every lane is greedy (the engine's cached identity dict then
+        rides instead — bit-identical, and no per-boundary rebuild)."""
+        if not any(g is not None and (g.sampled or g.base_key is not None)
+                   for g in self._lanes):
+            return None
+        from .sampling import base_key, greedy_sample, lane_policy
+
+        sample = greedy_sample(self.engine.max_slots)
+        for i, g in enumerate(self._lanes):
+            if g is None or not g.sampled:
+                continue
+            if g.base_key is None:
+                g.base_key = base_key(g.seed)
+            lane_policy(sample, i, g.temperature, g.top_k, g.top_p,
+                        g.base_key, g.prompt.shape[0])
+        return sample
 
     def _max_pos(self) -> int:
         m = 1
@@ -886,31 +1049,43 @@ class GenerationBatcher:
         now = time.monotonic()
         if self.stats:
             self.stats.record_decode_tokens(1)
+            if gen.sampled:
+                self.stats.record_sampled_tokens(1)
             if gen.t_last_token is not None:
                 self.stats.record_itl(now - gen.t_last_token)
         gen.t_last_token = now
         if gen.eos_id is not None and tok == gen.eos_id:
             self._finish(gen, "eos")
             return True
-        if len(gen.tokens) >= gen.max_new_tokens or \
-                gen.prompt.shape[0] + len(gen.tokens) >= self.engine.max_len:
-            # budget spent, or the next token's pool position would fall
-            # off the end of the KV rows
-            self._finish(gen, "length")
+        if len(gen.tokens) >= gen.max_new_tokens:
+            self._finish(gen, "budget")  # max_new_tokens spent
+            return True
+        if gen.prompt.shape[0] + len(gen.tokens) >= self.engine.max_len:
+            # the next token's pool position would fall off the KV rows
+            self._finish(gen, "pool-edge")
             return True
         return False
 
     def _shed_expired_lanes(self) -> bool:
         """Deadline shed at the token boundary — mid-generation, as PR 2
-        sheds at coalesce time. Returns True on structural change."""
+        sheds at coalesce time. A lane shed here has already produced
+        real tokens, so its future resolves with a PARTIAL
+        ``GenerationResult`` (``finish_reason="deadline"``) instead of a
+        ``DeadlineExceeded`` — the caller keeps what the deadline paid
+        for. Queued/at-submit sheds still raise typed (no tokens exist
+        to return). Returns True on structural change."""
         changed = False
         now = time.monotonic()
         for i, g in enumerate(self._lanes):
             if g is None or g.deadline is None or now < g.deadline:
                 continue
             g.done = True
-            if self._resolve(g, exc=DeadlineExceeded(now - g.deadline,
-                                                     "mid-generation")):
+            ttft = (g.t_first_token - g.t_submit
+                    if g.t_first_token else now - g.t_submit)
+            partial = GenerationResult(
+                list(g.tokens), ttft, g.version, "deadline",
+                logprobs=list(g.logprobs) if g.want_logprobs else None)
+            if self._resolve(g, result=partial):
                 if self.stats:
                     self.stats.record_deadline()
                 if self.accountant.enabled:
@@ -929,7 +1104,7 @@ class GenerationBatcher:
         """Host-sync one in-flight step and retire its finishers. The lanes
         snapshot taken at dispatch names who each row belonged to (a lane
         may have been shed since). Returns True on structural change."""
-        tok_dev, version, lanes_snap, t_disp, window = item
+        tok_dev, lg_dev, version, lanes_snap, t_disp, window = item
         try:
             toks = np.asarray(tok_dev)
         except Exception as e:
@@ -960,10 +1135,18 @@ class GenerationBatcher:
         self.scheduler.observe_step(window, dt)
         if self.stats:
             self.stats.record_stage("decode_step", dt)
+        lg = None
+        if lg_dev is not None and any(
+                g is not None and g.want_logprobs for g in lanes_snap):
+            lg = np.asarray(lg_dev)
         changed = False
         for i, g in enumerate(lanes_snap):
             if g is None or g.done or self._lanes[i] is not g:
                 continue
+            if g.want_logprobs and lg is not None:
+                from .sampling import logprob_of
+
+                g.logprobs.append(logprob_of(lg[i], int(toks[i])))
             if self._retire_or_continue(g, int(toks[i])):
                 self.engine.free_slot(g.slot)
                 self._lanes[i] = None
@@ -975,6 +1158,49 @@ class GenerationBatcher:
         while self._inflight:
             changed |= self._sync_boundary(self._inflight.popleft())
         return changed
+
+    def _spec_round(self) -> None:
+        """One speculative round: the draft proposes, the target verifies
+        in one batched chunk, rejection sampling commits 1..k+1 tokens per
+        lane through the normal retirement path (eos/budget/pool-edge mid-
+        round drop the tail — exactly where vanilla decode would have
+        stopped)."""
+        lanes_snap = list(self._lanes)
+        try:
+            out = self.spec.round(lanes_snap)
+        except Exception as e:
+            err = e if isinstance(e, ServingUnavailable) else \
+                ServingUnavailable(f"speculative round failed: {e}")
+            ev = get_event_log()
+            if ev.enabled:
+                ev.emit("decode_step_failed", severity="error",
+                        where="spec_round", lanes=self.active,
+                        error=f"{type(e).__name__}: {e}"[:200])
+            for i, g in enumerate(self._lanes):
+                if g is None:
+                    continue
+                g.done = True
+                if self._resolve(g, exc=err):
+                    if self.stats:
+                        self.stats.record_failure()
+                self.engine.free_slot(g.slot)
+                self._lanes[i] = None
+            return
+        for i, g in enumerate(lanes_snap):
+            if g is None or g.done or self._lanes[i] is not g:
+                continue
+            committed, logit_rows = out[i]
+            for tok, row in zip(committed, logit_rows):
+                if g.want_logprobs:
+                    from .sampling import logprob_of
+
+                    g.logprobs.append(logprob_of(row, int(tok)))
+                if self._retire_or_continue(g, int(tok)):
+                    self.engine.free_slot(g.slot)
+                    self._lanes[i] = None
+                    break
+        if self.stats:
+            self.stats.set_decode_slots(self.active, self.engine.max_slots)
 
     def _reap_finished_lanes(self) -> bool:
         """Drop lanes whose future resolved out-of-band (abort close, a
@@ -1103,25 +1329,35 @@ class GenerationBatcher:
                         except queue.Empty:
                             pass
                     continue
+                if self.spec is not None:
+                    # speculative mode: one synchronous draft/verify/
+                    # accept round per boundary (its own host sync — no
+                    # carry, no inflight depth)
+                    self._spec_round()
+                    continue
                 if changed or self._carry is None:
                     if self._drain_inflight():
                         # a late retirement landed during the flush; let
                         # the next iteration re-run the boundary
                         self._carry = None
                         continue
-                    toks, pos, val, slots = self._lane_arrays()
+                    toks, pos, val, slots, sample = self._lane_arrays()
                     self._slots_arr = slots
                     self._valids_arr = val
+                    self._sample_arr = sample
                 else:
                     toks, pos = self._carry
                     slots, val = self._slots_arr, self._valids_arr
+                    sample = self._sample_arr
                 window = self.engine.window_bucket(self._max_pos())
                 t_disp = time.monotonic()
                 lanes_snap = list(self._lanes)
+                want_lg = any(g is not None and g.want_logprobs
+                              for g in lanes_snap)
                 try:
-                    tok_dev, _lg, pos_dev, version = \
+                    tok_dev, lg_dev, pos_dev, version = \
                         self.engine.dispatch_chunk(toks, pos, val, slots,
-                                                   window)
+                                                   window, sample=sample)
                 except Exception as e:
                     err = e if isinstance(e, ServingUnavailable) else \
                         ServingUnavailable(f"decode dispatch failed: {e}")
@@ -1144,7 +1380,8 @@ class GenerationBatcher:
                     continue
                 self._carry = (tok_dev.reshape(-1, 1), pos_dev)
                 self._inflight.append(
-                    (tok_dev, version, lanes_snap, t_disp, window))
+                    (tok_dev, lg_dev if want_lg else None, version,
+                     lanes_snap, t_disp, window))
                 if self.stats:
                     self.stats.set_decode_slots(self.active,
                                                 self.engine.max_slots)

@@ -138,6 +138,10 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
         # account (or saw nothing in the window) — absence of accounting
         # must read as neutral, not as a fully-badput replica.
         "goodput_ratio": g.get("pt_goodput_ratio", 1.0),
+        # speculative decoding (docs §25): lifetime draft-acceptance
+        # rate. -1.0 is the not-speculating sentinel (the CLI renders
+        # "-"); a real rate is always in [0, 1].
+        "spec_acceptance": g.get("pt_serving_spec_acceptance_rate", -1.0),
     }
 
 
@@ -828,12 +832,17 @@ class FleetRouter:
     def generate(self, tokens, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None, tenant: Optional[str] = None,
                  timeout_ms: Optional[float] = None, trace=False,
-                 session: Optional[str] = None) -> Dict[str, Any]:
+                 session: Optional[str] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 seed: Optional[int] = None,
+                 logprobs: bool = False) -> Dict[str, Any]:
         """Route one generation. The generation is PINNED to its replica
         (never hedged — a duplicate in-flight generation would hold two
         KV slots for one answer); on replica death it is retried from
         scratch elsewhere under the remaining deadline, or answers with
-        a typed error."""
+        a typed error. Sampling params ride the wire unchanged — a
+        retried-elsewhere sampled generation reproduces the SAME stream
+        (per-(request, seed) determinism is replica-independent)."""
         t_id = trace if isinstance(trace, str) else (
             new_trace_id() if trace else None)
         t0 = time.monotonic()
@@ -841,6 +850,16 @@ class FleetRouter:
         self.stats.record_submit()
         payload = {"tokens": tokens, "max_new_tokens": max_new_tokens,
                    "eos_id": eos_id}
+        if temperature:
+            payload["temperature"] = float(temperature)
+        if top_k:
+            payload["top_k"] = int(top_k)
+        if top_p != 1.0:
+            payload["top_p"] = float(top_p)
+        if seed is not None:
+            payload["seed"] = int(seed)
+        if logprobs:
+            payload["logprobs"] = True
         with get_tracer().span("fleet/route", trace_id=t_id,
                                op="generate", tenant=tenant or "default"):
             self._admit(tenant)

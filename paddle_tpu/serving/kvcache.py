@@ -477,7 +477,8 @@ class _PagedKVMixin:
         self._release_slot(slot)
 
     # -- compiled step: the paged chunk fn --
-    def _make_chunk_fn(self, lanes: int, chunk: int, window: int):
+    def _make_chunk_fn(self, lanes: int, chunk: int, window: int,
+                       full: bool = False):
         import functools
 
         import jax
@@ -489,10 +490,12 @@ class _PagedKVMixin:
         if mesh is None:
             return jax.jit(functools.partial(
                 decode_forward_paged, cfg=self.cfg, window=window,
-                page_len=self.page_len), donate_argnums=(1, 2))
+                page_len=self.page_len, full_logits=full),
+                donate_argnums=(1, 2))
         # sharded: pools hold each rank's head subset (axis 3 of the
         # paged shape, exactly like the dense pool's _pool_spec); params
-        # are column shards; the page table replicates
+        # are column shards; the page table AND the per-lane sample
+        # policy vectors replicate
         from jax.sharding import PartitionSpec as P
 
         from ..parallel._compat import shard_map
@@ -501,18 +504,30 @@ class _PagedKVMixin:
             specs = self._param_specs_pytree(self._params)
         body = functools.partial(decode_forward_paged, cfg=self.cfg,
                                  window=window, page_len=self.page_len,
+                                 full_logits=full,
                                  tp=tp, tp_axis="tp" if tp > 1 else None)
         pool = self._pool_spec()
+        samp = {"temp": P(), "topk": P(), "topp": P(), "key": P(),
+                "plen": P()}
         fn = shard_map(
-            lambda p, pk, pv, tok, pos, val, slot, tab:
-                body(p, pk, pv, tok, pos, val, slot, tab),
+            lambda p, pk, pv, tok, pos, val, slot, tab, smp:
+                body(p, pk, pv, tok, pos, val, slot, tab, smp),
             mesh=mesh,
-            in_specs=(specs, pool, pool, P(), P(), P(), P(), P()),
+            in_specs=(specs, pool, pool, P(), P(), P(), P(), P(), samp),
             out_specs=(P(), P(), P(), pool, pool), check_vma=False)
         return jax.jit(fn, donate_argnums=(1, 2))
 
+    def sync_frontier(self, slot: int, pos: int) -> None:
+        """Rewind a slot's write frontier to ``pos`` (the next position a
+        chunk will write). The speculative decoder calls this after each
+        round: a verify chunk writes k+1 positions but only 1..k+1 of
+        them commit, so without the rewind the host frontier would creep
+        past the real sequence and lazily map pages the reservation
+        never accounted for."""
+        self._frontier[slot] = int(pos)
+
     def dispatch_chunk(self, tokens, positions, valids, slots,
-                       window: int):
+                       window: int, sample=None, full: bool = False):
         """The dense dispatch plus page backing: before the device call,
         every valid lane's write span gets pages (lazy allocation — the
         per-slot frontier is the host's mirror of ``positions``, which
@@ -544,7 +559,9 @@ class _PagedKVMixin:
             # padding never costs pages
             self._ensure_slot_pages(s, self._frontier[s] + v)
             self._frontier[s] += v
-        entry = self._get_fn(lanes, chunk, window)
+        if sample is None:
+            sample = self.default_sample(lanes)
+        entry = self._get_fn(lanes, chunk, window, full)
         if self.chaos is not None:
             self.chaos.on_dispatch()
         with self._lock:
@@ -560,7 +577,8 @@ class _PagedKVMixin:
                 params, self.pool_k, self.pool_v, tokens,
                 jax.numpy.asarray(positions, jax.numpy.int32),
                 jax.numpy.asarray(valids_np),
-                jax.numpy.asarray(slots_np), self._page_table.copy())
+                jax.numpy.asarray(slots_np), self._page_table.copy(),
+                sample)
         if cold:
             entry.compile_s = time.monotonic() - t0
             entry.cold = False
@@ -604,8 +622,8 @@ class _PagedKVMixin:
 
     def prefill(self, slot: int, prompt: np.ndarray,
                 use_cache: bool = True,
-                reserve_new_tokens: Optional[int] = None
-                ) -> Tuple[Any, Any, int]:
+                reserve_new_tokens: Optional[int] = None,
+                sample=None) -> Tuple[Any, Any, int]:
         """Prefix-aware prefill: the longest cached full-page chain maps
         straight into the slot's page table (acquired, never copied) and
         only the suffix runs device chunks — TTFT and prefill FLOPs drop
@@ -683,7 +701,7 @@ class _PagedKVMixin:
             out = self.dispatch_chunk(
                 buf, np.array([start], np.int32),
                 np.array([valid], np.int32),
-                np.array([slot], np.int32), window)
+                np.array([slot], np.int32), window, sample=sample)
             start += valid
         next_tok, logits, _new_pos, version = out
         if use_cache and self.prefix_cache is not None \
@@ -793,7 +811,8 @@ class ShardedPagedDecodeEngine(_PagedKVMixin, ShardedDecodeEngine):
         txt = entry.fn.lower(
             params, self.pool_k, self.pool_v,
             jax.numpy.asarray(toks), zeros, zeros, slots,
-            jax.numpy.asarray(self._page_table)).compile().as_text()
+            jax.numpy.asarray(self._page_table),
+            self.default_sample(self.max_slots)).compile().as_text()
         return count_hlo_collectives(txt)
 
 

@@ -1,0 +1,216 @@
+"""Token-policy subsystem: per-lane sampling parameters as *runtime*
+inputs to the one compiled decode step.
+
+The decode stack (serving/decode.py) compiles one chunk function per
+``(lanes, chunk, window)`` signature and reuses it forever — zero
+steady-state recompiles is a hard contract. Sampling must therefore ride
+as *data*, never as shape or as a Python branch inside the traced
+function. This module defines that data plane:
+
+* **Sample dict** — five device-resident per-lane vectors that travel as
+  one extra pytree argument of the chunk call::
+
+      temp  f32[B]   temperature; 0.0 = greedy (argmax) lane
+      topk  i32[B]   top-k cutoff; 0 = disabled
+      topp  f32[B]   top-p (nucleus) threshold; 1.0 = disabled
+      key   u32[B,2] per-request base PRNG key (seed-derived, threefry)
+      plen  i32[B]   prompt length (turns positions into a token counter)
+
+  Every lane always has a row; inactive/greedy lanes carry the identity
+  policy (temp 0), and the fused epilogue selects
+  ``where(temp > 0, sampled, argmax)`` so greedy lanes are BIT-identical
+  to the historical argmax path — same executable, same math, the
+  sampling branch's result simply unselected.
+
+* **Fused mask→renormalize→categorical epilogue**
+  (:func:`sample_tokens`) — one sort per lane builds both the top-k
+  prefix mask and the nucleus cutoff; the categorical draw keys off
+  ``fold_in(base_key, token_index)`` where ``token_index`` is recovered
+  in-kernel as ``positions + valids - plen``. The stream a lane samples
+  is therefore a pure function of (request seed, token index): admission
+  order, slot number, co-tenant mix and pipeline depth cannot perturb
+  it.
+
+* **Host mirrors** — the speculative decoder (serving/spec.py) runs its
+  accept/reject arithmetic on the host against synced logits. It needs
+  the *same policy distribution* applied to both draft and target
+  logits; :func:`policy_probs` is that shared definition (float64).
+  Host-side draws use counter-based Philox streams keyed by
+  ``(seed, token index, domain)`` (:func:`host_rng`) so they too are
+  deterministic per (request, seed) and independent of batching history.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+# Philox domain separators for the host-side speculative streams: the
+# draft proposal draw, the accept/reject uniform, the residual draw on
+# rejection, and the bonus draw after a fully-accepted window.
+DOMAIN_DRAFT = 1
+DOMAIN_ACCEPT = 2
+DOMAIN_RESIDUAL = 3
+DOMAIN_BONUS = 4
+
+_MASK64 = (1 << 64) - 1
+
+
+def base_key(seed: int) -> np.ndarray:
+    """Seed -> legacy threefry key ``uint32[2]`` (host numpy). One per
+    request; the kernel folds the token index in per draw."""
+    import jax
+
+    return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+
+
+def greedy_sample(lanes: int) -> Dict[str, np.ndarray]:
+    """The identity policy for ``lanes`` lanes: every row greedy. This is
+    what every pre-sampling call site implicitly dispatched with — the
+    epilogue reduces to argmax bit-exactly on these rows."""
+    return {
+        "temp": np.zeros(lanes, np.float32),
+        "topk": np.zeros(lanes, np.int32),
+        "topp": np.ones(lanes, np.float32),
+        "key": np.zeros((lanes, 2), np.uint32),
+        "plen": np.zeros(lanes, np.int32),
+    }
+
+
+def lane_policy(sample: Dict[str, np.ndarray], lane: int,
+                temperature: float, top_k: int, top_p: float,
+                key: Optional[np.ndarray], prompt_len: int) -> None:
+    """Write one lane's policy row into a sample dict in place."""
+    sample["temp"][lane] = np.float32(temperature)
+    sample["topk"][lane] = np.int32(top_k)
+    sample["topp"][lane] = np.float32(top_p)
+    if key is not None:
+        sample["key"][lane] = key
+    sample["plen"][lane] = np.int32(prompt_len)
+
+
+def validate_policy(temperature: float, top_k: int, top_p: float) -> None:
+    """Shared request-surface validation (batcher submit + server wire)."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+# ---------------------------------------------------------------------------
+# Device-side fused epilogue (traced inside the chunk forward)
+# ---------------------------------------------------------------------------
+
+
+def sample_tokens(head_logits, sample, positions, valids):
+    """The fused sampling epilogue, traced inside the compiled chunk.
+
+    ``head_logits``: ``[B, V]`` last-valid-position logits. Returns
+    ``int32[B]`` next tokens. One descending sort per lane serves both
+    the top-k prefix mask and the top-p cumulative cutoff; masking is by
+    *value* (``z >= cutoff``), so ties at the boundary stay in the
+    support — deterministic, and identical to the host mirror
+    :func:`policy_probs` which uses the same rule.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(head_logits, axis=-1).astype(jnp.int32)
+    temp = sample["temp"]
+    t_safe = jnp.where(temp > 0.0, temp, 1.0)
+    z = head_logits / t_safe[:, None]
+    V = head_logits.shape[-1]
+
+    def mask_one(zl, k, p):
+        sz = -jnp.sort(-zl)  # descending values
+        idx = jnp.arange(V, dtype=jnp.int32)
+        k_eff = jnp.where(k > 0, jnp.minimum(k, V), V)
+        kmask = idx < k_eff
+        zs = jnp.where(kmask, sz, -jnp.inf)
+        probs = jax.nn.softmax(zs)
+        cum = jnp.cumsum(probs)
+        # nucleus rule: keep while the mass BEFORE this token is < p
+        # (the first token is always kept)
+        keep = ((cum - probs) < p) & kmask
+        n_keep = jnp.maximum(jnp.sum(keep.astype(jnp.int32)), 1)
+        cutoff = sz[n_keep - 1]
+        return zl >= cutoff
+
+    mask = jax.vmap(mask_one)(z, sample["topk"], sample["topp"])
+    masked = jnp.where(mask, z, -jnp.inf)
+    # token counter: positions+valids is the next write frontier, minus
+    # the prompt length = index of the token being generated (0-based)
+    ctr = positions + valids - sample["plen"]
+    keys = jax.vmap(jax.random.fold_in)(sample["key"], ctr)
+    drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temp > 0.0, drawn, greedy)
+
+
+# ---------------------------------------------------------------------------
+# Host mirrors (speculative accept/reject + logprobs)
+# ---------------------------------------------------------------------------
+
+
+def policy_probs(logits: np.ndarray, temperature: float, top_k: int,
+                 top_p: float) -> np.ndarray:
+    """The policy distribution over one ``[V]`` logit row, float64.
+
+    This is the single definition of "the distribution a lane samples
+    from" that the speculative decoder applies to BOTH draft and target
+    logits — rejection sampling is exact with respect to whatever q and
+    p say, so they must say it through the same function.
+    Temperature 0 degenerates to a one-hot on the argmax.
+    """
+    z = np.asarray(logits, np.float64)
+    V = z.shape[-1]
+    if temperature <= 0.0:
+        out = np.zeros(V, np.float64)
+        out[int(np.argmax(z))] = 1.0
+        return out
+    z = z / float(temperature)
+    sz = np.sort(z)[::-1]
+    k_eff = V if top_k <= 0 else min(int(top_k), V)
+    zs = np.where(np.arange(V) < k_eff, sz, -np.inf)
+    zs_max = zs[0]
+    probs = np.exp(zs - zs_max)
+    probs = probs / probs.sum()
+    cum = np.cumsum(probs)
+    keep = ((cum - probs) < top_p) & (np.arange(V) < k_eff)
+    n_keep = max(1, int(keep.sum()))
+    cutoff = sz[n_keep - 1]
+    mask = z >= cutoff
+    out = np.where(mask, np.exp(z - z[mask].max()), 0.0)
+    return out / out.sum()
+
+
+def host_rng(seed: int, token_index: int, domain: int) -> np.random.Generator:
+    """Counter-based Philox stream keyed by (seed, token index, domain):
+    the draw at a given key is the same no matter what round structure,
+    co-tenants, or acceptance history preceded it."""
+    # seed rides the 128-bit Philox key; (token_index, domain) pick a
+    # 256-bit counter block with 2**64 of room each, so streams for
+    # different tokens/domains can never collide however many values
+    # either one consumes
+    ctr = ((int(token_index) & _MASK64) << 96) \
+        | ((int(domain) & _MASK64) << 64)
+    return np.random.Generator(
+        np.random.Philox(key=int(seed) & _MASK64, counter=ctr))
+
+
+def draw_from(probs: np.ndarray, rng: np.random.Generator) -> int:
+    """One inverse-CDF draw from a host distribution."""
+    u = rng.random()
+    cum = np.cumsum(probs)
+    return int(min(np.searchsorted(cum, u, side="right"),
+                   probs.shape[0] - 1))
+
+
+def logprob_of(logits: np.ndarray, token: int) -> float:
+    """Raw-model logprob of ``token`` under one ``[V]`` logit row (the
+    wire logprob surface reports MODEL logprobs, not policy-renormalized
+    ones — the policy is the caller's filter, not the model's belief)."""
+    z = np.asarray(logits, np.float64)
+    m = z.max()
+    return float(z[int(token)] - m - np.log(np.exp(z - m).sum()))

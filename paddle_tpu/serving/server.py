@@ -255,7 +255,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 tokens,
                 max_new_tokens=params.get("max_new_tokens"),
                 eos_id=params.get("eos_id"),
-                deadline=deadline, trace_id=trace_id)
+                deadline=deadline, trace_id=trace_id,
+                temperature=float(params.get("temperature", 0.0)),
+                top_k=int(params.get("top_k", 0)),
+                top_p=float(params.get("top_p", 1.0)),
+                seed=params.get("seed"),
+                logprobs=bool(params.get("logprobs", False)))
             res = fut.result(timeout=wait)
         except ServingError as e:
             return {"error": error_info(e)}
@@ -283,6 +288,8 @@ class _Handler(socketserver.StreamRequestHandler):
             "finish_reason": res.finish_reason,
             "weights_version": res.weights_version,
         }
+        if res.logprobs is not None:
+            result["logprobs"] = [float(x) for x in res.logprobs]
         if trace_id is not None:
             result["trace"] = {"trace_id": trace_id}
         return {"result": result}
@@ -479,6 +486,17 @@ class ServingServer(socketserver.ThreadingTCPServer):
                     else:
                         self.decode_engine = DecodeEngine(decode_dir,
                                                           **dknobs)
+                # speculative decoding (docs/design.md §25): "spec_draft"
+                # names the draft export dir, "spec_k" the propose depth
+                spec = None
+                spec_draft = dcfg.pop("spec_draft", None)
+                spec_k = dcfg.pop("spec_k", 4)
+                spec_adaptive = dcfg.pop("spec_adaptive", True)
+                if spec_draft:
+                    from .spec import SpecDecoder
+
+                    spec = SpecDecoder(spec_draft, k=int(spec_k),
+                                       adaptive=bool(spec_adaptive))
                 self.gen_batcher = GenerationBatcher(
                     self.decode_engine,
                     queue_capacity=dcfg.pop("gen_queue_capacity",
@@ -489,6 +507,7 @@ class ServingServer(socketserver.ThreadingTCPServer):
                                             pipeline_depth),
                     default_max_new_tokens=dcfg.pop(
                         "default_max_new_tokens", 64),
+                    spec=spec,
                     start=start_batcher)
                 if dcfg:
                     raise ValueError(f"unknown decode knobs {sorted(dcfg)}")
@@ -655,6 +674,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 self.engine.warmup()
                 if self.decode_engine is not None:
                     self.decode_engine.warmup()
+                if (self.gen_batcher is not None
+                        and self.gen_batcher.spec is not None):
+                    self.gen_batcher.spec.warmup()
             # chaos hooks attach AFTER warmup: the ladder pre-compile is
             # deployment plumbing, not traffic the harness should fault
             self.chaos = chaos
@@ -1091,18 +1113,35 @@ class ServingClient:
     def generate(self, tokens, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  timeout_ms: Optional[float] = None,
-                 trace=False, attempt: int = 0) -> Dict[str, Any]:
+                 trace=False, attempt: int = 0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None,
+                 logprobs: bool = False) -> Dict[str, Any]:
         """Autoregressive generation on a decode-enabled server. Returns
         ``{"tokens": [...], "ttft_ms": float, "finish_reason":
-        "eos"|"length", "weights_version": int}``. Same deadline/retry
-        semantics as ``predict`` (a failed generation is retryable: no
-        state outlives the request's KV slot)."""
+        "eos"|"budget"|"pool-edge"|"deadline", "weights_version": int}``
+        (plus ``"logprobs"`` when requested). ``temperature=0`` is greedy
+        (bit-identical to the argmax path); ``temperature>0`` samples
+        under the per-request top-k/top-p policy, deterministic per
+        ``(tokens, seed)`` whatever else the server is running. Same
+        deadline/retry semantics as ``predict`` (a failed generation is
+        retryable: no state outlives the request's KV slot)."""
         params: Dict[str, Any] = {
             "tokens": [int(t) for t in np.asarray(tokens).reshape(-1)]}
         if max_new_tokens is not None:
             params["max_new_tokens"] = int(max_new_tokens)
         if eos_id is not None:
             params["eos_id"] = int(eos_id)
+        if temperature:
+            params["temperature"] = float(temperature)
+        if top_k:
+            params["top_k"] = int(top_k)
+        if top_p != 1.0:
+            params["top_p"] = float(top_p)
+        if seed is not None:
+            params["seed"] = int(seed)
+        if logprobs:
+            params["logprobs"] = True
         if trace:
             from ..obs import new_trace_id
 

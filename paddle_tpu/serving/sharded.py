@@ -504,7 +504,8 @@ class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
         z = np.zeros(self._pool_shape, np.float32)
         return (jax.device_put(z, sharding), jax.device_put(z, sharding))
 
-    def _make_chunk_fn(self, lanes: int, chunk: int, window: int):
+    def _make_chunk_fn(self, lanes: int, chunk: int, window: int,
+                       full: bool = False):
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -514,20 +515,25 @@ class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
         with self._lock:
             specs = self._param_specs_pytree(self._params)
         body = functools.partial(decode_forward_chunk, cfg=self.cfg,
-                                 window=window, tp=self.tp,
+                                 window=window, full_logits=full,
+                                 tp=self.tp,
                                  tp_axis="tp" if self.tp > 1 else None)
         pool = self._pool_spec()
+        # the per-lane sample policy vectors replicate, like positions
+        samp = {"temp": P(), "topk": P(), "topp": P(), "key": P(),
+                "plen": P()}
         fn = shard_map(
-            lambda p, pk, pv, tok, pos, val, slot:
-                body(p, pk, pv, tok, pos, val, slot),
+            lambda p, pk, pv, tok, pos, val, slot, smp:
+                body(p, pk, pv, tok, pos, val, slot, smp),
             mesh=self.mesh,
-            in_specs=(specs, pool, pool, P(), P(), P(), P()),
+            in_specs=(specs, pool, pool, P(), P(), P(), P(), samp),
             out_specs=(P(), P(), P(), pool, pool), check_vma=False)
         return jax.jit(fn, donate_argnums=(1, 2))
 
-    def dispatch_chunk(self, tokens, positions, valids, slots, window: int):
+    def dispatch_chunk(self, tokens, positions, valids, slots, window: int,
+                       sample=None, full: bool = False):
         out = super().dispatch_chunk(tokens, positions, valids, slots,
-                                     window)
+                                     window, sample=sample, full=full)
         # each chunk runs the same static gather schedule as predict —
         # count it so a decode-only sharded replica's collective
         # instruments move too (.shape only: tokens may be the pipelined
@@ -549,5 +555,6 @@ class ShardedDecodeEngine(_ShardedParamStore, DecodeEngine):
             params = self._params
         txt = entry.fn.lower(
             params, self.pool_k, self.pool_v,
-            jax.numpy.asarray(toks), zeros, zeros, slots).compile().as_text()
+            jax.numpy.asarray(toks), zeros, zeros, slots,
+            self.default_sample(self.max_slots)).compile().as_text()
         return count_hlo_collectives(txt)

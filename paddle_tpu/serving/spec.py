@@ -1,0 +1,340 @@
+"""Speculative decoding with exact-distribution rejection sampling.
+
+A small deterministic DRAFT model proposes ``k`` tokens per lane; the
+TARGET verifies all ``k`` in ONE batched chunk (the ``full_logits``
+variant of the compiled decode step returns per-position logits, so one
+dispatch scores every proposal); host-side rejection sampling then
+commits 1..k+1 tokens per lane with the output distribution EXACTLY the
+target policy's — never the draft's.
+
+Exactness (the standard argument, specialized to our policy surface):
+let q' and p' be the draft and target distributions AFTER the lane's
+sampling policy (temperature/top-k/top-p — ``sampling.policy_probs``,
+the single shared definition). Propose ``d ~ q'``; accept with
+probability ``min(1, p'(d)/q'(d))``; on rejection draw from the residual
+``norm(max(p' - q', 0))``. For any token t::
+
+    P(commit t) = q'(t) min(1, p'(t)/q'(t))
+                + (1 - sum_d q'(d) min(1, p'(d)/q'(d))) * resid(t)
+                = min(q'(t), p'(t)) + (p'(t) - min(q'(t), p'(t)))
+                = p'(t)
+
+A fully-accepted window commits one BONUS token drawn from the target's
+(k+1)-th distribution — the verify chunk already produced it for free.
+Greedy lanes (temperature 0) degenerate to one-hot distributions: accept
+iff the draft's argmax equals the target's, replacement/bonus = target
+argmax — i.e. every committed token IS the target argmax, so the greedy
+speculative stream is BIT-identical to vanilla greedy decode (the same
+cross-chunk-shape argmax stability the chunked-prefill parity tests
+already pin).
+
+KV discipline: the verify chunk writes the proposals' K/V through the
+normal scatter (dense slot rows or the paged table); rejected suffix
+positions hold stale K/V, but the NEXT round's chunk starts at the
+commit frontier and rewrites every stale position before any query can
+attend it (write-then-attend + the valid-masked scatter in the chunk
+forwards). The paged engine's host frontier is rewound per round
+(``sync_frontier``) so lazy page mapping tracks the COMMITTED sequence,
+keeping the reservation-admission invariant sound.
+
+The draft engine is a plain dense ``DecodeEngine`` over its own tiny
+export: one pending-ingest chunk (1..2 tokens — 2 after a fully-accepted
+round, because the last proposal was never fed) then ``k-1`` chunk-1
+feeds per round, all precompiled by :meth:`SpecDecoder.warmup` alongside
+the target's ``full_logits`` verify signatures — zero steady-state
+recompiles holds across BOTH engines.
+
+Scheduling: per-round acceptance and draft/verify costs feed the
+``SlotScheduler`` EMAs; with ``adaptive=True`` each round's depth is
+``plan_draft_depth(k)`` — expected committed tokens per second, priced
+against the inter-token-latency budget.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .sampling import DOMAIN_ACCEPT, DOMAIN_BONUS, DOMAIN_DRAFT, \
+    DOMAIN_RESIDUAL, draw_from, host_rng, policy_probs
+
+
+class SpecDecoder:
+    """Draft-model management + the batched propose/verify/accept round.
+
+    Construct with the draft export dir, then hand to
+    ``GenerationBatcher(spec=...)`` — the batcher calls :meth:`bind` with
+    its engine/scheduler/stats and runs one :meth:`round` per token
+    boundary.
+    """
+
+    def __init__(self, draft_dir: str, k: int = 4, place=None,
+                 adaptive: bool = True):
+        if k < 1:
+            raise ValueError("draft depth k must be >= 1")
+        self.draft_dir = draft_dir
+        self.k = int(k)
+        self._place = place
+        self.adaptive = bool(adaptive)
+        self.target = None
+        self.draft = None
+        self.scheduler = None
+        self.stats = None
+        # lifetime acceptance accounting (the bench/CLI surface)
+        self.proposed_total = 0
+        self.accepted_total = 0
+        self.rounds = 0
+
+    # -- wiring --
+    def bind(self, target, scheduler=None, stats=None) -> None:
+        """Attach to the target engine (idempotent). Builds the draft
+        engine slot-for-slot: draft pool row i mirrors target slot i, so
+        lane->slot mapping is shared and admission needs no translation."""
+        if self.target is target:
+            self.scheduler = scheduler or self.scheduler
+            self.stats = stats or self.stats
+            return
+        from .decode import DecodeEngine
+
+        self.target = target
+        self.scheduler = scheduler
+        self.stats = stats
+        self.draft = DecodeEngine(self.draft_dir,
+                                  place=self._place or target._place,
+                                  max_slots=target.max_slots,
+                                  max_len=target.max_len)
+        if self.draft.cfg["vocab"] != target.cfg["vocab"]:
+            raise ValueError(
+                f"draft vocab {self.draft.cfg['vocab']} != target vocab "
+                f"{target.cfg['vocab']} — rejection sampling needs one "
+                f"token space")
+        B = target.max_slots
+        # per-slot draft state: next draft write position, and the
+        # committed tokens the draft has not ingested yet (1..2; the
+        # last pending token is always the lane's x_last)
+        self._dpos = [0] * B
+        self._pending: List[List[int]] = [[] for _ in range(B)]
+        # the verify variant + per-round draft chunks are extra compile
+        # signatures; grow both LRUs so warmup's work is never evicted
+        target.cache_capacity += len(target.kv_buckets) * (self.k + 1) + 4
+        self.draft.cache_capacity += 3 * len(self.draft.kv_buckets) + 8
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Lifetime proposal acceptance; -1.0 before any proposal (the
+        gauge sentinel the fleet column renders as '-')."""
+        if self.proposed_total <= 0:
+            return -1.0
+        return self.accepted_total / self.proposed_total
+
+    def warmup(self) -> int:
+        """Precompile every signature a speculative steady state can hit:
+        the draft's prefill/step ladder, the draft's pending-ingest
+        chunk-2, and the target's ``full_logits`` verify chunks at every
+        (depth, window) pair. Returns fresh compile count (both engines).
+        """
+        tgt, drf = self.target, self.draft
+        misses0 = tgt.cache_misses + drf.cache_misses
+        drf.warmup()
+        B = drf.max_slots
+        for w in drf.kv_buckets:
+            drf.dispatch_chunk(np.zeros((B, 2), np.int32),
+                               np.zeros(B, np.int32),
+                               np.zeros(B, np.int32),
+                               np.full(B, drf.trash_slot, np.int32), w)
+        drf.reset_pool()
+        B = tgt.max_slots
+        for w in tgt.kv_buckets:
+            for c in range(2, self.k + 2):
+                tgt.dispatch_chunk(np.zeros((B, c), np.int32),
+                                   np.zeros(B, np.int32),
+                                   np.zeros(B, np.int32),
+                                   np.full(B, tgt.trash_slot, np.int32),
+                                   w, full=True)
+        return tgt.cache_misses + drf.cache_misses - misses0
+
+    # -- per-lane lifecycle (driven by the batcher) --
+    def admit(self, slot: int, prompt: np.ndarray, first_tok: int) -> None:
+        """Mirror an admitted generation into the draft: prefill the
+        prompt into draft row ``slot`` and queue the target's first token
+        as the pending ingest. Slot reuse resets state implicitly."""
+        self.draft.prefill(slot, np.asarray(prompt, np.int32))
+        self._dpos[slot] = int(np.asarray(prompt).reshape(-1).shape[0])
+        self._pending[slot] = [int(first_tok)]
+
+    # -- the round --
+    def round(self, gens) -> Dict[int, Tuple[List[int], List[np.ndarray]]]:
+        """One batched draft/verify/accept round over the active lanes.
+
+        ``gens`` is the batcher's lane list (duck-typed ``_Generation``
+        rows or ``None``). Returns ``{lane: (committed_tokens,
+        target_logit_rows)}`` — logit rows are the raw ``[V]`` target
+        logits each committed token was drawn under (the logprob
+        surface). The batcher owns retirement; this method owns draft
+        state and acceptance accounting.
+        """
+        tgt, drf = self.target, self.draft
+        active = [(i, g) for i, g in enumerate(gens)
+                  if g is not None and not getattr(g, "done", False)]
+        if not active:
+            return {}
+        k = self.k
+        if self.adaptive and self.scheduler is not None:
+            k = max(1, min(self.k, self.scheduler.plan_draft_depth(self.k)))
+        B = tgt.max_slots
+        S = np.zeros(B, np.int64)   # committed tokens (prompt + generated)
+        v = np.zeros(B, np.int32)   # per-lane verify valids (1 + eff. k)
+        for i, g in active:
+            S[i] = g.prompt.shape[0] + len(g.tokens)
+            room_pool = tgt.max_len - S[i] + 1
+            room_budget = g.max_new_tokens - len(g.tokens)
+            v[i] = max(1, min(k + 1, int(room_pool), int(room_budget)))
+
+        # -- 1) draft proposes (host-sampled from draft logits) --
+        t0 = time.monotonic()
+        q_rows: List[np.ndarray] = []  # [B, V] per proposal step
+        props = np.zeros((B, k), np.int32)
+        toks = np.zeros((B, 2), np.int32)
+        dval = np.zeros(B, np.int32)
+        dpos = np.zeros(B, np.int32)
+        dslots = np.full(B, drf.trash_slot, np.int32)
+        for i, g in active:
+            pend = self._pending[g.slot]
+            toks[i, :len(pend)] = pend
+            dval[i] = len(pend)
+            dpos[i] = self._dpos[g.slot]
+            dslots[i] = g.slot
+        draft_steps = 0
+        for j in range(k):
+            if j > 0:
+                toks = np.zeros((B, 1), np.int32)
+                dval = np.zeros(B, np.int32)
+                for i, g in active:
+                    if j <= v[i] - 2:  # this feed seeds proposal j+1
+                        toks[i, 0] = props[i, j - 1]
+                        dval[i] = 1
+            w = drf.window_bucket(int((dpos + dval).max()))
+            _t, lg, _p, _ver = drf.dispatch_chunk(toks, dpos, dval,
+                                                  dslots, w)
+            draft_steps += 1
+            q = np.asarray(lg)
+            q_rows.append(q)
+            dpos = dpos + dval
+            for i, g in active:
+                if j > v[i] - 2 and j > 0:
+                    continue  # lane out of room: proposal unused
+                tok_idx = len(g.tokens) + j
+                if g.temperature <= 0.0:
+                    props[i, j] = int(np.argmax(q[i]))
+                else:
+                    probs = policy_probs(q[i], g.temperature, g.top_k,
+                                         g.top_p)
+                    props[i, j] = draw_from(
+                        probs, host_rng(g.seed, tok_idx, DOMAIN_DRAFT))
+        dt_draft = time.monotonic() - t0
+
+        # -- 2) target verifies all proposals in one chunk --
+        t1 = time.monotonic()
+        C = k + 1
+        vtoks = np.zeros((B, C), np.int32)
+        vpos = np.zeros(B, np.int32)
+        vval = np.zeros(B, np.int32)
+        vslots = np.full(B, tgt.trash_slot, np.int32)
+        for i, g in active:
+            vtoks[i, 0] = g.tokens[-1]
+            vtoks[i, 1:] = props[i]
+            vpos[i] = S[i] - 1
+            vval[i] = v[i]
+            vslots[i] = g.slot
+        w = tgt.window_bucket(int((vpos + vval).max()))
+        _nt, full_lg, _np2, _version = tgt.dispatch_chunk(
+            vtoks, vpos, vval, vslots, w, full=True)
+        p_lg = np.asarray(full_lg)  # [B, C, V]
+        dt_verify = time.monotonic() - t1
+
+        # -- 3) rejection sampling per lane --
+        out: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
+        round_prop = 0
+        round_acc = 0
+        for i, g in active:
+            keff = int(v[i]) - 1
+            idx0 = len(g.tokens)
+            greedy = g.temperature <= 0.0
+            committed: List[int] = []
+            rows: List[np.ndarray] = []
+            accepted = 0
+            rejected = False
+            for j in range(keff):
+                d = int(props[i, j])
+                if greedy:
+                    ok = d == int(np.argmax(p_lg[i, j]))
+                else:
+                    p = policy_probs(p_lg[i, j], g.temperature, g.top_k,
+                                     g.top_p)
+                    q = policy_probs(q_rows[j][i], g.temperature, g.top_k,
+                                     g.top_p)
+                    u = host_rng(g.seed, idx0 + j, DOMAIN_ACCEPT).random()
+                    ok = q[d] > 0.0 and u * q[d] <= p[d]
+                if ok:
+                    committed.append(d)
+                    rows.append(p_lg[i, j])
+                    accepted += 1
+                    continue
+                # rejected: replacement from the residual distribution
+                if greedy:
+                    r = int(np.argmax(p_lg[i, j]))
+                else:
+                    resid = np.maximum(p - q, 0.0)
+                    tot = resid.sum()
+                    rng = host_rng(g.seed, idx0 + j, DOMAIN_RESIDUAL)
+                    r = draw_from(resid / tot if tot > 0.0 else p, rng)
+                committed.append(r)
+                rows.append(p_lg[i, j])
+                rejected = True
+                break
+            if not rejected:
+                # whole window accepted: bonus token from p_{keff+1}
+                if greedy:
+                    r = int(np.argmax(p_lg[i, keff]))
+                else:
+                    probs = policy_probs(p_lg[i, keff], g.temperature,
+                                         g.top_k, g.top_p)
+                    r = draw_from(probs, host_rng(g.seed, idx0 + keff,
+                                                  DOMAIN_BONUS))
+                committed.append(r)
+                rows.append(p_lg[i, keff])
+            out[i] = (committed, rows)
+            round_prop += keff
+            round_acc += accepted
+            # -- 4) draft/frontier bookkeeping for the continuing lane --
+            slot = g.slot
+            if not rejected and keff >= 1:
+                # fully accepted: the last proposal was never fed to the
+                # draft (feeds cover props[0..keff-2]) — ingest it
+                # together with the bonus next round
+                self._pending[slot] = [int(props[i, keff - 1]),
+                                       committed[-1]]
+                self._dpos[slot] = int(S[i]) + keff - 1
+            else:
+                self._pending[slot] = [committed[-1]]
+                self._dpos[slot] = int(S[i]) + accepted
+            if hasattr(tgt, "sync_frontier"):
+                # committed length is now S + accepted + 1; the next
+                # chunk (x_last) writes at the new S' - 1
+                tgt.sync_frontier(slot, int(S[i]) + accepted)
+
+        # -- accounting --
+        self.rounds += 1
+        self.proposed_total += round_prop
+        self.accepted_total += round_acc
+        if self.scheduler is not None:
+            self.scheduler.observe_spec(round_acc, round_prop)
+            self.scheduler.observe_draft(draft_steps, dt_draft)
+            self.scheduler.observe_verify(dt_verify)
+        if self.stats is not None:
+            self.stats.record_stage("draft", dt_draft)
+            self.stats.record_stage("verify", dt_verify)
+            self.stats.record_spec(round_acc, round_prop,
+                                   self.acceptance_rate)
+        return out

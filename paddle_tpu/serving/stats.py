@@ -64,8 +64,9 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 PREDICT_STAGES = ("pad", "queue_wait", "coalesce", "dispatch",
                   "pipeline_wait", "device_sync", "scatter")
 
-#: decode-serving stages (docs/design.md §16)
-DECODE_STAGES = ("prefill", "decode_step")
+#: decode-serving stages (docs/design.md §16; "draft"/"verify" are the
+#: speculative-decoding round halves, docs/design.md §25)
+DECODE_STAGES = ("prefill", "decode_step", "draft", "verify")
 
 #: every stage, in hot-path order
 STAGES = PREDICT_STAGES + DECODE_STAGES
@@ -163,6 +164,29 @@ class ServingStats:
         r.gauge("pt_serving_decode_tokens_per_second",
                 "Windowed generated-token rate",
                 callback=self.decode_tokens_rate)
+        # token-policy + speculative-decoding instruments (serving/
+        # sampling.py, serving/spec.py, docs/design.md §25). Registered
+        # unconditionally so /metrics (and the metrics-doc generator)
+        # shows the full surface with zeros before any sampled traffic.
+        self._sample_requests = r.counter(
+            "pt_serving_sample_requests_total",
+            "Generations submitted with temperature > 0")
+        self._sample_tokens = r.counter(
+            "pt_serving_sample_tokens_total",
+            "Tokens committed on sampled (non-greedy) lanes")
+        self._spec_proposed = r.counter(
+            "pt_serving_spec_proposed_total",
+            "Draft tokens proposed to speculative verification")
+        self._spec_accepted = r.counter(
+            "pt_serving_spec_accepted_total",
+            "Draft proposals accepted by target rejection sampling")
+        self._spec_rounds = r.counter(
+            "pt_serving_spec_rounds_total",
+            "Speculative propose/verify/accept rounds")
+        self._spec_rate = r.gauge(
+            "pt_serving_spec_acceptance_rate",
+            "Lifetime accepted/proposed ratio (-1 before any proposal)")
+        self._spec_rate.set(-1.0)
         # sharded-serving instruments (serving/sharded.py, docs/design.md
         # §18): shard count makes MFU an AGGREGATE across the mesh (the
         # denominator scales with devices — a fleet router scraping a
@@ -361,6 +385,38 @@ class ServingStats:
         self._decode_active.set(int(active))
         self._decode_capacity.set(int(capacity))
 
+    # -- sampling + speculative decoding (docs/design.md §25) --
+    def record_sampled_request(self) -> None:
+        """A generation entered with temperature > 0 (policy lane)."""
+        self._sample_requests.inc()
+
+    def record_sampled_tokens(self, n: int = 1) -> None:
+        self._sample_tokens.inc(n)
+
+    def record_spec(self, accepted: int, proposed: int,
+                    acceptance_rate: float) -> None:
+        """One speculative round: ``proposed`` draft tokens verified,
+        ``accepted`` kept; the gauge carries the caller's LIFETIME rate
+        (-1.0 sentinel preserved before any proposal)."""
+        self._spec_rounds.inc()
+        if proposed > 0:
+            self._spec_proposed.inc(proposed)
+        if accepted > 0:
+            self._spec_accepted.inc(accepted)
+        self._spec_rate.set(float(acceptance_rate))
+
+    @property
+    def spec_proposed(self) -> int:
+        return int(self._spec_proposed.value)
+
+    @property
+    def spec_accepted(self) -> int:
+        return int(self._spec_accepted.value)
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        return float(self._spec_rate.value)
+
     def decode_tokens_rate(self) -> float:
         """Windowed generated tokens/s (the decode throughput gauge)."""
         return self._decode_tokens_window.rate()
@@ -523,6 +579,13 @@ class ServingStats:
             "shards": self.shard_count,
             "collectives": self.collectives,
             "decode": self.decode_summary(),
+            "spec": {
+                "rounds": int(self._spec_rounds.value),
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": self.spec_acceptance_rate,
+            },
+            "sampled_requests": int(self._sample_requests.value),
         }
         if extra:
             snap.update(extra)

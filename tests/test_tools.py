@@ -345,12 +345,16 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all thirteen tracked metrics carry a bar (r8 added sharded serving,
+    # all fourteen tracked metrics carry a bar (r8 added sharded serving,
     # r10 the quantized CPU serving lane, r11/ISSUE-12 the tuner
     # contract, r13/ISSUE-13 the paged-KV prefix-cache workload,
     # r14/ISSUE-14 the goodput accounting-closure contract, r15/ISSUE-15
-    # the sharded data-parallel training workload)
-    assert len(bench.BARS) == 13
+    # the sharded data-parallel training workload, r16/ISSUE-16 the
+    # speculative-decode commit ratio)
+    assert len(bench.BARS) == 14
+    spd = bench.BARS["speculative_decode_token_ratio"]
+    assert spd["field"] == "value" and spd["min"] == 1.5
+    assert spd.get("provisional") is True
     ddp = bench.BARS["ddp_training_step_time_ratio"]
     assert ddp["field"] == "value" and ddp["min"] == 0.5
     assert ddp.get("provisional") is True

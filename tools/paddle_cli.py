@@ -207,7 +207,7 @@ def fleet_rows(endpoints, timeout=3.0):
         row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
                "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
                "shards": "-", "weights": "-", "quant": "-", "kv": "-",
-               "goodput": "-", "decode": ""}
+               "goodput": "-", "accept": "-", "decode": ""}
         try:
             with ServingClient(ep, timeout=timeout) as c:
                 hz = c.healthz()
@@ -227,6 +227,12 @@ def fleet_rows(endpoints, timeout=3.0):
                 # goodput accounting (docs §23): windowed good/(good+bad)
                 # request-seconds; 1.0 = neutral (not accounting / idle)
                 goodput=f"{m.get('goodput_ratio', 1.0):.2f}")
+            # speculative-decode acceptance (docs §25): lifetime
+            # accepted/proposed; the gauge idles at -1.0 until the
+            # replica's first draft proposal ("-" = spec never armed)
+            acc = float(m.get("spec_acceptance", -1.0))
+            if acc >= 0.0:
+                row["accept"] = f"{acc:.0%}"
             # paged-KV column: in-use/total pages + prefix-cache hit rate
             # (the session-affinity signal; "-" on unpaged replicas)
             total_pg = int(m.get("kv_pages_free", 0)
@@ -299,7 +305,8 @@ def router_report(r):
 def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
              f"{'occ':>5}{'mfu':>11}{'shards':>7}{'quant':>7}"
-             f"{'weights':>9}{'kv':>15}{'goodput':>9}  decode"]
+             f"{'weights':>9}{'kv':>15}{'goodput':>9}{'accept':>8}"
+             f"  decode"]
     for r in rows:
         q = (f"{r['queue']}/{r['capacity']}"
              if r["queue"] != "-" else "-")
@@ -310,7 +317,8 @@ def fleet_report(rows):
                      f"{str(r.get('quant', '-')):>7}"
                      f"{str(r['weights']):>9}"
                      f"{str(r.get('kv', '-')):>15}"
-                     f"{str(r.get('goodput', '-')):>9}  {r['decode']}")
+                     f"{str(r.get('goodput', '-')):>9}"
+                     f"{str(r.get('accept', '-')):>8}  {r['decode']}")
     healthy = sum(1 for r in rows if r["health"] == "healthy")
     lines.append(f"{healthy}/{len(rows)} replicas healthy")
     return "\n".join(lines)

@@ -911,6 +911,140 @@ def cpu_mode():
     print(json.dumps(out))
 
 
+def _spec_child(argv):
+    """One speculative-decoding sweep cell in a FRESH process:
+    `perf_lab.py spec-child TARGET DRAFT K MAX_SLOTS dense|paged N_REQS`.
+    A fresh process so every cell measures a cold-warmed engine pair —
+    compile caches, draft state, and acceptance EMAs never leak between
+    cells. K=0 is the vanilla (no-spec) lane. Prints ONE JSON line."""
+    import json
+    import os
+
+    target, draft = argv[0], argv[1]
+    k, max_slots = int(argv[2]), int(argv[3])
+    paged, n_reqs = argv[4] == "paged", int(argv[5])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import numpy as np
+
+    from paddle_tpu.serving.decode import DecodeEngine, GenerationBatcher
+    from paddle_tpu.serving.kvcache import PagedDecodeEngine
+    from paddle_tpu.serving.spec import SpecDecoder
+
+    eng_cls = PagedDecodeEngine if paged else DecodeEngine
+    eng = eng_cls(target, max_slots=max_slots)
+    spec = SpecDecoder(draft, k=k, adaptive=False) if k > 0 else None
+    b = GenerationBatcher(eng, spec=spec, start=False)
+    if spec is not None:
+        spec.warmup()
+    eng.warmup()
+    b.start()
+    vocab = eng.cfg["vocab"]
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, vocab, size=(int(rng.randint(2, 10)),))
+               for _ in range(n_reqs)]
+    misses0 = eng.cache_misses + (spec.draft.cache_misses if spec else 0)
+    t0 = time.perf_counter()
+    futs = [b.submit(p, max_new_tokens=24) for p in prompts]
+    toks = sum(len(f.result(timeout=300).tokens) for f in futs)
+    dt = time.perf_counter() - t0
+    recompiles = (eng.cache_misses
+                  + (spec.draft.cache_misses if spec else 0) - misses0)
+    b.close()
+    print(json.dumps({
+        "k": k, "max_slots": max_slots,
+        "engine": "paged" if paged else "dense",
+        "tokens": toks, "tokens_per_s": round(toks / dt, 2),
+        "acceptance": (round(spec.acceptance_rate, 4)
+                       if spec is not None else None),
+        "recompiles": recompiles}))
+
+
+def spec_mode():
+    """`perf_lab.py spec [TARGET_EXPORT [DRAFT_EXPORT]]` — the speculative
+    decoding sweep (docs/design.md §25): draft depth k x slot count x
+    dense/paged KV, every cell a FRESH subprocess over the same export
+    pair, greedy closed-loop tokens/s as the score. k=0 rows are the
+    vanilla baselines; the winner is the best speculative cell and its
+    ratio is taken against the vanilla row with the SAME slot count and
+    engine (spec must beat its own lane, not a strawman). A cell that
+    steady-state-recompiles is disqualified — the zero-recompile contract
+    is part of the score, not a footnote. Final line: winner JSON."""
+    import json
+    import os
+    import subprocess
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    target = sys.argv[2] if len(sys.argv) > 2 else None
+    draft = sys.argv[3] if len(sys.argv) > 3 else None
+    if target is None or draft is None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu.models.transformer import train_successor_lm_export
+
+        root = tempfile.mkdtemp(prefix="perf_lab_spec_")
+        if target is None:
+            target = os.path.join(root, "target")
+            print(f"no target export given: training the pinned "
+                  f"successor-task LM -> {target}")
+            train_successor_lm_export(target, vocab_size=128, max_len=48,
+                                      d_model=64, d_ff=256, steps=80)
+        if draft is None:
+            draft = os.path.join(root, "draft")
+            print(f"no draft export given: training a 1-layer draft on "
+                  f"the same task -> {draft}")
+            train_successor_lm_export(draft, vocab_size=128, max_len=48,
+                                      d_model=32, n_layers=1, d_ff=128,
+                                      steps=80)
+
+    n_reqs = int(os.environ.get("PERF_LAB_SPEC_REQS", "12"))
+    here = os.path.abspath(__file__)
+    rows = []
+    print(f"{'engine':<7}{'slots':>6}{'k':>4}{'tok/s':>10}{'accept':>9}"
+          f"{'recompiles':>12}")
+    for engine in ("dense", "paged"):
+        for slots in (2, 4):
+            for k in (0, 2, 4):
+                try:
+                    r = subprocess.run(
+                        [sys.executable, here, "spec-child", target, draft,
+                         str(k), str(slots), engine, str(n_reqs)],
+                        capture_output=True, text=True, timeout=600)
+                except subprocess.TimeoutExpired:
+                    print(f"{engine:<7}{slots:>6}{k:>4}  FAILED: timed out "
+                          f"after 600s")
+                    continue
+                if r.returncode != 0:
+                    print(f"{engine:<7}{slots:>6}{k:>4}  FAILED: "
+                          f"{(r.stderr or '')[-120:]}")
+                    continue
+                rec = json.loads(r.stdout.strip().splitlines()[-1])
+                rows.append(rec)
+                acc = rec["acceptance"]
+                print(f"{engine:<7}{slots:>6}{k:>4}"
+                      f"{rec['tokens_per_s']:>10.1f}"
+                      f"{acc if acc is not None else '-':>9}"
+                      f"{rec['recompiles']:>12}")
+    base = {(r["engine"], r["max_slots"]): r for r in rows if r["k"] == 0}
+    candidates = [r for r in rows if r["k"] > 0 and r["recompiles"] == 0
+                  and (r["engine"], r["max_slots"]) in base]
+    out = {"target": target, "draft": draft, "rows": rows, "winner": None}
+    if candidates:
+        best = max(candidates, key=lambda r: r["tokens_per_s"])
+        b = base[(best["engine"], best["max_slots"])]
+        out["winner"] = dict(best,
+                             vanilla_tokens_per_s=b["tokens_per_s"],
+                             ratio=round(best["tokens_per_s"]
+                                         / b["tokens_per_s"], 3))
+        print(f"winner: {best['engine']} slots={best['max_slots']} "
+              f"k={best['k']} -> {best['tokens_per_s']:.1f} tok/s "
+              f"(x{out['winner']['ratio']:.2f} vs its vanilla lane, "
+              f"acceptance {best['acceptance']:.2%})")
+    else:
+        print("no eligible speculative cell (all failed or recompiled)")
+    print(json.dumps(out))
+
+
 #: dW sweep adoption bar — the PR-4 discipline (serving/quant.py spells the
 #: same 5% for the CPU lane); a win inside the slope's noise is weather
 TUNE_MARGIN = 0.95
@@ -1058,6 +1192,12 @@ def main():
         return
     if layout == "cpu-child":
         _cpu_child(sys.argv[2:])
+        return
+    if layout == "spec":
+        spec_mode()
+        return
+    if layout == "spec-child":
+        _spec_child(sys.argv[2:])
         return
     if layout == "train_scale":
         train_scale_mode()

@@ -113,23 +113,73 @@ def _parse_range(spec, name):
     return lo, hi
 
 
+def _parse_sample(spec):
+    """``T[:TOPK[:TOPP[:SEED]]]`` -> (temperature, top_k, top_p, seed)."""
+    parts = spec.split(":")
+    if not 1 <= len(parts) <= 4:
+        raise SystemExit(f"--sample wants T[:TOPK[:TOPP[:SEED]]], "
+                         f"got {spec!r}")
+    try:
+        temp = float(parts[0])
+        top_k = int(parts[1]) if len(parts) > 1 else 0
+        top_p = float(parts[2]) if len(parts) > 2 else 1.0
+        seed = int(parts[3]) if len(parts) > 3 else 0
+    except ValueError:
+        raise SystemExit(f"--sample wants numbers in T[:TOPK[:TOPP"
+                         f"[:SEED]]], got {spec!r}")
+    if temp < 0 or top_k < 0 or not 0 < top_p <= 1:
+        raise SystemExit(f"--sample policy out of range: {spec!r}")
+    return temp, top_k, top_p, seed
+
+
+def _parse_spec_knob(spec, default_draft):
+    """``k=K[,draft=DIR]`` -> (k, draft_dir). Without ``draft=`` the
+    target export drafts for itself (self-speculation: useful for
+    plumbing/latency tests; acceptance is near 1.0 on greedy)."""
+    k, draft = None, default_draft
+    for part in spec.split(","):
+        key, _, val = part.partition("=")
+        if key == "k" and val:
+            try:
+                k = int(val)
+            except ValueError:
+                raise SystemExit(f"--spec k wants an int, got {val!r}")
+        elif key == "draft" and val:
+            draft = val
+        else:
+            raise SystemExit(f"--spec wants k=K[,draft=DIR], got {spec!r}")
+    if k is None or k < 1:
+        raise SystemExit(f"--spec wants k=K with K >= 1, got {spec!r}")
+    return k, draft
+
+
 def _gen_client_loop(endpoint, vocab, prompt_rng_seed, prompt_range,
-                     token_range, stop, out, retries, deadline_ms):
+                     token_range, stop, out, retries, deadline_ms,
+                     sample=None):
     """One closed-loop generation client: random prompt + budget, wait for
-    the whole stream, repeat."""
+    the whole stream, repeat. ``sample=(T, top_k, top_p, seed)`` turns
+    every request into a sampled one (per-request seeds derived from the
+    base seed so re-runs reproduce the same streams)."""
     rng = np.random.RandomState(prompt_rng_seed)
     lat, ttfts, tokens, done = [], [], 0, 0
     rejected = deadline_missed = exhausted = errors = 0
+    temp, top_k, top_p, seed0 = sample or (0.0, 0, 1.0, None)
     with ServingClient(endpoint, retries=retries, backoff_base_ms=5.0,
                        retry_seed=prompt_rng_seed) as c:
+        reqno = 0
         while not stop.is_set():
             prompt = rng.randint(0, vocab, size=(
                 int(rng.randint(prompt_range[0], prompt_range[1] + 1)),))
             budget = int(rng.randint(token_range[0], token_range[1] + 1))
+            seed = (None if seed0 is None
+                    else seed0 + prompt_rng_seed * 1000003 + reqno)
+            reqno += 1
             t0 = time.monotonic()
             try:
                 r = c.generate(prompt, max_new_tokens=budget,
-                               timeout_ms=deadline_ms)
+                               timeout_ms=deadline_ms,
+                               temperature=temp, top_k=top_k, top_p=top_p,
+                               seed=seed)
                 lat.append(time.monotonic() - t0)
                 ttfts.append(r["ttft_ms"] / 1e3)
                 tokens += len(r["tokens"])
@@ -153,7 +203,7 @@ def _gen_client_loop(endpoint, vocab, prompt_rng_seed, prompt_range,
 
 def bench_generate(endpoint, vocab, clients, duration, prompt_range,
                    token_range, retries=0, deadline_ms=None,
-                   occupancy_poll_s=0.05):
+                   occupancy_poll_s=0.05, sample=None):
     """Closed-loop generation bench + an occupancy sampler riding healthz
     (the decode gauge is instantaneous; the mean NEEDS sampling)."""
     stop = threading.Event()
@@ -161,7 +211,7 @@ def bench_generate(endpoint, vocab, clients, duration, prompt_range,
     threads = [threading.Thread(target=_gen_client_loop,
                                 args=(endpoint, vocab, i, prompt_range,
                                       token_range, stop, out, retries,
-                                      deadline_ms), daemon=True)
+                                      deadline_ms, sample), daemon=True)
                for i in range(clients)]
     occ_samples = []
 
@@ -331,12 +381,17 @@ def _fleet_client_loop(router, feeds, tenant, stop, out, deadline_ms,
             if gen_spec is None:
                 router.predict(feeds, tenant=tenant, timeout_ms=deadline_ms)
             else:
-                vocab, pr, tr, rng = gen_spec
+                vocab, pr, tr, rng, sample = gen_spec
                 prompt = rng.randint(0, vocab, size=(
                     int(rng.randint(pr[0], pr[1] + 1)),))
                 budget = int(rng.randint(tr[0], tr[1] + 1))
+                temp, top_k, top_p, seed0 = sample or (0.0, 0, 1.0, None)
                 r = router.generate(prompt, max_new_tokens=budget,
-                                    tenant=tenant, timeout_ms=deadline_ms)
+                                    tenant=tenant, timeout_ms=deadline_ms,
+                                    temperature=temp, top_k=top_k,
+                                    top_p=top_p,
+                                    seed=(None if seed0 is None
+                                          else seed0 + done))
                 tokens += len(r["tokens"])
             lat.append(time.monotonic() - t0)
             done += 1
@@ -373,8 +428,8 @@ def bench_fleet(fleet, feeds, clients, duration, tenants=None,
     for i in range(clients):
         gen_spec = None
         if gen_args is not None:
-            vocab, pr, tr = gen_args
-            gen_spec = (vocab, pr, tr, np.random.RandomState(i))
+            vocab, pr, tr, sample = gen_args
+            gen_spec = (vocab, pr, tr, np.random.RandomState(i), sample)
         threads.append(threading.Thread(
             target=_fleet_client_loop,
             args=(fleet.router, feeds, names[i % len(names)], stop, out,
@@ -534,6 +589,12 @@ def _main_fleet(args, shapes, tracer, quantize=None):
             decode["max_slots"] = args.max_slots
         if args.prefill_chunk is not None:
             decode["prefill_chunk"] = args.prefill_chunk
+        if args.paged_kv:
+            decode["paged"] = True
+        if args.spec:
+            k, draft = _parse_spec_knob(args.spec, args.model_dir)
+            decode["spec_draft"] = draft
+            decode["spec_k"] = k
         server_kwargs["decode"] = decode
     router_kwargs = {"retries": args.fleet_retries,
                      "attempt_retries": (args.retries
@@ -554,7 +615,8 @@ def _main_fleet(args, shapes, tracer, quantize=None):
             vocab = fleet.servers[0].decode_engine.cfg["vocab"]
             pr = _parse_range(args.prompt_tokens, "prompt-tokens")
             tr = _parse_range(args.gen_tokens, "gen-tokens")
-            gen_args = (vocab, pr, tr)
+            sample = _parse_sample(args.sample) if args.sample else None
+            gen_args = (vocab, pr, tr, sample)
         else:
             for n in fleet.servers[0].engine.feed_names:
                 if n not in shapes:
@@ -668,6 +730,23 @@ def main(argv=None):
                          "instead of one-shot predict")
     ap.add_argument("--gen-tokens", default="8:64", metavar="LO:HI",
                     help="per-generation max_new_tokens range (--generate)")
+    ap.add_argument("--sample", metavar="T[:TOPK[:TOPP[:SEED]]]",
+                    default=None,
+                    help="sampled generation (--generate/--fleet loops): "
+                         "temperature T with optional top-k/top-p policy "
+                         "and per-request seeds derived from SEED "
+                         "(default 0; streams reproduce across re-runs). "
+                         "T=0 is the greedy bit-path")
+    ap.add_argument("--spec", metavar="k=K[,draft=DIR]", default=None,
+                    help="speculative decoding (docs §25): a draft engine "
+                         "over DIR (default: the target export drafting "
+                         "for itself) proposes K tokens/lane per round, "
+                         "verified in one batched target step with exact "
+                         "rejection sampling. Needs --model-dir + "
+                         "--generate; composes with --sample, --fleet, "
+                         "--mesh, and --paged-kv. Single-server runs "
+                         "bench vanilla first and print the spec-vs-"
+                         "vanilla tokens/s ratio")
     ap.add_argument("--prompt-tokens", default="2:16", metavar="LO:HI",
                     help="per-generation prompt length range (--generate); "
                          "with --prefix-mix this sizes the per-request "
@@ -767,6 +846,17 @@ def main(argv=None):
     if args.quantize and not args.model_dir:
         ap.error("--quantize A/Bs quantized engines over one export; it "
                  "needs --model-dir")
+    if args.spec:
+        if not args.model_dir:
+            ap.error("--spec builds an in-process draft engine; it needs "
+                     "--model-dir")
+        if not args.generate and not args.prefix_mix:
+            ap.error("--spec is a generation workload; add --generate")
+        _parse_spec_knob(args.spec, args.model_dir)  # fail on typos early
+    if args.sample:
+        if not args.generate and not args.prefix_mix:
+            ap.error("--sample shapes generated tokens; add --generate")
+        _parse_sample(args.sample)
     if args.mesh is not None:
         if not args.model_dir:
             ap.error("--mesh builds in-process sharded engines; it needs "
@@ -800,7 +890,30 @@ def main(argv=None):
     if args.fleet is not None:
         return _main_fleet(args, shapes, tracer)[0]
 
+    if args.spec and not args.prefix_mix:
+        return _main_spec_ab(args, shapes, tracer, retries)
+
     return _main_single(args, shapes, tracer, retries)[0]
+
+
+def _main_spec_ab(args, shapes, tracer, retries):
+    """The --spec ratio lane: the SAME generation bench twice over one
+    export — lane A vanilla continuous batching, lane B speculative —
+    then the spec-vs-vanilla tokens/s ratio (both lanes share --sample,
+    --paged-kv, --mesh, slot knobs)."""
+    import copy
+
+    vanilla = copy.copy(args)
+    vanilla.spec = None
+    print("=== lane A: vanilla decode ===")
+    rc_a, ra = _main_single(vanilla, dict(shapes), tracer, retries)
+    print("=== lane B: speculative decode ===")
+    rc_b, rb = _main_single(args, dict(shapes), tracer, retries)
+    a = ra.get("tokens_per_s", 0.0)
+    b = rb.get("tokens_per_s", 0.0)
+    print(f"spec-vs-vanilla tokens/s: {b:.1f} vs {a:.1f} "
+          f"(x{b / a if a else 0.0:.2f})")
+    return rc_a or rc_b
 
 
 def _main_quantize_ab(args, shapes, tracer, retries):
@@ -889,6 +1002,10 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
                 if args.prefill_chunk is not None:
                     decode["prefill_chunk"] = args.prefill_chunk
                 decode["gen_queue_capacity"] = args.queue_capacity
+                if args.spec:
+                    k, draft = _parse_spec_knob(args.spec, args.model_dir)
+                    decode["spec_draft"] = draft
+                    decode["spec_k"] = k
                 if args.paged_kv or args.prefix_mix:
                     decode["paged"] = True
                 for knob, val in (("page_len", args.kv_page_len),
@@ -996,12 +1113,18 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
         if args.generate:
             pr = _parse_range(args.prompt_tokens, "prompt-tokens")
             tr = _parse_range(args.gen_tokens, "gen-tokens")
+            sample = _parse_sample(args.sample) if args.sample else None
+            if sample:
+                print(f"sampling: temperature={sample[0]} "
+                      f"top_k={sample[1] or 'off'} "
+                      f"top_p={sample[2] if sample[2] < 1 else 'off'} "
+                      f"seed_base={sample[3]}")
             print(f"benching {endpoint}: {args.clients} closed-loop "
                   f"GENERATION clients, {args.duration:.0f}s, prompts "
                   f"{pr[0]}-{pr[1]} tokens, budgets {tr[0]}-{tr[1]} tokens")
             r = bench_generate(endpoint, args.vocab, args.clients,
                                args.duration, pr, tr, retries=retries,
-                               deadline_ms=args.deadline_ms)
+                               deadline_ms=args.deadline_ms, sample=sample)
             print(f"generations={r['generations']} tokens={r['tokens']} "
                   f"rejected={r['rejected']} "
                   f"deadline_missed={r['deadline_missed']} "
@@ -1029,6 +1152,11 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
                         print(f"  {st:<12} mean={stages[st]['mean_ms']:8.3f} "
                               f"p95={stages[st]['p95_ms']:8.3f} "
                               f"n={stages[st]['count']}")
+                sp = s.get("spec") or {}
+                if sp.get("proposed"):
+                    print(f"speculative: rounds={sp['rounds']} accepted="
+                          f"{sp['accepted']}/{sp['proposed']} "
+                          f"(acceptance {sp['acceptance_rate']:.2%})")
                 _print_goodput(s)
                 if "chaos" in s:
                     print(f"chaos: {s['chaos']}")
