@@ -38,6 +38,7 @@ MODEL_FILENAME = "__model__"
 SUCCESS_MARKER = "_SUCCESS"
 MANIFEST_FILENAME = "_MANIFEST.json"
 ZERO_META_FILENAME = "_ZERO.json"
+TRAIN_STATE_FILENAME = "_TRAIN_STATE.json"
 CHECKPOINT_PREFIX = "checkpoint"
 SHARD_META_SUFFIX = ".shards.json"
 
@@ -558,13 +559,30 @@ def read_zero_meta(checkpoint_serial_path: str) -> Optional[dict]:
         raise IOError(f"unreadable ZeRO descriptor at {path}: {e}")
 
 
+def read_train_state(checkpoint_serial_path: str) -> Optional[dict]:
+    """The training cursor a resumable checkpoint carries (``Trainer``/
+    ``ResilientTrainer`` — epoch, step, reader position, PRNG lineage;
+    docs §26). ``None`` for checkpoints saved without one; a corrupt
+    cursor raises ``IOError`` — resuming at the wrong step silently
+    replays or skips data, which is exactly the bug the stamp exists to
+    kill, so a torn cursor must be loud."""
+    path = os.path.join(checkpoint_serial_path, TRAIN_STATE_FILENAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise IOError(f"unreadable train-state cursor at {path}: {e}")
+
+
 def checkpoint_serial_dir(checkpoint_dir: str, serial: int) -> str:
     return os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
 
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
                     max_num_checkpoints=3, scope=None, step=None,
-                    host_tables=None, zero_meta=None):
+                    host_tables=None, zero_meta=None, train_state=None):
     """``host_tables``: HostEmbeddingTable instances checkpointed INSIDE the
     same numbered dir, before its _SUCCESS marker — the reference's pserver
     lookup-table checkpoint (checkpoint_notify table blocks,
@@ -599,6 +617,13 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
             _atomic_write(
                 os.path.join(cur, ZERO_META_FILENAME),
                 lambda f: f.write(json.dumps(zero_meta).encode()))
+        if train_state is not None:
+            # the resume cursor (docs §26) likewise commits before the
+            # manifest: params without their cursor are a checkpoint
+            # that replays data on resume, so they verify as one unit
+            _atomic_write(
+                os.path.join(cur, TRAIN_STATE_FILENAME),
+                lambda f: f.write(json.dumps(train_state).encode()))
     if jax.process_count() > 1:
         # every host must finish its shard writes before the chief marks the
         # checkpoint complete (<- pservers each checkpointing their shard,
@@ -785,7 +810,30 @@ def _next_checkpoint_serial(checkpoint_dir) -> int:
 
 
 def _scroll_delete(checkpoint_dir, max_num_checkpoints):
+    """Retention GC. Keeps the newest ``max_num_checkpoints`` *complete*
+    (``_SUCCESS``-marked) serials — the newest complete serial is NEVER
+    deleted, whatever the budget. Torn dirs (no marker: a crash between
+    the manifest and ``_SUCCESS``, or mid-array-write) older than the
+    newest complete serial are swept too — they can never be loaded
+    (``_checkpoint_serials`` skips them) and without GC a crashy run
+    leaks one orphan dir per crash. Torn dirs NEWER than the newest
+    complete serial are left alone: that numbered dir may be a save
+    currently in flight on another thread or host."""
     serials = _checkpoint_serials(checkpoint_dir)
     for s in serials[:-max_num_checkpoints] if max_num_checkpoints > 0 else []:
         shutil.rmtree(checkpoint_serial_dir(checkpoint_dir, s),
                       ignore_errors=True)
+    if not serials:
+        return
+    newest_complete = serials[-1]
+    for name in os.listdir(checkpoint_dir):
+        if not name.startswith(CHECKPOINT_PREFIX + "_"):
+            continue
+        try:
+            s = int(name.rsplit("_", 1)[1])
+        except ValueError:
+            continue
+        path = os.path.join(checkpoint_dir, name)
+        if s < newest_complete and not os.path.exists(
+                os.path.join(path, SUCCESS_MARKER)):
+            shutil.rmtree(path, ignore_errors=True)

@@ -55,6 +55,11 @@ EVENT_TYPES = (
     "chaos_inject",
     # training numerics sentinels
     "nan_detected", "loss_spike", "grad_norm_spike",
+    # training resilience plane (parallel/resilience.py, docs §26)
+    "checkpoint_saved",      # snapshot published (_SUCCESS written)
+    "rollback",              # sentinel escalation -> restore last-good
+    "preemption",            # SIGTERM caught -> grace snapshot + typed exit
+    "elastic_resize",        # resume re-planned for a new device count
     # watchdog / recorder
     "slo_breach", "worker_exception", "bundle_dumped",
     # differential attribution (obs/profile.py, docs §23): a profile pair

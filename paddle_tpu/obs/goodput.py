@@ -55,7 +55,7 @@ from .metrics import MetricsRegistry, RateWindow, get_registry
 #: training-plane taxonomy (docs §23; ``collective`` added by the sharded
 #: trainer, docs §24). ``idle`` is the sweep residual.
 TRAIN_CATEGORIES = ("device_compute", "collective", "host_input", "h2d",
-                    "compile", "fetch_sync", "idle")
+                    "compile", "fetch_sync", "checkpoint", "idle")
 
 #: sweep priorities: at any instant the highest-priority *active* interval
 #: owns it (device beats everything — host work overlapped with the device
@@ -65,8 +65,14 @@ TRAIN_CATEGORIES = ("device_compute", "collective", "host_input", "h2d",
 #: reduce-scatter/all-gather intervals nested inside the device window
 #: (parallel/ddp.py), and the sweep carves them out of device time — the
 #: closure invariant stays exact by construction.
-TRAIN_PRIORITY = {"collective": 6, "device_compute": 5, "compile": 4,
-                  "fetch_sync": 3, "h2d": 2, "host_input": 1}
+#: ``checkpoint`` sits BELOW everything: an async snapshot copied out
+#: while the device window runs is attributed to device_compute (the
+#: snapshot is provably free); only checkpoint seconds the run is
+#: actually *exposed* to — a sync save blocking the step loop, or the
+#: publish tail spilling past the window — surface as checkpoint badput.
+TRAIN_PRIORITY = {"collective": 7, "device_compute": 6, "compile": 5,
+                  "fetch_sync": 4, "h2d": 3, "host_input": 2,
+                  "checkpoint": 1}
 
 #: categories whose seconds count as GOODPUT (the device doing, or the
 #: host blocked on, useful model math); everything else — queueing,

@@ -2,3 +2,6 @@ from .mesh import make_mesh, sharding_for  # noqa: F401
 from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy  # noqa: F401
 from .pipeline import gpipe  # noqa: F401
 from .ddp import ShardedTrainError, ShardedTrainStep, split_train_block  # noqa: F401
+from .resilience import (CheckpointPolicy, PreemptedError,  # noqa: F401
+                         ResilientTrainer, RollbackExhausted, TrainChaos,
+                         WorkerKilled)

@@ -1,0 +1,734 @@
+"""Fault-tolerant elastic training: the training-side twin of the serving
+resilience plane (docs/design.md §26).
+
+The reference's production story was the etcd-backed master/pserver tier
+that survived worker death mid-job; our serving stack rebuilt that
+discipline end to end (typed errors + retries + drain, fleet chaos), but a
+training run that lost a host, caught a preemption SIGTERM, or hit a NaN
+simply died and restarted from whatever checkpoint someone last wrote by
+hand. ``ResilientTrainer`` closes that gap around the windowed step loop:
+
+* **async snapshot checkpoints** — at a window boundary the persistable
+  state is copied device→host (the only exposed cost), then a background
+  publisher thread writes it through io.py's manifest+``_SUCCESS``
+  discipline while the NEXT window computes. The write overlaps device
+  time, so the goodput sweep attributes it to ``device_compute`` — the
+  snapshot is provably ~free; only the boundary copy (and a ``sync=True``
+  publish) surfaces as ``checkpoint`` badput. Double-buffered: one
+  snapshot writing + one queued; a third is SKIPPED (counted), never
+  allowed to stall the step loop.
+* **bit-deterministic resume** — every checkpoint stamps the cursor
+  (next window, global step, skipped windows) and the executor's PRNG
+  seed counter via io.py's ``_TRAIN_STATE.json``. A killed-and-resumed
+  run replays the exact seed stream and consumes the exact batches the
+  uninterrupted run would have — the repo's signature bitwise gate,
+  applied to training.
+* **preemption + failure handling** — SIGTERM (or
+  ``request_preemption()``) triggers a grace final snapshot then a typed
+  ``PreemptedError``; a non-finite loss window rolls back to the last
+  good snapshot with bounded exponential backoff, and a window that
+  faults twice in a row is SKIPPED (a deterministic poison would
+  otherwise NaN forever). Every transition emits an event and lands in
+  flight-recorder bundles.
+* **elastic dp resize** — ``elastic=True`` re-plans (dp, accum, zero)
+  for the CURRENT device inventory with ``TrainPlacementSearcher``,
+  preserving the global batch; ``_ZERO.json`` reshard-on-load makes a
+  dp4→dp2 resume exact.
+* **training chaos** — ``TrainChaos`` drives seeded kills, SIGTERM
+  storms, checkpoint corruption, NaN injection and host stalls through
+  the same hook discipline as ``serving/chaos.py``: off means one
+  ``is None`` check, and a failing storm replays from its seed.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import queue
+import random
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import io as model_io
+from ..core.executor import Executor, Scope
+from ..obs.events import get_event_log
+from ..obs.flight import get_recorder
+from ..obs.goodput import get_accountant
+
+#: injector counter -> the fault name its chaos_inject event carries
+#: (same join discipline as serving/chaos.py FAULT_NAMES)
+FAULT_NAMES = {"kills": "kill", "sigterms": "sigterm",
+               "corruptions": "corrupt_ckpt", "nans": "nan",
+               "stalls": "stall"}
+
+
+class PreemptedError(RuntimeError):
+    """Typed preemption exit: the grace snapshot is on disk. ``serial``
+    is the final checkpoint, ``window`` the next window to execute —
+    a supervisor restarts the job and resumes bit-exactly."""
+
+    def __init__(self, serial: int, window: int):
+        super().__init__(
+            f"preempted: final snapshot serial={serial}, resume at "
+            f"window {window}")
+        self.serial = serial
+        self.window = window
+
+
+class WorkerKilled(RuntimeError):
+    """``TrainChaos``'s in-process stand-in for ``kill -9`` mid-window:
+    un-published progress is lost exactly as a real kill would lose it
+    (queued snapshots are dropped; only completed publishes survive)."""
+
+    def __init__(self, window: int):
+        super().__init__(f"chaos: worker killed at window {window}")
+        self.window = window
+
+
+class RollbackExhausted(RuntimeError):
+    """More consecutive rollbacks than the budget allows — the run is
+    diverging faster than it recovers; a human (or the supervisor's
+    page) owns the next move."""
+
+    def __init__(self, window: int, rollbacks: int):
+        super().__init__(
+            f"rollback budget exhausted at window {window} after "
+            f"{rollbacks} consecutive rollbacks")
+        self.window = window
+        self.rollbacks = rollbacks
+
+
+class CheckpointPolicy:
+    """Snapshot cadence + retention. ``every_windows``/``every_seconds``
+    are OR'd (either due triggers a snapshot); ``max_keep`` is io.py's
+    retention budget (the newest complete serial is never deleted);
+    ``sync=True`` publishes inline on the step thread — the control arm
+    of the async-overhead bench, not a production setting."""
+
+    def __init__(self, every_windows: Optional[int] = 1,
+                 every_seconds: Optional[float] = None, max_keep: int = 3,
+                 sync: bool = False, grace_seconds: float = 5.0):
+        self.every_windows = (max(1, int(every_windows))
+                              if every_windows is not None else None)
+        self.every_seconds = (float(every_seconds)
+                              if every_seconds is not None else None)
+        self.max_keep = int(max_keep)
+        self.sync = bool(sync)
+        self.grace_seconds = float(grace_seconds)
+
+    def due(self, windows_since: int, seconds_since: float) -> bool:
+        if self.every_windows is not None \
+                and windows_since >= self.every_windows:
+            return True
+        return (self.every_seconds is not None
+                and seconds_since >= self.every_seconds)
+
+
+# -- resilience-plane obs instruments (process default registry) ----------
+_resil_obs = None
+_resil_obs_lock = threading.Lock()
+
+
+def _resilience_metrics():
+    """Lazy get-or-create of the checkpoint/rollback instruments, one set
+    per process (the ``_train_metrics`` discipline)."""
+    global _resil_obs
+    if _resil_obs is not None:
+        return _resil_obs
+    with _resil_obs_lock:
+        if _resil_obs is not None:
+            return _resil_obs
+        from ..obs import get_registry
+
+        r = get_registry()
+        _resil_obs = {
+            "saves": r.counter("pt_train_ckpt_saves_total",
+                               "Snapshot checkpoints published (_SUCCESS)"),
+            "skipped": r.counter(
+                "pt_train_ckpt_skipped_total",
+                "Snapshots skipped because both buffers were in flight"),
+            "seconds": r.counter(
+                "pt_train_ckpt_seconds_total",
+                "Seconds spent copying + publishing snapshots"),
+            "last_serial": r.gauge("pt_train_ckpt_last_serial",
+                                   "Serial of the newest published snapshot"),
+            "rollbacks": r.counter(
+                "pt_train_rollbacks_total",
+                "Rollbacks to the last good snapshot (sentinel escalation)"),
+            "preemptions": r.counter(
+                "pt_train_preemptions_total",
+                "Preemptions handled with a grace snapshot + typed exit"),
+        }
+    return _resil_obs
+
+
+class TrainChaos:
+    """Seeded fault injector for the training plane (the PR-7 FleetChaos
+    discipline pointed at a trainer): every injection is one coin flip
+    from one seeded RNG, counted and event-logged, so a failing storm
+    replays exactly. Hooks:
+
+    * ``on_window(trainer, w)`` — window start: may stall the host,
+      flag a preemption, raise ``WorkerKilled``, or return ``"nan"`` to
+      poison this window's batch (the numerics-sentinel drill).
+    * ``on_window_end(trainer, w)`` — after compute, before the
+      snapshot publishes: the worst-case crash point (a kill here loses
+      the whole window).
+    * ``on_published(dir, serial)`` — after ``_SUCCESS``: may tear an
+      array file so the NEXT load must fall back through the manifest.
+    """
+
+    def __init__(self, seed: int = 0, kill_prob: float = 0.0,
+                 sigterm_prob: float = 0.0, corrupt_prob: float = 0.0,
+                 nan_prob: float = 0.0, stall_prob: float = 0.0,
+                 stall_ms: float = 10.0, max_faults: Optional[int] = None):
+        self.seed = int(seed)
+        self.kill_prob = kill_prob
+        self.sigterm_prob = sigterm_prob
+        self.corrupt_prob = corrupt_prob
+        self.nan_prob = nan_prob
+        self.stall_prob = stall_prob
+        self.stall_ms = stall_ms
+        self.max_faults = max_faults
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected = {"kills": 0, "sigterms": 0, "corruptions": 0,
+                         "nans": 0, "stalls": 0}
+
+    @classmethod
+    def default_storm(cls, seed: int = 0) -> "TrainChaos":
+        """The bench storm: every fault class armed, bounded count."""
+        return cls(seed=seed, kill_prob=0.08, sigterm_prob=0.08,
+                   corrupt_prob=0.15, nan_prob=0.10, stall_prob=0.10,
+                   stall_ms=5.0, max_faults=12)
+
+    def _roll(self, prob: float, counter: str, **attrs) -> bool:
+        if prob <= 0.0:
+            return False
+        with self._lock:
+            if self.max_faults is not None \
+                    and sum(self.injected.values()) >= self.max_faults:
+                return False
+            if self._rng.random() >= prob:
+                return False
+            self.injected[counter] += 1
+        ev = get_event_log()
+        if ev.enabled:
+            ev.emit("chaos_inject", severity="warn",
+                    fault=FAULT_NAMES[counter], seed=self.seed, **attrs)
+        return True
+
+    def on_window(self, trainer: "ResilientTrainer",
+                  window: int) -> Optional[str]:
+        if self._roll(self.stall_prob, "stalls", window=window):
+            time.sleep(self.stall_ms / 1e3)
+        if self._roll(self.sigterm_prob, "sigterms", window=window):
+            trainer.request_preemption()
+        if self._roll(self.kill_prob, "kills", window=window):
+            trainer._abandon_pending()
+            raise WorkerKilled(window)
+        if self._roll(self.nan_prob, "nans", window=window):
+            return "nan"
+        return None
+
+    def on_window_end(self, trainer: "ResilientTrainer",
+                      window: int) -> None:
+        if self._roll(self.kill_prob, "kills", window=window,
+                      at="window_end"):
+            trainer._abandon_pending()
+            raise WorkerKilled(window)
+
+    def on_published(self, checkpoint_dir: str, serial: int) -> None:
+        if not self._roll(self.corrupt_prob, "corruptions", serial=serial):
+            return
+        files = sorted(glob.glob(os.path.join(
+            model_io.checkpoint_serial_dir(checkpoint_dir, serial),
+            "*.npy")))
+        if not files:
+            return
+        data = open(files[0], "rb").read()
+        with open(files[0], "wb") as f:
+            f.write(data[:max(1, len(data) // 2)])
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.injected)
+
+
+class ResilientTrainer:
+    """Supervisor around the windowed step loop. ``feed_fn(w)`` must be a
+    pure function of the window index returning one global-batch feed
+    dict — that purity is what makes kill-and-resume bit-identical: the
+    resumed run asks for the same windows and draws the same seeds.
+
+    ``parallel={"dp":..,"accum_steps":..,"zero_stage":..}`` wraps the
+    program in a ``ShardedTrainStep``; ``elastic=True`` instead asks
+    ``TrainPlacementSearcher`` to plan those three axes for the CURRENT
+    device inventory, preserving ``global_batch`` — resuming a dp4
+    checkpoint on 2 devices re-plans and reshard-on-load does the rest.
+    """
+
+    def __init__(self, program, *, checkpoint_dir: str,
+                 feed_fn: Callable[[int], Dict[str, Any]],
+                 loss_name: str, executor: Optional[Executor] = None,
+                 scope: Optional[Scope] = None,
+                 startup_program=None, seed: Optional[int] = None,
+                 window_steps: int = 4, parallel: Optional[dict] = None,
+                 elastic: bool = False, inventory=None,
+                 global_batch: Optional[int] = None, max_accum: int = 64,
+                 policy: Optional[CheckpointPolicy] = None,
+                 max_rollbacks: int = 4, rollback_backoff: float = 0.0,
+                 rollback_backoff_max: float = 1.0,
+                 chaos: Optional[TrainChaos] = None):
+        self.program = program
+        self.checkpoint_dir = checkpoint_dir
+        self.feed_fn = feed_fn
+        self.loss_name = loss_name
+        self.window_steps = max(1, int(window_steps))
+        self.policy = policy or CheckpointPolicy()
+        self.max_rollbacks = max(0, int(max_rollbacks))
+        self.rollback_backoff = float(rollback_backoff)
+        self.rollback_backoff_max = float(rollback_backoff_max)
+        self.chaos = chaos
+        self.exe = executor or Executor(None)
+        self.scope = scope if scope is not None else Scope()
+        if startup_program is not None:
+            self.exe.run(startup_program, scope=self.scope, seed=seed)
+
+        self.plan = None
+        if elastic:
+            import jax
+
+            from ..placement import (DeviceInventory, TrainPlacementSearcher,
+                                     TrainProfile)
+
+            if global_batch is None:
+                raise ValueError("elastic=True needs global_batch")
+            n = (int(inventory.n_devices) if inventory is not None
+                 else len(jax.devices()))
+            inventory = inventory or DeviceInventory.host(n)
+            profile = TrainProfile.from_program(program, self.scope,
+                                                feed=feed_fn(0))
+            self.plan = TrainPlacementSearcher(
+                profile, inventory, global_batch,
+                max_accum=max_accum).search(n)
+            parallel = {"dp": self.plan.dp,
+                        "accum_steps": self.plan.accum_steps,
+                        "zero_stage": self.plan.zero_stage}
+        self.ddp = None
+        if parallel:
+            from .ddp import ShardedTrainStep
+
+            self.ddp = ShardedTrainStep(program, executor=self.exe,
+                                        **parallel)
+
+        # background publisher: double buffer = one writing + one queued
+        self._pub_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._pub_cv = threading.Condition()
+        self._pub_pending = 0
+        self._pub_err: Optional[BaseException] = None
+        self._pub_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+        self._preempt = threading.Event()
+        self._old_sigterm = None
+        self.last_serial = -1
+        self.window = 0          # next window to execute
+        self.global_step = 0
+        self.skipped_windows: List[int] = []
+        self.rollbacks = 0
+        self.resumed_serial = self._resume()
+        # serials are ISSUED at submit time (a queued snapshot owns its
+        # number before it hits disk); start past both the loaded serial
+        # and whatever the directory already holds
+        self._issued_serial = max(
+            self.last_serial,
+            model_io._next_checkpoint_serial(self.checkpoint_dir) - 1)
+        get_recorder().register_provider("train_resilience",
+                                         self._provider_state)
+
+    # -- resume ------------------------------------------------------------
+
+    def _dp(self) -> int:
+        return self.ddp.dp if self.ddp is not None else 1
+
+    def _resume(self) -> int:
+        try:
+            if self.ddp is not None:
+                serial = self.ddp.load_checkpoint(self.checkpoint_dir,
+                                                  self.scope)
+            else:
+                serial = model_io.load_checkpoint(
+                    self.exe, self.checkpoint_dir, self.program,
+                    scope=self.scope)
+        except FileNotFoundError:
+            return -1
+        if serial < 0:
+            return serial
+        ts = model_io.read_train_state(
+            model_io.checkpoint_serial_dir(self.checkpoint_dir, serial))
+        if ts is not None:
+            self.window = int(ts.get("window", 0))
+            self.global_step = int(ts.get("step", 0))
+            self.skipped_windows = [int(w) for w in
+                                    ts.get("skipped_windows", [])]
+            # PRNG lineage: the seed counter continues exactly where the
+            # checkpointed run left it (docs §26)
+            self.exe._step_seed = int(ts.get("step_seed",
+                                             self.exe._step_seed))
+            saved_dp = int(ts.get("dp", 1))
+            if saved_dp != self._dp():
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("elastic_resize", severity="info",
+                            saved_dp=saved_dp, dp=self._dp(),
+                            accum_steps=(self.ddp.accum_steps
+                                         if self.ddp else 1),
+                            zero_stage=(self.ddp.zero_stage
+                                        if self.ddp else 0),
+                            serial=serial)
+        self.last_serial = serial
+        return serial
+
+    # -- preemption --------------------------------------------------------
+
+    def request_preemption(self) -> None:
+        """Flag a preemption; honored at the next window boundary with a
+        grace snapshot + typed ``PreemptedError``."""
+        self._preempt.set()
+
+    def install_signal_handlers(self) -> None:
+        """Opt-in SIGTERM hook (main thread only): the cloud scheduler's
+        preemption notice becomes a flagged, grace-snapshotted exit."""
+        self._old_sigterm = signal.signal(
+            signal.SIGTERM, lambda signum, frame: self.request_preemption())
+
+    def uninstall_signal_handlers(self) -> None:
+        if self._old_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._old_sigterm)
+            self._old_sigterm = None
+
+    # -- snapshot pipeline -------------------------------------------------
+
+    def _train_state(self) -> Dict[str, Any]:
+        return {"schema": 1, "window": self.window,
+                "step": self.global_step,
+                "step_seed": int(self.exe._step_seed),
+                "skipped_windows": sorted(set(self.skipped_windows)),
+                "dp": self._dp(),
+                "accum_steps": self.ddp.accum_steps if self.ddp else 1,
+                "zero_stage": self.ddp.zero_stage if self.ddp else 0,
+                "window_steps": self.window_steps}
+
+    def _next_serial(self) -> int:
+        self._issued_serial = max(
+            self._issued_serial + 1,
+            model_io._next_checkpoint_serial(self.checkpoint_dir))
+        return self._issued_serial
+
+    def snapshot(self, sync: Optional[bool] = None) -> Optional[int]:
+        """Take one snapshot at the current boundary. Async mode copies
+        device→host here (the only exposed cost) and hands the publish to
+        the background thread; returns the serial it WILL get, or None if
+        both buffers were in flight (skipped, counted). ZeRO-sharded
+        state publishes inline: its per-shard save path reads the live
+        placed arrays, which a host copy cannot represent."""
+        sync = self.policy.sync if sync is None else sync
+        serial = self._next_serial()
+        state = self._train_state()
+        if self.ddp is not None:
+            t0 = time.monotonic()
+            self.ddp.save_checkpoint(
+                self.checkpoint_dir, self.scope, step=serial,
+                max_num_checkpoints=self.policy.max_keep,
+                train_state=state)
+            self._published(serial, t0, sync=True)
+            return serial
+        t0 = time.monotonic()
+        host_state = {}
+        for v in self.program.list_vars():
+            if not v.persistable:
+                continue
+            val = self.scope.get(v.name)
+            if val is not None:
+                host_state[v.name] = np.array(val, copy=True)
+        copy_dur = time.monotonic() - t0
+        acct = get_accountant()
+        if acct.enabled:
+            # the boundary copy is the snapshot's only exposed cost —
+            # attribute it; the background write overlaps the next
+            # window and sweeps under device_compute (hidden, ~free)
+            acct.account("checkpoint", t0, copy_dur)
+        if sync:
+            self._publish(serial, host_state, state)
+            return serial
+        self._start_publisher()
+        with self._pub_cv:
+            if self._pub_err is not None:
+                err, self._pub_err = self._pub_err, None
+                raise err
+            if self._pub_pending >= 2:
+                _resilience_metrics()["skipped"].inc()
+                return None
+            self._pub_pending += 1
+        self._pub_q.put({"serial": serial, "host_state": host_state,
+                         "train_state": state})
+        return serial
+
+    def _start_publisher(self) -> None:
+        if self._pub_thread is None or not self._pub_thread.is_alive():
+            self._pub_thread = threading.Thread(
+                target=self._pub_loop, daemon=True,
+                name="pt-ckpt-publisher")
+            self._pub_thread.start()
+
+    def _pub_loop(self) -> None:
+        while True:
+            item = self._pub_q.get()
+            if item is None:
+                return
+            try:
+                self._publish(**item)
+            except BaseException as e:  # surfaced at the next boundary
+                with self._pub_cv:
+                    self._pub_err = e
+            finally:
+                with self._pub_cv:
+                    self._pub_pending -= 1
+                    self._pub_cv.notify_all()
+
+    def _publish(self, serial: int, host_state: Dict[str, np.ndarray],
+                 train_state: Dict[str, Any]) -> None:
+        t0 = time.monotonic()
+        host_scope = Scope()
+        for name, arr in host_state.items():
+            host_scope.set(name, arr)
+        model_io.save_checkpoint(
+            self.exe, self.checkpoint_dir, main_program=self.program,
+            max_num_checkpoints=self.policy.max_keep, scope=host_scope,
+            step=serial, train_state=train_state)
+        acct = get_accountant()
+        if acct.enabled:
+            # exposed only in sync mode; async overlaps the next device
+            # window and the priority sweep hides it under device_compute
+            acct.account("checkpoint", t0, time.monotonic() - t0)
+        self._published(serial, t0, sync=False)
+
+    def _published(self, serial: int, t0: float, sync: bool) -> None:
+        m = _resilience_metrics()
+        m["saves"].inc()
+        m["seconds"].inc(time.monotonic() - t0)
+        m["last_serial"].set(float(serial))
+        with self._pub_cv:
+            self.last_serial = max(self.last_serial, serial)
+        ev = get_event_log()
+        if ev.enabled:
+            ev.emit("checkpoint_saved", severity="info", serial=serial,
+                    window=self.window, step=self.global_step, sync=sync)
+        if self.chaos is not None:
+            self.chaos.on_published(self.checkpoint_dir, serial)
+
+    def flush(self, timeout: Optional[float] = 60.0) -> None:
+        """Block until every queued snapshot is on disk; re-raise a
+        background publish failure here rather than losing it."""
+        with self._pub_cv:
+            self._pub_cv.wait_for(lambda: self._pub_pending == 0, timeout)
+            if self._pub_err is not None:
+                err, self._pub_err = self._pub_err, None
+                raise err
+
+    def _abandon_pending(self) -> None:
+        """Kill semantics: queued-but-unstarted snapshots die with the
+        worker; an in-flight write is left to finish (a half-written dir
+        would carry no ``_SUCCESS`` and the loader skips it anyway)."""
+        while True:
+            try:
+                self._pub_q.get_nowait()
+            except queue.Empty:
+                break
+            with self._pub_cv:
+                self._pub_pending -= 1
+                self._pub_cv.notify_all()
+        with self._pub_cv:
+            self._pub_cv.wait_for(lambda: self._pub_pending == 0, 30.0)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._pub_thread is not None and self._pub_thread.is_alive():
+            self.flush()
+            self._pub_q.put(None)
+            self._pub_thread.join(timeout=10.0)
+        self.uninstall_signal_handlers()
+
+    # -- rollback ----------------------------------------------------------
+
+    def _restore(self) -> int:
+        """Roll back to the newest good snapshot: params, cursor and
+        seed counter all come from the verified serial the loader picks
+        (a torn newest falls back through the manifest)."""
+        self.flush()
+        if self.ddp is not None:
+            serial = self.ddp.load_checkpoint(self.checkpoint_dir,
+                                              self.scope)
+        else:
+            serial = model_io.load_checkpoint(
+                self.exe, self.checkpoint_dir, self.program,
+                scope=self.scope)
+        ts = model_io.read_train_state(
+            model_io.checkpoint_serial_dir(self.checkpoint_dir, serial)) \
+            or {}
+        skipped = set(self.skipped_windows) \
+            | set(int(w) for w in ts.get("skipped_windows", []))
+        self.skipped_windows = sorted(skipped)
+        self.window = int(ts.get("window", 0))
+        self.global_step = int(ts.get("step", 0))
+        self.exe._step_seed = int(ts.get("step_seed", self.exe._step_seed))
+        self.last_serial = serial
+        return serial
+
+    # -- the window loop ---------------------------------------------------
+
+    def _run_window(self, feed) -> np.ndarray:
+        k = self.window_steps
+        if self.ddp is not None:
+            out = self.ddp.run_window(feed, k=k,
+                                      fetch_list=[self.loss_name],
+                                      scope=self.scope, return_numpy=True)
+            # [k, accum, dp, ...] -> per-step global-batch mean loss
+            a = np.asarray(out[0])
+            return a.reshape(k, -1).mean(axis=1)
+        out = self.exe.run_steps(self.program, feed=feed, k=k,
+                                 fetch_list=[self.loss_name],
+                                 scope=self.scope, return_numpy=True)
+        return np.asarray(out[0]).reshape(k, -1).mean(axis=1)
+
+    def run(self, num_windows: int) -> List[Dict[str, Any]]:
+        """Run windows ``self.window .. num_windows-1`` (resume-aware).
+        Returns one record per executed window: the per-step loss stream,
+        the snapshot serial it published (None = not due or skipped), and
+        rollback bookkeeping. Raises ``PreemptedError`` on a flagged
+        preemption (after the grace snapshot), ``RollbackExhausted`` past
+        the backoff budget, ``WorkerKilled`` under chaos."""
+        records: List[Dict[str, Any]] = []
+        acct = get_accountant()
+        if self.last_serial < 0:
+            # anchor snapshot: a rollback (or kill) before the first
+            # cadence snapshot needs a last-good to restore to
+            self.snapshot(sync=True)
+        consecutive = 0
+        failed_window = None
+        windows_since_snap = 0
+        last_snap_t = time.monotonic()
+        skipped = set(self.skipped_windows)
+        while self.window < num_windows:
+            w = self.window
+            if w in skipped:
+                self.window = w + 1
+                continue
+            if self._preempt.is_set():
+                self._preempt_exit()
+            action = None
+            if self.chaos is not None:
+                action = self.chaos.on_window(self, w)
+                if self._preempt.is_set():
+                    self._preempt_exit()
+            feed = dict(self.feed_fn(w))
+            if action == "nan":
+                name = sorted(feed)[0]
+                feed[name] = np.asarray(feed[name]) * np.float32("nan")
+            if acct.enabled:
+                acct.begin_window(f"resilient-w{w}")
+            losses = self._run_window(feed)
+            if self.chaos is not None:
+                self.chaos.on_window_end(self, w)
+            if not np.all(np.isfinite(losses)):
+                if acct.enabled:
+                    acct.end_window()
+                consecutive += 1
+                _resilience_metrics()["rollbacks"].inc()
+                self.rollbacks += 1
+                restored = self._restore()
+                ev = get_event_log()
+                if ev.enabled:
+                    ev.emit("rollback", severity="error", window=w,
+                            restored_serial=restored,
+                            consecutive=consecutive,
+                            skip=(failed_window == w))
+                    get_recorder().maybe_dump(
+                        {"type": "rollback", "window": w,
+                         "restored_serial": restored})
+                if consecutive > self.max_rollbacks:
+                    raise RollbackExhausted(w, consecutive)
+                if failed_window == w:
+                    # second consecutive fault on the SAME window: the
+                    # poison is in the data, not the weather — skip it
+                    # (recorded in the cursor) instead of NaN'ing forever
+                    skipped.add(w)
+                    self.skipped_windows = sorted(skipped)
+                    failed_window = None
+                else:
+                    failed_window = w
+                if self.rollback_backoff > 0.0:
+                    time.sleep(min(self.rollback_backoff_max,
+                                   self.rollback_backoff
+                                   * 2.0 ** min(consecutive - 1, 63)))
+                continue
+            consecutive = 0
+            failed_window = None
+            self.window = w + 1
+            self.global_step += self.window_steps
+            windows_since_snap += 1
+            rec = {"window": w, "losses": [float(x) for x in losses],
+                   "serial": None, "rollbacks": self.rollbacks}
+            if self.policy.due(windows_since_snap,
+                               time.monotonic() - last_snap_t):
+                # snapshot INSIDE the accounting window: the boundary
+                # copy (and a sync publish) is this window's exposed
+                # checkpoint cost; the async write lands in the next
+                # window's span, hidden under its device_compute
+                rec["serial"] = self.snapshot()
+                windows_since_snap = 0
+                last_snap_t = time.monotonic()
+            gw = acct.end_window() if acct.enabled else None
+            if gw is not None:
+                rec["goodput"] = gw
+            records.append(rec)
+            if self._preempt.is_set():
+                self._preempt_exit()
+        self.flush()
+        return records
+
+    def _preempt_exit(self) -> None:
+        """Grace path: final sync snapshot, events + bundle, typed exit."""
+        self._preempt.clear()
+        self.flush()
+        serial = self.snapshot(sync=True)
+        _resilience_metrics()["preemptions"].inc()
+        ev = get_event_log()
+        if ev.enabled:
+            ev.emit("preemption", severity="warn", serial=serial,
+                    window=self.window, step=self.global_step)
+            get_recorder().maybe_dump(
+                {"type": "preemption", "serial": serial,
+                 "window": self.window})
+        raise PreemptedError(serial, self.window)
+
+    def _provider_state(self) -> Dict[str, Any]:
+        state = {"window": self.window, "global_step": self.global_step,
+                 "last_serial": self.last_serial,
+                 "rollbacks": self.rollbacks,
+                 "skipped_windows": sorted(set(self.skipped_windows)),
+                 "dp": self._dp(),
+                 "resumed_serial": self.resumed_serial}
+        if self.chaos is not None:
+            state["chaos"] = self.chaos.snapshot()
+        if self.plan is not None:
+            state["plan"] = {"dp": self.plan.dp,
+                             "accum_steps": self.plan.accum_steps,
+                             "zero_stage": self.plan.zero_stage}
+        return state

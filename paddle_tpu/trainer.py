@@ -124,6 +124,7 @@ class Trainer:
             fluid_io.load_persistables(self.exe, param_path,
                                        self.train_program, scope=self.scope)
         self._resumed_serial = -1
+        self._train_state = None
         if self.checkpoint_cfg:
             try:
                 self._resumed_serial = fluid_io.load_checkpoint(
@@ -131,6 +132,19 @@ class Trainer:
                     self.train_program, scope=self.scope)
             except FileNotFoundError:
                 pass  # fresh start
+            if self._resumed_serial >= 0:
+                self._train_state = fluid_io.read_train_state(
+                    fluid_io.checkpoint_serial_dir(
+                        self.checkpoint_cfg.checkpoint_dir,
+                        self._resumed_serial))
+                if self._train_state is not None:
+                    # PRNG lineage: the executor's seed counter resumes
+                    # exactly where the checkpointed run left it, so
+                    # dropout/shuffle keys downstream of the resume are
+                    # the SAME keys the uninterrupted run would draw —
+                    # the bit-determinism half of the cursor (docs §26)
+                    self.exe._step_seed = int(self._train_state.get(
+                        "step_seed", self.exe._step_seed))
 
     def stop(self):
         """Request the train loop to exit after the current step
@@ -185,7 +199,19 @@ class Trainer:
         acct = goodput_from_flags()  # PT_FLAG_OBS_GOODPUT -> accounting
 
         step_count = 0
-        for epoch in range(num_epochs):
+        start_epoch, resume_skip = 0, 0
+        if self._train_state is not None:
+            # resume cursor (docs §26): the stamp names the NEXT (epoch,
+            # step) to execute, so a resumed run re-executes no step and
+            # skips none — consumed batches of the in-flight epoch are
+            # drained from the (deterministic) reader without running
+            ts = self._train_state
+            start_epoch = int(ts.get("epoch", 0))
+            resume_skip = int(ts.get("next_step", 0))
+            step_count = int(ts.get("step_count", 0))
+            self._train_state = None  # one resume per load
+        for epoch in range(start_epoch, num_epochs):
+            skip = resume_skip if epoch == start_epoch else 0
             event_handler(BeginEpochEvent(epoch))
             if acct.enabled:
                 # one goodput accounting window per epoch:
@@ -193,6 +219,8 @@ class Trainer:
                 # each epoch (docs §23)
                 acct.begin_window(f"epoch{epoch}")
             for step, feed in enumerate(feed_stream()):
+                if step < skip:
+                    continue  # already executed before the interruption
                 if self.stop_requested:
                     if acct.enabled:
                         acct.end_window()
@@ -257,13 +285,14 @@ class Trainer:
                 step_count += 1
                 if (self.checkpoint_cfg
                         and step_count % self.checkpoint_cfg.step_interval == 0):
-                    self._save_checkpoint()
+                    self._save_checkpoint(
+                        self._cursor(epoch, step + 1, step_count))
             if acct.enabled:
                 acct.end_window()
             event_handler(EndEpochEvent(epoch))
             if (self.checkpoint_cfg
                     and (epoch + 1) % self.checkpoint_cfg.epoch_interval == 0):
-                self._save_checkpoint()
+                self._save_checkpoint(self._cursor(epoch + 1, 0, step_count))
 
     def test(self, reader: Callable, feed_order: Sequence[str]) -> List[float]:
         """Average loss+metrics over the reader using the for_test clone
@@ -292,14 +321,26 @@ class Trainer:
                                       target_vars, self.exe,
                                       self.test_program, scope=self.scope)
 
-    def _save_checkpoint(self):
+    def _cursor(self, epoch: int, next_step: int, step_count: int) -> dict:
+        """The resume cursor stamped into every auto-checkpoint (docs
+        §26): the NEXT (epoch, step) to execute — never the last one
+        done, which is the classic replay-one-step off-by-one — plus the
+        executor's PRNG seed counter (the lineage the resumed run must
+        continue from) and the cadence counter."""
+        return {"schema": 1, "epoch": int(epoch),
+                "next_step": int(next_step),
+                "step_count": int(step_count),
+                "step_seed": int(self.exe._step_seed)}
+
+    def _save_checkpoint(self, train_state: Optional[dict] = None):
         fluid_io.save_checkpoint(
             self.exe, self.checkpoint_cfg.checkpoint_dir,
             main_program=self.train_program,
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
             scope=self.scope,
             zero_meta=self.ddp.zero_meta() if self.ddp is not None
-            else None)
+            else None,
+            train_state=train_state)
 
 
 class Inferencer:

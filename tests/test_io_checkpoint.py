@@ -133,6 +133,87 @@ def test_checkpoint_corruption_falls_back_to_older(tmp_path):
         io.load_checkpoint(exe, ckpt, main, scope=fluid.Scope())
 
 
+def test_kill_between_manifest_and_success_is_invisible(tmp_path):
+    """The crash window (docs §26): a kill AFTER ``_MANIFEST.json`` lands
+    but BEFORE the ``_SUCCESS`` marker leaves a torn serial dir that the
+    loader must never consider — resume lands on the newest *complete*
+    serial, bit-exact, with no corruption warning (the torn dir is
+    invisible, not 'corrupt')."""
+    import warnings
+
+    main, startup, pred, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    ckpt = str(tmp_path / "ckpts")
+    X = np.random.randn(8, 4).astype("float32")
+    Y = np.random.randint(0, 3, (8, 1)).astype("int64")
+    per_serial = {}
+    for step in range(3):
+        exe.run(main, feed={"x": X, "label": Y}, fetch_list=[], scope=scope)
+        serial = io.save_checkpoint(exe, ckpt, main_program=main, scope=scope)
+        per_serial[serial] = {
+            v.name: np.asarray(scope.get(v.name)).copy()
+            for v in main.list_vars() if v.persistable}
+    latest = max(per_serial)
+
+    # simulate the kill: the newest serial has every array + the digest
+    # manifest on disk, but died before the _SUCCESS marker was written
+    torn = os.path.join(ckpt, f"checkpoint_{latest}")
+    assert os.path.exists(os.path.join(torn, io.MANIFEST_FILENAME))
+    os.remove(os.path.join(torn, io.SUCCESS_MARKER))
+
+    # the torn serial is invisible to discovery ...
+    assert io._checkpoint_serials(ckpt) == sorted(
+        s for s in per_serial if s != latest)
+    # ... and the loader resumes the newest COMPLETE serial, silently
+    scope2 = fluid.Scope()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        got = io.load_checkpoint(exe, ckpt, main, scope=scope2)
+    assert got == latest - 1
+    for name, want in per_serial[latest - 1].items():
+        np.testing.assert_array_equal(np.asarray(scope2.get(name)), want,
+                                      err_msg=name)
+
+
+def test_scroll_delete_keeps_newest_complete_and_sweeps_torn(tmp_path):
+    """Retention GC invariants (docs §26): the newest ``_SUCCESS``-complete
+    serial is NEVER deleted (even at max_num_checkpoints=1); torn dirs
+    older than it are swept; torn dirs NEWER than it — a save possibly in
+    flight — are left alone."""
+    main, startup, pred, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    ckpt = str(tmp_path / "ckpts")
+    X = np.random.randn(8, 4).astype("float32")
+    Y = np.random.randint(0, 3, (8, 1)).astype("int64")
+    for step in range(3):
+        exe.run(main, feed={"x": X, "label": Y}, fetch_list=[], scope=scope)
+        io.save_checkpoint(exe, ckpt, main_program=main, scope=scope,
+                           max_num_checkpoints=0)  # no GC yet
+    # tear serial 1 (an old crash leftover) and fabricate serial 99 with
+    # no marker (a save in flight from another thread/host)
+    os.remove(os.path.join(ckpt, "checkpoint_1", io.SUCCESS_MARKER))
+    os.makedirs(os.path.join(ckpt, "checkpoint_99"))
+    with open(os.path.join(ckpt, "checkpoint_99", "partial.npy"), "wb") as f:
+        f.write(b"in-flight")
+
+    io._scroll_delete(ckpt, max_num_checkpoints=1)
+    left = sorted(os.listdir(ckpt))
+    # serial 2 (newest complete) survives the budget-of-1; serial 0 fell
+    # to rotation; torn serial 1 was swept; torn serial 99 was left alone
+    assert left == ["checkpoint_2", "checkpoint_99"], left
+    assert os.path.exists(os.path.join(ckpt, "checkpoint_2",
+                                       io.SUCCESS_MARKER))
+
+    # degenerate budget, single complete serial: still never deleted
+    io._scroll_delete(ckpt, max_num_checkpoints=1)
+    assert io._checkpoint_serials(ckpt) == [2]
+    assert io.load_checkpoint(exe, ckpt, main, scope=fluid.Scope()) == 2
+
+
 def test_sharded_checkpoint_roundtrip_no_gather(tmp_path):
     """dp-sharded params save per-shard files (no host gather of the global
     array) and load straight back onto their devices; training resumes with

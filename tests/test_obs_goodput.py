@@ -66,9 +66,13 @@ def test_stage_list_has_one_owner():
     # the train taxonomy is exhaustive: sweep categories + idle
     # (ISSUE 15 added `collective` — the sharded trainer's in-window
     # reduce-scatter/all-gather attribution, docs §24)
+    # (ISSUE 17 added `checkpoint` — async snapshot attribution,
+    # docs §26: hidden-behind-compute snapshots stay device_compute,
+    # only exposed checkpoint seconds surface, and always as badput)
     assert set(TRAIN_CATEGORIES) - {"idle"} == \
         {"device_compute", "collective", "host_input", "h2d", "compile",
-         "fetch_sync"}
+         "fetch_sync", "checkpoint"}
+    assert "checkpoint" not in GOOD_CATEGORIES
     # goodput classification covers only known categories
     assert GOOD_CATEGORIES <= set(TRAIN_CATEGORIES) | set(STAGES)
 

@@ -345,13 +345,16 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     bench._PREV = {}
-    # all fourteen tracked metrics carry a bar (r8 added sharded serving,
+    # all fifteen tracked metrics carry a bar (r8 added sharded serving,
     # r10 the quantized CPU serving lane, r11/ISSUE-12 the tuner
     # contract, r13/ISSUE-13 the paged-KV prefix-cache workload,
     # r14/ISSUE-14 the goodput accounting-closure contract, r15/ISSUE-15
     # the sharded data-parallel training workload, r16/ISSUE-16 the
-    # speculative-decode commit ratio)
-    assert len(bench.BARS) == 14
+    # speculative-decode commit ratio, r17/ISSUE-17 the fault-tolerant
+    # training recovery contract)
+    assert len(bench.BARS) == 15
+    res = bench.BARS["resilient_training_recovery"]
+    assert res["field"] == "value" and res["min"] == 0.95
     spd = bench.BARS["speculative_decode_token_ratio"]
     assert spd["field"] == "value" and spd["min"] == 1.5
     assert spd.get("provisional") is True

@@ -95,6 +95,71 @@ def test_trainer_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(w1, w2)
 
 
+def test_trainer_resume_executes_each_step_exactly_once(tmp_path):
+    """Resume off-by-one guard (docs §26): the checkpoint cursor names the
+    NEXT step to execute, so a killed-and-resumed run replays no step and
+    skips none. A counting reader + event log pin the exact (epoch, step)
+    schedule, and the resumed params are BIT-identical to an uninterrupted
+    run — replaying even one step (the classic last-step-redone bug) or
+    dropping one would break the equality."""
+
+    def det_reader():
+        # per-epoch deterministic: the RandomState is created inside the
+        # call, so every epoch replays the same 16 samples — the resume
+        # contract's precondition
+        rng = np.random.RandomState(7)
+        for _ in range(16):
+            x = rng.randn(13).astype("float32")
+            y = (x @ W_TRUE + 0.5).astype("float32")
+            yield x, y
+
+    batched = fluid.reader.batch(lambda: det_reader(), batch_size=4)
+
+    def make(seed):
+        cfg = fluid.CheckpointConfig(str(tmp_path / "ckpt"), step_interval=3)
+        return fluid.Trainer(_train_func, _optimizer_func,
+                             place=fluid.CPUPlace(),
+                             checkpoint_config=cfg, seed=seed)
+
+    # --- interrupted leg: stop right after the step-3 checkpoint lands
+    executed = []
+
+    def stopper(e):
+        if isinstance(e, fluid.EndStepEvent):
+            executed.append((e.epoch, e.step))
+            if (e.epoch, e.step) == (0, 2):  # step_count hits 3 -> save
+                t1.stop()
+
+    t1 = make(seed=3)
+    t1.train(num_epochs=2, event_handler=stopper, reader=batched,
+             feed_order=["x", "y"])
+    assert executed == [(0, 0), (0, 1), (0, 2)]
+    assert t1._resumed_serial == -1
+
+    # --- resumed leg: picks up at (0, 3), re-executes nothing
+    resumed = []
+
+    def recorder(e):
+        if isinstance(e, fluid.EndStepEvent):
+            resumed.append((e.epoch, e.step))
+
+    t2 = make(seed=99)  # seed must not matter: state comes off disk
+    assert t2._resumed_serial >= 0
+    t2.train(num_epochs=2, event_handler=recorder, reader=batched,
+             feed_order=["x", "y"])
+    assert resumed == [(0, 3), (1, 0), (1, 1), (1, 2), (1, 3)]
+
+    # --- reference leg: same schedule, never interrupted, fresh dir
+    cfg3 = fluid.CheckpointConfig(str(tmp_path / "ref"), step_interval=3)
+    t3 = fluid.Trainer(_train_func, _optimizer_func, place=fluid.CPUPlace(),
+                       checkpoint_config=cfg3, seed=3)
+    t3.train(num_epochs=2, reader=batched, feed_order=["x", "y"])
+
+    name = _param_name(t2)
+    np.testing.assert_array_equal(np.asarray(t2.scope.get(name)),
+                                  np.asarray(t3.scope.get(name)))
+
+
 def _param_name(trainer):
     return next(n for n, v in trainer.train_program.global_block().vars.items()
                 if v.persistable and n.endswith(".w_0"))

@@ -418,6 +418,28 @@ def doctor_findings(bundle):
                              f"step(s) {steps[:5]} — see the captured "
                              f"metrics/flags for the config that produced "
                              f"it"))
+        elif typ == "rollback":
+            windows = sorted({(x.get("attrs") or {}).get("window")
+                              for x in evs} - {None})
+            serials = sorted({(x.get("attrs") or {}).get("restored_serial")
+                              for x in evs} - {None})
+            skipped = sum(1 for x in evs
+                          if (x.get("attrs") or {}).get("skip"))
+            tail = (f"; {skipped} window(s) ultimately SKIPPED "
+                    f"(poisoned data, stamped in the cursor)"
+                    if skipped else "")
+            findings.append((score * 3, f"resilience: {len(evs)} "
+                             f"rollback(s) to snapshot serial(s) "
+                             f"{serials[:5]} at window(s) {windows[:5]}"
+                             f"{tail} — the nan_detected/chaos findings "
+                             f"name the trigger"))
+        elif typ == "preemption":
+            serials = sorted({(x.get("attrs") or {}).get("serial")
+                              for x in evs} - {None})
+            findings.append((score, f"resilience: preemption drained "
+                             f"with grace snapshot serial(s) "
+                             f"{serials[:5]} — the resumed run continues "
+                             f"bit-exactly from there"))
         elif typ == "slo_breach":
             slos = {}
             for x in evs:
