@@ -104,13 +104,29 @@ def _train_metrics():
             "dp": r.gauge("pt_train_dp",
                           "Data-parallel width of the sharded training "
                           "step (1 = unsharded)"),
+            # 3D plane (docs §27): tensor/pipeline widths plus the
+            # slice of the modeled collective seconds the overlap
+            # measurement shows hidden under compute (modeled minus
+            # exposed wall-clock delta vs. the collective-ablated twin)
+            "tp": r.gauge("pt_train_tp",
+                          "Tensor-parallel width of the sharded training "
+                          "step (1 = unsharded)"),
+            "pp": r.gauge("pt_train_pp",
+                          "Pipeline-parallel depth of the training step "
+                          "(1 = no pipeline)"),
             "collective": r.counter(
                 "pt_train_collective_seconds_total",
                 "Model-attributed reduce-scatter/all-gather seconds "
                 "inside sharded training windows"),
+            "hidden_collective": r.counter(
+                "pt_train_hidden_collective_seconds_total",
+                "Model-attributed collective seconds hidden under "
+                "compute (overlap-measured windows only)"),
             "window": window,
         }
         _train_obs["dp"].set(1.0)
+        _train_obs["tp"].set(1.0)
+        _train_obs["pp"].set(1.0)
         r.gauge("pt_train_flops_per_second",
                 "Windowed rate of cost-analysis FLOPs dispatched",
                 callback=window.rate)
@@ -666,7 +682,15 @@ class Executor:
                 raise RuntimeError(
                     f"variable {n!r} is read by the program but missing from "
                     f"the scope; run the startup program first")
-            readonly[n] = v
+            # COMMIT to the executor device: startup-run outputs are
+            # uncommitted jax arrays, and an uncommitted vs committed input
+            # changes the jit signature — window 1 would compile for the
+            # uncommitted startup state and window 2 recompile for the
+            # committed window-1 outputs (one wasted XLA compile per
+            # signature). device_put of an already-committed resident array
+            # is a no-op, so every window after the first hits this fast.
+            readonly[n] = (v if not isinstance(v, jax.Array)
+                           else jax.device_put(v, self._device))
         state = {}
         for n in state_out_names:
             v = scope.get(n, _MISSING)
@@ -675,7 +699,9 @@ class Executor:
                     f"state variable {n!r} has no initial value in the scope "
                     f"(run_steps carries the full state; run the startup "
                     f"program first)")
-            state[n] = v
+            state[n] = (v if not isinstance(v, jax.Array)
+                        else jax.device_put(v, self._device))
+            scope.set(n, state[n])
 
         # per-step PRNG keys: step i of the window draws the same key the
         # i-th sequential run() call would, so pipelined and unpipelined

@@ -733,7 +733,22 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
                 continue
             arr = np.asarray(val)
             nelem = int(info.get("nelem") or np.prod(shape))
-            if arr.ndim == 1 and arr.shape != shape and arr.size >= nelem:
+            tp = int(info.get("tp") or 1)
+            if arr.ndim != 1 or arr.shape == shape or arr.size < nelem:
+                continue
+            if tp > 1 and len(shape) >= 2 and shape[-1] % tp == 0 \
+                    and arr.size % tp == 0:
+                # schema-2 tp layout: the flat is a tp-major concat of
+                # dp-padded column shards — restack the columns (mirrors
+                # ShardedTrainStep._unflatten_local without needing the
+                # live step object)
+                per = arr.size // tp
+                nloc = nelem // tp
+                loc = shape[:-1] + (shape[-1] // tp,)
+                cols = [arr[t * per:t * per + nloc].reshape(loc)
+                        for t in range(tp)]
+                sc.set(name, np.concatenate(cols, axis=-1))
+            else:
                 sc.set(name, arr[:nelem].reshape(shape))
     for table in (host_tables or []):
         tdir = _host_table_dir(cur, table.name, jax.process_index())

@@ -54,8 +54,9 @@ from .metrics import MetricsRegistry, RateWindow, get_registry
 
 #: training-plane taxonomy (docs §23; ``collective`` added by the sharded
 #: trainer, docs §24). ``idle`` is the sweep residual.
-TRAIN_CATEGORIES = ("device_compute", "collective", "host_input", "h2d",
-                    "compile", "fetch_sync", "checkpoint", "idle")
+TRAIN_CATEGORIES = ("device_compute", "collective", "collective_hidden",
+                    "host_input", "h2d", "compile", "fetch_sync",
+                    "checkpoint", "idle")
 
 #: sweep priorities: at any instant the highest-priority *active* interval
 #: owns it (device beats everything — host work overlapped with the device
@@ -72,7 +73,13 @@ TRAIN_CATEGORIES = ("device_compute", "collective", "host_input", "h2d",
 #: publish tail spilling past the window — surface as checkpoint badput.
 TRAIN_PRIORITY = {"collective": 7, "device_compute": 6, "compile": 5,
                   "fetch_sync": 4, "h2d": 3, "host_input": 2,
-                  "checkpoint": 1}
+                  "checkpoint": 1,
+                  # the hidden slice of the collective model (docs §27):
+                  # lowest priority so any concurrent interval — above
+                  # all, device_compute — owns the wall-clock; the
+                  # category records that the seconds existed and were
+                  # overlapped, without ever carving time out of compute
+                  "collective_hidden": 0}
 
 #: categories whose seconds count as GOODPUT (the device doing, or the
 #: host blocked on, useful model math); everything else — queueing,

@@ -17,6 +17,8 @@ normalization statistics, f32 master weights cast at point of use.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -45,7 +47,49 @@ def _dot(x, w, amp):
     return out.astype(x.dtype if amp else out.dtype)
 
 
-def _decoder_layer(p, x, n_heads, causal, amp, tp_axis=None):
+# Megatron region boundaries for callers that run jax.vjp INSIDE the
+# shard_map body (the 1F1B engine differentiates each stage per
+# microbatch). There, psum's transpose rule is psum — which double-counts
+# replicated cotangents — so the correct per-rank backward must be spelled
+# out: identity-forward/psum-backward entering a column-parallel region,
+# psum-forward/identity-backward leaving a row-parallel one. Differentiated
+# from OUTSIDE the shard_map (the GPipe path), plain lax.psum is the
+# correct spelling and these boundaries would be wrong — hence the
+# ``inner_vjp`` switch instead of a blanket replacement.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _copy_to_tp(x, axis):
+    return x
+
+
+def _copy_to_tp_fwd(x, axis):
+    return x, None
+
+
+def _copy_to_tp_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+_copy_to_tp.defvjp(_copy_to_tp_fwd, _copy_to_tp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _reduce_from_tp(x, axis):
+    return lax.psum(x, axis)
+
+
+def _reduce_from_tp_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_from_tp_bwd(axis, _, ct):
+    return (ct,)
+
+
+_reduce_from_tp.defvjp(_reduce_from_tp_fwd, _reduce_from_tp_bwd)
+
+
+def _decoder_layer(p, x, n_heads, causal, amp, tp_axis=None,
+                   inner_vjp=False):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). p: single-layer dict.
 
     ``tp_axis``: when set, the layer runs as one Megatron shard inside a
@@ -58,6 +102,8 @@ def _decoder_layer(p, x, n_heads, causal, amp, tp_axis=None):
     d_head = d // n_heads
     n_heads_local = p["wq"].shape[-1] // d_head  # n_heads/tp under a shard
     a = _ln(x, p["ln1s"], p["ln1b"])
+    if tp_axis is not None and inner_vjp:
+        a = _copy_to_tp(a, tp_axis)
     q = _dot(a, p["wq"], amp).reshape(mb, t, n_heads_local, d_head)
     k = _dot(a, p["wk"], amp).reshape(mb, t, n_heads_local, d_head)
     v = _dot(a, p["wv"], amp).reshape(mb, t, n_heads_local, d_head)
@@ -65,15 +111,19 @@ def _decoder_layer(p, x, n_heads, causal, amp, tp_axis=None):
     ctx_v = ctx_v.reshape(mb, t, n_heads_local * d_head)
     attn = _dot(ctx_v, p["wo"], amp)
     if tp_axis is not None:
-        attn = lax.psum(attn, tp_axis)
+        attn = (_reduce_from_tp(attn, tp_axis) if inner_vjp
+                else lax.psum(attn, tp_axis))
     x = x + attn.astype(x.dtype)
     f = _ln(x, p["ln2s"], p["ln2b"])
+    if tp_axis is not None and inner_vjp:
+        f = _copy_to_tp(f, tp_axis)
     h = _dot(f, p["wup"], amp) + p["bup"].astype(
         jnp.bfloat16 if amp else p["bup"].dtype)
     h = jax.nn.relu(h)
     f = _dot(h, p["wdown"], amp)
     if tp_axis is not None:
-        f = lax.psum(f, tp_axis)
+        f = (_reduce_from_tp(f, tp_axis) if inner_vjp
+             else lax.psum(f, tp_axis))
     f = f + p["bdown"].astype(jnp.bfloat16 if amp else p["bdown"].dtype)
     return x + f.astype(x.dtype)
 

@@ -239,23 +239,55 @@ class ShardedTrainStep:
     """
 
     def __init__(self, program, *, dp: int = 1, accum_steps: int = 1,
-                 zero_stage: int = 2, place=None, amp: bool = False,
-                 executor=None, devices=None, link_gbps: float = 45.0):
+                 zero_stage: int = 2, tp: int = 1, pp: int = 1,
+                 place=None, amp: bool = False,
+                 executor=None, devices=None, link_gbps: float = 45.0,
+                 zero3_bucket_mb: float = 4.0, measure_overlap: bool = False,
+                 pp_microbatches: Optional[int] = None):
         from ..core.executor import Executor
 
         if dp < 1:
             raise ShardedTrainError(f"dp must be >= 1, got {dp}")
+        if tp < 1:
+            raise ShardedTrainError(f"tp must be >= 1, got {tp}")
+        if pp < 1:
+            raise ShardedTrainError(f"pp must be >= 1, got {pp}")
         if accum_steps < 1:
             raise ShardedTrainError(
                 f"accum_steps must be >= 1, got {accum_steps}")
-        if zero_stage not in (1, 2):
+        if zero_stage not in (1, 2, 3):
             raise ShardedTrainError(
-                f"zero_stage must be 1 or 2, got {zero_stage}")
+                f"zero_stage must be 1, 2 or 3, got {zero_stage}")
+        if zero_stage == 3 and dp < 2:
+            raise ShardedTrainError(
+                "zero_stage=3 shards parameters over dp; dp=1 leaves "
+                "nothing to shard — use zero_stage<=2 (docs/design.md §27 "
+                "failure matrix)")
+        if pp > 1 and zero_stage > 1:
+            raise ShardedTrainError(
+                f"zero_stage={zero_stage} does not compose with pipeline "
+                f"stages (pp={pp}): stage gradients live per device on the "
+                f"'pp' axis and cannot be reduce-scattered over 'dp' "
+                f"element ranges — use zero_stage=1 with pp, or pp=1 "
+                f"(docs/design.md §27 failure matrix)")
+        if pp > 1 and accum_steps > 1:
+            raise ShardedTrainError(
+                f"accum_steps={accum_steps} does not compose with pp={pp}: "
+                f"the pipeline's microbatch schedule IS the accumulation "
+                f"window — raise pp_microbatches instead (docs/design.md "
+                f"§27 failure matrix)")
         self.program = program
         self.dp = int(dp)
+        self.tp = int(tp)
+        self.pp = int(pp)
         self.accum_steps = int(accum_steps)
         self.zero_stage = int(zero_stage)
         self.link_bw = float(link_gbps) * 1e9
+        self.zero3_bucket_bytes = max(0.0, float(zero3_bucket_mb)) * 2 ** 20
+        self.measure_overlap = bool(measure_overlap)
+        self.pp_microbatches = (int(pp_microbatches)
+                                if pp_microbatches else None)
+        self.pp_schedule: Optional[str] = None  # set by the pp path
         self.exe = executor if executor is not None else Executor(place,
                                                                   amp=amp)
         self.amp = self.exe.amp
@@ -273,27 +305,33 @@ class ShardedTrainStep:
                 f"dp nor survives microbatching; train it unsharded "
                 f"(dp=1, accum_steps=1) or move it behind the optimizer")
         self.mesh = None
-        if self.dp > 1:
+        n_dev = self.dp * self.tp * self.pp
+        if n_dev > 1:
             import jax
 
-            from .mesh import make_mesh
+            from .mesh import train_mesh
 
             platform = self.exe._device.platform
             if devices is None:
                 devices = jax.devices(platform)
-            if self.dp > len(devices):
+            if n_dev > len(devices):
                 raise ShardedTrainError(
-                    f"dp={self.dp} needs {self.dp} devices, only "
+                    f"dp*tp*pp={n_dev} needs {n_dev} devices, only "
                     f"{len(devices)} available (host meshes: set XLA_FLAGS="
                     f"--xla_force_host_platform_device_count=N before jax "
                     f"initializes)")
-            self.mesh = make_mesh({"dp": self.dp},
-                                  devices=devices[:self.dp])
-        # name -> (logical_shape, nelem, padded, shard, np_dtype)
+            self.mesh = train_mesh(self.dp, self.tp, self.pp,
+                                   devices=devices[:n_dev])
+        # name -> (LOCAL_shape, nelem_loc, padded_loc, shard_loc, np_dtype)
+        # — local means this param's 1/tp column shard when tp-eligible,
+        # the logical shape otherwise (self._tp_parts / self._logical)
         self._layout: Dict[str, Tuple] = {}
+        self._logical: Dict[str, Tuple] = {}   # name -> full logical shape
+        self._tp_parts: Dict[str, int] = {}    # name -> tp shard count (>=1)
         self._placed: Dict[str, Any] = {}  # identity cache of placed state
         self._cache: Dict[Any, Any] = {}   # compiled windows
         self._readonly_cache: Dict[Tuple, List[str]] = {}
+        self._pp_cache: Dict[Any, Any] = {}
 
     # -- state layout -------------------------------------------------------
     def _spec(self, *axes):
@@ -307,37 +345,153 @@ class ShardedTrainStep:
 
         return NamedSharding(self.mesh, PartitionSpec(*axes))
 
+    def _tp_of(self, shape) -> int:
+        """How many column shards a param of ``shape`` splits into on the
+        'tp' axis: every >=2-D tensor whose LAST dim divides by tp
+        column-shards (fc / matmul / fused-QKV weights). Bit-safety does
+        not hinge on this classification — the window all-gathers the
+        full weight at a static boundary before any contraction (docs
+        §27), so sharding is purely a residency choice."""
+        if self.tp > 1 and len(shape) >= 2 and shape[-1] % self.tp == 0:
+            return self.tp
+        return 1
+
+    def _set_layout(self, name: str, logical_shape, dtype) -> None:
+        """Record logical + LOCAL (1/tp column shard) flat layout for one
+        param-shaped tensor."""
+        logical = tuple(int(s) for s in logical_shape)
+        tp_p = self._tp_of(logical)
+        local = (logical[:-1] + (logical[-1] // tp_p,)) if tp_p > 1 \
+            else logical
+        nelem_loc = int(np.prod(local)) if local else 1
+        shard_loc = -(-nelem_loc // self.dp)  # ceil
+        self._logical[name] = logical
+        self._tp_parts[name] = tp_p
+        try:
+            dt = np.dtype(dtype)
+        except TypeError:
+            dt = np.dtype(str(dtype))
+        self._layout[name] = (local, nelem_loc, shard_loc * self.dp,
+                              shard_loc, dt)
+
+    def _flat_spec(self, name):
+        """Sharding for a flat 1-D state array in the (tp-major,
+        dp-padded) layout: P(('tp','dp')) when the tensor column-shards,
+        P('dp') otherwise."""
+        from jax.sharding import PartitionSpec
+
+        if self._tp_parts.get(name, 1) > 1:
+            return self._spec(("tp", "dp"))
+        return self._spec("dp")
+
+    def _flatten_local(self, host: np.ndarray, name: str) -> np.ndarray:
+        """Logical host array -> flat 1-D (tp * padded_loc) in the layout
+        ``_flat_spec`` shards: per tp rank, that rank's column shard
+        flattened and zero-padded to a dp multiple, concatenated
+        tp-major."""
+        local, nelem_loc, padded_loc, _sh, _dt = self._layout[name]
+        tp_p = self._tp_parts[name]
+        host = np.asarray(host)
+        pieces = []
+        for t in range(tp_p):
+            if tp_p > 1:
+                cols = local[-1]
+                piece = host[..., t * cols:(t + 1) * cols].reshape(-1)
+            else:
+                piece = host.reshape(-1)
+            if padded_loc > nelem_loc:
+                piece = np.concatenate(
+                    [piece, np.zeros(padded_loc - nelem_loc, piece.dtype)])
+            pieces.append(piece)
+        return pieces[0] if tp_p == 1 else np.concatenate(pieces)
+
+    def _unflatten_local(self, flat, name: str) -> np.ndarray:
+        """Inverse of ``_flatten_local``: flat (tp * padded_loc) host
+        array -> logical shape (column shards re-concatenated on the last
+        dim)."""
+        local, nelem_loc, _padded, _sh, _dt = self._layout[name]
+        tp_p = self._tp_parts[name]
+        flat = np.asarray(flat).reshape(-1)
+        rows = flat.reshape(tp_p, -1)[:, :nelem_loc]
+        parts = [r.reshape(local) for r in rows]
+        out = parts[0] if tp_p == 1 else np.concatenate(parts, axis=-1)
+        return out.reshape(self._logical[name])
+
+    def _host_logical(self, val, name: str) -> np.ndarray:
+        """Coerce a scope value to its logical host shape. Accepts the
+        logical array (fresh startup, an io-restored checkpoint — io.py
+        reconstructs column shards from the _ZERO.json layout stamp), a
+        flat array in THIS config's layout, or a flat dp-only layout from
+        a pre-tp checkpoint."""
+        logical = self._logical[name]
+        host = np.asarray(val)
+        if tuple(host.shape) == logical:
+            return host
+        flat = host.reshape(-1)
+        tp_p = self._tp_parts[name]
+        _local, nelem_loc, padded_loc, _sh, _dt = self._layout[name]
+        if flat.size == tp_p * padded_loc and tp_p > 1:
+            return self._unflatten_local(flat, name)
+        nelem = int(np.prod(logical)) if logical else 1
+        if flat.size < nelem:
+            raise ShardedTrainError(
+                f"state {name!r} holds {flat.size} elements, fewer than "
+                f"its logical {nelem} — the checkpoint does not match "
+                f"this program")
+        # dp-only flat layout (any previous dp): unpad is the reshard
+        return flat[:nelem].reshape(logical)
+
     def _prepare_state(self, scope) -> None:
-        """Lay the scope's training state out on the mesh: params and
-        scalar state replicated, param-shaped accumulators flattened,
-        zero-padded to a dp multiple, and sharded 1/dp. Accepts state in
-        logical shape (a fresh startup run, a dp=1 checkpoint) OR as the
-        flat padded array of ANY previous dp (a sharded checkpoint
-        restored onto a different mesh) — reshard-on-load is this
-        unpad/repad, not a special path."""
+        """Lay the scope's training state out on the mesh (docs §24/§27):
+
+        * params — zero_stage<=2: replicated over dp, column-sharded
+          P(None, ..., 'tp') over tp when eligible; zero_stage=3: flat
+          1-D (tp-major, dp-padded) shards — 1/(tp*dp) resident bytes;
+        * param-shaped accumulators — always the flat layout;
+        * scalar state — replicated.
+
+        Accepts state in logical shape (a fresh startup run, an
+        io-restored checkpoint of any layout) OR a flat array of any
+        previous dp — reshard-on-load is this unpad/repad, not a special
+        path."""
         import jax
 
         split = self.split
         repl = self._spec()
-        shard_spec = self._spec("dp")
         for p in split.param_names:
             val = scope.get(p)
             if val is None:
                 raise RuntimeError(
                     f"param {p!r} has no value in the scope; run the "
                     f"startup program first")
-            arr = np.asarray(val) if not hasattr(val, "sharding") else val
-            nelem = int(np.prod(arr.shape)) if arr.shape else 1
-            shard = -(-nelem // self.dp)  # ceil
-            self._layout[p] = (tuple(arr.shape), nelem, shard * self.dp,
-                               shard, np.dtype(str(arr.dtype)))
-            if self._placed.get(p) is not scope.get(p):
-                placed = jax.device_put(val, repl)
-                scope.set(p, placed)
-                self._placed[p] = placed
+            if p not in self._layout:
+                shape = (val.shape if hasattr(val, "shape")
+                         else np.asarray(val).shape)
+                dt = getattr(val, "dtype", None) or np.asarray(val).dtype
+                # a flat zero-3 restore from THIS config: recover the
+                # logical shape from the program declaration
+                block = self.program.blocks[self.split.block_idx]
+                var = block.find_var_recursive(p)
+                if var is not None and var.shape and \
+                        tuple(var.shape) != tuple(shape):
+                    shape = tuple(var.shape)
+                self._set_layout(p, shape, dt)
+            if self._placed.get(p) is scope.get(p):
+                continue
+            host = self._host_logical(val, p)
+            if self.zero_stage == 3:
+                placed = jax.device_put(self._flatten_local(host, p),
+                                        self._flat_spec(p))
+            elif self._tp_parts[p] > 1:
+                nd = len(self._logical[p])
+                placed = jax.device_put(
+                    host, self._spec(*((None,) * (nd - 1) + ("tp",))))
+            else:
+                placed = jax.device_put(host, repl)
+            scope.set(p, placed)
+            self._placed[p] = placed
         for a in split.sharded_acc_names:
             p = split.acc_param[a]
-            shape, nelem, padded, shard, _pd = self._layout[p]
             val = scope.get(a)
             if val is None:
                 raise RuntimeError(
@@ -345,19 +499,15 @@ class ShardedTrainStep:
                     f"run the startup program first")
             if self._placed.get(a) is scope.get(a):
                 continue
-            host = np.asarray(val)
-            flat = host.reshape(-1)
-            if flat.size < nelem:
-                raise ShardedTrainError(
-                    f"optimizer state {a!r} holds {flat.size} elements, "
-                    f"fewer than its param's {nelem} — the checkpoint does "
-                    f"not match this program")
-            flat = flat[:nelem]  # drop any previous dp's padding
-            if padded > nelem:
-                flat = np.concatenate(
-                    [flat, np.zeros(padded - nelem, flat.dtype)])
-            self._layout[a] = (shape, nelem, padded, shard, flat.dtype)
-            placed = jax.device_put(flat, shard_spec)
+            self._logical[a] = self._logical[p]
+            self._tp_parts[a] = self._tp_parts[p]
+            self._layout[a] = self._layout[p]
+            host = self._host_logical(val, a)
+            local, nelem_loc, padded_loc, shard_loc, _pd = self._layout[p]
+            self._layout[a] = (local, nelem_loc, padded_loc, shard_loc,
+                               np.dtype(str(host.dtype)))
+            placed = jax.device_put(self._flatten_local(host, a),
+                                    self._flat_spec(a))
             scope.set(a, placed)
             self._placed[a] = placed
         for s in split.scalar_state_names:
@@ -373,27 +523,38 @@ class ShardedTrainStep:
 
     def gather_state(self, scope) -> None:
         """Convert the scope's ZeRO state back to logical shapes (host
-        numpy): unpad each flat shard array and reshape to its param's
-        shape. After this the scope drives the plain Executor again (or
-        saves a dp-agnostic checkpoint)."""
+        numpy): unflatten each flat (tp-major, dp-padded) array, restack
+        column shards, and reshape to the param's logical shape. After
+        this the scope drives the plain Executor again (or saves a
+        layout-agnostic checkpoint)."""
         for a in self.split.sharded_acc_names:
             lay = self._layout.get(a)
-            if lay is None:
-                continue
-            shape, nelem = lay[0], lay[1]
             val = scope.get(a)
             if val is None:
                 continue
-            host = np.asarray(val).reshape(-1)
-            if host.size != nelem:
-                host = host[:nelem]
-            scope.set(a, host.reshape(shape))
+            if lay is None:
+                # pp path: accumulators are logically shaped (just
+                # device-placed) — host round-trip is a plain copy
+                scope.set(a, np.asarray(val))
+            else:
+                scope.set(a, self._unflatten_local(np.asarray(val), a))
             self._placed.pop(a, None)
-        for p in self.split.param_names + self.split.scalar_state_names:
+        for p in self.split.param_names:
             val = scope.get(p)
+            if val is None:
+                continue
+            host = np.asarray(val)
+            if self.zero_stage == 3 and p in self._layout \
+                    and host.ndim == 1 \
+                    and tuple(host.shape) != self._logical.get(p):
+                host = self._unflatten_local(host, p)
+            scope.set(p, host)
+            self._placed.pop(p, None)
+        for s in self.split.scalar_state_names:
+            val = scope.get(s)
             if val is not None:
-                scope.set(p, np.asarray(val))
-                self._placed.pop(p, None)
+                scope.set(s, np.asarray(val))
+                self._placed.pop(s, None)
         # the scope now drives the plain (unsharded) executor again —
         # the dp gauge must not keep reporting this step's width
         from ..core.executor import _train_metrics
@@ -402,18 +563,44 @@ class ShardedTrainStep:
 
     def zero_meta(self) -> Dict[str, Any]:
         """The reshard descriptor a checkpoint carries (io.py writes it
-        as ``_ZERO.json``): enough to validate a restore onto any dp."""
+        as ``_ZERO.json``): the full 3D layout stamp — enough to validate
+        a restore onto any (dp, tp) and to refuse a mismatched pp. Each
+        flat-stored var records its logical shape plus the tp shard count
+        its on-disk flat layout was built with, so io.load_checkpoint can
+        reconstruct logical arrays without this class (schema 2; schema-1
+        readers see the same dp/zero keys they always did)."""
+        vars_meta: Dict[str, Any] = {}
+
+        def entry(name):
+            p = self.split.acc_param.get(name, name)
+            if p not in self._logical:
+                return None
+            logical = self._logical[p]
+            return {"param": p, "shape": list(logical),
+                    "nelem": int(np.prod(logical)) if logical else 1,
+                    "tp": self._tp_parts.get(p, 1)}
+
+        for a in self.split.sharded_acc_names:
+            e = entry(a)
+            if e is not None:
+                vars_meta[a] = e
+        if self.zero_stage == 3:
+            # zero-3 params are themselves stored flat — stamp them so a
+            # plain (non-ddp) load restores logical arrays
+            for p in self.split.param_names:
+                e = entry(p)
+                if e is not None:
+                    vars_meta[p] = dict(e, kind="param")
         return {
-            "schema": 1,
+            "schema": 2,
             "dp": self.dp,
+            "tp": self.tp,
+            "pp": self.pp,
+            "pp_schedule": self.pp_schedule,
             "zero_stage": self.zero_stage,
             "accum_steps": self.accum_steps,
             "optimizer": list(self.split.optimizer_types),
-            "vars": {a: {"param": self.split.acc_param[a],
-                         "shape": list(self._layout[self.split.acc_param[a]][0]),
-                         "nelem": self._layout[self.split.acc_param[a]][1]}
-                     for a in self.split.sharded_acc_names
-                     if self.split.acc_param[a] in self._layout},
+            "vars": vars_meta,
         }
 
     def save_checkpoint(self, checkpoint_dir: str, scope,
@@ -437,21 +624,53 @@ class ShardedTrainStep:
         garbage."""
         from .. import io as model_io
 
+        def _check_pp(m):
+            ck_pp = int(m.get("pp", 1))
+            if ck_pp != self.pp:
+                raise ShardedTrainError(
+                    f"checkpoint was trained with pp={ck_pp} pipeline "
+                    f"stages, this step runs pp={self.pp} — stage-stacked "
+                    f"parameters do not reshard across pipeline depths; "
+                    f"rebuild the model with pp_stages={ck_pp} or "
+                    f"re-partition offline (docs/design.md §27). dp/tp "
+                    f"reshard-on-load stays free")
+
+        # refuse a mismatched pipeline depth BEFORE any bytes touch the
+        # scope — a stage-stacked layout cannot be repaired after load
+        probe = (serial if serial is not None
+                 else model_io._latest_checkpoint_serial(checkpoint_dir))
+        if probe >= 0:
+            pre = model_io.read_zero_meta(
+                model_io.checkpoint_serial_dir(checkpoint_dir, probe))
+            if pre is not None:
+                _check_pp(pre)
+
         serial = model_io.load_checkpoint(
             self.exe, checkpoint_dir, main_program=self.program,
             scope=scope, serial=serial)
         meta = model_io.read_zero_meta(
             model_io.checkpoint_serial_dir(checkpoint_dir, serial))
         if meta is not None:
+            # re-check: verification may have picked an older serial
+            _check_pp(meta)
             self._prepare_layout_only(scope)
             for a, info in meta.get("vars", {}).items():
-                if a not in self.split.acc_param:
+                if info.get("kind") == "param":
+                    if a not in self.split.param_names:
+                        raise ShardedTrainError(
+                            f"checkpoint zero-3 param {a!r} is not part "
+                            f"of this program — wrong program for this "
+                            f"checkpoint")
+                    p = a
+                elif a not in self.split.acc_param:
                     raise ShardedTrainError(
                         f"checkpoint optimizer state {a!r} is not part of "
                         f"this program's update segment — wrong program "
                         f"for this checkpoint")
-                p = self.split.acc_param[a]
-                want = self._layout[p][1]
+                else:
+                    p = self.split.acc_param[a]
+                logical = self._logical[p]
+                want = int(np.prod(logical)) if logical else 1
                 if int(info.get("nelem", want)) != want:
                     raise ShardedTrainError(
                         f"checkpoint state {a!r} has {info['nelem']} "
@@ -478,10 +697,7 @@ class ShardedTrainStep:
                 shape = tuple(np.asarray(val).shape)
             else:
                 shape = tuple(var.shape)
-            nelem = int(np.prod(shape)) if shape else 1
-            shard = -(-nelem // self.dp)
-            self._layout[p] = (shape, nelem, shard * self.dp, shard,
-                               np.dtype(np.float32))
+            self._set_layout(p, shape, np.float32)
 
     def state_bytes_per_device(self, scope) -> Dict[str, float]:
         """The live per-device residency vs the ZeRO account — the bench
@@ -500,7 +716,8 @@ class ShardedTrainStep:
             lay = self._layout.get(a)
             if lay is not None:
                 opt_logical += lay[1] * lay[4].itemsize
-            if hasattr(v, "addressable_shards") and self.dp > 1:
+            if hasattr(v, "addressable_shards") and \
+                    (self.dp > 1 or self.tp > 1):
                 opt_shard += v.addressable_shards[0].data.nbytes
             else:
                 opt_shard += np.asarray(v).nbytes / max(self.dp, 1)
@@ -513,10 +730,12 @@ class ShardedTrainStep:
             "opt_shard_bytes_per_device": opt_shard,
             "opt_logical_bytes": opt_logical,
             "scalar_bytes": scalars,
-            # the account the searcher prices: logical/dp plus at most one
-            # padding element per tensor per rank
-            "zero_account_bytes": opt_logical / self.dp + sum(
-                (lay[2] - lay[1]) * lay[4].itemsize / self.dp
+            # the account the searcher prices: logical/(dp*tp) plus at
+            # most one padding element per tensor per rank (the _layout
+            # rows are already per-tp-shard local, so /dp completes the
+            # division)
+            "zero_account_bytes": sum(
+                (lay[1] + (lay[2] - lay[1])) * lay[4].itemsize / self.dp
                 for a in self.split.sharded_acc_names
                 for lay in [self._layout.get(a)] if lay is not None),
         }
@@ -554,19 +773,27 @@ class ShardedTrainStep:
             k = len(feeds)
             invariant = False
 
-        if self.dp == 1 and self.accum_steps == 1:
+        if self.pp > 1:
+            # pipeline stages run at GSPMD level — the stacked-layer op
+            # shard_maps over 'pp' internally, and shard_maps don't nest
+            return self._run_pipeline(feeds, invariant, k, fetch_names,
+                                      scope, seed, return_numpy)
+        if self.dp == 1 and self.tp == 1 and self.accum_steps == 1:
             # the pre-PR path, byte for byte: same executor, same cache
             # key, same compiled program
             from ..core.executor import _train_metrics
 
-            _train_metrics()["dp"].set(1.0)
+            m = _train_metrics()
+            m["dp"].set(1.0)
+            m["tp"].set(1.0)
+            m["pp"].set(1.0)
             out = self.exe.run_steps(
                 self.program, feed=feeds, k=k,
                 fetch_list=fetch_names, scope=scope,
                 return_numpy=return_numpy, seed=seed)
             return [v.reshape((k, 1, 1) + tuple(v.shape[1:]))
                     for v in out]
-        if self.dp == 1:
+        if self.dp == 1 and self.tp == 1:
             # accumulation without a mesh: same algebra on one device —
             # shard_map over a 1-rank mesh would only add identity
             # collectives to the program
@@ -628,7 +855,8 @@ class ShardedTrainStep:
 
         cache_key = (self.program.uid, self.program.version, step_sig,
                      tuple(fetch_names), self.amp, invariant, k,
-                     self.dp, self.accum_steps, self.zero_stage)
+                     self.dp, self.tp, self.accum_steps, self.zero_stage,
+                     self.zero3_bucket_bytes)
         fn = self._cache.get(cache_key)
         if fn is None:
             _train_metrics()["compiles"].inc()
@@ -641,15 +869,51 @@ class ShardedTrainStep:
             self._cache[cache_key] = fn
             while len(self._cache) > 16:
                 self._cache.pop(next(iter(self._cache)))
+        twin = None
+        if self.measure_overlap and (self.dp > 1 or self.tp > 1):
+            # the collective-ablated twin (docs §27): same program with
+            # every collective replaced by a local slice/tile, compiled
+            # once per signature and NOT counted as a training compile
+            # (it is a measurement instrument, not a window)
+            tkey = cache_key + ("ablate",)
+            twin = self._cache.get(tkey)
+            if twin is None:
+                t_c = time.monotonic() if acct.enabled else 0.0
+                with tr.span("train/ddp_compile", cat="compile",
+                             ablate=True):
+                    twin = self._compile_window(feed_names, fetch_names,
+                                                invariant, k, mesh,
+                                                ablate=True)
+                if acct.enabled:
+                    acct.account("compile", t_c, time.monotonic() - t_c)
+                self._cache[tkey] = twin
+                while len(self._cache) > 16:
+                    self._cache.pop(next(iter(self._cache)))
         if acct.enabled:
             acct.account("host_input", t_acct, time.monotonic() - t_acct)
 
         m = _train_metrics()
         m["dp"].set(float(self.dp))
+        m["tp"].set(float(self.tp))
+        m["pp"].set(1.0)
+        twin_dur = None
+        if twin is not None:
+            # the twin runs FIRST (the real window donates the state
+            # buffers) and its outputs are discarded after the sync
+            t_tw = time.monotonic()
+            with tr.span("train/ablate_twin", cat="train", k=k):
+                tout = twin(feed_vals, readonly, params, shards, scalars,
+                            keys)
+                jax.block_until_ready(tout)
+            twin_dur = time.monotonic() - t_tw
+            del tout
         t_dev = time.monotonic()
         with tr.span("train/device_window", cat="train", k=k, dp=self.dp):
             fetches, new_params, new_shards, new_scalars = fn(
                 feed_vals, readonly, params, shards, scalars, keys)
+            if twin is not None:
+                jax.block_until_ready((fetches, new_params, new_shards,
+                                       new_scalars))
             for p, v in new_params.items():
                 scope.set(p, v)
                 self._placed[p] = v
@@ -662,16 +926,35 @@ class ShardedTrainStep:
         dev_dur = time.monotonic() - t_dev
         if acct.enabled:
             acct.account("device_compute", t_dev, dev_dur)
-        if self.dp > 1:
-            # model-attributed collective seconds (docs §24): the ring
-            # volumes are exact, the wall share is the searcher's own
-            # link-bandwidth model clamped to the measured window — an
-            # attribution, not a measurement (XLA hides true overlap)
-            comm_s = min(self.comm_seconds_per_step() * k, dev_dur)
-            m["collective"].inc(comm_s)
-            if acct.enabled and comm_s > 0:
-                acct.account("collective",
-                             t_dev + dev_dur - comm_s, comm_s)
+        if self.dp > 1 or self.tp > 1:
+            if twin_dur is not None:
+                # measured overlap (docs §27): the modeled collective
+                # seconds are the ring volumes at the configured link;
+                # the EXPOSED share is the wall-clock the real window
+                # lost vs. its collective-ablated twin; the rest was
+                # hidden under compute by XLA's scheduler — a
+                # measurement, not an assertion
+                modeled = self.comm_seconds_per_step() * k
+                exposed = min(max(dev_dur - twin_dur, 0.0), modeled)
+                hidden = modeled - exposed
+                m["collective"].inc(modeled)
+                m["hidden_collective"].inc(hidden)
+                if acct.enabled and exposed > 0:
+                    acct.account("collective",
+                                 t_dev + dev_dur - exposed, exposed)
+                if acct.enabled and hidden > 0:
+                    acct.account("collective_hidden", t_dev, hidden)
+            else:
+                # model-attributed collective seconds (docs §24): the
+                # ring volumes are exact, the wall share is the
+                # searcher's own link-bandwidth model clamped to the
+                # measured window — an attribution, not a measurement
+                # (XLA hides true overlap)
+                comm_s = min(self.comm_seconds_per_step() * k, dev_dur)
+                m["collective"].inc(comm_s)
+                if acct.enabled and comm_s > 0:
+                    acct.account("collective",
+                                 t_dev + dev_dur - comm_s, comm_s)
         if return_numpy:
             t_f = time.monotonic() if acct.enabled else 0.0
             with tr.span("train/fetch_sync", cat="train"):
@@ -681,21 +964,416 @@ class ShardedTrainStep:
         m["steps"].inc(k)
         return fetches
 
+    # -- pipeline execution (pp > 1, docs §27) ------------------------------
+    def _find_stack_op(self):
+        """The single pipelined_transformer_stack op the pp path drives —
+        typed refusals for anything else (two stacks cannot share one
+        'pp' axis schedule; a stage count that disagrees with the mesh
+        would silently all-gather every step)."""
+        block = self.program.blocks[self.split.block_idx]
+        grad_ops = block.ops[:self.split.split_idx]
+        idxs = [i for i, op in enumerate(grad_ops)
+                if op.type == "pipelined_transformer_stack"]
+        if len(idxs) != 1:
+            raise ShardedTrainError(
+                f"pp={self.pp} needs exactly one pipelined_transformer_"
+                f"stack op in the forward, found {len(idxs)} — build the "
+                f"model with pp_stages={self.pp} "
+                f"(models/transformer.py transformer_lm)")
+        op = grad_ops[idxs[0]]
+        wq = block.find_var_recursive(op.inputs["WQ"][0])
+        n_stages = int(wq.shape[0]) if wq is not None and wq.shape else -1
+        if n_stages != self.pp:
+            raise ShardedTrainError(
+                f"the model's pipelined stack has {n_stages} stages but "
+                f"this step runs pp={self.pp} — rebuild with "
+                f"pp_stages={self.pp} or resize the mesh")
+        return idxs[0], op
+
+    def _prepare_pp_state(self, scope, names) -> None:
+        """Place state for the GSPMD pipeline plane: the program's
+        ParamAttr sharding hints place the stacked stage parameters
+        P('pp', ...[, 'tp']); each optimizer accumulator inherits its
+        param's spec (same shape, same placement); everything else
+        replicates — the ParallelExecutor placement discipline, shared
+        via ``mesh.param_sharding``."""
+        import jax
+
+        from .mesh import param_sharding, replicated
+
+        block = self.program.global_block()
+        acc_of = self.split.acc_param
+        for n in names:
+            v = scope.get(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} has no value in the scope; run the "
+                    f"startup program first")
+            if self._placed.get(n) is v:
+                continue
+            src = acc_of.get(n, n)
+            var = block.find_var_recursive(src)
+            sh = (param_sharding(self.mesh, var) if var is not None
+                  else replicated(self.mesh))
+            arr = np.asarray(v)
+            if len(sh.spec) > arr.ndim:
+                # scalar optimizer state (Adam's beta pows) inherits its
+                # param's NAME mapping but not its rank — replicate
+                sh = replicated(self.mesh)
+            placed = jax.device_put(arr, sh)
+            scope.set(n, placed)
+            self._placed[n] = placed
+
+    def _run_pipeline(self, feeds, invariant, k, fetch_names, scope, seed,
+                      return_numpy):
+        """pp > 1 window: GSPMD-level execution (the stack op's internal
+        shard_map owns the 'pp' rotation — shard_maps do not nest, so
+        this path mirrors ParallelExecutor rather than ``_run_sharded``).
+        The schedule pick IS the gpipe/1F1B crossover rule
+        (``one_f_one_b_preferred``): M <= 2S keeps the stack op's gpipe
+        (the IR backward differentiates through it), M > 2S swaps the IR
+        backward for the revived ``one_f_one_b`` engine — the warning
+        that used to go to stderr now routes the plan (docs §27)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.executor import _coerce_host, _train_metrics
+        from ..obs import get_tracer
+        from ..obs.goodput import get_accountant
+        from .pipeline import one_f_one_b_preferred
+
+        acct = get_accountant()
+        tr = get_tracer()
+        t_acct = time.monotonic() if acct.enabled else 0.0
+        stack_idx, stack_op = self._find_stack_op()
+        M = self.pp_microbatches or int(
+            stack_op.attrs.get("microbatches", 4))
+        schedule = "1f1b" if one_f_one_b_preferred(M, self.pp) else "gpipe"
+        self.pp_schedule = schedule
+
+        feed_names = tuple(sorted(feeds if invariant else feeds[0]))
+        feed_list = [feeds] * k if invariant else list(feeds)
+        seeds = self._microbatch_seeds(k, seed)
+
+        ckey = (self.program.uid, self.program.version, feed_names,
+                tuple(fetch_names), self.amp, schedule, M,
+                self.dp, self.tp, self.pp)
+        entry = self._pp_cache.get(ckey)
+        if entry is None:
+            _train_metrics()["compiles"].inc()
+            t_c = time.monotonic() if acct.enabled else 0.0
+            with tr.span("train/pp_compile", cat="compile",
+                         schedule=schedule):
+                if schedule == "gpipe":
+                    entry = self._build_pp_gpipe_step(feed_names,
+                                                      fetch_names)
+                else:
+                    entry = self._build_pp_1f1b_step(feed_names,
+                                                     fetch_names,
+                                                     stack_idx, stack_op,
+                                                     M)
+            if acct.enabled:
+                acct.account("compile", t_c, time.monotonic() - t_c)
+            self._pp_cache[ckey] = entry
+            while len(self._pp_cache) > 8:
+                self._pp_cache.pop(next(iter(self._pp_cache)))
+        fn, readonly_names, donated_names, state_out = entry
+
+        with tr.span("train/host_prep", cat="train", k=k, pp=self.pp):
+            self._prepare_pp_state(scope, donated_names)
+            self._prepare_pp_state(scope, readonly_names)
+        if acct.enabled:
+            acct.account("host_input", t_acct, time.monotonic() - t_acct)
+
+        m = _train_metrics()
+        m["dp"].set(float(self.dp))
+        m["tp"].set(float(self.tp))
+        m["pp"].set(float(self.pp))
+        rs = self.program.random_seed or 0
+        div = self.dp * (M if schedule == "1f1b" else 1)
+        outs = []
+        for i in range(k):
+            fd = feed_list[i]
+            feed_vals = {}
+            for n in feed_names:
+                host = _coerce_host(np.asarray(fd[n]), self.program, n)
+                if host.ndim and host.shape[0] % div:
+                    raise ShardedTrainError(
+                        f"feed {n!r} batch {host.shape[0]} is not "
+                        f"divisible by dp*microbatches = {div}")
+                t_h2d = time.monotonic()
+                spec = ("dp",) + (None,) * (host.ndim - 1) \
+                    if host.ndim else ()
+                feed_vals[n] = jax.device_put(host, self._spec(*spec))
+                if acct.enabled:
+                    acct.account("h2d", t_h2d, time.monotonic() - t_h2d)
+            readonly = {n: scope.get(n) for n in readonly_names}
+            donated = {n: scope.get(n) for n in donated_names}
+            key = jax.random.PRNGKey(np.uint32(seeds[i] ^ rs))
+            t_dev = time.monotonic()
+            with tr.span("train/pp_window", cat="train", pp=self.pp,
+                         schedule=schedule):
+                with self.mesh:
+                    fetches, new_state = fn(feed_vals, readonly, donated,
+                                            key)
+                for n in state_out:
+                    if n in new_state:
+                        scope.set(n, new_state[n])
+                        self._placed[n] = new_state[n]
+            if acct.enabled:
+                acct.account("device_compute", t_dev,
+                             time.monotonic() - t_dev)
+            outs.append(fetches)
+        m["steps"].inc(k)
+        stacked = []
+        for j in range(len(fetch_names)):
+            v = jnp.stack([outs[i][j] for i in range(k)])
+            v = v.reshape((k, 1, 1) + tuple(v.shape[1:]))
+            stacked.append(np.asarray(v) if return_numpy else v)
+        return stacked
+
+    def _build_pp_gpipe_step(self, feed_names, fetch_names):
+        """The M <= 2S schedule: one jitted GSPMD step over the WHOLE IR
+        block — the stack op sees ctx.mesh and runs its internal gpipe
+        shard_map; IR autodiff differentiates straight through it and
+        the optimizer update runs on the P('pp')-sharded stacks."""
+        import jax
+
+        from ..core.executor import build_step_fn
+
+        step, readonly_names, donated_names, state_out = build_step_fn(
+            self.program, self.split.block_idx, feed_names,
+            list(fetch_names), amp=self.amp, mesh=self.mesh)
+        return (jax.jit(step, donate_argnums=(2,)), readonly_names,
+                donated_names, state_out)
+
+    def _build_pp_1f1b_step(self, feed_names, fetch_names, stack_idx,
+                            stack_op, M):
+        """The M > 2S schedule: strip the IR backward and drive the
+        revived ``one_f_one_b`` engine (parallel/pipeline.py) directly.
+        Surgery on the block, all at trace time:
+
+        * forward prefix (embedding/positions) runs under ``jax.vjp`` so
+          the pipeline's dx seeds its parameter grads;
+        * the stack op is REPLACED by 1F1B over a stage_fn rebuilt from
+          ops/pipelined_stack's ``_decoder_layer`` (same math, same
+          Megatron tp psums);
+        * the head (final LN + LM head + loss) becomes ``loss_grad_fn``,
+          gated to the last stage per microbatch;
+        * the optimizer update runs on the engine's grads through the
+          ordinary update ops.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..core.executor import BlockProgramBuilder, _collect_block_io
+        from ..core.registry import ExecContext
+        from ..ops.pipelined_stack import _KEYS, _SLOTS, _decoder_layer
+        from .pipeline import one_f_one_b
+
+        split = self.split
+        if split.grad_segment_writes:
+            raise ShardedTrainError(
+                f"the grad segment writes persistable state "
+                f"{split.grad_segment_writes[:4]} — the 1F1B engine owns "
+                f"the backward and would drop these writes; train with "
+                f"the gpipe schedule (M <= 2*pp) or move the state "
+                f"(docs/design.md §27 failure matrix)")
+        block = self.program.blocks[split.block_idx]
+        grad_ops = block.ops[:split.split_idx]
+        update_ops = block.ops[split.split_idx:]
+        fill_idx = loss_name = None
+        for i, op in enumerate(grad_ops):
+            if op.type == "fill_constant":
+                outs = [n for ns in op.outputs.values() for n in ns]
+                if outs and outs[0].endswith("@GRAD"):
+                    fill_idx = i
+                    loss_name = outs[0][:-len("@GRAD")]
+                    break
+        if fill_idx is None or fill_idx <= stack_idx:
+            raise ShardedTrainError(
+                "1F1B surgery found no gradient-seeding fill_constant "
+                "behind the pipeline stack — the program has no IR "
+                "backward to replace")
+        bad = [n for n in fetch_names if n != loss_name]
+        if bad:
+            raise ShardedTrainError(
+                f"pp={self.pp} under the 1F1B schedule can only fetch the "
+                f"loss {loss_name!r} (got {bad}) — intermediate "
+                f"activations live distributed across pipeline stages")
+        pre_ops = grad_ops[:stack_idx]
+        post_ops = grad_ops[stack_idx + 1:fill_idx]
+        tail_ops = grad_ops[fill_idx:]
+
+        # the update segment's extras (scaled lr chains) have their
+        # producers in the stripped tail — keep the grad-free closure
+        need = set(split.extra_names)
+        extra_ops = []
+        for op in reversed(tail_ops):
+            outs = {n for ns in op.outputs.values() for n in ns if n}
+            if need & outs:
+                ins = [n for ns in op.inputs.values() for n in ns if n]
+                if any(n.endswith("@GRAD") for n in ins):
+                    raise ShardedTrainError(
+                        f"op {op.type!r} feeds the update segment through "
+                        f"gradient values — the 1F1B engine owns the "
+                        f"gradients and cannot honor this program "
+                        f"(docs/design.md §27 failure matrix)")
+                extra_ops.append(op)
+                need.update(ins)
+        extra_ops.reverse()
+
+        def reads(ops):
+            out, seen = [], set()
+            for op in ops:
+                for ns in op.inputs.values():
+                    for n in ns:
+                        if n and n not in seen:
+                            seen.add(n)
+                            out.append(n)
+            return out
+
+        stack_param_names = {kk: stack_op.inputs[slot][0]
+                             for kk, slot in zip(_KEYS, _SLOTS)}
+        stack_in_name = stack_op.inputs["X"][0]
+        stack_out_name = stack_op.outputs["Out"][0]
+        pre_reads = set(reads(pre_ops))
+        post_reads = set(reads(post_ops))
+        stack_set = set(stack_param_names.values())
+        pre_params = [p for p in split.param_names
+                      if p in pre_reads and p not in stack_set]
+        head_params = [p for p in split.param_names
+                       if p in post_reads and p not in stack_set]
+        label_feeds = [n for n in feed_names if n in post_reads]
+
+        state_in, state_out = _collect_block_io(
+            self.program, split.block_idx, feed_names)
+        donated_names = [n for n in state_in if n in set(state_out)]
+        readonly_names = [n for n in state_in if n not in set(donated_names)]
+
+        builder = BlockProgramBuilder(self.program)
+        grad_of = dict(zip(split.param_names, split.grad_names))
+        amp = self.amp
+        mesh = self.mesh
+        n_heads = int(stack_op.attrs["n_heads"])
+        causal = bool(stack_op.attrs.get("causal", True))
+        tp_axis = ("tp" if bool(stack_op.attrs.get("tp_shard", False))
+                   and self.tp > 1 else None)
+        wq_var = block.find_var_recursive(stack_param_names["wq"])
+        L = int(wq_var.shape[1])
+
+        def stage_fn(p_stage, x_mb):
+            out = x_mb
+            for layer in range(L):
+                p_l = {kk: v[layer] for kk, v in p_stage.items()}
+                # the 1F1B engine runs jax.vjp INSIDE the shard_map body,
+                # so the stage needs the explicit Megatron region
+                # boundaries (see pipelined_stack._copy_to_tp)
+                out = _decoder_layer(p_l, out, n_heads, causal, amp,
+                                     tp_axis=tp_axis, inner_vjp=True)
+            return out
+
+        if tp_axis is not None:
+            col = P("pp", None, None, "tp")
+            row = P("pp", None, "tp", None)
+            rep2 = P("pp", None, None)
+            pspecs = {"ln1s": rep2, "ln1b": rep2, "wq": col, "wk": col,
+                      "wv": col, "wo": row, "ln2s": rep2, "ln2b": rep2,
+                      "wup": col, "bup": P("pp", None, "tp"),
+                      "wdown": row, "bdown": rep2}
+        else:
+            pspecs = {kk: P("pp") for kk in _KEYS}
+
+        def step(feed_vals, readonly, donated, key):
+            env = {}
+            env.update(readonly)
+            env.update(donated)
+            env.update(feed_vals)
+            ctx = ExecContext(key=key, block_runner=builder, amp=amp,
+                              mesh=mesh)
+            pre_p = {p: env[p] for p in pre_params}
+
+            def pre_fn(pp_):
+                e = dict(env)
+                e.update(pp_)
+                for op in pre_ops:
+                    builder.run_op(op, e, ctx)
+                return e[stack_in_name]
+
+            x, pre_vjp = jax.vjp(pre_fn, pre_p)
+            stage_p = {kk: env[nm]
+                       for kk, nm in stack_param_names.items()}
+            head_p = {p: env[p] for p in head_params}
+            labels = {n: env[n] for n in label_feeds}
+
+            def head_fn(hp, y_mb, lbl):
+                e = dict(env)
+                e.update(hp)
+                e[stack_out_name] = y_mb
+                e.update(lbl)
+                for op in post_ops:
+                    builder.run_op(op, e, ctx)
+                return e[loss_name]
+
+            def loss_grad_fn(hp, y_mb, lbl):
+                loss_mb, vjp = jax.vjp(
+                    lambda h, y: head_fn(h, y, lbl), hp, y_mb)
+                dh, dy = vjp(jnp.ones_like(loss_mb))
+                return loss_mb, dy, dh
+
+            loss, dstage, dhead, dx = one_f_one_b(
+                stage_fn, loss_grad_fn, stage_p, head_p, x, labels,
+                mesh, axis="pp", microbatches=M, batch_axes=("dp",),
+                param_specs=pspecs, warn=False)
+            (dpre,) = pre_vjp(dx)
+            env[loss_name] = loss
+            for kk, nm in stack_param_names.items():
+                env[grad_of[nm]] = dstage[kk].astype(env[nm].dtype)
+            for p in head_params:
+                env[grad_of[p]] = dhead[p].astype(env[p].dtype)
+            for p in pre_params:
+                env[grad_of[p]] = dpre[p].astype(env[p].dtype)
+            for op in extra_ops:
+                builder.run_op(op, env, ctx)
+            for op in update_ops:
+                builder.run_op(op, env, ctx)
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env[n] for n in state_out if n in env}
+            return fetches, new_state
+
+        return (jax.jit(step, donate_argnums=(2,)), readonly_names,
+                donated_names, state_out)
+
     def comm_bytes_per_step(self) -> float:
-        """Exact ring-collective bytes per optimizer step: reduce-scatter
-        moves ``grad_bytes*(dp-1)/dp`` per scatter (``accum`` of them at
-        zero_stage=2, one at stage 1) + the param all-gather's
-        ``param_bytes*(dp-1)/dp``."""
-        if self.dp <= 1:
-            return 0.0
-        grad_bytes = sum(self._layout[p][1] * 4
-                         for p in self.split.param_names
-                         if p in self._layout)
-        param_bytes = sum(
-            self._layout[p][1] * self._layout[p][4].itemsize
-            for p in self.split.param_names if p in self._layout)
-        rs = self.accum_steps if self.zero_stage == 2 else 1
-        return (rs * grad_bytes + param_bytes) * (self.dp - 1) / self.dp
+        """Exact per-device ring-collective bytes per optimizer step,
+        summed over the axes (docs §27). dp: reduce-scatter moves
+        ``grad_bytes*(dp-1)/dp`` per scatter (``accum`` of them at
+        zero_stage>=2, one at stage 1) + the param all-gather's
+        ``param_bytes*(dp-1)/dp`` — the same bytes whether the gather
+        trails the update (zero<=2) or prefetches the next step's
+        forward (zero-3). tp: the once-per-step full-weight all-gather
+        of every column-sharded param, ``nelem_loc*itemsize*(tp-1)``
+        each. The dp terms use LOCAL (per-tp-rank) sizes — the dp
+        collectives run inside each tp group."""
+        dp_bytes = tp_bytes = 0.0
+        if self.dp > 1:
+            grad_bytes = sum(self._layout[p][1] * 4
+                             for p in self.split.param_names
+                             if p in self._layout)
+            param_bytes = sum(
+                self._layout[p][1] * self._layout[p][4].itemsize
+                for p in self.split.param_names if p in self._layout)
+            rs = self.accum_steps if self.zero_stage >= 2 else 1
+            dp_bytes = ((rs * grad_bytes + param_bytes)
+                        * (self.dp - 1) / self.dp)
+        if self.tp > 1:
+            tp_bytes = sum(
+                self._layout[p][1] * self._layout[p][4].itemsize
+                * (self._tp_parts[p] - 1)
+                for p in self.split.param_names
+                if p in self._layout and self._tp_parts.get(p, 1) > 1)
+        return dp_bytes + tp_bytes
 
     def comm_seconds_per_step(self) -> float:
         return self.comm_bytes_per_step() / self.link_bw
@@ -767,7 +1445,16 @@ class ShardedTrainStep:
 
     # -- compilation --------------------------------------------------------
     def _compile_window(self, feed_names, fetch_names, invariant, k,
-                        use_mesh: bool):
+                        use_mesh: bool, ablate: bool = False):
+        """Build the jitted k-step window program (docs §24/§27).
+
+        ``ablate=True`` builds the overlap-measurement twin: every
+        collective is replaced by a LOCAL op of identical output shape
+        (reduce-scatter -> slice, all-gather -> tile), so the twin's
+        wall-clock is the window's compute floor and real - twin is the
+        EXPOSED collective time (``run_window``'s overlap accounting).
+        The twin's outputs are garbage and discarded; it never donates
+        its inputs."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -784,9 +1471,47 @@ class ShardedTrainStep:
         wanted = generic_grad_fwd_instances(block)
         grad_of = dict(zip(split.param_names, split.grad_names))
         layout = dict(self._layout)
-        dp, accum, zero2 = self.dp, self.accum_steps, self.zero_stage == 2
+        logical = dict(self._logical)
+        tp_parts = dict(self._tp_parts)
+        dp, accum = self.dp, self.accum_steps
+        zero2 = self.zero_stage >= 2
+        zero3 = self.zero_stage == 3
         amp = self.amp
         denom = float(dp * accum)
+
+        # ZeRO-3 prefetch buckets: params in FIRST-USE order (the order
+        # the forward consumes them — issuing bucket gathers in that
+        # order lets XLA's latency-hiding scheduler start bucket i+1's
+        # all-gather while bucket i's consumers run: the double-buffer),
+        # greedily packed to ``zero3_bucket_mb`` per dtype (the concat
+        # needs one dtype per bucket). bucket_mb <= 0 -> one param per
+        # bucket: the unbucketed reference the bit-match test runs.
+        buckets: List[List[str]] = []
+        if zero3:
+            pset = set(split.param_names)
+            order: List[str] = []
+            seen = set()
+            for op in grad_ops:
+                for names in op.inputs.values():
+                    for n in names:
+                        if n in pset and n not in seen:
+                            seen.add(n)
+                            order.append(n)
+            order += [p for p in split.param_names if p not in seen]
+            cap = self.zero3_bucket_bytes
+            cur: List[str] = []
+            cur_b, cur_dt = 0, None
+            for p in order:
+                dt = layout[p][4]
+                nb = layout[p][2] * dt.itemsize
+                if cur and (cap <= 0 or dt != cur_dt or cur_b + nb > cap):
+                    buckets.append(cur)
+                    cur, cur_b = [], 0
+                cur.append(p)
+                cur_b += nb
+                cur_dt = dt
+            if cur:
+                buckets.append(cur)
 
         def run_ops(ops, env, key):
             ctx = ExecContext(key=key, amp=amp)
@@ -803,25 +1528,88 @@ class ShardedTrainStep:
                     [flat, jnp.zeros((padded - flat.shape[0],), flat.dtype)])
             return flat
 
-        def scatter(flat):
-            if not use_mesh:
-                return flat
-            return jax.lax.psum_scatter(flat, "dp", scatter_dimension=0,
-                                        tiled=True)
-
         def rank_fn(feed_local, readonly, params, shards, scalars, keys):
             r = jax.lax.axis_index("dp") if use_mesh else 0
+
+            def scatter(flat):
+                if not use_mesh:
+                    return flat
+                if ablate:
+                    sh = flat.shape[0] // dp
+                    return jax.lax.dynamic_slice(flat, (r * sh,), (sh,))
+                return jax.lax.psum_scatter(flat, "dp",
+                                            scatter_dimension=0, tiled=True)
+
+            def ag_dp(flat):
+                if not use_mesh:
+                    return flat
+                if ablate:
+                    return jnp.tile(flat, dp)
+                return jax.lax.all_gather(flat, "dp", tiled=True)
+
+            def ag_tp(x, tp_p):
+                if tp_p <= 1:
+                    return x
+                if ablate:
+                    return jnp.tile(x, (1,) * (x.ndim - 1) + (tp_p,))
+                return jax.lax.all_gather(x, "tp", axis=x.ndim - 1,
+                                          tiled=True)
+
+            def tp_cols(g, p):
+                # this tp rank's column block of the full gradient (the
+                # forward ran on the all-gathered weight, so dW is full
+                # and — with replicated PRNG keys — identical across the
+                # tp group; each rank keeps only its columns)
+                tp_p = tp_parts.get(p, 1)
+                if tp_p <= 1:
+                    return g
+                cols = layout[p][0][-1]
+                t = jax.lax.axis_index("tp")
+                return jax.lax.dynamic_slice_in_dim(
+                    g, t * cols, cols, axis=g.ndim - 1)
+
+            def materialize(params):
+                """Full logical weights for the forward — the static
+                all-gather boundary of docs §27: weights change only at
+                the update, so this runs once per optimizer step and
+                covers every accum microbatch. zero<=2: params already
+                arrive in their storage layout (column shard or full) —
+                only the tp gather runs. zero3: bucketed dp all-gathers
+                first; the reshape(dp, -1) column-block walk is pure
+                data movement, bitwise equal to per-param gathers."""
+                full = {}
+                if zero3:
+                    flats = {}
+                    for bucket in buckets:
+                        cat = (params[bucket[0]] if len(bucket) == 1
+                               else jnp.concatenate(
+                                   [params[p] for p in bucket]))
+                        mat = ag_dp(cat).reshape(dp, -1)
+                        off = 0
+                        for p in bucket:
+                            sh = layout[p][3]
+                            flats[p] = mat[:, off:off + sh].reshape(-1)
+                            off += sh
+                    for p in split.param_names:
+                        local, nelem, _pad, _sh, _dt = layout[p]
+                        w = flats[p][:nelem].reshape(local)
+                        full[p] = ag_tp(w, tp_parts.get(p, 1))
+                else:
+                    for p in split.param_names:
+                        full[p] = ag_tp(params[p], tp_parts.get(p, 1))
+                return full
 
             def opt_step(carry, xs):
                 params, shards, scalars = carry
                 feed_step, keys_step = xs
+                weights = materialize(params)
 
                 def micro(acc, mxs):
                     feed_m, key_m = mxs
                     env = {}
                     env.update(readonly)
                     env.update(scalars)
-                    env.update(params)
+                    env.update(weights)
                     env.update(feed_m)
                     run_ops(grad_ops, env, key_m)
                     fetches = []
@@ -837,6 +1625,7 @@ class ShardedTrainStep:
                     nxt = {}
                     for p in split.param_names:
                         g = jnp.asarray(env[grad_of[p]], jnp.float32)
+                        g = tp_cols(g, p)
                         if zero2:
                             g = scatter(flatpad(g, layout[p][2]))
                         nxt[p] = acc[p] + g
@@ -844,13 +1633,15 @@ class ShardedTrainStep:
 
                 acc0 = {}
                 for p in split.param_names:
-                    shape, nelem, padded, shard, _pd = layout[p]
+                    local, nelem, padded, shard, _pd = layout[p]
                     if zero2:
                         # the 1/dp grad shard IS the accumulation buffer
                         n0 = shard if use_mesh else padded
                         acc0[p] = jnp.zeros((n0,), jnp.float32)
                     else:
-                        acc0[p] = jnp.zeros(shape, jnp.float32)
+                        # zero-1 accumulates this rank's LOCAL column
+                        # shard (the full logical tensor only at tp=1)
+                        acc0[p] = jnp.zeros(local, jnp.float32)
                 acc, (fetch_stack, extras_stack) = jax.lax.scan(
                     micro, acc0, (feed_step, keys_step))
                 extras = jax.tree.map(lambda x: x[-1], extras_stack)
@@ -860,30 +1651,38 @@ class ShardedTrainStep:
                 env.update(extras)
                 env.update(scalars)
                 for p in split.param_names:
-                    shape, nelem, padded, shard, _pd = layout[p]
+                    local, nelem, padded, shard, _pd = layout[p]
                     if zero2:
                         gshard = acc[p] / denom
                     else:
                         gshard = scatter(flatpad(acc[p], padded)) / denom
-                    pflat = flatpad(params[p], padded)
-                    if use_mesh:
-                        pshard = jax.lax.dynamic_slice(
-                            pflat, (r * shard,), (shard,))
+                    if zero3:
+                        # the carried flat shard IS the update operand
+                        pshard = params[p]
                     else:
-                        pshard = pflat
+                        pflat = flatpad(params[p], padded)
+                        if use_mesh:
+                            pshard = jax.lax.dynamic_slice(
+                                pflat, (r * shard,), (shard,))
+                        else:
+                            pshard = pflat
                     env[p] = pshard
-                    env[grad_of[p]] = gshard.astype(params[p].dtype)
+                    env[grad_of[p]] = gshard.astype(pshard.dtype)
                 for a_n in split.sharded_acc_names:
                     env[a_n] = shards[a_n]
                 run_ops(update_ops, env, None)
                 new_params = {}
                 for p in split.param_names:
-                    shape, nelem, padded, shard, _pd = layout[p]
-                    if use_mesh:
-                        full = jax.lax.all_gather(env[p], "dp", tiled=True)
+                    local, nelem, padded, shard, _pd = layout[p]
+                    if zero3:
+                        # keep the flat shard — no trailing gather; the
+                        # next step's materialize re-gathers (prefetch)
+                        new_params[p] = env[p]
+                    elif use_mesh:
+                        full = ag_dp(env[p])
+                        new_params[p] = full[:nelem].reshape(local)
                     else:
-                        full = env[p]
-                    new_params[p] = full[:nelem].reshape(shape)
+                        new_params[p] = env[p][:nelem].reshape(local)
                 new_shards = {a_n: env[a_n]
                               for a_n in split.sharded_acc_names}
                 new_scalars = {s: env[s]
@@ -913,9 +1712,28 @@ class ShardedTrainStep:
                 return rank_fn(feed_local, readonly, params, shards,
                                scalars, keys)
 
+            if ablate:
+                return jax.jit(window)
             return jax.jit(window, donate_argnums=(2, 3, 4))
 
         feed_axis = P(None, "dp") if invariant else P(None, None, "dp")
+
+        def pspec(p):
+            """Storage spec of one param: zero-3 -> flat (tp-major,
+            dp-padded) shards; else column-sharded logical over 'tp'
+            when eligible, replicated otherwise."""
+            if zero3:
+                return (P(("tp", "dp")) if tp_parts.get(p, 1) > 1
+                        else P("dp"))
+            if tp_parts.get(p, 1) > 1:
+                nd = len(logical[p])
+                return P(*((None,) * (nd - 1) + ("tp",)))
+            return P()
+
+        def sspec(a):
+            """Storage spec of one flat optimizer-state array."""
+            return (P(("tp", "dp")) if tp_parts.get(a, 1) > 1
+                    else P("dp"))
 
         def ranked(feed_vals, readonly, params, shards, scalars, keys):
             # shard_map hands each rank a size-1 slice along the dp dim;
@@ -929,21 +1747,23 @@ class ShardedTrainStep:
             in_specs = (
                 {n: feed_axis for n in feed_names},
                 jax.tree.map(lambda _: P(), readonly),
-                jax.tree.map(lambda _: P(), params),
-                jax.tree.map(lambda _: P("dp"), shards),
+                {p: pspec(p) for p in params},
+                {a: sspec(a) for a in shards},
                 jax.tree.map(lambda _: P(), scalars),
                 P(),
             )
             out_specs = (
                 [P(None, None, "dp")] * len(fetch_names),
-                jax.tree.map(lambda _: P(), params),
-                jax.tree.map(lambda _: P("dp"), shards),
+                {p: pspec(p) for p in params},
+                {a: sspec(a) for a in shards},
                 jax.tree.map(lambda _: P(), scalars),
             )
             fn = shard_map(ranked, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
             return fn(feed_vals, readonly, params, shards, scalars, keys)
 
+        if ablate:
+            return jax.jit(window)
         return jax.jit(window, donate_argnums=(2, 3, 4))
 
     # -- introspection ------------------------------------------------------

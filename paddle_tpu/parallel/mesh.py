@@ -67,6 +67,27 @@ def serving_mesh(dp: int, tp: int,
     return make_mesh({"dp": int(dp), "tp": int(tp)}, devices=devices[:n])
 
 
+def train_mesh(dp: int, tp: int = 1, pp: int = 1,
+               devices: Optional[Sequence[jax.Device]] = None,
+               platform: Optional[str] = None) -> Mesh:
+    """The training tier's 3-axis ('dp', 'tp', 'pp') mesh over the first
+    dp*tp*pp addressable devices (parallel/ddp.py builds its windows on
+    this — docs/design.md §27). Size-1 axes stay in the mesh so one set
+    of PartitionSpecs covers every (dp, tp, pp) combination; the same
+    XLA_FLAGS hint as ``serving_mesh`` when the host is short."""
+    if devices is None:
+        devices = jax.devices(platform) if platform else jax.devices()
+    n = int(dp) * int(tp) * int(pp)
+    if n > len(devices):
+        raise ValueError(
+            f"train mesh needs dp*tp*pp = {n} devices, only "
+            f"{len(devices)} available (host meshes: set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N before "
+            f"jax initializes)")
+    return make_mesh({"dp": int(dp), "tp": int(tp), "pp": int(pp)},
+                     devices=devices[:n])
+
+
 def sharding_for(mesh: Mesh, *spec) -> NamedSharding:
     """NamedSharding helper: sharding_for(mesh, 'dp', None) etc."""
     return NamedSharding(mesh, PartitionSpec(*spec))

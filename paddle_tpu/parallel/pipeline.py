@@ -117,9 +117,21 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, x, mesh: Mesh,
     return fn_sharded(stage_params, x)
 
 
+def one_f_one_b_preferred(microbatches: int, n_stages: int) -> bool:
+    """The 1F1B-vs-GPipe crossover as a DECISION, not a warning: True when
+    the 1F1B schedule is the measured-faster choice (M > 2S — below that
+    the per-tick vjp replay loses to GPipe-remat; docs/perf.md '1F1B head
+    gating' has the measured bracket, 1.16x slower at M=2S, 0.80x at
+    M=8S). ``ShardedTrainStep`` picks its pipeline schedule with this and
+    ``TrainPlacementSearcher`` prices plans with it — the same rule that
+    used to only warn on stderr now feeds the searcher (docs §27)."""
+    return n_stages > 1 and microbatches > 2 * n_stages
+
+
 def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
                 mesh: Mesh, axis: str = "pp", microbatches: int = 4,
-                batch_axes: tuple = ("dp",), param_specs: Any = None):
+                batch_axes: tuple = ("dp",), param_specs: Any = None,
+                warn: bool = True):
     """1F1B pipeline TRAINING step: loss + grads in ONE interleaved schedule.
 
     Why this is a separate engine and not a grad rule on ``gpipe``: inside
@@ -145,6 +157,12 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
         -> (loss_mb_scalar, dy_mb, dhead_mb)            (caller builds it
         with jax.value_and_grad over the head+loss; it runs ONLY on the
         last stage, at the tick its microbatch exits the stack)
+    ``labels`` may be any pytree of [B, ...] arrays (a dict of label
+    feeds); each leaf is microbatched along dim 0 and the per-microbatch
+    tree is handed to ``loss_grad_fn``. ``warn=False`` silences the
+    M <= 2S stderr warning — callers that already consulted
+    ``one_f_one_b_preferred`` (the ddp schedule pick, the placement
+    searcher) made the decision upstream.
     Returns (mean_loss, stage_param_grads, head_param_grads, dx).
     """
     n_stages = mesh.shape[axis]
@@ -160,7 +178,7 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
     mb = batch // dp_total // microbatches
     M = microbatches
     S = n_stages
-    if S > 1 and M <= 2 * S:
+    if warn and S > 1 and M <= 2 * S:
         # Selection rule (measured, docs/perf.md "1F1B head gating"): 1F1B
         # pays a per-tick vjp forward replay that only amortizes when
         # M >> S. At S=4 the measured points bracket the crossover: M=8
@@ -185,7 +203,8 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
         stage = lax.axis_index(axis)
         local_batch = x.shape[0]
         xs = x.reshape((M, mb) + x.shape[1:])
-        lbls = labels.reshape((M, mb) + labels.shape[1:])
+        lbls = jax.tree.map(
+            lambda a: a.reshape((M, mb) + a.shape[1:]), labels)
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
         ticks = 2 * (S - 1) + M
@@ -214,7 +233,9 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
             # docs/perf.md "1F1B head gating".
             is_last = stage == S - 1
             fmask = f_valid & is_last
-            lbl_mb = lax.dynamic_index_in_dim(lbls, mf_c, 0, keepdims=False)
+            lbl_mb = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, mf_c, 0,
+                                                   keepdims=False), lbls)
 
             def run_head(args):
                 hp, y_mb, lbl = args
@@ -281,8 +302,9 @@ def one_f_one_b(stage_fn, loss_grad_fn, stage_params, head_params, x, labels,
              else jax.tree.map(lambda _: P(axis), stage_params))
     xspec = P(data_axes if data_axes else None)
     hspec = jax.tree.map(lambda _: P(), head_params)
+    lspec = jax.tree.map(lambda _: xspec, labels)
     fn_sharded = shard_map(
         local, mesh=mesh,
-        in_specs=(pspec, hspec, xspec, xspec),
+        in_specs=(pspec, hspec, xspec, lspec),
         out_specs=(P(), pspec, hspec, xspec), check_vma=False)
     return fn_sharded(stage_params, head_params, x, labels)

@@ -136,12 +136,13 @@ class TrainProfile:
 
     __slots__ = ("param_bytes", "grad_bytes", "opt_state_bytes",
                  "act_bytes_per_row", "flops_per_row", "n_tensors",
-                 "source", "optimizer")
+                 "source", "optimizer", "n_layers", "hidden_bytes_per_row")
 
     def __init__(self, param_bytes: float, opt_state_bytes: float,
                  act_bytes_per_row: float, flops_per_row: float,
                  grad_bytes: Optional[float] = None, n_tensors: int = 1,
-                 source: str = "synthetic", optimizer: str = "?"):
+                 source: str = "synthetic", optimizer: str = "?",
+                 n_layers: int = 1, hidden_bytes_per_row: float = 0.0):
         self.param_bytes = float(param_bytes)
         # f32 grads: one float per param element even for low-bit params
         self.grad_bytes = (float(grad_bytes) if grad_bytes is not None
@@ -152,6 +153,12 @@ class TrainProfile:
         self.n_tensors = max(1, int(n_tensors))
         self.source = source
         self.optimizer = optimizer
+        # tp/pp comm modeling hints: layer count (tp psums scale with it)
+        # and the bytes of ONE hidden activation slab per batch row (what
+        # a tp psum reduces / a pp boundary ships). 0 disables those comm
+        # terms — profiles built before the 3D axes stay scoreable.
+        self.n_layers = max(1, int(n_layers))
+        self.hidden_bytes_per_row = float(hidden_bytes_per_row)
 
     @classmethod
     def for_lm(cls, n_params: float, n_layers: int, d_model: int,
@@ -172,7 +179,8 @@ class TrainProfile:
             act_bytes_per_row=act_per_token * seq_len,
             flops_per_row=6.0 * n_params * seq_len,
             n_tensors=2 + n_layers * 6, source=source,
-            optimizer=optimizer)
+            optimizer=optimizer, n_layers=n_layers,
+            hidden_bytes_per_row=4.0 * d_model * seq_len)
 
     @classmethod
     def synthetic_lm(cls, n_layers: int, d_model: int, d_ff: int,
@@ -269,26 +277,33 @@ class TrainProfile:
 
 
 class TrainPlacementPlan:
-    """One scored (dp, accum_steps, zero_stage) split of a fixed global
-    batch: the ZeRO per-device byte account, the modeled comm/compute
-    split, and the step-time/throughput numbers that chose it."""
+    """One scored (dp, tp, pp, accum_steps, zero_stage) split of a fixed
+    global batch: the 3D per-device byte account, the per-axis modeled
+    comm split (``comm_dp_s``/``comm_tp_s``/``comm_pp_s``), the chosen
+    reduction strategy and pipeline schedule, and the
+    step-time/throughput numbers that chose it."""
 
-    __slots__ = ("dp", "accum_steps", "zero_stage", "global_batch",
-                 "microbatch_rows", "feasible", "reason",
+    __slots__ = ("dp", "tp", "pp", "accum_steps", "zero_stage",
+                 "global_batch", "microbatch_rows", "feasible", "reason",
                  "hbm_bytes_per_device", "hbm_fraction",
                  "param_bytes_per_device", "grad_bytes_per_device",
                  "opt_bytes_per_device", "act_bytes_per_device",
                  "comm_bytes_per_step", "collectives_per_step",
-                 "comm_s", "compute_s", "hbm_s", "step_s",
+                 "comm_s", "comm_dp_s", "comm_tp_s", "comm_pp_s",
+                 "reduction", "pp_microbatches", "pp_schedule",
+                 "bubble_frac", "overlap_frac",
+                 "compute_s", "hbm_s", "step_s",
                  "rows_per_sec", "rows_per_sec_per_chip", "inventory")
 
     def __init__(self, **kw):
         for k in self.__slots__:
             setattr(self, k, kw.get(k))
+        self.tp = int(self.tp or 1)
+        self.pp = int(self.pp or 1)
 
     @property
     def devices(self) -> int:
-        return self.dp
+        return self.dp * self.tp * self.pp
 
     def as_dict(self) -> Dict[str, Any]:
         d = {k: getattr(self, k) for k in self.__slots__
@@ -298,19 +313,27 @@ class TrainPlacementPlan:
         return d
 
     def __repr__(self):
+        axes = (f"dp={self.dp}, tp={self.tp}, pp={self.pp}, "
+                f"accum={self.accum_steps}, zero={self.zero_stage}")
         if not self.feasible:
-            return (f"TrainPlacementPlan(dp={self.dp}, "
-                    f"accum={self.accum_steps}, zero={self.zero_stage}, "
-                    f"INFEASIBLE: {self.reason})")
-        return (f"TrainPlacementPlan(dp={self.dp}, accum={self.accum_steps},"
-                f" zero={self.zero_stage}, "
+            return f"TrainPlacementPlan({axes}, INFEASIBLE: {self.reason})"
+        return (f"TrainPlacementPlan({axes}, "
                 f"hbm/dev={self.hbm_bytes_per_device / GIB:.2f}GiB, "
                 f"step={self.step_s * 1e3:.2f}ms)")
 
 
 class TrainPlacementSearcher:
-    """Exhaustive (dp, accum_steps, zero_stage) enumeration under the
-    §24 cost model, for one model x one chip count x one global batch.
+    """Exhaustive (dp, tp, pp, accum_steps, zero_stage) enumeration under
+    the §24/§27 cost model, for one model x one chip count x one global
+    batch. Beyond the original dp x accum x zero space this prices the
+    full 3D mesh: tensor parallelism divides the model-parallel byte
+    terms and adds the Megatron psum traffic, pipeline stages divide
+    them further and add boundary ppermutes plus the fill/drain bubble
+    (schedule picked by ``parallel.pipeline.one_f_one_b_preferred`` —
+    the crossover WARNING became a plan input), ZeRO-3 shards the
+    parameter store itself with the executor's bucket size pricing the
+    gather count, and wide-dp gradient reductions may go hierarchical
+    (two-level ring) when the latency term wins.
 
     Cost model (per optimizer step over the whole global batch ``B``;
     ``b_loc = B / (dp * accum)`` rows per rank per microbatch)::
@@ -342,41 +365,103 @@ class TrainPlacementSearcher:
     the optimizer math stays the global-batch step.
     """
 
-    AXIS_NAMES = ("dp", "accum", "zero")
+    AXIS_NAMES = ("dp", "accum", "zero", "tp", "pp")
 
     def __init__(self, profile: TrainProfile, inventory: DeviceInventory,
-                 global_batch: int, max_accum: int = 64):
+                 global_batch: int, max_accum: int = 64,
+                 zero3_bucket_mb: float = 4.0):
         if global_batch < 1:
             raise ValueError(f"global_batch must be >= 1: {global_batch}")
         self.profile = profile
         self.inventory = inventory
         self.global_batch = int(global_batch)
         self.max_accum = int(max_accum)
+        # mirrors ShardedTrainStep(zero3_bucket_mb=...): the searcher's
+        # collective-count term prices the SAME bucketing the executor
+        # runs (one gather per bucket, not per tensor)
+        self.zero3_bucket_bytes = max(1.0, float(zero3_bucket_mb) * 2 ** 20)
 
-    def score(self, dp: int, accum_steps: int,
-              zero_stage: int) -> TrainPlacementPlan:
+    def _pp_microbatches(self, dp: int, pp: int) -> int:
+        """Deepest divisible microbatch split for the pipeline, preferring
+        M > 2*pp (the 1F1B-profitable region) down to M = pp: deeper
+        splits shrink the fill/drain bubble (pp-1)/M."""
+        for m in (8 * pp, 4 * pp, 2 * pp, pp):
+            if self.global_batch % (dp * m) == 0:
+                return m
+        return 0
+
+    def score(self, dp: int, accum_steps: int, zero_stage: int,
+              tp: int = 1, pp: int = 1) -> TrainPlacementPlan:
         prof, inv, B = self.profile, self.inventory, self.global_batch
+        tp, pp = int(tp), int(pp)
         plan = TrainPlacementPlan(
-            dp=dp, accum_steps=accum_steps, zero_stage=zero_stage,
-            global_batch=B, inventory=inv)
-        if zero_stage not in (1, 2):
+            dp=dp, tp=tp, pp=pp, accum_steps=accum_steps,
+            zero_stage=zero_stage, global_batch=B, inventory=inv,
+            comm_dp_s=0.0, comm_tp_s=0.0, comm_pp_s=0.0,
+            reduction="flat", bubble_frac=0.0, overlap_frac=0.0)
+        if zero_stage not in (1, 2, 3):
             plan.feasible = False
-            plan.reason = f"zero_stage must be 1 or 2, got {zero_stage}"
+            plan.reason = f"zero_stage must be 1, 2 or 3, got {zero_stage}"
+            return plan
+        # the executable space's failure matrix (docs/design.md §27):
+        # plans the ShardedTrainStep would refuse are priced as
+        # infeasible with the SAME reasons, so the searcher can never
+        # pick a plan the executor rejects
+        if zero_stage == 3 and dp < 2:
+            plan.feasible = False
+            plan.reason = ("zero_stage=3 shards parameters over dp — "
+                           "nothing to shard at dp=1 (failure matrix)")
+            return plan
+        if pp > 1 and zero_stage != 1:
+            plan.feasible = False
+            plan.reason = (f"zero_stage={zero_stage} does not compose "
+                           f"with pp={pp}: stage gradients live per "
+                           f"device on the 'pp' axis (failure matrix)")
+            return plan
+        if pp > 1 and accum_steps > 1:
+            plan.feasible = False
+            plan.reason = (f"accum_steps={accum_steps} does not compose "
+                           f"with pp={pp}: the pipeline's microbatches "
+                           f"ARE the accumulation (failure matrix)")
             return plan
         if B % (dp * accum_steps):
             plan.feasible = False
             plan.reason = (f"global batch {B} not divisible by "
                            f"dp*accum = {dp * accum_steps}")
             return plan
+        M = 0
+        if pp > 1:
+            M = self._pp_microbatches(dp, pp)
+            if not M:
+                plan.feasible = False
+                plan.reason = (f"global batch {B} cannot form pp={pp} "
+                               f"microbatches at dp={dp}")
+                return plan
+            plan.pp_microbatches = M
+            from .parallel.pipeline import one_f_one_b_preferred
+            plan.pp_schedule = ("1f1b" if one_f_one_b_preferred(M, pp)
+                                else "gpipe")
         b_loc = B // (dp * accum_steps)
         plan.microbatch_rows = b_loc
-        grad_div = dp if zero_stage == 2 else 1
-        plan.param_bytes_per_device = prof.param_bytes
-        plan.grad_bytes_per_device = prof.grad_bytes / grad_div
-        plan.opt_bytes_per_device = prof.opt_state_bytes / dp
-        plan.act_bytes_per_device = prof.act_bytes_per_row * b_loc
+        mp = tp * pp  # model-parallel shard fraction
+        grad_div = dp if zero_stage >= 2 else 1
+        param_div = dp if zero_stage == 3 else 1
+        # opt state dp-shards on the shard_map plane only (pp runs the
+        # GSPMD plane where accumulators follow their P('pp'[, 'tp'])
+        # params and replicate over dp)
+        opt_dp_div = dp if pp == 1 else 1
+        plan.param_bytes_per_device = prof.param_bytes / mp / param_div
+        plan.grad_bytes_per_device = prof.grad_bytes / mp / grad_div
+        plan.opt_bytes_per_device = prof.opt_state_bytes / mp / opt_dp_div
+        # peak activation slab: one microbatch's layers, stage-local
+        # under pp (the schedules free microbatch slabs as they drain)
+        plan.act_bytes_per_device = prof.act_bytes_per_row * b_loc / pp
         hbm = (plan.param_bytes_per_device + plan.grad_bytes_per_device
                + plan.opt_bytes_per_device + plan.act_bytes_per_device)
+        if zero_stage == 3 and dp > 1:
+            # the prefetch window keeps ~2 bucketed full-param slabs live
+            hbm += 2.0 * min(self.zero3_bucket_bytes,
+                             prof.param_bytes / mp)
         plan.hbm_bytes_per_device = hbm
         plan.hbm_fraction = hbm / inv.hbm_bytes
         if hbm > inv.hbm_bytes:
@@ -384,68 +469,139 @@ class TrainPlacementSearcher:
             plan.reason = (f"per-device bytes {hbm / GIB:.2f} GiB exceed "
                            f"modeled HBM {inv.hbm_bytes / GIB:.2f} GiB")
             return plan
-        compute_s = prof.flops_per_row * (B / dp) / inv.peak_flops
-        # HBM traffic: each microbatch's fwd+bwd streams the params ~3x
-        # (fwd read, bwd read, update write amortized) + the opt shard
-        hbm_s = accum_steps * (3.0 * prof.param_bytes
-                               + 2.0 * prof.opt_state_bytes / dp) / inv.hbm_bw
+        compute_s = prof.flops_per_row * (B / dp) / mp / inv.peak_flops
+        if pp > 1:
+            # fill/drain bubble — both schedules idle (pp-1) microbatch
+            # slots; 1F1B only shrinks the ACTIVATION footprint
+            plan.bubble_frac = (pp - 1) / M
+            compute_s *= 1.0 + plan.bubble_frac
+        # HBM traffic: each microbatch's fwd+bwd streams the local params
+        # ~3x (fwd read, bwd read, update write amortized) + the opt shard
+        hbm_s = accum_steps * (3.0 * prof.param_bytes / mp
+                               + 2.0 * plan.opt_bytes_per_device) / inv.hbm_bw
+        # -- per-axis comm models ------------------------------------------
+        n_coll = 0
+        comm_bytes = 0.0
         if dp > 1:
-            rs_count = accum_steps if zero_stage == 2 else 1
-            n_coll = prof.n_tensors * (rs_count + 1)
+            rs_count = accum_steps if zero_stage >= 2 else 1
+            if zero_stage == 3:
+                # bucketed prefetch: one gather per BUCKET, not per tensor
+                n_units = max(1, math.ceil(
+                    (prof.param_bytes / mp) / self.zero3_bucket_bytes))
+            else:
+                n_units = prof.n_tensors
+            n_coll = n_units * (rs_count + 1)
             comm_bytes = (rs_count * prof.grad_bytes + prof.param_bytes) \
-                * (dp - 1) / dp
-            comm_s = n_coll * inv.alpha_s + comm_bytes / inv.link_bw
-        else:
-            n_coll, comm_bytes, comm_s = 0, 0.0, 0.0
+                / mp * (dp - 1) / dp
+            flat_s = n_coll * inv.alpha_s + comm_bytes / inv.link_bw
+            plan.comm_dp_s, plan.reduction = flat_s, "flat"
+            if dp >= 4:
+                # hierarchical two-level reduction: ring within groups of
+                # g1, then across the dp/g1 group leads — halves ring
+                # latency depth for wide dp at the cost of a second pass
+                g1 = 2 ** (int(math.log2(dp)) // 2)
+                g2 = dp // g1
+                hier_bytes = (rs_count * prof.grad_bytes
+                              + prof.param_bytes) / mp \
+                    * ((g1 - 1) / g1 + (g2 - 1) / g2)
+                hier_s = 2 * n_coll * inv.alpha_s + hier_bytes / inv.link_bw
+                if hier_s < flat_s:
+                    plan.comm_dp_s = hier_s
+                    plan.reduction = f"hier({g1}x{g2})"
+                    comm_bytes = hier_bytes
+        if tp > 1 and prof.hidden_bytes_per_row > 0:
+            # Megatron psums: 2 fwd + 2 bwd all-reduces per layer, each
+            # moving one hidden slab per row — every row crosses every
+            # layer regardless of pp (the stages partition the layers)
+            tp_bytes = (4.0 * prof.n_layers * prof.hidden_bytes_per_row
+                        * (B / dp) * 2.0 * (tp - 1) / tp)
+            n_tp_coll = 4 * prof.n_layers * max(accum_steps, M or 1)
+            plan.comm_tp_s = n_tp_coll * inv.alpha_s + tp_bytes / inv.link_bw
+            n_coll += n_tp_coll
+            comm_bytes += tp_bytes
+        if pp > 1 and prof.hidden_bytes_per_row > 0:
+            # stage boundary traffic: each microbatch ships its hidden
+            # slab across (pp-1) boundaries forward and backward
+            pp_bytes = (2.0 * (pp - 1) * prof.hidden_bytes_per_row
+                        * (B / dp))
+            n_pp_coll = 2 * M * (pp - 1)
+            plan.comm_pp_s = n_pp_coll * inv.alpha_s + pp_bytes / inv.link_bw
+            n_coll += n_pp_coll
+            comm_bytes += pp_bytes
+        comm_s = plan.comm_dp_s + plan.comm_tp_s + plan.comm_pp_s
         plan.collectives_per_step = n_coll
         plan.comm_bytes_per_step = comm_bytes
         plan.compute_s, plan.hbm_s, plan.comm_s = compute_s, hbm_s, comm_s
+        # modeled overlap: the fraction of collective seconds the bucketed
+        # prefetch / in-step collectives could hide under compute. It is
+        # REPORTED, not credited — step_s stays the non-overlapped upper
+        # bound and the bench's goodput-measured ratio is the number that
+        # gets believed (arXiv 2512.02551 discipline).
+        if comm_s > 0 and (dp > 1 or tp > 1):
+            plan.overlap_frac = min(1.0, compute_s / comm_s)
         plan.step_s = max(compute_s, hbm_s) + comm_s
         plan.rows_per_sec = B / plan.step_s
-        plan.rows_per_sec_per_chip = plan.rows_per_sec / dp
+        plan.rows_per_sec_per_chip = plan.rows_per_sec / plan.devices
         plan.feasible = True
         return plan
 
     def candidates(self, max_devices: Optional[int] = None
-                   ) -> List[Tuple[int, int, int]]:
+                   ) -> List[Tuple[int, int, int, int, int]]:
+        """(dp, accum, zero, tp, pp) tuples in ``AXIS_NAMES`` order —
+        every power-of-two 3D factorization with dp*tp*pp within the
+        inventory, crossed with the accumulation/ZeRO space the failure
+        matrix allows."""
         n = min(self.inventory.n_devices,
                 max_devices or self.inventory.n_devices)
-        dps = []
+        pows = []
         d = 1
         while d <= n:
-            dps.append(d)
+            pows.append(d)
             d *= 2
         out = []
-        for dp in dps:
-            accum = 1
-            while accum <= self.max_accum and dp * accum <= self.global_batch:
-                if self.global_batch % (dp * accum) == 0:
-                    for z in (1, 2):
-                        out.append((dp, accum, z))
-                accum *= 2
+        for dp in pows:
+            for tp in pows:
+                for pp_ in pows:
+                    if dp * tp * pp_ > n:
+                        continue
+                    if pp_ > 1:
+                        if self._pp_microbatches(dp, pp_):
+                            out.append((dp, 1, 1, tp, pp_))
+                        continue
+                    accum = 1
+                    while accum <= self.max_accum \
+                            and dp * accum <= self.global_batch:
+                        if self.global_batch % (dp * accum) == 0:
+                            for z in (1, 2, 3):
+                                if z == 3 and dp < 2:
+                                    continue
+                                out.append((dp, accum, z, tp, 1))
+                        accum *= 2
         return sorted(out)
 
     def all_plans(self, max_devices: Optional[int] = None
                   ) -> List[TrainPlacementPlan]:
-        return [self.score(*c) for c in self.candidates(max_devices)]
+        return [self.score(dp, accum, z, tp=tp, pp=pp)
+                for dp, accum, z, tp, pp in self.candidates(max_devices)]
 
     def search(self, max_devices: Optional[int] = None
                ) -> TrainPlacementPlan:
         """The best feasible plan: minimum modeled step time for the
         fixed global batch (training wants the optimizer step done, not
         per-chip elegance — the global batch is the unit of progress);
-        ties break toward fewer devices, then fewer accumulation steps
-        (less latency per optimizer step), then the lower zero stage
-        (fewer collectives) — a total order, so the choice is
-        deterministic for fixed inputs."""
+        ties break toward fewer devices, then shallower pipelines, then
+        narrower tensor parallelism, then fewer accumulation steps (less
+        latency per optimizer step), then the lower zero stage (fewer
+        collectives) — a total order, so the choice is deterministic for
+        fixed inputs."""
         best, reasons = None, {}
         for plan in self.all_plans(max_devices):
             if not plan.feasible:
-                reasons[(plan.dp, plan.accum_steps, plan.zero_stage)] = \
-                    plan.reason
+                reasons[(plan.dp, plan.accum_steps, plan.zero_stage,
+                         plan.tp, plan.pp)] = plan.reason
                 continue
-            key = (plan.step_s, plan.dp, plan.accum_steps,
-                   plan.zero_stage)
+            key = (plan.step_s, plan.devices, plan.pp, plan.tp,
+                   plan.accum_steps, plan.zero_stage)
             if best is None or key < best[0]:
                 best = (key, plan)
         if best is None:
@@ -455,22 +611,30 @@ class TrainPlacementSearcher:
 
 def train_plan_table(plans: Sequence[TrainPlacementPlan]) -> str:
     """Fixed-width table of scored train plans (paddle_cli placement
-    --train / perf_lab train_scale both print through here)."""
-    lines = [f"{'dp':>4}{'accum':>7}{'zero':>6}{'b_loc':>7}{'hbm/dev':>10}"
+    --train / perf_lab train_scale both print through here). ``ovl`` is
+    the MODELED hidden-collective fraction (compute that could cover the
+    comm); the measured number lives in the bench's goodput column."""
+    lines = [f"{'dp':>4}{'tp':>4}{'pp':>4}{'accum':>7}{'zero':>6}"
+             f"{'b_loc':>7}{'hbm/dev':>10}"
              f"{'fit':>6}{'step_ms':>9}{'rows/s/chip':>13}{'comm_ms':>9}"
-             f"  status"]
+             f"{'ovl':>6}{'sched':>7}  status"]
     for p in plans:
         if p.feasible:
             lines.append(
-                f"{p.dp:>4}{p.accum_steps:>7}{p.zero_stage:>6}"
+                f"{p.dp:>4}{p.tp:>4}{p.pp:>4}"
+                f"{p.accum_steps:>7}{p.zero_stage:>6}"
                 f"{p.microbatch_rows:>7}"
                 f"{p.hbm_bytes_per_device / GIB:>9.2f}G"
                 f"{p.hbm_fraction:>6.0%}"
                 f"{p.step_s * 1e3:>9.3f}{p.rows_per_sec_per_chip:>13.1f}"
-                f"{p.comm_s * 1e3:>9.3f}  ok")
+                f"{p.comm_s * 1e3:>9.3f}"
+                f"{p.overlap_frac:>6.0%}"
+                f"{p.pp_schedule or '-':>7}  ok")
         else:
             lines.append(
-                f"{p.dp:>4}{p.accum_steps:>7}{p.zero_stage:>6}{'-':>7}"
+                f"{p.dp:>4}{p.tp:>4}{p.pp:>4}"
+                f"{p.accum_steps:>7}{p.zero_stage:>6}{'-':>7}"
                 f"{(p.hbm_bytes_per_device or 0) / GIB:>9.2f}G{'-':>6}"
-                f"{'-':>9}{'-':>13}{'-':>9}  INFEASIBLE: {p.reason}")
+                f"{'-':>9}{'-':>13}{'-':>9}{'-':>6}{'-':>7}"
+                f"  INFEASIBLE: {p.reason}")
     return "\n".join(lines)

@@ -76,13 +76,20 @@ class Trainer:
                  checkpoint_config: Optional[CheckpointConfig] = None,
                  seed: Optional[int] = None, log_json: bool = False,
                  parallel: Optional[dict] = None):
-        """``parallel``: sharded data-parallel training (docs §24) —
-        ``{"dp": N, "accum_steps": K, "zero_stage": 1|2}`` wraps every
-        training step in a ``parallel.ddp.ShardedTrainStep``: each reader
-        batch is one GLOBAL batch (``rows % (dp*accum) == 0``), grads
-        reduce-scatter over the mesh, optimizer state shards 1/dp, and
-        checkpoints carry the ZeRO reshard descriptor so a resume at a
-        different dp re-lays the state out."""
+        """``parallel``: sharded 3D-parallel training (docs §24/§27) —
+        the full plan dict ``{"dp": N, "tp": T, "pp": S,
+        "accum_steps": K, "zero_stage": 1|2|3, "zero3_bucket_mb": MB,
+        "measure_overlap": bool, "pp_microbatches": M}`` (every key
+        optional, all forwarded verbatim to
+        ``parallel.ddp.ShardedTrainStep`` — a
+        ``placement.TrainPlacementSearcher`` plan maps 1:1) wraps every
+        training step: each reader batch is one GLOBAL batch
+        (``rows % (dp*accum) == 0``), grads reduce-scatter over the
+        mesh, optimizer state shards 1/dp, tp column-shards the wide
+        matmuls, pp pipelines the stacked layers, and checkpoints carry
+        the 3D reshard descriptor (``_ZERO.json``) so a resume at a
+        different (dp, tp) re-lays the state out — a mismatched pp
+        refuses typed."""
         self.checkpoint_cfg = checkpoint_config
         self.place = place
         self.stop_requested = False

@@ -69,9 +69,12 @@ def test_stage_list_has_one_owner():
     # (ISSUE 17 added `checkpoint` — async snapshot attribution,
     # docs §26: hidden-behind-compute snapshots stay device_compute,
     # only exposed checkpoint seconds surface, and always as badput)
+    # (ISSUE 18 added `collective_hidden` — the overlap measurement's
+    # hidden share, docs §27: modeled comm the ablation twin shows was
+    # buried under compute; exposed comm stays `collective`)
     assert set(TRAIN_CATEGORIES) - {"idle"} == \
-        {"device_compute", "collective", "host_input", "h2d", "compile",
-         "fetch_sync", "checkpoint"}
+        {"device_compute", "collective", "collective_hidden",
+         "host_input", "h2d", "compile", "fetch_sync", "checkpoint"}
     assert "checkpoint" not in GOOD_CATEGORIES
     # goodput classification covers only known categories
     assert GOOD_CATEGORIES <= set(TRAIN_CATEGORIES) | set(STAGES)

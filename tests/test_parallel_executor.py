@@ -207,11 +207,14 @@ print("GUARDED-DRYRUN-OK")
     # a plugin probing absent hardware can hang backend init for MINUTES
     # before falling back to cpu — in that environment the guard is vacuous
     # either way, so find out with a short, killable probe instead of
-    # paying the full hang inside the real (expensive) subprocess below
+    # paying the full hang inside the real (expensive) subprocess below.
+    # 20s: a real backend (or no plugin at all) answers in a few seconds;
+    # only the probing-absent-hardware hang runs longer, and there the
+    # outcome is the same skip
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, cwd=REPO, env=env, timeout=60)
+            capture_output=True, text=True, cwd=REPO, env=env, timeout=20)
     except subprocess.TimeoutExpired:
         pytest.skip("accelerator plugin probe hung; guard vacuous here")
     if probe.returncode == 0 and probe.stdout.strip() == "cpu":

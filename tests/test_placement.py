@@ -421,3 +421,91 @@ def test_train_plan_table_renders_infeasible_rows(tprofile):
     plans = TrainPlacementSearcher(tprofile, inv, 8).all_plans()
     text = train_plan_table(plans)
     assert "INFEASIBLE" in text and "zero" in text
+
+
+# -- PR 18: 3D (dp x tp x pp) search space ---------------------------------
+
+def test_train_3d_hbm_gate_7b_needs_model_parallelism():
+    """ISSUE 18 acceptance: a 7B-class bf16 adam profile on 8 v5e chips
+    rejects EVERY pure-dp plan on the HBM account (each carries a typed
+    reason) and goes feasible only under a (tp, pp) split."""
+    prof = TrainProfile.for_lm(n_params=7e9, n_layers=32, d_model=4096,
+                               d_ff=11008, vocab=32000, seq_len=2048,
+                               optimizer="adam")
+    inv = DeviceInventory.tpu_v5e(8)
+    s = TrainPlacementSearcher(prof, inv, global_batch=2)
+    plans = s.all_plans()
+    pure_dp = [p for p in plans if p.tp == 1 and p.pp == 1]
+    assert pure_dp
+    for p in pure_dp:
+        assert not p.feasible
+        assert "HBM" in p.reason or "exceed" in p.reason, p.reason
+    win = s.search()
+    assert win.feasible and win.tp * win.pp >= 4
+    assert win.hbm_bytes_per_device <= inv.hbm_bytes
+    # the table carries the new axes for the feasible winner
+    text = train_plan_table(sorted(
+        plans, key=lambda p: (not p.feasible, p.step_s or 0))[:6])
+    for col in ("tp", "pp", "ovl", "sched"):
+        assert col in text
+
+
+def test_train_3d_failure_matrix_mirrors_executor(tprofile):
+    """The searcher can never pick a plan the executor refuses: zero-3
+    needs dp>=2, and pp>1 excludes zero>1 and accum>1 — rejected with
+    the same typed reasons ShardedTrainStep raises."""
+    inv = DeviceInventory(8, hbm_gb=1e4)
+    s = TrainPlacementSearcher(tprofile, inv, 64)
+    p = s.score(1, 1, 3)
+    assert not p.feasible and "nothing to shard" in p.reason
+    p = s.score(2, 1, 2, tp=1, pp=2)
+    assert not p.feasible and "zero_stage" in p.reason
+    p = s.score(2, 2, 1, tp=1, pp=2)
+    assert not p.feasible and "accum" in p.reason
+    for plan in s.all_plans():
+        if plan.feasible:
+            assert not (plan.pp > 1 and
+                        (plan.zero_stage > 1 or plan.accum_steps > 1))
+            assert not (plan.zero_stage == 3 and plan.dp < 2)
+
+
+def test_train_3d_pp_schedule_follows_crossover(tprofile):
+    """pp plans carry the executor's actual schedule pick: 1f1b iff
+    M > 2*S (parallel/pipeline.one_f_one_b_preferred), gpipe below."""
+    from paddle_tpu.parallel.pipeline import one_f_one_b_preferred
+
+    inv = DeviceInventory(8, hbm_gb=1e4)
+    s = TrainPlacementSearcher(tprofile, inv, 64)
+    for plan in s.all_plans():
+        if plan.feasible and plan.pp > 1:
+            assert plan.pp_microbatches >= plan.pp
+            want = ("1f1b" if one_f_one_b_preferred(
+                plan.pp_microbatches, plan.pp) else "gpipe")
+            assert plan.pp_schedule == want, plan
+    assert any(p.feasible and p.pp > 1 for p in s.all_plans())
+
+
+def test_train_3d_overlap_reported_not_credited(tprofile):
+    """overlap_frac reports how much collective time compute CAN hide;
+    step_s stays the non-overlapped upper bound (comm fully exposed)."""
+    inv = DeviceInventory(8, hbm_gb=1e4)
+    s = TrainPlacementSearcher(tprofile, inv, 64)
+    p = s.score(4, 1, 2)
+    assert p.feasible and 0.0 <= p.overlap_frac <= 1.0
+    assert p.step_s == pytest.approx(
+        p.compute_s + p.comm_s, rel=1e-9)
+    # dp=1 tp=1: nothing to overlap
+    assert s.score(1, 1, 1).overlap_frac == 0.0
+
+
+def test_train_3d_search_deterministic(tprofile):
+    inv = DeviceInventory.tpu_v5e(8)
+    big = TrainProfile.for_lm(n_params=7e9, n_layers=32, d_model=4096,
+                              d_ff=11008, vocab=32000, seq_len=2048,
+                              optimizer="adam")
+    a = TrainPlacementSearcher(big, inv, 2).search()
+    b = TrainPlacementSearcher(big, inv, 2).search()
+    assert (a.dp, a.tp, a.pp, a.accum_steps, a.zero_stage,
+            a.pp_schedule, a.reduction) == \
+        (b.dp, b.tp, b.pp, b.accum_steps, b.zero_stage,
+         b.pp_schedule, b.reduction)

@@ -48,11 +48,15 @@ from collections import defaultdict
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _probe_backends(timeout_s=45):
+def _probe_backends(timeout_s=None):
     """Platform list via a killable child: `version` is a host-side
     informational command, and an accelerator plugin probing absent
     hardware can hang jax backend init for minutes (the PR-1 benchmark
-    driver hang) — that must bound-fail the backends line, not the CLI."""
+    driver hang) — that must bound-fail the backends line, not the CLI.
+    PADDLE_CLI_PROBE_TIMEOUT_S overrides the bound (CI on plugin-less
+    hosts pays the full timeout just to print "unavailable")."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("PADDLE_CLI_PROBE_TIMEOUT_S", "45"))
     code = ("import jax; "
             "print(','.join(sorted({d.platform for d in jax.devices()})))")
     try:
@@ -789,15 +793,18 @@ def train_placement_report(prof, chips=8, hbm_gb=16.0, peak_tflops=197.0,
     except NoFeasiblePlacement as e:
         lines.append(f"train: NO FEASIBLE PLAN: {e}")
         return "\n".join(lines), None
+    sched = f" sched={best.pp_schedule}" if best.pp > 1 else ""
     lines.append(
-        f"train chosen: dp={best.dp} accum={best.accum_steps} "
-        f"zero={best.zero_stage}  per-device HBM "
+        f"train chosen: dp={best.dp} tp={best.tp} pp={best.pp} "
+        f"accum={best.accum_steps} zero={best.zero_stage}"
+        f"{sched}  per-device HBM "
         f"{best.hbm_bytes_per_device / 2**30:.3f} GiB "
         f"({best.hbm_fraction:.0%})  comm "
         f"{best.comm_bytes_per_step / 2**20:.2f} MiB/step over "
         f"{best.collectives_per_step} collectives  modeled step "
         f"{best.step_s * 1e3:.2f} ms "
-        f"({best.rows_per_sec_per_chip:.1f} rows/s/chip)")
+        f"({best.rows_per_sec_per_chip:.1f} rows/s/chip, "
+        f"overlap can hide {best.overlap_frac:.0%} of comm)")
     return "\n".join(lines), best
 
 
@@ -915,10 +922,11 @@ def cmd_placement(argv):
                          "HBM; a must-shard model can become single-chip "
                          "— the headline row) and return ITS plan")
     ap.add_argument("--train", type=int, default=None, metavar="N_CHIPS",
-                    help="also print the TRAINING (dp, accum, zero_stage) "
-                         "candidate table for N chips — ZeRO per-device "
-                         "HBM + modeled step time (docs §24); nonzero "
-                         "exit when nothing fits")
+                    help="also print the TRAINING (dp, tp, pp, accum, "
+                         "zero_stage) candidate table for N chips — 3D "
+                         "ZeRO per-device HBM + modeled step time with "
+                         "per-axis comm and pipeline schedule "
+                         "(docs §24/§27); nonzero exit when nothing fits")
     ap.add_argument("--train-batch", type=int, default=64,
                     help="global batch the train searcher splits")
     ap.add_argument("--train-optimizer", default="adam",

@@ -622,19 +622,23 @@ def placement_mode(seed: int = 5):
 
 def _train_child(argv):
     """One train_scale cell, run in a FRESH process: `perf_lab.py
-    train-child DP ACCUM ZERO WINDOWS K GLOBAL_BATCH`. Fresh because the
-    forced virtual-device count must land before jax initializes and must
-    never perturb the other lanes' thread pools (the PR-8 --mesh trick).
-    Prints ONE JSON line the parent collects."""
+    train-child DP ACCUM ZERO WINDOWS K GLOBAL_BATCH [TP PP MICRO]`.
+    Fresh because the forced virtual-device count (dp*tp*pp) must land
+    before jax initializes and must never perturb the other lanes'
+    thread pools (the PR-8 --mesh trick). Prints ONE JSON line the
+    parent collects."""
     import json
     import os
 
     dp, accum, zero, windows, k, gb = (int(a) for a in argv[:6])
+    tp = int(argv[6]) if len(argv) > 6 else 1
+    pp = int(argv[7]) if len(argv) > 7 else 1
+    micro = int(argv[8]) if len(argv) > 8 else 0
     flags_env = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags_env:
         os.environ["XLA_FLAGS"] = (
             flags_env + f" --xla_force_host_platform_device_count="
-            f"{max(dp, 1)}").strip()
+            f"{max(dp * tp * pp, 1)}").strip()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     import numpy as np
@@ -649,24 +653,29 @@ def _train_child(argv):
         with fluid.program_guard(main_prog, startup):
             ids = fluid.layers.data("ids", shape=[T], dtype="int64")
             labels = fluid.layers.data("labels", shape=[T], dtype="int64")
-            _, loss = transformer_lm(ids, labels, vocab_size=V, max_len=T,
-                                     d_model=D, n_heads=H, n_layers=L,
-                                     d_ff=FF)
+            if pp > 1:
+                _, loss = transformer_lm(
+                    ids, labels, vocab_size=V, max_len=T, d_model=D,
+                    n_heads=H, n_layers=L, d_ff=FF, pp_stages=pp,
+                    pp_microbatches=micro or None, tp_shard=tp > 1)
+            else:
+                _, loss = transformer_lm(ids, labels, vocab_size=V,
+                                         max_len=T, d_model=D, n_heads=H,
+                                         n_layers=L, d_ff=FF)
             fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss, startup)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     exe.run(startup, scope=scope, seed=11)
     sts = ShardedTrainStep(main_prog, dp=dp, accum_steps=accum,
-                           zero_stage=zero, executor=exe)
+                           zero_stage=zero, tp=tp, pp=pp,
+                           pp_microbatches=micro or None, executor=exe)
     rng = np.random.RandomState(5)
     X = rng.randint(0, V, (gb, T)).astype(np.int64)
     feed = {"ids": X, "labels": X}
-    # two warm windows: window 1 compiles, window 2 absorbs the one-time
-    # recompile the dp=1 delegate path pays when donated device-resident
-    # state replaces the startup numpy inputs — timed cells compare
-    # steady states across dp
-    for _ in range(2):
-        out = sts.run_window(feed, k=k, fetch_list=[loss], scope=scope)
+    # one warm window: run_steps commits state arrays to the executor
+    # device, so the delegate path compiles exactly once per signature
+    # and the timed cells compare steady states across dp
+    out = sts.run_window(feed, k=k, fetch_list=[loss], scope=scope)
     t0 = time.perf_counter()
     for _ in range(windows):
         out = sts.run_window(feed, k=k, fetch_list=[loss], scope=scope)
@@ -674,10 +683,11 @@ def _train_child(argv):
     res = sts.state_bytes_per_device(scope)
     print(json.dumps({
         "dp": dp, "accum": accum, "zero_stage": zero,
+        "tp": tp, "pp": pp, "pp_schedule": sts.pp_schedule,
         "global_batch": gb, "k": k,
         "step_ms": round(step_s * 1e3, 3),
         "rows_per_sec": round(gb / step_s, 1),
-        "rows_per_sec_per_chip": round(gb / step_s / dp, 1),
+        "rows_per_sec_per_chip": round(gb / step_s / (dp * tp * pp), 1),
         "loss_final": float(np.asarray(out[0]).mean()),
         "opt_shard_bytes_per_device": res["opt_shard_bytes_per_device"],
         "zero_account_bytes": res["zero_account_bytes"],
@@ -685,12 +695,15 @@ def _train_child(argv):
 
 
 def train_scale_mode(windows: int = 4, k: int = 2, global_batch: int = 32):
-    """`perf_lab.py train_scale` — sweep dp x accum_steps x zero_stage in
-    fresh subprocesses (each child forces its own virtual-device count
-    before jax initializes — the PR-8 --mesh discipline, so the forced
-    mesh never perturbs other lanes), print the table, and emit the
-    winner (max rows/s/chip at the fixed global batch, ties to the
-    simpler config) as the final JSON line."""
+    """`perf_lab.py train_scale` — sweep dp x tp x pp x zero_stage (and
+    accum on the pure-dp lanes) in fresh subprocesses (each child forces
+    its own virtual-device count dp*tp*pp before jax initializes — the
+    PR-8 --mesh discipline, so the forced mesh never perturbs other
+    lanes), print the table, and emit the winner (max rows/s/chip at
+    the fixed global batch, ties to the simpler config) as the final
+    JSON line. The grid mirrors docs/design.md §27's failure matrix:
+    zero-3 needs dp>=2; pp lanes run zero=1/accum=1 (the microbatch
+    schedule IS the accumulation window)."""
     import json
     import os
     import subprocess
@@ -699,39 +712,52 @@ def train_scale_mode(windows: int = 4, k: int = 2, global_batch: int = 32):
     env = {key: v for key, v in os.environ.items() if key != "PYTHONPATH"}
     env.pop("XLA_FLAGS", None)  # each child forces its own device count
     env["JAX_PLATFORMS"] = "cpu"
-    grid = [(dp, accum, zero)
+    # (dp, accum, zero, tp, pp, microbatches)
+    grid = [(dp, accum, zero, 1, 1, 0)
             for dp in (1, 2, 4, 8)
             for accum in (1, 2, 4)
             for zero in (1, 2)
             if global_batch % (dp * accum) == 0
             and not (dp == 1 and zero == 2 and accum == 1)]
+    # zero-3 bucketed-prefetch lanes (dp>=2, accum=1)
+    grid += [(dp, 1, 3, 1, 1, 0) for dp in (2, 4, 8)]
+    # tensor-parallel lanes (Path A: column-sharded weights in-window)
+    grid += [(1, 1, 1, 2, 1, 0), (2, 1, 1, 2, 1, 0), (2, 1, 3, 2, 1, 0)]
+    # pipeline lanes: M=2*pp -> gpipe, M=8 > 2*pp -> 1f1b
+    grid += [(1, 1, 1, 1, 2, 4), (2, 1, 1, 1, 2, 8), (1, 1, 1, 2, 2, 8)]
     rows = []
-    print(f"{'dp':>4}{'accum':>7}{'zero':>6}{'step_ms':>9}"
-          f"{'rows/s':>9}{'rows/s/chip':>13}{'opt_B/dev':>11}  note")
-    for dp, accum, zero in grid:
+    print(f"{'dp':>4}{'tp':>4}{'pp':>4}{'accum':>7}{'zero':>6}"
+          f"{'step_ms':>9}{'rows/s':>9}{'rows/s/chip':>13}"
+          f"{'opt_B/dev':>11}{'sched':>7}  note")
+    for dp, accum, zero, tp, pp, micro in grid:
         r = subprocess.run(
             [sys.executable, here, "train-child", str(dp), str(accum),
-             str(zero), str(windows), str(k), str(global_batch)],
+             str(zero), str(windows), str(k), str(global_batch),
+             str(tp), str(pp), str(micro)],
             capture_output=True, text=True, env=env, timeout=900)
         if r.returncode != 0:
-            print(f"{dp:>4}{accum:>7}{zero:>6}{'-':>9}{'-':>9}{'-':>13}"
-                  f"{'-':>11}  FAILED: {(r.stderr or '')[-120:]}")
+            print(f"{dp:>4}{tp:>4}{pp:>4}{accum:>7}{zero:>6}{'-':>9}"
+                  f"{'-':>9}{'-':>13}{'-':>11}{'-':>7}  "
+                  f"FAILED: {(r.stderr or '')[-120:]}")
             continue
         rec = json.loads(r.stdout.strip().splitlines()[-1])
         rows.append(rec)
-        print(f"{dp:>4}{accum:>7}{zero:>6}{rec['step_ms']:>9.3f}"
-              f"{rec['rows_per_sec']:>9.1f}"
+        print(f"{dp:>4}{tp:>4}{pp:>4}{accum:>7}{zero:>6}"
+              f"{rec['step_ms']:>9.3f}{rec['rows_per_sec']:>9.1f}"
               f"{rec['rows_per_sec_per_chip']:>13.1f}"
-              f"{int(rec['opt_shard_bytes_per_device']):>11}")
+              f"{int(rec['opt_shard_bytes_per_device']):>11}"
+              f"{rec.get('pp_schedule') or '-':>7}")
     if not rows:
         print(json.dumps({"error": "every train_scale cell failed"}))
         sys.exit(1)
     best = max(rows, key=lambda r: (r["rows_per_sec_per_chip"],
-                                    -r["dp"], -r["accum"],
+                                    -r["dp"], -r.get("tp", 1),
+                                    -r.get("pp", 1), -r["accum"],
                                     -r["zero_stage"]))
     print("chosen config:")
     print(json.dumps({"chosen": {key: best[key] for key in
-                                 ("dp", "accum", "zero_stage")},
+                                 ("dp", "tp", "pp", "accum",
+                                  "zero_stage")},
                       "step_ms": best["step_ms"],
                       "rows_per_sec_per_chip":
                           best["rows_per_sec_per_chip"],
