@@ -302,6 +302,14 @@ class Executor:
         # cache_key -> XLA cost-analysis FLOPs (annotated lazily on the
         # first run of each entry — obs/cost.py, feeds the MFU gauges)
         self._flops: Dict[Any, Any] = {}
+        # memory ledger (obs/mem.py, docs §28): cost-analysis bytes of
+        # retained executables, summed into one compile_cache entry
+        self._cache_nbytes: Dict[Any, int] = {}
+        from ..obs.mem import get_ledger, init_from_flags as _mem_flags
+
+        _mem_flags()  # PT_FLAG_OBS_MEM alone turns the ledger on
+        self._mem_compile = get_ledger().track(
+            "compile_cache", "executor blocks", 0)
         # numerics-sentinel host state (flags.obs_sentinel, docs §19):
         # EMAs for spike detection, the one-bundle-per-incident latch, and
         # a dedicated monotone step counter for event attribution (the
@@ -396,7 +404,17 @@ class Executor:
         with RecordEvent(f"executor_run/block{block_idx}"):
             t_acct = time.monotonic() if acct.enabled else 0.0
             with tr.span("train/device_dispatch", cat="train"):
-                fetches, new_state = fn(feed_vals, readonly, donated, key)
+                try:
+                    fetches, new_state = fn(feed_vals, readonly, donated,
+                                            key)
+                except Exception as e:
+                    from ..obs.mem import get_ledger
+
+                    if get_ledger().is_oom(e):
+                        get_ledger().handle_oom(
+                            e, component="train_dispatch",
+                            block=block_idx)
+                    raise
                 for n in state_out_names:
                     scope.set(n, new_state[n])
             if acct.enabled:
@@ -454,7 +472,13 @@ class Executor:
                 from ..obs import abstractify, analyze_jit
 
                 avals = tuple(abstractify(a) for a in call_args)
-                flops = analyze_jit(fn, *avals)["flops"]
+                res = analyze_jit(fn, *avals)
+                flops = res["flops"]
+                if res.get("bytes"):
+                    # ledger: retained-executable bytes by cache key
+                    self._cache_nbytes[cache_key] = int(res["bytes"])
+                    self._mem_compile.resize(
+                        sum(self._cache_nbytes.values()))
             except Exception:
                 flops = None
             if acct.enabled:
@@ -771,17 +795,34 @@ class Executor:
             acct = get_accountant()
             t_acct = time.monotonic() if acct.enabled else 0.0
             t_c = time.perf_counter()
-            with RecordEvent(event):
-                with get_tracer().span(f"train/{event}", cat="compile"):
-                    entry = compile_fn()
+            try:
+                with RecordEvent(event):
+                    with get_tracer().span(f"train/{event}", cat="compile"):
+                        entry = compile_fn()
+            except Exception as e:
+                # OOM postmortem (obs/mem.py): a compile that exhausts
+                # HBM trips the oom event + flight bundle with the full
+                # ledger snapshot; the exception still propagates
+                from ..obs.mem import get_ledger
+
+                if get_ledger().is_oom(e):
+                    get_ledger().handle_oom(e, component="train_compile",
+                                            label=log_label)
+                raise
             if acct.enabled:
                 acct.account("compile", t_acct, time.monotonic() - t_acct)
             if get_flag("log_compile"):
                 print(f"[compile] {log_label} "
                       f"{time.perf_counter() - t_c:.3f}s", flush=True)
             self._cache[cache_key] = entry
+            evicted = False
             while len(self._cache) > self._cache_capacity:
-                self._cache.pop(next(iter(self._cache)))
+                gone = next(iter(self._cache))
+                self._cache.pop(gone)
+                evicted = self._cache_nbytes.pop(gone, None) is not None \
+                    or evicted
+            if evicted:
+                self._mem_compile.resize(sum(self._cache_nbytes.values()))
         else:  # refresh LRU order
             self._cache[cache_key] = self._cache.pop(cache_key)
         return entry

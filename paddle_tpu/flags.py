@@ -76,6 +76,23 @@ _DEFAULTS: Dict[str, Any] = {
     # where automatic postmortem bundles land ("" = <tempdir>/
     # paddle_tpu_flight); obs/flight.py FlightRecorder.dump
     "obs_flight_dir": "",
+    # live device-memory ledger (obs/mem.py, docs/design.md §28): obs_mem
+    # turns measured HBM attribution on (zero-cost disabled — every
+    # registration site is one attribute read; disabled track() returns
+    # one shared no-op handle). obs_mem_hbm_bytes declares device capacity
+    # for occupancy/headroom gauges (0 = unknown); drift_tolerance is the
+    # relative model-vs-measured byte drift that flips a component finding
+    # to out-of-tolerance (typed mem_drift event); reconcile_max_arrays
+    # bounds the jax.live_arrays() walk so the closure pass stays cheap
+    # enough to run per bench round on CPU; admission_watermark > 0 lets
+    # paged-KV admission consult MEASURED occupancy (evict prefix-cache
+    # pages above the watermark) instead of modeled-only (0.0 = off —
+    # bit-identical admission when disabled).
+    "obs_mem": False,
+    "obs_mem_hbm_bytes": 0,
+    "obs_mem_drift_tolerance": 0.1,
+    "obs_mem_reconcile_max_arrays": 4096,
+    "obs_mem_admission_watermark": 0.0,
     # goodput accountant (obs/goodput.py, docs/design.md §23): classify
     # every wall-clock second of training windows and every request-second
     # of serving into the exhaustive taxonomy; exports pt_goodput_ratio /

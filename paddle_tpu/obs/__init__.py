@@ -45,6 +45,7 @@ from .events import (DISCARDED, Event, EventLog,  # noqa: F401
                      LoggingJSONSink, enable_json_logging, get_event_log)
 from .flight import (FlightRecorder, get_recorder, load_bundle,  # noqa: F401
                      replay_bundle, validate_bundle)
+from .mem import (MemoryLedger, NOOP_ALLOCATION, get_ledger)  # noqa: F401
 from .slo import SLO, SLOWatchdog, judge_bench, parse_slo_spec  # noqa: F401
 from .goodput import (GOOD_CATEGORIES, TRAIN_CATEGORIES,  # noqa: F401
                       GoodputAccountant, get_accountant,
@@ -58,12 +59,14 @@ __all__ = [
     "Counter", "DISCARDED", "Event", "EventLog", "ExemplarStore",
     "FlightRecorder", "GOOD_CATEGORIES", "Gauge", "GoodputAccountant",
     "Histogram", "LoggingJSONSink",
-    "MetricsRegistry", "MetricsServer", "ProfileError", "RateWindow",
+    "MemoryLedger", "MetricsRegistry", "MetricsServer", "NOOP_ALLOCATION",
+    "ProfileError", "RateWindow",
     "SLO", "SLOWatchdog",
     "Span", "TRAIN_CATEGORIES", "Tracer", "abstractify", "analyze_jit",
     "attribute_regression", "build_profile", "diff_profiles",
     "disable", "enable", "enable_json_logging", "flops_of_lowered",
-    "format_diff", "get_accountant", "get_event_log", "get_recorder",
+    "format_diff", "get_accountant", "get_event_log", "get_ledger",
+    "get_recorder",
     "get_registry", "get_tracer", "goodput_report",
     "init_from_flags", "judge_bench", "load_bundle", "load_profile",
     "new_trace_id", "parse_slo_spec", "peak_flops", "profile_from_window",

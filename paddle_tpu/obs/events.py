@@ -60,6 +60,10 @@ EVENT_TYPES = (
     "rollback",              # sentinel escalation -> restore last-good
     "preemption",            # SIGTERM caught -> grace snapshot + typed exit
     "elastic_resize",        # resume re-planned for a new device count
+    # memory plane (obs/mem.py, docs §28): RESOURCE_EXHAUSTED postmortem
+    # (attrs name the suspect component + ledger state at failure) and a
+    # model-vs-measured byte drift beyond obs_mem_drift_tolerance
+    "oom", "mem_drift",
     # watchdog / recorder
     "slo_breach", "worker_exception", "bundle_dumped",
     # differential attribution (obs/profile.py, docs §23): a profile pair

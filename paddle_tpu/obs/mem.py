@@ -1,0 +1,680 @@
+"""Live device-memory ledger: measured HBM attribution (docs §28).
+
+The obs tier measures *time* exhaustively (PR-5 tracing, PR-9 flight
+bundles, PR-13 goodput closure) but until now measured *memory* nowhere
+— yet every feasibility decision in the system (serving placement §18,
+quantization flips §20, paged-KV admission §22, the train searcher's
+HBM gate §27) rides an **analytic** byte account that was never checked
+against what actually lives on the device.
+
+``MemoryLedger`` is that check. Every framework-owned device allocation
+registers here with ``{component, shard, dtype, bytes, label}``:
+
+* engine weight stores (f32 and quantized ``.q``/``.s``),
+* dense and paged KV pools (pages broken out free/active/prefix-cached
+  via a lazy ``detail`` callback),
+* decode slot carries and prefetch buffers,
+* ZeRO/3D param+optimizer shards per mesh axis,
+* compile-cache retained executables (XLA cost-analysis bytes where
+  available),
+* resilience snapshot host buffers (``device="host"`` — excluded from
+  the device reconciliation).
+
+Three closure surfaces keep the ledger honest:
+
+1. **Reconciliation** — ``reconcile()`` diffs ledger totals against a
+   bounded ``jax.live_arrays()`` walk → ``pt_mem_unattributed_bytes`` /
+   ``pt_mem_attributed_ratio`` (the goodput ``sum == wall`` discipline
+   applied to bytes). An allocation the ledger does not know about shows
+   up as unattributed — the negative test injects one and watches the
+   gauge catch it.
+2. **Model-vs-measured drift** — ``reconcile_model(account)`` compares
+   per-component measured bytes against the analytic
+   ``ModelProfile``/``TrainProfile`` account; drift beyond
+   ``obs_mem_drift_tolerance`` produces a typed finding and a
+   ``mem_drift`` event — the first measured audit of the byte math that
+   gates every placement decision.
+3. **High-water marks + residency intervals** — exported to the Chrome
+   timeline as a per-component memory lane (``tools/timeline.py
+   --mem_path``, pid 3).
+
+OOM becomes a first-class postmortem: RESOURCE_EXHAUSTED caught at
+dispatch/compile calls ``handle_oom()`` which emits an ``oom`` event and
+trips a PR-9 flight bundle carrying the full ledger snapshot + top-N
+allocations + high-water history; ``paddle_cli doctor`` ranks the
+suspect component ("kv_pool 61% of HBM at failure, 2.3 GiB above plan").
+
+Design constraints (the PR-5 discipline, verbatim):
+
+* **zero-cost when disabled** — every instrumentation site is guarded by
+  one ``led.enabled`` attribute read; a disabled ``track()`` records
+  nothing and returns one shared ``NOOP_ALLOCATION`` sentinel
+  (identity-tested like the tracer's no-op span and the event log's
+  ``DISCARDED``).
+* **bounded** — residency intervals land in an overwrite ring; the
+  high-water history is a bounded ring; ``reconcile()`` caps its
+  ``live_arrays`` walk (``max_arrays``) and counts its own cost in
+  ``pt_mem_reconcile_seconds_total`` so it is cheap enough to run per
+  bench round on CPU.
+* **never on the math path** — the ledger only *observes* bytes; with
+  the flag off the serving/training numerics are bit-identical.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: the component taxonomy (docs/design.md §28). ``track()`` accepts any
+#: string, but these are what the instrumented tree produces and what
+#: ``paddle_cli doctor`` knows how to rank.
+COMPONENTS = (
+    "weights",        # engine weight stores (f32 / quantized .q+.s)
+    "kv_pool",        # dense or paged KV cache pools
+    "decode_carry",   # decode-loop carry state held across steps
+    "prefetch",       # reader DevicePrefetcher staged batches
+    "train_state",    # ZeRO/3D placed params + optimizer shards
+    "compile_cache",  # retained executables (cost-analysis bytes)
+    "snapshot_host",  # resilience snapshot host buffers (host-side)
+    "other",
+)
+
+_INTERVAL_RING = 4096   # completed residency intervals kept for timeline
+_HIGHWATER_RING = 512   # (t, total_bytes) samples kept for postmortems
+
+
+def _nbytes(value: Any) -> int:
+    """Best-effort byte count of an array / pytree / int. Walks dicts,
+    lists and tuples; leaves must expose ``.nbytes`` or be numbers.
+    Never imports jax — host-only processes can run the ledger."""
+    if value is None:
+        return 0
+    if isinstance(value, (int, float)):
+        return int(value)
+    nb = getattr(value, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(value, dict):
+        return sum(_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes(v) for v in value)
+    return 0
+
+
+class _NoopAllocation:
+    """Shared sentinel a disabled ``track()`` returns — the identity test
+    asserts no per-call allocation on the disabled path (the PR-5
+    ``_NOOP`` span / PR-9 ``DISCARDED`` pattern)."""
+
+    __slots__ = ()
+
+    def resize(self, value: Any) -> None:
+        pass
+
+    def release(self) -> None:
+        pass
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return "<allocation discarded: ledger disabled>"
+
+
+NOOP_ALLOCATION = _NoopAllocation()
+
+
+class Allocation:
+    """One live tracked allocation. ``resize()`` when the underlying
+    store changes size (e.g. compile cache grows), ``release()`` when the
+    device memory is dropped. Safe to release twice."""
+
+    __slots__ = ("_ledger", "aid", "component", "label", "shard", "dtype",
+                 "device", "bytes", "detail", "t0", "released")
+
+    def __init__(self, ledger, aid, component, label, shard, dtype, device,
+                 nbytes, detail):
+        self._ledger = ledger
+        self.aid = aid
+        self.component = component
+        self.label = label
+        self.shard = shard
+        self.dtype = dtype
+        self.device = device
+        self.bytes = int(nbytes)
+        self.detail = detail
+        self.t0 = time.monotonic()
+        self.released = False
+
+    def resize(self, value: Any) -> None:
+        self._ledger._resize(self, _nbytes(value))
+
+    def release(self) -> None:
+        self._ledger._release(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"component": self.component, "label": self.label,
+             "bytes": self.bytes, "device": self.device, "t0": self.t0}
+        if self.shard is not None:
+            d["shard"] = self.shard
+        if self.dtype is not None:
+            d["dtype"] = str(self.dtype)
+        if self.detail is not None:
+            try:
+                detail = self.detail()
+                if detail is not None:
+                    d["detail"] = detail
+            except Exception:
+                pass
+        return d
+
+
+class MemoryLedger:
+    """Thread-safe registry of framework-owned device (and host)
+    allocations, with reconciliation against ``jax.live_arrays()``,
+    model-vs-measured drift findings, high-water tracking, OOM
+    postmortems and admission watermark hooks."""
+
+    def __init__(self, registry=None):
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._registry = registry
+        self._allocs: Dict[int, Allocation] = {}
+        self._aid = 0
+        self._capacity = 0          # HBM bytes for occupancy/headroom
+        self._totals: Dict[str, int] = {}       # device bytes/component
+        self._host_totals: Dict[str, int] = {}  # host bytes/component
+        self._high_water: Dict[str, int] = {}   # per-component device HW
+        self._hw_total = 0
+        self._hw_ring: List[Any] = []           # (t, total) bounded ring
+        self._intervals: List[Dict[str, Any]] = []  # completed residencies
+        self._next_iv = 0
+        self._last_reconcile: Dict[str, Any] = {}
+        self._last_drift: List[Dict[str, Any]] = []
+        self._counters = None   # lazy (reconcile_seconds_total, oom_total)
+        self._oom_count = 0
+        self._exported: List[Any] = []  # registries already carrying gauges
+
+    # -- switches --
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity_bytes: Optional[int] = None) -> "MemoryLedger":
+        with self._lock:
+            if capacity_bytes:
+                self._capacity = int(capacity_bytes)
+            self._enabled = True
+        self._register_flight_provider()
+        self.export_gauges()
+        return self
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        """Drop all tracked state (tests); gauges read zeros after."""
+        with self._lock:
+            self._allocs = {}
+            self._totals = {}
+            self._host_totals = {}
+            self._high_water = {}
+            self._hw_total = 0
+            self._hw_ring = []
+            self._intervals = []
+            self._next_iv = 0
+            self._last_reconcile = {}
+            self._last_drift = []
+
+    def _register_flight_provider(self) -> None:
+        try:
+            from .flight import get_recorder
+
+            get_recorder().register_provider("mem_ledger", self.snapshot)
+        except Exception:
+            pass
+
+    # -- capacity / watermark hooks --
+    def set_capacity(self, nbytes: int) -> None:
+        self._capacity = int(nbytes)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def device_bytes(self) -> int:
+        with self._lock:
+            return sum(self._totals.values())
+
+    def occupancy(self) -> float:
+        """Measured fraction of declared HBM capacity in use; 0.0 when no
+        capacity is declared (gauges stay meaningful without config)."""
+        cap = self._capacity
+        if cap <= 0:
+            return 0.0
+        return self.device_bytes() / float(cap)
+
+    def headroom(self) -> Optional[int]:
+        """Bytes of declared capacity not yet attributed, or None when no
+        capacity is declared — admission hooks treat None as 'no opinion'."""
+        cap = self._capacity
+        if cap <= 0:
+            return None
+        return cap - self.device_bytes()
+
+    def above_watermark(self, watermark: float) -> bool:
+        """Admission hook: is measured occupancy above ``watermark``
+        (fraction of capacity)? False when disabled or capacity unknown —
+        modeled-only admission keeps working unchanged."""
+        if not self._enabled or watermark <= 0.0 or self._capacity <= 0:
+            return False
+        return self.occupancy() > watermark
+
+    # -- recording --
+    def track(self, component: str, label: str, value: Any = None,
+              shard: Optional[str] = None, dtype: Any = None,
+              device: str = "device",
+              detail: Optional[Callable[[], Any]] = None):
+        """Register one framework-owned allocation; returns a live
+        ``Allocation`` handle (or the shared ``NOOP_ALLOCATION`` when
+        disabled). ``value`` may be an array, a pytree of arrays, or a
+        byte count; ``device="host"`` allocations are tracked but
+        excluded from device totals and reconciliation. ``detail`` is a
+        lazy callback evaluated only at snapshot/dump time (e.g. paged-KV
+        free/active/cached byte split)."""
+        if not self._enabled:
+            return NOOP_ALLOCATION
+        nb = _nbytes(value)
+        with self._lock:
+            self._aid += 1
+            a = Allocation(self, self._aid, component, label, shard, dtype,
+                           device, nb, detail)
+            self._allocs[a.aid] = a
+            self._bump(component, nb, device)
+        return a
+
+    def _bump(self, component: str, delta: int, device: str) -> None:
+        # caller holds the lock
+        tot = self._host_totals if device == "host" else self._totals
+        tot[component] = tot.get(component, 0) + delta
+        if device != "host":
+            cur = self._totals.get(component, 0)
+            if cur > self._high_water.get(component, 0):
+                self._high_water[component] = cur
+            total = sum(self._totals.values())
+            if total > self._hw_total:
+                self._hw_total = total
+            ring = self._hw_ring
+            ring.append((time.monotonic(), total))
+            if len(ring) > _HIGHWATER_RING:
+                del ring[: len(ring) - _HIGHWATER_RING]
+
+    def _record_interval(self, a: Allocation, nbytes: int, now: float) -> None:
+        # caller holds the lock; one completed residency for the timeline
+        iv = {"t0": a.t0, "dur": max(0.0, now - a.t0),
+              "component": a.component, "label": a.label,
+              "bytes": int(nbytes), "device": a.device}
+        if len(self._intervals) < _INTERVAL_RING:
+            self._intervals.append(iv)
+        else:
+            self._intervals[self._next_iv] = iv
+        self._next_iv = (self._next_iv + 1) % _INTERVAL_RING
+
+    def _resize(self, a: Allocation, nbytes: int) -> None:
+        if not self._enabled or a.released:
+            return
+        with self._lock:
+            delta = int(nbytes) - a.bytes
+            if delta == 0:
+                return
+            now = time.monotonic()
+            self._record_interval(a, a.bytes, now)
+            a.bytes = int(nbytes)
+            a.t0 = now
+            self._bump(a.component, delta, a.device)
+
+    def _release(self, a: Allocation) -> None:
+        if a.released:
+            return
+        with self._lock:
+            if a.released:
+                return
+            a.released = True
+            self._allocs.pop(a.aid, None)
+            self._record_interval(a, a.bytes, time.monotonic())
+            self._bump(a.component, -a.bytes, a.device)
+
+    # -- reading --
+    def totals(self, device: str = "device") -> Dict[str, int]:
+        with self._lock:
+            src = self._host_totals if device == "host" else self._totals
+            return {k: v for k, v in src.items() if v}
+
+    def high_water(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._high_water)
+            out["total"] = self._hw_total
+            return out
+
+    def high_water_history(self) -> List[Any]:
+        with self._lock:
+            return list(self._hw_ring)
+
+    def allocations(self) -> List[Allocation]:
+        with self._lock:
+            return list(self._allocs.values())
+
+    def top_allocations(self, n: int = 10) -> List[Dict[str, Any]]:
+        allocs = sorted(self.allocations(), key=lambda a: -a.bytes)[:n]
+        return [a.to_dict() for a in allocs]
+
+    def dump_intervals(self) -> Dict[str, Any]:
+        """Residency intervals (completed + live) for the Chrome-timeline
+        memory lane (``tools/timeline.py --mem_path``, pid 3)."""
+        now = time.monotonic()
+        with self._lock:
+            if len(self._intervals) < _INTERVAL_RING:
+                ivs = list(self._intervals)
+            else:
+                ivs = (self._intervals[self._next_iv:]
+                       + self._intervals[: self._next_iv])
+            for a in self._allocs.values():
+                ivs.append({"t0": a.t0, "dur": max(0.0, now - a.t0),
+                            "component": a.component, "label": a.label,
+                            "bytes": a.bytes, "device": a.device,
+                            "live": True})
+        return {"intervals": ivs, "high_water": self.high_water(),
+                "high_water_history": self.high_water_history()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full ledger state for the flight-recorder ``mem_ledger``
+        provider — what the OOM bundle carries and doctor ranks."""
+        return {
+            "enabled": self._enabled,
+            "capacity_bytes": self._capacity,
+            "device_bytes": self.device_bytes(),
+            "occupancy": self.occupancy(),
+            "totals": self.totals(),
+            "host_totals": self.totals(device="host"),
+            "high_water": self.high_water(),
+            "high_water_history": self.high_water_history()[-64:],
+            "top_allocations": self.top_allocations(10),
+            "reconcile": dict(self._last_reconcile),
+            "drift": list(self._last_drift),
+            "oom_count": self._oom_count,
+        }
+
+    # -- closure surface 1: reconciliation vs jax.live_arrays() --
+    def reconcile(self, baseline_bytes: int = 0,
+                  max_arrays: Optional[int] = None) -> Dict[str, Any]:
+        """Diff ledger device totals against measured ``jax.live_arrays()``
+        bytes — the closure gauge. ``baseline_bytes`` subtracts arrays
+        that predate the workload (in-process tests); ``max_arrays``
+        bounds the walk (CI hygiene; the truncation is reported, never
+        silent). Updates ``pt_mem_unattributed_bytes`` /
+        ``pt_mem_attributed_ratio`` and counts its own wall cost in
+        ``pt_mem_reconcile_seconds_total``."""
+        t_start = time.monotonic()
+        if max_arrays is None:
+            try:
+                from ..flags import get_flag
+
+                max_arrays = int(get_flag("obs_mem_reconcile_max_arrays"))
+            except Exception:
+                max_arrays = 4096
+        live = 0
+        n = 0
+        truncated = False
+        try:
+            import jax
+
+            for arr in jax.live_arrays():
+                if n >= max_arrays:
+                    truncated = True
+                    break
+                n += 1
+                try:
+                    live += int(arr.nbytes)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+        live = max(0, live - int(baseline_bytes))
+        attributed = self.device_bytes()
+        unattributed = max(0, live - attributed)
+        ratio = (attributed / float(live)) if live > 0 else 1.0
+        seconds = time.monotonic() - t_start
+        res = {"live_bytes": live, "attributed_bytes": attributed,
+               "unattributed_bytes": unattributed, "ratio": ratio,
+               "arrays": n, "truncated": truncated, "seconds": seconds,
+               "baseline_bytes": int(baseline_bytes)}
+        with self._lock:
+            self._last_reconcile = res
+        c = self._get_counters()
+        if c is not None:
+            try:
+                c["reconcile_seconds"].inc(seconds)
+                c["reconcile_total"].inc()
+            except Exception:
+                pass
+        return res
+
+    def last_reconcile(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._last_reconcile)
+
+    # -- closure surface 2: model-vs-measured drift --
+    def reconcile_model(self, account: Dict[str, int],
+                        tolerance: Optional[float] = None
+                        ) -> List[Dict[str, Any]]:
+        """Compare measured per-component device bytes against the
+        analytic ``account`` ({component: planned_bytes}, e.g. from
+        ``ModelProfile``). Components drifting beyond ``tolerance``
+        (relative, default flag ``obs_mem_drift_tolerance``) produce a
+        typed finding and a ``mem_drift`` event. Returns ALL per-component
+        findings; each carries ``within_tolerance``."""
+        if tolerance is None:
+            try:
+                from ..flags import get_flag
+
+                tolerance = float(get_flag("obs_mem_drift_tolerance"))
+            except Exception:
+                tolerance = 0.1
+        measured = self.totals()
+        findings: List[Dict[str, Any]] = []
+        for comp in sorted(set(account) | set(measured)):
+            plan = int(account.get(comp, 0))
+            got = int(measured.get(comp, 0))
+            if plan <= 0 and got <= 0:
+                continue
+            denom = float(max(plan, 1))
+            drift = (got - plan) / denom
+            ok = abs(drift) <= tolerance if plan > 0 else False
+            findings.append({"component": comp, "planned_bytes": plan,
+                             "measured_bytes": got, "drift": drift,
+                             "within_tolerance": ok})
+        with self._lock:
+            self._last_drift = findings
+        try:
+            from .events import get_event_log
+
+            log = get_event_log()
+            if log.enabled:
+                for f in findings:
+                    if not f["within_tolerance"]:
+                        log.emit("mem_drift", severity="warn",
+                                 component=f["component"],
+                                 planned_bytes=f["planned_bytes"],
+                                 measured_bytes=f["measured_bytes"],
+                                 drift=round(f["drift"], 4))
+        except Exception:
+            pass
+        return findings
+
+    # -- OOM postmortem --
+    @staticmethod
+    def is_oom(exc: BaseException) -> bool:
+        """Classify an exception as XLA device-memory exhaustion.
+        RESOURCE_EXHAUSTED is how XLA spells OOM across backends."""
+        text = "%s: %s" % (type(exc).__name__, exc)
+        low = text.lower()
+        return ("resource_exhausted" in low or "resource exhausted" in low
+                or "out of memory" in low)
+
+    def handle_oom(self, exc: BaseException, component: str = "unknown",
+                   **ctx) -> Optional[str]:
+        """OOM postmortem: emit an ``oom`` event and trip a flight bundle
+        carrying the full ledger snapshot (the ``mem_ledger`` provider) +
+        top-N allocations + high-water history. Returns the bundle path
+        (None when the recorder declines/rate-limits). Never raises —
+        the original exception is what propagates."""
+        self._oom_count += 1
+        c = self._get_counters()
+        if c is not None:
+            try:
+                c["oom_total"].inc()
+            except Exception:
+                pass
+        info = {"component": component, "error": str(exc)[:500]}
+        info.update({k: v for k, v in ctx.items() if v is not None})
+        try:
+            from .events import get_event_log
+
+            log = get_event_log()
+            if log.enabled:
+                top = self.top_allocations(3)
+                log.emit("oom", severity="error",
+                         device_bytes=self.device_bytes(),
+                         occupancy=round(self.occupancy(), 4),
+                         top=[{"component": t["component"],
+                               "bytes": t["bytes"]} for t in top],
+                         **info)
+        except Exception:
+            pass
+        try:
+            from .flight import get_recorder
+
+            self._register_flight_provider()
+            trigger = {"type": "oom"}
+            trigger.update(info)
+            return get_recorder().maybe_dump(trigger)
+        except Exception:
+            return None
+
+    # -- gauges --
+    def _get_counters(self):
+        if self._counters is None:
+            try:
+                from .metrics import get_registry
+
+                r = self._registry or get_registry()
+                self._counters = {
+                    "reconcile_seconds": r.counter(
+                        "pt_mem_reconcile_seconds_total",
+                        "Wall seconds spent in ledger reconciliation "
+                        "passes (CI-hygiene budget)"),
+                    "reconcile_total": r.counter(
+                        "pt_mem_reconcile_total",
+                        "Ledger reconciliation passes run"),
+                    "oom_total": r.counter(
+                        "pt_mem_oom_total",
+                        "RESOURCE_EXHAUSTED postmortems handled"),
+                }
+            except Exception:
+                return None
+        return self._counters
+
+    def export_gauges(self, registry=None) -> None:
+        """Register the ``pt_mem_*`` pull gauges into ``registry`` (the
+        process default when omitted). Callback-style — scraping reads
+        live ledger state; callable any number of times on any number of
+        registries (each server exports on its own /metrics page)."""
+        if registry is None:
+            try:
+                from .metrics import get_registry
+
+                registry = self._registry or get_registry()
+            except Exception:
+                return
+        if any(r is registry for r in self._exported):
+            return
+        try:
+            registry.gauge(
+                "pt_mem_tracked_bytes",
+                "Ledger-attributed device bytes across all components",
+                callback=self.device_bytes)
+            registry.gauge(
+                "pt_mem_hbm_capacity_bytes",
+                "Declared device HBM capacity (flag obs_mem_hbm_bytes)",
+                callback=lambda: self._capacity)
+            registry.gauge(
+                "pt_mem_hbm_occupancy",
+                "Measured fraction of declared HBM capacity in use",
+                callback=self.occupancy)
+            registry.gauge(
+                "pt_mem_unattributed_bytes",
+                "live_arrays bytes the ledger cannot attribute "
+                "(closure gauge; last reconcile pass)",
+                callback=lambda: self._last_reconcile.get(
+                    "unattributed_bytes", 0))
+            registry.gauge(
+                "pt_mem_attributed_ratio",
+                "attributed/live byte ratio of the last reconcile pass "
+                "(1.0 = full closure)",
+                callback=lambda: self._last_reconcile.get("ratio", 1.0))
+            registry.gauge(
+                "pt_mem_high_water_bytes",
+                "High-water mark of total tracked device bytes",
+                callback=lambda: self._hw_total)
+            registry.gauge(
+                "pt_mem_kv_pool_share",
+                "kv_pool fraction of all tracked device bytes",
+                callback=self._kv_share)
+            comp = registry.gauge(
+                "pt_mem_component_bytes",
+                "Ledger-attributed device bytes by component",
+                labelnames=("component",))
+            for name in COMPONENTS:
+                comp.labels(component=name).set_callback(
+                    lambda n=name: self._totals.get(n, 0))
+            drift = registry.gauge(
+                "pt_mem_drift_ratio",
+                "Relative model-vs-measured byte drift by component "
+                "(last reconcile_model pass)",
+                labelnames=("component",))
+            for name in COMPONENTS:
+                drift.labels(component=name).set_callback(
+                    lambda n=name: self._drift_of(n))
+            self._exported.append(registry)
+        except Exception:
+            pass
+
+    def _kv_share(self) -> float:
+        with self._lock:
+            total = sum(self._totals.values())
+            kv = self._totals.get("kv_pool", 0)
+        return (kv / float(total)) if total > 0 else 0.0
+
+    def _drift_of(self, component: str) -> float:
+        with self._lock:
+            for f in self._last_drift:
+                if f["component"] == component:
+                    return f["drift"]
+        return 0.0
+
+
+_default = MemoryLedger()
+
+
+def get_ledger() -> MemoryLedger:
+    """The process-wide default memory ledger every registration site
+    writes into (the memory-plane sibling of ``get_tracer()``)."""
+    return _default
+
+
+def init_from_flags() -> MemoryLedger:
+    """Honor ``flags.obs_mem`` / ``obs_mem_hbm_bytes`` — an env var alone
+    (``PT_FLAG_OBS_MEM=1``) turns the ledger on."""
+    from ..flags import get_flag
+
+    if not _default.enabled and get_flag("obs_mem"):
+        cap = int(get_flag("obs_mem_hbm_bytes"))
+        _default.enable(capacity_bytes=cap or None)
+    return _default

@@ -81,6 +81,13 @@ def live_instruments() -> Dict[str, Dict[str, object]]:
 
     SLOWatchdog([], registry=r)
     _collect(r, "obs/slo.py SLOWatchdog", out)
+    # memory plane (docs §28): the ledger's gauges are all scrape-time
+    # callbacks, registered by export_gauges against any registry
+    r = MetricsRegistry()
+    from .mem import MemoryLedger
+
+    MemoryLedger().export_gauges(r)
+    _collect(r, "obs/mem.py MemoryLedger", out)
     # training + tuner planes register into the PROCESS default registry
     # lazily; poke them, then read only their families off it
     from ..core.executor import _train_metrics
@@ -89,6 +96,13 @@ def live_instruments() -> Dict[str, Dict[str, object]]:
     _train_metrics()
     _collect_prefixed(get_registry(), "pt_train_",
                       "core/executor.py _train_metrics", out)
+    # the ledger's counters (reconcile walltime/count, OOM count) are
+    # process-wide like pt_events_total — poke the lazy family
+    from .mem import get_ledger
+
+    get_ledger()._get_counters()
+    _collect_prefixed(get_registry(), "pt_mem_",
+                      "obs/mem.py MemoryLedger counters", out)
     try:
         from ..tune import service as tune_service
 

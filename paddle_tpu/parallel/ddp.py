@@ -332,6 +332,26 @@ class ShardedTrainStep:
         self._cache: Dict[Any, Any] = {}   # compiled windows
         self._readonly_cache: Dict[Tuple, List[str]] = {}
         self._pp_cache: Dict[Any, Any] = {}
+        self._mem_state = None  # ledger handle (obs/mem.py, lazy)
+
+    def _mem_sync(self) -> None:
+        """Resize the memory ledger's train_state entry to the currently
+        placed bytes — the ZeRO/3D param + optimizer shards, labeled with
+        the mesh axes (obs/mem.py, docs §28). One attribute read when the
+        ledger is off."""
+        from ..obs.mem import get_ledger
+
+        led = get_ledger()
+        if not led.enabled:
+            return
+        total = sum(int(getattr(v, "nbytes", 0))
+                    for v in self._placed.values())
+        if self._mem_state is None or self._mem_state.released:
+            self._mem_state = led.track(
+                "train_state", f"zero{self.zero_stage} placed state",
+                total, shard=f"dp{self.dp}xtp{self.tp}xpp{self.pp}")
+        else:
+            self._mem_state.resize(total)
 
     # -- state layout -------------------------------------------------------
     def _spec(self, *axes):
@@ -520,6 +540,7 @@ class ShardedTrainStep:
                 placed = jax.device_put(val, repl)
                 scope.set(s, placed)
                 self._placed[s] = placed
+        self._mem_sync()
 
     def gather_state(self, scope) -> None:
         """Convert the scope's ZeRO state back to logical shapes (host
@@ -560,6 +581,7 @@ class ShardedTrainStep:
         from ..core.executor import _train_metrics
 
         _train_metrics()["dp"].set(1.0)
+        self._mem_sync()  # placed state went back to host (leak gate)
 
     def zero_meta(self) -> Dict[str, Any]:
         """The reshard descriptor a checkpoint carries (io.py writes it
@@ -923,6 +945,7 @@ class ShardedTrainStep:
             for s, v in new_scalars.items():
                 scope.set(s, v)
                 self._placed[s] = v
+        self._mem_sync()
         dev_dur = time.monotonic() - t_dev
         if acct.enabled:
             acct.account("device_compute", t_dev, dev_dur)
@@ -1023,6 +1046,7 @@ class ShardedTrainStep:
             placed = jax.device_put(arr, sh)
             scope.set(n, placed)
             self._placed[n] = placed
+        self._mem_sync()
 
     def _run_pipeline(self, feeds, invariant, k, fetch_names, scope, seed,
                       return_numpy):
@@ -1124,6 +1148,7 @@ class ShardedTrainStep:
                 acct.account("device_compute", t_dev,
                              time.monotonic() - t_dev)
             outs.append(fetches)
+        self._mem_sync()
         m["steps"].inc(k)
         stacked = []
         for j in range(len(fetch_names)):

@@ -462,20 +462,33 @@ class ResilientTrainer:
             # attribute it; the background write overlaps the next
             # window and sweeps under device_compute (hidden, ~free)
             acct.account("checkpoint", t0, copy_dur)
+        # memory ledger (obs/mem.py): the host-side snapshot buffers are
+        # real memory a double-buffered publisher holds up to two of —
+        # tracked as snapshot_host (device="host", excluded from the
+        # device reconcile), released when the publish lands
+        from ..obs.mem import get_ledger
+
+        mem = get_ledger().track("snapshot_host", f"snapshot s{serial}",
+                                 host_state, device="host")
         if sync:
-            self._publish(serial, host_state, state)
+            try:
+                self._publish(serial, host_state, state)
+            finally:
+                mem.release()
             return serial
         self._start_publisher()
         with self._pub_cv:
             if self._pub_err is not None:
                 err, self._pub_err = self._pub_err, None
+                mem.release()
                 raise err
             if self._pub_pending >= 2:
                 _resilience_metrics()["skipped"].inc()
+                mem.release()
                 return None
             self._pub_pending += 1
         self._pub_q.put({"serial": serial, "host_state": host_state,
-                         "train_state": state})
+                         "train_state": state, "mem": mem})
         return serial
 
     def _start_publisher(self) -> None:
@@ -490,12 +503,15 @@ class ResilientTrainer:
             item = self._pub_q.get()
             if item is None:
                 return
+            mem = item.pop("mem", None)
             try:
                 self._publish(**item)
             except BaseException as e:  # surfaced at the next boundary
                 with self._pub_cv:
                     self._pub_err = e
             finally:
+                if mem is not None:
+                    mem.release()
                 with self._pub_cv:
                     self._pub_pending -= 1
                     self._pub_cv.notify_all()

@@ -80,6 +80,30 @@ class DevicePrefetcher:
 
         from ..obs import get_tracer
         from ..obs.goodput import get_accountant
+        from ..obs.mem import get_ledger
+
+        # resident-and-waiting bytes: one ledger handle resized as placed
+        # batches enter/leave the bounded queue.  depth * batch_bytes is
+        # exactly the HBM this pipeline holds beyond the live step.
+        led = get_ledger()
+        mem = led.track("prefetch", "staged batches", 0)
+        mem_lock = threading.Lock()
+        resident = [0]
+
+        def _mem_add(placed):
+            if not led.enabled:
+                return 0
+            n = sum(int(getattr(v, "nbytes", 0)) for v in placed.values())
+            with mem_lock:
+                resident[0] += n
+                mem.resize(resident[0])
+            return n
+
+        def _mem_sub(n):
+            if n:
+                with mem_lock:
+                    resident[0] = max(0, resident[0] - n)
+                    mem.resize(resident[0])
 
         def fill():
             tr = get_tracer()
@@ -103,6 +127,7 @@ class DevicePrefetcher:
                     if acct.enabled:
                         acct.account("h2d", t_acct,
                                      time.monotonic() - t_acct)
+                    _mem_add(placed)
                     while not stop.is_set():
                         try:
                             q.put(placed, timeout=0.1)
@@ -140,7 +165,11 @@ class DevicePrefetcher:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                if led.enabled:
+                    _mem_sub(sum(int(getattr(v, "nbytes", 0))
+                                 for v in item.values()))
                 self.batches += 1
                 yield item
         finally:
             stop.set()  # consumer abandoned the iterator: unblock the filler
+            mem.release()

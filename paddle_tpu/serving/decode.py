@@ -228,6 +228,62 @@ class DecodeEngine:
         # passing it keeps those paths bit-identical (sampling.py)
         self._default_samples: Dict[int, Dict[str, np.ndarray]] = {}
 
+        # memory ledger (obs/mem.py, docs §28): weight store + KV pools;
+        # one attribute read when the ledger is off
+        from ..obs.mem import NOOP_ALLOCATION
+
+        self._mem_weights = NOOP_ALLOCATION
+        self._mem_pools = NOOP_ALLOCATION
+        self._mem_track_weights()
+        self._mem_track_pools()
+
+    # -- memory ledger hooks --
+    def _mem_shard_label(self) -> Optional[str]:
+        """Mesh annotation for ledger entries (sharded.py overrides)."""
+        return None
+
+    def _mem_kv_detail(self):
+        """Lazy per-state byte split for the kv_pool ledger entry (the
+        paged mixin overrides with free/active/prefix-cached pages)."""
+        return None
+
+    def _mem_weights_detail(self):
+        """Lazy byte-split of the weight store for ledger snapshots (the
+        quantized engines override with the q/s breakdown)."""
+        return None
+
+    def _mem_track_weights(self) -> None:
+        from ..obs.mem import get_ledger
+
+        led = get_ledger()
+        if not led.enabled:
+            return
+        self._mem_weights.release()
+        self._mem_weights = led.track(
+            "weights", f"decode:{self.dirname}", self.weights_bytes(),
+            shard=self._mem_shard_label(), dtype=self.quant_mode or "f32",
+            detail=self._mem_weights_detail)
+
+    def _mem_track_pools(self) -> None:
+        from ..obs.mem import get_ledger
+
+        led = get_ledger()
+        if not led.enabled:
+            return
+        self._mem_pools.release()
+        nbytes = (int(getattr(self.pool_k, "nbytes", 0))
+                  + int(getattr(self.pool_v, "nbytes", 0)))
+        self._mem_pools = led.track(
+            "kv_pool", f"decode:{self.dirname}", nbytes,
+            shard=self._mem_shard_label(), dtype="f32",
+            detail=self._mem_kv_detail)
+
+    def _mem_release(self) -> None:
+        """Drop this engine's ledger entries (server close / replica
+        drain) — the ledger must return to baseline."""
+        self._mem_weights.release()
+        self._mem_pools.release()
+
     # -- placement hooks (serving/sharded.py overrides both) --
     def _device_put_params(self, host_params):
         """Host pytree -> device-resident pytree. The sharded engine
@@ -356,12 +412,23 @@ class DecodeEngine:
             version = self.params_version
         cold = entry.cold
         t0 = time.monotonic() if cold else 0.0
-        with jax.default_device(self._device):
-            next_tok, logits, new_pos, self.pool_k, self.pool_v = entry.fn(
-                params, self.pool_k, self.pool_v, tokens,
-                jax.numpy.asarray(positions, jax.numpy.int32),
-                jax.numpy.asarray(valids, jax.numpy.int32),
-                jax.numpy.asarray(slots, jax.numpy.int32), sample)
+        try:
+            with jax.default_device(self._device):
+                next_tok, logits, new_pos, self.pool_k, self.pool_v = \
+                    entry.fn(
+                        params, self.pool_k, self.pool_v, tokens,
+                        jax.numpy.asarray(positions, jax.numpy.int32),
+                        jax.numpy.asarray(valids, jax.numpy.int32),
+                        jax.numpy.asarray(slots, jax.numpy.int32), sample)
+        except Exception as e:
+            # OOM postmortem (obs/mem.py): typed event + flight bundle
+            # with the ledger snapshot; the exception still propagates
+            from ..obs.mem import get_ledger
+
+            if get_ledger().is_oom(e):
+                get_ledger().handle_oom(e, component="decode_dispatch",
+                                        lanes=lanes, window=window)
+            raise
         if cold:
             entry.compile_s = time.monotonic() - t0
             entry.cold = False
@@ -459,7 +526,10 @@ class DecodeEngine:
         with self._lock:
             self._params = staged
             self.params_version += 1
-            return self.params_version
+            version = self.params_version
+        # ledger: the old store's bytes drop with the swap (leak gate b)
+        self._mem_track_weights()
+        return version
 
 
 class SlotScheduler:
@@ -715,6 +785,12 @@ class GenerationBatcher:
             [None] * engine.max_slots
         self._inflight: deque = deque()  # (next_tok_dev, version, lanes_snapshot, t_dispatch, window)
         self._carry = None  # (tokens_dev, positions_dev) steady-state carry
+        # memory ledger: the carry's device bytes (tiny, but part of the
+        # closure) — one live handle resized at each boundary
+        from ..obs.mem import get_ledger
+
+        self._mem_carry = get_ledger().track(
+            "decode_carry", "batcher carry", 0)
         # reload barrier hand-off
         self._reload_lock = threading.Lock()  # one reload at a time
         self._staged_params = None
@@ -1379,6 +1455,8 @@ class GenerationBatcher:
                     self._carry = None
                     continue
                 self._carry = (tok_dev.reshape(-1, 1), pos_dev)
+                self._mem_carry.resize(int(getattr(tok_dev, "nbytes", 0))
+                                       + int(getattr(pos_dev, "nbytes", 0)))
                 self._inflight.append(
                     (tok_dev, lg_dev if want_lg else None, version,
                      lanes_snap, t_disp, window))
@@ -1399,6 +1477,7 @@ class GenerationBatcher:
                 self.engine.free_slot(g.slot)
                 self._lanes[i] = None
             self._resolve_leftovers()
+            self._mem_carry.release()
             if self.stats:
                 self.stats.set_decode_slots(0, self.engine.max_slots)
 

@@ -223,6 +223,50 @@ class ServingEngine:
         self.params_version = 1  # bumped by every successful reload_params
         self.chaos = None  # optional ChaosInjector (dispatch hooks)
 
+        # memory ledger (obs/mem.py, docs §28): register the resident
+        # weight store and the compile cache; one attribute read when the
+        # ledger is off (the handles are the shared no-op singleton)
+        from ..obs.mem import NOOP_ALLOCATION
+
+        self._mem_weights = NOOP_ALLOCATION
+        self._mem_compile = NOOP_ALLOCATION
+        self._mem_track_weights()
+
+    def _mem_shard_label(self) -> Optional[str]:
+        """Mesh/shard annotation for the ledger entry (the sharded engine
+        overrides: "dp2xtp4")."""
+        return None
+
+    def _mem_weights_detail(self):
+        """Lazy byte-split of the weight store for ledger snapshots (the
+        quantized engines override with the q/s breakdown)."""
+        return None
+
+    def _mem_track_weights(self) -> None:
+        """(Re)register the resident param store with the memory ledger —
+        called at construction, after a quantization flip, and on every
+        commit_params (the old version's bytes drop with the swap, which
+        is exactly the two-version-residency leak gate)."""
+        from ..obs.mem import NOOP_ALLOCATION, get_ledger
+
+        led = get_ledger()
+        if not led.enabled:
+            return
+        self._mem_weights.release()
+        self._mem_weights = led.track(
+            "weights", f"serving:{self.dirname}", self.weights_bytes(),
+            shard=self._mem_shard_label(), dtype=self.quant_mode or "f32",
+            detail=self._mem_weights_detail)
+        if self._mem_compile is NOOP_ALLOCATION:
+            self._mem_compile = led.track("compile_cache",
+                                          "serving buckets", 0)
+
+    def _mem_release(self) -> None:
+        """Drop this engine's ledger entries (server close / replica
+        drain) — the ledger must return to baseline."""
+        self._mem_weights.release()
+        self._mem_compile.release()
+
     def _load_params(self) -> Dict[str, Any]:
         """Scope -> device-resident serving params, all on ONE device.
         The sharded engine (serving/sharded.py) overrides this to place
@@ -370,6 +414,8 @@ class ServingEngine:
             entry = self._cache.setdefault(sig, entry)
             while len(self._cache) > self.cache_capacity:
                 self._cache.popitem(last=False)
+            retained = sum(int(e.bytes or 0) for e in self._cache.values())
+        self._mem_compile.resize(retained)
         return entry
 
     def cache_info(self) -> Dict[str, int]:
@@ -451,7 +497,11 @@ class ServingEngine:
         with self._lock:
             self._params = new_params
             self.params_version += 1
-            return self.params_version
+            version = self.params_version
+        # no two-version residency on the ledger either: the old store's
+        # bytes drop the moment the swap lands (leak gate b)
+        self._mem_track_weights()
+        return version
 
     # -- execution --
     def run_batch(self, feeds: Dict[str, Any]) -> List[np.ndarray]:
@@ -498,12 +548,23 @@ class ServingEngine:
             version = self.params_version
         cold = entry.cold
         t_call = time.monotonic() if cold else 0.0
-        with jax.default_device(self._device):
-            feed_vals = {n: jax.device_put(a, self._device)
-                         for n, a in feeds.items()}
-            readonly = {n: params[n] for n in self._readonly_names}
-            donated = {n: params[n] for n in self._donated_names}
-            fetches, _ = entry.fn(feed_vals, readonly, donated, self._key)
+        try:
+            with jax.default_device(self._device):
+                feed_vals = {n: jax.device_put(a, self._device)
+                             for n, a in feeds.items()}
+                readonly = {n: params[n] for n in self._readonly_names}
+                donated = {n: params[n] for n in self._donated_names}
+                fetches, _ = entry.fn(feed_vals, readonly, donated, self._key)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED at dispatch/compile becomes a first-class
+            # postmortem: oom event + flight bundle with the full ledger
+            # snapshot; the original exception still propagates
+            from ..obs.mem import get_ledger
+
+            if get_ledger().is_oom(e):
+                get_ledger().handle_oom(e, component="serving_dispatch",
+                                        bucket=bucket, rows=rows)
+            raise
         if cold:
             # the first call through a fresh jit wrapper runs the XLA
             # compile synchronously — this duration IS the cache-miss

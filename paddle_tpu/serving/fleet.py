@@ -142,6 +142,14 @@ def scraped_gauges(hz: Dict[str, Any], metrics_text: str) -> Dict[str, float]:
         # rate. -1.0 is the not-speculating sentinel (the CLI renders
         # "-"); a real rate is always in [0, 1].
         "spec_acceptance": g.get("pt_serving_spec_acceptance_rate", -1.0),
+        # memory ledger (docs §28): measured HBM occupancy against the
+        # declared capacity, the bytes live arrays hold that no component
+        # claimed, and the pool's share of tracked bytes. Occupancy 0.0
+        # means the replica has no ledger (or no declared capacity) —
+        # absence of measurement must read as no pressure, not as full.
+        "hbm_occupancy": g.get("pt_mem_hbm_occupancy", 0.0),
+        "mem_unattributed": g.get("pt_mem_unattributed_bytes", 0.0),
+        "kv_pool_share": g.get("pt_mem_kv_pool_share", 0.0),
     }
 
 
@@ -394,6 +402,7 @@ class FleetRouter:
                  circuit_threshold: int = 3, circuit_cooldown_s: float = 2.0,
                  shed_base: float = 0.6, shed_step: float = 0.15,
                  degraded_pressure: float = 0.6,
+                 degraded_hbm_occupancy: float = 0.95,
                  pressure_override: Optional[float] = None,
                  default_priority: int = 1,
                  scale_up_qps: Optional[float] = None,
@@ -416,6 +425,7 @@ class FleetRouter:
         self.shed_base = shed_base
         self.shed_step = shed_step
         self.degraded_pressure = degraded_pressure
+        self.degraded_hbm_occupancy = degraded_hbm_occupancy
         self.pressure_override = pressure_override
         self.default_priority = int(default_priority)
         self.scale_up_qps = scale_up_qps
@@ -653,10 +663,21 @@ class FleetRouter:
                    if h.reachable and not h.draining
                    and h.health != "draining" and h.circuit.would_allow())
 
+    def worst_hbm_occupancy(self) -> float:
+        """Highest measured HBM occupancy across routable replicas — the
+        memory-ledger gauge (``pt_mem_hbm_occupancy``) scraped per
+        replica. 0.0 when no replica measures (no ledger or no declared
+        capacity): absence of measurement is not pressure."""
+        vals = [float(h.metrics.get("hbm_occupancy") or 0.0)
+                for h in self._replica_list()
+                if h.reachable and not h.draining]
+        return max(vals) if vals else 0.0
+
     def fleet_state(self) -> str:
         """``unavailable`` (nothing routable) / ``degraded`` (pressure at
-        the degraded bar, or a majority of replicas unroutable) /
-        ``healthy`` — the PR-2 state machine at fleet scope."""
+        the degraded bar, a majority of replicas unroutable, or any
+        replica's measured HBM occupancy at the OOM bar) / ``healthy`` —
+        the PR-2 state machine at fleet scope."""
         reps = [h for h in self._replica_list() if not h.draining]
         routable = self.healthy_replica_count()
         if routable == 0:
@@ -664,6 +685,8 @@ class FleetRouter:
         if self.pressure() >= self.degraded_pressure:
             return "degraded"
         if reps and routable * 2 < len(reps):
+            return "degraded"
+        if self.worst_hbm_occupancy() >= self.degraded_hbm_occupancy:
             return "degraded"
         return "healthy"
 

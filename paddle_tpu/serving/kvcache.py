@@ -423,9 +423,32 @@ class _PagedKVMixin:
         """Device bytes of the paged K+V pool (full, pre-tp-split)."""
         return int(2 * 4 * np.prod(self._pool_shape))
 
+    def _mem_kv_detail(self) -> Dict[str, int]:
+        """Ledger detail callback (obs/mem.py): the pool's bytes broken
+        out by page state — free/active/prefix-cached — evaluated lazily
+        at snapshot/dump time only."""
+        info = self.kv_pages_info()
+        per_page = self.kv_pool_bytes() // (self.pool_pages + 1)
+        return {st: info.get(st, 0) * per_page
+                for st in ("free", "active", "cached")}
+
     # -- page allocation --
     def _alloc_pages(self, n: int) -> List[int]:
         pool = self.page_pool
+        # measured-headroom admission hook (obs/mem.py, docs §28): when
+        # the ledger reports occupancy above obs_mem_admission_watermark,
+        # reclaim prefix-cache pages alongside this claim — admission
+        # consults MEASURED pressure, not the modeled account alone. One
+        # attribute read when the ledger is off (bit-identical admission).
+        from ..obs.mem import get_ledger
+
+        led = get_ledger()
+        if led.enabled and self.prefix_cache is not None:
+            from ..flags import get_flag
+
+            wm = float(get_flag("obs_mem_admission_watermark"))
+            if wm > 0.0 and led.above_watermark(wm):
+                self.prefix_cache.evict(n)
         deficit = n - pool.free_count
         if deficit > 0 and self.prefix_cache is not None:
             self.prefix_cache.evict(deficit)

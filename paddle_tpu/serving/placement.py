@@ -306,6 +306,26 @@ class ModelProfile:
         return 2.0 * 4 * c["n_layers"] * (pages + 1) * page_len \
             * c["d_model"]
 
+    def mem_account(self, slots: Optional[int] = None, paged: bool = False,
+                    page_len: int = 16, overcommit: float = 2.0,
+                    quant_mode: Optional[str] = None) -> Dict[str, float]:
+        """Planned bytes per ledger component (obs/mem.py taxonomy): the
+        analytic side of ``MemoryLedger.reconcile_model``. Keys match the
+        ledger's component names so the drift findings line up 1:1 —
+        ``weights`` is the stored param account under ``quant_mode`` (or
+        this profile's own mode), ``kv_pool`` the dense or paged decode
+        pool for ``slots`` generation slots (omitted when ``slots`` is
+        None, i.e. a prefill-only engine holds no pool)."""
+        prof = self.quantize(quant_mode) if quant_mode else self
+        account = {"weights": float(prof.param_bytes)}
+        if slots is not None:
+            if paged:
+                account["kv_pool"] = prof.decode_paged_pool_bytes(
+                    slots, page_len=page_len, overcommit=overcommit)
+            else:
+                account["kv_pool"] = prof.decode_pool_bytes(slots)
+        return account
+
     def as_dict(self) -> Dict[str, Any]:
         return {"cfg": dict(self.cfg), "source": self.source,
                 "param_bytes": self.param_bytes,

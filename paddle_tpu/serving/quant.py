@@ -319,6 +319,25 @@ def quantize_export(dirname: str, mode: str = "int8",
 # ---------------------------------------------------------------------------
 
 
+def quantized_mem_detail(params) -> Dict[str, int]:
+    """q/s/f32 byte split of a quantized param store — the memory
+    ledger's lazy ``detail`` callback for quantized weight entries
+    (obs/mem.py): the int store and its per-channel scales are
+    accounted separately in snapshots and OOM bundles."""
+    from .engine import _flat_items
+
+    out = {"q_bytes": 0, "s_bytes": 0, "f32_bytes": 0}
+    for path, leaf in _flat_items(params):
+        nb = int(getattr(leaf, "nbytes", 0))
+        if path.endswith(".q"):
+            out["q_bytes"] += nb
+        elif path.endswith(".s"):
+            out["s_bytes"] += nb
+        else:
+            out["f32_bytes"] += nb
+    return out
+
+
 class QuantizedServingEngine(ServingEngine):
     """One-shot predict over a weight-only quantized param store — a
     drop-in ``ServingEngine`` whose compiled step is
@@ -359,6 +378,11 @@ class QuantizedServingEngine(ServingEngine):
         with jax.default_device(self._device):
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, self._device), qhost)
+
+    def _mem_weights_detail(self):
+        with self._lock:
+            params = self._params
+        return quantized_mem_detail(params)
 
     # -- compile cache: predict_forward over the quantized store --
     def _make_fn(self, sig: Tuple):
@@ -458,6 +482,11 @@ class QuantizedDecodeEngine(DecodeEngine):
         if not is_quantized_params(host_params):
             host_params = quantize_params(host_params, self.quant_mode)
         return super()._device_put_params(host_params)
+
+    def _mem_weights_detail(self):
+        with self._lock:
+            params = self._params
+        return quantized_mem_detail(params)
 
     def _stage_transform(self, staged: Dict[str, Any]) -> Dict[str, Any]:
         # reload staging through the quantizer: the staged set quantizes

@@ -328,6 +328,12 @@ class ServingServer(socketserver.ThreadingTCPServer):
             from ..flags import get_flag
             from .quant import adopt_tuned, resolve_quantize
 
+            # memory ledger (obs/mem.py, docs §28): arm from flags BEFORE
+            # any engine builds — weight stores and KV pools register at
+            # engine construction
+            from ..obs.mem import init_from_flags as mem_from_flags
+
+            mem_from_flags()
             if quantize is None:
                 # the flag is a fleet-wide default for dirname-built
                 # servers ONLY: a prebuilt engine (possibly already
@@ -536,6 +542,14 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 self.batcher.accountant = self.accountant
                 if self.gen_batcher is not None:
                     self.gen_batcher.accountant = self.accountant
+            # memory ledger (docs §28): pt_mem_* pull gauges on THIS
+            # server's /metrics page (scraped_gauges rolls occupancy /
+            # unattributed bytes / kv share fleet-wide)
+            from ..obs.mem import get_ledger as _get_mem_ledger
+
+            self._mem_ledger = _get_mem_ledger()
+            if self._mem_ledger.enabled:
+                self._mem_ledger.export_gauges(self.stats.registry)
             if log_json:
                 # structured-logging bridge: every event (health
                 # transitions, sheds, reload commits, faults) becomes one
@@ -929,6 +943,13 @@ class ServingServer(socketserver.ThreadingTCPServer):
         self.batcher.close()  # serves anything still queued, then stops
         self.shutdown()
         self.server_close()
+        # memory-ledger hygiene (leak gate c): a closed replica's stores
+        # drop off the ledger — remove_replica(drain=True) returns the
+        # fleet's attributed bytes to baseline
+        for eng in (self.engine, self.decode_engine):
+            release = getattr(eng, "_mem_release", None)
+            if release is not None:
+                release()
 
     def install_signal_handlers(self, signals=(signal.SIGTERM, signal.SIGINT)):
         """SIGTERM/SIGINT -> graceful drain + close. Main thread only (a

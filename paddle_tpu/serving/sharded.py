@@ -109,6 +109,12 @@ class _ShardedParamStore:
     sharded device placement, the per-shard HBM account, and the
     cost-model comm attribution (plan term per dispatch)."""
 
+    def _mem_shard_label(self):
+        """Ledger mesh annotation (obs/mem.py): which axes this engine's
+        stores are split over — "dp2xtp4" — so per-shard entries in OOM
+        bundles name their layout."""
+        return f"dp{self.dp}xtp{self.tp}"
+
     def _comm_profile(self):
         """The analytic profile the comm attribution prices gathers with
         — built ONCE (the cfg is frozen; this sits on the hot path)."""

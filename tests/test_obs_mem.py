@@ -1,0 +1,621 @@
+"""Device-memory ledger: measured HBM attribution (ISSUE 20, docs §28).
+
+Acceptance contract: every framework-owned device allocation registers
+with the ledger; ``reconcile()`` closes the books against a bounded
+``jax.live_arrays()`` walk (an injected UNREGISTERED allocation must
+surface as unattributed — the negative control); ``reconcile_model()``
+audits the analytic placement byte account with typed drift findings;
+RESOURCE_EXHAUSTED trips a schema-valid flight bundle whose ``doctor``
+finding ranks the suspect component; leak gates prove generation
+retirement, hot reload, and replica removal return the books to
+baseline; and with the flag off every path is bit-identical, with
+``track()`` returning one shared no-op sentinel (the PR-5 discipline).
+
+Everything runs on JAX_PLATFORMS=cpu (conftest) with tiny models — fast
+tier, except the flat-high-water soak (slow-marked).
+"""
+import gc
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags as ptflags
+from paddle_tpu.obs.mem import (COMPONENTS, NOOP_ALLOCATION, MemoryLedger,
+                                get_ledger)
+from paddle_tpu.obs.metrics import MetricsRegistry
+
+from test_serving_decode import _export_lm
+
+V = 97  # matches test_serving_decode's tiny LM export
+
+
+@pytest.fixture(scope="module")
+def lm_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("obs_mem")
+    return (_export_lm(str(root / "a"), seed=11),
+            _export_lm(str(root / "b"), seed=47))
+
+
+@pytest.fixture()
+def armed():
+    """The process ledger, enabled for one test and restored after —
+    the flag comes back to default so unrelated tests keep the
+    zero-cost disabled path."""
+    led = get_ledger()
+    ptflags.set_flag("obs_mem", True)
+    led.clear()
+    led.enable()
+    try:
+        yield led
+    finally:
+        led.disable()
+        led.clear()
+        led.set_capacity(0)
+        ptflags.set_flag("obs_mem", False)
+
+
+def _cli():
+    spec = importlib.util.spec_from_file_location(
+        "paddle_cli", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "paddle_cli.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    return cli
+
+
+# ---------------------------------------------------------------------------
+# the PR-5 discipline: zero-cost when disabled
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_track_returns_shared_noop_singleton():
+    led = MemoryLedger()
+    a = led.track("weights", "w", 1024)
+    b = led.track("kv_pool", "kv", np.zeros((4, 4), dtype=np.float32))
+    assert a is NOOP_ALLOCATION and b is NOOP_ALLOCATION  # identity, not ==
+    assert a is get_ledger().track("other", "x", 1)  # default ledger too
+    a.resize(1 << 30)
+    a.release()  # no-ops, never raise
+    assert led.totals() == {} and led.device_bytes() == 0
+    assert not hasattr(NOOP_ALLOCATION, "__dict__")  # __slots__ = ()
+
+
+def test_disabled_generation_is_bit_identical(lm_dirs):
+    """Flag off vs on: the greedy stream never changes — the ledger only
+    observes bytes, it is never on the math path."""
+    from paddle_tpu.serving.decode import DecodeEngine, generate_sequential
+
+    prompts = [np.arange(5) % V, np.arange(3) % V]
+
+    def run():
+        eng = DecodeEngine(lm_dirs[0], max_slots=2)
+        try:
+            return generate_sequential(eng, prompts, [8, 8])
+        finally:
+            eng._mem_release()
+
+    off = run()
+    led = get_ledger()
+    ptflags.set_flag("obs_mem", True)
+    led.enable()
+    try:
+        on = run()
+    finally:
+        led.disable()
+        led.clear()
+        ptflags.set_flag("obs_mem", False)
+    assert [list(map(int, t)) for t in off] == [list(map(int, t)) for t in on]
+
+
+# ---------------------------------------------------------------------------
+# core bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_track_resize_release_totals_and_high_water():
+    led = MemoryLedger(registry=MetricsRegistry())
+    led.enable(capacity_bytes=10_000)
+    w = led.track("weights", "store", 4000, shard="dp1xtp2", dtype="f32")
+    kv = led.track("kv_pool", "pool", np.zeros((250,), dtype=np.float32))
+    assert led.totals() == {"weights": 4000, "kv_pool": 1000}
+    assert led.device_bytes() == 5000
+    assert led.occupancy() == pytest.approx(0.5)
+    assert led.headroom() == 5000
+    assert led.above_watermark(0.4) and not led.above_watermark(0.6)
+    kv.resize(3000)
+    assert led.totals()["kv_pool"] == 3000
+    kv.resize(500)  # shrink: totals follow, high water does not
+    hw = led.high_water()
+    assert hw["kv_pool"] == 3000 and hw["total"] == 7000
+    w.release()
+    w.release()  # double release is safe
+    assert led.totals() == {"kv_pool": 500}
+    # host allocations never pollute the device books
+    h = led.track("snapshot_host", "snap", 9999, device="host")
+    assert led.device_bytes() == 500
+    assert led.totals(device="host") == {"snapshot_host": 9999}
+    h.release()
+    assert led.totals(device="host") == {}
+    top = led.top_allocations()
+    assert top and top[0]["component"] == "kv_pool"
+
+
+def test_gauges_exported_and_idempotent():
+    reg = MetricsRegistry()
+    led = MemoryLedger(registry=reg)
+    led.enable(capacity_bytes=2000)
+    led.track("kv_pool", "pool", 1500)
+    led.export_gauges(reg)
+    led.export_gauges(reg)  # second call must not duplicate/raise
+    text = reg.expose()
+    assert "pt_mem_tracked_bytes 1500" in text
+    assert "pt_mem_hbm_capacity_bytes 2000" in text
+    assert "pt_mem_hbm_occupancy 0.75" in text
+    assert 'pt_mem_component_bytes{component="kv_pool"} 1500' in text
+    assert "pt_mem_kv_pool_share 1" in text
+    assert "pt_mem_attributed_ratio 1" in text  # no reconcile yet
+    assert "pt_mem_high_water_bytes 1500" in text
+
+
+def test_intervals_ride_the_timeline_dump():
+    led = MemoryLedger(registry=MetricsRegistry())
+    led.enable()
+    a = led.track("weights", "w", 100)
+    time.sleep(0.002)
+    a.release()
+    led.track("kv_pool", "pool", 200)  # still live at dump time
+    d = led.dump_intervals()
+    comps = {iv["component"] for iv in d["intervals"]}
+    assert comps == {"weights", "kv_pool"}
+    live = [iv for iv in d["intervals"] if iv.get("live")]
+    assert len(live) == 1 and live[0]["component"] == "kv_pool"
+    assert all(iv["dur"] >= 0 for iv in d["intervals"])
+    # weights released before kv arrived: peak concurrent total is 200,
+    # per-component marks remember both
+    assert d["high_water"]["total"] == 200
+    assert d["high_water"]["weights"] == 100
+    assert d["high_water_history"]
+
+
+# ---------------------------------------------------------------------------
+# closure surface 1: reconciliation vs jax.live_arrays()
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_closure_and_unregistered_allocation_is_caught():
+    import jax
+
+    led = MemoryLedger(registry=MetricsRegistry())
+    led.enable()
+    gc.collect()
+    baseline = led.reconcile()["live_bytes"]
+    tracked = jax.device_put(np.zeros((1024,), dtype=np.float32))
+    tracked.block_until_ready()
+    led.track("other", "tracked", tracked)
+    rec = led.reconcile(baseline_bytes=baseline)
+    assert rec["attributed_bytes"] == tracked.nbytes
+    assert rec["unattributed_bytes"] == 0
+    assert rec["ratio"] == pytest.approx(1.0)
+    # the negative control: an allocation the ledger never saw MUST grow
+    # the unattributed gauge by its size
+    rogue = jax.device_put(np.zeros((2048,), dtype=np.float32))
+    rogue.block_until_ready()
+    rec2 = led.reconcile(baseline_bytes=baseline)
+    assert rec2["unattributed_bytes"] - rec["unattributed_bytes"] \
+        >= rogue.nbytes
+    assert rec2["ratio"] < 1.0
+    assert led.last_reconcile() == rec2
+    del tracked, rogue
+
+
+def test_reconcile_is_bounded_and_counts_its_own_cost():
+    """CI hygiene: the walk truncates at max_arrays (reported, never
+    silent) and bills its wall cost to pt_mem_reconcile_seconds_total."""
+    import jax
+
+    reg = MetricsRegistry()
+    led = MemoryLedger(registry=reg)
+    led.enable()
+    keep = [jax.device_put(np.zeros((8,), dtype=np.float32))
+            for _ in range(4)]
+    rec = led.reconcile(max_arrays=2)
+    assert rec["truncated"] is True and rec["arrays"] == 2
+    n0 = reg.get("pt_mem_reconcile_total").value
+    led.reconcile(max_arrays=2)
+    assert reg.get("pt_mem_reconcile_total").value == n0 + 1
+    assert reg.get("pt_mem_reconcile_seconds_total").value >= 0.0
+    del keep
+
+
+# ---------------------------------------------------------------------------
+# closure surface 2: model-vs-measured drift
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_model_drift_findings_and_event():
+    from paddle_tpu.obs.events import get_event_log
+
+    led = MemoryLedger(registry=MetricsRegistry())
+    led.enable()
+    led.track("weights", "w", 1000)
+    led.track("kv_pool", "pool", 500)
+    log = get_event_log()
+    log.enable()
+    try:
+        f = {x["component"]: x
+             for x in led.reconcile_model({"weights": 1000, "kv_pool": 1000},
+                                          tolerance=0.1)}
+        assert f["weights"]["within_tolerance"]
+        assert f["weights"]["drift"] == pytest.approx(0.0)
+        assert not f["kv_pool"]["within_tolerance"]
+        assert f["kv_pool"]["drift"] == pytest.approx(-0.5)
+        evs = log.events(type="mem_drift")
+        assert evs and evs[-1].attrs["component"] == "kv_pool"
+        assert evs[-1].severity == "warn"
+        # a component the plan never budgeted is always a finding
+        led.track("prefetch", "surprise", 64)
+        f2 = {x["component"]: x
+              for x in led.reconcile_model({"weights": 1000}, tolerance=10.0)}
+        assert not f2["prefetch"]["within_tolerance"]
+    finally:
+        log.disable()
+
+
+def test_mem_account_matches_real_engine_bytes(lm_dirs, armed):
+    """The analytic ModelProfile.mem_account lines up with the measured
+    registration to the byte on a real decode engine — drift 0."""
+    from paddle_tpu.serving.decode import DecodeEngine
+    from paddle_tpu.serving.placement import profile_export
+
+    eng = DecodeEngine(lm_dirs[0], max_slots=4)
+    try:
+        account = profile_export(
+            lm_dirs[0], xla_cost=False).mem_account(slots=4)
+        f = {x["component"]: x for x in armed.reconcile_model(account)}
+        assert f["weights"]["drift"] == pytest.approx(0.0)
+        assert f["kv_pool"]["drift"] == pytest.approx(0.0)
+    finally:
+        eng._mem_release()
+
+
+# ---------------------------------------------------------------------------
+# OOM postmortem: bundle + doctor attribution
+# ---------------------------------------------------------------------------
+
+
+class _FakeXlaError(RuntimeError):
+    pass
+
+
+def test_oom_trips_schema_valid_bundle_and_doctor_ranks_component(
+        tmp_path, armed):
+    from paddle_tpu.obs.events import get_event_log
+    from paddle_tpu.obs.flight import get_recorder, validate_bundle
+
+    rec = get_recorder()
+    rec.clear()
+    old_dir = rec.dir
+    rec.dir = str(tmp_path)
+    log = get_event_log()
+    log.enable()
+    armed.set_capacity(10_000)
+    armed.track("kv_pool", "pool", 6100)
+    armed.track("weights", "w", 2000)
+    try:
+        exc = _FakeXlaError("RESOURCE_EXHAUSTED: out of memory allocating "
+                            "1.5G on device")
+        assert MemoryLedger.is_oom(exc)
+        assert not MemoryLedger.is_oom(ValueError("shape mismatch"))
+        path = armed.handle_oom(exc, component="decode_dispatch", lanes=3)
+        assert path and os.path.exists(path)
+        bundle = json.loads(open(path).read())
+        assert validate_bundle(bundle) == []
+        mem = bundle["providers"]["mem_ledger"]
+        assert mem["oom_count"] == 1
+        assert mem["totals"]["kv_pool"] == 6100
+        assert mem["high_water"]["total"] == 8100
+        evs = [e for e in bundle["events"] if e["type"] == "oom"]
+        assert evs and evs[-1]["severity"] == "error"
+        assert evs[-1]["attrs"]["component"] == "decode_dispatch"
+        # doctor ranks the component holding the most HBM at failure
+        findings = _cli().doctor_findings(bundle)
+        oom = [(s, t) for s, t in findings if "suspect kv_pool" in t]
+        assert oom, findings
+        score, text = oom[0]
+        assert score >= 50
+        assert "75%" in text  # 6100 / 8100 tracked bytes
+        # a second OOM inside the rate-limit window: counted, not dumped
+        assert armed.handle_oom(exc, component="decode_dispatch") is None
+        assert armed.snapshot()["oom_count"] == 2
+    finally:
+        log.disable()
+        rec.dir = old_dir
+        rec.clear()
+
+
+# ---------------------------------------------------------------------------
+# registration sites: real engines put real bytes on the books
+# ---------------------------------------------------------------------------
+
+
+def test_decode_engine_registers_weights_and_pool(lm_dirs, armed):
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    eng = DecodeEngine(lm_dirs[0], max_slots=2)
+    try:
+        t = armed.totals()
+        assert t["weights"] == eng.weights_bytes()
+        assert t["kv_pool"] == eng.pool_k.nbytes + eng.pool_v.nbytes
+    finally:
+        eng._mem_release()
+    assert armed.totals() == {}
+
+
+def test_hot_reload_swaps_not_stacks_weight_stores(lm_dirs, armed):
+    """Leak gate: commit_params drops the old weight store — the books
+    never show two resident versions."""
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    eng = DecodeEngine(lm_dirs[0], max_slots=2)
+    try:
+        before = armed.totals()["weights"]
+        staged = eng.stage_params(lm_dirs[1])  # same arch, new weights
+        eng.commit_params(staged)
+        assert armed.totals()["weights"] == before
+    finally:
+        eng._mem_release()
+
+
+def test_generation_retirement_frees_pages_and_carry(lm_dirs, armed):
+    """Leak gate: after every generation retires, the paged pool's
+    active span is zero and the decode carry is off the books."""
+    from paddle_tpu.serving.decode import GenerationBatcher
+    from paddle_tpu.serving.kvcache import PagedDecodeEngine
+
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=8,
+                            pool_pages=16)
+    try:
+        gb = GenerationBatcher(eng, queue_capacity=4)
+        try:
+            futs = [gb.submit(np.arange(4) % V, max_new_tokens=6)
+                    for _ in range(3)]
+            for f in futs:
+                f.result(timeout=120)
+        finally:
+            gb.close()
+        assert "decode_carry" not in armed.totals()  # released with the loop
+        detail = eng._mem_kv_detail()
+        assert detail["active"] == 0  # every page span retired
+        assert detail["free"] + detail["cached"] > 0
+        # the kv_pool ledger entry carries the same split lazily
+        kv = [a for a in armed.top_allocations()
+              if a["component"] == "kv_pool"]
+        assert kv and kv[0]["detail"]["active"] == 0
+    finally:
+        eng._mem_release()
+    assert armed.totals() == {}
+
+
+def test_quantized_engine_reports_q_s_split(lm_dirs, armed):
+    from paddle_tpu.serving.quant import QuantizedDecodeEngine
+
+    eng = QuantizedDecodeEngine(lm_dirs[0], mode="int8", max_slots=2)
+    try:
+        w = [a for a in armed.top_allocations()
+             if a["component"] == "weights"]
+        assert w and w[0]["dtype"] == "int8"
+        d = w[0]["detail"]
+        assert d["q_bytes"] > 0 and d["s_bytes"] > 0
+        assert d["q_bytes"] + d["s_bytes"] + d["f32_bytes"] \
+            == eng.weights_bytes()
+    finally:
+        eng._mem_release()
+
+
+def test_fleet_remove_replica_returns_books_to_baseline(armed, tmp_path):
+    """Leak gate: remove_replica(drain=True) + server shutdown drops the
+    replica's whole footprint; the scraped mem gauges feed the router's
+    degraded signal."""
+    from paddle_tpu.serving.fleet import LocalFleet
+    from test_serving_chaos import _export
+
+    model = _export(str(tmp_path / "m"), seed=21)
+    fl = LocalFleet(model, 2, router_kwargs={"scrape_interval_s": 0.05},
+                    warmup=False)
+    try:
+        both = armed.device_bytes()
+        assert both > 0 and both % 2 == 0  # two identical replicas
+        # worst-replica HBM occupancy >= the bar -> fleet degrades
+        armed.set_capacity(both)
+        deadline = time.monotonic() + 5
+        while fl.router.worst_hbm_occupancy() < 0.95 \
+                and time.monotonic() < deadline:
+            fl.router.scrape_now()
+            time.sleep(0.02)
+        assert fl.router.worst_hbm_occupancy() == pytest.approx(1.0)
+        assert fl.router.fleet_state() == "degraded"
+        fl.router.degraded_hbm_occupancy = 2.0  # un-bar: healthy again
+        assert fl.router.fleet_state() == "healthy"
+        ep0 = fl.servers[0].endpoint
+        assert fl.router.remove_replica(ep0, drain=True)
+        fl.kill_replica(0)  # close() releases the engines' ledger handles
+        assert armed.device_bytes() == both // 2
+    finally:
+        fl.close()
+    assert armed.device_bytes() == 0
+
+
+def test_prefetcher_stages_and_releases(armed):
+    from paddle_tpu.reader.prefetch import DevicePrefetcher
+
+    batches = [{"x": np.zeros((4, 8), dtype=np.float32)} for _ in range(3)]
+    pf = DevicePrefetcher(lambda: iter(batches), depth=2)
+    seen_staged = 0
+    for _ in pf():
+        # the filler stages ahead of the consumer; poll briefly for the
+        # component to show up while batches are still queued
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            seen_staged = max(seen_staged,
+                              armed.totals().get("prefetch", 0))
+            if seen_staged:
+                break
+            time.sleep(0.005)
+    assert seen_staged > 0  # bytes were on the books mid-pipeline
+    assert "prefetch" not in armed.totals()  # handle released at the end
+
+
+def test_executor_compile_cache_bytes(armed):
+    """The executor's retained-executable account rides the cost-analysis
+    bytes; eviction resizes it down."""
+    ptflags.set_flag("obs_cost_analysis", True)
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[4], dtype="float32")
+                y = fluid.layers.fc(x, size=3)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            exe.run(startup, scope=scope, seed=0)
+            exe.run(main, feed={"x": np.zeros((2, 4), dtype=np.float32)},
+                    fetch_list=[y], scope=scope)
+        assert armed.totals().get("compile_cache", 0) > 0
+    finally:
+        ptflags.set_flag("obs_cost_analysis", False)
+
+
+# ---------------------------------------------------------------------------
+# fleet scrape contract + timeline lane
+# ---------------------------------------------------------------------------
+
+
+def test_scraped_gauges_mem_keys_and_defaults():
+    from paddle_tpu.serving.fleet import scraped_gauges
+
+    text = ("pt_mem_hbm_occupancy 0.83\n"
+            "pt_mem_unattributed_bytes 4096\n"
+            "pt_mem_kv_pool_share 0.61\n")
+    g = scraped_gauges({}, text)
+    assert g["hbm_occupancy"] == pytest.approx(0.83)
+    assert g["mem_unattributed"] == 4096.0
+    assert g["kv_pool_share"] == pytest.approx(0.61)
+    # absence of measurement reads as NO pressure, never as full
+    g = scraped_gauges({}, "")
+    assert g["hbm_occupancy"] == 0.0 and g["mem_unattributed"] == 0.0
+
+
+def test_fleet_report_mem_columns():
+    cli = _cli()
+    row = {"endpoint": "h:1", "health": "healthy", "circuit": "closed",
+           "queue": 0, "capacity": 8, "occupancy": 0, "mfu": "-",
+           "shards": 1, "weights": 1, "quant": "f32", "kv": "-",
+           "goodput": "-", "accept": "-", "hbm": "83%", "unattr": "4.0M",
+           "kvshare": "61%", "decode": ""}
+    text = cli.fleet_report([row])
+    assert "hbm" in text and "83%" in text
+    assert "unattr" in text and "4.0M" in text and "61%" in text
+
+
+def test_timeline_memory_lane():
+    import importlib.util as iu
+
+    spec = iu.spec_from_file_location(
+        "timeline", os.path.join(os.path.dirname(__file__), "..",
+                                 "tools", "timeline.py"))
+    tl = iu.module_from_spec(spec)
+    spec.loader.exec_module(tl)
+    led = MemoryLedger(registry=MetricsRegistry())
+    led.enable()
+    a = led.track("weights", "w", 100)
+    led.track("kv_pool", "pool", 200)
+    a.release()
+    dump = led.dump_intervals()
+    trace = json.loads(tl.to_chrome_trace({"events": []}, mem=dump))
+    meta = [e for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["pid"] == 3]
+    assert meta and meta[0]["args"]["name"] == "memory components"
+    regions = [e for e in trace["traceEvents"]
+               if e.get("ph") == "X" and e["pid"] == 3]
+    comps = {e["name"].split(":")[0] for e in regions}
+    assert comps == {"weights", "kv_pool"}
+    assert {e["tid"] for e in regions} == {0, 1}  # one lane per component
+    counters = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+    assert counters and counters[-1]["args"]["bytes"] >= 0
+    assert all(e["ts"] >= 0 for e in regions + counters)
+
+
+# ---------------------------------------------------------------------------
+# measured-headroom admission + soak
+# ---------------------------------------------------------------------------
+
+
+def test_paged_admission_watermark_evicts_prefix_cache(lm_dirs, armed):
+    """Above the measured watermark, page allocation sheds prefix-cache
+    pages first (the measured-headroom admission hook); with no capacity
+    declared the hook is inert."""
+    from paddle_tpu.serving.decode import GenerationBatcher
+    from paddle_tpu.serving.kvcache import PagedDecodeEngine
+
+    eng = PagedDecodeEngine(lm_dirs[0], max_slots=2, page_len=8,
+                            pool_pages=16)
+    try:
+        template = (np.arange(10) % V).astype(np.int64)
+
+        def warm_once():
+            gb = GenerationBatcher(eng, queue_capacity=4)
+            try:
+                gb.submit(np.concatenate([template, [3]]),
+                          max_new_tokens=4).result(timeout=120)
+            finally:
+                gb.close()
+
+        warm_once()  # interns the template pages into the prefix cache
+        cached0 = eng.kv_pages_info()["cached"]
+        assert cached0 > 0
+        armed.set_capacity(armed.device_bytes())  # occupancy == 1.0
+        # watermark flag unset (0.0): the hook is inert even at full HBM
+        pages = eng._alloc_pages(1)
+        assert eng.kv_pages_info()["cached"] == cached0
+        eng.page_pool.free(pages)
+        # armed: each admission above the watermark sheds cached pages
+        ptflags.set_flag("obs_mem_admission_watermark", 0.5)
+        pages = eng._alloc_pages(1)
+        assert eng.kv_pages_info()["cached"] == cached0 - 1
+        eng.page_pool.free(pages)
+    finally:
+        ptflags.set_flag("obs_mem_admission_watermark", 0.0)
+        eng._mem_release()
+
+
+@pytest.mark.slow
+def test_soak_high_water_is_flat(lm_dirs, armed):
+    """Leak soak: repeated generation rounds on one engine never raise
+    the high-water mark after the first round."""
+    from paddle_tpu.serving.decode import DecodeEngine, GenerationBatcher
+
+    eng = DecodeEngine(lm_dirs[0], max_slots=2)
+    rng = np.random.RandomState(3)
+    try:
+        def round_():
+            gb = GenerationBatcher(eng, queue_capacity=4)
+            try:
+                futs = [gb.submit(rng.randint(0, V, size=(5,)),
+                                  max_new_tokens=6) for _ in range(3)]
+                for f in futs:
+                    f.result(timeout=120)
+            finally:
+                gb.close()
+
+        round_()
+        hw1 = armed.high_water()["total"]
+        for _ in range(5):
+            round_()
+        assert armed.high_water()["total"] == hw1
+    finally:
+        eng._mem_release()

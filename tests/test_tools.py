@@ -356,10 +356,14 @@ def test_bench_judges_its_own_bars(tmp_path, capsys):
     # the sharded data-parallel training workload, r16/ISSUE-16 the
     # speculative-decode commit ratio, r17/ISSUE-17 the fault-tolerant
     # training recovery contract, r18/ISSUE-18 the 3D-training hidden-
-    # collective overlap ratio)
-    assert len(bench.BARS) == 16
+    # collective overlap ratio, r20/ISSUE-20 the device-memory ledger
+    # attribution-closure contract)
+    assert len(bench.BARS) == 17
     res = bench.BARS["resilient_training_recovery"]
     assert res["field"] == "value" and res["min"] == 0.95
+    mem = bench.BARS["memory_ledger_closure"]
+    assert mem["field"] == "value" and mem["min"] == 0.95
+    assert "UNREGISTERED" in mem["source"]
     t3d = bench.BARS["train_3d_hidden_collective_ratio"]
     assert t3d["field"] == "value" and t3d["min"] == 0.5
     assert "BIT-IDENTICAL" in t3d["source"]

@@ -211,7 +211,8 @@ def fleet_rows(endpoints, timeout=3.0):
         row = {"endpoint": ep, "health": "unreachable", "circuit": "open",
                "queue": "-", "capacity": "-", "occupancy": "-", "mfu": "-",
                "shards": "-", "weights": "-", "quant": "-", "kv": "-",
-               "goodput": "-", "accept": "-", "decode": ""}
+               "goodput": "-", "accept": "-", "hbm": "-", "unattr": "-",
+               "kvshare": "-", "decode": ""}
         try:
             with ServingClient(ep, timeout=timeout) as c:
                 hz = c.healthz()
@@ -246,6 +247,19 @@ def fleet_rows(endpoints, timeout=3.0):
                 used = int(m["kv_pages_active"] + m["kv_pages_cached"])
                 row["kv"] = (f"{used}/{total_pg}pg "
                              f"{m.get('prefix_hit_rate', 0.0):.0%}")
+            # memory-ledger columns (docs §28): measured HBM occupancy
+            # against the replica's declared capacity, live bytes no
+            # component claimed (the reconciliation gap), and the KV
+            # pool's share of tracked bytes ("-" = no ledger/capacity)
+            occ_hbm = float(m.get("hbm_occupancy", 0.0))
+            if occ_hbm > 0.0:
+                row["hbm"] = f"{occ_hbm:.0%}"
+            unattr = float(m.get("mem_unattributed", 0.0))
+            if unattr > 0.0:
+                row["unattr"] = f"{unattr / 2**20:.1f}M"
+            share = float(m.get("kv_pool_share", 0.0))
+            if share > 0.0:
+                row["kvshare"] = f"{share:.0%}"
             d = hz.get("decode")
             if d:
                 row["decode"] = (f"{d['active_slots']}/{d['max_slots']} "
@@ -310,7 +324,7 @@ def fleet_report(rows):
     lines = [f"{'replica':<24}{'health':<12}{'circuit':<9}{'queue':>9}"
              f"{'occ':>5}{'mfu':>11}{'shards':>7}{'quant':>7}"
              f"{'weights':>9}{'kv':>15}{'goodput':>9}{'accept':>8}"
-             f"  decode"]
+             f"{'hbm':>6}{'unattr':>9}{'kvshare':>9}  decode"]
     for r in rows:
         q = (f"{r['queue']}/{r['capacity']}"
              if r["queue"] != "-" else "-")
@@ -322,7 +336,10 @@ def fleet_report(rows):
                      f"{str(r['weights']):>9}"
                      f"{str(r.get('kv', '-')):>15}"
                      f"{str(r.get('goodput', '-')):>9}"
-                     f"{str(r.get('accept', '-')):>8}  {r['decode']}")
+                     f"{str(r.get('accept', '-')):>8}"
+                     f"{str(r.get('hbm', '-')):>6}"
+                     f"{str(r.get('unattr', '-')):>9}"
+                     f"{str(r.get('kvshare', '-')):>9}  {r['decode']}")
     healthy = sum(1 for r in rows if r["health"] == "healthy")
     lines.append(f"{healthy}/{len(rows)} replicas healthy")
     return "\n".join(lines)
@@ -444,6 +461,38 @@ def doctor_findings(bundle):
                              f"with grace snapshot serial(s) "
                              f"{serials[:5]} — the resumed run continues "
                              f"bit-exactly from there"))
+        elif typ == "oom":
+            # memory postmortem (docs §28): the ledger snapshot rode the
+            # bundle (mem_ledger provider) — rank the component holding
+            # the most HBM at failure, and if the model-drift findings
+            # put it above its analytic plan, say by how much
+            mem = (bundle.get("providers") or {}).get("mem_ledger") or {}
+            mtotals = mem.get("totals") or {}
+            dev = float(mem.get("device_bytes") or 0.0) \
+                or float(sum(mtotals.values()))
+            comps = sorted({(x.get("attrs") or {}).get("component")
+                            for x in evs} - {None})
+            text = (f"OOM: {len(evs)} RESOURCE_EXHAUSTED dispatch(es)"
+                    + (f" at {', '.join(comps)}" if comps else ""))
+            if mtotals and dev > 0:
+                suspect, nbytes = max(mtotals.items(), key=lambda kv: kv[1])
+                text += (f" — suspect {suspect}: {nbytes / dev:.0%} of "
+                         f"tracked HBM at failure "
+                         f"({nbytes / 2**30:.2f} GiB)")
+                for d in mem.get("drift") or []:
+                    if d.get("component") == suspect \
+                            and not d.get("within_tolerance"):
+                        over = (float(d.get("measured_bytes", 0.0))
+                                - float(d.get("planned_bytes", 0.0)))
+                        if over > 0:
+                            text += (f", {over / 2**30:.2f} GiB above "
+                                     f"the placement plan")
+            unattr = float((mem.get("reconcile") or {})
+                           .get("unattributed_bytes", 0.0) or 0.0)
+            if unattr > 0:
+                text += (f"; {unattr / 2**20:.1f} MiB live but "
+                         f"unattributed (possible leak)")
+            findings.append((score * 6, text))
         elif typ == "slo_breach":
             slos = {}
             for x in evs:

@@ -811,6 +811,10 @@ def main(argv=None):
                     help="arm the goodput accountant (docs §23) in the "
                          "in-process server(s) and print the per-category "
                          "request-second breakdown + goodput ratio")
+    ap.add_argument("--mem", action="store_true",
+                    help="arm the device-memory ledger (docs §28) in the "
+                         "in-process server(s) and print the per-component "
+                         "HBM table + high-water line after the run")
     args = ap.parse_args(argv)
     if args.goodput:
         # must land before server construction: the server binds its
@@ -818,6 +822,12 @@ def main(argv=None):
         from paddle_tpu import flags as ptflags
 
         ptflags.set_flag("obs_goodput", True)
+    if args.mem:
+        # same ordering rule: engine construction registers its weight
+        # stores and pools only when the ledger is already enabled
+        from paddle_tpu import flags as ptflags
+
+        ptflags.set_flag("obs_mem", True)
     if args.prefix_mix:
         args.generate = True  # the prefix mix IS a generation workload
     if args.log_json:
@@ -981,6 +991,32 @@ def _print_goodput(s):
                  for c, v in sorted(cats.items(), key=lambda kv: -kv[1])
                  if v > 0]
         print("  request-seconds by category: " + " ".join(parts))
+
+
+def _print_mem():
+    """Print the in-process memory ledger's per-component table +
+    high-water line (armed by --mem / obs_mem, docs §28). The in-process
+    server shares this process's ledger, so the table IS the server's
+    HBM attribution at bench end."""
+    from paddle_tpu.obs.mem import get_ledger
+
+    led = get_ledger()
+    if not led.enabled:
+        return
+    totals = led.totals()
+    hw = led.high_water()
+    dev = led.device_bytes()
+    print(f"memory ledger: {dev / 2**20:.2f} MiB tracked on device, "
+          f"high water {hw.get('total', 0) / 2**20:.2f} MiB"
+          + (f", occupancy {led.occupancy():.1%}" if led.capacity else ""))
+    for comp, nbytes in sorted(totals.items(), key=lambda kv: -kv[1]):
+        share = nbytes / dev if dev else 0.0
+        print(f"  {comp:<14} {nbytes / 2**20:10.2f} MiB ({share:.0%})  "
+              f"high water {hw.get(comp, 0) / 2**20:.2f} MiB")
+    host = led.totals(device="host")
+    if host:
+        parts = [f"{c}={v / 2**20:.2f}MiB" for c, v in sorted(host.items())]
+        print("  host buffers: " + " ".join(parts))
 
 
 def _main_single(args, shapes, tracer, retries, quantize=None):
@@ -1160,6 +1196,7 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
                 _print_goodput(s)
                 if "chaos" in s:
                     print(f"chaos: {s['chaos']}")
+            _print_mem()
             if tracer is not None:
                 n = tracer.dump(args.trace_out)
                 print(f"chrome trace: {args.trace_out} ({n} spans)")
@@ -1217,6 +1254,7 @@ def _main_single(args, shapes, tracer, retries, quantize=None):
             _print_goodput(s)
             if "chaos" in s:
                 print(f"chaos: {s['chaos']}")
+        _print_mem()
         if tracer is not None:
             n = tracer.dump(args.trace_out)
             print(f"chrome trace: {args.trace_out} ({n} spans; "

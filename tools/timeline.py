@@ -37,6 +37,12 @@ class _ChromeTraceFormatter:
             "ts": timestamp_us, "dur": duration_us, "args": args or {},
         })
 
+    def emit_counter(self, timestamp_us, pid, name, values):
+        self._events.append({
+            "ph": "C", "cat": "mem", "name": name, "pid": pid, "tid": 0,
+            "ts": timestamp_us, "args": values,
+        })
+
     def format_to_string(self, pretty=False):
         trace = {"traceEvents": self._metadata + self._events}
         return json.dumps(trace, indent=4 if pretty else None,
@@ -44,7 +50,7 @@ class _ChromeTraceFormatter:
 
 
 def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None,
-                    goodput: dict = None) -> str:
+                    goodput: dict = None, mem: dict = None) -> str:
     """``obs_trace`` (an ``obs.Tracer.to_chrome_trace()`` dict or a loaded
     dump file) merges into the same timeline: profiler host events land on
     pid 0, obs spans on pid 1. When the obs dump carries its absolute
@@ -57,7 +63,13 @@ def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None,
     ``goodput`` (a ``GoodputAccountant.dump_intervals()`` dump) adds the
     accountant's per-category lanes on pid 2 — one tid per taxonomy
     category, so the category owning a regression is visible as a lane in
-    the same view as the spans it classifies (docs/design.md §23)."""
+    the same view as the spans it classifies (docs/design.md §23).
+
+    ``mem`` (a ``MemoryLedger.dump_intervals()`` dump) adds the memory
+    plane on pid 3 — one tid per ledger component, each allocation's
+    residency as a region (bytes in args), plus a ``hbm total`` counter
+    series from the high-water ring, so an allocation spike lines up
+    against the span that caused it (docs/design.md §28)."""
     f = _ChromeTraceFormatter()
     f.emit_pid("host", 0)
     events = profile.get("events", [])
@@ -102,6 +114,32 @@ def to_chrome_trace(profile: dict, pretty=False, obs_trace: dict = None,
                     duration_us=iv["dur"] * 1e6,
                     pid=2, tid=tid, category="goodput", name=cat,
                     args={"good": bool(iv.get("good"))})
+    if mem:
+        ivs = mem.get("intervals") or []
+        hist = mem.get("high_water_history") or []
+        if ivs or hist:
+            f.emit_pid("memory components", 3)
+            # same rebase rule as the goodput lane: ledger t0s are
+            # absolute monotonic stamps
+            stamps = ([iv["t0"] for iv in ivs]
+                      + [float(h[0]) for h in hist])
+            base = t0 if events else min(stamps)
+            tids = {}  # component -> stable lane id, first-seen order
+            for iv in ivs:
+                comp = iv.get("component", "?")
+                tid = tids.setdefault(comp, len(tids))
+                f.emit_region(
+                    timestamp_us=(iv["t0"] - base) * 1e6,
+                    duration_us=iv["dur"] * 1e6,
+                    pid=3, tid=tid, category="mem",
+                    name=f"{comp}:{iv.get('label', '')}",
+                    args={"bytes": int(iv.get("bytes", 0)),
+                          "device": iv.get("device", "device"),
+                          "live": bool(iv.get("live"))})
+            for h in hist:
+                f.emit_counter(
+                    timestamp_us=(float(h[0]) - base) * 1e6, pid=3,
+                    name="hbm total", values={"bytes": int(h[1])})
     return f.format_to_string(pretty)
 
 
@@ -118,6 +156,10 @@ def main():
                         help="optional goodput interval dump "
                              "(obs.get_accountant().dump_intervals(...)) "
                              "— adds one lane per taxonomy category")
+    parser.add_argument("--mem_path", type=str, default=None,
+                        help="optional memory-ledger interval dump "
+                             "(obs.mem.get_ledger().dump_intervals()) "
+                             "— adds one lane per ledger component")
     args = parser.parse_args()
     with open(args.profile_path) as f:
         profile = json.load(f)
@@ -129,9 +171,13 @@ def main():
     if args.goodput_path:
         with open(args.goodput_path) as f:
             goodput = json.load(f)
+    mem = None
+    if args.mem_path:
+        with open(args.mem_path) as f:
+            mem = json.load(f)
     with open(args.timeline_path, "w") as f:
         f.write(to_chrome_trace(profile, pretty=True, obs_trace=obs_trace,
-                                goodput=goodput))
+                                goodput=goodput, mem=mem))
     print("timeline written to", args.timeline_path)
 
 
